@@ -1,0 +1,182 @@
+"""Central flag table for ray_tpu.
+
+TPU-native analog of the reference's ``RAY_CONFIG`` macro system
+(/root/reference/src/ray/common/ray_config_def.h: 188 flags, overridable via
+``RAY_<NAME>`` env vars or ``ray.init(_system_config=...)``).  Here the table is
+a single Python dataclass-of-record: every knob is declared once with a type and
+default, is overridable via ``RAY_TPU_<NAME>`` environment variables, and can be
+bulk-overridden at ``init(system_config={...})`` time (the dict is serialized to
+every spawned daemon/worker process through its environment).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import threading
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+_SYSTEM_CONFIG_ENV = "RAY_TPU_SYSTEM_CONFIG"
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name: str, type_: type, default: Any, doc: str = ""):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+
+    def parse(self, raw: str) -> Any:
+        if self.type is bool:
+            return raw.lower() in ("1", "true", "yes", "on")
+        if self.type in (dict, list):
+            return json.loads(raw)
+        return self.type(raw)
+
+
+_FLAG_TABLE: Dict[str, _Flag] = {}
+
+
+def _declare(name: str, type_: type, default: Any, doc: str = "") -> None:
+    _FLAG_TABLE[name] = _Flag(name, type_, default, doc)
+
+
+# --------------------------------------------------------------------------- #
+# Core runtime                                                                #
+# --------------------------------------------------------------------------- #
+_declare("inline_object_max_bytes", int, 100 * 1024,
+         "Objects at most this size are inlined in RPC replies / the in-process "
+         "memory store instead of the shared-memory store.")
+_declare("object_store_memory_bytes", int, 2 * 1024**3,
+         "Default per-node shared-memory object store capacity.")
+_declare("object_store_fallback_dir", str, "/tmp",
+         "Directory for fallback-allocated (spilled) store segments.")
+_declare("object_spill_threshold", float, 0.8,
+         "Fraction of store capacity above which primary copies spill to disk.")
+_declare("worker_pool_prestart", int, 0,
+         "Number of workers each node daemon prestarts eagerly.")
+_declare("worker_pool_max_idle", int, 8,
+         "Max idle workers kept alive per node for lease reuse.")
+_declare("worker_start_timeout_s", float, 30.0, "Worker process start timeout.")
+_declare("worker_lease_timeout_s", float, 30.0, "Worker lease RPC timeout.")
+_declare("task_retry_delay_ms", int, 100, "Delay before resubmitting a failed task.")
+_declare("max_direct_call_args_bytes", int, 100 * 1024,
+         "Args bigger than this are put into the object store before submit.")
+_declare("heartbeat_period_ms", int, 250,
+         "Node daemon -> GCS resource/liveness report period.")
+_declare("health_check_failure_threshold", int, 8,
+         "Missed heartbeats before the GCS marks a node dead.")
+_declare("gcs_rpc_timeout_s", float, 30.0, "Client->GCS RPC timeout.")
+_declare("raylet_rpc_timeout_s", float, 30.0, "Client->node-daemon RPC timeout.")
+_declare("actor_creation_timeout_s", float, 60.0, "Actor __init__ readiness timeout.")
+_declare("memory_monitor_refresh_ms", int, 250,
+         "Period of the per-node host-memory monitor; 0 disables it.")
+_declare("memory_usage_threshold", float, 0.95,
+         "Host-memory fraction above which the worker-killing policy engages.")
+_declare("lineage_max_bytes", int, 64 * 1024**2,
+         "Cap on pinned lineage (task specs kept for object reconstruction).")
+_declare("free_objects_period_ms", int, 100,
+         "Batching period for releasing store objects whose refcount hit zero.")
+_declare("pull_chunk_bytes", int, 4 * 1024**2,
+         "Chunk size for inter-node object transfer.")
+_declare("log_to_driver", bool, True, "Forward worker stdout/stderr to the driver.")
+_declare("event_stats", bool, False, "Record per-handler event-loop stats.")
+_declare("task_events_buffer_size", int, 10000,
+         "Ring-buffer capacity of per-worker task state-transition events.")
+
+# --------------------------------------------------------------------------- #
+# TPU / device model                                                          #
+# --------------------------------------------------------------------------- #
+_declare("tpu_chips_per_host", int, 0,
+         "Override detected TPU chip count for the node resource report.")
+_declare("tpu_slice_name", str, "",
+         "Pod-slice identifier this host belongs to (e.g. 'v5e-16/abc'). Hosts "
+         "of one slice form an atomic scheduling bundle.")
+_declare("mesh_default_axes", dict, {"data": -1},
+         "Default mesh axis layout used by JaxTrainer when none is given.")
+
+# --------------------------------------------------------------------------- #
+# Collectives                                                                 #
+# --------------------------------------------------------------------------- #
+_declare("collective_rendezvous_timeout_s", float, 60.0,
+         "Timeout for host-collective group rendezvous via the GCS KV store.")
+_declare("collective_op_timeout_s", float, 120.0, "Host collective op timeout.")
+
+# --------------------------------------------------------------------------- #
+# Libraries                                                                   #
+# --------------------------------------------------------------------------- #
+_declare("data_block_target_bytes", int, 128 * 1024**2,
+         "Target block size for ray_tpu.data datasets.")
+_declare("serve_http_host", str, "127.0.0.1", "Serve proxy bind host.")
+_declare("serve_http_port", int, 8000, "Serve proxy bind port.")
+_declare("serve_controller_loop_ms", int, 100, "Serve controller reconcile period.")
+
+
+class Config:
+    """Process-wide resolved flag values.
+
+    Resolution order (highest wins):
+      1. explicit ``set()`` calls / ``init(system_config=...)``
+      2. ``RAY_TPU_<NAME>`` environment variables
+      3. the JSON blob in ``RAY_TPU_SYSTEM_CONFIG`` (how daemons inherit the
+         driver's overrides)
+      4. declared defaults
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._overrides: Dict[str, Any] = {}
+        blob = os.environ.get(_SYSTEM_CONFIG_ENV)
+        if blob:
+            try:
+                for k, v in json.loads(blob).items():
+                    if k in _FLAG_TABLE:
+                        self._overrides[k] = v
+            except (ValueError, TypeError):
+                pass
+
+    def __getattr__(self, name: str) -> Any:
+        flag = _FLAG_TABLE.get(name)
+        if flag is None:
+            raise AttributeError(f"unknown ray_tpu config flag: {name!r}")
+        with self._lock:
+            if name in self._overrides:
+                value = self._overrides[name]
+                return copy.deepcopy(value) if isinstance(value, (dict, list)) else value
+        raw = os.environ.get(_ENV_PREFIX + name.upper())
+        if raw is not None:
+            try:
+                return flag.parse(raw)
+            except (ValueError, TypeError):
+                pass
+        default = flag.default
+        return copy.deepcopy(default) if isinstance(default, (dict, list)) else default
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in _FLAG_TABLE:
+            raise KeyError(f"unknown ray_tpu config flag: {name!r}")
+        with self._lock:
+            self._overrides[name] = value
+
+    def update(self, overrides: Dict[str, Any]) -> None:
+        for k, v in (overrides or {}).items():
+            self.set(k, v)
+
+    def overrides_env_blob(self) -> str:
+        """Serialized overrides to pass to child processes via env."""
+        with self._lock:
+            return json.dumps(self._overrides)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in _FLAG_TABLE}
+
+
+CONFIG = Config()
+
+
+def flag_docs() -> Dict[str, str]:
+    return {f.name: f.doc for f in _FLAG_TABLE.values()}

@@ -1,0 +1,52 @@
+"""Logging setup shared by drivers, daemons, and workers.
+
+Analog of the reference's spdlog wrapper + per-session log dir layout
+(/root/reference/python/ray/_private/ray_logging.py, src/ray/util/logging.cc):
+every process logs to ``<session_dir>/logs/<component>-<pid>.log`` and,
+for workers, optionally mirrors stdout/stderr there so the driver-side log
+monitor can tail and forward them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s:%(lineno)d -- %(message)s"
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"ray_tpu.{name}")
+
+
+def setup_component_logging(component: str, session_dir: str | None = None,
+                            level: int = logging.INFO) -> str | None:
+    """Configure the root ray_tpu logger; returns the log file path if any."""
+    logger = logging.getLogger("ray_tpu")
+    logger.setLevel(level)
+    logger.propagate = False
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    path = None
+    if session_dir:
+        log_dir = os.path.join(session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        path = os.path.join(log_dir, f"{component}-{os.getpid()}.log")
+        handler: logging.Handler = logging.FileHandler(path)
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    return path
+
+
+def redirect_stdio_to(path_prefix: str) -> None:
+    """Redirect this process's stdout/stderr to files (worker processes)."""
+    parent = os.path.dirname(path_prefix)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    out = open(path_prefix + ".out", "a", buffering=1)
+    err = open(path_prefix + ".err", "a", buffering=1)
+    os.dup2(out.fileno(), sys.stdout.fileno())
+    os.dup2(err.fileno(), sys.stderr.fileno())

@@ -1,0 +1,262 @@
+"""Control-plane RPC: length-prefixed pickled frames over TCP.
+
+Plays the role of the reference's gRPC layer (/root/reference/src/ray/rpc/
+grpc_server.h:73, client_call.h) for the Python control daemons.  Design goals
+match the reference's: full-duplex connections (either side can push), request
+multiplexing over one socket, per-connection ordering (the property the actor
+task queue relies on), and reconnect-free failure surfacing (a dropped
+connection fails all in-flight calls with ``ConnectionError``).
+
+The data plane (large objects) never travels here — it goes through the
+shared-memory store / chunked transfer, mirroring the reference's strict
+control/data plane split (SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu._private.logging_utils import get_logger
+
+logger = get_logger("rpc")
+
+_LEN = struct.Struct("<I")
+_REQUEST, _RESPONSE, _PUSH = 0, 1, 2
+
+
+class RpcError(Exception):
+    pass
+
+
+class RemoteError(RpcError):
+    """Handler raised on the remote side; wraps the original exception."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(repr(cause))
+        self.cause = cause
+
+
+def _send_frame(sock: socket.socket, lock: threading.Lock, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=5)
+    with lock:
+        sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("socket closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class Connection:
+    """One duplex connection; used by both client and server sides."""
+
+    def __init__(self, sock: socket.socket,
+                 handler: Optional[Callable[["Connection", str, Any], Any]] = None,
+                 push_handler: Optional[Callable[[str, Any], None]] = None,
+                 on_close: Optional[Callable[["Connection"], None]] = None):
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._wlock = threading.Lock()
+        self._handler = handler
+        self._push_handler = push_handler
+        self._on_close = on_close
+        self._ids = itertools.count(1)
+        self._inflight: Dict[int, Future] = {}
+        self._inflight_lock = threading.Lock()
+        self._closed = threading.Event()
+        self.peer: Any = None  # attachable identity (e.g. worker id)
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    # ------------------------------------------------------------------ send
+    def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
+        return self.call_async(method, payload).result(timeout)
+
+    def call_async(self, method: str, payload: Any = None) -> Future:
+        fut: Future = Future()
+        msg_id = next(self._ids)
+        with self._inflight_lock:
+            if self._closed.is_set():
+                fut.set_exception(ConnectionError("connection closed"))
+                return fut
+            self._inflight[msg_id] = fut
+        try:
+            _send_frame(self._sock, self._wlock, (_REQUEST, msg_id, method, payload))
+        except OSError as e:
+            with self._inflight_lock:
+                self._inflight.pop(msg_id, None)
+            fut.set_exception(ConnectionError(str(e)))
+        except Exception as e:  # e.g. unpicklable payload
+            with self._inflight_lock:
+                self._inflight.pop(msg_id, None)
+            fut.set_exception(e)
+        return fut
+
+    def push(self, method: str, payload: Any = None) -> None:
+        """Fire-and-forget message (pubsub notifications, log batches)."""
+        try:
+            _send_frame(self._sock, self._wlock, (_PUSH, 0, method, payload))
+        except OSError as e:
+            raise ConnectionError(str(e)) from e
+
+    # ------------------------------------------------------------------ recv
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                kind, msg_id, a, b = _recv_frame(self._sock)
+                if kind == _REQUEST:
+                    threading.Thread(
+                        target=self._handle_request, args=(msg_id, a, b),
+                        daemon=True).start()
+                elif kind == _RESPONSE:
+                    with self._inflight_lock:
+                        fut = self._inflight.pop(msg_id, None)
+                    if fut is not None:
+                        ok, value = a, b
+                        if ok:
+                            fut.set_result(value)
+                        else:
+                            fut.set_exception(RemoteError(value))
+                else:  # _PUSH
+                    if self._push_handler is not None:
+                        try:
+                            self._push_handler(a, b)
+                        except Exception:
+                            logger.exception("push handler failed for %s", a)
+        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
+            pass
+        finally:
+            self.close()
+
+    def _handle_request(self, msg_id: int, method: str, payload: Any) -> None:
+        try:
+            if self._handler is None:
+                raise RpcError(f"no handler for {method}")
+            result = self._handler(self, method, payload)
+            reply = (_RESPONSE, msg_id, True, result)
+        except BaseException as e:  # noqa: BLE001 - errors cross the wire
+            reply = (_RESPONSE, msg_id, False, e)
+        try:
+            _send_frame(self._sock, self._wlock, reply)
+        except OSError:
+            self.close()
+        except Exception as e:
+            # Result/exception wasn't picklable — still answer the caller so
+            # its call() never hangs.
+            try:
+                _send_frame(self._sock, self._wlock,
+                            (_RESPONSE, msg_id, False,
+                             RpcError(f"unserializable {method} reply: {e!r}")))
+            except OSError:
+                self.close()
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._inflight_lock:
+            inflight, self._inflight = self._inflight, {}
+        for fut in inflight.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("connection closed"))
+        if self._on_close is not None:
+            cb, self._on_close = self._on_close, None
+            try:
+                cb(self)
+            except Exception:
+                logger.exception("on_close callback failed")
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+class Server:
+    """Threaded RPC server.
+
+    ``handler(conn, method, payload)`` runs on a per-request thread; per-
+    connection request *dispatch* order is preserved by the reader loop, and
+    handlers that need strict ordering (actor queues) do their own sequencing.
+    """
+
+    def __init__(self, handler: Callable[[Connection, str, Any], Any],
+                 host: str = "127.0.0.1", port: int = 0,
+                 on_disconnect: Optional[Callable[[Connection], None]] = None):
+        self._handler = handler
+        self._on_disconnect = on_disconnect
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(512)
+        self.address: Tuple[str, int] = self._listener.getsockname()
+        self._conns: set[Connection] = set()
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                break
+            conn = Connection(sock, handler=self._handler,
+                              on_close=self._conn_closed)
+            with self._lock:
+                self._conns.add(conn)
+
+    def _conn_closed(self, conn: Connection) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+        if self._on_disconnect is not None and not self._stopped.is_set():
+            self._on_disconnect(conn)
+
+    def connections(self) -> list[Connection]:
+        with self._lock:
+            return list(self._conns)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in self.connections():
+            conn.close()
+
+
+def connect(address: Tuple[str, int],
+            push_handler: Optional[Callable[[str, Any], None]] = None,
+            handler: Optional[Callable[[Connection, str, Any], Any]] = None,
+            timeout: float = 30.0,
+            on_close: Optional[Callable[[Connection], None]] = None) -> Connection:
+    sock = socket.create_connection(address, timeout=timeout)
+    sock.settimeout(None)
+    return Connection(sock, handler=handler, push_handler=push_handler,
+                      on_close=on_close)

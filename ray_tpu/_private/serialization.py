@@ -1,0 +1,124 @@
+"""Object serialization: pickle-5 with out-of-band buffers.
+
+TPU-native analog of the reference's SerializationContext
+(/root/reference/python/ray/_private/serialization.py:92; out-of-band buffer
+handling at :191-204).  Wire format of a serialized object:
+
+    [u32 meta_len][meta: msgpack {nbuf, lens, error?}] [pickled payload] [buf0][buf1]...
+
+Large contiguous buffers (numpy arrays, bytes) are carried out-of-band via
+``pickle.protocol=5`` buffer callbacks, so a store ``get`` can reconstruct
+numpy arrays as zero-copy views over shared memory.  JAX arrays are converted
+to numpy on serialize (device arrays never transit the object store — on TPU
+they stay device-resident and move via in-graph collectives, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+import msgpack
+
+_HEADER = struct.Struct("<I")
+
+# Error sentinel types stored as object payloads (cf. reference RayError
+# hierarchy, python/ray/exceptions.py).
+ERROR_TASK = 1
+ERROR_ACTOR_DIED = 2
+ERROR_WORKER_DIED = 3
+ERROR_OBJECT_LOST = 4
+ERROR_TASK_CANCELLED = 5
+ERROR_OOM = 6
+
+
+def _identity(x):
+    return x
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, buffers: List[pickle.PickleBuffer]):
+        super().__init__(file, protocol=5,
+                         buffer_callback=lambda b: buffers.append(b) or False)
+
+    def reducer_override(self, obj):
+        # Device arrays -> host numpy before pickling.  The numpy array is
+        # handed back to *this* pickler so its buffer rides out-of-band.
+        tn = type(obj).__module__
+        if tn.startswith("jaxlib") or tn.startswith("jax."):
+            try:
+                import jax
+                if isinstance(obj, jax.Array):
+                    import numpy as np
+                    return (_identity, (np.asarray(obj),))
+            except ImportError:
+                pass
+        return NotImplemented
+
+
+def serialize(value: Any, error_type: int = 0) -> Tuple[bytes, List[memoryview]]:
+    """Returns (header_and_payload, out_of_band_buffers)."""
+    import io
+    buffers: List[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    _Pickler(f, buffers).dump(value)
+    payload = f.getvalue()
+    views = [b.raw() for b in buffers]
+    meta = msgpack.packb({
+        "n": len(views),
+        "lens": [len(v) for v in views],
+        "plen": len(payload),
+        "err": error_type,
+    })
+    head = _HEADER.pack(len(meta)) + meta
+    return head + payload, views
+
+
+def serialized_size(head_payload: bytes, views: List[memoryview]) -> int:
+    return len(head_payload) + sum(len(v) for v in views)
+
+
+def to_flat_bytes(head_payload: bytes, views: List[memoryview]) -> bytes:
+    out = bytearray(head_payload)
+    for v in views:
+        out += v
+    return bytes(out)
+
+
+def write_into(buf: memoryview, head_payload: bytes, views: List[memoryview]) -> int:
+    """Write the full serialized object into a preallocated buffer."""
+    off = len(head_payload)
+    buf[:off] = head_payload
+    for v in views:
+        n = len(v)
+        buf[off:off + n] = v
+        off += n
+    return off
+
+
+def deserialize(buf: memoryview | bytes) -> Any:
+    """Reconstruct from one contiguous buffer; numpy views stay zero-copy."""
+    buf = memoryview(buf)
+    (meta_len,) = _HEADER.unpack(bytes(buf[:_HEADER.size]))
+    off = _HEADER.size
+    meta = msgpack.unpackb(bytes(buf[off:off + meta_len]))
+    off += meta_len
+    payload = buf[off:off + meta["plen"]]
+    off += meta["plen"]
+    oob = []
+    for n in meta["lens"]:
+        oob.append(buf[off:off + n])
+        off += n
+    value = pickle.loads(payload, buffers=oob)
+    if meta.get("err"):
+        raise value
+    return value
+
+
+def error_type_of(buf: memoryview | bytes) -> int:
+    buf = memoryview(buf)
+    (meta_len,) = _HEADER.unpack(bytes(buf[:_HEADER.size]))
+    meta = msgpack.unpackb(bytes(buf[_HEADER.size:_HEADER.size + meta_len]))
+    return meta.get("err", 0)

@@ -1,0 +1,68 @@
+"""Public exception hierarchy.
+
+Analog of the reference's /root/reference/python/ray/exceptions.py: errors are
+first-class object payloads — a failed task's return object *contains* the
+exception, so ``get`` raises it at the caller with cause chaining.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all ray_tpu errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution (cf. RayTaskError)."""
+
+    def __init__(self, function_name: str = "", cause: BaseException | None = None,
+                 traceback_str: str = ""):
+        self.function_name = function_name
+        self.cause = cause
+        self.traceback_str = traceback_str
+        super().__init__(
+            f"task {function_name!r} failed: {cause!r}\n{traceback_str}")
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died (cf. WorkerCrashedError)."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead and will not be restarted (cf. RayActorError)."""
+
+    def __init__(self, reason: str = "actor died"):
+        self.reason = reason
+        super().__init__(reason)
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (restart pending)."""
+
+
+class ObjectLostError(RayTpuError):
+    """The object's primary copy was lost and could not be reconstructed."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The shared-memory store could not admit the object."""
+
+
+class OutOfMemoryError(RayTpuError):
+    """A worker was killed by the memory monitor (cf. OutOfMemoryError)."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before/while running (cf. TaskCancelledError)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get(..., timeout=)`` expired (cf. GetTimeoutError)."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Preparing the task/actor runtime environment failed."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor's max_pending_calls backpressure limit hit."""

@@ -1,0 +1,35 @@
+"""Test harness configuration.
+
+Forces JAX onto the host platform with 8 virtual devices BEFORE jax is
+imported anywhere, so every sharding/collective test runs against a simulated
+8-chip mesh (SURVEY.md §4: the CPU-device-simulation analog of the reference's
+fake-GPU yamls).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Start a fresh single-node ray_tpu instance for the test (head + 1 node)."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-daemon simulated cluster (cf. reference cluster_utils.Cluster)."""
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster()
+    yield cluster
+    cluster.shutdown()

@@ -1,0 +1,95 @@
+"""Unit tests for IDs, serialization, config, and RPC plumbing."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+
+
+def test_id_roundtrip():
+    t = TaskID.from_random()
+    assert TaskID(t.binary()) == t
+    assert TaskID.from_hex(t.hex()) == t
+    assert t != TaskID.from_random()
+    assert not t.is_nil() and TaskID.nil().is_nil()
+    assert hash(JobID(t.binary())) != hash(ActorID(t.binary()))
+
+
+def test_object_id_embeds_task_and_index():
+    t = TaskID.from_random()
+    o = ObjectID.for_task_return(t, 3)
+    assert o.task_id() == t
+    assert o.return_index() == 3
+    assert not o.is_put()
+    p = ObjectID.for_put(t, 7)
+    assert p.is_put() and p.task_id() == t
+
+
+def test_serialize_roundtrip_scalar_and_nested():
+    for value in [42, "hello", {"a": [1, 2, (3, None)]}, b"\x00" * 100]:
+        head, views = ser.serialize(value)
+        flat = ser.to_flat_bytes(head, views)
+        assert ser.deserialize(flat) == value
+
+
+def test_serialize_numpy_zero_copy():
+    arr = np.arange(1 << 16, dtype=np.float32).reshape(256, 256)
+    head, views = ser.serialize({"w": arr, "tag": 1})
+    assert sum(len(v) for v in views) >= arr.nbytes  # out-of-band
+    flat = ser.to_flat_bytes(head, views)
+    out = ser.deserialize(flat)
+    np.testing.assert_array_equal(out["w"], arr)
+
+
+def test_serialize_error_payload_raises_on_deserialize():
+    err = ValueError("boom")
+    head, views = ser.serialize(err, error_type=ser.ERROR_TASK)
+    flat = ser.to_flat_bytes(head, views)
+    assert ser.error_type_of(flat) == ser.ERROR_TASK
+    with pytest.raises(ValueError, match="boom"):
+        ser.deserialize(flat)
+
+
+def test_config_defaults_and_overrides():
+    assert CONFIG.inline_object_max_bytes == 100 * 1024
+    CONFIG.set("inline_object_max_bytes", 1)
+    try:
+        assert CONFIG.inline_object_max_bytes == 1
+    finally:
+        CONFIG.set("inline_object_max_bytes", 100 * 1024)
+    with pytest.raises(AttributeError):
+        _ = CONFIG.not_a_flag
+    assert "object_store_memory_bytes" in CONFIG.snapshot()
+
+
+def test_rpc_call_push_and_error():
+    from ray_tpu._private import rpc
+
+    pushes = []
+
+    def handler(conn, method, payload):
+        if method == "echo":
+            return payload
+        if method == "fail":
+            raise RuntimeError("nope")
+        raise KeyError(method)
+
+    server = rpc.Server(handler)
+    try:
+        conn = rpc.connect(server.address, push_handler=lambda m, p: pushes.append((m, p)))
+        assert conn.call("echo", {"x": 1}) == {"x": 1}
+        with pytest.raises(rpc.RemoteError):
+            conn.call("fail")
+        # server -> client push
+        server.connections()[0].push("note", 7)
+        import time
+        for _ in range(100):
+            if pushes:
+                break
+            time.sleep(0.01)
+        assert pushes == [("note", 7)]
+        conn.close()
+    finally:
+        server.stop()
