@@ -1,0 +1,94 @@
+"""Headline benchmark: flagship GPT train-step throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/sec/chip, "unit": "tokens/s",
+   "vs_baseline": achieved_MFU / 0.35}
+
+The reference commits no number for its Train north-star metric
+(BASELINE.json "published" is empty), so ``vs_baseline`` is measured against
+the north-star target itself: BASELINE.md's "GPT-J FSDP->GSPMD >= 35% MFU".
+vs_baseline >= 1.0 means we meet/beat the target MFU on this chip.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK_FLOPS = {
+    # bf16 peak per chip
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+}
+
+
+def main():
+    from ray_tpu.models import get_config, GPT
+    from ray_tpu.train.step import OptimizerConfig, make_sharded_train
+    from ray_tpu.parallel import build_mesh, MeshConfig
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    kind = getattr(dev, "device_kind", "")
+    peak = next((v for k, v in PEAK_FLOPS.items() if k in kind), 197e12)
+
+    n_dev = len(jax.devices())
+    if on_tpu:
+        batch, seq = 8 * n_dev, 1024
+        cfg = get_config("gpt-small", max_seq_len=seq, remat=False,
+                         attention_impl="flash")
+        steps, warmup = 20, 3
+    else:  # CI smoke fallback
+        batch, seq = 4 * n_dev, 128
+        cfg = get_config("tiny")
+        steps, warmup = 5, 1
+
+    mesh = build_mesh(MeshConfig(data=-1))
+    model = GPT(cfg, mesh=mesh)
+    rng = np.random.default_rng(0)
+    batch_data = {"tokens": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)}
+    init_fn, step_fn, _, _ = make_sharded_train(
+        model, mesh, OptimizerConfig(warmup_steps=10, decay_steps=1000),
+        example_batch=batch_data)
+    state = init_fn(jax.random.PRNGKey(0), batch_data)
+
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch_data)
+    # Fence via a device-to-host read: on the axon tunnel platform
+    # block_until_ready returns early, a D2H copy forces the full chain.
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch_data)
+    final_loss = float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    n_chips = mesh.size
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step / dt / n_chips  # per chip
+    n_params = cfg.num_params()
+    # PaLM-style: 6N per token fwd+bwd + attention 12*L*d*S
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+    mfu = flops_per_token * tokens_per_sec / peak
+    print(json.dumps({
+        "metric": "gpt_small_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt * 1e3, 2),
+        "device": kind or dev.platform,
+        "n_chips": n_chips,
+        "params": n_params,
+        "final_loss": round(final_loss, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

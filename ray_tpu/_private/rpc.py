@@ -87,11 +87,22 @@ class Connection:
 
     # ------------------------------------------------------------------ send
     def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
-        return self.call_async(method, payload).result(timeout)
+        fut = self.call_async(method, payload)
+        try:
+            return fut.result(timeout)
+        except TimeoutError:
+            # Drop the abandoned future so a late response isn't delivered
+            # to it and _inflight doesn't grow unbounded on timeouts.
+            msg_id = getattr(fut, "_rpc_msg_id", None)
+            if msg_id is not None:
+                with self._inflight_lock:
+                    self._inflight.pop(msg_id, None)
+            raise
 
     def call_async(self, method: str, payload: Any = None) -> Future:
         fut: Future = Future()
         msg_id = next(self._ids)
+        fut._rpc_msg_id = msg_id  # used by call() to reap timed-out futures
         with self._inflight_lock:
             if self._closed.is_set():
                 fut.set_exception(ConnectionError("connection closed"))
@@ -102,11 +113,13 @@ class Connection:
         except OSError as e:
             with self._inflight_lock:
                 self._inflight.pop(msg_id, None)
-            fut.set_exception(ConnectionError(str(e)))
+            if not fut.done():  # close() may have failed it concurrently
+                fut.set_exception(ConnectionError(str(e)))
         except Exception as e:  # e.g. unpicklable payload
             with self._inflight_lock:
                 self._inflight.pop(msg_id, None)
-            fut.set_exception(e)
+            if not fut.done():
+                fut.set_exception(e)
         return fut
 
     def push(self, method: str, payload: Any = None) -> None:
@@ -221,11 +234,17 @@ class Server:
         self._accept_thread.start()
 
     def _accept_loop(self) -> None:
+        import time
         while not self._stopped.is_set():
             try:
                 sock, _ = self._listener.accept()
             except OSError:
-                break
+                if self._stopped.is_set():
+                    break
+                # Transient failure (e.g. EMFILE): keep the server alive.
+                logger.exception("accept() failed; retrying")
+                time.sleep(0.1)
+                continue
             conn = Connection(sock, handler=self._handler,
                               on_close=self._conn_closed)
             with self._lock:

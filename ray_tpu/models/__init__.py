@@ -1,0 +1,6 @@
+"""Model families: flagship GPT (LLaMA-style) LM, ResNet vision models."""
+
+from ray_tpu.models.configs import PRESETS, TransformerConfig, get_config
+from ray_tpu.models.gpt import GPT
+
+__all__ = ["GPT", "TransformerConfig", "PRESETS", "get_config"]

@@ -1,0 +1,80 @@
+"""Model configuration presets.
+
+Sizes chosen to line up with the reference's benchmark families
+(BASELINE.md: GPT-J-6B pretraining is the Train north-star; resnet50 the
+vision baseline) plus tiny configs for tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None       # None -> = n_heads (MHA)
+    d_ff: Optional[int] = None             # None -> 4 * d_model (8/3 for swiglu)
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: jnp.dtype = jnp.bfloat16        # activation dtype
+    param_dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "auto"           # auto | xla | flash | ring
+    remat: bool = True                     # checkpoint each block (HBM <-> FLOPs)
+    scan_layers: bool = True               # lax.scan over layers
+    tie_embeddings: bool = False
+    z_loss: float = 1e-4
+
+    def __post_init__(self):
+        if self.n_kv_heads is None:
+            self.n_kv_heads = self.n_heads
+        if self.d_ff is None:
+            self.d_ff = 4 * self.d_model
+        assert self.d_model % self.n_heads == 0
+        assert self.n_heads % self.n_kv_heads == 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        emb = self.vocab_size * self.d_model * (1 if self.tie_embeddings else 2)
+        attn = self.d_model * self.head_dim * (
+            self.n_heads * 2 + self.n_kv_heads * 2)
+        mlp = 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model
+        return emb + self.n_layers * (attn + mlp + norms) + self.d_model
+
+
+PRESETS = {
+    # test-size
+    "tiny": TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                              n_heads=4, d_ff=128, max_seq_len=128,
+                              dtype=jnp.float32, remat=False),
+    # ~124M GPT-2 small shapes
+    "gpt-small": TransformerConfig(vocab_size=50304, d_model=768, n_layers=12,
+                                   n_heads=12, max_seq_len=1024),
+    # ~1.3B
+    "gpt-xl": TransformerConfig(vocab_size=50304, d_model=2048, n_layers=24,
+                                n_heads=16, max_seq_len=2048),
+    # GPT-J-6B shapes (the reference Train benchmark model, BASELINE.md)
+    "gptj-6b": TransformerConfig(vocab_size=50400, d_model=4096, n_layers=28,
+                                 n_heads=16, max_seq_len=2048),
+    # LLaMA-3-8B shapes (the reference Serve benchmark model, BASELINE.md)
+    "llama3-8b": TransformerConfig(vocab_size=128256, d_model=4096,
+                                   n_layers=32, n_heads=32, n_kv_heads=8,
+                                   d_ff=14336, max_seq_len=8192,
+                                   rope_theta=500000.0),
+}
+
+
+def get_config(name: str, **overrides) -> TransformerConfig:
+    base = PRESETS[name]
+    return dataclasses.replace(base, **overrides) if overrides else base

@@ -1,0 +1,197 @@
+"""Flagship decoder-only transformer (LLaMA-style: RMSNorm/RoPE/SwiGLU/GQA).
+
+TPU-first design notes:
+  - params carry *logical* axis names via ``nn.with_logical_partitioning``;
+    ray_tpu.parallel.sharding maps them to mesh axes (DP/FSDP/TP/SP from one
+    rule table — the capability matrix the reference lacks, SURVEY.md §2.6).
+  - layers run under ``lax.scan`` (one compiled block, O(1) compile time in
+    depth) with optional remat (HBM <-> FLOPs trade).
+  - attention dispatches to the Pallas flash kernel, plain XLA einsum, or
+    ring attention over the mesh's ``context`` axis for long sequences.
+  - decode uses a KV cache held in the flax ``cache`` collection
+    (``decode`` is a module attribute, so it stays static under remat/scan).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.models.configs import TransformerConfig
+from ray_tpu.ops.attention import repeat_kv, xla_attention
+from ray_tpu.ops.layers import apply_rope, rope_frequencies
+from ray_tpu.parallel.sharding import LOGICAL_RULES, ShardingRules, with_sharding
+
+
+def _dense(features, logical_axes, name=None, use_bias=False,
+           param_dtype=jnp.float32, dtype=jnp.bfloat16):
+    return nn.DenseGeneral(
+        features=features, axis=-1, use_bias=use_bias, name=name,
+        dtype=dtype, param_dtype=param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), logical_axes))
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale",
+                           nn.with_logical_partitioning(
+                               nn.initializers.ones_init(), ("norm",)),
+                           (x.shape[-1],), jnp.float32)
+        from ray_tpu.ops.layers import rms_norm
+        return rms_norm(x, scale, self.eps)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+    rules: ShardingRules = LOGICAL_RULES
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions=None):
+        cfg = self.cfg
+        h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        q = _dense((h, hd), ("embed", "heads", "head_dim"), "wq",
+                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        k = _dense((kvh, hd), ("embed", "kv", "head_dim"), "wk",
+                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        v = _dense((kvh, hd), ("embed", "kv", "head_dim"), "wv",
+                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        if self.decode:
+            out = self._decode_attend(q, k, v)
+        else:
+            out = self._train_attend(q, k, v)
+        out = out.reshape(*out.shape[:2], h * hd)
+        return _dense(cfg.d_model, ("heads_embed", "embed"), "wo",
+                      dtype=cfg.dtype, param_dtype=cfg.param_dtype)(out)
+
+    def _train_attend(self, q, k, v):
+        cfg = self.cfg
+        impl = cfg.attention_impl
+        if impl == "ring":
+            if self.mesh is None:
+                raise ValueError("ring attention requires a mesh")
+            from ray_tpu.ops.ring_attention import ring_attention
+            if cfg.n_kv_heads != cfg.n_heads:
+                k = repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
+                v = repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
+            return ring_attention(q, k, v, mesh=self.mesh, causal=True)
+        from ray_tpu.ops.attention import attention
+        return attention(q, k, v, causal=True, impl=impl)
+
+    def _decode_attend(self, q, k, v):
+        """Append to the KV cache and attend (cache collection vars)."""
+        cfg = self.cfg
+        b = q.shape[0]
+        ck = self.variable("cache", "k", jnp.zeros,
+                           (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim),
+                           cfg.dtype)
+        cv = self.variable("cache", "v", jnp.zeros,
+                           (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim),
+                           cfg.dtype)
+        idx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+        if self.is_initializing():
+            # shape-only pass: leave the cache untouched (flax convention —
+            # a cache write here would leave index advanced before decoding)
+            return xla_attention(q, k, v, causal=True)
+        cur = idx.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k.astype(cfg.dtype),
+                                                (0, cur, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v.astype(cfg.dtype),
+                                                (0, cur, 0, 0))
+        idx.value = cur + q.shape[1]
+        return xla_attention(q, ck.value, cv.value, causal=True, q_offset=cur)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+    rules: ShardingRules = LOGICAL_RULES
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions=None):
+        cfg = self.cfg
+        y = RMSNorm(cfg.norm_eps, name="attn_norm")(x)
+        y = Attention(cfg, self.mesh, self.rules, self.decode, name="attn")(
+            y, cos, sin, positions)
+        x = x + y
+        y = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
+        gate = _dense(cfg.d_ff, ("embed", "mlp"), "w_gate",
+                      dtype=cfg.dtype, param_dtype=cfg.param_dtype)(y)
+        up = _dense(cfg.d_ff, ("embed", "mlp"), "w_up",
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype)(y)
+        y = _dense(cfg.d_model, ("mlp", "embed"), "w_down",
+                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)(
+            nn.silu(gate) * up)
+        x = x + y
+        if self.mesh is not None and not self.decode:
+            x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),
+                              self.rules)
+        return x
+
+
+class GPT(nn.Module):
+    """Decoder-only LM.  ``__call__`` returns logits [B, S, vocab]."""
+
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+    rules: ShardingRules = LOGICAL_RULES
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.cfg
+        embed = self.param(
+            "embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        if self.mesh is not None and not self.decode:
+            x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),
+                              self.rules)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+
+        block_cls = Block
+        if cfg.remat and not self.decode:
+            block_cls = nn.remat(
+                Block, prevent_cse=False,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, cos, sin, positions), None),
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: None},
+            )(block_cls(cfg, self.mesh, self.rules, self.decode,
+                        name="blocks"), x, None)
+        else:
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, self.mesh, self.rules, self.decode,
+                              name=f"block_{i}")(x, cos, sin, positions)
+
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))
+        else:
+            logits = _dense(cfg.vocab_size, ("embed", "vocab"), "lm_head",
+                            dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        if self.mesh is not None and not self.decode:
+            logits = with_sharding(self.mesh, logits,
+                                   ("batch", "seq", "act_vocab"), self.rules)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,17 @@
+"""TPU compute ops: attention kernels, fused layers, losses.
+
+The hot-op layer of the framework.  Where the reference leans on torch/CUDA
+kernels inside user training loops, these are Pallas TPU kernels (MXU-shaped
+block sizes, VMEM-resident tiles, fp32 accumulation) with jax-native
+fallbacks that run anywhere (CPU tests, interpret mode).
+"""
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
+from ray_tpu.ops.losses import softmax_cross_entropy
+from ray_tpu.ops.ring_attention import ring_attention
+
+__all__ = [
+    "attention", "ring_attention", "rms_norm", "apply_rope",
+    "rope_frequencies", "swiglu", "softmax_cross_entropy",
+]

@@ -1,0 +1,80 @@
+"""Multi-head attention with selectable implementation.
+
+``impl``:
+  - ``"xla"``    — einsum attention; runs everywhere, materializes [Sq, Sk].
+  - ``"flash"``  — Pallas TPU flash kernel (ray_tpu/ops/flash_attention.py);
+                   O(S) memory, fused online softmax on the MXU.
+  - ``"auto"``   — flash on TPU backends, xla elsewhere.
+
+Layout convention throughout the framework: ``q``: [batch, q_len, heads,
+head_dim]; ``k``/``v``: [batch, kv_len, kv_heads, head_dim] with grouped-query
+attention when ``kv_heads < heads``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except (RuntimeError, IndexError):
+        return False
+
+
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, KvH, D] -> [B, S, KvH*n_rep, D] for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)
+                            ).reshape(b, s, h * n_rep, d)
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                  causal: bool = True, sm_scale: Optional[float] = None,
+                  q_offset: int = 0) -> jax.Array:
+    """Reference einsum attention (fp32 logits/softmax, input-dtype output).
+
+    ``q_offset``: global position of q[0] relative to k[0] — used by the ring
+    attention fallback and by decode (q_len==1 at position offset).
+    """
+    *_, q_len, heads, head_dim = q.shape
+    kv_len, kv_heads = k.shape[-3], k.shape[-2]
+    if kv_heads != heads:
+        k = repeat_kv(k, heads // kv_heads)
+        v = repeat_kv(v, heads // kv_heads)
+    scale = sm_scale if sm_scale is not None else head_dim ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = jnp.arange(q_len)[:, None] + q_offset
+        k_pos = jnp.arange(kv_len)[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+              causal: bool = True, sm_scale: Optional[float] = None,
+              impl: str = "auto") -> jax.Array:
+    """Public fused attention entry point (see module docstring)."""
+    if impl == "auto":
+        impl = "flash" if _on_tpu() else "xla"
+    if impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+        heads, kv_heads = q.shape[-2], k.shape[-2]
+        if kv_heads != heads:
+            k = repeat_kv(k, heads // kv_heads)
+            v = repeat_kv(v, heads // kv_heads)
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    raise ValueError(f"unknown attention impl: {impl!r}")

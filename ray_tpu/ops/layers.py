@@ -1,0 +1,59 @@
+"""Fused layer primitives: RMSNorm, rotary embeddings, SwiGLU.
+
+Kept as jax-native expressions — XLA fuses these elementwise chains into the
+surrounding matmuls on TPU (HBM-bandwidth note in the repo brief); Pallas is
+reserved for ops XLA can't fuse well (attention, ring collectives).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in fp32, cast back to input dtype."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Precompute (cos, sin) tables of shape [max_len, head_dim // 2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array | None = None) -> jax.Array:
+    """Rotary position embedding on [B, S, H, D] (D split into even/odd halves).
+
+    ``positions``: [B, S] global positions (for context-parallel shards /
+    decode offsets); default arange(S).
+    """
+    b, s, h, d = x.shape
+    if positions is None:
+        cos_s = cos[:s][None, :, None, :]
+        sin_s = sin[:s][None, :, None, :]
+    else:
+        cos_s = cos[positions][:, :, None, :]
+        sin_s = sin[positions][:, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * cos_s - x2 * sin_s, x2 * cos_s + x1 * sin_s], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+           w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP: silu(x @ w_gate) * (x @ w_up) @ w_down."""
+    gate = jax.nn.silu(x @ w_gate)
+    return (gate * (x @ w_up)) @ w_down

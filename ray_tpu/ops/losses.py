@@ -1,0 +1,31 @@
+"""Loss functions for LM training."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
+                          mask: Optional[jax.Array] = None,
+                          z_loss: float = 0.0) -> Tuple[jax.Array, jax.Array]:
+    """Token-level CE with optional z-loss; returns (mean_loss, denominator).
+
+    logits: [..., vocab] (any dtype; softmax in fp32), labels: [...] int,
+    mask: [...] with 0 to exclude (padding).
+    """
+    logits32 = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits32, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits32, labels[..., None], axis=-1).squeeze(-1)
+    losses = lse - label_logits
+    if z_loss:
+        losses = losses + z_loss * jnp.square(lse)
+    if mask is not None:
+        losses = losses * mask
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        denom = jnp.asarray(losses.size, jnp.float32)
+    return jnp.sum(losses) / denom, denom

@@ -1,0 +1,20 @@
+"""Parallelism layer: device meshes, logical-axis sharding rules, collectives.
+
+This is the TPU-native replacement for the reference's ML-parallelism surface
+(SURVEY.md §2.6): DP/FSDP/TP/SP/EP are all expressed as shardings of one
+``jax.sharding.Mesh`` and compiled into XLA collectives over ICI/DCN, instead
+of NCCL process groups (/root/reference/python/ray/util/collective/) and
+per-framework DDP wrappers (/root/reference/python/ray/train/torch/config.py:29).
+"""
+
+from ray_tpu.parallel.mesh import (MeshConfig, build_mesh, local_mesh,
+                                   mesh_shape_for)
+from ray_tpu.parallel.sharding import (LOGICAL_RULES, ShardingRules,
+                                       logical_sharding, logical_spec,
+                                       shard_pytree_like, with_sharding)
+
+__all__ = [
+    "MeshConfig", "build_mesh", "local_mesh", "mesh_shape_for",
+    "ShardingRules", "LOGICAL_RULES", "logical_spec", "logical_sharding",
+    "with_sharding", "shard_pytree_like",
+]

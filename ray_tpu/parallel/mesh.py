@@ -1,0 +1,97 @@
+"""Device-mesh construction for single- and multi-host TPU topologies.
+
+The mesh is the framework's unit of ML parallelism: axes ``data`` (DP),
+``fsdp`` (ZeRO-3 parameter sharding), ``context`` (sequence/context
+parallelism for ring attention), and ``tensor`` (megatron-style TP).  The
+reference has no analog — its DP is torch DDP over NCCL
+(/root/reference/python/ray/train/torch/config.py:29) and TP/SP/EP are absent
+(SURVEY.md §2.6); here they are all layouts of one mesh, and XLA emits the
+collectives.
+
+Axis order matters on hardware: later mesh axes map to faster ICI dimensions
+under ``mesh_utils.create_device_mesh``, so ``tensor`` (highest-traffic
+collectives) is innermost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_ORDER: Tuple[str, ...] = ("data", "fsdp", "context", "tensor")
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Declarative mesh request, resolved against available devices.
+
+    Sizes of ``-1`` mean "absorb remaining devices" (at most one axis may be
+    -1).  This is the TPU analog of the reference's ``ScalingConfig``
+    (/root/reference/python/ray/air/config.py:79): instead of
+    num_workers×use_gpu it declares how chips factor into parallelism axes.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    context: int = 1
+    tensor: int = 1
+
+    def sizes(self) -> Tuple[int, int, int, int]:
+        return (self.data, self.fsdp, self.context, self.tensor)
+
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
+        sizes = list(self.sizes())
+        wildcard = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wildcard) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wildcard:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wildcard[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {tuple(sizes)} needs {fixed} devices, have {n_devices}")
+        return tuple(sizes)
+
+
+def mesh_shape_for(n_devices: int, config: Optional[MeshConfig] = None
+                   ) -> Tuple[int, int, int, int]:
+    return (config or MeshConfig()).resolve(n_devices)
+
+
+def build_mesh(config: Optional[MeshConfig] = None,
+               devices: Optional[Sequence[jax.Device]] = None,
+               axis_names: Tuple[str, ...] = AXIS_ORDER) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all) with ICI-aware placement.
+
+    Uses ``mesh_utils.create_device_mesh`` when the devices span a real TPU
+    topology so physically-adjacent chips land on the innermost (highest
+    traffic) axes; falls back to a plain reshape for host-platform devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    shape = mesh_shape_for(len(devices), config)
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=devices, allow_split_physical_axes=True)
+    except (ValueError, AssertionError, NotImplementedError):
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def local_mesh(axis: str = "data") -> Mesh:
+    """1-axis mesh over this process's addressable devices (single-host DP)."""
+    devices = jax.local_devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def dp_size(mesh: Mesh) -> int:
+    """Global batch-sharding factor (data × fsdp; batch shards over both)."""
+    return mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)

@@ -1,0 +1,155 @@
+"""Logical-axis → mesh-axis sharding rules (GSPMD annotation layer).
+
+Models annotate parameters/activations with *logical* axis names ("embed",
+"mlp", "heads", "batch", "seq", ...); this module maps them onto physical mesh
+axes and produces ``NamedSharding``s for ``jax.jit``'s in/out shardings.  One
+rule table expresses DP, ZeRO-3/FSDP, tensor parallelism, sequence/context
+parallelism, and expert parallelism — the strategies the reference either
+delegates to torch (DP/DDP: /root/reference/python/ray/train/torch/
+train_loop_utils.py ``prepare_model``) or lacks entirely (TP/SP/EP —
+SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+# Default rule table (t5x-style).  batch shards over data+fsdp (ZeRO data
+# axes); params shard over fsdp (ZeRO-3) and tensor; sequence activations
+# shard over context for ring attention; experts over an expert dimension
+# folded into data.
+DEFAULT_RULES: Tuple[Tuple[str, MeshAxes], ...] = (
+    ("batch", ("data", "fsdp")),
+    ("seq", "context"),            # activation sequence axis (context parallel)
+    ("kv_seq", None),              # gathered KV sequence stays replicated
+    ("act_embed", None),           # activation model dim (params shard fsdp,
+                                   # activations stay whole per shard)
+    ("act_vocab", "tensor"),       # logits vocab dim shards with TP
+    ("embed", "fsdp"),             # ZeRO-3 parameter shard axis
+    ("mlp", "tensor"),
+    ("heads", "tensor"),
+    ("heads_embed", "tensor"),     # merged heads*head_dim axis (attn output proj)
+    ("kv", "tensor"),              # kv heads shard with TP (GQA: kv_heads >= tp)
+    ("head_dim", None),
+    ("vocab", "tensor"),
+    ("expert", ("data", "fsdp")),  # expert-parallel: experts across data axes
+    ("expert_mlp", "tensor"),
+    ("stage", None),               # pipeline stage axis (pipeline.py overrides)
+    ("norm", None),
+    ("conv_io", None),
+    ("conv_spatial", None),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    rules: Tuple[Tuple[str, MeshAxes], ...] = DEFAULT_RULES
+
+    def to_mesh_axes(self, logical: str, mesh: Optional[Mesh] = None) -> MeshAxes:
+        for name, axes in self.rules:
+            if name == logical:
+                return self._prune(axes, mesh)
+        return None
+
+    @staticmethod
+    def _prune(axes: MeshAxes, mesh: Optional[Mesh]) -> MeshAxes:
+        """Drop mesh axes that don't exist / are size 1 on this mesh."""
+        if axes is None or mesh is None:
+            return axes
+        shape = dict(mesh.shape)
+        if isinstance(axes, str):
+            return axes if shape.get(axes, 1) > 1 else None
+        kept = tuple(a for a in axes if shape.get(a, 1) > 1)
+        return kept if len(kept) > 1 else (kept[0] if kept else None)
+
+    def replace(self, **overrides: MeshAxes) -> "ShardingRules":
+        new = [(n, overrides.get(n, a)) for n, a in self.rules]
+        for extra in overrides.keys() - {n for n, _ in self.rules}:
+            new.append((extra, overrides[extra]))
+        return ShardingRules(tuple(new))
+
+
+LOGICAL_RULES = ShardingRules()
+
+
+def logical_spec(logical_axes: Sequence[Optional[str]],
+                 mesh: Optional[Mesh] = None,
+                 rules: ShardingRules = LOGICAL_RULES) -> PartitionSpec:
+    """('batch','seq','embed') -> PartitionSpec(('data','fsdp'),'context','fsdp')."""
+    return PartitionSpec(*[
+        rules.to_mesh_axes(a, mesh) if a is not None else None
+        for a in logical_axes])
+
+
+def logical_sharding(mesh: Mesh, logical_axes: Sequence[Optional[str]],
+                     rules: ShardingRules = LOGICAL_RULES) -> NamedSharding:
+    return NamedSharding(mesh, logical_spec(logical_axes, mesh, rules))
+
+
+def with_sharding(mesh: Mesh, x: jax.Array,
+                  logical_axes: Sequence[Optional[str]],
+                  rules: ShardingRules = LOGICAL_RULES) -> jax.Array:
+    """Constrain an intermediate value's sharding inside jit."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, logical_axes, rules))
+
+
+def logical_pspec_to_mesh(spec: Optional[PartitionSpec], mesh: Mesh,
+                          rules: ShardingRules = LOGICAL_RULES) -> NamedSharding:
+    """Translate a PartitionSpec of *logical* names (e.g. from
+    ``nn.get_partition_spec``) into a mesh NamedSharding."""
+    if spec is None:
+        return NamedSharding(mesh, PartitionSpec())
+    return NamedSharding(mesh, PartitionSpec(*[
+        rules.to_mesh_axes(a, mesh) if a is not None else None for a in spec]))
+
+
+def tree_mesh_shardings(logical_spec_tree: Any, mesh: Mesh,
+                        rules: ShardingRules = LOGICAL_RULES) -> Any:
+    """Map ``logical_pspec_to_mesh`` over a tree of logical PartitionSpecs
+    (None leaves -> replicated)."""
+    return jax.tree.map(
+        lambda s: logical_pspec_to_mesh(s, mesh, rules),
+        logical_spec_tree,
+        is_leaf=lambda x: x is None or isinstance(x, PartitionSpec))
+
+
+def tree_logical_axes(params: Any) -> Any:
+    """Extract logical axis metadata from a flax param tree
+    (``nn.Partitioned`` leaves from ``with_logical_partitioning``)."""
+    import flax.linen as nn
+
+    def leaf_axes(p):
+        if isinstance(p, nn.Partitioned):
+            return p.names
+        return None
+
+    return jax.tree.map(leaf_axes, params,
+                        is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def shard_pytree_like(tree: Any, axes_tree: Any, mesh: Mesh,
+                      rules: ShardingRules = LOGICAL_RULES) -> Any:
+    """NamedShardings for a pytree given a matching tree of logical axes.
+
+    ``axes_tree`` leaves are tuples of logical axis names (or None for fully
+    replicated); tuples/None are leaves here, so the two trees are flattened
+    independently and zipped.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    axes_leaves = treedef.flatten_up_to(axes_tree)
+    if len(axes_leaves) != len(leaves):
+        raise ValueError("axes_tree structure does not match tree")
+
+    def one(axes):
+        if axes is None:
+            return NamedSharding(mesh, PartitionSpec())
+        return logical_sharding(mesh, axes, rules)
+
+    return jax.tree.unflatten(treedef, [one(a) for a in axes_leaves])

@@ -14,6 +14,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The environment's sitecustomize force-registers an `axon` TPU backend and
+# overrides jax_platforms programmatically; put it back to host CPU for tests.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,103 @@
+"""Correctness tests for attention kernels and fused layers (CPU, 8-dev mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from ray_tpu.ops.attention import attention, xla_attention
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies
+from ray_tpu.ops.losses import softmax_cross_entropy
+from ray_tpu.ops.ring_attention import ring_attention
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_xla_fwd_bwd(causal):
+    key = jax.random.PRNGKey(0)
+    B, S, H, D = 2, 128, 2, 32
+    q, k, v = [jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in jax.random.split(key, 3)]
+    o_ref = xla_attention(q, k, v, causal=causal)
+    o = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    np.testing.assert_allclose(o, o_ref, atol=2e-5)
+
+    g_ref = jax.grad(lambda *a: (xla_attention(*a, causal=causal) ** 2).sum(),
+                     (0, 1, 2))(q, k, v)
+    g = jax.grad(lambda *a: (flash_attention(*a, causal=causal, block_q=32,
+                                             block_k=32) ** 2).sum(),
+                 (0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(a, b, atol=2e-4)
+
+
+def test_gqa_repeat_kv():
+    key = jax.random.PRNGKey(1)
+    B, S, H, KvH, D = 1, 64, 8, 2, 16
+    q = jax.random.normal(key, (B, S, H, D))
+    k = jax.random.normal(key, (B, S, KvH, D))
+    v = jax.random.normal(key, (B, S, KvH, D))
+    out = attention(q, k, v, impl="xla")
+    assert out.shape == (B, S, H, D)
+    # flash path handles GQA by expansion in ops.attention
+    out2 = attention(q, k, v, impl="flash")
+    np.testing.assert_allclose(out, out2, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "context"))
+    key = jax.random.PRNGKey(2)
+    B, S, H, D = 2, 256, 2, 16
+    q, k, v = [jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in jax.random.split(key, 3)]
+    ref = xla_attention(q, k, v, causal=causal)
+    out = jax.jit(lambda *a: ring_attention(
+        *a, mesh=mesh, causal=causal, batch_axes=("data",)))(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+    # gradients flow through the ring (scan + ppermute autodiff)
+    g_ref = jax.grad(lambda *a: (xla_attention(*a, causal=causal) ** 2).sum())(
+        q, k, v)
+    g = jax.grad(lambda *a: (ring_attention(
+        *a, mesh=mesh, causal=causal, batch_axes=("data",)) ** 2).sum())(
+        q, k, v)
+    np.testing.assert_allclose(g, g_ref, atol=2e-4)
+
+
+def test_rms_norm_and_rope():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    w = jnp.ones((16,))
+    y = rms_norm(x, w)
+    norms = jnp.sqrt(jnp.mean(y ** 2, axis=-1))
+    np.testing.assert_allclose(norms, jnp.ones_like(norms), atol=1e-3)
+
+    cos, sin = rope_frequencies(16, 32)
+    q = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 2, 16))
+    q_rot = apply_rope(q, cos, sin)
+    # norms are preserved by rotation
+    np.testing.assert_allclose(
+        jnp.linalg.norm(q_rot, axis=-1), jnp.linalg.norm(q, axis=-1),
+        atol=1e-4)
+    # position 0 is identity
+    np.testing.assert_allclose(q_rot[:, 0], q[:, 0], atol=1e-5)
+    # explicit positions match implicit arange
+    pos = jnp.broadcast_to(jnp.arange(8), (1, 8))
+    np.testing.assert_allclose(apply_rope(q, cos, sin, pos), q_rot, atol=1e-6)
+
+
+def test_cross_entropy_matches_manual():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 16))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 16)
+    loss, denom = softmax_cross_entropy(logits, labels)
+    manual = -jnp.mean(jnp.take_along_axis(
+        jax.nn.log_softmax(logits, -1), labels[..., None], -1))
+    np.testing.assert_allclose(loss, manual, rtol=1e-5)
+    assert denom == 32
+
+    mask = jnp.zeros((4, 8)).at[:, :4].set(1.0)
+    loss_m, denom_m = softmax_cross_entropy(logits, labels, mask)
+    assert denom_m == 16
+    manual_m = -jnp.sum(jnp.take_along_axis(
+        jax.nn.log_softmax(logits, -1), labels[..., None], -1)[..., 0] * mask) / 16
+    np.testing.assert_allclose(loss_m, manual_m, rtol=1e-5)
