@@ -1,0 +1,21 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+Tasks/actors/objects core (C++ shared-memory store + Python control plane)
+plus a TPU-first ML stack: GSPMD mesh parallelism, Pallas kernels, ring
+attention, JaxTrainer, datasets, tuning, RL, and serving.
+"""
+
+from ray_tpu._private.config import CONFIG  # noqa: F401
+from ray_tpu.actor import get_actor, kill  # noqa: F401
+from ray_tpu.api import (available_resources, cluster_resources, context,  # noqa: F401
+                         get, init, is_initialized, nodes, put, remote,
+                         shutdown, wait)
+from ray_tpu.runtime.core_worker import ObjectRef  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "get_actor", "kill", "nodes", "cluster_resources",
+    "available_resources", "context", "ObjectRef", "CONFIG", "__version__",
+]

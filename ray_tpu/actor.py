@@ -1,0 +1,106 @@
+"""@ray_tpu.remote for classes: ActorClass / ActorHandle / ActorMethod.
+
+Analog of /root/reference/python/ray/actor.py (ActorClass :377,
+ActorHandle :1022, ActorMethod :92).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.ids import ActorID
+from ray_tpu.runtime.core_worker import get_global_worker
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        worker = get_global_worker()
+        refs = worker.submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs,
+            num_returns=self._num_returns)
+        return refs[0] if self._num_returns == 1 else refs
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID):
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id,))
+
+
+class ActorClass:
+    def __init__(self, cls, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
+                 resources: Optional[Dict[str, float]] = None,
+                 max_restarts: int = 0, name: Optional[str] = None,
+                 namespace: str = "", lifetime: Optional[str] = None):
+        self._cls = cls
+        self._resources = dict(resources or {})
+        self._resources["CPU"] = num_cpus
+        if num_tpus:
+            self._resources["TPU"] = num_tpus
+        self._max_restarts = max_restarts
+        self._name = name
+        self._namespace = namespace
+        self._lifetime = lifetime
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__!r} cannot be instantiated "
+            "directly; use .remote()")
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = get_global_worker()
+        actor_id = worker.create_actor(
+            self._cls, args, kwargs,
+            name=self._name,
+            namespace=self._namespace,
+            detached=self._lifetime == "detached",
+            max_restarts=self._max_restarts,
+            resources=self._resources)
+        return ActorHandle(actor_id)
+
+    def options(self, **opts) -> "ActorClass":
+        return ActorClass(
+            self._cls,
+            num_cpus=opts.get("num_cpus", self._resources.get("CPU", 1.0)),
+            num_tpus=opts.get("num_tpus", self._resources.get("TPU", 0.0)),
+            resources=opts.get("resources",
+                               {k: v for k, v in self._resources.items()
+                                if k not in ("CPU", "TPU")}),
+            max_restarts=opts.get("max_restarts", self._max_restarts),
+            name=opts.get("name", self._name),
+            namespace=opts.get("namespace", self._namespace),
+            lifetime=opts.get("lifetime", self._lifetime))
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    """Look up a named actor (cf. ray.get_actor)."""
+    worker = get_global_worker()
+    info = worker.gcs.call("get_actor", {"name": name,
+                                         "namespace": namespace})
+    if info is None:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(ActorID.from_hex(info["actor_id"]))
+
+
+def kill(handle: ActorHandle) -> None:
+    """Forcibly terminate an actor (cf. ray.kill)."""
+    get_global_worker().kill_actor(handle._actor_id)

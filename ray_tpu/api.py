@@ -1,0 +1,203 @@
+"""Public core API: init/shutdown, remote, get/put/wait, actors.
+
+Analog of /root/reference/python/ray/_private/worker.py (init :1031,
+get :2222, put :2335, wait :2391, remote :2715, shutdown :1581).
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import JobID
+from ray_tpu.actor import ActorClass, get_actor, kill  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime import core_worker as cw
+from ray_tpu.runtime.core_worker import ObjectRef
+from ray_tpu.runtime.node import NodeProcesses, new_session_dir
+
+_init_lock = threading.Lock()
+_node: Optional[NodeProcesses] = None
+
+
+def is_initialized() -> bool:
+    return cw._global_worker is not None
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: Optional[int] = None,
+         system_config: Optional[Dict[str, Any]] = None,
+         namespace: str = "") -> Dict[str, Any]:
+    """Start (or connect to) a cluster and attach this process as a driver.
+
+    ``address=None`` starts a head node (GCS + raylet) owned by this driver;
+    ``address="host:port"`` connects to an existing GCS.
+    """
+    global _node
+    with _init_lock:
+        if is_initialized():
+            return context()
+        if system_config:
+            CONFIG.update(system_config)
+
+        if address is None:
+            session_dir = new_session_dir()
+            node = NodeProcesses(session_dir)
+            gcs_addr = node.start_gcs()
+            res = dict(resources or {})
+            if num_cpus is not None:
+                res["CPU"] = float(num_cpus)
+            if num_tpus is not None:
+                res["TPU"] = float(num_tpus)
+            node.start_raylet(gcs_addr, resources=res or None,
+                              object_store_memory=object_store_memory)
+            _node = node
+            raylet_addr = node.raylet_address
+            node_id = node.node_id
+            store_path = node.store_path
+        else:
+            host, port = address.rsplit(":", 1)
+            gcs_addr = (host, int(port))
+            # join an existing cluster: attach to a raylet on THIS host —
+            # identified by its shm store segment existing locally
+            import os
+            from ray_tpu.runtime.gcs import GcsClient
+            probe = GcsClient(gcs_addr)
+            try:
+                alive = [n for n in probe.call("list_nodes") if n["alive"]]
+            finally:
+                probe.close()
+            if not alive:
+                raise RuntimeError("no alive nodes in cluster")
+            local = next((n for n in alive
+                          if n.get("store_path")
+                          and os.path.exists(n["store_path"])), None)
+            if local is None:
+                raise RuntimeError(
+                    "no raylet running on this host; start one with "
+                    "cluster.add_node() or `ray_tpu start --address=...`")
+            raylet_addr = tuple(local["address"])
+            node_id = local["node_id"]
+            store_path = local["store_path"]
+            session_dir = ""
+
+        job_id = JobID.from_random()
+        worker = cw.CoreWorker(
+            mode="driver",
+            gcs_address=gcs_addr,
+            raylet_address=raylet_addr,
+            store_path=store_path,
+            node_id=node_id,
+            job_id=job_id,
+            session_dir=session_dir,
+        )
+        worker.namespace = namespace
+        worker.gcs.call("register_job", {
+            "job_id": job_id.hex(),
+            "driver_address": list(worker.address),
+            "entrypoint": " ".join(__import__("sys").argv[:2]),
+        })
+        cw.set_global_worker(worker)
+        return context()
+
+
+def context() -> Dict[str, Any]:
+    worker = cw.get_global_worker()
+    return {
+        "gcs_address": ":".join(map(str, worker.gcs._conn._sock.getpeername())),
+        "node_id": worker.node_id,
+        "job_id": worker.job_id.hex(),
+        "session_dir": worker.session_dir,
+    }
+
+
+def shutdown() -> None:
+    global _node
+    with _init_lock:
+        worker = cw._global_worker
+        if worker is not None:
+            try:
+                worker.gcs.call("finish_job", {"job_id": worker.job_id.hex()},
+                                timeout=5)
+            except Exception:
+                pass
+            worker.shutdown()
+            cw.set_global_worker(None)
+        if _node is not None:
+            _node.stop()
+            _node = None
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=..., num_tpus=...)`` decorator."""
+    def decorate(target):
+        if inspect.isclass(target):
+            return ActorClass(
+                target,
+                num_cpus=kwargs.get("num_cpus", 1.0),
+                num_tpus=kwargs.get("num_tpus", 0.0),
+                resources=kwargs.get("resources"),
+                max_restarts=kwargs.get("max_restarts", 0),
+                name=kwargs.get("name"),
+                namespace=kwargs.get("namespace", ""),
+                lifetime=kwargs.get("lifetime"))
+        return RemoteFunction(
+            target,
+            num_returns=kwargs.get("num_returns", 1),
+            num_cpus=kwargs.get("num_cpus", 1.0),
+            num_tpus=kwargs.get("num_tpus", 0.0),
+            resources=kwargs.get("resources"),
+            max_retries=kwargs.get("max_retries", 3))
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword arguments only")
+    return decorate
+
+
+def put(value: Any) -> ObjectRef:
+    return cw.get_global_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    worker = cw.get_global_worker()
+    if isinstance(refs, ObjectRef):
+        return worker.get([refs], timeout=timeout)[0]
+    return worker.get(list(refs), timeout=timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None,
+         fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    return cw.get_global_worker().wait(
+        list(refs), num_returns=num_returns, timeout=timeout,
+        fetch_local=fetch_local)
+
+
+def nodes() -> List[dict]:
+    return cw.get_global_worker().gcs.call("list_nodes")
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if n["alive"]:
+            for r, v in n["resources"].items():
+                total[r] = total.get(r, 0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if n["alive"]:
+            for r, v in n["available"].items():
+                total[r] = total.get(r, 0) + v
+    return total

@@ -1,0 +1,107 @@
+"""In-process simulated multi-node clusters for tests.
+
+Analog of /root/reference/python/ray/cluster_utils.py (Cluster :99,
+add_node :165, remove_node :238): multiple raylet daemons as separate OS
+processes on one machine, each with its own shm store and resource pool,
+against one GCS — node-failure tests without VMs (SURVEY.md §4 tier 3).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.runtime.node import NodeProcesses, new_session_dir
+
+
+class ClusterNode:
+    def __init__(self, proc: subprocess.Popen, node_id: str,
+                 address, store_path: str):
+        self.proc = proc
+        self.node_id = node_id
+        self.address = tuple(address)
+        self.store_path = store_path
+
+
+class Cluster:
+    def __init__(self, head_resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: int = 128 * 1024 * 1024):
+        self.session_dir = new_session_dir()
+        self._node_procs = NodeProcesses(self.session_dir)
+        self.gcs_address = self._node_procs.start_gcs()
+        self._object_store_memory = object_store_memory
+        self.nodes: List[ClusterNode] = []
+        self.head_node = self.add_node(resources=head_resources)
+
+    @property
+    def address(self) -> str:
+        return f"{self.gcs_address[0]}:{self.gcs_address[1]}"
+
+    def add_node(self, resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: Optional[int] = None) -> ClusterNode:
+        import json
+        import os
+        import sys
+
+        from ray_tpu.runtime.node import _spawn, _wait_address_file
+        addr_file = f"{self.session_dir}/raylet_{len(self.nodes)}_" \
+                    f"{int(time.time() * 1e6)}.json"
+        cmd = [sys.executable, "-m", "ray_tpu.runtime.raylet",
+               "--gcs-host", self.gcs_address[0],
+               "--gcs-port", str(self.gcs_address[1]),
+               "--session-dir", self.session_dir,
+               "--address-file", addr_file,
+               "--object-store-memory",
+               str(object_store_memory or self._object_store_memory)]
+        if resources:
+            cmd += ["--resources", json.dumps(resources)]
+        proc = _spawn(cmd, self.session_dir,
+                      f"raylet_{len(self.nodes)}")
+        info = _wait_address_file(addr_file, proc)
+        node = ClusterNode(proc, info["node_id"],
+                           (info["host"], info["port"]), info["store_path"])
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: ClusterNode, sigkill: bool = True) -> None:
+        if node.proc.poll() is None:
+            if sigkill:
+                node.proc.kill()
+            else:
+                node.proc.terminate()
+            node.proc.wait(timeout=10)
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def kill_gcs(self) -> None:
+        if self._node_procs.gcs_proc is not None:
+            self._node_procs.gcs_proc.kill()
+            self._node_procs.gcs_proc.wait(timeout=10)
+
+    def wait_for_nodes(self, count: Optional[int] = None,
+                       timeout: float = 30.0) -> None:
+        from ray_tpu.runtime.gcs import GcsClient
+        want = count if count is not None else len(self.nodes)
+        client = GcsClient(self.gcs_address)
+        try:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                alive = [n for n in client.call("list_nodes") if n["alive"]]
+                if len(alive) >= want:
+                    return
+                time.sleep(0.1)
+            raise TimeoutError(f"only {len(alive)} of {want} nodes alive")
+        finally:
+            client.close()
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        for node in list(self.nodes):
+            try:
+                self.remove_node(node)
+            except Exception:
+                pass
+        self._node_procs.stop()

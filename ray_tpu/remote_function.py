@@ -1,0 +1,56 @@
+"""@ray_tpu.remote for functions.
+
+Analog of /root/reference/python/ray/remote_function.py (RemoteFunction :35,
+_remote :241, .options :141).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu.runtime.core_worker import get_global_worker
+
+
+class RemoteFunction:
+    def __init__(self, func, *, num_returns: int = 1,
+                 num_cpus: float = 1.0, num_tpus: float = 0.0,
+                 resources: Optional[Dict[str, float]] = None,
+                 max_retries: int = 3):
+        self._func = func
+        self._num_returns = num_returns
+        self._resources = dict(resources or {})
+        self._resources["CPU"] = num_cpus
+        if num_tpus:
+            self._resources["TPU"] = num_tpus
+        self._max_retries = max_retries
+        functools.update_wrapper(self, func)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._func.__name__!r} cannot be called "
+            "directly; use .remote()")
+
+    def remote(self, *args, **kwargs):
+        worker = get_global_worker()
+        refs = worker.submit_task(
+            self._func, args, kwargs,
+            num_returns=self._num_returns,
+            resources=self._resources,
+            max_retries=self._max_retries,
+            name=self._func.__name__)
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def options(self, **opts) -> "RemoteFunction":
+        new = RemoteFunction(
+            self._func,
+            num_returns=opts.get("num_returns", self._num_returns),
+            num_cpus=opts.get("num_cpus", self._resources.get("CPU", 1.0)),
+            num_tpus=opts.get("num_tpus", self._resources.get("TPU", 0.0)),
+            resources=opts.get("resources",
+                               {k: v for k, v in self._resources.items()
+                                if k not in ("CPU", "TPU")}),
+            max_retries=opts.get("max_retries", self._max_retries))
+        return new

@@ -1,0 +1,1022 @@
+"""Per-process runtime: task submission, object ownership, get/put/wait.
+
+TPU-native analog of the reference CoreWorker
+(/root/reference/src/ray/core_worker/core_worker.h:261): every driver and
+worker embeds one.  It owns
+
+  - the in-process memory store for inlined objects
+    (store_provider/memory_store/memory_store.h:43),
+  - the shm-store client for large objects (plasma_store_provider.h:88),
+  - the ownership table: this process owns the objects its tasks return
+    (reference_count.h:61 ownership model — the owner records locations and
+    serves gets; no central object table),
+  - the lease-based task submitter
+    (transport/direct_task_transport.h:57 — lease a worker per scheduling
+    key from the raylet, push tasks directly, return when idle), and
+  - actor handles with per-actor ordered submission queues
+    (transport/direct_actor_task_submitter.h:67 — sequence numbers,
+    resubmit on restart).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import rpc
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.logging_utils import get_logger
+from ray_tpu.runtime.gcs import ALIVE, DEAD, GcsClient, RESTARTING
+from ray_tpu.runtime.object_store import SharedMemoryStore
+
+logger = get_logger("core_worker")
+
+_INLINE_MAX = None  # resolved lazily from CONFIG
+
+
+class ObjectRef:
+    """Handle to a future object.  Embeds the owner's serving address so any
+    borrower can reach the owner directly (ownership-based directory,
+    cf. ownership_based_object_directory.h)."""
+
+    __slots__ = ("id", "owner_addr", "_worker", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_addr: Tuple[str, int],
+                 worker: Optional["CoreWorker"] = None):
+        self.id = object_id
+        self.owner_addr = tuple(owner_addr)
+        self._worker = worker
+        if worker is not None:
+            worker._ref_created(object_id)
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self.id.hex()[:16]})"
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __del__(self):
+        w = self._worker
+        if w is not None:
+            w._ref_deleted(self.id)
+
+    def __reduce__(self):
+        # crossing process boundaries drops the local refcount hook; the
+        # receiver re-binds to its own core worker on use
+        return (_rebuild_ref, (self.id.binary(), self.owner_addr))
+
+    def future(self):
+        """concurrent.futures-style accessor used by library code."""
+        from concurrent.futures import Future
+        f: Future = Future()
+        def _poll():
+            try:
+                f.set_result(get_global_worker().get([self], timeout=None)[0])
+            except BaseException as e:  # noqa: BLE001
+                f.set_exception(e)
+        threading.Thread(target=_poll, daemon=True).start()
+        return f
+
+
+def _rebuild_ref(id_bytes: bytes, owner_addr) -> "ObjectRef":
+    worker = _global_worker
+    return ObjectRef(ObjectID(id_bytes), tuple(owner_addr), worker)
+
+
+_global_worker: Optional["CoreWorker"] = None
+
+
+def get_global_worker() -> "CoreWorker":
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _global_worker
+
+
+def set_global_worker(worker: Optional["CoreWorker"]) -> None:
+    global _global_worker
+    _global_worker = worker
+
+
+class _OwnedObject:
+    __slots__ = ("state", "data", "error", "locations", "event", "refcount",
+                 "task_spec")
+
+    def __init__(self):
+        self.state = "pending"       # pending | ready
+        self.data: Optional[bytes] = None     # serialized inline payload
+        self.error = 0
+        self.locations: set = set()  # node_id hex with a shm copy
+        self.event = threading.Event()
+        self.refcount = 0
+        self.task_spec: Optional[bytes] = None  # lineage for reconstruction
+
+
+class _Lease:
+    __slots__ = ("lease_id", "worker_id", "address", "conn", "key",
+                 "granting_addr")
+
+    def __init__(self, key, grant, conn):
+        self.key = key
+        self.lease_id = grant["lease_id"]
+        self.worker_id = grant["worker_id"]
+        self.address = tuple(grant["address"])
+        self.granting_addr = grant.get("granting_addr")  # None == local
+        self.conn = conn
+
+
+class CoreWorker:
+    def __init__(self, *, mode: str, gcs_address: Tuple[str, int],
+                 raylet_address: Tuple[str, int], store_path: str,
+                 node_id: str, job_id: Optional[JobID] = None,
+                 worker_id: Optional[WorkerID] = None,
+                 session_dir: str = "", host: str = "127.0.0.1"):
+        global _INLINE_MAX
+        _INLINE_MAX = CONFIG.inline_object_max_bytes
+        self.mode = mode  # "driver" | "worker"
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.job_id = job_id or JobID.from_random()
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.current_task_id = TaskID.from_random()  # driver root task
+        self._put_counter = 0
+        self._counter_lock = threading.Lock()
+
+        self._owned: Dict[ObjectID, _OwnedObject] = {}
+        self._owned_lock = threading.RLock()  # ObjectRef ctor re-enters
+        self._memory_cache: Dict[ObjectID, Any] = {}   # deserialized values
+        self._pins: Dict[ObjectID, int] = {}   # local shm pins we hold
+        self._pins_lock = threading.Lock()
+        # strong refs to task-argument ObjectRefs, held until the task using
+        # them completes (otherwise the owner may free the object before the
+        # executing worker fetches it)
+        self._arg_refs: Dict[bytes, list] = {}
+        self._owner_conns: Dict[Tuple[str, int], rpc.Connection] = {}
+        self._owner_conns_lock = threading.Lock()
+
+        self.store = SharedMemoryStore.attach(store_path)
+        self._server = rpc.Server(self._handle_rpc, host=host)
+        self.address = self._server.address
+
+        self.gcs = GcsClient(gcs_address)
+        self.raylet_addr = tuple(raylet_address)
+        self._raylet = rpc.connect(self.raylet_addr)
+
+        # task submission state: per scheduling key a FIFO of pending specs
+        # and a set of leased workers that pull from it (cf. reference
+        # OnWorkerIdle, direct_task_transport.cc:174 — tasks pipeline onto
+        # leased workers; at most one lease request in flight per key,
+        # RequestNewWorkerIfNeeded :325)
+        self._sched: Dict[str, Dict[str, Any]] = {}
+        self._sched_lock = threading.Lock()
+        self._fn_cache: Dict[str, Any] = {}
+        self._node_table: Dict[str, Dict] = {}
+
+        # actor submission: per-actor ordered pipeline (a single sender
+        # thread per actor allocates seqs in submission order and pipelines
+        # calls; cf. CoreWorkerDirectActorTaskSubmitter's per-actor queues,
+        # direct_actor_task_submitter.h:67).  A fresh connection starts a new
+        # caller-stream with seq 0, so the actor-side queue never waits on
+        # seqs that died with an old connection.
+        self._actor_pipes: Dict[str, "_ActorPipe"] = {}
+        self._actor_lock = threading.Lock()
+
+        self._task_events = deque(maxlen=CONFIG.task_events_buffer_size)
+        self._shutdown = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._sched_lock:
+            leases = [l for s in self._sched.values() for l in s["leases"]]
+            self._sched.clear()
+        for lease in leases:
+            self._return_lease(lease)
+        self._server.stop()
+        with self._actor_lock:
+            pipes = list(self._actor_pipes.values())
+        for pipe in pipes:
+            if pipe.conn is not None:
+                pipe.conn.close()
+        try:
+            self._raylet.close()
+        except Exception:
+            pass
+        try:
+            self.gcs.close()
+        except Exception:
+            pass
+        self.store.close()
+
+    # ------------------------------------------------------- refcounting
+    def _ref_created(self, oid: ObjectID) -> None:
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+            if entry is not None:
+                entry.refcount += 1
+
+    def _ref_deleted(self, oid: ObjectID) -> None:
+        if self._shutdown.is_set():
+            return
+        free = False
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+            if entry is not None:
+                entry.refcount -= 1
+                if entry.refcount <= 0 and entry.state == "ready":
+                    del self._owned[oid]
+                    self._memory_cache.pop(oid, None)
+                    free = True
+        if free:
+            self._release_pins(oid)
+            # release primary shm copy if we placed one locally
+            try:
+                self.store.delete(oid)
+            except Exception:
+                pass
+
+    def _note_pin(self, oid: ObjectID) -> None:
+        with self._pins_lock:
+            self._pins[oid] = self._pins.get(oid, 0) + 1
+
+    def _release_pins(self, oid: ObjectID) -> None:
+        with self._pins_lock:
+            count = self._pins.pop(oid, 0)
+        for _ in range(count):
+            try:
+                self.store.release(oid)
+            except Exception:
+                break
+
+    def release_borrowed(self, oids) -> None:
+        """Drop pins + cached values for borrowed objects (a worker calls
+        this after finishing the task that resolved them)."""
+        for oid in oids:
+            with self._owned_lock:
+                if oid in self._owned:
+                    continue  # owned objects are managed by refcounting
+                self._memory_cache.pop(oid, None)
+            self._release_pins(oid)
+
+    # ------------------------------------------------------------- put/get
+    def put(self, value: Any) -> ObjectRef:
+        with self._counter_lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        oid = ObjectID.for_put(self.current_task_id, idx)
+        head, views = ser.serialize(value)
+        size = ser.serialized_size(head, views)
+        entry = _OwnedObject()
+        entry.state = "ready"
+        with self._owned_lock:
+            self._owned[oid] = entry
+        if size <= _INLINE_MAX:
+            entry.data = ser.to_flat_bytes(head, views)
+            self._memory_cache[oid] = value
+        else:
+            self.store.put_serialized(oid, head, views)
+            entry.locations.add(self.node_id)
+        entry.event.set()
+        return ObjectRef(oid, self.address, self)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
+            ) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return [self._get_one(r, deadline) for r in refs]
+
+    def _remaining(self, deadline: Optional[float]) -> Optional[float]:
+        if deadline is None:
+            return None
+        return max(0.0, deadline - time.monotonic())
+
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        oid = ref.id
+        if oid in self._memory_cache:
+            return self._memory_cache[oid]
+        data = self._fetch_serialized(ref, deadline)
+        if data is None:
+            raise exc.GetTimeoutError(f"get timed out on {ref}")
+        value = ser.deserialize(data)   # raises stored task errors
+        self._memory_cache[oid] = value
+        self._maybe_trim_cache()
+        return value
+
+    def _maybe_trim_cache(self, cap: int = 4096) -> None:
+        """Bound the borrowed portion of the value cache (owned entries are
+        evicted by refcounting; borrowed ones would otherwise accumulate in
+        long-lived pooled workers)."""
+        if len(self._memory_cache) <= cap:
+            return
+        with self._owned_lock:
+            victims = [oid for oid in self._memory_cache
+                       if oid not in self._owned][:len(self._memory_cache) - cap]
+            for oid in victims:
+                self._memory_cache.pop(oid, None)
+        for oid in victims:
+            self._release_pins(oid)
+
+    def _fetch_serialized(self, ref: ObjectRef,
+                          deadline: Optional[float]) -> Optional[memoryview]:
+        oid = ref.id
+        # 1. owned inline
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+        if entry is not None:
+            t = self._remaining(deadline)
+            if not entry.event.wait(t if t is not None else None):
+                return None
+            if entry.data is not None:
+                return memoryview(entry.data)
+            # owned but stored in shm somewhere
+            return self._fetch_from_locations(oid, entry.locations, deadline)
+        # 2. local shm
+        res = self.store.get(oid, timeout=0.0)
+        if res is not None:
+            buf, _ = res
+            self._note_pin(oid)
+            return buf
+        # 3. ask the owner
+        return self._fetch_from_owner(ref, deadline)
+
+    def _fetch_from_locations(self, oid: ObjectID, locations: set,
+                              deadline: Optional[float]) -> Optional[memoryview]:
+        while True:
+            if self.node_id in locations:
+                res = self.store.get(oid, timeout=self._remaining(deadline))
+                if res is not None:
+                    self._note_pin(oid)
+                    return res[0]
+            for node_hex in list(locations):
+                if node_hex == self.node_id:
+                    continue
+                data = self._fetch_remote(node_hex, oid, deadline)
+                if data is not None:
+                    return memoryview(data)
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(0.005)
+
+    def _node_address(self, node_hex: str) -> Optional[Tuple[str, int]]:
+        node = self._node_table.get(node_hex)
+        if node is None:
+            for n in self.gcs.call("list_nodes"):
+                self._node_table[n["node_id"]] = n
+            node = self._node_table.get(node_hex)
+        return tuple(node["address"]) if node else None
+
+    def _fetch_remote(self, node_hex: str, oid: ObjectID,
+                      deadline: Optional[float]) -> Optional[bytes]:
+        addr = self._node_address(node_hex)
+        if addr is None:
+            return None
+        try:
+            conn = rpc.connect(addr, timeout=5.0)
+            try:
+                res = conn.call("fetch_object",
+                                {"object_id": oid.binary(),
+                                 "timeout": 0.0},
+                                timeout=CONFIG.raylet_rpc_timeout_s)
+            finally:
+                conn.close()
+        except (ConnectionError, rpc.RemoteError, TimeoutError, OSError):
+            return None
+        return res["data"] if res else None
+
+    def _owner_conn(self, addr: Tuple[str, int]) -> rpc.Connection:
+        addr = tuple(addr)
+        with self._owner_conns_lock:
+            conn = self._owner_conns.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+        conn = rpc.connect(addr, timeout=5.0)
+        with self._owner_conns_lock:
+            old = self._owner_conns.get(addr)
+            if old is not None and not old.closed:
+                conn.close()
+                return old
+            self._owner_conns[addr] = conn
+        return conn
+
+    def _fetch_from_owner(self, ref: ObjectRef,
+                          deadline: Optional[float]) -> Optional[memoryview]:
+        while True:
+            t = self._remaining(deadline)
+            try:
+                conn = self._owner_conn(ref.owner_addr)
+                res = conn.call("get_object", {
+                    "object_id": ref.id.binary(),
+                    "timeout": min(t, 2.0) if t is not None else 2.0,
+                }, timeout=CONFIG.gcs_rpc_timeout_s)
+            except (ConnectionError, rpc.RemoteError, OSError):
+                raise exc.ObjectLostError(
+                    f"owner of {ref} unreachable at {ref.owner_addr}")
+            if res is not None:
+                if "data" in res:
+                    return memoryview(res["data"])
+                # location answer
+                data = self._fetch_from_locations(
+                    ref.id, set(res["locations"]), deadline)
+                if data is not None:
+                    return data
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+
+    # ------------------------------------------------------------- wait
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None,
+             fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        refs = list(refs)
+        ready: List[ObjectRef] = []
+        while True:
+            ready = [r for r in refs if self._is_ready(r)]
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.002)
+        ready_set = {r.id for r in ready[:num_returns]}
+        ready_list = [r for r in refs if r.id in ready_set]
+        rest = [r for r in refs if r.id not in ready_set]
+        return ready_list, rest
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        if ref.id in self._memory_cache:
+            return True
+        with self._owned_lock:
+            entry = self._owned.get(ref.id)
+        if entry is not None:
+            return entry.event.is_set()
+        if self.store.contains(ref.id):
+            return True
+        # borrowed & remote: ask owner without blocking
+        try:
+            conn = self._owner_conn(ref.owner_addr)
+            res = conn.call("get_object", {"object_id": ref.id.binary(),
+                                           "timeout": 0.0,
+                                           "probe": True}, timeout=5.0)
+            return res is not None
+        except (ConnectionError, rpc.RemoteError, TimeoutError, OSError):
+            return False
+
+    # -------------------------------------------------- function registry
+    def register_function(self, func) -> str:
+        blob = cloudpickle.dumps(func)
+        key = hashlib.sha1(blob).hexdigest()
+        full = f"fn:{self.job_id.hex()}:{key}"
+        if full not in self._fn_cache:
+            self.gcs.kv_put(full, blob, overwrite=False)
+            self._fn_cache[full] = func
+        return full
+
+    def load_function(self, key: str):
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            blob = self.gcs.kv_get(key)
+            if blob is None:
+                raise exc.RayTpuError(f"function {key} not found in GCS")
+            fn = cloudpickle.loads(blob)
+            self._fn_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------ task submission
+    def submit_task(self, func, args: tuple, kwargs: dict, *,
+                    num_returns: int = 1,
+                    resources: Optional[Dict[str, float]] = None,
+                    max_retries: int = 3,
+                    name: str = "",
+                    scheduling_key: Optional[str] = None) -> List[ObjectRef]:
+        fn_key = self.register_function(func)
+        task_id = TaskID.from_random()
+        resources = dict(resources or {})
+        # scheduling key = resource footprint (not the function): workers are
+        # fungible across functions, so leases and the raylet's idle pool are
+        # shared by everything with the same shape (cf. reference
+        # SchedulingKey in direct_task_transport.h — runtime_env + resources)
+        key = scheduling_key or (
+            self.job_id.hex()[:8] + "|" +
+            ",".join(f"{k}={v}" for k, v in sorted(resources.items())))
+        arg_blob, live_refs = self._serialize_args(args, kwargs)
+        if live_refs:
+            self._arg_refs[task_id.binary()] = live_refs
+        spec = {
+            "task_id": task_id.binary(),
+            "fn_key": fn_key,
+            "args": arg_blob,
+            "num_returns": num_returns,
+            "owner_addr": list(self.address),
+            "name": name or getattr(func, "__name__", "task"),
+        }
+        return_refs = []
+        with self._owned_lock:
+            for i in range(num_returns):
+                oid = ObjectID.for_task_return(task_id, i)
+                entry = _OwnedObject()
+                entry.task_spec = cloudpickle.dumps(
+                    {"spec": spec, "resources": resources, "key": key,
+                     "retries_left": max_retries})
+                self._owned[oid] = entry
+                return_refs.append(ObjectRef(oid, self.address, self))
+        self._enqueue_task(key, resources, spec, max_retries)
+        self._task_events.append(
+            {"task_id": task_id.hex(), "name": spec["name"],
+             "state": "SUBMITTED", "ts": time.time()})
+        return return_refs
+
+    def _serialize_args(self, args: tuple, kwargs: dict):
+        """Pickle args; ObjectRefs become markers resolved executor-side.
+        Large plain values are auto-promoted to the store first (cf.
+        reference max_direct_call_object_size).  Returns (blob, live_refs):
+        the caller must keep ``live_refs`` alive until the task completes so
+        the owner doesn't free argument objects mid-flight."""
+        promoted_args = []
+        live_refs = []
+        for a in args:
+            if not isinstance(a, ObjectRef):
+                blob_size = len(cloudpickle.dumps(a, protocol=5)) \
+                    if _maybe_big(a) else 0
+                if blob_size > CONFIG.max_direct_call_args_bytes:
+                    a = self.put(a)
+            if isinstance(a, ObjectRef):
+                live_refs.append(a)
+            promoted_args.append(a)
+        for v in kwargs.values():
+            if isinstance(v, ObjectRef):
+                live_refs.append(v)
+        return cloudpickle.dumps((tuple(promoted_args), kwargs)), live_refs
+
+    def _serialize_args_tracked(self, args, kwargs, task_id: TaskID) -> bytes:
+        blob, live_refs = self._serialize_args(args, kwargs)
+        if live_refs:
+            self._arg_refs[task_id.binary()] = live_refs
+        return blob
+
+    def _store_task_error(self, spec, error: BaseException) -> None:
+        task_id = TaskID(spec["task_id"])
+        self._arg_refs.pop(spec["task_id"], None)
+        head, views = ser.serialize(error, error_type=ser.ERROR_TASK)
+        data = ser.to_flat_bytes(head, views)
+        with self._owned_lock:
+            for i in range(spec["num_returns"]):
+                oid = ObjectID.for_task_return(task_id, i)
+                entry = self._owned.get(oid)
+                if entry is not None:
+                    entry.data = data
+                    entry.state = "ready"
+                    entry.error = ser.ERROR_TASK
+                    entry.event.set()
+
+    # ----- per-key scheduling queue: leased workers pull pending specs -----
+    def _sched_state(self, key: str, resources) -> Dict[str, Any]:
+        with self._sched_lock:
+            st = self._sched.get(key)
+            if st is None:
+                st = {"queue": deque(), "leases": [], "requesting": False,
+                      "resources": dict(resources)}
+                self._sched[key] = st
+            return st
+
+    def _enqueue_task(self, key, resources, spec, retries: int) -> None:
+        st = self._sched_state(key, resources)
+        with self._sched_lock:
+            st["queue"].append((spec, retries))
+        self._maybe_request_lease(key, st)
+
+    def _maybe_request_lease(self, key: str, st) -> None:
+        with self._sched_lock:
+            if (st["requesting"] or not st["queue"]
+                    or self._shutdown.is_set()):
+                return
+            st["requesting"] = True
+        threading.Thread(target=self._lease_request_loop, args=(key, st),
+                         daemon=True).start()
+
+    def _lease_request_loop(self, key: str, st) -> None:
+        """At most one in-flight lease request per scheduling key."""
+        try:
+            while True:
+                with self._sched_lock:
+                    if not st["queue"] or self._shutdown.is_set():
+                        return
+                try:
+                    grant = self._lease_with_spillback(key, st)
+                    conn = rpc.connect(tuple(grant["address"]))
+                except (ConnectionError, rpc.RemoteError, TimeoutError) as e:
+                    # resources busy / raylet hiccup: if existing leases are
+                    # draining the queue that's fine; otherwise keep trying
+                    with self._sched_lock:
+                        have_workers = bool(st["leases"])
+                        pending = bool(st["queue"])
+                    if not pending:
+                        return
+                    if not have_workers and self._shutdown.is_set():
+                        return
+                    if not have_workers and isinstance(e, ConnectionError):
+                        self._fail_queued(st, exc.RayTpuError(
+                            f"raylet unreachable: {e}"))
+                        return
+                    time.sleep(0.2)
+                    continue
+                lease = _Lease(key, grant, conn)
+                with self._sched_lock:
+                    st["leases"].append(lease)
+                threading.Thread(target=self._lease_worker_loop,
+                                 args=(key, st, lease), daemon=True).start()
+        finally:
+            with self._sched_lock:
+                st["requesting"] = False
+            # new tasks may have arrived while we were exiting
+            with self._sched_lock:
+                need_more = bool(st["queue"]) and not st["leases"]
+            if need_more:
+                self._maybe_request_lease(key, st)
+
+    def _lease_with_spillback(self, key: str, st) -> dict:
+        """Lease locally; follow at most two retry_at redirects (the
+        reference's spillback, direct_task_transport.cc retry_at_raylet).
+        The grant remembers which raylet granted it so return_worker goes to
+        the right node."""
+        payload = {"key": key, "resources": st["resources"],
+                   "job_id": self.job_id.hex()}
+        target_addr = None  # None -> local raylet
+        for hop in range(3):
+            if target_addr is None:
+                grant = self._raylet.call(
+                    "lease_worker", dict(payload, spillback=hop),
+                    timeout=CONFIG.worker_lease_timeout_s + 5)
+            else:
+                conn = rpc.connect(target_addr)
+                try:
+                    grant = conn.call(
+                        "lease_worker", dict(payload, spillback=hop),
+                        timeout=CONFIG.worker_lease_timeout_s + 5)
+                finally:
+                    conn.close()
+            if "retry_at" in grant:
+                target_addr = tuple(grant["retry_at"])
+                continue
+            grant["granting_addr"] = target_addr  # None == local
+            return grant
+        raise rpc.RpcError("spillback loop exceeded")
+
+    def _fail_queued(self, st, error: BaseException) -> None:
+        with self._sched_lock:
+            items = list(st["queue"])
+            st["queue"].clear()
+        for spec, _ in items:
+            self._store_task_error(spec, error)
+
+    def _lease_worker_loop(self, key: str, st, lease: _Lease) -> None:
+        """Pull tasks from the key's queue and push them to this worker."""
+        while True:
+            with self._sched_lock:
+                if st["queue"] and not self._shutdown.is_set():
+                    spec, retries = st["queue"].popleft()
+                else:
+                    st["leases"].remove(lease)
+                    break
+            try:
+                reply = lease.conn.call("push_task", spec, timeout=None)
+                self._on_task_reply(spec, reply)
+            except (ConnectionError, OSError, rpc.RemoteError) as e:
+                if isinstance(e, rpc.RemoteError):
+                    self._store_task_error(spec, exc.RayTpuError(str(e)))
+                    continue
+                # worker died mid-task
+                if retries > 0:
+                    logger.info("task %s worker died; retrying (%d left)",
+                                spec["name"], retries)
+                    with self._sched_lock:
+                        st["queue"].appendleft((spec, retries - 1))
+                else:
+                    self._store_task_error(spec, exc.WorkerCrashedError(
+                        f"task {spec['name']} worker died: {e}"))
+                with self._sched_lock:
+                    st["leases"].remove(lease)
+                try:
+                    lease.conn.close()
+                except Exception:
+                    pass
+                self._maybe_request_lease(key, st)
+                return
+        self._return_lease(lease)
+        self._maybe_request_lease(key, st)
+
+    def _return_lease(self, lease: _Lease) -> None:
+        payload = {"lease_id": lease.lease_id,
+                   "worker_id": lease.worker_id,
+                   "key": lease.key}
+        try:
+            if lease.granting_addr is None:
+                self._raylet.call("return_worker", payload, timeout=10)
+            else:
+                conn = rpc.connect(tuple(lease.granting_addr))
+                try:
+                    conn.call("return_worker", payload, timeout=10)
+                finally:
+                    conn.close()
+        except (ConnectionError, rpc.RemoteError, TimeoutError, OSError):
+            pass
+        try:
+            lease.conn.close()
+        except Exception:
+            pass
+
+    def _on_task_reply(self, spec, reply) -> None:
+        task_id = TaskID(spec["task_id"])
+        self._arg_refs.pop(spec["task_id"], None)
+        results = reply["results"]
+        with self._owned_lock:
+            for i, result in enumerate(results):
+                oid = ObjectID.for_task_return(task_id, i)
+                entry = self._owned.get(oid)
+                if entry is None:
+                    continue
+                entry.error = result.get("error", 0)
+                if result.get("data") is not None:
+                    entry.data = result["data"]
+                    self._memory_cache.pop(oid, None)
+                else:
+                    entry.locations.add(result["location"])
+                entry.state = "ready"
+                entry.event.set()
+        self._task_events.append(
+            {"task_id": task_id.hex(), "name": spec["name"],
+             "state": "FINISHED", "ts": time.time()})
+
+    # --------------------------------------------------------------- actors
+    def create_actor(self, cls, args, kwargs, *, name: Optional[str] = None,
+                     namespace: str = "", detached: bool = False,
+                     max_restarts: int = 0,
+                     resources: Optional[Dict[str, float]] = None) -> "ActorID":
+        actor_id = ActorID.from_random()
+        cls_key = self.register_function(cls)
+        creation_spec = cloudpickle.dumps({
+            "actor_id": actor_id.binary(),
+            "cls_key": cls_key,
+            "args": self._serialize_args_tracked(args, kwargs,
+                                                 TaskID.from_random()),
+            "owner_addr": list(self.address),
+        })
+        self.gcs.call("register_actor", {
+            "actor_id": actor_id.hex(),
+            "job_id": self.job_id.hex(),
+            "name": name,
+            "namespace": namespace,
+            "detached": detached,
+            "spec": creation_spec,
+            "resources": dict(resources or {}),
+            "max_restarts": max_restarts,
+        }, timeout=CONFIG.actor_creation_timeout_s)
+        return actor_id
+
+    def _resolve_actor(self, actor_id_hex: str,
+                       timeout: Optional[float] = None) -> Tuple[str, int]:
+        deadline = time.monotonic() + (timeout or
+                                       CONFIG.actor_creation_timeout_s)
+        while True:
+            info = self.gcs.call("get_actor", {"actor_id": actor_id_hex})
+            if info is None:
+                raise exc.ActorDiedError(f"actor {actor_id_hex[:8]} not found")
+            if info["state"] == ALIVE and info["address"]:
+                return tuple(info["address"])
+            if info["state"] == DEAD:
+                raise exc.ActorDiedError(
+                    info.get("death_cause") or "actor is dead")
+            if time.monotonic() > deadline:
+                raise exc.ActorUnavailableError(
+                    f"actor {actor_id_hex[:8]} not ready "
+                    f"(state={info['state']})")
+            time.sleep(0.02)
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args: tuple, kwargs: dict, *,
+                          num_returns: int = 1,
+                          max_task_retries: int = 0) -> List[ObjectRef]:
+        task_id = TaskID.from_random()
+        aid = actor_id.hex()
+        spec = {
+            "task_id": task_id.binary(),
+            "actor_id": aid,
+            "method": method_name,
+            "args": self._serialize_args_tracked(args, kwargs, task_id),
+            "num_returns": num_returns,
+            "owner_addr": list(self.address),
+            "name": method_name,
+        }
+        refs = []
+        with self._owned_lock:
+            for i in range(num_returns):
+                oid = ObjectID.for_task_return(task_id, i)
+                self._owned[oid] = _OwnedObject()
+                refs.append(ObjectRef(oid, self.address, self))
+        with self._actor_lock:
+            pipe = self._actor_pipes.get(aid)
+            if pipe is None:
+                pipe = _ActorPipe(self, aid)
+                self._actor_pipes[aid] = pipe
+        pipe.enqueue(spec, max_task_retries)
+        return refs
+
+    def _store_actor_error(self, spec, error: BaseException) -> None:
+        task_id = TaskID(spec["task_id"])
+        self._arg_refs.pop(spec["task_id"], None)
+        head, views = ser.serialize(error, error_type=ser.ERROR_ACTOR_DIED)
+        data = ser.to_flat_bytes(head, views)
+        with self._owned_lock:
+            for i in range(spec["num_returns"]):
+                oid = ObjectID.for_task_return(task_id, i)
+                entry = self._owned.get(oid)
+                if entry is not None:
+                    entry.data = data
+                    entry.state = "ready"
+                    entry.error = ser.ERROR_ACTOR_DIED
+                    entry.event.set()
+
+    def kill_actor(self, actor_id: ActorID) -> None:
+        self.gcs.call("kill_actor", {"actor_id": actor_id.hex()})
+
+    # ----------------------------------------------------------- rpc server
+    def _handle_rpc(self, conn: rpc.Connection, method: str, p: Any) -> Any:
+        if method == "get_object":
+            return self._rpc_get_object(p or {})
+        raise rpc.RpcError(f"core_worker: unknown method {method}")
+
+    def _rpc_get_object(self, p) -> Optional[dict]:
+        """Owner side of borrower gets: inline data or known locations."""
+        oid = ObjectID(p["object_id"])
+        timeout = p.get("timeout", 0.0)
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+        if entry is None:
+            # maybe it's in our local shm even if not owned
+            if self.store.contains(oid):
+                res = self.store.get(oid, timeout=0.0)
+                if res is not None:
+                    buf, _ = res
+                    try:
+                        return {"data": bytes(buf)}
+                    finally:
+                        buf.release()
+                        self.store.release(oid)
+            return None
+        if not entry.event.wait(timeout):
+            return None
+        if p.get("probe"):
+            return {"ready": True}
+        if entry.data is not None:
+            return {"data": entry.data}
+        return {"locations": list(entry.locations)}
+
+    # -------------------------------------------------------------- events
+    def task_events(self) -> List[dict]:
+        return list(self._task_events)
+
+
+class _ActorPipe:
+    """Ordered, pipelined submission channel to one actor.
+
+    A single sender thread drains the FIFO, assigning sequence numbers in
+    submission order and issuing async calls without waiting (pipelining).
+    On connection loss: unsent + retryable in-flight tasks are resubmitted in
+    order on a fresh stream once the actor is ALIVE again; non-retryable
+    in-flight tasks fail (reference semantics: actor tasks are not retried
+    unless max_task_retries > 0)."""
+
+    def __init__(self, core: "CoreWorker", actor_id_hex: str):
+        self.core = core
+        self.aid = actor_id_hex
+        self.queue: deque = deque()          # (spec, retries)
+        self.inflight: Dict[int, tuple] = {}  # seq -> (spec, retries)
+        self.cv = threading.Condition()
+        self.conn: Optional[rpc.Connection] = None
+        self.next_seq = 0
+        self.stream = ""
+        self.broken = False
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def enqueue(self, spec, retries: int) -> None:
+        with self.cv:
+            self.queue.append((spec, retries))
+            self.cv.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self.cv:
+                while not self.queue and not self.broken:
+                    self.cv.wait()
+                if self.broken:
+                    self._handle_break_locked()
+                    continue
+                spec, retries = self.queue.popleft()
+            if not self._ensure_conn(spec):
+                continue
+            with self.cv:
+                seq = self.next_seq
+                self.next_seq += 1
+                spec = dict(spec, seq=seq, stream=self.stream)
+                self.inflight[seq] = (spec, retries)
+                conn = self.conn
+            fut = conn.call_async("actor_task", spec)
+            fut.add_done_callback(
+                lambda f, s=seq, sp=spec: self._on_done(s, sp, f))
+
+    def _ensure_conn(self, spec) -> bool:
+        with self.cv:
+            if self.conn is not None and not self.conn.closed:
+                return True
+        try:
+            addr = self.core._resolve_actor(self.aid)
+            conn = rpc.connect(addr)
+        except exc.RayTpuError as e:
+            self.core._store_actor_error(spec, e)
+            # fail everything queued: the actor is gone for good
+            with self.cv:
+                dead = list(self.queue)
+                self.queue.clear()
+            for sp, _ in dead:
+                self.core._store_actor_error(sp, e)
+            return False
+        with self.cv:
+            self.conn = conn
+            self.stream = WorkerID.from_random().hex()[:16]
+            self.next_seq = 0
+        return True
+
+    def _on_done(self, seq: int, spec, fut) -> None:
+        try:
+            reply = fut.result()
+        except (ConnectionError, OSError):
+            # connection died; the sender thread re-plans everything that
+            # was in flight, so just flag the break
+            with self.cv:
+                self.broken = True
+                self.cv.notify()
+            return
+        except rpc.RemoteError as e:
+            self.core._store_actor_error(spec, exc.RayTpuError(str(e)))
+            with self.cv:
+                self.inflight.pop(seq, None)
+            return
+        with self.cv:
+            self.inflight.pop(seq, None)
+        self.core._on_task_reply(spec, reply)
+
+    def _handle_break_locked(self) -> None:
+        """cv held.  Reset the pipe after a connection loss."""
+        if self.conn is not None:
+            conn, self.conn = self.conn, None
+        else:
+            conn = None
+        inflight = [self.inflight[s] for s in sorted(self.inflight)]
+        self.inflight.clear()
+        self.broken = False
+        requeue = []
+        failed = []
+        for spec, retries in inflight:
+            base = {k: v for k, v in spec.items()
+                    if k not in ("seq", "stream")}
+            if retries > 0:
+                requeue.append((base, retries - 1))
+            else:
+                failed.append(base)
+        self.queue.extendleft(reversed(requeue))
+        # release the lock-free work outside: store errors after cv block by
+        # stashing on self (simplest: do it inline; _store_actor_error only
+        # touches _owned_lock which is never held while calling here)
+        for spec in failed:
+            self.core._store_actor_error(spec, exc.ActorUnavailableError(
+                f"actor {self.aid[:8]} died while this call was in flight"))
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
+def _maybe_big(value: Any) -> bool:
+    """Cheap pre-filter before paying for a pickle size check."""
+    import numpy as np
+    if isinstance(value, np.ndarray):
+        return value.nbytes > CONFIG.max_direct_call_args_bytes
+    if isinstance(value, (bytes, bytearray, str)):
+        return len(value) > CONFIG.max_direct_call_args_bytes
+    return isinstance(value, (list, tuple, dict)) and len(value) > 1000

@@ -1,0 +1,593 @@
+"""Global Control Service: the head-node daemon.
+
+TPU-native analog of the reference GCS
+(/root/reference/src/ray/gcs/gcs_server/gcs_server.cc:121-181 wires the same
+module set): node table + health checking (GcsNodeManager/GcsHealthCheckManager),
+actor directory + restart FSM (GcsActorManager, gcs_actor_manager.cc:240/1233),
+job table (GcsJobManager), internal KV (GcsKVManager — function/config store),
+pubsub channels (long-poll in the reference, push-based here since our RPC
+connections are duplex), and placement groups.
+
+Storage is pluggable like the reference's RedisStoreClient/InMemoryStoreClient
+(store_client/*.h): in-memory dict by default, optional file-snapshot backend
+so a restarted GCS replays state (GcsInitData replay analog).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._private.logging_utils import get_logger
+
+logger = get_logger("gcs")
+
+# Actor FSM states (cf. reference rpc::ActorTableData::ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class GcsServer:
+    """All control state for one cluster; serves the RPC surface."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.RLock()
+        # node_id hex -> {address, resources, available, last_heartbeat, alive}
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+        # actor_id hex -> actor table entry
+        self._actors: Dict[str, Dict[str, Any]] = {}
+        self._named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> id
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        self._kv: Dict[str, bytes] = {}
+        self._placement_groups: Dict[str, Dict[str, Any]] = {}
+        # channel -> list of (conn, subscriber key)
+        self._subs: Dict[str, List[rpc.Connection]] = {}
+        self._node_conns: Dict[str, rpc.Connection] = {}
+        self._server = rpc.Server(self._handle, host=host, port=port,
+                                  on_disconnect=self._on_disconnect)
+        self._stopped = threading.Event()
+        self._health_thread = threading.Thread(target=self._health_loop,
+                                               daemon=True)
+        self._health_thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.address
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._server.stop()
+
+    # ------------------------------------------------------------------ rpc
+    def _handle(self, conn: rpc.Connection, method: str, p: Any) -> Any:
+        fn = getattr(self, "_rpc_" + method, None)
+        if fn is None:
+            raise rpc.RpcError(f"GCS: unknown method {method}")
+        return fn(conn, p or {})
+
+    def _on_disconnect(self, conn: rpc.Connection) -> None:
+        with self._lock:
+            for subs in self._subs.values():
+                if conn in subs:
+                    subs.remove(conn)
+            dead_node = None
+            for nid, c in list(self._node_conns.items()):
+                if c is conn:
+                    dead_node = nid
+                    del self._node_conns[nid]
+            # driver conn drop -> finish its job
+            job_id = getattr(conn, "peer", None)
+            if isinstance(job_id, str) and job_id in self._jobs:
+                self._finish_job_locked(job_id)
+        if dead_node:
+            self._mark_node_dead(dead_node)
+
+    # ----------------------------------------------------------------- nodes
+    def _rpc_register_node(self, conn, p):
+        node_id = p["node_id"]
+        with self._lock:
+            self._nodes[node_id] = {
+                "node_id": node_id,
+                "address": tuple(p["address"]),
+                "store_path": p.get("store_path"),
+                "resources": dict(p.get("resources", {})),
+                "available": dict(p.get("resources", {})),
+                "labels": dict(p.get("labels", {})),
+                "alive": True,
+                "last_heartbeat": time.monotonic(),
+            }
+            self._node_conns[node_id] = conn
+            conn.peer = ("node", node_id)
+        self._publish("node", {"node_id": node_id, "state": "ALIVE"})
+        # a new node may unblock pending actors / placement groups
+        threading.Thread(target=self._retry_pending_actors,
+                         daemon=True).start()
+        return {"ok": True}
+
+    def _retry_pending_actors(self) -> None:
+        with self._lock:
+            pending = [aid for aid, a in self._actors.items()
+                       if a["state"] in (PENDING_CREATION, RESTARTING)
+                       and not a.get("dispatched")]
+            pending_pgs = [pgid for pgid, pg in self._placement_groups.items()
+                           if pg["state"] == "PENDING"]
+        for aid in pending:
+            self._schedule_actor(aid)
+        for pgid in pending_pgs:
+            self._retry_placement_group(pgid)
+
+    def _retry_placement_group(self, pgid: str) -> None:
+        with self._lock:
+            pg = self._placement_groups.get(pgid)
+            if pg is None or pg["state"] != "PENDING":
+                return
+            nodes = [n for n in self._nodes.values() if n["alive"]]
+            placement = self._pack_bundles(pg["bundles"], pg["strategy"],
+                                           nodes)
+            if placement is None:
+                return
+            for bundle, node_id in zip(pg["bundles"], placement):
+                node = self._nodes[node_id]
+                for r, v in bundle.items():
+                    node["available"][r] = node["available"].get(r, 0) - v
+            pg["state"] = "CREATED"
+            pg["placement"] = placement
+        self._publish("placement_group", {"pg_id": pgid, "state": "CREATED"})
+
+    def _rpc_heartbeat(self, conn, p):
+        with self._lock:
+            node = self._nodes.get(p["node_id"])
+            if node is None:
+                return {"ok": False, "reregister": True}
+            if not node["alive"]:
+                # Death is permanent (reference semantics): a stalled node
+                # whose actors were already restarted elsewhere must not be
+                # resurrected — tell it to shut down.
+                return {"ok": False, "dead": True}
+            node["last_heartbeat"] = time.monotonic()
+            node["available"] = dict(p.get("available", node["available"]))
+        return {"ok": True}
+
+    def _rpc_list_nodes(self, conn, p):
+        with self._lock:
+            return [dict(n) for n in self._nodes.values()]
+
+    def _health_loop(self) -> None:
+        period = CONFIG.heartbeat_period_ms / 1000.0
+        threshold = CONFIG.health_check_failure_threshold
+        while not self._stopped.wait(period):
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for nid, node in self._nodes.items():
+                    if node["alive"] and \
+                            now - node["last_heartbeat"] > period * threshold:
+                        dead.append(nid)
+            for nid in dead:
+                self._mark_node_dead(nid)
+
+    def _mark_node_dead(self, node_id: str) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if not node or not node["alive"]:
+                return
+            node["alive"] = False
+            affected = [aid for aid, a in self._actors.items()
+                        if a.get("node_id") == node_id
+                        and a["state"] in (ALIVE, PENDING_CREATION)]
+        logger.warning("node %s marked dead (actors affected: %d)",
+                       node_id[:8], len(affected))
+        self._publish("node", {"node_id": node_id, "state": "DEAD"})
+        for aid in affected:
+            self._on_actor_failure(aid, f"node {node_id[:8]} died")
+
+    # ----------------------------------------------------------------- jobs
+    def _rpc_register_job(self, conn, p):
+        job_id = p["job_id"]
+        with self._lock:
+            self._jobs[job_id] = {"job_id": job_id, "state": "RUNNING",
+                                  "driver_address": tuple(p.get("driver_address") or ()),
+                                  "start_time": time.time(),
+                                  "entrypoint": p.get("entrypoint", "")}
+            conn.peer = job_id
+        return {"ok": True}
+
+    def _rpc_finish_job(self, conn, p):
+        with self._lock:
+            self._finish_job_locked(p["job_id"])
+        return {"ok": True}
+
+    def _finish_job_locked(self, job_id: str) -> None:
+        job = self._jobs.get(job_id)
+        if job and job["state"] == "RUNNING":
+            job["state"] = "FINISHED"
+            job["end_time"] = time.time()
+            # non-detached actors of the job die with it — and their worker
+            # processes must actually be killed so their lease resources free
+            doomed = []
+            for aid, a in self._actors.items():
+                if a.get("job_id") == job_id and not a.get("detached") \
+                        and a["state"] != DEAD:
+                    a["state"] = DEAD
+                    a["death_cause"] = "job finished"
+                    node_conn = self._node_conns.get(a.get("node_id") or "")
+                    doomed.append((aid, node_conn))
+                    if a.get("name"):
+                        self._named_actors.pop(
+                            (a.get("namespace", ""), a["name"]), None)
+            for aid, node_conn in doomed:
+                if node_conn is not None:
+                    try:
+                        node_conn.push("kill_actor_worker", {"actor_id": aid})
+                    except ConnectionError:
+                        pass
+            self._publish("job", {"job_id": job_id, "state": "FINISHED"})
+
+    def _rpc_list_jobs(self, conn, p):
+        with self._lock:
+            return [dict(j) for j in self._jobs.values()]
+
+    # ------------------------------------------------------------------- kv
+    def _rpc_kv_put(self, conn, p):
+        with self._lock:
+            existed = p["key"] in self._kv
+            if p.get("overwrite", True) or not existed:
+                self._kv[p["key"]] = p["value"]
+        return {"existed": existed}
+
+    def _rpc_kv_get(self, conn, p):
+        with self._lock:
+            return self._kv.get(p["key"])
+
+    def _rpc_kv_del(self, conn, p):
+        with self._lock:
+            return {"deleted": self._kv.pop(p["key"], None) is not None}
+
+    def _rpc_kv_keys(self, conn, p):
+        prefix = p.get("prefix", "")
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    def _rpc_kv_exists(self, conn, p):
+        with self._lock:
+            return p["key"] in self._kv
+
+    # --------------------------------------------------------------- pubsub
+    def _rpc_subscribe(self, conn, p):
+        with self._lock:
+            self._subs.setdefault(p["channel"], []).append(conn)
+        return {"ok": True}
+
+    def _rpc_publish(self, conn, p):
+        self._publish(p["channel"], p["message"])
+        return {"ok": True}
+
+    def _publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, []))
+        for c in subs:
+            try:
+                c.push("pubsub", {"channel": channel, "message": message})
+            except ConnectionError:
+                pass
+
+    # --------------------------------------------------------------- actors
+    def _rpc_register_actor(self, conn, p):
+        """Register + schedule an actor; cf. GcsActorManager::HandleRegisterActor
+        (/root/reference/src/ray/gcs/gcs_server/gcs_actor_manager.cc:240) and
+        GcsActorScheduler (gcs_actor_scheduler.h:111)."""
+        aid = p["actor_id"]
+        with self._lock:
+            if aid in self._actors:
+                return dict(self._actors[aid])
+            name = p.get("name")
+            ns = p.get("namespace", "")
+            if name and (ns, name) in self._named_actors:
+                raise ValueError(f"actor name {name!r} already taken")
+            entry = {
+                "actor_id": aid,
+                "job_id": p.get("job_id"),
+                "name": name,
+                "namespace": ns,
+                "detached": bool(p.get("detached")),
+                "state": PENDING_CREATION,
+                "spec": p["spec"],          # opaque creation task spec bytes
+                "resources": dict(p.get("resources", {})),
+                "max_restarts": int(p.get("max_restarts", 0)),
+                "restarts": 0,
+                "node_id": None,
+                "address": None,
+                "death_cause": None,
+            }
+            self._actors[aid] = entry
+            if name:
+                self._named_actors[(ns, name)] = aid
+        self._schedule_actor(aid)
+        return {"ok": True}
+
+    def _schedule_actor(self, aid: str) -> None:
+        with self._lock:
+            entry = self._actors.get(aid)
+            if entry is None or entry["state"] == DEAD \
+                    or entry.get("dispatched"):
+                return
+            need = entry["resources"]
+            target = None
+            for node in self._nodes.values():
+                if not node["alive"]:
+                    continue
+                if all(node["available"].get(r, 0) >= v
+                       for r, v in need.items()):
+                    target = node
+                    break
+            if target is None:
+                # no feasible node now; retried on the next node registration
+                logger.info("actor %s pending: no feasible node", aid[:8])
+                return
+            entry["node_id"] = target["node_id"]
+            entry["dispatched"] = True
+            node_conn = self._node_conns.get(target["node_id"])
+        if node_conn is None:
+            with self._lock:
+                entry["dispatched"] = False
+            return
+        try:
+            node_conn.call("create_actor", {
+                "actor_id": aid,
+                "spec": self._actors[aid]["spec"],
+                "resources": self._actors[aid]["resources"],
+            }, timeout=CONFIG.actor_creation_timeout_s)
+        except (rpc.RemoteError, ConnectionError, TimeoutError) as e:
+            logger.warning("actor %s creation dispatch failed: %s", aid[:8], e)
+            self._on_actor_failure(aid, f"creation failed: {e}")
+
+    def _rpc_actor_ready(self, conn, p):
+        """Called by the actor's worker once __init__ completed."""
+        with self._lock:
+            entry = self._actors.get(p["actor_id"])
+            if entry is None:
+                return {"ok": False}
+            entry["state"] = ALIVE
+            entry["address"] = tuple(p["address"])
+        self._publish("actor", {"actor_id": p["actor_id"], "state": ALIVE,
+                                "address": tuple(p["address"])})
+        return {"ok": True}
+
+    def _rpc_actor_failed(self, conn, p):
+        self._on_actor_failure(p["actor_id"], p.get("reason", "worker died"))
+        return {"ok": True}
+
+    def _on_actor_failure(self, aid: str, reason: str) -> None:
+        """Actor restart FSM; cf. GcsActorManager::OnActorCreationFailed /
+        SchedulePendingActors (gcs_actor_manager.cc:1233)."""
+        with self._lock:
+            entry = self._actors.get(aid)
+            if entry is None or entry["state"] == DEAD:
+                return
+            if entry["restarts"] < entry["max_restarts"]:
+                entry["restarts"] += 1
+                entry["state"] = RESTARTING
+                entry["address"] = None
+                entry["dispatched"] = False
+                restart = True
+            else:
+                entry["state"] = DEAD
+                entry["death_cause"] = reason
+                restart = False
+        self._publish("actor", {"actor_id": aid,
+                                "state": RESTARTING if restart else DEAD,
+                                "reason": reason})
+        if restart:
+            logger.info("restarting actor %s (%s)", aid[:8], reason)
+            self._schedule_actor(aid)
+
+    def _rpc_get_actor(self, conn, p):
+        aid = p.get("actor_id")
+        with self._lock:
+            if aid is None:
+                key = (p.get("namespace", ""), p["name"])
+                aid = self._named_actors.get(key)
+                if aid is None:
+                    return None
+            entry = self._actors.get(aid)
+            return dict(entry, spec=None) if entry else None
+
+    def _rpc_list_actors(self, conn, p):
+        with self._lock:
+            return [dict(a, spec=None) for a in self._actors.values()]
+
+    def _rpc_kill_actor(self, conn, p):
+        aid = p["actor_id"]
+        with self._lock:
+            entry = self._actors.get(aid)
+            if entry is None:
+                return {"ok": False}
+            entry["state"] = DEAD
+            entry["death_cause"] = "killed via kill_actor"
+            entry["max_restarts"] = 0
+            addr = entry.get("address")
+            node_conn = self._node_conns.get(entry.get("node_id") or "")
+            if entry.get("name"):
+                self._named_actors.pop(
+                    (entry.get("namespace", ""), entry["name"]), None)
+        if node_conn is not None:
+            try:
+                node_conn.push("kill_actor_worker", {"actor_id": aid})
+            except ConnectionError:
+                pass
+        self._publish("actor", {"actor_id": aid, "state": DEAD,
+                                "reason": "killed"})
+        return {"ok": True, "address": addr}
+
+    # ----------------------------------------------------- placement groups
+    def _rpc_create_placement_group(self, conn, p):
+        """2-phase bundle reservation across nodes; cf.
+        GcsPlacementGroupScheduler (reference §2.1).  Bundles with a
+        ``tpu-slice`` label are atomic: all land on nodes of one slice."""
+        pgid = p["pg_id"]
+        bundles = p["bundles"]
+        strategy = p.get("strategy", "PACK")
+        with self._lock:
+            nodes = [n for n in self._nodes.values() if n["alive"]]
+            placement = self._pack_bundles(bundles, strategy, nodes)
+            if placement is None:
+                self._placement_groups[pgid] = {
+                    "pg_id": pgid, "state": "PENDING", "bundles": bundles,
+                    "strategy": strategy, "placement": None,
+                    "job_id": p.get("job_id")}
+                return {"state": "PENDING"}
+            # commit: deduct resources
+            for bundle, node_id in zip(bundles, placement):
+                node = self._nodes[node_id]
+                for r, v in bundle.items():
+                    node["available"][r] = node["available"].get(r, 0) - v
+            self._placement_groups[pgid] = {
+                "pg_id": pgid, "state": "CREATED", "bundles": bundles,
+                "strategy": strategy, "placement": placement,
+                "job_id": p.get("job_id")}
+        return {"state": "CREATED", "placement": placement}
+
+    def _pack_bundles(self, bundles, strategy, nodes) -> Optional[List[str]]:
+        avail = {n["node_id"]: dict(n["available"]) for n in nodes}
+        order = list(avail.keys())
+        placement = []
+        for bundle in bundles:
+            placed = None
+            candidates = order if strategy in ("PACK", "STRICT_PACK") \
+                else sorted(order, key=lambda nid: -min(
+                    avail[nid].get(r, 0) for r in bundle) if bundle else 0)
+            for nid in candidates:
+                if all(avail[nid].get(r, 0) >= v for r, v in bundle.items()):
+                    placed = nid
+                    break
+            if placed is None:
+                return None
+            if strategy == "STRICT_PACK" and placement and \
+                    placed != placement[0]:
+                return None
+            for r, v in bundle.items():
+                avail[placed][r] -= v
+            placement.append(placed)
+        if strategy == "STRICT_SPREAD" and \
+                len(set(placement)) != len(placement):
+            return None
+        return placement
+
+    def _rpc_get_placement_group(self, conn, p):
+        with self._lock:
+            pg = self._placement_groups.get(p["pg_id"])
+            return dict(pg) if pg else None
+
+    def _rpc_remove_placement_group(self, conn, p):
+        with self._lock:
+            pg = self._placement_groups.pop(p["pg_id"], None)
+            if pg and pg.get("placement"):
+                for bundle, node_id in zip(pg["bundles"], pg["placement"]):
+                    node = self._nodes.get(node_id)
+                    if node:
+                        for r, v in bundle.items():
+                            node["available"][r] = \
+                                node["available"].get(r, 0) + v
+        return {"ok": pg is not None}
+
+
+class GcsClient:
+    """Thin client; one duplex connection, also carries pubsub pushes."""
+
+    def __init__(self, address: Tuple[str, int],
+                 push_handler=None, timeout: Optional[float] = None,
+                 handler=None):
+        self._timeout = timeout or CONFIG.gcs_rpc_timeout_s
+        self._sub_lock = threading.Lock()
+        self._sub_handlers: Dict[str, List] = {}
+        self._user_push = push_handler
+        # ``handler`` serves requests the GCS sends *to us* over this duplex
+        # connection (e.g. create_actor dispatched to a raylet).
+        self._conn = rpc.connect(tuple(address),
+                                 push_handler=self._on_push,
+                                 handler=handler)
+
+    def _on_push(self, method: str, payload: Any) -> None:
+        if method == "pubsub":
+            channel = payload["channel"]
+            with self._sub_lock:
+                handlers = list(self._sub_handlers.get(channel, []))
+            for h in handlers:
+                try:
+                    h(payload["message"])
+                except Exception:
+                    logger.exception("pubsub handler error on %s", channel)
+        elif self._user_push is not None:
+            self._user_push(method, payload)
+
+    def call(self, method: str, payload: Any = None,
+             timeout: Optional[float] = None) -> Any:
+        return self._conn.call(method, payload,
+                               timeout=timeout or self._timeout)
+
+    def subscribe(self, channel: str, handler) -> None:
+        with self._sub_lock:
+            self._sub_handlers.setdefault(channel, []).append(handler)
+        self.call("subscribe", {"channel": channel})
+
+    # convenience KV API (cf. reference internal_kv)
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        return self.call("kv_put", {"key": key, "value": value,
+                                    "overwrite": overwrite})["existed"]
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self.call("kv_get", {"key": key})
+
+    def kv_del(self, key: str) -> bool:
+        return self.call("kv_del", {"key": key})["deleted"]
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        return self.call("kv_keys", {"prefix": prefix})
+
+    def kv_exists(self, key: str) -> bool:
+        return self.call("kv_exists", {"key": key})
+
+    def close(self) -> None:
+        self._conn.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._conn.closed
+
+
+def main():  # pragma: no cover - spawned as a subprocess
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session-dir", default=None)
+    parser.add_argument("--address-file", default=None)
+    args = parser.parse_args()
+    from ray_tpu._private.logging_utils import setup_component_logging
+    setup_component_logging("gcs_server", args.session_dir)
+    server = GcsServer(args.host, args.port)
+    if args.address_file:
+        tmp = args.address_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"host": server.address[0],
+                       "port": server.address[1]}, f)
+        os.replace(tmp, args.address_file)
+    logger.info("GCS serving at %s", server.address)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

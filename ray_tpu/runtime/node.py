@@ -1,0 +1,137 @@
+"""Node/process orchestration: spawning GCS and raylet daemons.
+
+Analog of /root/reference/python/ray/_private/node.py (start_head_processes
+:1045, start_ray_processes :1083) and services.py (start_gcs_server :1200,
+start_raylet :1273): the head starts a GCS subprocess then a raylet
+subprocess; worker nodes start just a raylet pointed at the head's GCS.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, Optional, Tuple
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.logging_utils import get_logger
+
+logger = get_logger("node")
+
+
+def new_session_dir() -> str:
+    base = os.path.join("/tmp", "ray_tpu_sessions")
+    os.makedirs(base, exist_ok=True)
+    session = os.path.join(
+        base, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}")
+    os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    return session
+
+
+def _wait_address_file(path: str, proc: subprocess.Popen,
+                       timeout: float = 30.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (ValueError, OSError):
+                pass
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"daemon exited with code {proc.returncode} before "
+                f"publishing {path}")
+        time.sleep(0.02)
+    raise TimeoutError(f"daemon did not publish {path}")
+
+
+def package_pythonpath() -> str:
+    """PYTHONPATH that makes ray_tpu importable in child processes."""
+    import ray_tpu
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    if pkg_root in existing.split(os.pathsep):
+        return existing
+    return pkg_root + (os.pathsep + existing if existing else "")
+
+
+def _spawn(cmd, session_dir: str, name: str,
+           env_overrides: Optional[Dict[str, str]] = None) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["RAY_TPU_SYSTEM_CONFIG"] = CONFIG.overrides_env_blob()
+    env["PYTHONPATH"] = package_pythonpath()
+    env.update(env_overrides or {})
+    log_prefix = os.path.join(session_dir, "logs", name)
+    out_f = open(log_prefix + ".out", "ab")
+    err_f = open(log_prefix + ".err", "ab")
+    try:
+        return subprocess.Popen(cmd, env=env, stdout=out_f, stderr=err_f)
+    finally:
+        out_f.close()
+        err_f.close()
+
+
+class NodeProcesses:
+    """Daemons started by this process (head or worker node)."""
+
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        self.gcs_proc: Optional[subprocess.Popen] = None
+        self.raylet_proc: Optional[subprocess.Popen] = None
+        self.gcs_address: Optional[Tuple[str, int]] = None
+        self.raylet_address: Optional[Tuple[str, int]] = None
+        self.node_id: Optional[str] = None
+        self.store_path: Optional[str] = None
+        atexit.register(self.stop)
+
+    def start_gcs(self, port: int = 0) -> Tuple[str, int]:
+        addr_file = os.path.join(self.session_dir, "gcs_address.json")
+        self.gcs_proc = _spawn(
+            [sys.executable, "-m", "ray_tpu.runtime.gcs",
+             "--port", str(port),
+             "--session-dir", self.session_dir,
+             "--address-file", addr_file],
+            self.session_dir, "gcs_server")
+        info = _wait_address_file(addr_file, self.gcs_proc)
+        self.gcs_address = (info["host"], info["port"])
+        return self.gcs_address
+
+    def start_raylet(self, gcs_address: Tuple[str, int],
+                     resources: Optional[Dict[str, float]] = None,
+                     object_store_memory: Optional[int] = None
+                     ) -> Tuple[str, int]:
+        addr_file = os.path.join(
+            self.session_dir, f"raylet_address_{os.getpid()}_"
+                              f"{int(time.time()*1e6)}.json")
+        cmd = [sys.executable, "-m", "ray_tpu.runtime.raylet",
+               "--gcs-host", gcs_address[0],
+               "--gcs-port", str(gcs_address[1]),
+               "--session-dir", self.session_dir,
+               "--address-file", addr_file]
+        if resources:
+            cmd += ["--resources", json.dumps(resources)]
+        if object_store_memory:
+            cmd += ["--object-store-memory", str(object_store_memory)]
+        self.raylet_proc = _spawn(cmd, self.session_dir, "raylet")
+        info = _wait_address_file(addr_file, self.raylet_proc)
+        self.raylet_address = (info["host"], info["port"])
+        self.node_id = info["node_id"]
+        self.store_path = info["store_path"]
+        return self.raylet_address
+
+    def stop(self) -> None:
+        for proc in (self.raylet_proc, self.gcs_proc):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        for proc in (self.raylet_proc, self.gcs_proc):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        self.raylet_proc = self.gcs_proc = None

@@ -1,0 +1,291 @@
+"""Worker process: executes tasks/actor methods pushed by owners.
+
+Analog of the reference's default_worker.py + task-execution path
+(/root/reference/python/ray/_private/workers/default_worker.py;
+execution callback `task_execution_handler` _raylet.pyx:1121; server-side
+scheduling queues src/ray/core_worker/transport/*scheduling_queue*).
+
+Execution model: one executor thread drains a FIFO of normal tasks (the
+NormalSchedulingQueue analog); actor tasks carry sequence numbers and are
+buffered until their turn (ActorSchedulingQueue analog) so actor state sees
+calls in submission order.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import rpc
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.logging_utils import get_logger, setup_component_logging
+from ray_tpu.runtime import core_worker as cw
+
+logger = get_logger("worker")
+
+
+class WorkerProcess:
+    def __init__(self, args):
+        self.worker_id = WorkerID.from_hex(args.worker_id)
+        self.core = cw.CoreWorker(
+            mode="worker",
+            gcs_address=(args.gcs_host, args.gcs_port),
+            raylet_address=(args.raylet_host, args.raylet_port),
+            store_path=args.store_path,
+            node_id=args.node_id,
+            worker_id=self.worker_id,
+            session_dir=args.session_dir,
+        )
+        cw.set_global_worker(self.core)
+        # actor state
+        self.actor_instance: Any = None
+        self.actor_id: Optional[str] = None
+        # per caller-stream ordered queues (ActorSchedulingQueue analog):
+        # {stream_id: {"next": int, "buf": {seq: work}}}
+        self._actor_streams: Dict[str, Dict[str, Any]] = {}
+        self._actor_cv = threading.Condition()
+        # normal-task FIFO
+        self._queue: "list[tuple]" = []
+        self._queue_cv = threading.Condition()
+        self._exec_thread = threading.Thread(target=self._exec_loop,
+                                             daemon=True)
+        self._exec_thread.start()
+        self._actor_thread = threading.Thread(target=self._actor_loop,
+                                              daemon=True)
+        self._actor_thread.start()
+
+        # serve pushes from owners on the core worker's own server by
+        # extending its dispatch
+        self.core._extra_handler = self._handle
+        core_handle = self.core._handle_rpc
+
+        def dispatch(conn, method, payload):
+            if method in ("push_task", "actor_task", "create_actor", "kill"):
+                return self._handle(conn, method, payload)
+            return core_handle(conn, method, payload)
+
+        self.core._server._handler = dispatch
+        for c in self.core._server.connections():
+            c._handler = dispatch
+
+        # register with the raylet; the raylet sends us requests
+        # (create_actor, kill) back over this same duplex connection.
+        # A worker must not outlive its raylet (fate-sharing, cf. reference
+        # raylet-socket disconnect handling): exit when the conn drops.
+        def _raylet_gone(_conn):
+            import os
+            logger.warning("raylet connection lost; worker exiting")
+            os._exit(1)
+
+        self.raylet_conn = rpc.connect((args.raylet_host, args.raylet_port),
+                                       handler=dispatch,
+                                       on_close=_raylet_gone)
+        self.raylet_conn.call("register_worker", {
+            "worker_id": args.worker_id,
+            "address": list(self.core.address),
+        })
+
+    # ------------------------------------------------------------- dispatch
+    def _handle(self, conn, method, p):
+        if method == "push_task":
+            return self._run_queued(p)
+        if method == "actor_task":
+            return self._run_actor_task(p)
+        if method == "create_actor":
+            return self._create_actor(p)
+        if method == "kill":
+            import os
+            os._exit(1)
+        raise rpc.RpcError(f"worker: unknown method {method}")
+
+    # --------------------------------------------------------- normal tasks
+    def _run_queued(self, spec) -> dict:
+        """Enqueue and wait for completion on the executor thread, keeping
+        per-worker execution strictly serial."""
+        done = threading.Event()
+        out: dict = {}
+        with self._queue_cv:
+            self._queue.append((spec, done, out))
+            self._queue_cv.notify()
+        done.wait()
+        if "raise" in out:
+            raise out["raise"]
+        return out["reply"]
+
+    def _exec_loop(self) -> None:
+        while True:
+            with self._queue_cv:
+                while not self._queue:
+                    self._queue_cv.wait()
+                spec, done, out = self._queue.pop(0)
+            try:
+                out["reply"] = self._execute(spec)
+            except BaseException as e:  # noqa: BLE001
+                out["raise"] = e
+            done.set()
+
+    def _resolve_args(self, blob: bytes) -> tuple:
+        """Returns (args, kwargs, borrowed_oids); the caller must hand
+        ``borrowed_oids`` to core.release_borrowed after execution so arg
+        pins/caches don't accumulate in pooled workers."""
+        args, kwargs = cloudpickle.loads(blob)
+        borrowed = []
+        resolved = []
+        for a in args:
+            if isinstance(a, cw.ObjectRef):
+                borrowed.append(a.id)
+                resolved.append(self.core._get_one(a, None))
+            else:
+                resolved.append(a)
+        rkw = {}
+        for k, v in kwargs.items():
+            if isinstance(v, cw.ObjectRef):
+                borrowed.append(v.id)
+                rkw[k] = self.core._get_one(v, None)
+            else:
+                rkw[k] = v
+        return tuple(resolved), rkw, borrowed
+
+    def _execute(self, spec) -> dict:
+        fn = self.core.load_function(spec["fn_key"])
+        self.core.current_task_id = TaskID(spec["task_id"])
+        borrowed = []
+        try:
+            args, kwargs, borrowed = self._resolve_args(spec["args"])
+            result = fn(*args, **kwargs)
+            return self._package_results(spec, result)
+        except Exception as e:  # noqa: BLE001 - user errors cross the wire
+            return self._package_error(spec, e)
+        finally:
+            self.core.release_borrowed(borrowed)
+
+    def _package_error(self, spec, e: BaseException) -> dict:
+        tb = traceback.format_exc()
+        err = exc.TaskError(spec.get("name", ""), e, tb)
+        head, views = ser.serialize(err, error_type=ser.ERROR_TASK)
+        data = ser.to_flat_bytes(head, views)
+        return {"results": [{"data": data, "error": ser.ERROR_TASK}
+                            for _ in range(spec["num_returns"])]}
+
+    def _package_results(self, spec, result) -> dict:
+        n = spec["num_returns"]
+        if n == 0:
+            values = []
+        elif n == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != n:
+                return self._package_error(spec, ValueError(
+                    f"task declared num_returns={n} but returned "
+                    f"{len(values)} values"))
+        results = []
+        task_id = TaskID(spec["task_id"])
+        for i, value in enumerate(values):
+            head, views = ser.serialize(value)
+            size = ser.serialized_size(head, views)
+            if size <= CONFIG.inline_object_max_bytes:
+                results.append({"data": ser.to_flat_bytes(head, views)})
+            else:
+                oid = ObjectID.for_task_return(task_id, i)
+                self.core.store.put_serialized(oid, head, views)
+                results.append({"location": self.core.node_id})
+        return {"results": results}
+
+    # --------------------------------------------------------------- actors
+    def _create_actor(self, p) -> dict:
+        creation = cloudpickle.loads(p["spec"])
+        cls = self.core.load_function(creation["cls_key"])
+        args, kwargs, _borrowed = self._resolve_args(creation["args"])
+        self.actor_id = p["actor_id"]
+        self.actor_instance = cls(*args, **kwargs)
+        self.core.gcs.call("actor_ready", {
+            "actor_id": p["actor_id"],
+            "address": list(self.core.address)})
+        logger.info("actor %s ready (%s)", p["actor_id"][:8],
+                    type(self.actor_instance).__name__)
+        return {"ok": True}
+
+    def _run_actor_task(self, spec) -> dict:
+        """Block until this (stream, seq)'s turn; executed on actor thread."""
+        done = threading.Event()
+        out: dict = {}
+        with self._actor_cv:
+            stream = self._actor_streams.setdefault(
+                spec.get("stream", ""), {"next": 0, "buf": {}})
+            stream["buf"][spec["seq"]] = (spec, done, out)
+            self._actor_cv.notify_all()
+        done.wait()
+        if "raise" in out:
+            raise out["raise"]
+        return out["reply"]
+
+    def _next_actor_work(self):
+        for stream in self._actor_streams.values():
+            if stream["next"] in stream["buf"]:
+                work = stream["buf"].pop(stream["next"])
+                stream["next"] += 1
+                return work
+        return None
+
+    def _actor_loop(self) -> None:
+        while True:
+            with self._actor_cv:
+                work = self._next_actor_work()
+                while work is None:
+                    self._actor_cv.wait()
+                    work = self._next_actor_work()
+            spec, done, out = work
+            try:
+                out["reply"] = self._execute_actor(spec)
+            except BaseException as e:  # noqa: BLE001
+                out["raise"] = e
+            done.set()
+
+    def _execute_actor(self, spec) -> dict:
+        if self.actor_instance is None:
+            return self._package_error(
+                spec, exc.ActorDiedError("actor not initialized"))
+        self.core.current_task_id = TaskID(spec["task_id"])
+        borrowed = []
+        try:
+            args, kwargs, borrowed = self._resolve_args(spec["args"])
+            if spec["method"] == "__ray_terminate__":
+                import os
+                os._exit(0)
+            method = getattr(self.actor_instance, spec["method"])
+            result = method(*args, **kwargs)
+            return self._package_results(spec, result)
+        except Exception as e:  # noqa: BLE001
+            return self._package_error(spec, e)
+        finally:
+            self.core.release_borrowed(borrowed)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-host", required=True)
+    parser.add_argument("--raylet-port", type=int, required=True)
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--store-path", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--node-id", required=True)
+    args = parser.parse_args()
+    setup_component_logging("worker", args.session_dir)
+    worker = WorkerProcess(args)
+    logger.info("worker %s serving at %s", args.worker_id[:8],
+                worker.core.address)
+    threading.Event().wait()  # serve forever; raylet kills us
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""Actor tests (cf. reference python/ray/tests/test_actor*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
+                                TaskError)
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, k=1):
+        self.n += k
+        return self.n
+
+    def get(self):
+        return self.n
+
+    def fail(self):
+        raise RuntimeError("actor method boom")
+
+    def die(self):
+        import os
+        os._exit(1)
+
+
+def test_actor_create_and_call(ray_start_regular):
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.inc.remote()) == 6
+    assert ray_tpu.get(c.inc.remote(10)) == 16
+
+
+def test_actor_call_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(40)]
+    assert ray_tpu.get(refs) == list(range(1, 41))
+
+
+def test_actor_method_error_keeps_actor_alive(ray_start_regular):
+    c = Counter.remote()
+    with pytest.raises(TaskError):
+        ray_tpu.get(c.fail.remote())
+    assert ray_tpu.get(c.get.remote()) == 0
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="shared").remote(7)
+    h = ray_tpu.get_actor("shared")
+    assert ray_tpu.get(h.get.remote()) == 7
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("nope")
+
+
+def test_actor_handle_passing(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(handle):
+        return ray_tpu.get(handle.inc.remote())
+
+    assert ray_tpu.get(bump.remote(c), timeout=60) == 1
+    assert ray_tpu.get(c.get.remote()) == 1
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote())
+    ray_tpu.kill(c)
+    with pytest.raises((ActorDiedError, ActorUnavailableError)):
+        ray_tpu.get(c.get.remote(), timeout=90)
+
+
+def test_actor_restart(ray_start_regular):
+    f = Counter.options(max_restarts=2).remote()
+    assert ray_tpu.get(f.inc.remote()) == 1
+    with pytest.raises((ActorDiedError, ActorUnavailableError, TaskError)):
+        ray_tpu.get(f.die.remote(), timeout=60)
+    # restarted actor: fresh state
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            assert ray_tpu.get(f.inc.remote(), timeout=60) == 1
+            break
+        except (ActorUnavailableError, ActorDiedError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.5)
+
+
+def test_actor_no_restart_dies_for_good(ray_start_regular):
+    f = Counter.options(max_restarts=0).remote()
+    ray_tpu.get(f.inc.remote())
+    f.die.remote()
+    with pytest.raises((ActorDiedError, ActorUnavailableError)):
+        ray_tpu.get(f.get.remote(), timeout=90)

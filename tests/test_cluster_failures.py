@@ -1,0 +1,77 @@
+"""Multi-node simulated cluster + failure tests (cf. reference
+python/ray/tests/test_failure*.py, test_component_failures*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_two_node_cluster_spreads_tasks(ray_start_cluster):
+    cluster = ray_start_cluster
+    # head has 1 CPU; second node adds 2
+    cluster.add_node(resources={"CPU": 2})
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(address=cluster.address)
+    assert ray_tpu.cluster_resources()["CPU"] >= 3.0
+
+    @ray_tpu.remote
+    def whoami():
+        import os
+        return os.getpid()
+
+    pids = set(ray_tpu.get([whoami.remote() for _ in range(8)], timeout=60))
+    assert len(pids) >= 1  # tasks ran somewhere
+    ray_tpu.shutdown()
+
+
+def test_node_death_detected(ray_start_cluster):
+    cluster = ray_start_cluster
+    node2 = cluster.add_node(resources={"CPU": 2, "spot": 1})
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(address=cluster.address)
+    assert len([n for n in ray_tpu.nodes() if n["alive"]]) == 2
+    cluster.remove_node(node2)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        alive = [n for n in ray_tpu.nodes() if n["alive"]]
+        if len(alive) == 1:
+            break
+        time.sleep(0.2)
+    assert len([n for n in ray_tpu.nodes() if n["alive"]]) == 1
+    ray_tpu.shutdown()
+
+
+def test_actor_restarts_after_node_death(ray_start_cluster):
+    cluster = ray_start_cluster
+    node2 = cluster.add_node(resources={"CPU": 2, "pin": 1})
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote
+    class A:
+        def where(self):
+            import os
+            return os.getpid()
+
+    # pin the actor to node2 via its custom resource, allow restart
+    a = A.options(max_restarts=1,
+                  resources={"pin": 1}).remote()
+    pid1 = ray_tpu.get(a.where.remote(), timeout=60)
+    # take node2 down; restart must land on the remaining feasible... there is
+    # none with "pin", so instead verify the actor is reported unavailable,
+    # then add a new pin node and watch it come back.
+    cluster.remove_node(node2)
+    cluster.add_node(resources={"CPU": 2, "pin": 1})
+    deadline = time.monotonic() + 90
+    while True:
+        try:
+            pid2 = ray_tpu.get(a.where.remote(), timeout=60)
+            break
+        except ray_tpu.exceptions.RayTpuError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.5)
+    assert pid2 != pid1
+    ray_tpu.shutdown()

@@ -1,0 +1,150 @@
+"""Core tasks/objects tests (cf. reference python/ray/tests/test_basic*.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError, WorkerCrashedError
+
+
+def test_put_get_roundtrip(ray_start_regular):
+    for value in [1, "s", {"a": [1, 2]}, np.arange(10)]:
+        ref = ray_tpu.put(value)
+        out = ray_tpu.get(ref)
+        if isinstance(value, np.ndarray):
+            np.testing.assert_array_equal(out, value)
+        else:
+            assert out == value
+
+
+def test_large_object_through_shm(ray_start_regular):
+    arr = np.random.default_rng(0).random(500_000)
+    ref = ray_tpu.put(arr)
+    np.testing.assert_array_equal(ray_tpu.get(ref), arr)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_parallel_tasks_and_order(ray_start_regular):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(30)]
+    assert ray_tpu.get(refs) == [i * i for i in range(30)]
+
+
+def test_task_with_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    x = ray_tpu.put(10)
+    y = add.remote(x, 5)
+    z = add.remote(y, x)   # task-output ref as arg
+    assert ray_tpu.get(z) == 25
+
+
+def test_large_task_result(ray_start_regular):
+    @ray_tpu.remote
+    def big():
+        return np.ones(400_000)
+
+    out = ray_tpu.get(big.remote())
+    assert out.shape == (400_000,)
+    assert float(out.sum()) == 400_000.0
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "kaboom" in str(ei.value)
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_wait_semantics(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, rest = ray_tpu.wait([s, f], num_returns=1, timeout=5)
+    assert ready == [f] and rest == [s]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_worker_crash_retry_then_error(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        import os
+        os._exit(1)
+
+    with pytest.raises(WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=30)
+
+
+def test_worker_crash_retry_succeeds(ray_start_regular):
+    # a task that dies on first execution and succeeds on retry, via a
+    # sentinel file (the retried execution sees it)
+    import tempfile
+    marker = tempfile.mktemp()
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky(path):
+        import os
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    assert ray_tpu.get(flaky.remote(marker), timeout=60) == "recovered"
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(1), timeout=60) == 12
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 4.0
