@@ -50,7 +50,8 @@ class ActorClass:
     def __init__(self, cls, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
                  resources: Optional[Dict[str, float]] = None,
                  max_restarts: int = 0, name: Optional[str] = None,
-                 namespace: str = "", lifetime: Optional[str] = None):
+                 namespace: str = "", lifetime: Optional[str] = None,
+                 scheduling_strategy=None):
         self._cls = cls
         self._resources = dict(resources or {})
         self._resources["CPU"] = num_cpus
@@ -60,6 +61,7 @@ class ActorClass:
         self._name = name
         self._namespace = namespace
         self._lifetime = lifetime
+        self._scheduling_strategy = scheduling_strategy
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -67,6 +69,7 @@ class ActorClass:
             "directly; use .remote()")
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu.util.scheduling_strategies import encode_strategy
         worker = get_global_worker()
         actor_id = worker.create_actor(
             self._cls, args, kwargs,
@@ -74,7 +77,8 @@ class ActorClass:
             namespace=self._namespace,
             detached=self._lifetime == "detached",
             max_restarts=self._max_restarts,
-            resources=self._resources)
+            resources=self._resources,
+            scheduling_strategy=encode_strategy(self._scheduling_strategy))
         return ActorHandle(actor_id)
 
     def options(self, **opts) -> "ActorClass":
@@ -88,7 +92,9 @@ class ActorClass:
             max_restarts=opts.get("max_restarts", self._max_restarts),
             name=opts.get("name", self._name),
             namespace=opts.get("namespace", self._namespace),
-            lifetime=opts.get("lifetime", self._lifetime))
+            lifetime=opts.get("lifetime", self._lifetime),
+            scheduling_strategy=opts.get("scheduling_strategy",
+                                         self._scheduling_strategy))
 
 
 def get_actor(name: str, namespace: str = "") -> ActorHandle:

@@ -145,14 +145,16 @@ def remote(*args, **kwargs):
                 max_restarts=kwargs.get("max_restarts", 0),
                 name=kwargs.get("name"),
                 namespace=kwargs.get("namespace", ""),
-                lifetime=kwargs.get("lifetime"))
+                lifetime=kwargs.get("lifetime"),
+                scheduling_strategy=kwargs.get("scheduling_strategy"))
         return RemoteFunction(
             target,
             num_returns=kwargs.get("num_returns", 1),
             num_cpus=kwargs.get("num_cpus", 1.0),
             num_tpus=kwargs.get("num_tpus", 0.0),
             resources=kwargs.get("resources"),
-            max_retries=kwargs.get("max_retries", 3))
+            max_retries=kwargs.get("max_retries", 3),
+            scheduling_strategy=kwargs.get("scheduling_strategy"))
 
     if len(args) == 1 and not kwargs and callable(args[0]):
         return decorate(args[0])

@@ -16,7 +16,8 @@ class RemoteFunction:
     def __init__(self, func, *, num_returns: int = 1,
                  num_cpus: float = 1.0, num_tpus: float = 0.0,
                  resources: Optional[Dict[str, float]] = None,
-                 max_retries: int = 3):
+                 max_retries: int = 3,
+                 scheduling_strategy: Any = None):
         self._func = func
         self._num_returns = num_returns
         self._resources = dict(resources or {})
@@ -24,6 +25,7 @@ class RemoteFunction:
         if num_tpus:
             self._resources["TPU"] = num_tpus
         self._max_retries = max_retries
+        self._scheduling_strategy = scheduling_strategy
         functools.update_wrapper(self, func)
 
     def __call__(self, *args, **kwargs):
@@ -32,13 +34,15 @@ class RemoteFunction:
             "directly; use .remote()")
 
     def remote(self, *args, **kwargs):
+        from ray_tpu.util.scheduling_strategies import encode_strategy
         worker = get_global_worker()
         refs = worker.submit_task(
             self._func, args, kwargs,
             num_returns=self._num_returns,
             resources=self._resources,
             max_retries=self._max_retries,
-            name=self._func.__name__)
+            name=self._func.__name__,
+            scheduling_strategy=encode_strategy(self._scheduling_strategy))
         if self._num_returns == 1:
             return refs[0]
         return refs
@@ -52,5 +56,7 @@ class RemoteFunction:
             resources=opts.get("resources",
                                {k: v for k, v in self._resources.items()
                                 if k not in ("CPU", "TPU")}),
-            max_retries=opts.get("max_retries", self._max_retries))
+            max_retries=opts.get("max_retries", self._max_retries),
+            scheduling_strategy=opts.get("scheduling_strategy",
+                                         self._scheduling_strategy))
         return new

@@ -502,7 +502,9 @@ class CoreWorker:
                     resources: Optional[Dict[str, float]] = None,
                     max_retries: int = 3,
                     name: str = "",
-                    scheduling_key: Optional[str] = None) -> List[ObjectRef]:
+                    scheduling_key: Optional[str] = None,
+                    scheduling_strategy: Optional[dict] = None
+                    ) -> List[ObjectRef]:
         fn_key = self.register_function(func)
         task_id = TaskID.from_random()
         resources = dict(resources or {})
@@ -513,6 +515,10 @@ class CoreWorker:
         key = scheduling_key or (
             self.job_id.hex()[:8] + "|" +
             ",".join(f"{k}={v}" for k, v in sorted(resources.items())))
+        if scheduling_strategy:
+            key += "|" + ",".join(
+                f"{k}={scheduling_strategy[k]}"
+                for k in sorted(scheduling_strategy))
         arg_blob, live_refs = self._serialize_args(args, kwargs)
         if live_refs:
             self._arg_refs[task_id.binary()] = live_refs
@@ -531,10 +537,12 @@ class CoreWorker:
                 entry = _OwnedObject()
                 entry.task_spec = cloudpickle.dumps(
                     {"spec": spec, "resources": resources, "key": key,
-                     "retries_left": max_retries})
+                     "retries_left": max_retries,
+                     "strategy": scheduling_strategy})
                 self._owned[oid] = entry
                 return_refs.append(ObjectRef(oid, self.address, self))
-        self._enqueue_task(key, resources, spec, max_retries)
+        self._enqueue_task(key, resources, spec, max_retries,
+                           strategy=scheduling_strategy)
         self._task_events.append(
             {"task_id": task_id.hex(), "name": spec["name"],
              "state": "SUBMITTED", "ts": time.time()})
@@ -584,17 +592,19 @@ class CoreWorker:
                     entry.event.set()
 
     # ----- per-key scheduling queue: leased workers pull pending specs -----
-    def _sched_state(self, key: str, resources) -> Dict[str, Any]:
+    def _sched_state(self, key: str, resources,
+                     strategy: Optional[dict] = None) -> Dict[str, Any]:
         with self._sched_lock:
             st = self._sched.get(key)
             if st is None:
                 st = {"queue": deque(), "leases": [], "requesting": False,
-                      "resources": dict(resources)}
+                      "resources": dict(resources), "strategy": strategy}
                 self._sched[key] = st
             return st
 
-    def _enqueue_task(self, key, resources, spec, retries: int) -> None:
-        st = self._sched_state(key, resources)
+    def _enqueue_task(self, key, resources, spec, retries: int,
+                      strategy: Optional[dict] = None) -> None:
+        st = self._sched_state(key, resources, strategy)
         with self._sched_lock:
             st["queue"].append((spec, retries))
         self._maybe_request_lease(key, st)
@@ -652,7 +662,14 @@ class CoreWorker:
         """Lease locally; follow at most two retry_at redirects (the
         reference's spillback, direct_task_transport.cc retry_at_raylet).
         The grant remembers which raylet granted it so return_worker goes to
-        the right node."""
+        the right node.  A scheduling strategy pins/redirects the lease
+        before the default local-first path runs."""
+        strategy = st.get("strategy")
+        if strategy:
+            grant = self._lease_with_strategy(key, st, strategy)
+            if grant is not None:
+                return grant
+            # soft affinity fall-through: default path below
         payload = {"key": key, "resources": st["resources"],
                    "job_id": self.job_id.hex()}
         target_addr = None  # None -> local raylet
@@ -675,6 +692,110 @@ class CoreWorker:
             grant["granting_addr"] = target_addr  # None == local
             return grant
         raise rpc.RpcError("spillback loop exceeded")
+
+    def _lease_at(self, addr: Optional[Tuple[str, int]],
+                  payload: dict) -> dict:
+        """One lease RPC to a specific raylet (no redirects honored)."""
+        if addr is None:
+            grant = self._raylet.call(
+                "lease_worker", payload,
+                timeout=CONFIG.worker_lease_timeout_s + 5)
+        else:
+            conn = rpc.connect(addr)
+            try:
+                grant = conn.call("lease_worker", payload,
+                                  timeout=CONFIG.worker_lease_timeout_s + 5)
+            finally:
+                conn.close()
+        grant["granting_addr"] = None if addr is None else list(addr)
+        return grant
+
+    def _lease_with_strategy(self, key: str, st,
+                             strategy: dict) -> Optional[dict]:
+        """Resolve a scheduling strategy to a pinned lease.
+
+        placement_group -> lease from the bundle's reserved pool on its
+        node; node_affinity -> lease from that raylet (soft falls back by
+        returning None); spread -> least-loaded feasible node."""
+        base = {"key": key, "resources": st["resources"],
+                "job_id": self.job_id.hex(), "spillback": 2}
+        kind = strategy.get("type")
+        if kind == "placement_group":
+            pg_id = strategy["pg_id"]
+            idx = int(strategy.get("bundle_index", -1))
+            deadline = time.monotonic() + CONFIG.worker_lease_timeout_s
+            while True:
+                info = self.gcs.call("get_placement_group",
+                                     {"pg_id": pg_id}, timeout=10)
+                if info is None:
+                    raise rpc.RpcError(f"placement group {pg_id[:8]} removed")
+                if info["state"] == "CREATED":
+                    break
+                if time.monotonic() > deadline:
+                    raise rpc.RpcError(
+                        f"placement group {pg_id[:8]} not placed in time")
+                time.sleep(0.05)
+            placement = info["placement"]
+            if idx >= len(placement) or idx < -1:
+                raise rpc.RpcError(
+                    f"bundle index {idx} out of range for a "
+                    f"{len(placement)}-bundle placement group")
+            indices = [idx] if idx >= 0 else list(range(len(placement)))
+            last_err: Optional[Exception] = None
+            for i in indices:
+                addr = self._node_address(placement[i])
+                if addr is None:
+                    continue
+                try:
+                    return self._lease_at(
+                        addr, dict(base, bundle=[pg_id, i]))
+                except (rpc.RemoteError, ConnectionError,
+                        TimeoutError) as e:
+                    last_err = e
+            raise rpc.RpcError(
+                f"no bundle of pg {pg_id[:8]} could grant a lease: "
+                f"{last_err}")
+        if kind == "node_affinity":
+            addr = self._node_address(strategy["node_id"])
+            if addr is None:
+                if strategy.get("soft"):
+                    return None
+                raise rpc.RpcError(
+                    f"node {strategy['node_id'][:8]} not found/alive")
+            try:
+                return self._lease_at(addr, dict(base))
+            except (rpc.RemoteError, ConnectionError, TimeoutError) as e:
+                if strategy.get("soft"):
+                    return None
+                raise rpc.RpcError(
+                    f"node affinity lease failed: {e}") from e
+        if kind == "spread":
+            # pick the alive feasible node with the most available CPU,
+            # breaking ties away from the most recently used one
+            try:
+                nodes = self.gcs.call("list_nodes", timeout=5)
+            except (ConnectionError, rpc.RemoteError, TimeoutError):
+                return None
+            need = dict(st["resources"])
+            need.setdefault("CPU", 1.0)
+            feasible = [
+                n for n in nodes if n["alive"] and
+                all(n["available"].get(r, 0) >= v for r, v in need.items())]
+            if not feasible:
+                return None
+            last = st.get("last_spread_node")
+            feasible.sort(key=lambda n: (n["node_id"] == last,
+                                         -n["available"].get("CPU", 0)))
+            for n in feasible:
+                addr = tuple(n["address"])
+                try:
+                    grant = self._lease_at(addr, dict(base))
+                    st["last_spread_node"] = n["node_id"]
+                    return grant
+                except (rpc.RemoteError, ConnectionError, TimeoutError):
+                    continue
+            return None
+        raise rpc.RpcError(f"unknown scheduling strategy {kind!r}")
 
     def _fail_queued(self, st, error: BaseException) -> None:
         with self._sched_lock:
@@ -765,8 +886,18 @@ class CoreWorker:
     def create_actor(self, cls, args, kwargs, *, name: Optional[str] = None,
                      namespace: str = "", detached: bool = False,
                      max_restarts: int = 0,
-                     resources: Optional[Dict[str, float]] = None) -> "ActorID":
+                     resources: Optional[Dict[str, float]] = None,
+                     scheduling_strategy: Optional[dict] = None) -> "ActorID":
         actor_id = ActorID.from_random()
+        bundle = None
+        strategy = None
+        if scheduling_strategy:
+            if scheduling_strategy.get("type") == "placement_group":
+                bundle = [scheduling_strategy["pg_id"],
+                          int(scheduling_strategy.get("bundle_index", -1))]
+            else:
+                # node_affinity / spread: enforced by the GCS scheduler
+                strategy = dict(scheduling_strategy)
         cls_key = self.register_function(cls)
         creation_spec = cloudpickle.dumps({
             "actor_id": actor_id.binary(),
@@ -784,6 +915,8 @@ class CoreWorker:
             "spec": creation_spec,
             "resources": dict(resources or {}),
             "max_restarts": max_restarts,
+            "bundle": bundle,
+            "strategy": strategy,
         }, timeout=CONFIG.actor_creation_timeout_s)
         return actor_id
 

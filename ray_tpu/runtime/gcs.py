@@ -128,20 +128,9 @@ class GcsServer:
     def _retry_placement_group(self, pgid: str) -> None:
         with self._lock:
             pg = self._placement_groups.get(pgid)
-            if pg is None or pg["state"] != "PENDING":
-                return
-            nodes = [n for n in self._nodes.values() if n["alive"]]
-            placement = self._pack_bundles(pg["bundles"], pg["strategy"],
-                                           nodes)
-            if placement is None:
-                return
-            for bundle, node_id in zip(pg["bundles"], placement):
-                node = self._nodes[node_id]
-                for r, v in bundle.items():
-                    node["available"][r] = node["available"].get(r, 0) - v
-            pg["state"] = "CREATED"
-            pg["placement"] = placement
-        self._publish("placement_group", {"pg_id": pgid, "state": "CREATED"})
+        if pg is None or pg["state"] != "PENDING":
+            return
+        self._try_place_pg(pg)
 
     def _rpc_heartbeat(self, conn, p):
         with self._lock:
@@ -184,11 +173,43 @@ class GcsServer:
             affected = [aid for aid, a in self._actors.items()
                         if a.get("node_id") == node_id
                         and a["state"] in (ALIVE, PENDING_CREATION)]
+            broken_pgs = [pg for pg in self._placement_groups.values()
+                          if pg.get("placement") and
+                          node_id in pg["placement"]]
         logger.warning("node %s marked dead (actors affected: %d)",
                        node_id[:8], len(affected))
         self._publish("node", {"node_id": node_id, "state": "DEAD"})
         for aid in affected:
             self._on_actor_failure(aid, f"node {node_id[:8]} died")
+        # placement groups with a bundle on the dead node go back to PENDING
+        # and get fully re-reserved (reference: rescheduling state). Runs on
+        # its own thread: the return_bundle/reserve_bundle RPCs must not
+        # stall the health loop's detection of other dead nodes.
+        if broken_pgs:
+            threading.Thread(target=self._reschedule_broken_pgs,
+                             args=(broken_pgs, node_id), daemon=True).start()
+
+    def _reschedule_broken_pgs(self, broken_pgs, node_id: str) -> None:
+        for pg in broken_pgs:
+            with self._lock:
+                placement = pg["placement"] or []
+                conns = {nid: self._node_conns.get(nid)
+                         for nid in placement if nid != node_id}
+                pg["state"] = "PENDING"
+                pg["placement"] = None
+            for i, nid in enumerate(placement):
+                node_conn = conns.get(nid)
+                if node_conn is None:
+                    continue
+                try:
+                    node_conn.call("return_bundle",
+                                   {"pg_id": pg["pg_id"], "index": i},
+                                   timeout=10)
+                except (ConnectionError, rpc.RpcError, TimeoutError):
+                    pass
+            self._publish("placement_group",
+                          {"pg_id": pg["pg_id"], "state": "PENDING"})
+            self._try_place_pg(pg)
 
     # ----------------------------------------------------------------- jobs
     def _rpc_register_job(self, conn, p):
@@ -307,11 +328,18 @@ class GcsServer:
                 "node_id": None,
                 "address": None,
                 "death_cause": None,
+                "bundle": p.get("bundle"),  # [pg_id_hex, index] or None
+                "strategy": p.get("strategy"),  # node_affinity/spread dict
             }
             self._actors[aid] = entry
             if name:
                 self._named_actors[(ns, name)] = aid
-        self._schedule_actor(aid)
+        # dispatch asynchronously: Actor.remote() must return immediately
+        # even if __init__ blocks (e.g. on a collective rendezvous with
+        # peers created later) — reference semantics: GcsActorManager
+        # schedules out-of-band, clients poll actor state.
+        threading.Thread(target=self._schedule_actor, args=(aid,),
+                         daemon=True).start()
         return {"ok": True}
 
     def _schedule_actor(self, aid: str) -> None:
@@ -321,34 +349,98 @@ class GcsServer:
                     or entry.get("dispatched"):
                 return
             need = entry["resources"]
-            target = None
-            for node in self._nodes.values():
-                if not node["alive"]:
-                    continue
-                if all(node["available"].get(r, 0) >= v
-                       for r, v in need.items()):
-                    target = node
-                    break
-            if target is None:
+            bundle = entry.get("bundle")
+            strategy = entry.get("strategy") or {}
+            fail_reason = None
+            # candidates: [(node_id, bundle_or_None), ...] tried in order
+            candidates = []
+            if bundle is not None:
+                # actor is pinned to a placement-group bundle: it must land
+                # on the node holding that reserved bundle
+                pg = self._placement_groups.get(bundle[0])
+                if pg is None:
+                    fail_reason = \
+                        f"placement group {bundle[0][:8]} removed"
+                elif pg["state"] != "CREATED":
+                    logger.info("actor %s pending: placement group pending",
+                                aid[:8])
+                    return
+                else:
+                    idx = int(bundle[1])
+                    placement = pg["placement"]
+                    if idx >= len(placement) or idx < -1:
+                        fail_reason = (
+                            f"bundle index {idx} out of range for "
+                            f"{len(placement)}-bundle placement group")
+                    else:
+                        indices = [idx] if idx >= 0 \
+                            else list(range(len(placement)))
+                        for i in indices:
+                            node = self._nodes.get(placement[i])
+                            if node is not None and node["alive"]:
+                                candidates.append(
+                                    (node["node_id"], [bundle[0], i]))
+                        if not candidates:
+                            return  # bundle nodes gone; pg will reschedule
+            elif strategy.get("type") == "node_affinity":
+                node = self._nodes.get(strategy["node_id"])
+                if node is not None and node["alive"]:
+                    candidates.append((node["node_id"], None))
+                elif not strategy.get("soft"):
+                    fail_reason = (
+                        f"node {strategy['node_id'][:8]} not found/alive "
+                        "(hard node affinity)")
+                if not candidates and fail_reason is None:
+                    # soft affinity falls back to the default policy
+                    strategy = {}
+            if not candidates and fail_reason is None and bundle is None \
+                    and strategy.get("type") != "node_affinity":
+                feasible = [
+                    node for node in self._nodes.values() if node["alive"]
+                    and all(node["available"].get(r, 0) >= v
+                            for r, v in need.items())]
+                if strategy.get("type") == "spread":
+                    # most-available-CPU first (cf. SpreadSchedulingPolicy)
+                    feasible.sort(
+                        key=lambda n: -n["available"].get("CPU", 0))
+                for node in feasible:
+                    candidates.append((node["node_id"], None))
+            if fail_reason is None and not candidates:
                 # no feasible node now; retried on the next node registration
                 logger.info("actor %s pending: no feasible node", aid[:8])
                 return
-            entry["node_id"] = target["node_id"]
-            entry["dispatched"] = True
-            node_conn = self._node_conns.get(target["node_id"])
-        if node_conn is None:
-            with self._lock:
-                entry["dispatched"] = False
+            if fail_reason is None:
+                entry["dispatched"] = True
+        if fail_reason is not None:
+            self._on_actor_failure(aid, fail_reason)
             return
-        try:
-            node_conn.call("create_actor", {
-                "actor_id": aid,
-                "spec": self._actors[aid]["spec"],
-                "resources": self._actors[aid]["resources"],
-            }, timeout=CONFIG.actor_creation_timeout_s)
-        except (rpc.RemoteError, ConnectionError, TimeoutError) as e:
-            logger.warning("actor %s creation dispatch failed: %s", aid[:8], e)
-            self._on_actor_failure(aid, f"creation failed: {e}")
+        last_err = None
+        for node_id, cand_bundle in candidates:
+            with self._lock:
+                entry["node_id"] = node_id
+                node_conn = self._node_conns.get(node_id)
+            if node_conn is None:
+                last_err = f"no connection to node {node_id[:8]}"
+                continue
+            try:
+                node_conn.call("create_actor", {
+                    "actor_id": aid,
+                    "spec": entry["spec"],
+                    "resources": entry["resources"],
+                    "bundle": cand_bundle,
+                }, timeout=CONFIG.actor_creation_timeout_s)
+                return
+            except (rpc.RemoteError, ConnectionError, TimeoutError) as e:
+                last_err = e
+                # only a resource shortfall is worth trying elsewhere; a
+                # user __init__ error would just re-raise on every node
+                if isinstance(e, rpc.RemoteError) and \
+                        "resources unavailable" not in str(e):
+                    break
+                continue
+        logger.warning("actor %s creation dispatch failed: %s",
+                       aid[:8], last_err)
+        self._on_actor_failure(aid, f"creation failed: {last_err}")
 
     def _rpc_actor_ready(self, conn, p):
         """Called by the actor's worker once __init__ completed."""
@@ -430,33 +522,114 @@ class GcsServer:
 
     # ----------------------------------------------------- placement groups
     def _rpc_create_placement_group(self, conn, p):
-        """2-phase bundle reservation across nodes; cf.
-        GcsPlacementGroupScheduler (reference §2.1).  Bundles with a
-        ``tpu-slice`` label are atomic: all land on nodes of one slice."""
+        """Register a placement group and try to place it now; otherwise it
+        stays PENDING and is retried as nodes join (cf. reference
+        GcsPlacementGroupManager / GcsPlacementGroupScheduler 2PC)."""
         pgid = p["pg_id"]
-        bundles = p["bundles"]
-        strategy = p.get("strategy", "PACK")
+        pg = {
+            "pg_id": pgid, "state": "PENDING", "bundles": p["bundles"],
+            "strategy": p.get("strategy", "PACK"),
+            "name": p.get("name", ""), "placement": None,
+            "job_id": p.get("job_id"),
+        }
         with self._lock:
+            existing = self._placement_groups.get(pgid)
+            if existing is not None:
+                return {"state": existing["state"]}
+            self._placement_groups[pgid] = pg
+        self._try_place_pg(pg)
+        return {"state": pg["state"], "placement": pg["placement"]}
+
+    def _try_place_pg(self, pg) -> bool:
+        """Plan a placement, then 2-phase reserve the bundles on the chosen
+        raylets (reserve_bundle; rollback with return_bundle on failure)."""
+        pgid = pg["pg_id"]
+        with self._lock:
+            if pg["state"] != "PENDING":
+                return pg["state"] == "CREATED"
             nodes = [n for n in self._nodes.values() if n["alive"]]
-            placement = self._pack_bundles(bundles, strategy, nodes)
+            placement = self._pack_bundles(pg["bundles"], pg["strategy"],
+                                           nodes)
             if placement is None:
-                self._placement_groups[pgid] = {
-                    "pg_id": pgid, "state": "PENDING", "bundles": bundles,
-                    "strategy": strategy, "placement": None,
-                    "job_id": p.get("job_id")}
-                return {"state": "PENDING"}
-            # commit: deduct resources
-            for bundle, node_id in zip(bundles, placement):
+                return False
+            # optimistic deduction on the GCS view so concurrent planners
+            # don't double-book; raylet heartbeats reconcile it afterwards
+            for bundle, node_id in zip(pg["bundles"], placement):
                 node = self._nodes[node_id]
                 for r, v in bundle.items():
                     node["available"][r] = node["available"].get(r, 0) - v
-            self._placement_groups[pgid] = {
-                "pg_id": pgid, "state": "CREATED", "bundles": bundles,
-                "strategy": strategy, "placement": placement,
-                "job_id": p.get("job_id")}
-        return {"state": "CREATED", "placement": placement}
+            conns = {nid: self._node_conns.get(nid) for nid in placement}
+        reserved = []
+        failed = False
+        for i, (bundle, nid) in enumerate(zip(pg["bundles"], placement)):
+            node_conn = conns.get(nid)
+            ok = False
+            if node_conn is not None:
+                try:
+                    reply = node_conn.call(
+                        "reserve_bundle",
+                        {"pg_id": pgid, "index": i, "resources": bundle},
+                        timeout=10)
+                    ok = bool(reply and reply.get("ok"))
+                except (ConnectionError, rpc.RpcError, TimeoutError):
+                    ok = False
+            if not ok:
+                failed = True
+                break
+            reserved.append((i, nid))
+        if failed:
+            for i, nid in reserved:
+                node_conn = conns.get(nid)
+                if node_conn is None:
+                    continue
+                try:
+                    node_conn.call("return_bundle",
+                                   {"pg_id": pgid, "index": i}, timeout=10)
+                except (ConnectionError, rpc.RpcError, TimeoutError):
+                    pass
+            with self._lock:  # roll back the optimistic view deduction
+                for bundle, node_id in zip(pg["bundles"], placement):
+                    node = self._nodes.get(node_id)
+                    if node and node["alive"]:
+                        for r, v in bundle.items():
+                            node["available"][r] = \
+                                node["available"].get(r, 0) + v
+            return False
+        with self._lock:
+            pg["state"] = "CREATED"
+            pg["placement"] = placement
+        self._publish("placement_group", {"pg_id": pgid, "state": "CREATED"})
+        # actors parked on this group's bundles can now be scheduled
+        with self._lock:
+            parked = [aid for aid, a in self._actors.items()
+                      if a.get("bundle") and a["bundle"][0] == pgid
+                      and a["state"] in (PENDING_CREATION, RESTARTING)
+                      and not a.get("dispatched")]
+        for aid in parked:
+            self._schedule_actor(aid)
+        return True
 
     def _pack_bundles(self, bundles, strategy, nodes) -> Optional[List[str]]:
+        """Bin-pack bundles onto nodes. TPU-slice awareness: if any bundle
+        names a ``tpu-slice`` resource, candidate nodes are restricted to a
+        single slice (node label ``tpu-slice``) so the group is atomic on
+        one pod slice (SURVEY.md §2.6)."""
+        slice_bundles = any("tpu-slice" in b for b in bundles)
+        if slice_bundles:
+            slices: Dict[str, List[dict]] = {}
+            for n in nodes:
+                label = n.get("labels", {}).get("tpu-slice")
+                if label:
+                    slices.setdefault(label, []).append(n)
+            for _, group in sorted(slices.items()):
+                placement = self._pack_bundles_on(bundles, strategy, group)
+                if placement is not None:
+                    return placement
+            return None
+        return self._pack_bundles_on(bundles, strategy, nodes)
+
+    def _pack_bundles_on(self, bundles, strategy, nodes
+                         ) -> Optional[List[str]]:
         avail = {n["node_id"]: dict(n["available"]) for n in nodes}
         order = list(avail.keys())
         placement = []
@@ -487,17 +660,46 @@ class GcsServer:
             pg = self._placement_groups.get(p["pg_id"])
             return dict(pg) if pg else None
 
-    def _rpc_remove_placement_group(self, conn, p):
+    def _rpc_list_placement_groups(self, conn, p):
         with self._lock:
-            pg = self._placement_groups.pop(p["pg_id"], None)
-            if pg and pg.get("placement"):
-                for bundle, node_id in zip(pg["bundles"], pg["placement"]):
-                    node = self._nodes.get(node_id)
-                    if node:
-                        for r, v in bundle.items():
-                            node["available"][r] = \
-                                node["available"].get(r, 0) + v
-        return {"ok": pg is not None}
+            return {pgid: dict(pg)
+                    for pgid, pg in self._placement_groups.items()}
+
+    def _rpc_remove_placement_group(self, conn, p):
+        pgid = p["pg_id"]
+        with self._lock:
+            pg = self._placement_groups.pop(pgid, None)
+            if pg is None:
+                return {"ok": False}
+            placement = pg.get("placement") or []
+            conns = {nid: self._node_conns.get(nid) for nid in placement}
+            # actors living in (or parked on) this group die with it
+            # (reference semantics: GcsPlacementGroupManager kills actors
+            # of removed groups)
+            doomed = [
+                (aid, self._node_conns.get(a.get("node_id") or ""))
+                for aid, a in self._actors.items()
+                if a.get("bundle") and a["bundle"][0] == pgid
+                and a["state"] != DEAD]
+        for aid, node_conn in doomed:
+            if node_conn is not None:
+                try:
+                    node_conn.push("kill_actor_worker", {"actor_id": aid})
+                except ConnectionError:
+                    pass
+            self._on_actor_failure(aid, "placement group removed")
+        for i, nid in enumerate(placement):
+            node_conn = conns.get(nid)
+            if node_conn is None:
+                continue
+            try:
+                node_conn.call("return_bundle",
+                               {"pg_id": pgid, "index": i}, timeout=10)
+            except (ConnectionError, rpc.RpcError, TimeoutError):
+                pass
+        self._publish("placement_group",
+                      {"pg_id": pgid, "state": "REMOVED"})
+        return {"ok": True}
 
 
 class GcsClient:

@@ -91,7 +91,11 @@ class Raylet:
         self._workers: Dict[str, WorkerHandle] = {}       # worker_id hex ->
         self._idle: Dict[str, deque] = {}                 # sched key -> ids
         self._pending_leases: deque = deque()
-        self._leases: Dict[str, Dict[str, float]] = {}    # lease_id -> res
+        # lease_id -> {"need": resources, "pool": bundle pool key or None}
+        self._leases: Dict[str, Dict[str, Any]] = {}
+        # placement-group bundle pools reserved on this node:
+        # "pgid:index" -> remaining resources in the bundle
+        self._bundle_pools: Dict[str, Dict[str, float]] = {}
         self._lock = threading.RLock()
         self._stopped = threading.Event()
 
@@ -283,22 +287,67 @@ class Raylet:
             self._kill_worker(wid, "actor killed")
 
     # ---------------------------------------------------------------- leases
-    def _try_acquire(self, need: Dict[str, float]) -> bool:
+    def _try_acquire(self, need: Dict[str, float],
+                     pool_key: Optional[str] = None) -> bool:
+        """Deduct ``need`` from the node pool, or from a reserved
+        placement-group bundle pool when ``pool_key`` is given."""
         with self._res_lock:
-            if all(self.available.get(r, 0) >= v for r, v in need.items()):
+            pool = self.available if pool_key is None \
+                else self._bundle_pools.get(pool_key)
+            if pool is None:
+                return False
+            if all(pool.get(r, 0) >= v for r, v in need.items()):
                 for r, v in need.items():
-                    self.available[r] = self.available.get(r, 0) - v
+                    pool[r] = pool.get(r, 0) - v
                 return True
         return False
 
+    def _give_back(self, need: Dict[str, float],
+                   pool_key: Optional[str]) -> None:
+        with self._res_lock:
+            pool = self.available
+            if pool_key is not None:
+                # if the bundle was dropped meanwhile, resources flow back
+                # to the node pool (they were carved out of it originally)
+                pool = self._bundle_pools.get(pool_key, self.available)
+            for r, v in need.items():
+                pool[r] = pool.get(r, 0) + v
+
     def _release_lease_resources(self, lease_id: str) -> None:
         with self._lock:
-            need = self._leases.pop(lease_id, None)
-        if need:
-            with self._res_lock:
-                for r, v in need.items():
-                    self.available[r] = self.available.get(r, 0) + v
+            rec = self._leases.pop(lease_id, None)
+        if rec:
+            self._give_back(rec["need"], rec.get("pool"))
         self._dispatch_pending()
+
+    # ------------------------------------------------- placement-group 2PC
+    def _rpc_reserve_bundle(self, conn, p):
+        """Phase-1/2 of GCS bundle reservation: carve the bundle's resources
+        out of the node pool into a dedicated pool (cf. reference
+        PlacementGroupResourceManager, placement_group_resource_manager.h)."""
+        key = f"{p['pg_id']}:{int(p['index'])}"
+        need = dict(p["resources"])
+        with self._res_lock:
+            if key in self._bundle_pools:
+                return {"ok": True}  # idempotent retry
+            if not all(self.available.get(r, 0) >= v
+                       for r, v in need.items()):
+                return {"ok": False, "reason": "insufficient resources"}
+            for r, v in need.items():
+                self.available[r] = self.available.get(r, 0) - v
+            self._bundle_pools[key] = dict(need)
+        return {"ok": True}
+
+    def _rpc_return_bundle(self, conn, p):
+        """Release a bundle pool; whatever is currently free in the pool
+        returns to the node. In-flight leases drain back via _give_back."""
+        key = f"{p['pg_id']}:{int(p['index'])}"
+        with self._res_lock:
+            pool = self._bundle_pools.pop(key, None)
+            if pool:
+                for r, v in pool.items():
+                    self.available[r] = self.available.get(r, 0) + v
+        return {"ok": pool is not None}
 
     def _rpc_lease_worker(self, conn, p):
         """Grant a worker lease, spill to another node, or queue.
@@ -309,8 +358,15 @@ class Raylet:
         (scheduling/policy/hybrid_scheduling_policy.h:48)."""
         need = dict(p.get("resources", {}))
         need.setdefault("CPU", 1.0)
+        bundle = p.get("bundle")  # [pg_id_hex, index] -> lease from the pool
+        pool_key = f"{bundle[0]}:{int(bundle[1])}" if bundle else None
+        if pool_key is not None:
+            with self._res_lock:
+                if pool_key not in self._bundle_pools:
+                    raise rpc.RpcError(
+                        f"bundle {pool_key} not reserved on this node")
         spillback = int(p.get("spillback", 0))
-        if spillback < 2:
+        if pool_key is None and spillback < 2:
             with self._res_lock:
                 local_ok = all(self.available.get(r, 0) >= v
                                for r, v in need.items())
@@ -322,6 +378,7 @@ class Raylet:
         event = threading.Event()
         req = {"key": p.get("key", ""), "resources": p.get("resources", {}),
                "job_id": p.get("job_id"), "env": p.get("env") or {},
+               "pool": pool_key,
                "event": event, "out": fut_holder}
         with self._lock:
             self._pending_leases.append(req)
@@ -360,17 +417,34 @@ class Raylet:
         return None
 
     def _dispatch_pending(self) -> None:
-        """Try to satisfy queued lease requests (FIFO)."""
+        """Satisfy queued lease requests, first-fit: a request blocked on an
+        exhausted bundle pool must not head-of-line-block node-pool leases
+        (and vice versa) since they draw from independent pools."""
         while True:
             with self._lock:
-                if not self._pending_leases:
+                req = None
+                rescan = False
+                for cand in self._pending_leases:
+                    need = dict(cand["resources"])
+                    need.setdefault("CPU", 1.0)
+                    pool_key = cand.get("pool")
+                    if pool_key is not None and not self._pool_exists(
+                            pool_key):
+                        # the bundle was removed while we queued: fail fast
+                        self._pending_leases.remove(cand)
+                        cand["out"]["error"] = \
+                            f"placement bundle {pool_key} removed"
+                        cand["event"].set()
+                        rescan = True
+                        break  # deque mutated mid-iteration; rescan
+                    if self._try_acquire(need, pool_key):
+                        req = cand
+                        break
+                if req is None:
+                    if rescan:
+                        continue
                     return
-                req = self._pending_leases[0]
-                need = dict(req["resources"])
-                need.setdefault("CPU", 1.0)
-                if not self._try_acquire(need):
-                    return
-                self._pending_leases.popleft()
+                self._pending_leases.remove(req)
                 # reuse an idle worker for this key if possible
                 q = self._idle.get(req["key"])
                 handle = None
@@ -383,7 +457,7 @@ class Raylet:
                 handle = self._spawn_worker(req["job_id"],
                                             self._tpu_env(need))
                 if not self._wait_worker_ready(handle):
-                    self._with_res_release(need)
+                    self._give_back(need, pool_key)
                     req["out"]["error"] = "worker failed to start"
                     req["event"].set()
                     continue
@@ -394,7 +468,7 @@ class Raylet:
                 "address": list(handle.address),
             }
             with self._lock:
-                self._leases[lease_id] = need
+                self._leases[lease_id] = {"need": need, "pool": pool_key}
                 handle.lease_id = lease_id
                 handle.job_id = req["job_id"]
                 abandoned = req.get("abandoned", False)
@@ -409,6 +483,10 @@ class Raylet:
                         handle.worker_id.hex())
                 self._release_lease_resources(lease_id)
             req["event"].set()
+
+    def _pool_exists(self, pool_key: str) -> bool:
+        with self._res_lock:
+            return pool_key in self._bundle_pools
 
     def _with_res_release(self, need: Dict[str, float]) -> None:
         with self._res_lock:
@@ -439,15 +517,17 @@ class Raylet:
         """GCS asks us to host an actor: dedicated worker + creation task."""
         need = dict(p.get("resources", {}))
         need.setdefault("CPU", 1.0)
-        if not self._try_acquire(need):
+        bundle = p.get("bundle")
+        pool_key = f"{bundle[0]}:{int(bundle[1])}" if bundle else None
+        if not self._try_acquire(need, pool_key):
             raise rpc.RpcError("resources unavailable for actor")
         handle = self._spawn_worker(None, self._tpu_env(need))
         if not self._wait_worker_ready(handle):
-            self._with_res_release(need)
+            self._give_back(need, pool_key)
             raise rpc.RpcError("actor worker failed to start")
         lease_id = "actor-" + p["actor_id"]
         with self._lock:
-            self._leases[lease_id] = need
+            self._leases[lease_id] = {"need": need, "pool": pool_key}
             handle.lease_id = lease_id
             handle.actor_id = p["actor_id"]
         try:

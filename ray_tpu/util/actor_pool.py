@@ -1,0 +1,115 @@
+"""A pool of actors processing a stream of tasks.
+
+Analog of /root/reference/python/ray/util/actor_pool.py (ActorPool).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    """Round-robins work over a fixed set of actor handles.
+
+    >>> pool = ActorPool([Worker.remote() for _ in range(4)])
+    >>> list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    """
+
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    # ------------------------------------------------------------- mapping
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterable[Any]:
+        """Ordered map; yields results in submission order."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterable[Any]:
+        """Unordered map; yields results as they complete (faster when task
+        durations vary)."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # ---------------------------------------------------------- scheduling
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        if not self._idle and not self._future_to_actor \
+                and not self._pending_submits:
+            raise ValueError("cannot submit to an ActorPool with no actors")
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def _return_actor(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in submission order (skipping results already taken
+        by :meth:`get_next_unordered`)."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        # indices assigned at submit time but absent from the map were
+        # consumed by get_next_unordered: skip them
+        while self._next_return_index < self._next_task_index and \
+                self._next_return_index not in self._index_to_future:
+            self._next_return_index += 1
+        future = self._index_to_future.get(self._next_return_index)
+        if future is None:
+            # every indexed task was consumed; anything left is parked,
+            # which with a non-empty pool implies in-flight futures exist —
+            # so this means has_next() lied (defensive)
+            raise StopIteration("no pending results")
+        value = ray_tpu.get(future, timeout=timeout)
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(future)
+        self._return_actor(actor)
+        return value
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Any completed result (completion order)."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        future = ready[0]
+        i, actor = self._future_to_actor.pop(future)
+        del self._index_to_future[i]
+        self._return_actor(actor)
+        return ray_tpu.get(future)
+
+    # --------------------------------------------------------------- admin
+    def push(self, actor) -> None:
+        """Add an idle actor to the pool."""
+        self._return_actor(actor)
+
+    def pop_idle(self):
+        """Remove and return an idle actor, or None."""
+        return self._idle.pop() if self._idle else None
+
+    def has_free(self) -> bool:
+        return bool(self._idle)

@@ -1,0 +1,27 @@
+"""Collective communication on top of the cluster runtime.
+
+Analog of /root/reference/python/ray/util/collective/collective.py
+(init_collective_group :120, allreduce :258, reduce/broadcast/allgather/
+reducescatter/send/recv/barrier :311-615).
+
+Two planes (SURVEY.md §5 "distributed communication backend"):
+
+- **ICI (in-graph)**: the hot path. TPU collectives are XLA ops compiled
+  into jitted programs via ``pjit``/``shard_map`` over a Mesh — see
+  :mod:`ray_tpu.util.collective.ici` for imperative-looking wrappers.
+- **DCN (host)**: a ring collective group over host TCP for control-plane
+  and cross-slice traffic, replacing the reference's Gloo/NCCL groups.
+"""
+
+from ray_tpu.util.collective.collective import (  # noqa: F401
+    ReduceOp, allgather, allreduce, barrier, broadcast,
+    destroy_collective_group, get_rank, get_collective_group_size,
+    init_collective_group, is_group_initialized, recv, reduce,
+    reducescatter, send)
+
+__all__ = [
+    "ReduceOp", "init_collective_group", "destroy_collective_group",
+    "is_group_initialized", "get_rank", "get_collective_group_size",
+    "allreduce", "allgather", "reducescatter", "broadcast", "reduce",
+    "send", "recv", "barrier",
+]

@@ -1,0 +1,353 @@
+"""Host-level (DCN) collective groups: ring allreduce & friends over TCP.
+
+Design notes (vs the reference's NCCL/Gloo groups,
+/root/reference/python/ray/util/collective/collective_group/):
+
+- Rendezvous rides the GCS KV (the reference uses a named actor store):
+  each rank publishes its listening address under
+  ``collective/<group>/<rank>`` and polls for the full ring.
+- allreduce/reducescatter/allgather use the bandwidth-optimal ring
+  algorithm (2*(N-1) steps, each moving 1/N of the data), the same
+  schedule NCCL uses — here over host sockets because on TPU the
+  intra-slice fabric (ICI) is only reachable in-graph via XLA.
+- Tensors are numpy arrays (JAX arrays are converted on the way in and
+  returned as numpy; callers on the hot path should use in-graph
+  collectives instead).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu._private import rpc
+from ray_tpu.runtime.core_worker import get_global_worker
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: np.add,
+    ReduceOp.PRODUCT: np.multiply,
+    ReduceOp.MIN: np.minimum,
+    ReduceOp.MAX: np.maximum,
+}
+
+_groups: Dict[str, "_Group"] = {}
+_groups_lock = threading.Lock()
+
+
+def _as_numpy(tensor: Any) -> np.ndarray:
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    return np.asarray(tensor)
+
+
+class _Mailbox:
+    """Incoming messages keyed by (src_rank, tag)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._msgs: Dict[Tuple[int, str], List[Any]] = {}
+
+    def put(self, src: int, tag: str, payload: Any) -> None:
+        with self._cv:
+            self._msgs.setdefault((src, tag), []).append(payload)
+            self._cv.notify_all()
+
+    def get(self, src: int, tag: str, timeout: float) -> Any:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                q = self._msgs.get((src, tag))
+                if q:
+                    msg = q.pop(0)
+                    if not q:
+                        del self._msgs[(src, tag)]
+                    return msg
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"collective recv (src={src}, tag={tag}) timed out")
+                self._cv.wait(remaining)
+
+
+class _Group:
+    def __init__(self, name: str, world_size: int, rank: int,
+                 timeout: float = 60.0):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.timeout = timeout
+        self._mailbox = _Mailbox()
+        self._server = rpc.Server(self._handle)
+        self._conns: Dict[int, rpc.Connection] = {}
+        self._conns_lock = threading.Lock()
+        self._seq = 0
+        self._rendezvous()
+
+    # ------------------------------------------------------------ plumbing
+    def _handle(self, conn: rpc.Connection, method: str, p: Any) -> Any:
+        if method == "msg":
+            self._mailbox.put(p["src"], p["tag"], p["data"])
+            return True
+        raise rpc.RpcError(f"collective: unknown method {method}")
+
+    def _rendezvous(self) -> None:
+        import json
+        gcs = get_global_worker().gcs
+        key = f"collective/{self.name}/{self.rank}"
+        gcs.kv_put(key, json.dumps(list(self._server.address)).encode())
+        self._addrs: Dict[int, Tuple[str, int]] = {}
+        deadline = time.monotonic() + self.timeout
+        while len(self._addrs) < self.world_size:
+            for r in range(self.world_size):
+                if r in self._addrs:
+                    continue
+                raw = gcs.kv_get(f"collective/{self.name}/{r}")
+                if raw is not None:
+                    host, port = json.loads(raw.decode())
+                    self._addrs[r] = (host, int(port))
+            if len(self._addrs) < self.world_size:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"collective group {self.name!r}: only "
+                        f"{len(self._addrs)}/{self.world_size} ranks showed")
+                time.sleep(0.05)
+
+    def _conn_to(self, peer: int) -> rpc.Connection:
+        with self._conns_lock:
+            conn = self._conns.get(peer)
+            if conn is None or conn.closed:
+                conn = rpc.connect(self._addrs[peer])
+                self._conns[peer] = conn
+            return conn
+
+    def _send(self, peer: int, tag: str, data: Any) -> None:
+        self._conn_to(peer).call(
+            "msg", {"src": self.rank, "tag": tag, "data": data},
+            timeout=self.timeout)
+
+    def _recv(self, peer: int, tag: str) -> Any:
+        return self._mailbox.get(peer, tag, self.timeout)
+
+    def _next_tag(self, opname: str) -> str:
+        # all ranks call collectives in the same order => same sequence
+        self._seq += 1
+        return f"{opname}:{self._seq}"
+
+    # ---------------------------------------------------------- primitives
+    def send(self, tensor: Any, dst: int, tag: str = "p2p") -> None:
+        self._send(dst, tag, _as_numpy(tensor))
+
+    def recv(self, src: int, tag: str = "p2p") -> np.ndarray:
+        return self._recv(src, tag)
+
+    def broadcast(self, tensor: Any, src: int) -> np.ndarray:
+        tag = self._next_tag("bcast")
+        if self.world_size == 1:
+            return _as_numpy(tensor)
+        # ring forward: src -> src+1 -> ... -> src-1
+        if self.rank == src:
+            out = _as_numpy(tensor)
+        else:
+            out = self._recv((self.rank - 1) % self.world_size, tag)
+        nxt = (self.rank + 1) % self.world_size
+        if nxt != src:
+            self._send(nxt, tag, out)
+        return out
+
+    def allreduce(self, tensor: Any, op: str = ReduceOp.SUM) -> np.ndarray:
+        """Ring allreduce: reduce-scatter then allgather, 2(N-1) steps."""
+        x = _as_numpy(tensor)
+        n = self.world_size
+        if n == 1:
+            return x.copy()
+        tag = self._next_tag("ar")
+        reducer = _REDUCERS[op]
+        flat = x.reshape(-1).astype(x.dtype, copy=True)
+        chunks = np.array_split(flat, n)
+        nxt, prv = (self.rank + 1) % n, (self.rank - 1) % n
+        # reduce-scatter: after N-1 steps, rank r owns the fully-reduced
+        # chunk (r+1) % n
+        for step in range(n - 1):
+            send_idx = (self.rank - step) % n
+            recv_idx = (self.rank - step - 1) % n
+            self._send(nxt, f"{tag}:rs{step}", chunks[send_idx])
+            incoming = self._recv(prv, f"{tag}:rs{step}")
+            chunks[recv_idx] = reducer(chunks[recv_idx], incoming)
+        # allgather: circulate the reduced chunks
+        for step in range(n - 1):
+            send_idx = (self.rank - step + 1) % n
+            recv_idx = (self.rank - step) % n
+            self._send(nxt, f"{tag}:ag{step}", chunks[send_idx])
+            chunks[recv_idx] = self._recv(prv, f"{tag}:ag{step}")
+        out = np.concatenate(chunks).reshape(x.shape)
+        return out
+
+    def reduce(self, tensor: Any, dst: int,
+               op: str = ReduceOp.SUM) -> np.ndarray:
+        """Reduce to ``dst`` (star gather; fine for control-plane sizes)."""
+        x = _as_numpy(tensor)
+        tag = self._next_tag("red")
+        if self.world_size == 1:
+            return x.copy()
+        if self.rank == dst:
+            acc = x.astype(x.dtype, copy=True)
+            reducer = _REDUCERS[op]
+            for r in range(self.world_size):
+                if r == dst:
+                    continue
+                acc = reducer(acc, self._recv(r, tag))
+            return acc
+        self._send(dst, tag, x)
+        return x
+
+    def allgather(self, tensor: Any) -> List[np.ndarray]:
+        x = _as_numpy(tensor)
+        n = self.world_size
+        if n == 1:
+            return [x.copy()]
+        tag = self._next_tag("allg")
+        nxt, prv = (self.rank + 1) % n, (self.rank - 1) % n
+        parts: List[Optional[np.ndarray]] = [None] * n
+        parts[self.rank] = x
+        for step in range(n - 1):
+            send_idx = (self.rank - step) % n
+            self._send(nxt, f"{tag}:{step}", parts[send_idx])
+            recv_idx = (self.rank - step - 1) % n
+            parts[recv_idx] = self._recv(prv, f"{tag}:{step}")
+        return [p for p in parts]
+
+    def reducescatter(self, tensor: Any,
+                      op: str = ReduceOp.SUM) -> np.ndarray:
+        """Each rank gets its reduced 1/N shard (ring reduce-scatter)."""
+        x = _as_numpy(tensor)
+        n = self.world_size
+        if n == 1:
+            return x.copy()
+        tag = self._next_tag("rs")
+        reducer = _REDUCERS[op]
+        flat = x.reshape(-1).astype(x.dtype, copy=True)
+        chunks = np.array_split(flat, n)
+        nxt, prv = (self.rank + 1) % n, (self.rank - 1) % n
+        for step in range(n - 1):
+            send_idx = (self.rank - step) % n
+            recv_idx = (self.rank - step - 1) % n
+            self._send(nxt, f"{tag}:{step}", chunks[send_idx])
+            incoming = self._recv(prv, f"{tag}:{step}")
+            chunks[recv_idx] = reducer(chunks[recv_idx], incoming)
+        return chunks[(self.rank + 1) % n]
+
+    def barrier(self) -> None:
+        self.allreduce(np.zeros(1, np.float32))
+
+    def destroy(self) -> None:
+        try:
+            gcs = get_global_worker().gcs
+            gcs.kv_del(f"collective/{self.name}/{self.rank}")
+        except Exception:
+            pass
+        with self._conns_lock:
+            for conn in self._conns.values():
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            self._conns.clear()
+        self._server.stop()
+
+
+# -------------------------------------------------------------- public API
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "dcn",
+                          group_name: str = "default",
+                          timeout: float = 60.0) -> None:
+    """Join a collective group. Every participating process calls this with
+    its own rank; returns once the full ring has rendezvoused."""
+    if backend not in ("dcn", "gloo", "ring"):
+        raise ValueError(
+            f"backend {backend!r} not supported; TPU in-graph collectives "
+            "are compiled via pjit (see ray_tpu.util.collective.ici)")
+    if not (0 <= rank < world_size):
+        raise ValueError(f"rank {rank} out of range [0, {world_size})")
+    with _groups_lock:
+        if group_name in _groups:
+            raise RuntimeError(f"group {group_name!r} already initialized")
+    g = _Group(group_name, world_size, rank, timeout)
+    with _groups_lock:
+        _groups[group_name] = g
+
+
+def _get(group_name: str) -> _Group:
+    with _groups_lock:
+        g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized")
+    return g
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    with _groups_lock:
+        return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _groups_lock:
+        g = _groups.pop(group_name, None)
+    if g is not None:
+        g.destroy()
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _get(group_name).world_size
+
+
+def allreduce(tensor: Any, group_name: str = "default",
+              op: str = ReduceOp.SUM) -> np.ndarray:
+    return _get(group_name).allreduce(tensor, op)
+
+
+def reduce(tensor: Any, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM) -> np.ndarray:
+    return _get(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor: Any, src_rank: int = 0,
+              group_name: str = "default") -> np.ndarray:
+    return _get(group_name).broadcast(tensor, src_rank)
+
+
+def allgather(tensor: Any, group_name: str = "default") -> List[np.ndarray]:
+    return _get(group_name).allgather(tensor)
+
+
+def reducescatter(tensor: Any, group_name: str = "default",
+                  op: str = ReduceOp.SUM) -> np.ndarray:
+    return _get(group_name).reducescatter(tensor, op)
+
+
+def send(tensor: Any, dst_rank: int, group_name: str = "default") -> None:
+    _get(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default") -> np.ndarray:
+    return _get(group_name).recv(src_rank)
+
+
+def barrier(group_name: str = "default") -> None:
+    _get(group_name).barrier()

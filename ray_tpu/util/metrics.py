@@ -1,0 +1,146 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Analog of /root/reference/python/ray/util/metrics.py (Counter:155,
+Histogram:220, Gauge:295). Metrics are pushed to the GCS KV under
+``metrics/<name>/<worker>`` so any process (dashboard, tests) can read a
+cluster-wide snapshot; a Prometheus scrape endpoint is served by the
+dashboard module.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.runtime.core_worker import get_global_worker
+
+_FLUSH_PERIOD_S = 1.0
+
+
+class _MetricBase:
+    _TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        if not name:
+            raise ValueError("metric name must be non-empty")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._dirty = False
+        self._last_flush = 0.0
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        for k in tags:
+            if k not in self._tag_keys:
+                raise ValueError(f"unknown tag key {k!r}")
+        self._default_tags = dict(tags)
+        return self
+
+    def _tagkey(self, tags: Optional[Dict[str, str]]
+                ) -> Tuple[Tuple[str, str], ...]:
+        merged = dict(self._default_tags)
+        if tags:
+            for k in tags:
+                if k not in self._tag_keys:
+                    raise ValueError(f"unknown tag key {k!r}")
+            merged.update(tags)
+        return tuple(sorted(merged.items()))
+
+    def _record(self, value: float, tags: Optional[Dict[str, str]],
+                mode: str) -> None:
+        key = self._tagkey(tags)
+        with self._lock:
+            if mode == "add":
+                self._values[key] = self._values.get(key, 0.0) + value
+            else:
+                self._values[key] = value
+            self._dirty = True
+        self._maybe_flush()
+
+    def _maybe_flush(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not self._dirty or \
+                    (not force and now - self._last_flush < _FLUSH_PERIOD_S):
+                return
+            snapshot = {json.dumps(dict(k)): v
+                        for k, v in self._values.items()}
+            self._dirty = False
+            self._last_flush = now
+        try:
+            worker = get_global_worker()
+            worker.gcs.kv_put(
+                f"metrics/{self._name}/{worker.worker_id.hex()[:12]}",
+                json.dumps({
+                    "type": self._TYPE,
+                    "description": self._description,
+                    "values": snapshot,
+                    "ts": time.time(),
+                }).encode())
+        except Exception:
+            pass  # metrics must never take down the app
+
+    def flush(self) -> None:
+        self._maybe_flush(force=True)
+
+
+class Counter(_MetricBase):
+    """Monotonically increasing value."""
+
+    _TYPE = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value <= 0:
+            raise ValueError("counter increments must be positive")
+        self._record(value, tags, "add")
+
+
+class Gauge(_MetricBase):
+    """Point-in-time value."""
+
+    _TYPE = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        self._record(value, tags, "set")
+
+
+class Histogram(_MetricBase):
+    """Distribution over configured boundaries; stores per-bucket counts."""
+
+    _TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        super().__init__(name, description, tag_keys)
+        if not boundaries or any(b <= 0 for b in boundaries):
+            raise ValueError("histogram needs positive boundaries")
+        self._boundaries = sorted(boundaries)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        bucket = next((b for b in self._boundaries if value <= b), float("inf"))
+        key = self._tagkey(tags) + (("le", str(bucket)),)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + 1
+            self._dirty = True
+        self._maybe_flush()
+
+
+def query_metrics(prefix: str = "") -> Dict[str, dict]:
+    """Cluster-wide metric snapshot from the GCS KV (for tests/dashboard)."""
+    worker = get_global_worker()
+    out: Dict[str, dict] = {}
+    for key in worker.gcs.kv_keys("metrics/" + prefix):
+        raw = worker.gcs.kv_get(key)
+        if raw:
+            out[key[len("metrics/"):]] = json.loads(raw.decode())
+    return out

@@ -1,0 +1,238 @@
+"""Tests for ray_tpu.util: placement groups, scheduling strategies,
+ActorPool, Queue, collective ring algorithms, metrics.
+
+Modeled on the reference's python/ray/tests/test_placement_group*.py,
+test_actor_pool.py, test_queue.py, util/collective/tests.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (ActorPool, PlacementGroup, Queue,
+                          NodeAffinitySchedulingStrategy,
+                          PlacementGroupSchedulingStrategy, placement_group,
+                          placement_group_table, remove_placement_group)
+
+
+# ---------------------------------------------------------------- fixtures
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def add(self, k):
+        self.n += k
+        return self.n
+
+    def node_id(self):
+        import ray_tpu
+        return ray_tpu.context()["node_id"]
+
+
+@ray_tpu.remote
+def where_am_i():
+    return ray_tpu.context()["node_id"]
+
+
+# --------------------------------------------------------- placement groups
+def test_placement_group_create_and_remove(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+    table = placement_group_table(pg)
+    info = table[pg.id.hex()]
+    assert info["state"] == "CREATED"
+    assert len(info["placement"]) == 2
+    remove_placement_group(pg)
+    table = placement_group_table(pg)
+    assert not table or table[pg.id.hex()] is None
+
+
+def test_placement_group_task_scheduling(ray_start_regular):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(30)
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    ref = where_am_i.options(scheduling_strategy=strategy).remote()
+    node = ray_tpu.get(ref, timeout=60)
+    info = placement_group_table(pg)[pg.id.hex()]
+    assert node == info["placement"][0]
+    remove_placement_group(pg)
+
+
+def test_placement_group_actor(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    c = Counter.options(scheduling_strategy=strategy).remote()
+    assert ray_tpu.get(c.add.remote(5), timeout=60) == 5
+    node = ray_tpu.get(c.node_id.remote(), timeout=60)
+    info = placement_group_table(pg)[pg.id.hex()]
+    assert node == info["placement"][0]
+    ray_tpu.kill(c)
+    remove_placement_group(pg)
+
+
+def test_placement_group_infeasible_pending(ray_start_regular):
+    # way more CPU than the single test node has
+    pg = placement_group([{"CPU": 512}])
+    assert not pg.wait(1.0)
+    info = placement_group_table(pg)[pg.id.hex()]
+    assert info["state"] == "PENDING"
+    remove_placement_group(pg)
+
+
+def test_placement_group_strict_spread_infeasible(ray_start_regular):
+    # single node -> STRICT_SPREAD of 2 bundles can't be placed
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert not pg.wait(1.0)
+    remove_placement_group(pg)
+
+
+def test_node_affinity_strategy(ray_start_regular):
+    my_node = ray_tpu.nodes()[0]["node_id"]
+    strategy = NodeAffinitySchedulingStrategy(node_id=my_node, soft=False)
+    node = ray_tpu.get(
+        where_am_i.options(scheduling_strategy=strategy).remote(),
+        timeout=60)
+    assert node == my_node
+
+
+# ------------------------------------------------------------- actor pool
+def test_actor_pool_map(ray_start_regular):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, v):
+            return v * 2
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    results = list(pool.map(lambda a, v: a.double.remote(v), range(6)))
+    assert results == [0, 2, 4, 6, 8, 10]
+
+
+def test_actor_pool_map_unordered(ray_start_regular):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, v):
+            return v * 2
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    results = sorted(pool.map_unordered(
+        lambda a, v: a.double.remote(v), range(6)))
+    assert results == [0, 2, 4, 6, 8, 10]
+
+
+# ------------------------------------------------------------------ queue
+def test_queue_basic(ray_start_regular):
+    q = Queue(maxsize=3)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+    with pytest.raises(ray_tpu.util.Empty):
+        q.get(block=False)
+    q.put_nowait_batch([1, 2, 3])
+    with pytest.raises(ray_tpu.util.Full):
+        q.put_nowait(4)
+    assert q.get_nowait_batch(3) == [1, 2, 3]
+    q.shutdown()
+
+
+def test_queue_across_tasks(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    ray_tpu.get(producer.remote(q, 5), timeout=60)
+    assert sorted(q.get() for _ in range(5)) == list(range(5))
+    q.shutdown()
+
+
+# ------------------------------------------------------------- collective
+def test_collective_ring_allreduce(ray_start_regular):
+    """4 actors run a ring allreduce over the host (DCN) backend."""
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, world, rank):
+            from ray_tpu.util import collective as col
+            self.col = col
+            col.init_collective_group(world, rank, group_name="test-ar")
+            self.rank = rank
+
+        def allreduce(self):
+            x = np.full((32,), float(self.rank + 1), np.float32)
+            out = self.col.allreduce(x, group_name="test-ar")
+            return out
+
+        def allgather(self):
+            x = np.full((4,), float(self.rank), np.float32)
+            return self.col.allgather(x, group_name="test-ar")
+
+        def broadcast(self):
+            x = np.full((8,), float(self.rank), np.float32)
+            return self.col.broadcast(x, src_rank=2, group_name="test-ar")
+
+        def destroy(self):
+            self.col.destroy_collective_group("test-ar")
+
+    world = 4
+    ranks = [Rank.remote(world, r) for r in range(world)]
+    outs = ray_tpu.get([r.allreduce.remote() for r in ranks], timeout=120)
+    expected = np.full((32,), float(sum(range(1, world + 1))), np.float32)
+    for out in outs:
+        np.testing.assert_allclose(out, expected)
+    gathers = ray_tpu.get([r.allgather.remote() for r in ranks], timeout=120)
+    for parts in gathers:
+        assert len(parts) == world
+        for r, part in enumerate(parts):
+            np.testing.assert_allclose(part, np.full((4,), float(r)))
+    bcasts = ray_tpu.get([r.broadcast.remote() for r in ranks], timeout=120)
+    for out in bcasts:
+        np.testing.assert_allclose(out, np.full((8,), 2.0))
+    ray_tpu.get([r.destroy.remote() for r in ranks], timeout=60)
+    for r in ranks:
+        ray_tpu.kill(r)
+
+
+def test_ici_collectives_on_mesh():
+    """In-graph collectives over the 8-device virtual mesh."""
+    import jax
+    from ray_tpu.parallel import build_mesh, MeshConfig
+    from ray_tpu.util.collective import ici
+
+    mesh = build_mesh(MeshConfig(data=8))
+    x = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    xs = ici.device_put_sharded(x, mesh, "data")
+    out = ici.all_gather(xs, mesh, "data")
+    np.testing.assert_allclose(np.asarray(out), x)
+    rs = ici.reduce_scatter(xs, mesh, "data")
+    np.testing.assert_allclose(
+        np.asarray(rs).reshape(-1), x.sum(axis=0))
+    pp = ici.ppermute(xs, mesh, "data", shift=1)
+    np.testing.assert_allclose(np.asarray(pp), np.roll(x, 1, axis=0))
+
+
+# ---------------------------------------------------------------- metrics
+def test_metrics_counter_gauge(ray_start_regular):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests", "reqs", tag_keys=("route",))
+    c.inc(2.0, tags={"route": "a"})
+    c.inc(3.0, tags={"route": "a"})
+    c.flush()
+    g = metrics.Gauge("test_temp", "temp")
+    g.set(42.0)
+    g.flush()
+    snap = metrics.query_metrics()
+    counters = [v for k, v in snap.items() if k.startswith("test_requests")]
+    assert counters and list(counters[0]["values"].values()) == [5.0]
+    gauges = [v for k, v in snap.items() if k.startswith("test_temp")]
+    assert gauges and list(gauges[0]["values"].values()) == [42.0]
