@@ -81,6 +81,7 @@ class Connection:
         self._inflight: Dict[int, Future] = {}
         self._inflight_lock = threading.Lock()
         self._closed = threading.Event()
+        self._push_queue = None   # created lazily on first push
         self.peer: Any = None  # attachable identity (e.g. worker id)
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
@@ -149,14 +150,34 @@ class Connection:
                             fut.set_exception(RemoteError(value))
                 else:  # _PUSH
                     if self._push_handler is not None:
-                        try:
-                            self._push_handler(a, b)
-                        except Exception:
-                            logger.exception("push handler failed for %s", a)
+                        # off the read loop: a push handler that makes a
+                        # synchronous call on THIS connection would otherwise
+                        # deadlock (its response can never be read). A serial
+                        # queue keeps per-connection push ordering (pubsub
+                        # state transitions rely on it).
+                        self._enqueue_push(a, b)
         except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
             pass
         finally:
             self.close()
+
+    def _enqueue_push(self, method: str, payload: Any) -> None:
+        if self._push_queue is None:
+            import queue
+            self._push_queue = queue.Queue()
+            threading.Thread(target=self._push_loop, daemon=True).start()
+        self._push_queue.put((method, payload))
+
+    def _push_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                method, payload = self._push_queue.get(timeout=1.0)
+            except Exception:
+                continue
+            try:
+                self._push_handler(method, payload)
+            except Exception:
+                logger.exception("push handler failed for %s", method)
 
     def _handle_request(self, msg_id: int, method: str, payload: Any) -> None:
         try:

@@ -66,3 +66,9 @@ class RuntimeEnvSetupError(RayTpuError):
 
 class PendingCallsLimitExceeded(RayTpuError):
     """Actor's max_pending_calls backpressure limit hit."""
+
+
+class SchedulingError(RayTpuError):
+    """A scheduling strategy can never be satisfied (placement group
+    removed, bundle index out of range, hard affinity to a dead node) —
+    permanent, not retried."""

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as exc
+from ray_tpu.exceptions import SchedulingError
 from ray_tpu._private import rpc
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import CONFIG
@@ -628,7 +629,13 @@ class CoreWorker:
                 try:
                     grant = self._lease_with_spillback(key, st)
                     conn = rpc.connect(tuple(grant["address"]))
-                except (ConnectionError, rpc.RemoteError, TimeoutError) as e:
+                except SchedulingError as e:
+                    # permanent strategy failure (pg removed, bad bundle
+                    # index, hard affinity to a dead node): fail the queued
+                    # tasks instead of respawning the loop forever
+                    self._fail_queued(st, exc.RayTpuError(str(e)))
+                    return
+                except (ConnectionError, rpc.RpcError, TimeoutError) as e:
                     # resources busy / raylet hiccup: if existing leases are
                     # draining the queue that's fine; otherwise keep trying
                     with self._sched_lock:
@@ -728,7 +735,8 @@ class CoreWorker:
                 info = self.gcs.call("get_placement_group",
                                      {"pg_id": pg_id}, timeout=10)
                 if info is None:
-                    raise rpc.RpcError(f"placement group {pg_id[:8]} removed")
+                    raise SchedulingError(
+                        f"placement group {pg_id[:8]} removed")
                 if info["state"] == "CREATED":
                     break
                 if time.monotonic() > deadline:
@@ -737,7 +745,7 @@ class CoreWorker:
                 time.sleep(0.05)
             placement = info["placement"]
             if idx >= len(placement) or idx < -1:
-                raise rpc.RpcError(
+                raise SchedulingError(
                     f"bundle index {idx} out of range for a "
                     f"{len(placement)}-bundle placement group")
             indices = [idx] if idx >= 0 else list(range(len(placement)))
@@ -760,7 +768,7 @@ class CoreWorker:
             if addr is None:
                 if strategy.get("soft"):
                     return None
-                raise rpc.RpcError(
+                raise SchedulingError(
                     f"node {strategy['node_id'][:8]} not found/alive")
             try:
                 return self._lease_at(addr, dict(base))
@@ -795,7 +803,7 @@ class CoreWorker:
                 except (rpc.RemoteError, ConnectionError, TimeoutError):
                     continue
             return None
-        raise rpc.RpcError(f"unknown scheduling strategy {kind!r}")
+        raise SchedulingError(f"unknown scheduling strategy {kind!r}")
 
     def _fail_queued(self, st, error: BaseException) -> None:
         with self._sched_lock:

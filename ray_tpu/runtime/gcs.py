@@ -192,6 +192,8 @@ class GcsServer:
     def _reschedule_broken_pgs(self, broken_pgs, node_id: str) -> None:
         for pg in broken_pgs:
             with self._lock:
+                if self._placement_groups.get(pg["pg_id"]) is not pg:
+                    continue   # removed concurrently; must not resurrect
                 placement = pg["placement"] or []
                 conns = {nid: self._node_conns.get(nid)
                          for nid in placement if nid != node_id}
@@ -375,12 +377,23 @@ class GcsServer:
                     else:
                         indices = [idx] if idx >= 0 \
                             else list(range(len(placement)))
-                        for i in indices:
+                        # an actor asking more than its bundle reserves can
+                        # never be placed — fail instead of retrying forever
+                        specs = pg["bundles"]
+                        fits = [i for i in indices
+                                if all(specs[i].get(r, 0) >= v
+                                       for r, v in need.items())]
+                        if not fits:
+                            fail_reason = (
+                                f"actor requires {need} but no bundle of "
+                                f"placement group {bundle[0][:8]} reserves "
+                                "that much")
+                        for i in fits:
                             node = self._nodes.get(placement[i])
                             if node is not None and node["alive"]:
                                 candidates.append(
                                     (node["node_id"], [bundle[0], i]))
-                        if not candidates:
+                        if not candidates and fail_reason is None:
                             return  # bundle nodes gone; pg will reschedule
             elif strategy.get("type") == "node_affinity":
                 node = self._nodes.get(strategy["node_id"])
@@ -429,6 +442,8 @@ class GcsServer:
                     "resources": entry["resources"],
                     "bundle": cand_bundle,
                 }, timeout=CONFIG.actor_creation_timeout_s)
+                with self._lock:
+                    entry.pop("retry_delay", None)
                 return
             except (rpc.RemoteError, ConnectionError, TimeoutError) as e:
                 last_err = e
@@ -438,6 +453,27 @@ class GcsServer:
                         "resources unavailable" not in str(e):
                     break
                 continue
+        if isinstance(last_err, rpc.RemoteError) and \
+                "resources unavailable" in str(last_err):
+            # candidate node(s) alive but momentarily out of resources
+            # (pinned affinity/bundle): park the actor pending and retry
+            # with backoff, like the no-feasible-node path, instead of
+            # failing it
+            logger.info("actor %s pending: %s", aid[:8], last_err)
+            with self._lock:
+                entry["dispatched"] = False
+                entry["node_id"] = None
+                delay = entry.get("retry_delay", 0.2)
+                entry["retry_delay"] = min(delay * 2, 5.0)
+                if strategy.get("type") == "node_affinity" \
+                        and strategy.get("soft"):
+                    # soft affinity: the pinned node is full — fall back to
+                    # the default policy rather than hammering that node
+                    entry["strategy"] = None
+            timer = threading.Timer(delay, self._schedule_actor, args=(aid,))
+            timer.daemon = True
+            timer.start()
+            return
         logger.warning("actor %s creation dispatch failed: %s",
                        aid[:8], last_err)
         self._on_actor_failure(aid, f"creation failed: {last_err}")
@@ -545,13 +581,18 @@ class GcsServer:
         raylets (reserve_bundle; rollback with return_bundle on failure)."""
         pgid = pg["pg_id"]
         with self._lock:
-            if pg["state"] != "PENDING":
+            if pg["state"] != "PENDING" or pg.get("placing"):
                 return pg["state"] == "CREATED"
+            if self._placement_groups.get(pgid) is not pg:
+                return False   # removed (or re-registered) concurrently
             nodes = [n for n in self._nodes.values() if n["alive"]]
             placement = self._pack_bundles(pg["bundles"], pg["strategy"],
                                            nodes)
             if placement is None:
                 return False
+            # single in-flight placer per group: concurrent attempts (client
+            # RPC vs node-registration retry) would double-reserve bundles
+            pg["placing"] = True
             # optimistic deduction on the GCS view so concurrent planners
             # don't double-book; raylet heartbeats reconcile it afterwards
             for bundle, node_id in zip(pg["bundles"], placement):
@@ -559,6 +600,14 @@ class GcsServer:
                 for r, v in bundle.items():
                     node["available"][r] = node["available"].get(r, 0) - v
             conns = {nid: self._node_conns.get(nid) for nid in placement}
+        try:
+            return self._reserve_pg_bundles(pg, placement, conns)
+        finally:
+            with self._lock:
+                pg["placing"] = False
+
+    def _reserve_pg_bundles(self, pg, placement, conns) -> bool:
+        pgid = pg["pg_id"]
         reserved = []
         failed = False
         for i, (bundle, nid) in enumerate(zip(pg["bundles"], placement)):
@@ -596,8 +645,24 @@ class GcsServer:
                                 node["available"].get(r, 0) + v
             return False
         with self._lock:
-            pg["state"] = "CREATED"
-            pg["placement"] = placement
+            if self._placement_groups.get(pgid) is not pg:
+                removed_during_placement = True
+            else:
+                removed_during_placement = False
+                pg["state"] = "CREATED"
+                pg["placement"] = placement
+        if removed_during_placement:
+            # remove_placement_group won the race: release what we reserved
+            for i, nid in reserved:
+                node_conn = conns.get(nid)
+                if node_conn is None:
+                    continue
+                try:
+                    node_conn.call("return_bundle",
+                                   {"pg_id": pgid, "index": i}, timeout=10)
+                except (ConnectionError, rpc.RpcError, TimeoutError):
+                    pass
+            return False
         self._publish("placement_group", {"pg_id": pgid, "state": "CREATED"})
         # actors parked on this group's bundles can now be scheduled
         with self._lock:

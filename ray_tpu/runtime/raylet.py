@@ -488,11 +488,6 @@ class Raylet:
         with self._res_lock:
             return pool_key in self._bundle_pools
 
-    def _with_res_release(self, need: Dict[str, float]) -> None:
-        with self._res_lock:
-            for r, v in need.items():
-                self.available[r] = self.available.get(r, 0) + v
-
     def _tpu_env(self, need: Dict[str, float]) -> Dict[str, str]:
         """Workers that lease no TPU must not grab libtpu (hard-part 4)."""
         if need.get("TPU", 0) > 0:

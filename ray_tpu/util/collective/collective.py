@@ -240,13 +240,16 @@ class _Group:
         flat = x.reshape(-1).astype(x.dtype, copy=True)
         chunks = np.array_split(flat, n)
         nxt, prv = (self.rank + 1) % n, (self.rank - 1) % n
+        # offset -1 vs allreduce's schedule so rank r finishes owning chunk
+        # r (each rank gets *its own* reduced shard, matching allgather's
+        # index==rank convention)
         for step in range(n - 1):
-            send_idx = (self.rank - step) % n
-            recv_idx = (self.rank - step - 1) % n
+            send_idx = (self.rank - step - 1) % n
+            recv_idx = (self.rank - step - 2) % n
             self._send(nxt, f"{tag}:{step}", chunks[send_idx])
             incoming = self._recv(prv, f"{tag}:{step}")
             chunks[recv_idx] = reducer(chunks[recv_idx], incoming)
-        return chunks[(self.rank + 1) % n]
+        return chunks[self.rank]
 
     def barrier(self) -> None:
         self.allreduce(np.zeros(1, np.float32))
