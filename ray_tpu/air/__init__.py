@@ -1,0 +1,17 @@
+"""AIR-style glue shared by train/tune/data/serve.
+
+Analog of /root/reference/python/ray/air (Checkpoint checkpoint.py:60,
+ScalingConfig config.py:79, FailureConfig :454, CheckpointConfig :513,
+RunConfig :642, session.py:41).
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig,  # noqa: F401
+                                RunConfig, ScalingConfig)
+from ray_tpu.air.result import Result  # noqa: F401
+from ray_tpu.air import session  # noqa: F401
+
+__all__ = [
+    "Checkpoint", "ScalingConfig", "FailureConfig", "CheckpointConfig",
+    "RunConfig", "Result", "session",
+]

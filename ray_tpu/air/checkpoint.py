@@ -1,0 +1,219 @@
+"""Checkpoint: the universal training-artifact currency.
+
+Analog of /root/reference/python/ray/air/checkpoint.py:60 — a checkpoint
+morphs between a dict, a directory, and bytes; here it additionally speaks
+JAX: ``from_jax``/``to_jax`` store pytrees of (possibly sharded) arrays via
+orbax when available, with a numpy fallback, so multi-host sharded state
+round-trips without gathering to one host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tarfile
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+_DICT_FILE = "checkpoint_dict.pkl"
+_JAX_DIR = "jax_state"
+_META_FILE = "checkpoint_meta.json"
+
+
+def _new_tmpdir() -> str:
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu_checkpoints")
+    os.makedirs(base, exist_ok=True)
+    return tempfile.mkdtemp(prefix="ckpt_", dir=base)
+
+
+class Checkpoint:
+    """An immutable training artifact, convertible between forms.
+
+    Construct with exactly one of ``from_dict``/``from_directory``/
+    ``from_bytes``/``from_jax``; consume with the matching ``to_*``.
+    Conversions are lazy and cached.
+    """
+
+    def __init__(self, *, _data: Optional[Dict[str, Any]] = None,
+                 _path: Optional[str] = None):
+        if (_data is None) == (_path is None):
+            raise ValueError("construct via from_dict/from_directory/"
+                             "from_bytes/from_jax")
+        self._data = _data
+        self._path = _path
+        self.id = uuid.uuid4().hex[:16]
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(_data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        if not os.path.isdir(path):
+            raise ValueError(f"not a directory: {path}")
+        return cls(_path=os.path.abspath(path))
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "Checkpoint":
+        return cls.from_dict(pickle.loads(blob))
+
+    @classmethod
+    def from_jax(cls, state: Any,
+                 metrics: Optional[Dict[str, Any]] = None) -> "Checkpoint":
+        """Save a JAX pytree (TrainState, params, ...) into a directory-form
+        checkpoint. Sharded ``jax.Array`` leaves are saved via orbax (ocdbt)
+        when available; otherwise fully-addressable arrays fall back to a
+        pickled numpy tree."""
+        path = _new_tmpdir()
+        save_jax_state(os.path.join(path, _JAX_DIR), state)
+        with open(os.path.join(path, _META_FILE), "w") as f:
+            json.dump({"metrics": metrics or {}, "format": "jax"}, f)
+        return cls.from_directory(path)
+
+    # -- converters --------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        p = os.path.join(self._path, _DICT_FILE)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                return pickle.load(f)
+        raise ValueError(
+            "directory checkpoint has no dict form; use to_directory/to_jax")
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = _new_tmpdir()
+        os.makedirs(path, exist_ok=True)
+        if self._path is not None:
+            if os.path.abspath(path) != self._path:
+                shutil.copytree(self._path, path, dirs_exist_ok=True)
+            return path
+        with open(os.path.join(path, _DICT_FILE), "wb") as f:
+            pickle.dump(self._data, f, protocol=pickle.HIGHEST_PROTOCOL)
+        return path
+
+    def to_bytes(self) -> bytes:
+        if self._data is not None:
+            return pickle.dumps(self._data, protocol=pickle.HIGHEST_PROTOCOL)
+        # tar the directory into bytes (small checkpoints / tests only)
+        import io
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            tar.add(self._path, arcname=".")
+        return pickle.dumps({"__dir_tar__": buf.getvalue()},
+                            protocol=pickle.HIGHEST_PROTOCOL)
+
+    def to_jax(self, target: Any = None, *, shardings: Any = None) -> Any:
+        """Restore a pytree saved with ``from_jax``. ``target`` (an abstract
+        or concrete pytree) fixes the structure; ``shardings`` (tree of
+        ``NamedSharding``) restores leaves already sharded over a mesh."""
+        path = self._resolve_dir()
+        return load_jax_state(os.path.join(path, _JAX_DIR), target,
+                              shardings=shardings)
+
+    def metrics(self) -> Dict[str, Any]:
+        try:
+            path = self._resolve_dir()
+        except ValueError:
+            return {}
+        p = os.path.join(path, _META_FILE)
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f).get("metrics", {})
+        return {}
+
+    def _resolve_dir(self) -> str:
+        if self._path is not None:
+            return self._path
+        if "__dir_tar__" in (self._data or {}):
+            path = _new_tmpdir()
+            import io
+            with tarfile.open(
+                    fileobj=io.BytesIO(self._data["__dir_tar__"])) as tar:
+                tar.extractall(path)
+            self._path = path
+            return path
+        raise ValueError("dict checkpoint has no directory form")
+
+    def __reduce__(self):
+        # Checkpoints travel through the object store as bytes; directory
+        # checkpoints re-materialize on the receiving host.
+        return (_checkpoint_from_bytes, (self.to_bytes(),))
+
+    def __repr__(self):
+        form = "dict" if self._data is not None else f"dir:{self._path}"
+        return f"Checkpoint({form})"
+
+
+def _checkpoint_from_bytes(blob: bytes) -> "Checkpoint":
+    return Checkpoint.from_bytes(blob)
+
+
+# -- JAX pytree (de)serialization ------------------------------------------
+
+def save_jax_state(path: str, state: Any) -> None:
+    """Orbax (ocdbt, async-capable, shard-aware) when importable; else a
+    pickled numpy tree (single-host only)."""
+    os.makedirs(path, exist_ok=True)
+    orbax_path = os.path.join(path, "orbax")
+    try:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(orbax_path, state, force=True)
+        return
+    except Exception:
+        # a partial orbax dir must not shadow the pickle fallback at load
+        shutil.rmtree(orbax_path, ignore_errors=True)
+    import jax
+    import numpy as np
+    leaves, treedef = jax.tree.flatten(state)
+    np_leaves = [np.asarray(x) if hasattr(x, "shape") else x for x in leaves]
+    with open(os.path.join(path, "state.pkl"), "wb") as f:
+        pickle.dump({"leaves": np_leaves, "treedef": treedef}, f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_jax_state(path: str, target: Any = None, *,
+                   shardings: Any = None) -> Any:
+    import jax
+
+    orbax_path = os.path.join(path, "orbax")
+    if os.path.exists(orbax_path):
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        restore_args = None
+        if shardings is not None:
+            restore_args = jax.tree.map(
+                lambda s: ocp.ArrayRestoreArgs(sharding=s), shardings)
+        restored = ckptr.restore(
+            orbax_path,
+            restore_args=restore_args) if restore_args is not None \
+            else ckptr.restore(orbax_path)
+        if target is not None:
+            # orbax returns dicts; rebuild the target structure
+            t_leaves, t_def = jax.tree.flatten(target)
+            r_leaves = jax.tree.leaves(restored)
+            if len(t_leaves) == len(r_leaves):
+                return jax.tree.unflatten(t_def, r_leaves)
+        return restored
+
+    with open(os.path.join(path, "state.pkl"), "rb") as f:
+        blob = pickle.load(f)
+    leaves, treedef = blob["leaves"], blob["treedef"]
+    if shardings is not None:
+        s_leaves = jax.tree.leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "mesh"))
+        leaves = [jax.device_put(x, s) if hasattr(x, "shape") else x
+                  for x, s in zip(leaves, s_leaves)]
+    restored = jax.tree.unflatten(treedef, leaves)
+    if target is not None:
+        t_leaves, t_def = jax.tree.flatten(target)
+        r_leaves = jax.tree.leaves(restored)
+        if len(t_leaves) == len(r_leaves):
+            return jax.tree.unflatten(t_def, r_leaves)
+    return restored

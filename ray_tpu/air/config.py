@@ -1,0 +1,90 @@
+"""Run/scaling/failure/checkpoint config dataclasses.
+
+Analog of /root/reference/python/ray/air/config.py (ScalingConfig :79,
+FailureConfig :454, CheckpointConfig :513, RunConfig :642) — extended with
+TPU-mesh fields: a ScalingConfig here describes hosts x chips and the
+logical device-mesh axes (data/fsdp/tensor/context/expert) the trainer
+builds over them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers (host processes) and how the device mesh is laid out.
+
+    ``num_workers`` is the number of trainer actors (one per host in a real
+    TPU pod; in tests, N processes sharing a CPU platform). ``mesh_shape``
+    maps logical axis name -> size; sizes must multiply to the total device
+    count visible to the worker group. ``use_tpu`` reserves the host's TPU
+    resource so only one group owns the chips (SURVEY.md §7 hard-part 4).
+    """
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    mesh_shape: Optional[Dict[str, int]] = None      # e.g. {"data":2,"fsdp":4}
+    devices_per_worker: Optional[int] = None
+    placement_strategy: str = "PACK"
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    @property
+    def mesh_axis_names(self) -> Tuple[str, ...]:
+        if not self.mesh_shape:
+            return ("data",)
+        return tuple(self.mesh_shape.keys())
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", float(self.devices_per_worker or 1))
+        return res
+
+    def as_placement_group_bundles(self) -> List[Dict[str, float]]:
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Cf. reference air/config.py:454. ``max_failures=-1`` retries forever."""
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Cf. reference air/config.py:513."""
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Cf. reference air/config.py:642."""
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1
+    log_to_file: bool = False
+
+    def __post_init__(self):
+        if self.failure_config is None:
+            self.failure_config = FailureConfig()
+        if self.checkpoint_config is None:
+            self.checkpoint_config = CheckpointConfig()
+        if self.storage_path is None:
+            self.storage_path = os.path.expanduser("~/ray_tpu_results")

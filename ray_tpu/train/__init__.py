@@ -1,0 +1,27 @@
+"""ray_tpu.train: trainers over worker-group actors + the pjit step library.
+
+Analog of /root/reference/python/ray/train (SURVEY.md §2.4): BaseTrainer /
+DataParallelTrainer drive a WorkerGroup; JaxTrainer is the TPU flagship
+(mesh + GSPMD shardings instead of DDP); TorchTrainer keeps CPU-torch
+parity; ray_tpu.train.step holds the sharded train-step builder.
+"""
+
+from ray_tpu.train.base_trainer import (BackendConfig,  # noqa: F401
+                                        BaseTrainer, DataParallelTrainer,
+                                        TrainingFailedError)
+from ray_tpu.train.jax_trainer import (JaxConfig, JaxTrainer,  # noqa: F401
+                                       get_mesh)
+from ray_tpu.train.step import (OptimizerConfig,  # noqa: F401
+                                lm_loss_fn, make_sharded_train)
+from ray_tpu.train.torch_trainer import (TorchConfig,  # noqa: F401
+                                         TorchTrainer, prepare_data_loader,
+                                         prepare_model)
+from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401
+
+__all__ = [
+    "BaseTrainer", "DataParallelTrainer", "BackendConfig",
+    "TrainingFailedError", "JaxTrainer", "JaxConfig", "get_mesh",
+    "TorchTrainer", "TorchConfig", "prepare_model", "prepare_data_loader",
+    "WorkerGroup", "TrainWorker", "make_sharded_train", "OptimizerConfig",
+    "lm_loss_fn",
+]

@@ -1,0 +1,237 @@
+"""BaseTrainer / DataParallelTrainer: the fit() driver loop.
+
+Analog of /root/reference/python/ray/train/base_trainer.py:339 (fit) and
+data_parallel_trainer.py:329 (training_loop). The reference routes fit()
+through Tune's TrialRunner even for a single run; here fit() drives the
+WorkerGroup directly and ``as_trainable()`` exposes the same run to the
+Tuner for sweeps — one mechanism, two entry points.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import CheckpointConfig, RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendConfig:
+    """Per-framework worker-group setup hooks (cf. reference
+    train/backend_config.py)."""
+
+    def on_start(self, worker_group: WorkerGroup,
+                 scaling: ScalingConfig) -> None:
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup) -> None:
+        pass
+
+
+class BaseTrainer:
+    def __init__(self, *,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def training_loop(self) -> None:
+        raise NotImplementedError
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """A Tune function-trainable that runs this trainer once per trial;
+        the trial config is merged into the train loop config."""
+        trainer = self
+
+        def _trainable(config: Dict[str, Any]):
+            from ray_tpu.air import session
+            import copy
+            t = copy.copy(trainer)
+            overrides = dict(config)
+            t._apply_trial_config(overrides)
+            for metrics, ckpt in t._iter_results():
+                session.report(metrics, checkpoint=ckpt)
+
+        _trainable.__name__ = type(self).__name__
+        return _trainable
+
+    def _apply_trial_config(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def _iter_results(self):
+        raise NotImplementedError
+
+
+class DataParallelTrainer(BaseTrainer):
+    """Runs ``train_loop_per_worker`` on a WorkerGroup, streaming reported
+    results back; rank-0's metrics are the canonical series."""
+
+    backend_config_cls = BackendConfig
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 backend_config: Optional[BackendConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config, datasets=datasets,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or self.backend_config_cls()
+
+    def _apply_trial_config(self, config: Dict[str, Any]) -> None:
+        merged = dict(self.train_loop_config)
+        merged.update(config.get("train_loop_config", config))
+        self.train_loop_config = merged
+
+    # -- driver loop -------------------------------------------------------
+    def _start_group(self, experiment_name: str) -> WorkerGroup:
+        sc = self.scaling_config
+        group = WorkerGroup(
+            num_workers=sc.num_workers,
+            resources_per_worker=sc.worker_resources(),
+            placement_strategy=sc.placement_strategy)
+        self.backend_config.on_start(group, sc)
+        shards = self._split_dataset(sc.num_workers)
+        trial_id = uuid.uuid4().hex[:8]
+        for rank, w in enumerate(group.workers):
+            w.start_training.remote(
+                self.train_loop_per_worker, self.train_loop_config,
+                experiment_name=experiment_name,
+                trial_id=trial_id,
+                checkpoint=self.resume_from_checkpoint,
+                dataset_shard=shards[rank])
+        return group
+
+    def _split_dataset(self, n: int) -> List[Any]:
+        ds = self.datasets.get("train")
+        if ds is None:
+            return [None] * n
+        if hasattr(ds, "split"):
+            try:
+                return ds.split(n, equal=True)
+            except TypeError:
+                return ds.split(n)
+        return [ds] * n
+
+    def _iter_results(self):
+        """Yield (metrics, checkpoint) pairs as workers report, with
+        FailureConfig-driven whole-group restarts on worker death."""
+        failure = self.run_config.failure_config
+        retries_left = failure.max_failures
+        name = self.run_config.name or type(self).__name__.lower()
+        while True:
+            group = self._start_group(name)
+            try:
+                yield from self._poll_group(group)
+                return
+            except TrainingFailedError:
+                if retries_left == 0:
+                    raise
+                if retries_left > 0:
+                    retries_left -= 1
+                time.sleep(1.0)
+            finally:
+                self.backend_config.on_shutdown(group)
+                group.shutdown()
+
+    def _poll_group(self, group: WorkerGroup):
+        import ray_tpu
+        done: List[Any] = [None] * len(group.workers)
+        while True:
+            round_items: List[Any] = []
+            for rank, w in enumerate(group.workers):
+                if done[rank] is not None:
+                    continue
+                try:
+                    item = ray_tpu.get(w.next_result.remote(timeout=10.0),
+                                       timeout=120.0)
+                except Exception as e:
+                    raise TrainingFailedError(
+                        f"worker {rank} died: {e}") from e
+                if item[0] == "error":
+                    raise TrainingFailedError(
+                        f"train loop failed on worker {rank}:\n{item[1]}")
+                if item[0] == "done":
+                    done[rank] = ("done", item[1])
+                elif item[0] == "result":
+                    round_items.append((rank, item[1], item[2]))
+            if all(d is not None for d in done):
+                return
+            for rank, metrics, ckpt in round_items:
+                if rank == 0:
+                    yield metrics, ckpt
+
+    def fit(self) -> Result:
+        ckpt_cfg = self.run_config.checkpoint_config or CheckpointConfig()
+        exp_dir = os.path.join(
+            self.run_config.storage_path,
+            self.run_config.name or f"{type(self).__name__}_"
+                                    f"{time.strftime('%Y%m%d_%H%M%S')}")
+        os.makedirs(exp_dir, exist_ok=True)
+        last_metrics: Dict[str, Any] = {}
+        kept: List[Any] = []   # (score, Checkpoint, metrics)
+        error: Optional[Exception] = None
+        try:
+            for metrics, ckpt in self._iter_results():
+                last_metrics = metrics
+                if ckpt is not None:
+                    kept.append((self._score(metrics, ckpt_cfg), ckpt,
+                                 metrics))
+                    kept = self._prune(kept, ckpt_cfg)
+                if self._should_stop(metrics):
+                    break
+        except TrainingFailedError as e:
+            error = e
+        best = kept[-1][1] if kept else None
+        if kept and ckpt_cfg.checkpoint_score_attribute:
+            ordered = sorted(kept, key=lambda t: t[0],
+                             reverse=ckpt_cfg.checkpoint_score_order == "max")
+            best = ordered[0][1]
+        # training failures come back on the Result (Tune-style); callers
+        # that want an exception check result.error
+        return Result(metrics=last_metrics, checkpoint=best, error=error,
+                      log_dir=exp_dir,
+                      best_checkpoints=[(c, m) for _, c, m in kept])
+
+    def _score(self, metrics: Dict[str, Any], cfg: CheckpointConfig):
+        attr = cfg.checkpoint_score_attribute
+        if attr and attr in metrics:
+            return metrics[attr]
+        return metrics.get("training_iteration", 0)
+
+    def _prune(self, kept: List[Any], cfg: CheckpointConfig) -> List[Any]:
+        if cfg.num_to_keep is None or len(kept) <= cfg.num_to_keep:
+            return kept
+        if cfg.checkpoint_score_attribute:
+            kept = sorted(kept, key=lambda t: t[0],
+                          reverse=cfg.checkpoint_score_order == "max")
+            return kept[:cfg.num_to_keep]
+        return kept[-cfg.num_to_keep:]
+
+    def _should_stop(self, metrics: Dict[str, Any]) -> bool:
+        stop = self.run_config.stop
+        if not stop:
+            return False
+        for k, v in stop.items():
+            if k in metrics and metrics[k] >= v:
+                return True
+        return False

@@ -1,0 +1,166 @@
+"""AIR glue + Train library tests (cf. reference python/ray/train/tests &
+air/tests — model: SURVEY.md §4 tier 2)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.air import (Checkpoint, CheckpointConfig, FailureConfig,
+                         RunConfig, ScalingConfig, session)
+
+
+def test_checkpoint_dict_roundtrip():
+    ckpt = Checkpoint.from_dict({"step": 3, "w": np.arange(4)})
+    d = ckpt.to_dict()
+    assert d["step"] == 3
+    np.testing.assert_array_equal(d["w"], np.arange(4))
+    blob = ckpt.to_bytes()
+    d2 = Checkpoint.from_bytes(blob).to_dict()
+    assert d2["step"] == 3
+
+
+def test_checkpoint_directory_roundtrip(tmp_path):
+    ckpt = Checkpoint.from_dict({"x": 1})
+    out = ckpt.to_directory(str(tmp_path / "c1"))
+    restored = Checkpoint.from_directory(out)
+    assert restored.to_dict() == {"x": 1}
+
+
+def test_checkpoint_jax_roundtrip():
+    import jax.numpy as jnp
+    state = {"params": {"w": jnp.ones((4, 4)), "b": jnp.zeros(4)},
+             "step": jnp.asarray(7)}
+    ckpt = Checkpoint.from_jax(state, metrics={"loss": 0.5})
+    restored = ckpt.to_jax()
+    leaves = sorted(str(k) for k in restored)
+    assert leaves
+    flat = restored["params"] if "params" in restored else restored
+    assert np.asarray(flat["w"]).shape == (4, 4)
+    assert ckpt.metrics()["loss"] == 0.5
+
+
+def test_checkpoint_jax_sharded_restore():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    from jax.experimental import mesh_utils
+
+    mesh = Mesh(mesh_utils.create_device_mesh((8,)), ("data",))
+    sh = NamedSharding(mesh, PartitionSpec("data"))
+    x = jax.device_put(jnp.arange(16.0), sh)
+    ckpt = Checkpoint.from_jax({"x": x})
+    restored = ckpt.to_jax(shardings={"x": sh})
+    rx = restored["x"]
+    np.testing.assert_allclose(np.asarray(rx), np.arange(16.0))
+    assert rx.sharding.is_equivalent_to(sh, rx.ndim)
+
+
+def test_scaling_config_resources():
+    sc = ScalingConfig(num_workers=2, use_tpu=True, devices_per_worker=4)
+    res = sc.worker_resources()
+    assert res["TPU"] == 4.0 and res["CPU"] == 1.0
+    assert len(sc.as_placement_group_bundles()) == 2
+
+
+def test_session_report_and_poll():
+    s = session.init_session(world_rank=0, world_size=2)
+    try:
+        import threading
+        def loop():
+            session.report({"loss": 1.0})
+            session.report({"loss": 0.5})
+        t = threading.Thread(target=loop)
+        t.start()
+        m1, _ = s.next_result(timeout=5)
+        m2, _ = s.next_result(timeout=5)
+        t.join(5)
+        assert m1["loss"] == 1.0 and m2["loss"] == 0.5
+        assert m2["training_iteration"] == 2
+        assert session.get_world_size() == 2
+    finally:
+        session.shutdown_session()
+
+
+def test_jax_trainer_single_worker_mesh(ray_start_regular):
+    """End-to-end: JaxTrainer runs a pjit step over a 2x4 mesh (8 virtual
+    devices), reports metrics + a checkpoint, fit() returns them."""
+    from ray_tpu.train import JaxTrainer, get_mesh
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = get_mesh()
+        assert dict(mesh.shape) == {"data": 2, "fsdp": 4}
+        w = jnp.ones((8, 8))
+        x = jax.device_put(
+            jnp.ones((8, 8)),
+            NamedSharding(mesh, PartitionSpec(("data", "fsdp"), None)))
+
+        @jax.jit
+        def step(w, x):
+            return (x @ w).mean()
+
+        for i in range(config["steps"]):
+            val = float(step(w, x))
+            session.report({"loss": val},
+                           checkpoint=Checkpoint.from_dict({"i": i}))
+
+    trainer = JaxTrainer(
+        loop, train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=1,
+                                     mesh_shape={"data": 2, "fsdp": 4}),
+        run_config=RunConfig(
+            checkpoint_config=CheckpointConfig(num_to_keep=2)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["loss"] == 8.0
+    assert result.metrics["training_iteration"] == 3
+    assert result.checkpoint is not None
+    assert result.checkpoint.to_dict()["i"] == 2
+    assert len(result.best_checkpoints) == 2
+
+
+def test_trainer_failure_propagates(ray_start_regular):
+    from ray_tpu.train import JaxTrainer, TrainingFailedError
+
+    def bad_loop(config):
+        raise ValueError("boom in train loop")
+
+    trainer = JaxTrainer(bad_loop,
+                         scaling_config=ScalingConfig(num_workers=1))
+    result = trainer.fit()
+    assert result.error is not None
+    assert "boom in train loop" in str(result.error)
+
+
+def test_trainer_stop_criterion(ray_start_regular):
+    from ray_tpu.train import JaxTrainer
+
+    def loop(config):
+        for i in range(100):
+            session.report({"score": i})
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(stop={"score": 5}))
+    result = trainer.fit()
+    assert result.metrics["score"] == 5
+
+
+def test_multi_worker_group(ray_start_regular):
+    """Two worker actors, no jax.distributed (each its own runtime) — the
+    group mechanics: rank-0 metrics stream, both loops complete."""
+    from ray_tpu.train import JaxConfig, JaxTrainer
+
+    def loop(config):
+        session.report({"rank": session.get_world_rank(),
+                        "ws": session.get_world_size()})
+
+    trainer = JaxTrainer(
+        loop, jax_config=JaxConfig(init_distributed=False),
+        scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rank"] == 0
+    assert result.metrics["ws"] == 2
