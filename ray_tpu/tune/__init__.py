@@ -1,0 +1,32 @@
+"""ray_tpu.tune: hyperparameter search over trial actors.
+
+Analog of /root/reference/python/ray/tune (SURVEY.md §2.4): Tuner.fit →
+TrialRunner event loop → trial actors; searchers + schedulers (ASHA, PBT,
+median stopping); JSONL/CSV logging; checkpoint-aware exploit/restore.
+"""
+
+from ray_tpu.air.result import Result  # noqa: F401
+from ray_tpu.tune.sample import (choice, grid_search, loguniform,  # noqa: F401
+                                 quniform, randint, randn, sample_from,
+                                 uniform)
+from ray_tpu.tune.schedulers import (ASHAScheduler,  # noqa: F401
+                                     FIFOScheduler, MedianStoppingRule,
+                                     PopulationBasedTraining,
+                                     TrialScheduler)
+from ray_tpu.tune.search import (BasicVariantGenerator,  # noqa: F401
+                                 ConcurrencyLimiter, HyperOptStyleSearch,
+                                 RandomSearch, Searcher)
+from ray_tpu.tune.trial import Trial  # noqa: F401
+from ray_tpu.tune.tuner import (ResultGrid, TuneConfig, TuneError,  # noqa: F401
+                                Tuner)
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "TuneError", "Trial",
+    "uniform", "loguniform", "quniform", "randint", "randn", "choice",
+    "sample_from", "grid_search",
+    "Searcher", "BasicVariantGenerator", "RandomSearch",
+    "ConcurrencyLimiter", "HyperOptStyleSearch",
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+    "Result",
+]

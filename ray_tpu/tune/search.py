@@ -1,0 +1,181 @@
+"""Search algorithms: suggest configs for new trials.
+
+Analog of /root/reference/python/ray/tune/search/ (BasicVariantGenerator
+basic_variant.py, Searcher searcher.py, ConcurrencyLimiter).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.sample import Domain, SampleFrom, generate_variants
+
+
+class Searcher:
+    """Suggest/observe interface (cf. reference search/searcher.py)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: Optional[str], mode: str,
+                              config: Dict[str, Any]) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str,
+                        result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid × random expansion of the param space, computed up front."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 num_samples: int = 1, seed: Optional[int] = None):
+        super().__init__()
+        self._space = space or {}
+        self._num_samples = num_samples
+        self._rng = random.Random(seed)
+        self._variants: Optional[List[Dict[str, Any]]] = None
+        self._idx = 0
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config:
+            self._space = config
+            self._variants = None
+        return super().set_search_properties(metric, mode, config)
+
+    def _ensure(self):
+        if self._variants is None:
+            self._variants = generate_variants(
+                self._space, self._rng, self._num_samples)
+
+    @property
+    def total_trials(self) -> int:
+        self._ensure()
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        self._ensure()
+        if self._idx >= len(self._variants):
+            return None
+        cfg = self._variants[self._idx]
+        self._idx += 1
+        return cfg
+
+
+class RandomSearch(Searcher):
+    """Endless random sampling (``num_samples`` enforced by the Tuner)."""
+
+    def __init__(self, space: Dict[str, Any], seed: Optional[int] = None):
+        super().__init__()
+        self._space = space
+        self._rng = random.Random(seed)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        return generate_variants(self._space, self._rng, 1)[0]
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions (cf. reference ConcurrencyLimiter)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        super().set_search_properties(metric, mode, config)
+        return self.searcher.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._live) >= self.max_concurrent:
+            return None   # back off; runner retries later
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class HyperOptStyleSearch(Searcher):
+    """A dependency-free TPE-flavored searcher: explores randomly for
+    ``n_initial`` trials, then samples candidates and picks the one closest
+    (in normalized param space) to the best-quartile trials and farthest
+    from the worst — a cheap stand-in for the reference's hyperopt/optuna
+    integrations (which need external packages).
+    """
+
+    def __init__(self, space: Dict[str, Any], metric: str, mode: str = "max",
+                 n_initial: int = 10, n_candidates: int = 24,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self._space = space
+        self._rng = random.Random(seed)
+        self._n_initial = n_initial
+        self._n_candidates = n_candidates
+        self._observations: List[Any] = []   # (config, score)
+        self._pending: Dict[str, Dict[str, Any]] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._observations) < self._n_initial:
+            cfg = generate_variants(self._space, self._rng, 1)[0]
+        else:
+            cands = [generate_variants(self._space, self._rng, 1)[0]
+                     for _ in range(self._n_candidates)]
+            cfg = max(cands, key=self._score_candidate)
+        self._pending[trial_id] = cfg
+        return cfg
+
+    def _numeric_keys(self):
+        return [k for k, v in self._space.items()
+                if isinstance(v, Domain) and not isinstance(v, SampleFrom)]
+
+    def _score_candidate(self, cand: Dict[str, Any]) -> float:
+        obs = sorted(self._observations, key=lambda o: o[1],
+                     reverse=self.mode == "max")
+        k = max(1, len(obs) // 4)
+        good, bad = obs[:k], obs[-k:]
+        keys = self._numeric_keys()
+
+        def dist(a, b):
+            d = 0.0
+            for key in keys:
+                va, vb = a.get(key), b.get(key)
+                if isinstance(va, (int, float)) and isinstance(vb,
+                                                               (int, float)):
+                    scale = abs(va) + abs(vb) + 1e-9
+                    d += ((va - vb) / scale) ** 2
+                elif va != vb:
+                    d += 1.0
+            return d ** 0.5
+
+        good_d = min(dist(cand, g) for g, _ in good)
+        bad_d = min(dist(cand, b) for b, _ in bad)
+        return bad_d - good_d
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is not None and result and self.metric in result \
+                and not error:
+            self._observations.append((cfg, result[self.metric]))

@@ -1,0 +1,158 @@
+"""Tune library tests (model: reference python/ray/tune/tests)."""
+
+import random
+
+import pytest
+
+from ray_tpu.air import Checkpoint, RunConfig, session
+from ray_tpu.tune import (ASHAScheduler, BasicVariantGenerator,
+                          ConcurrencyLimiter, HyperOptStyleSearch,
+                          MedianStoppingRule, PopulationBasedTraining,
+                          TuneConfig, Tuner, choice, grid_search, loguniform,
+                          randint, uniform)
+from ray_tpu.tune.sample import generate_variants
+
+
+def test_generate_variants_grid_and_samples():
+    space = {"lr": grid_search([0.1, 0.01]), "wd": uniform(0, 1),
+             "layers": grid_search([2, 4]), "fixed": 7}
+    variants = generate_variants(space, random.Random(0), num_samples=3)
+    assert len(variants) == 12   # 2 x 2 grid x 3 samples
+    lrs = {v["lr"] for v in variants}
+    assert lrs == {0.1, 0.01}
+    assert all(0 <= v["wd"] <= 1 and v["fixed"] == 7 for v in variants)
+
+
+def test_generate_variants_nested():
+    space = {"opt": {"lr": grid_search([1, 2]), "b1": 0.9},
+             "n": randint(1, 10)}
+    vs = generate_variants(space, random.Random(0))
+    assert len(vs) == 2
+    assert {v["opt"]["lr"] for v in vs} == {1, 2}
+    assert all(v["opt"]["b1"] == 0.9 for v in vs)
+
+
+def test_domains_sample_ranges():
+    rng = random.Random(0)
+    assert 1e-4 <= loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+    assert choice(["a", "b"]).sample(rng) in ("a", "b")
+    assert 0 <= randint(0, 5).sample(rng) < 5
+
+
+def test_concurrency_limiter():
+    base = BasicVariantGenerator({"x": uniform(0, 1)}, num_samples=5)
+    lim = ConcurrencyLimiter(base, max_concurrent=2)
+    a = lim.suggest("t1")
+    b = lim.suggest("t2")
+    assert a is not None and b is not None
+    assert lim.suggest("t3") is None           # capped
+    lim.on_trial_complete("t1", {"x": 1.0})
+    assert lim.suggest("t3") is not None       # freed
+
+
+def test_asha_stops_bad_trials():
+    sched = ASHAScheduler(metric="score", mode="max", grace_period=1,
+                          reduction_factor=2, max_t=100)
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    class R:
+        trials = []
+
+    # descending scores: once the rung fills, worse-than-cutoff trials stop
+    decisions = {}
+    for i, score in enumerate([4.0, 3.0, 2.0, 1.0]):
+        t = T(f"t{i}")
+        decisions[i] = sched.on_trial_result(
+            R, t, {"training_iteration": 1, "score": score})
+    assert decisions[0] == "CONTINUE"      # rung not filled yet
+    assert decisions[2] == "STOP"
+    assert decisions[3] == "STOP"
+
+
+def test_median_stopping():
+    sched = MedianStoppingRule(metric="score", mode="max", grace_period=0,
+                               min_samples_required=2)
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    good1, good2, bad = T("g1"), T("g2"), T("b")
+    for it in range(3):
+        sched.on_trial_result(None, good1, {"training_iteration": it,
+                                            "score": 10.0})
+        sched.on_trial_result(None, good2, {"training_iteration": it,
+                                            "score": 8.0})
+    d = sched.on_trial_result(None, bad, {"training_iteration": 3,
+                                          "score": 1.0})
+    assert d == "STOP"
+
+
+def test_hyperopt_style_search_learns():
+    space = {"x": uniform(-1, 1)}
+    s = HyperOptStyleSearch(space, metric="score", mode="max", n_initial=4,
+                            seed=0)
+    # feed observations: score = x (higher x better)
+    for i in range(8):
+        cfg = s.suggest(f"t{i}")
+        s.on_trial_complete(f"t{i}", {"score": cfg["x"]})
+    later = [s.suggest(f"u{i}")["x"] for i in range(10)]
+    assert sum(later) / len(later) > 0   # biased toward good region
+
+
+def test_tuner_grid_experiment(ray_start_regular):
+    def trainable(config):
+        for i in range(3):
+            session.report({"score": config["lr"] * (i + 1)})
+
+    tuner = Tuner(trainable,
+                  param_space={"lr": grid_search([1.0, 2.0, 3.0])},
+                  tune_config=TuneConfig(metric="score", mode="max"))
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.metrics["config"]["lr"] == 3.0
+    assert best.metrics["score"] == 9.0
+    assert not grid.errors
+
+
+def test_tuner_with_checkpoints_and_failure(ray_start_regular):
+    def flaky(config):
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["i"] + 1 if ckpt else 0
+        for i in range(start, 4):
+            if i == 2 and start == 0:
+                raise RuntimeError("transient")
+            session.report({"i": i},
+                           checkpoint=Checkpoint.from_dict({"i": i}))
+
+    from ray_tpu.air import FailureConfig
+    tuner = Tuner(flaky, param_space={},
+                  tune_config=TuneConfig(metric="i", mode="max"),
+                  run_config=RunConfig(
+                      failure_config=FailureConfig(max_failures=2)))
+    grid = tuner.fit()
+    assert not grid.errors
+    # resumed from checkpoint i=1 and reached i=3
+    assert grid.get_best_result().metrics["i"] == 3
+
+
+def test_tuner_asha_integration(ray_start_regular):
+    def trainable(config):
+        for i in range(10):
+            session.report({"score": config["q"] * (i + 1)})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"q": grid_search([1.0, 5.0, 10.0, 20.0])},
+        tune_config=TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=4,
+            scheduler=ASHAScheduler(metric="score", mode="max",
+                                    grace_period=2, reduction_factor=2,
+                                    max_t=10)))
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["config"]["q"] == 20.0
