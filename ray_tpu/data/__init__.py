@@ -1,0 +1,87 @@
+"""ray_tpu.data: distributed datasets over object-store blocks.
+
+Analog of /root/reference/python/ray/data (SURVEY.md §2.4): read_* → lazy
+plan → map/shuffle/sort/split → iter_batches/to_* consumption; blocks are
+objects, transforms are tasks/actor pools, splits feed per-host trainer
+shards.
+"""
+
+from typing import Any, List, Optional
+
+from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
+from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,  # noqa: F401
+                                  ExecutionPlan, GroupedData,
+                                  TaskPoolStrategy)
+from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
+from ray_tpu.data import datasource as _dsrc
+
+
+def _from_tasks(tasks) -> Dataset:
+    return Dataset(ExecutionPlan(read_tasks=tasks))
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return _from_tasks(_dsrc.range_tasks(n, parallelism))
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    return _from_tasks(_dsrc.items_tasks(list(items), parallelism))
+
+
+def from_pandas(dfs) -> Dataset:
+    import ray_tpu
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return Dataset(ExecutionPlan(
+        block_refs=[ray_tpu.put(df) for df in dfs]))
+
+
+def from_numpy(arrays) -> Dataset:
+    import numpy as np
+
+    import ray_tpu
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    return Dataset(ExecutionPlan(block_refs=[
+        ray_tpu.put({"data": np.asarray(a)}) for a in arrays]))
+
+
+def from_arrow(tables) -> Dataset:
+    import ray_tpu
+    if not isinstance(tables, list):
+        tables = [tables]
+    return Dataset(ExecutionPlan(
+        block_refs=[ray_tpu.put(t) for t in tables]))
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    return _from_tasks(_dsrc.parquet_tasks(paths, columns))
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    return _from_tasks(_dsrc.csv_tasks(paths, **kwargs))
+
+
+def read_json(paths, *, lines: bool = True) -> Dataset:
+    return _from_tasks(_dsrc.json_tasks(paths, lines))
+
+
+def read_numpy(paths) -> Dataset:
+    return _from_tasks(_dsrc.numpy_tasks(paths))
+
+
+def read_text(paths) -> Dataset:
+    return _from_tasks(_dsrc.text_tasks(paths))
+
+
+def read_binary_files(paths) -> Dataset:
+    return _from_tasks(_dsrc.binary_tasks(paths))
+
+
+__all__ = [
+    "Dataset", "DatasetPipeline", "BlockAccessor", "Block",
+    "TaskPoolStrategy", "ActorPoolStrategy", "GroupedData",
+    "range", "from_items", "from_pandas", "from_numpy", "from_arrow",
+    "read_parquet", "read_csv", "read_json", "read_numpy", "read_text",
+    "read_binary_files",
+]

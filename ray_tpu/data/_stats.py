@@ -1,0 +1,37 @@
+"""Per-stage timing stats (cf. reference data/_internal/stats.py)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List
+
+_lock = threading.Lock()
+_timings: Dict[str, List[float]] = {}
+
+
+@contextlib.contextmanager
+def timed(stage: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            _timings.setdefault(stage, []).append(dt)
+
+
+def summary() -> str:
+    with _lock:
+        lines = []
+        for stage, times in _timings.items():
+            lines.append(
+                f"stage {stage}: n={len(times)} total={sum(times):.3f}s "
+                f"mean={sum(times) / len(times):.3f}s max={max(times):.3f}s")
+    return "\n".join(lines) or "(no stages executed)"
+
+
+def reset() -> None:
+    with _lock:
+        _timings.clear()

@@ -1,0 +1,198 @@
+"""Datasources: read tasks and file writers.
+
+Analog of /root/reference/python/ray/data/read_api.py (read_parquet :429)
+and data/datasource/*: a read produces ReadTasks — serializable callables,
+one per output block — that the execution plan submits as remote tasks, so
+IO parallelizes across the cluster and blocks land in the object store on
+the node that read them.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class ReadTask:
+    """One unit of input IO → one block."""
+
+    def __init__(self, fn: Callable[[], Any],
+                 num_rows: Optional[int] = None,
+                 input_files: Optional[List[str]] = None):
+        self._fn = fn
+        self.num_rows = num_rows
+        self.input_files = input_files or []
+
+    def __call__(self):
+        return self._fn()
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pat = os.path.join(p, "**", f"*{suffix}" if suffix else "*")
+            out.extend(sorted(_glob.glob(pat, recursive=True)))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    files = [f for f in out if os.path.isfile(f)]
+    if not files:
+        raise FileNotFoundError(f"no input files for {paths!r}")
+    return files
+
+
+# -- readers (each returns a list of ReadTasks) -----------------------------
+
+def range_tasks(n: int, parallelism: int) -> List[ReadTask]:
+    parallelism = max(1, min(parallelism, n or 1))
+    step = (n + parallelism - 1) // parallelism
+    tasks = []
+    for start in range(0, n, step):
+        end = min(start + step, n)
+        tasks.append(ReadTask(
+            lambda s=start, e=end: {"id": np.arange(s, e)},
+            num_rows=end - start))
+    return tasks
+
+
+def items_tasks(items: List[Any], parallelism: int) -> List[ReadTask]:
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    step = (len(items) + parallelism - 1) // parallelism
+    tasks = []
+    for start in range(0, len(items), step):
+        chunk = items[start:start + step]
+        tasks.append(ReadTask(lambda c=chunk: list(c), num_rows=len(chunk)))
+    return tasks
+
+
+def parquet_tasks(paths, columns: Optional[List[str]] = None) -> List[ReadTask]:
+    files = _expand_paths(paths, ".parquet")
+
+    def read_one(path: str):
+        import pyarrow.parquet as pq
+        return pq.read_table(path, columns=columns)
+
+    return [ReadTask(lambda p=f: read_one(p), input_files=[f])
+            for f in files]
+
+
+def csv_tasks(paths, **pandas_kwargs) -> List[ReadTask]:
+    files = _expand_paths(paths, ".csv")
+
+    def read_one(path: str):
+        import pandas as pd
+        return pd.read_csv(path, **pandas_kwargs)
+
+    return [ReadTask(lambda p=f: read_one(p), input_files=[f])
+            for f in files]
+
+
+def json_tasks(paths, lines: bool = True) -> List[ReadTask]:
+    files = _expand_paths(paths, ".json")
+
+    def read_one(path: str):
+        import json
+        rows = []
+        with open(path) as fh:
+            if lines:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+            else:
+                data = json.load(fh)
+                rows = data if isinstance(data, list) else [data]
+        return rows
+
+    return [ReadTask(lambda p=f: read_one(p), input_files=[f])
+            for f in files]
+
+
+def numpy_tasks(paths) -> List[ReadTask]:
+    files = _expand_paths(paths, ".npy")
+    return [ReadTask(lambda p=f: {"data": np.load(p)}, input_files=[f])
+            for f in files]
+
+
+def text_tasks(paths) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def read_one(path: str):
+        with open(path) as fh:
+            return [line.rstrip("\n") for line in fh]
+
+    return [ReadTask(lambda p=f: read_one(p), input_files=[f])
+            for f in files]
+
+
+def binary_tasks(paths) -> List[ReadTask]:
+    files = _expand_paths(paths)
+
+    def read_one(path: str):
+        with open(path, "rb") as fh:
+            return [{"path": path, "bytes": fh.read()}]
+
+    return [ReadTask(lambda p=f: read_one(p), input_files=[f])
+            for f in files]
+
+
+# -- writers (run as remote tasks, one file per block) ----------------------
+
+def write_parquet_block(block, path: str, idx: int) -> str:
+    from ray_tpu.data.block import BlockAccessor
+    import pyarrow.parquet as pq
+    os.makedirs(path, exist_ok=True)
+    table = BlockAccessor.for_block(block).to_arrow()
+    out = os.path.join(path, f"part-{idx:05d}.parquet")
+    pq.write_table(table, out)
+    return out
+
+
+def write_csv_block(block, path: str, idx: int) -> str:
+    from ray_tpu.data.block import BlockAccessor
+    os.makedirs(path, exist_ok=True)
+    df = BlockAccessor.for_block(block).to_pandas()
+    out = os.path.join(path, f"part-{idx:05d}.csv")
+    df.to_csv(out, index=False)
+    return out
+
+
+def write_json_block(block, path: str, idx: int) -> str:
+    import json
+
+    from ray_tpu.data.block import BlockAccessor
+    os.makedirs(path, exist_ok=True)
+    acc = BlockAccessor.for_block(block)
+    out = os.path.join(path, f"part-{idx:05d}.json")
+    with open(out, "w") as fh:
+        for row in acc.iter_rows():
+            fh.write(json.dumps(_jsonable(row)) + "\n")
+    return out
+
+
+def write_numpy_block(block, path: str, idx: int, column: str) -> str:
+    from ray_tpu.data.block import BlockAccessor
+    os.makedirs(path, exist_ok=True)
+    arrs = BlockAccessor.for_block(block).to_numpy()
+    out = os.path.join(path, f"part-{idx:05d}.npy")
+    np.save(out, arrs[column])
+    return out
+
+
+def _jsonable(row: Any) -> Any:
+    if isinstance(row, dict):
+        return {k: _jsonable(v) for k, v in row.items()}
+    if isinstance(row, np.ndarray):
+        return row.tolist()
+    if isinstance(row, (np.integer,)):
+        return int(row)
+    if isinstance(row, (np.floating,)):
+        return float(row)
+    return row

@@ -1,0 +1,226 @@
+"""Data library tests (model: reference python/ray/data/tests)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+from ray_tpu.data import ActorPoolStrategy
+from ray_tpu.data.block import BlockAccessor
+
+
+def test_block_accessor_formats():
+    import pandas as pd
+    simple = BlockAccessor.for_block([{"a": 1}, {"a": 2}])
+    assert simple.num_rows() == 2
+    np.testing.assert_array_equal(simple.to_numpy()["a"], [1, 2])
+
+    npb = BlockAccessor.for_block({"x": np.arange(4)})
+    assert npb.num_rows() == 4
+    assert npb.slice(1, 3)["x"].tolist() == [1, 2]
+
+    df = BlockAccessor.for_block(pd.DataFrame({"c": [1, 2, 3]}))
+    assert df.num_rows() == 3
+    assert list(df.iter_rows()) == [{"c": 1}, {"c": 2}, {"c": 3}]
+
+
+def test_range_count_take(ray_start_regular):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+    assert ds.num_blocks() == 4
+
+
+def test_map_batches_fusion(ray_start_regular):
+    ds = rd.range(32, parallelism=2) \
+        .map_batches(lambda b: {"id": b["id"] * 2}, batch_format="numpy") \
+        .map_batches(lambda b: {"id": b["id"] + 1}, batch_format="numpy")
+    rows = ds.take_all()
+    assert rows[0] == {"id": 1} and rows[-1] == {"id": 63}
+
+
+def test_map_filter_flat_map(ray_start_regular):
+    ds = rd.from_items(list(range(10)), parallelism=2)
+    doubled = ds.map(lambda x: x * 2)
+    assert doubled.take_all() == [x * 2 for x in range(10)]
+    evens = ds.filter(lambda x: x % 2 == 0)
+    assert evens.take_all() == [0, 2, 4, 6, 8]
+    repeated = ds.flat_map(lambda x: [x, x])
+    assert repeated.count() == 20
+
+
+def test_actor_pool_strategy(ray_start_regular):
+    class AddConst:
+        def __init__(self, c=100):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.c}
+
+    ds = rd.range(16, parallelism=2).map_batches(
+        AddConst, batch_format="numpy",
+        compute=ActorPoolStrategy(1, 2))
+    rows = ds.take_all()
+    assert rows[0]["id"] == 100
+
+
+def test_repartition(ray_start_regular):
+    ds = rd.range(20, parallelism=5).repartition(2)
+    assert ds.num_blocks() in (2, 5)      # hint before exec
+    refs = ds.get_internal_block_refs()
+    assert len(refs) == 2
+    assert ds.count() == 20
+
+
+def test_random_shuffle(ray_start_regular):
+    ds = rd.range(50, parallelism=4).random_shuffle(seed=42)
+    rows = [r["id"] for r in ds.take_all()]
+    assert sorted(rows) == list(range(50))
+    assert rows != list(range(50))
+
+
+def test_sort(ray_start_regular):
+    import random
+    items = list(range(40))
+    random.Random(0).shuffle(items)
+    ds = rd.from_items(items, parallelism=4).sort()
+    assert ds.take_all() == sorted(items)
+    ds_desc = rd.from_items(items, parallelism=4).sort(descending=True)
+    assert ds_desc.take_all() == sorted(items, reverse=True)
+
+
+def test_sort_by_key(ray_start_regular):
+    rows = [{"k": i % 5, "v": i} for i in range(20)]
+    ds = rd.from_items(rows, parallelism=3).sort(key="k")
+    out = ds.take_all()
+    assert [r["k"] for r in out] == sorted(r["k"] for r in rows)
+
+
+def test_groupby_aggregate(ray_start_regular):
+    rows = [{"g": i % 3, "v": i} for i in range(12)]
+    ds = rd.from_items(rows, parallelism=3)
+    sums = {r["g"]: r["sum(v)"]
+            for r in ds.groupby("g").sum("v").take_all()}
+    expect = {}
+    for r in rows:
+        expect[r["g"]] = expect.get(r["g"], 0) + r["v"]
+    assert sums == expect
+    means = ds.groupby("g").mean("v").take_all()
+    assert len(means) == 3
+
+
+def test_split_and_split_at_indices(ray_start_regular):
+    ds = rd.range(30, parallelism=6)
+    shards = ds.split(3)
+    assert len(shards) == 3
+    assert sum(s.count() for s in shards) == 30
+    equal = ds.split(3, equal=True)
+    assert [s.count() for s in equal] == [10, 10, 10]
+    a, b = ds.split_at_indices([12])
+    assert a.count() == 12 and b.count() == 18
+    assert a.take_all()[-1] == {"id": 11}
+
+
+def test_iter_batches(ray_start_regular):
+    ds = rd.range(25, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=10, batch_format="numpy"))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [10, 10, 5]
+    all_ids = np.concatenate([b["id"] for b in batches])
+    np.testing.assert_array_equal(np.sort(all_ids), np.arange(25))
+
+
+def test_iter_batches_shuffled(ray_start_regular):
+    ds = rd.range(40, parallelism=2)
+    batches = list(ds.iter_batches(batch_size=8, batch_format="numpy",
+                                   local_shuffle_buffer_size=16,
+                                   local_shuffle_seed=7))
+    ids = np.concatenate([b["id"] for b in batches])
+    assert sorted(ids.tolist()) == list(range(40))
+    assert ids.tolist() != list(range(40))
+
+
+def test_zip_union_limit(ray_start_regular):
+    a = rd.range(8, parallelism=2)
+    b = rd.range(8, parallelism=2).map_batches(
+        lambda x: {"id2": x["id"] * 10}, batch_format="numpy")
+    z = a.zip(b)
+    rows = z.take_all()
+    assert rows[3]["id"] == 3 and rows[3]["id2"] == 30
+    u = a.union(a)
+    assert u.count() == 16
+    assert a.limit(3).count() == 3
+
+
+def test_file_roundtrips(ray_start_regular, tmp_path):
+    ds = rd.range(12, parallelism=3)
+    pq_dir = str(tmp_path / "pq")
+    ds.write_parquet(pq_dir)
+    back = rd.read_parquet(pq_dir)
+    assert back.count() == 12
+    assert sorted(r["id"] for r in back.take_all()) == list(range(12))
+
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    back_csv = rd.read_csv(csv_dir)
+    assert back_csv.count() == 12
+
+    js_dir = str(tmp_path / "js")
+    ds.write_json(js_dir)
+    back_js = rd.read_json(js_dir)
+    assert back_js.count() == 12
+
+
+def test_from_pandas_numpy(ray_start_regular):
+    import pandas as pd
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    ds = rd.from_pandas(df)
+    assert ds.count() == 3
+    assert ds.to_pandas()["a"].tolist() == [1, 2, 3]
+
+    nds = rd.from_numpy(np.arange(6).reshape(3, 2))
+    arrs = nds.to_numpy()
+    assert arrs["data"].shape == (3, 2)
+
+
+def test_train_test_split(ray_start_regular):
+    ds = rd.range(20, parallelism=2)
+    train, test = ds.train_test_split(0.25)
+    assert train.count() == 15 and test.count() == 5
+
+
+def test_pipeline_window_repeat(ray_start_regular):
+    ds = rd.range(20, parallelism=4)
+    pipe = ds.window(blocks_per_window=2)
+    windows = list(pipe.iter_datasets())
+    assert len(windows) == 2
+    assert pipe.count() == 20
+
+    rep = ds.repeat(2)
+    assert rep.count() == 40
+
+    mapped = ds.window(blocks_per_window=2).map_batches(
+        lambda b: {"id": b["id"] + 1}, batch_format="numpy")
+    first = next(mapped.iter_rows())
+    assert first == {"id": 1}
+
+
+def test_dataset_feeds_trainer(ray_start_regular):
+    """Dataset shard → session.get_dataset_shard → iter_batches inside a
+    JaxTrainer loop (the AIR ingest path)."""
+    from ray_tpu.air import ScalingConfig, session
+    from ray_tpu.train import JaxTrainer
+
+    ds = rd.range(32, parallelism=4)
+
+    def loop(config):
+        shard = session.get_dataset_shard("train")
+        total = 0
+        for batch in shard.iter_batches(batch_size=8, batch_format="numpy"):
+            total += int(batch["id"].sum())
+        session.report({"total": total})
+
+    result = JaxTrainer(loop,
+                        scaling_config=ScalingConfig(num_workers=1),
+                        datasets={"train": ds}).fit()
+    assert result.error is None
+    assert result.metrics["total"] == sum(range(32))
