@@ -1,0 +1,200 @@
+"""Algorithm + AlgorithmConfig: the RL training driver.
+
+Analog of /root/reference/rllib/algorithms/algorithm.py:142 (a Trainable;
+training_step :1284) and algorithm_config.py:124 (fluent builder). The
+TPU-native shape (SURVEY.md §2.6): CPU rollout actors sample; the learner
+is a pjit step over the device mesh (data-sharded batch), so gradient
+collectives ride ICI inside the compiled step instead of NCCL.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rl.worker_set import WorkerSet
+
+
+class AlgorithmConfig:
+    """Fluent builder: ``PPOConfig().environment("CartPole-v1")
+    .rollouts(num_rollout_workers=2).training(lr=5e-5).build()``."""
+
+    algo_class: Optional[type] = None
+
+    def __init__(self):
+        self.env_spec: Any = None
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 1
+        self.rollout_fragment_length = 200
+        self.recreate_failed_workers = True
+        self.gamma = 0.99
+        self.lam = 0.95
+        self.lr = 5e-5
+        self.train_batch_size = 4000
+        self.sgd_minibatch_size = 128
+        self.num_sgd_iter = 30
+        self.grad_clip = 0.5
+        self.hidden = (256, 256)
+        self.seed: Optional[int] = None
+        self.mesh_shape: Optional[Dict[str, int]] = None
+        self.extra: Dict[str, Any] = {}
+
+    # -- fluent sections (reference names) --------------------------------
+    def environment(self, env=None, **kwargs) -> "AlgorithmConfig":
+        if env is not None:
+            self.env_spec = env
+        self.extra.update(kwargs)
+        return self
+
+    def rollouts(self, *, num_rollout_workers: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None,
+                 recreate_failed_workers: Optional[bool] = None,
+                 **kwargs) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if recreate_failed_workers is not None:
+            self.recreate_failed_workers = recreate_failed_workers
+        self.extra.update(kwargs)
+        return self
+
+    env_runners = rollouts   # newer reference API name
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def resources(self, *, mesh_shape: Optional[Dict[str, int]] = None,
+                  **kwargs) -> "AlgorithmConfig":
+        if mesh_shape is not None:
+            self.mesh_shape = mesh_shape
+        self.extra.update(kwargs)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None,
+                  **kwargs) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        self.extra.update(kwargs)
+        return self
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("use a concrete config (PPOConfig, ...)")
+        return self.algo_class(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items() if k != "extra"}
+
+
+class Algorithm:
+    """Base driver: owns the WorkerSet + learner; subclasses implement
+    training_step() returning a result dict."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        if config.env_spec is None:
+            raise ValueError("config.environment(env) is required")
+        self.workers = WorkerSet(
+            config.env_spec,
+            num_workers=max(config.num_rollout_workers, 1),
+            worker_kwargs=dict(
+                num_envs=config.num_envs_per_worker,
+                rollout_fragment_length=config.rollout_fragment_length,
+                gamma=config.gamma, lam=config.lam,
+                hidden=config.hidden, seed=config.seed),
+            recreate_failed_workers=config.recreate_failed_workers)
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._episode_history: List[Dict[str, float]] = []
+        self.setup_learner()
+        self.workers.sync_weights(self.get_weights())
+
+    # -- subclass surface --------------------------------------------------
+    def setup_learner(self) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def get_weights(self) -> Any:
+        raise NotImplementedError
+
+    def set_weights(self, weights: Any) -> None:
+        raise NotImplementedError
+
+    # -- public API --------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        result = self.training_step()
+        self.iteration += 1
+        metrics = self._collect_episode_metrics()
+        result.update(metrics)
+        result["training_iteration"] = self.iteration
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.perf_counter() - t0
+        result["num_worker_restarts"] = self.workers.num_restarts
+        return result
+
+    def _collect_episode_metrics(self) -> Dict[str, Any]:
+        for eps in self.workers.foreach_worker("get_metrics"):
+            self._episode_history.extend(eps)
+        self._episode_history = self._episode_history[-100:]
+        if not self._episode_history:
+            return {"episode_reward_mean": float("nan"),
+                    "episode_len_mean": float("nan"), "episodes_total": 0}
+        rewards = [e["episode_reward"] for e in self._episode_history]
+        lens = [e["episode_len"] for e in self._episode_history]
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episode_reward_max": float(np.max(rewards)),
+                "episode_reward_min": float(np.min(rewards)),
+                "episode_len_mean": float(np.mean(lens)),
+                "episodes_total": len(self._episode_history)}
+
+    def save(self) -> Checkpoint:
+        return Checkpoint.from_dict({
+            "weights": self.get_weights(),
+            "iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+        })
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        d = checkpoint.to_dict()
+        self.set_weights(d["weights"])
+        self.iteration = d.get("iteration", 0)
+        self._timesteps_total = d.get("timesteps_total", 0)
+        self.workers.sync_weights(self.get_weights())
+
+    def stop(self) -> None:
+        self.workers.stop()
+
+    @classmethod
+    def as_trainable(cls, config: AlgorithmConfig) -> Callable:
+        """Tune integration: a function trainable running this algorithm."""
+        def _trainable(trial_config: Dict[str, Any]):
+            from ray_tpu.air import session
+            import copy
+            cfg = copy.deepcopy(config)
+            cfg.training(**trial_config)
+            algo = cfg.algo_class(cfg)
+            try:
+                ckpt = session.get_checkpoint()
+                if ckpt is not None:
+                    algo.restore(ckpt)
+                while True:
+                    result = algo.train()
+                    session.report(result, checkpoint=algo.save())
+            finally:
+                algo.stop()
+        return _trainable

@@ -1,0 +1,185 @@
+"""IMPALA: async actor-learner training with V-trace.
+
+Analog of /root/reference/rllib/algorithms/impala/impala.py:528
+(training_step: async rollout queue → LearnerThread
+rllib/execution/learner_thread.py:17) with the V-trace correction of
+vtrace_torch.py (Espeholt et al. 2018). Rollout actors free-run with
+stale weights; each completed fragment triggers one learner step and a
+weight push back to that actor only — no global sync barrier.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as M
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import Box, make_env
+
+
+def vtrace(target_logp, behavior_logp, rewards, values, bootstrap_value,
+           discounts, rho_bar: float = 1.0, c_bar: float = 1.0):
+    """V-trace targets/advantages over a [T, B] fragment (time-major).
+
+    discounts: gamma * (1 - done) per step. Returns (vs, pg_advantages).
+    """
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(rho_bar, rhos)
+    cs = jnp.minimum(c_bar, rhos)
+    values_t_plus_1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (
+        rewards + discounts * values_t_plus_1 - values)
+
+    def scan_fn(carry, xs):
+        delta, discount, c = xs
+        carry = delta + discount * c * carry
+        return carry, carry
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs), reverse=True)
+    vs = vs_minus_v + values
+    vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = clipped_rhos * (rewards + discounts * vs_t_plus_1 - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.vtrace_rho_bar = 1.0
+        self.vtrace_c_bar = 1.0
+        self.batches_per_step = 8
+        self.rollout_fragment_length = 50
+        self.algo_class = Impala
+
+
+class Impala(Algorithm):
+    def setup_learner(self) -> None:
+        cfg: ImpalaConfig = self.config
+        probe = make_env(cfg.env_spec)
+        continuous = isinstance(probe.action_space, Box)
+        act_dim = int(np.prod(probe.action_space.shape)) if continuous \
+            else probe.action_space.n
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        probe.close()
+        self.model = M.ActorCritic(action_dim=act_dim,
+                                   hidden=tuple(cfg.hidden),
+                                   continuous=continuous)
+        self.params = self.model.init(
+            jax.random.PRNGKey(cfg.seed or 0),
+            jnp.zeros((1, obs_dim)))["params"]
+        self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                              optax.rmsprop(cfg.lr, decay=0.99))
+        self.opt_state = self.tx.init(self.params)
+        self._inflight: Dict[Any, int] = {}   # ref -> worker index
+
+        if continuous:
+            logp_fn, ent_fn = M.diag_gaussian_logp, M.diag_gaussian_entropy
+        else:
+            logp_fn, ent_fn = M.categorical_logp, M.categorical_entropy
+        model, gamma = self.model, cfg.gamma
+        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+        rho_bar, c_bar = cfg.vtrace_rho_bar, cfg.vtrace_c_bar
+        tx = self.tx
+
+        def loss_fn(params, batch):
+            T, B = batch[SB.REWARDS].shape
+            obs = batch[SB.OBS]
+            flat_obs = obs.reshape((T * B,) + obs.shape[2:])
+            logits, values = model.apply({"params": params}, flat_obs)
+            logits = logits.reshape((T, B) + logits.shape[1:])
+            values = values.reshape(T, B)
+            boot_logits, boot_value = model.apply(
+                {"params": params}, batch["bootstrap_obs"])
+            target_logp = logp_fn(logits, batch[SB.ACTIONS])
+            discounts = gamma * (1.0 - batch[SB.TERMINATEDS]
+                                 .astype(jnp.float32))
+            vs, pg_adv = vtrace(target_logp, batch[SB.ACTION_LOGP],
+                                batch[SB.REWARDS], values, boot_value,
+                                discounts, rho_bar, c_bar)
+            pg_loss = -(target_logp * pg_adv).mean()
+            vf_loss = 0.5 * jnp.square(vs - values).mean()
+            entropy = ent_fn(logits).mean()
+            total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        @jax.jit
+        def sgd_step(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._sgd_step = sgd_step
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+    def _submit(self, idx: int) -> None:
+        ref = self.workers.workers[idx].sample_time_major.remote()
+        self._inflight[ref] = idx
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+        cfg: ImpalaConfig = self.config
+        # keep one fragment in flight per worker
+        live = set(self._inflight.values())
+        for i in range(len(self.workers.workers)):
+            if i not in live:
+                self._submit(i)
+        aux_last: Dict[str, Any] = {}
+        processed = 0
+        steps = 0
+        while processed < cfg.batches_per_step:
+            ready, _ = ray_tpu.wait(list(self._inflight.keys()),
+                                    num_returns=1, timeout=60.0)
+            if not ready:
+                break
+            ref = ready[0]
+            idx = self._inflight.pop(ref)
+            try:
+                fragment = ray_tpu.get(ref, timeout=30.0)
+            except Exception:
+                # worker died mid-fragment: replace it and move on
+                self.workers.workers[idx] = self.workers._make(idx)
+                self.workers.num_restarts += 1
+                self._submit(idx)
+                continue
+            batch = {k: jnp.asarray(v) for k, v in fragment.items()}
+            self.params, self.opt_state, aux = self._sgd_step(
+                self.params, self.opt_state, batch)
+            aux_last = aux
+            steps += fragment[SB.REWARDS].size
+            processed += 1
+            # push fresh weights only to the actor we just consumed
+            try:
+                self.workers.workers[idx].set_weights.remote(
+                    self.get_weights())
+            except Exception:
+                pass
+            self._submit(idx)
+        self._timesteps_total += steps
+        info = {k: float(v) for k, v in aux_last.items()}
+        info["batches_processed"] = processed
+        return {"info": info}
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()

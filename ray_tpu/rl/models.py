@@ -1,0 +1,77 @@
+"""Policy/value networks in flax.
+
+Analog of the reference's ModelCatalog defaults
+(/root/reference/rllib/models/catalog.py: fcnet 2x256 tanh) — but flax
+modules whose apply is jitted into the learner step; the same params run
+on CPU in rollout workers and sharded on the TPU mesh in the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ActorCritic(nn.Module):
+    """Shared-nothing actor & critic MLP towers (rllib default
+    vf_share_layers=False)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+    continuous: bool = False
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.tanh(nn.Dense(h, name=f"pi_{i}")(x))
+        if self.continuous:
+            mean = nn.Dense(self.action_dim, name="pi_mean")(x)
+            log_std = self.param("pi_log_std", nn.initializers.zeros,
+                                 (self.action_dim,))
+            logits = jnp.concatenate(
+                [mean, jnp.broadcast_to(log_std, mean.shape)], axis=-1)
+        else:
+            logits = nn.Dense(self.action_dim, name="pi_out")(x)
+        v = obs
+        for i, h in enumerate(self.hidden):
+            v = nn.tanh(nn.Dense(h, name=f"vf_{i}")(v))
+        value = nn.Dense(1, name="vf_out")(v)[..., 0]
+        return logits, value
+
+
+def categorical_sample(rng, logits):
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def categorical_logp(logits, actions):
+    logp = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logp, actions[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+
+
+def categorical_entropy(logits):
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def diag_gaussian_sample(rng, logits):
+    mean, log_std = jnp.split(logits, 2, axis=-1)
+    noise = jax.random.normal(rng, mean.shape)
+    return mean + noise * jnp.exp(log_std)
+
+
+def diag_gaussian_logp(logits, actions):
+    mean, log_std = jnp.split(logits, 2, axis=-1)
+    var = jnp.exp(2 * log_std)
+    logp = -0.5 * (jnp.square(actions - mean) / var
+                   + 2 * log_std + jnp.log(2 * jnp.pi))
+    return jnp.sum(logp, axis=-1)
+
+
+def diag_gaussian_entropy(logits):
+    _, log_std = jnp.split(logits, 2, axis=-1)
+    return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)

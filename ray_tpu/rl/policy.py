@@ -1,0 +1,79 @@
+"""JaxPolicy: action computation on rollout CPUs, shared param tree.
+
+Analog of the reference Policy abstraction
+(/root/reference/rllib/policy/policy.py + torch_policy_v2.py): the policy
+owns params + distribution fns; rollout workers call compute_actions on
+host CPU (jitted, tiny batches), the learner updates the same tree on the
+device mesh and broadcasts numpy weights back.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models as M
+from ray_tpu.rl.env import Box, Discrete
+
+
+class JaxPolicy:
+    def __init__(self, observation_space, action_space,
+                 hidden=(256, 256), seed: int = 0):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.continuous = isinstance(action_space, Box)
+        if self.continuous:
+            act_dim = int(np.prod(action_space.shape))
+        else:
+            act_dim = action_space.n
+        self.model = M.ActorCritic(action_dim=act_dim, hidden=tuple(hidden),
+                                   continuous=self.continuous)
+        obs_dim = int(np.prod(observation_space.shape))
+        self._rng = jax.random.PRNGKey(seed)
+        self.params = self.model.init(
+            self._rng, jnp.zeros((1, obs_dim)))["params"]
+
+        if self.continuous:
+            sample_fn, logp_fn = M.diag_gaussian_sample, M.diag_gaussian_logp
+        else:
+            sample_fn, logp_fn = M.categorical_sample, M.categorical_logp
+
+        @jax.jit
+        def _compute(params, rng, obs):
+            logits, value = self.model.apply({"params": params}, obs)
+            actions = sample_fn(rng, logits)
+            logp = logp_fn(logits, actions)
+            return actions, logp, value
+
+        @jax.jit
+        def _deterministic(params, obs):
+            logits, value = self.model.apply({"params": params}, obs)
+            if self.continuous:
+                mean, _ = jnp.split(logits, 2, axis=-1)
+                return mean, value
+            return jnp.argmax(logits, axis=-1), value
+
+        self._compute = _compute
+        self._deterministic = _deterministic
+
+    def compute_actions(self, obs: np.ndarray, *, explore: bool = True
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """returns (actions, logp, vf_preds) as numpy."""
+        obs = jnp.asarray(obs)
+        if explore:
+            self._rng, key = jax.random.split(self._rng)
+            a, logp, v = self._compute(self.params, key, obs)
+        else:
+            a, v = self._deterministic(self.params, obs)
+            logp = jnp.zeros(a.shape[0])
+        return np.asarray(a), np.asarray(logp), np.asarray(v)
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)

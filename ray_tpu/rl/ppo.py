@@ -1,0 +1,158 @@
+"""PPO: synchronous on-policy training with a mesh-sharded learner.
+
+Analog of /root/reference/rllib/algorithms/ppo/ppo.py:311 (training_step:
+synchronous_parallel_sample → train over minibatch epochs) with the loss
+of ppo_torch_policy.py (clipped surrogate + clipped value loss + entropy).
+TPU-native: the SGD step is one jitted function whose batch is sharded
+over the mesh's data axis — XLA inserts the gradient psum over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as M
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import Box, make_env
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.kl_target = 0.01
+        self.lr = 3e-4
+        self.algo_class = PPO
+
+
+class PPO(Algorithm):
+    def setup_learner(self) -> None:
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        cfg: PPOConfig = self.config
+        probe = make_env(cfg.env_spec)
+        continuous = isinstance(probe.action_space, Box)
+        act_dim = int(np.prod(probe.action_space.shape)) if continuous \
+            else probe.action_space.n
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        probe.close()
+        self.model = M.ActorCritic(action_dim=act_dim,
+                                   hidden=tuple(cfg.hidden),
+                                   continuous=continuous)
+        self.continuous = continuous
+        params = self.model.init(jax.random.PRNGKey(cfg.seed or 0),
+                                 jnp.zeros((1, obs_dim)))["params"]
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr))
+
+        # learner mesh: data-parallel over every local device
+        n_dev = jax.device_count()
+        shape = cfg.mesh_shape or {"data": n_dev}
+        sizes = tuple(shape.values())
+        self.mesh = Mesh(mesh_utils.create_device_mesh(sizes),
+                         tuple(shape.keys()))
+        self.batch_sharding = NamedSharding(self.mesh, P("data"))
+        repl = NamedSharding(self.mesh, P())
+        params = jax.device_put(params, repl)
+        self.opt_state = jax.device_put(self.tx.init(params), repl)
+        self.params = params
+
+        if continuous:
+            logp_fn, ent_fn = M.diag_gaussian_logp, M.diag_gaussian_entropy
+        else:
+            logp_fn, ent_fn = M.categorical_logp, M.categorical_entropy
+        model = self.model
+        clip, vf_clip = cfg.clip_param, cfg.vf_clip_param
+        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+        tx = self.tx
+
+        def loss_fn(params, batch):
+            logits, values = model.apply({"params": params}, batch[SB.OBS])
+            logp = logp_fn(logits, batch[SB.ACTIONS])
+            ratio = jnp.exp(logp - batch[SB.ACTION_LOGP])
+            adv = batch[SB.ADVANTAGES]
+            adv = (adv - adv.mean()) / jnp.maximum(adv.std(), 1e-4)
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            vf_targets = batch[SB.VALUE_TARGETS]
+            vf_err = jnp.square(values - vf_targets)
+            vf_clipped = batch[SB.VF_PREDS] + jnp.clip(
+                values - batch[SB.VF_PREDS], -vf_clip, vf_clip)
+            vf_err2 = jnp.square(vf_clipped - vf_targets)
+            vf_loss = 0.5 * jnp.maximum(vf_err, vf_err2)
+            entropy = ent_fn(logits)
+            total = (-surr + vf_coeff * vf_loss - ent_coeff * entropy).mean()
+            kl = (batch[SB.ACTION_LOGP] - logp).mean()
+            return total, {"policy_loss": -surr.mean(),
+                           "vf_loss": vf_loss.mean(),
+                           "entropy": entropy.mean(), "kl": kl}
+
+        @jax.jit
+        def sgd_step(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            aux["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, aux
+
+        self._sgd_step = sgd_step
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(self.mesh, P())
+        self.params = jax.device_put(
+            jax.tree.map(jnp.asarray, weights), repl)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: PPOConfig = self.config
+        # 1. synchronous parallel sample (rollout_ops.py:21)
+        batches = self.workers.foreach_worker("sample")
+        train_batch = SampleBatch.concat_samples(batches)
+        while train_batch.count < cfg.train_batch_size:
+            more = self.workers.foreach_worker("sample")
+            if not more:
+                break
+            train_batch = SampleBatch.concat_samples([train_batch] + more)
+        self._timesteps_total += train_batch.count
+
+        # 2. minibatch SGD epochs on the mesh (train_ops.py:26)
+        n_shards = self.mesh.devices.size
+        mb = max(cfg.sgd_minibatch_size, n_shards)
+        mb -= mb % n_shards   # divisible by the data axis
+        aux_last: Dict[str, Any] = {}
+        n_updates = 0
+        for epoch in range(cfg.num_sgd_iter):
+            for minibatch in train_batch.minibatches(
+                    mb, seed=None if cfg.seed is None
+                    else cfg.seed + self.iteration * 100 + epoch):
+                device_batch = {
+                    k: jax.device_put(v, self.batch_sharding)
+                    for k, v in minibatch.items() if k != SB.EPS_ID}
+                self.params, self.opt_state, aux = self._sgd_step(
+                    self.params, self.opt_state, device_batch)
+                n_updates += 1
+            aux_last = aux
+        # 3. broadcast fresh weights to rollout workers
+        self.workers.sync_weights(self.get_weights())
+        info = {k: float(v) for k, v in aux_last.items()}
+        info["num_sgd_updates"] = n_updates
+        info["train_batch_size"] = train_batch.count
+        return {"info": info}

@@ -1,0 +1,142 @@
+"""RolloutWorker: env stepping on CPU hosts.
+
+Analog of /root/reference/rllib/evaluation/rollout_worker.py:157
+(sample() :869): vectorized envs stepped with the current policy, GAE
+postprocessing per episode fragment, metrics tracked per completed
+episode. Runs as a CPU actor; the TPU never blocks on env code.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.env import VectorEnv
+from ray_tpu.rl.policy import JaxPolicy
+from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
+
+
+class RolloutWorker:
+    def __init__(self, env_spec, *, num_envs: int = 1,
+                 rollout_fragment_length: int = 200,
+                 gamma: float = 0.99, lam: float = 0.95,
+                 hidden=(256, 256),
+                 worker_index: int = 0, seed: Optional[int] = None):
+        # rollout actors must never grab the TPU
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        self.worker_index = worker_index
+        seed = (seed if seed is not None else 1234) + worker_index * 1000
+        self.vec = VectorEnv(env_spec, num_envs, seed=seed)
+        self.policy = JaxPolicy(self.vec.observation_space,
+                                self.vec.action_space, hidden=hidden,
+                                seed=seed)
+        self.fragment = rollout_fragment_length
+        self.gamma, self.lam = gamma, lam
+        self._obs = self.vec.reset()
+        self._eps_id = np.arange(num_envs) + worker_index * 1_000_000
+        self._next_eps = num_envs + worker_index * 1_000_000
+        self._ep_rewards = np.zeros(num_envs)
+        self._ep_lens = np.zeros(num_envs, np.int64)
+        self._completed: List[Dict[str, float]] = []
+
+    # -- weights sync ------------------------------------------------------
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self) -> SampleBatch:
+        n_envs = self.vec.num_envs
+        T = self.fragment
+        cols: Dict[str, List[np.ndarray]] = {
+            SB.OBS: [], SB.ACTIONS: [], SB.REWARDS: [], SB.TERMINATEDS: [],
+            SB.TRUNCATEDS: [], SB.VF_PREDS: [], SB.ACTION_LOGP: [],
+            SB.EPS_ID: []}
+        for _ in range(T):
+            actions, logp, values = self.policy.compute_actions(self._obs)
+            next_obs, rewards, terms, truncs, infos = self.vec.step(actions)
+            cols[SB.OBS].append(self._obs)
+            cols[SB.ACTIONS].append(actions)
+            cols[SB.REWARDS].append(rewards)
+            cols[SB.TERMINATEDS].append(terms)
+            cols[SB.TRUNCATEDS].append(truncs)
+            cols[SB.VF_PREDS].append(values)
+            cols[SB.ACTION_LOGP].append(logp)
+            cols[SB.EPS_ID].append(self._eps_id.copy())
+            self._ep_rewards += rewards
+            self._ep_lens += 1
+            for i in range(n_envs):
+                if terms[i] or truncs[i]:
+                    self._completed.append(
+                        {"episode_reward": float(self._ep_rewards[i]),
+                         "episode_len": int(self._ep_lens[i])})
+                    self._ep_rewards[i] = 0.0
+                    self._ep_lens[i] = 0
+                    self._eps_id[i] = self._next_eps
+                    self._next_eps += 1
+            self._obs = next_obs
+
+        # bootstrap values for fragments cut mid-episode (or truncated)
+        _, _, last_values = self.policy.compute_actions(self._obs)
+        # stack to [T, N] then split per env for GAE over time order
+        stacked = {k: np.stack(v) for k, v in cols.items()}
+        per_env = []
+        for i in range(n_envs):
+            env_batch = SampleBatch(
+                {k: stacked[k][:, i] for k in stacked.keys()})
+            pieces = env_batch.split_by_episode()
+            for j, piece in enumerate(pieces):
+                last = j == len(pieces) - 1
+                terminated = bool(piece[SB.TERMINATEDS][-1])
+                boot = 0.0 if terminated else (
+                    float(last_values[i]) if last else 0.0)
+                # non-last pieces always end terminated or truncated; a
+                # truncated middle piece bootstraps from its own final vf
+                if not last and not terminated:
+                    boot = float(piece[SB.VF_PREDS][-1])
+                per_env.append(compute_gae(piece, gamma=self.gamma,
+                                           lam=self.lam, last_value=boot))
+        return SampleBatch.concat_samples(per_env)
+
+    def sample_time_major(self) -> Dict[str, np.ndarray]:
+        """[T, N]-shaped fragment without GAE — IMPALA's V-trace does its
+        own off-policy correction on the learner (cf. rllib vtrace)."""
+        n_envs = self.vec.num_envs
+        cols: Dict[str, List[np.ndarray]] = {
+            SB.OBS: [], SB.ACTIONS: [], SB.REWARDS: [], SB.TERMINATEDS: [],
+            SB.ACTION_LOGP: []}
+        for _ in range(self.fragment):
+            actions, logp, _ = self.policy.compute_actions(self._obs)
+            next_obs, rewards, terms, truncs, _ = self.vec.step(actions)
+            cols[SB.OBS].append(self._obs)
+            cols[SB.ACTIONS].append(actions)
+            cols[SB.REWARDS].append(rewards)
+            cols[SB.TERMINATEDS].append(np.logical_or(terms, truncs))
+            cols[SB.ACTION_LOGP].append(logp)
+            self._ep_rewards += rewards
+            self._ep_lens += 1
+            for i in range(n_envs):
+                if terms[i] or truncs[i]:
+                    self._completed.append(
+                        {"episode_reward": float(self._ep_rewards[i]),
+                         "episode_len": int(self._ep_lens[i])})
+                    self._ep_rewards[i] = 0.0
+                    self._ep_lens[i] = 0
+            self._obs = next_obs
+        out = {k: np.stack(v) for k, v in cols.items()}
+        out["bootstrap_obs"] = self._obs.copy()
+        return out
+
+    def get_metrics(self) -> List[Dict[str, float]]:
+        out, self._completed = self._completed, []
+        return out
+
+    def ping(self) -> bool:
+        return True

@@ -1,0 +1,62 @@
+"""WorkerSet: fault-tolerant gang of rollout actors.
+
+Analog of /root/reference/rllib/evaluation/worker_set.py:77 with the
+restart behavior of FaultTolerantActorManager
+(rllib/utils/actor_manager.py:187): dead rollout workers are replaced
+in-place and the round continues with the survivors' samples.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.rl.rollout_worker import RolloutWorker
+
+
+class WorkerSet:
+    def __init__(self, env_spec, *, num_workers: int, worker_kwargs: dict,
+                 recreate_failed_workers: bool = True):
+        import ray_tpu
+        self._env_spec = env_spec
+        self._kwargs = dict(worker_kwargs)
+        self._recreate = recreate_failed_workers
+        self._cls = ray_tpu.remote(num_cpus=1)(RolloutWorker)
+        self.workers = [
+            self._make(i) for i in range(num_workers)]
+        self.num_restarts = 0
+
+    def _make(self, index: int):
+        return self._cls.remote(self._env_spec, worker_index=index,
+                                **self._kwargs)
+
+    def foreach_worker(self, method: str, *args,
+                       timeout: float = 120.0, **kwargs) -> List[Any]:
+        """Call ``method`` on all workers; replace any that died (their
+        result is dropped this round)."""
+        import ray_tpu
+        refs = [(i, getattr(w, method).remote(*args, **kwargs))
+                for i, w in enumerate(self.workers)]
+        out = []
+        for i, ref in refs:
+            try:
+                out.append(ray_tpu.get(ref, timeout=timeout))
+            except Exception:
+                if not self._recreate:
+                    raise
+                self.workers[i] = self._make(i)
+                self.num_restarts += 1
+        return out
+
+    def sync_weights(self, weights) -> None:
+        import ray_tpu
+        wref = ray_tpu.put(weights)
+        self.foreach_worker("set_weights", wref)
+
+    def stop(self) -> None:
+        import ray_tpu
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

@@ -1,0 +1,168 @@
+"""RL library tests (model: reference rllib/tests + per-algo tests)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import (CartPoleEnv, PendulumEnv, PrioritizedReplayBuffer,
+                        ReplayBuffer, SampleBatch, VectorEnv, compute_gae)
+from ray_tpu.rl.sample_batch import (ACTION_LOGP, ACTIONS, ADVANTAGES, EPS_ID,
+                                     OBS, REWARDS, TERMINATEDS, TRUNCATEDS,
+                                     VALUE_TARGETS, VF_PREDS)
+
+
+def test_cartpole_env_api():
+    env = CartPoleEnv()
+    obs, info = env.reset(seed=0)
+    assert obs.shape == (4,)
+    total = 0
+    for _ in range(10):
+        obs, r, term, trunc, _ = env.step(env.action_space.sample(
+            np.random.default_rng(0)))
+        total += r
+        if term or trunc:
+            break
+    assert total > 0
+
+
+def test_vector_env_autoreset():
+    vec = VectorEnv("CartPole-v1", 3, seed=0)
+    obs = vec.reset()
+    assert obs.shape == (3, 4)
+    for _ in range(300):
+        obs, r, terms, truncs, infos = vec.step([1, 1, 1])
+    assert obs.shape == (3, 4)   # auto-reset keeps batch alive
+
+
+def test_sample_batch_ops():
+    b1 = SampleBatch({"a": np.arange(5), "b": np.ones(5)})
+    b2 = SampleBatch({"a": np.arange(3), "b": np.zeros(3)})
+    cat = SampleBatch.concat_samples([b1, b2])
+    assert cat.count == 8
+    mbs = list(cat.minibatches(4, epochs=2, seed=0))
+    assert len(mbs) == 4 and all(m.count == 4 for m in mbs)
+
+
+def test_gae_simple():
+    batch = SampleBatch({
+        REWARDS: np.array([1.0, 1.0, 1.0], np.float32),
+        VF_PREDS: np.array([0.5, 0.5, 0.5], np.float32),
+        TERMINATEDS: np.array([False, False, True]),
+    })
+    out = compute_gae(batch, gamma=0.99, lam=0.95)
+    assert ADVANTAGES in out and VALUE_TARGETS in out
+    # terminal step: adv = r - v = 0.5
+    np.testing.assert_allclose(out[ADVANTAGES][-1], 0.5, rtol=1e-5)
+    assert out[ADVANTAGES][0] > out[ADVANTAGES][-1]
+
+
+def test_vtrace_on_policy_reduces_to_returns():
+    """With target==behavior and rho/c uncapped effect absent, vs should
+    equal discounted returns when values are zero."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import vtrace
+    T, B = 4, 2
+    logp = jnp.zeros((T, B))
+    rewards = jnp.ones((T, B))
+    values = jnp.zeros((T, B))
+    boot = jnp.zeros(B)
+    discounts = jnp.full((T, B), 0.9)
+    vs, pg_adv = vtrace(logp, logp, rewards, values, boot, discounts)
+    expected_v0 = 1 + 0.9 * (1 + 0.9 * (1 + 0.9 * 1))
+    np.testing.assert_allclose(np.asarray(vs)[0], expected_v0, rtol=1e-5)
+
+
+def test_replay_buffers():
+    buf = ReplayBuffer(100, seed=0)
+    buf.add(SampleBatch({"x": np.arange(150)}))
+    assert len(buf) == 100
+    s = buf.sample(32)
+    assert s.count == 32
+
+    pbuf = PrioritizedReplayBuffer(64, seed=0)
+    pbuf.add(SampleBatch({"x": np.arange(10)}))
+    s = pbuf.sample(8)
+    assert "weights" in s and "batch_indexes" in s
+    pbuf.update_priorities(s["batch_indexes"], np.full(8, 5.0))
+    s2 = pbuf.sample(8)
+    assert s2.count == 8
+
+
+def test_rollout_worker_local():
+    from ray_tpu.rl.rollout_worker import RolloutWorker
+    w = RolloutWorker("CartPole-v1", num_envs=2,
+                      rollout_fragment_length=50, seed=0)
+    batch = w.sample()
+    assert batch.count == 100
+    assert ADVANTAGES in batch and ACTION_LOGP in batch
+    tm = w.sample_time_major()
+    assert tm[OBS].shape == (50, 2, 4)
+    assert tm["bootstrap_obs"].shape == (2, 4)
+    metrics = w.get_metrics()
+    assert isinstance(metrics, list)
+
+
+def test_ppo_cartpole_learns(ray_start_regular):
+    """PPO improves CartPole reward within a few iterations (tuned target
+    in the reference: 150 within 100k steps; we check clear learning
+    progress in a short budget)."""
+    from ray_tpu.rl import PPOConfig
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_fragment_length=100)
+            .training(train_batch_size=400, sgd_minibatch_size=128,
+                      num_sgd_iter=6, lr=3e-4, entropy_coeff=0.01)
+            .debugging(seed=0)
+            .build())
+    try:
+        first = algo.train()
+        best = -np.inf
+        for _ in range(7):
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+        assert result["timesteps_total"] >= 3200
+        assert best > first["episode_reward_mean"] + 10, \
+            f"no learning: first={first['episode_reward_mean']} best={best}"
+        ckpt = algo.save()
+        algo.restore(ckpt)
+    finally:
+        algo.stop()
+
+
+def test_impala_cartpole_runs(ray_start_regular):
+    from ray_tpu.rl import ImpalaConfig
+    algo = (ImpalaConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_fragment_length=25)
+            .training(batches_per_step=4, lr=5e-4)
+            .debugging(seed=0)
+            .build())
+    try:
+        r1 = algo.train()
+        r2 = algo.train()
+        assert r2["timesteps_total"] > r1["timesteps_total"] > 0
+        assert "total_loss" in r2["info"]
+    finally:
+        algo.stop()
+
+
+def test_worker_set_fault_tolerance(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.rl.worker_set import WorkerSet
+    ws = WorkerSet("CartPole-v1", num_workers=2,
+                   worker_kwargs=dict(num_envs=1,
+                                      rollout_fragment_length=10,
+                                      gamma=0.99, lam=0.95,
+                                      hidden=(32,), seed=0))
+    try:
+        out = ws.foreach_worker("sample")
+        assert len(out) == 2
+        ray_tpu.kill(ws.workers[0])
+        out = ws.foreach_worker("sample", timeout=30.0)
+        assert ws.num_restarts >= 1
+        out = ws.foreach_worker("sample")
+        assert len(out) == 2
+    finally:
+        ws.stop()
