@@ -51,6 +51,7 @@ class ActorClass:
                  resources: Optional[Dict[str, float]] = None,
                  max_restarts: int = 0, name: Optional[str] = None,
                  namespace: str = "", lifetime: Optional[str] = None,
+                 max_concurrency: int = 1,
                  scheduling_strategy=None):
         self._cls = cls
         self._resources = dict(resources or {})
@@ -61,6 +62,7 @@ class ActorClass:
         self._name = name
         self._namespace = namespace
         self._lifetime = lifetime
+        self._max_concurrency = max_concurrency
         self._scheduling_strategy = scheduling_strategy
 
     def __call__(self, *args, **kwargs):
@@ -77,6 +79,7 @@ class ActorClass:
             namespace=self._namespace,
             detached=self._lifetime == "detached",
             max_restarts=self._max_restarts,
+            max_concurrency=self._max_concurrency,
             resources=self._resources,
             scheduling_strategy=encode_strategy(self._scheduling_strategy))
         return ActorHandle(actor_id)
@@ -93,6 +96,8 @@ class ActorClass:
             name=opts.get("name", self._name),
             namespace=opts.get("namespace", self._namespace),
             lifetime=opts.get("lifetime", self._lifetime),
+            max_concurrency=opts.get("max_concurrency",
+                                     self._max_concurrency),
             scheduling_strategy=opts.get("scheduling_strategy",
                                          self._scheduling_strategy))
 

@@ -146,6 +146,7 @@ def remote(*args, **kwargs):
                 name=kwargs.get("name"),
                 namespace=kwargs.get("namespace", ""),
                 lifetime=kwargs.get("lifetime"),
+                max_concurrency=kwargs.get("max_concurrency", 1),
                 scheduling_strategy=kwargs.get("scheduling_strategy"))
         return RemoteFunction(
             target,

@@ -894,6 +894,7 @@ class CoreWorker:
     def create_actor(self, cls, args, kwargs, *, name: Optional[str] = None,
                      namespace: str = "", detached: bool = False,
                      max_restarts: int = 0,
+                     max_concurrency: int = 1,
                      resources: Optional[Dict[str, float]] = None,
                      scheduling_strategy: Optional[dict] = None) -> "ActorID":
         actor_id = ActorID.from_random()
@@ -913,6 +914,7 @@ class CoreWorker:
             "args": self._serialize_args_tracked(args, kwargs,
                                                  TaskID.from_random()),
             "owner_addr": list(self.address),
+            "max_concurrency": max_concurrency,
         })
         self.gcs.call("register_actor", {
             "actor_id": actor_id.hex(),

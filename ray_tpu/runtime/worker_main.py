@@ -47,6 +47,7 @@ class WorkerProcess:
         # actor state
         self.actor_instance: Any = None
         self.actor_id: Optional[str] = None
+        self._actor_pool = None  # ThreadPoolExecutor when max_concurrency > 1
         # per caller-stream ordered queues (ActorSchedulingQueue analog):
         # {stream_id: {"next": int, "buf": {seq: work}}}
         self._actor_streams: Dict[str, Dict[str, Any]] = {}
@@ -205,6 +206,16 @@ class WorkerProcess:
         cls = self.core.load_function(creation["cls_key"])
         args, kwargs, _borrowed = self._resolve_args(creation["args"])
         self.actor_id = p["actor_id"]
+        max_concurrency = int(creation.get("max_concurrency", 1) or 1)
+        if max_concurrency > 1:
+            # Threaded actor (cf. reference ConcurrencyGroupManager /
+            # BoundedExecutor, src/ray/core_worker/transport/
+            # concurrency_group_manager.h): methods dispatch in submission
+            # order but may execute concurrently on a bounded pool.
+            from concurrent.futures import ThreadPoolExecutor
+            self._actor_pool = ThreadPoolExecutor(
+                max_workers=max_concurrency,
+                thread_name_prefix="actor-exec")
         self.actor_instance = cls(*args, **kwargs)
         self.core.gcs.call("actor_ready", {
             "actor_id": p["actor_id"],
@@ -243,11 +254,17 @@ class WorkerProcess:
                     self._actor_cv.wait()
                     work = self._next_actor_work()
             spec, done, out = work
-            try:
-                out["reply"] = self._execute_actor(spec)
-            except BaseException as e:  # noqa: BLE001
-                out["raise"] = e
-            done.set()
+            if self._actor_pool is not None:
+                self._actor_pool.submit(self._run_actor_work, spec, done, out)
+            else:
+                self._run_actor_work(spec, done, out)
+
+    def _run_actor_work(self, spec, done, out) -> None:
+        try:
+            out["reply"] = self._execute_actor(spec)
+        except BaseException as e:  # noqa: BLE001
+            out["raise"] = e
+        done.set()
 
     def _execute_actor(self, spec) -> dict:
         if self.actor_instance is None:

@@ -1,0 +1,27 @@
+"""Serve: scalable model serving on ray_tpu actors.
+
+TPU-native analog of the reference Serve library
+(/root/reference/python/ray/serve): a detached ServeController actor owns
+deployment state and reconciles replica actors; handles route requests with
+power-of-two-choices load balancing; an aiohttp HTTP proxy fronts
+deployments; autoscaling reacts to per-replica queue metrics.
+
+Adapted to the TPU process model: replicas that hold TPU chips get
+``num_tpus`` resources so one replica owns the host's chips, and the router
+keeps TPU replicas saturated with in-flight batches (continuous batching via
+``@serve.batch``).
+"""
+
+from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
+                               run, shutdown, start, status)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle
+
+__all__ = [
+    "start", "run", "shutdown", "delete", "status", "deployment",
+    "Deployment", "Application", "DeploymentHandle", "batch",
+    "AutoscalingConfig", "HTTPOptions", "get_app_handle",
+    "get_deployment_handle",
+]

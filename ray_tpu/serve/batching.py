@@ -1,0 +1,125 @@
+"""@serve.batch — coalesce concurrent calls into one batched invocation.
+
+Analog of /root/reference/python/ray/serve/batching.py (_BatchQueue). The
+reference coalesces asyncio tasks; here replicas are threaded actors
+(max_concurrency > 1), so the queue coalesces across concurrent threads:
+callers block on an event while a batcher thread drains the queue into
+calls of the wrapped function with a list of inputs.
+
+On TPU replicas this is the continuous-batching seam: the wrapped function
+sees a padded batch it can feed to a jitted forward step.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+
+_QUEUE_CREATE_LOCK = threading.Lock()
+_QUEUES: dict = {}
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait = batch_wait_timeout_s
+        self._lock = threading.Condition()
+        self._items: List[tuple] = []  # (arg, event, out)
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def submit(self, instance, arg) -> Any:
+        ev = threading.Event()
+        out: dict = {}
+        with self._lock:
+            self._items.append((instance, arg, ev, out))
+            self._ensure_thread()
+            self._lock.notify()
+        ev.wait()
+        if "err" in out:
+            raise out["err"]
+        return out["val"]
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                while not self._items:
+                    self._lock.wait()
+                # wait up to batch_wait_timeout_s for a full batch
+                deadline = time.monotonic() + self._wait
+                while (len(self._items) < self._max
+                       and time.monotonic() < deadline):
+                    self._lock.wait(timeout=deadline - time.monotonic())
+                batch = self._items[:self._max]
+                del self._items[:len(batch)]
+            instance = batch[0][0]
+            args = [b[1] for b in batch]
+            try:
+                if instance is not None:
+                    results = self._fn(instance, args)
+                else:
+                    results = self._fn(args)
+                if len(results) != len(args):
+                    raise ValueError(
+                        f"@serve.batch function returned {len(results)} "
+                        f"results for a batch of {len(args)}")
+                for (_, _, ev, out), r in zip(batch, results):
+                    out["val"] = r
+                    ev.set()
+            except Exception as e:  # noqa: BLE001 - delivered to callers
+                for _, _, ev, out in batch:
+                    out["err"] = e
+                    ev.set()
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: the wrapped fn must take a list of inputs and return a
+    list of outputs of the same length; concurrent callers each pass one
+    input and receive one output."""
+
+    def wrap(fn: Callable):
+        params = fn.__code__.co_varnames[:fn.__code__.co_argcount]
+        is_method = params and params[0] == "self"
+        # Queues hold locks/threads, so they must be created lazily in the
+        # executing process — never captured in the pickled closure.
+        key = f"__serve_batch_queue_{fn.__name__}"
+
+        if is_method:
+            @functools.wraps(fn)
+            def method(self, arg):
+                # runtime import: locks/threads must never ride the pickle
+                from ray_tpu.serve import batching as _b
+                with _b._QUEUE_CREATE_LOCK:
+                    q = getattr(self, key, None)
+                    if q is None:
+                        q = _b._BatchQueue(fn, max_batch_size,
+                                           batch_wait_timeout_s)
+                        setattr(self, key, q)
+                return q.submit(self, arg)
+            return method
+
+        @functools.wraps(fn)
+        def func(arg):
+            from ray_tpu.serve import batching as _b
+            qkey = (fn.__module__, fn.__qualname__)
+            with _b._QUEUE_CREATE_LOCK:
+                q = _b._QUEUES.get(qkey)
+                if q is None:
+                    q = _b._QUEUES[qkey] = _b._BatchQueue(
+                        fn, max_batch_size, batch_wait_timeout_s)
+            return q.submit(None, arg)
+        return func
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

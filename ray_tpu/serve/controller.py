@@ -1,0 +1,298 @@
+"""ServeController: detached actor owning all deployment state.
+
+Analog of /root/reference/python/ray/serve/controller.py (ServeController
+:61) + _private/deployment_state.py (DeploymentState/DeploymentStateManager
+:958/:1767): a reconcile loop drives each deployment's replica set toward
+its target (rolling updates via version stamps, health checks, autoscaling
+from replica queue metrics).
+
+Config propagation: the reference pushes via LongPollHost
+(_private/long_poll.py:185). ray_tpu actors execute methods from one
+ordered queue, so a blocking long-poll would starve the controller;
+handles/proxies instead short-poll ``get_targets`` with a version stamp
+(cheap dict compare server-side) — same eventual-consistency contract.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+SERVE_NAMESPACE = "serve"
+REPLICA_PREFIX = "SERVE_REPLICA::"
+
+
+class ServeController:
+    def __init__(self):
+        # deployment name -> state dict
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+        self._global_version = 0
+        self._shutdown = False
+        self._reconcile_thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True)
+        self._reconcile_thread.start()
+
+    # ----------------------------------------------------------- public API
+    def deploy(self, name: str, serialized_init: bytes,
+               config: Dict[str, Any]) -> None:
+        with self._lock:
+            state = self._deployments.get(name)
+            version = (state["version"] + 1) if state else 1
+            auto = config.get("autoscaling_config")
+            target = config.get("num_replicas", 1)
+            if auto:
+                target = max(auto["min_replicas"],
+                             min(target, auto["max_replicas"]))
+            self._deployments[name] = {
+                "name": name,
+                "version": version,
+                # routing_version bumps on ANY replica-set change (scale,
+                # crash retirement, rolling update) so handles always see
+                # fresh tables; "version" stamps the code/config rollout.
+                "routing_version": (state["routing_version"] + 1) if state
+                                   else 1,
+                "serialized_init": serialized_init,
+                "config": config,
+                "target_replicas": target,
+                "replicas": dict(state["replicas"]) if state else {},
+                # replica_tag -> {"name", "version", "healthy"}
+                "status": "UPDATING",
+                "last_scale_up": 0.0,
+                "last_scale_down": 0.0,
+                "ongoing_history": [],
+            }
+            self._global_version += 1
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            state = self._deployments.pop(name, None)
+            self._global_version += 1
+        if state:
+            for info in state["replicas"].values():
+                self._kill_replica(info["name"])
+
+    def get_targets(self, name: str,
+                    known_version: int = -1) -> Optional[Dict[str, Any]]:
+        """Replica routing table for one deployment; handles poll this."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return None
+            if state["routing_version"] == known_version:
+                return {"version": known_version, "unchanged": True}
+            return {
+                "version": state["routing_version"],
+                "replicas": [i["name"] for i in state["replicas"].values()
+                             if i["healthy"]
+                             and i["version"] == state["version"]],
+                "max_concurrent_queries":
+                    state["config"].get("max_concurrent_queries", 8),
+            }
+
+    def list_deployments(self):
+        with self._lock:
+            return sorted(self._deployments)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "status": s["status"],
+                    "version": s["version"],
+                    "target_replicas": s["target_replicas"],
+                    "running_replicas": sum(
+                        1 for i in s["replicas"].values()
+                        if i["healthy"] and i["version"] == s["version"]),
+                }
+                for name, s in self._deployments.items()
+            }
+
+    def shutdown_serve(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            deployments = list(self._deployments.values())
+            self._deployments.clear()
+        for state in deployments:
+            for info in state["replicas"].values():
+                self._kill_replica(info["name"])
+
+    def ping(self) -> bool:
+        return True
+
+    # ------------------------------------------------------- reconciliation
+    def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 - loop must survive
+                import traceback
+                traceback.print_exc()
+            time.sleep(0.25)
+
+    def _reconcile_once(self):
+        import ray_tpu
+        from ray_tpu.serve.replica import ReplicaActor
+
+        with self._lock:
+            deployments = list(self._deployments.keys())
+        for name in deployments:
+            with self._lock:
+                state = self._deployments.get(name)
+                if state is None:
+                    continue
+                version = state["version"]
+                target = state["target_replicas"]
+                config = state["config"]
+                replicas = dict(state["replicas"])
+
+            # health checks + metrics; a replica is dead only after
+            # HEALTH_CHECK_FAILURE_THRESHOLD consecutive failures (cf.
+            # reference deployment_state ReplicaState STARTING vs RUNNING:
+            # freshly created replicas get a startup grace period)
+            healthy_current = []
+            total_ongoing = 0.0
+            for tag, info in list(replicas.items()):
+                try:
+                    handle = ray_tpu.get_actor(info["name"],
+                                               namespace=SERVE_NAMESPACE)
+                    metrics = ray_tpu.get(handle.get_metrics.remote(),
+                                          timeout=config.get(
+                                              "health_check_period_s", 2.0))
+                    info["healthy"] = True
+                    info["fails"] = 0
+                    total_ongoing += metrics["num_ongoing"]
+                except Exception:
+                    info["fails"] = info.get("fails", 0) + 1
+                    grace = (time.monotonic() - info.get("created_at", 0.0)
+                             < 30.0)
+                    if info["fails"] >= 3 and not (grace and info["fails"]
+                                                   < 30):
+                        info["healthy"] = False
+                if info["healthy"] and info["version"] == version:
+                    healthy_current.append(tag)
+
+            # autoscaling decision
+            auto = config.get("autoscaling_config")
+            if auto and healthy_current:
+                target = self._autoscale(name, auto, total_ongoing,
+                                         len(healthy_current), target)
+
+            # scale up: start missing replicas at the current version
+            missing = target - len(healthy_current)
+            for _ in range(max(0, missing)):
+                tag = f"{name}#{uuid.uuid4().hex[:8]}"
+                actor_name = REPLICA_PREFIX + tag
+                opts = dict(config.get("ray_actor_options") or {})
+                max_cq = config.get("max_concurrent_queries", 8)
+                try:
+                    ray_tpu.remote(ReplicaActor).options(
+                        name=actor_name,
+                        namespace=SERVE_NAMESPACE,
+                        lifetime="detached",
+                        max_concurrency=max_cq + 2,
+                        num_cpus=opts.get("num_cpus", 0.1),
+                        num_tpus=opts.get("num_tpus", 0.0),
+                        resources=opts.get("resources"),
+                    ).remote(state["serialized_init"], name, tag,
+                             config.get("user_config"))
+                    replicas[tag] = {"name": actor_name, "version": version,
+                                     "healthy": True, "fails": 0,
+                                     "created_at": time.monotonic()}
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+
+            # scale down / retire old-version or unhealthy replicas
+            to_kill = []
+            excess = len(healthy_current) - target
+            for tag, info in list(replicas.items()):
+                if info["version"] != version or not info["healthy"]:
+                    to_kill.append(tag)
+                elif excess > 0:
+                    to_kill.append(tag)
+                    excess -= 1
+            for tag in to_kill:
+                info = replicas.pop(tag)
+                self._kill_replica(info["name"])
+
+            with self._lock:
+                cur = self._deployments.get(name)
+                if cur is None:
+                    # deployment deleted mid-pass: kill replicas we created
+                    orphans = [i["name"] for i in replicas.values()]
+                elif cur["version"] != version:
+                    # deploy() raced us: keep every replica tracked so the
+                    # next pass retires old-version ones (nothing orphaned)
+                    orphans = []
+                    for tag, info in replicas.items():
+                        cur["replicas"].setdefault(tag, info)
+                    cur["routing_version"] += 1
+                else:
+                    orphans = []
+                    if (set(replicas) != set(cur["replicas"])
+                            or any(replicas[t]["healthy"]
+                                   != cur["replicas"][t]["healthy"]
+                                   for t in replicas
+                                   if t in cur["replicas"])):
+                        cur["routing_version"] += 1
+                    cur["replicas"] = replicas
+                    cur["target_replicas"] = target
+                    running = sum(1 for i in replicas.values()
+                                  if i["healthy"]
+                                  and i["version"] == version)
+                    cur["status"] = ("HEALTHY" if running >= target
+                                     else "UPDATING")
+            for actor_name in orphans:
+                self._kill_replica(actor_name)
+
+    def _autoscale(self, name: str, auto: Dict[str, Any], total_ongoing:
+                   float, num_replicas: int, target: int) -> int:
+        """Queue-depth policy, cf. reference
+        serve/_private/autoscaling_policy.py (calculate_desired_num_replicas).
+        """
+        desired = math.ceil(
+            total_ongoing /
+            max(auto["target_num_ongoing_requests_per_replica"], 1e-6))
+        desired = max(auto["min_replicas"],
+                      min(auto["max_replicas"], desired))
+        now = time.monotonic()
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return target
+            if desired > target:
+                if state["last_scale_up"] == 0.0:
+                    state["last_scale_up"] = now
+                if now - state["last_scale_up"] >= auto["upscale_delay_s"]:
+                    state["last_scale_up"] = 0.0
+                    state["last_scale_down"] = 0.0
+                    return desired
+            elif desired < target:
+                if state["last_scale_down"] == 0.0:
+                    state["last_scale_down"] = now
+                if now - state["last_scale_down"] >= auto["downscale_delay_s"]:
+                    state["last_scale_up"] = 0.0
+                    state["last_scale_down"] = 0.0
+                    return desired
+            else:
+                state["last_scale_up"] = 0.0
+                state["last_scale_down"] = 0.0
+        return target
+
+    def _kill_replica(self, actor_name: str) -> None:
+        import ray_tpu
+        try:
+            handle = ray_tpu.get_actor(actor_name,
+                                       namespace=SERVE_NAMESPACE)
+            try:
+                ray_tpu.get(handle.prepare_for_shutdown.remote(), timeout=6)
+            except Exception:
+                pass
+            ray_tpu.kill(handle)
+        except Exception:
+            pass

@@ -1,0 +1,188 @@
+"""DeploymentHandle: client-side router to a deployment's replicas.
+
+Analog of /root/reference/python/ray/serve/handle.py (RayServeHandle :78)
++ _private/router.py (Router/ReplicaSet :261/:62, assign_replica :221):
+power-of-two-choices over handle-local in-flight counts, with
+max_concurrent_queries backpressure; routing tables refresh from the
+controller with a version stamp (short-poll analog of LongPollClient).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, SERVE_NAMESPACE
+
+_REFRESH_INTERVAL_S = 1.0
+
+
+class _SubHandle:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle._route(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._lock = threading.Condition()
+        self._version = -1
+        self._replicas: List[str] = []
+        self._max_concurrent = 8
+        self._inflight: Dict[str, int] = {}
+        self._outstanding: List[tuple] = []  # (ref, replica_name)
+        self._last_refresh = 0.0
+        self._controller = None
+        self._drain_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ plumbing
+    def _get_controller(self):
+        if self._controller is None:
+            self._controller = ray_tpu.get_actor(
+                CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        return self._controller
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < _REFRESH_INTERVAL_S:
+            return
+        self._last_refresh = now
+        targets = ray_tpu.get(
+            self._get_controller().get_targets.remote(
+                self.deployment_name, self._version), timeout=10)
+        if targets is None:
+            with self._lock:
+                self._replicas = []
+            return
+        if targets.get("unchanged"):
+            return
+        with self._lock:
+            self._version = targets["version"]
+            self._replicas = targets["replicas"]
+            self._max_concurrent = targets["max_concurrent_queries"]
+            for r in self._replicas:
+                self._inflight.setdefault(r, 0)
+            self._lock.notify_all()
+
+    def _ensure_drainer(self):
+        with self._lock:
+            if (self._drain_thread is None
+                    or not self._drain_thread.is_alive()):
+                self._drain_thread = threading.Thread(
+                    target=self._drain_loop, daemon=True)
+                self._drain_thread.start()
+
+    def _drain_loop(self):
+        """Decrement in-flight counts as replica calls complete. Exits when
+        no requests are outstanding (restarted on demand by _route) so idle
+        handles pin no thread."""
+        idle_since = None
+        while True:
+            with self._lock:
+                outstanding = list(self._outstanding)
+            if not outstanding:
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since > 1.0:
+                    with self._lock:
+                        if not self._outstanding:
+                            self._drain_thread = None
+                            return
+                time.sleep(0.02)
+                continue
+            idle_since = None
+            refs = [r for r, _ in outstanding]
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.2,
+                                       fetch_local=False)
+            except Exception:
+                # transient wait failure: errored calls still complete their
+                # refs, so just retry rather than zeroing in-flight counts
+                time.sleep(0.1)
+                continue
+            if done:
+                done_ids = {d.id for d in done}
+                with self._lock:
+                    still = []
+                    for ref, replica in self._outstanding:
+                        if ref.id in done_ids:
+                            self._inflight[replica] = max(
+                                0, self._inflight.get(replica, 1) - 1)
+                        else:
+                            still.append((ref, replica))
+                    self._outstanding = still
+                    self._lock.notify_all()
+
+    # ------------------------------------------------------------- routing
+    def _pick_replica(self) -> Optional[str]:
+        """Power-of-two choices among replicas with spare concurrency."""
+        candidates = [r for r in self._replicas
+                      if self._inflight.get(r, 0) < self._max_concurrent]
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            return candidates[0]
+        a, b = random.sample(candidates, 2)
+        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+
+    def _route(self, method: str, args: tuple, kwargs: dict):
+        self._refresh()
+        deadline = time.monotonic() + 60.0
+        while True:
+            with self._lock:
+                replica = self._pick_replica()
+                if replica is not None:
+                    self._inflight[replica] = \
+                        self._inflight.get(replica, 0) + 1
+            if replica is None:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no replica of {self.deployment_name!r} available "
+                        "(backpressure timeout)")
+                with self._lock:
+                    self._lock.wait(timeout=0.1)
+                self._refresh(force=not self._replicas)
+                continue
+            try:
+                actor = ray_tpu.get_actor(replica,
+                                          namespace=SERVE_NAMESPACE)
+                ref = actor.handle_request.remote(method, args, kwargs)
+            except Exception:
+                # replica vanished (scale-down/crash): drop it locally,
+                # force-refresh the table, and retry until the deadline
+                with self._lock:
+                    self._inflight[replica] = max(
+                        0, self._inflight.get(replica, 1) - 1)
+                    if replica in self._replicas:
+                        self._replicas.remove(replica)
+                if time.monotonic() > deadline:
+                    raise
+                self._refresh(force=True)
+                time.sleep(0.05)
+                continue
+            with self._lock:
+                self._outstanding.append((ref, replica))
+            self._ensure_drainer()
+            return ref
+
+    # ------------------------------------------------------------ user API
+    def remote(self, *args, **kwargs):
+        return self._route("__call__", args, kwargs)
+
+    def __getattr__(self, name: str) -> _SubHandle:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _SubHandle(self, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self.deployment_name!r})"

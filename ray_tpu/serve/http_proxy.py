@@ -1,0 +1,85 @@
+"""HTTP ingress: aiohttp proxy actor routing to deployment handles.
+
+Analog of /root/reference/python/ray/serve/_private/http_proxy.py
+(HTTPProxyActor :387, HTTPProxy :218, uvicorn/starlette there; aiohttp
+here — starlette isn't baked in). Routes ``/{deployment}`` with a JSON
+body to ``handle.remote(body)``; replica calls run in an executor so the
+event loop stays free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Dict
+
+import ray_tpu
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class HTTPProxyActor:
+    """Threaded actor: aiohttp server runs on a background event loop."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def ready(self) -> bool:
+        self._ready.wait(timeout=15)
+        return self._ready.is_set()
+
+    def _get_handle(self, deployment: str) -> DeploymentHandle:
+        if deployment not in self._handles:
+            self._handles[deployment] = DeploymentHandle(deployment)
+        return self._handles[deployment]
+
+    def _serve(self):
+        from aiohttp import web
+
+        async def handle(request: web.Request) -> web.Response:
+            deployment = request.match_info["deployment"]
+            if request.can_read_body:
+                try:
+                    payload = await request.json()
+                except json.JSONDecodeError:
+                    payload = (await request.read()).decode()
+            else:
+                payload = dict(request.query)
+            loop = asyncio.get_running_loop()
+
+            def call():
+                h = self._get_handle(deployment)
+                return ray_tpu.get(h.remote(payload), timeout=60)
+
+            try:
+                result = await loop.run_in_executor(None, call)
+            except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500
+                return web.json_response(
+                    {"error": type(e).__name__, "message": str(e)},
+                    status=500)
+            try:
+                return web.json_response(result)
+            except TypeError:
+                return web.Response(text=str(result))
+
+        async def healthz(_request):
+            return web.Response(text="ok")
+
+        async def main():
+            app = web.Application()
+            app.router.add_get("/-/healthz", healthz)
+            app.router.add_route("*", "/{deployment}", handle)
+            app.router.add_route("*", "/{deployment}/{tail:.*}", handle)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self._ready.set()
+            await asyncio.Event().wait()
+
+        asyncio.run(main())

@@ -1,0 +1,86 @@
+"""Replica actor: wraps the user's deployment callable.
+
+Analog of /root/reference/python/ray/serve/_private/replica.py
+(RayServeReplica :250, handle_request :494): tracks in-flight queries for
+autoscaling metrics, enforces max_concurrent_queries admission, supports
+reconfigure(user_config) and health checks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict
+
+
+class ReplicaActor:
+    """Runs as a threaded ray_tpu actor (max_concurrency =
+    max_concurrent_queries + house-keeping headroom) so queries execute
+    concurrently while metrics/health calls stay responsive."""
+
+    def __init__(self, serialized_init: bytes, deployment_name: str,
+                 replica_tag: str, user_config: Any = None):
+        import cloudpickle
+        cls_or_fn, init_args, init_kwargs = cloudpickle.loads(serialized_init)
+        self.deployment_name = deployment_name
+        self.replica_tag = replica_tag
+        self._lock = threading.Lock()
+        self._num_ongoing = 0
+        self._num_processed = 0
+        self._started = time.time()
+        if isinstance(cls_or_fn, type):
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = cls_or_fn
+            self._is_function = True
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # ------------------------------------------------------------- requests
+    def handle_request(self, method_name: str, args: tuple,
+                       kwargs: dict) -> Any:
+        with self._lock:
+            self._num_ongoing += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            elif method_name in ("__call__", "", None):
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._num_ongoing -= 1
+                self._num_processed += 1
+
+    # ------------------------------------------------------------- control
+    def reconfigure(self, user_config: Any) -> None:
+        if not self._is_function and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def check_health(self) -> bool:
+        if not self._is_function and hasattr(self._callable, "check_health"):
+            self._callable.check_health()
+        return True
+
+    def get_metrics(self) -> Dict[str, Any]:
+        """Queue metrics feeding the controller's autoscaling policy
+        (cf. reference serve/_private/autoscaling_metrics.py)."""
+        with self._lock:
+            return {
+                "replica_tag": self.replica_tag,
+                "num_ongoing": self._num_ongoing,
+                "num_processed": self._num_processed,
+                "uptime_s": time.time() - self._started,
+            }
+
+    def prepare_for_shutdown(self) -> bool:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._num_ongoing == 0:
+                    return True
+            time.sleep(0.05)
+        return False

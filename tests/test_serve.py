@@ -1,0 +1,176 @@
+"""Serve library tests: deployments, routing, batching, autoscaling wiring,
+composition graphs, HTTP proxy.
+
+Modeled on reference python/ray/serve/tests/ (test_api.py, test_batching.py,
+test_deployment_graph.py).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance():
+    ray_tpu.init(num_cpus=8, object_store_memory=128 * 1024 * 1024)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment(serve_instance):
+    @serve.deployment
+    def echo(x):
+        return {"echo": x}
+
+    handle = serve.run(echo.bind())
+    assert ray_tpu.get(handle.remote("hi")) == {"echo": "hi"}
+
+
+def test_class_deployment_and_methods(serve_instance):
+    @serve.deployment
+    class Counter:
+        def __init__(self, start):
+            self.count = start
+
+        def __call__(self, inc):
+            self.count += inc
+            return self.count
+
+        def value(self):
+            return self.count
+
+    handle = serve.run(Counter.bind(10))
+    assert ray_tpu.get(handle.remote(5)) == 15
+    assert ray_tpu.get(handle.value.remote()) == 15
+
+
+def test_multiple_replicas_all_serve(serve_instance):
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __init__(self):
+            import os
+            self.pid = os.getpid()
+
+        def __call__(self, _):
+            return self.pid
+
+    handle = serve.run(WhoAmI.bind())
+    pids = {ray_tpu.get(handle.remote(None)) for _ in range(20)}
+    assert len(pids) == 2, f"expected both replicas hit, got {pids}"
+
+
+def test_redeploy_updates_version(serve_instance):
+    @serve.deployment
+    def v(_):
+        return 1
+
+    handle = serve.run(v.bind())
+    assert ray_tpu.get(handle.remote(None)) == 1
+
+    @serve.deployment(name="v")
+    def v2(_):
+        return 2
+
+    handle = serve.run(v2.bind())
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if ray_tpu.get(handle.remote(None)) == 2:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.get(handle.remote(None)) == 2
+
+
+def test_user_config_reconfigure(serve_instance):
+    @serve.deployment(user_config={"threshold": 5})
+    class Thresholder:
+        def __init__(self):
+            self.threshold = 0
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, _):
+            return self.threshold
+
+    handle = serve.run(Thresholder.bind())
+    assert ray_tpu.get(handle.remote(None)) == 5
+
+
+def test_composition_graph(serve_instance):
+    @serve.deployment
+    class Adder:
+        def __init__(self, increment):
+            self.increment = increment
+
+        def __call__(self, x):
+            return x + self.increment
+
+    @serve.deployment
+    class Combiner:
+        def __init__(self, adder):
+            self.adder = adder
+
+        def __call__(self, x):
+            doubled = ray_tpu.get(self.adder.remote(x))
+            return doubled * 10
+
+    handle = serve.run(Combiner.bind(Adder.bind(3)))
+    assert ray_tpu.get(handle.remote(4)) == 70
+
+
+def test_batching(serve_instance):
+    @serve.deployment(max_concurrent_queries=8)
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def __call__(self, xs):
+            # returns batch size per element so the test can observe coalescing
+            return [len(xs)] * len(xs)
+
+    handle = serve.run(Batched.bind())
+    refs = [handle.remote(i) for i in range(4)]
+    sizes = ray_tpu.get(refs)
+    assert max(sizes) > 1, f"no batching observed: {sizes}"
+
+
+def test_status_and_delete(serve_instance):
+    @serve.deployment
+    def f(_):
+        return "ok"
+
+    serve.run(f.bind())
+    st = serve.status()
+    assert st["f"]["status"] == "HEALTHY"
+    assert st["f"]["running_replicas"] == 1
+    serve.delete("f")
+    assert "f" not in serve.status()
+
+
+def test_http_proxy(serve_instance):
+    import json
+    import urllib.request
+
+    @serve.deployment
+    def hello(payload):
+        return {"got": payload}
+
+    serve.run(hello.bind(),
+              http_options=serve.HTTPOptions(port=18231))
+    deadline = time.monotonic() + 10
+    body = json.dumps({"a": 1}).encode()
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            req = urllib.request.Request(
+                "http://127.0.0.1:18231/hello", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert json.loads(resp.read()) == {"got": {"a": 1}}
+            return
+        except Exception as e:  # noqa: BLE001
+            last = e
+            time.sleep(0.3)
+    raise AssertionError(f"http proxy never served: {last}")
