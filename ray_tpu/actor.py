@@ -84,6 +84,11 @@ class ActorClass:
             scheduling_strategy=encode_strategy(self._scheduling_strategy))
         return ActorHandle(actor_id)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG authoring (cf. reference dag/class_node.py)."""
+        from ray_tpu.dag import ClassNode
+        return ClassNode(self, args, kwargs)
+
     def options(self, **opts) -> "ActorClass":
         return ActorClass(
             self._cls,

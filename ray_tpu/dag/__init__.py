@@ -1,0 +1,14 @@
+"""Lazy DAG authoring API.
+
+Analog of /root/reference/python/ray/dag (DAGNode dag_node.py:23,
+FunctionNode function_node.py:12, ClassNode class_node.py:16, InputNode
+input_node.py:13): `.bind()` on remote functions/classes builds a lazy
+graph; `.execute(input)` submits it as ray_tpu tasks/actors bottom-up.
+Used by Workflow for durable execution.
+"""
+
+from ray_tpu.dag.dag_node import (ClassMethodNode, ClassNode, DAGNode,
+                                  FunctionNode, InputNode)
+
+__all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode",
+           "InputNode"]

@@ -47,6 +47,11 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG authoring (cf. reference dag/function_node.py)."""
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def options(self, **opts) -> "RemoteFunction":
         new = RemoteFunction(
             self._func,

@@ -1,0 +1,15 @@
+"""Workflow: durable DAG execution with checkpointed steps and resume.
+
+Analog of /root/reference/python/ray/workflow (WorkflowExecutor
+workflow_executor.py:32, workflow_state_from_dag.py, workflow_storage.py):
+a DAG authored with ``.bind()`` runs step-by-step; each step's result is
+persisted to workflow storage before dependents run, so a crashed or
+cancelled workflow resumes from its last completed step.
+"""
+
+from ray_tpu.workflow.api import (cancel, delete, get_output, get_status,
+                                  init, list_all, resume, run, run_async)
+from ray_tpu.workflow.storage import WorkflowStorage
+
+__all__ = ["init", "run", "run_async", "resume", "get_output", "get_status",
+           "list_all", "cancel", "delete", "WorkflowStorage"]

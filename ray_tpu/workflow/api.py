@@ -1,0 +1,129 @@
+"""Public Workflow API.
+
+Analog of /root/reference/python/ray/workflow/api.py: run/run_async/
+resume/get_output/get_status/list_all/cancel/delete. The DAG and input are
+pickled into storage at submission, so ``resume`` needs only the
+workflow_id (matching reference workflow recovery semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Any, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag import DAGNode
+from ray_tpu.workflow import storage as st
+from ray_tpu.workflow.executor import execute_workflow
+
+_storage: Optional[st.WorkflowStorage] = None
+_lock = threading.Lock()
+
+
+def init(storage_dir: Optional[str] = None) -> None:
+    global _storage
+    with _lock:
+        if storage_dir is None:
+            storage_dir = os.environ.get(
+                "RAY_TPU_WORKFLOW_DIR",
+                os.path.expanduser("~/.ray_tpu/workflows"))
+        _storage = st.WorkflowStorage(storage_dir)
+
+
+def _get_storage() -> st.WorkflowStorage:
+    if _storage is None:
+        init()
+    return _storage
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        input_value: Any = None) -> Any:
+    """Run a DAG durably to completion; returns the final value."""
+    storage = _get_storage()
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    if not storage.workflow_exists(workflow_id):
+        storage.create_workflow(workflow_id)
+    else:
+        storage.set_status(workflow_id, st.STATUS_RUNNING)
+    # always persist THIS dag so a later resume() replays what actually ran
+    storage._atomic_write(
+        os.path.join(storage._wf_dir(workflow_id), "dag.pkl"),
+        cloudpickle.dumps((dag, input_value)))
+    return execute_workflow(storage, workflow_id, dag, input_value)
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              input_value: Any = None) -> Tuple[str, "ray_tpu.ObjectRef"]:
+    """Submit and return (workflow_id, ref-like thread result).
+
+    Runs the executor on a driver-side thread (steps themselves are remote
+    tasks); returns a handle whose .result() joins it.
+    """
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    out: dict = {}
+    done = threading.Event()
+
+    def target():
+        try:
+            out["value"] = run(dag, workflow_id=workflow_id,
+                               input_value=input_value)
+        except BaseException as e:  # noqa: BLE001
+            out["error"] = e
+        done.set()
+
+    threading.Thread(target=target, daemon=True).start()
+
+    class _Future:
+        def result(self, timeout: Optional[float] = None):
+            if not done.wait(timeout):
+                raise TimeoutError("workflow still running")
+            if "error" in out:
+                raise out["error"]
+            return out["value"]
+
+    return workflow_id, _Future()
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a stored workflow; completed steps load from checkpoints."""
+    storage = _get_storage()
+    if not storage.workflow_exists(workflow_id):
+        raise ValueError(f"no workflow {workflow_id!r}")
+    with open(os.path.join(storage._wf_dir(workflow_id), "dag.pkl"),
+              "rb") as f:
+        dag, input_value = cloudpickle.loads(f.read())
+    storage.set_status(workflow_id, st.STATUS_RUNNING)
+    return execute_workflow(storage, workflow_id, dag, input_value)
+
+
+def get_output(workflow_id: str) -> Any:
+    storage = _get_storage()
+    if storage.has_step_result(workflow_id, "__output__"):
+        return storage.load_step_result(workflow_id, "__output__")
+    raise ValueError(f"workflow {workflow_id!r} has no output "
+                     f"(status={storage.get_status(workflow_id)})")
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    return _get_storage().get_status(workflow_id)
+
+
+def list_all() -> List[Tuple[str, str]]:
+    storage = _get_storage()
+    return [(wid, storage.get_status(wid))
+            for wid in storage.list_workflows()]
+
+
+def cancel(workflow_id: str) -> None:
+    """Flag a workflow canceled; the executor checks before each step and
+    stops with WorkflowCancellationError (already-submitted step tasks run
+    to completion, matching reference cancel semantics)."""
+    _get_storage().set_status(workflow_id, st.STATUS_CANCELED)
+
+
+def delete(workflow_id: str) -> None:
+    _get_storage().delete_workflow(workflow_id)

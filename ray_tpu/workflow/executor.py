@@ -1,0 +1,117 @@
+"""Durable DAG executor.
+
+Analog of /root/reference/python/ray/workflow/workflow_executor.py (:32)
++ workflow_state_from_dag.py: flattens the DAG into steps with
+deterministic IDs (topological index + callable name — stable across a
+re-built identical DAG, which is what resume() relies on), executes each
+step as a ray_tpu task, and checkpoints every result before dependents
+consume it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import ray_tpu
+from ray_tpu.dag import ClassMethodNode, ClassNode, DAGNode, FunctionNode, InputNode
+from ray_tpu.workflow import storage as st
+
+
+class WorkflowCancellationError(Exception):
+    """Raised inside a running workflow when cancel() flips its status."""
+
+
+def _step_ids(dag: DAGNode) -> Dict[str, str]:
+    """node uuid -> deterministic step id."""
+    ids = {}
+    for i, node in enumerate(dag.walk()):
+        if isinstance(node, FunctionNode):
+            name = node._remote_function._func.__name__
+        elif isinstance(node, ClassNode):
+            name = node._actor_class._cls.__name__
+        elif isinstance(node, ClassMethodNode):
+            name = node._method_name
+        else:
+            name = type(node).__name__
+        ids[node._stable_uuid] = f"{i:04d}_{name}"
+    return ids
+
+
+def execute_workflow(storage: st.WorkflowStorage, workflow_id: str,
+                     dag: DAGNode, input_value: Any = None) -> Any:
+    """Run the DAG durably; returns the final result value.
+
+    Completed steps (from a previous run of the same workflow_id) are
+    loaded from storage instead of re-executed.
+    """
+    ids = _step_ids(dag)
+    cache: Dict[str, Any] = {}
+
+    def execute_node(node: DAGNode) -> Any:
+        if node._stable_uuid in cache:
+            return cache[node._stable_uuid]
+        step_id = ids[node._stable_uuid]
+
+        if storage.get_status(workflow_id) == st.STATUS_CANCELED:
+            raise WorkflowCancellationError(workflow_id)
+
+        if isinstance(node, InputNode):
+            value = input_value
+        elif isinstance(node, ClassNode):
+            # actors are transient (recreated on every run/resume), so their
+            # method steps are NOT durable: skipping a checkpointed method
+            # call would leave the fresh actor's state behind (wrong
+            # results). Only stateless FunctionNode steps checkpoint.
+            args, kwargs = _resolve(node)
+            cls = node._actor_class
+            if node._options:
+                cls = cls.options(**node._options)
+            value = cls.remote(*args, **kwargs)
+        elif isinstance(node, ClassMethodNode):
+            handle = execute_node(node._class_node)
+            args, kwargs = _resolve(node)
+            ref = getattr(handle, node._method_name).remote(*args, **kwargs)
+            try:
+                value = ray_tpu.get(ref)
+            except Exception as e:
+                storage.save_step_exception(workflow_id, step_id, e)
+                raise
+        elif storage.has_step_result(workflow_id, step_id):
+            value = storage.load_step_result(workflow_id, step_id)
+        elif isinstance(node, FunctionNode):
+            args, kwargs = _resolve(node)
+            fn = node._remote_function
+            if node._options:
+                fn = fn.options(**node._options)
+            ref = fn.remote(*args, **kwargs)
+            try:
+                value = ray_tpu.get(ref)
+            except Exception as e:
+                storage.save_step_exception(workflow_id, step_id, e)
+                raise
+            storage.save_step_result(workflow_id, step_id, value)
+        else:
+            raise TypeError(f"cannot execute {type(node).__name__}")
+        cache[node._stable_uuid] = value
+        return value
+
+    def _resolve(node: DAGNode):
+        args = tuple(execute_node(a) if isinstance(a, DAGNode) else a
+                     for a in node._bound_args)
+        kwargs = {k: (execute_node(v) if isinstance(v, DAGNode) else v)
+                  for k, v in node._bound_kwargs.items()}
+        return args, kwargs
+
+    try:
+        result = execute_node(dag)
+        # output checkpoint BEFORE the status flip: a crash between the two
+        # must never yield SUCCESS-with-no-output
+        storage.save_step_result(workflow_id, "__output__", result)
+        storage.set_status(workflow_id, st.STATUS_SUCCESS)
+        return result
+    except WorkflowCancellationError:
+        raise
+    except Exception:
+        if storage.get_status(workflow_id) != st.STATUS_CANCELED:
+            storage.set_status(workflow_id, st.STATUS_FAILED)
+        raise

@@ -1,0 +1,106 @@
+"""Filesystem workflow storage.
+
+Analog of /root/reference/python/ray/workflow/workflow_storage.py: one
+directory per workflow, one per step; step results are written atomically
+(tmp + rename) so a crash mid-write never yields a corrupt checkpoint.
+Layout:
+
+    {base}/{workflow_id}/status                    RUNNING|SUCCESS|FAILED|CANCELED
+    {base}/{workflow_id}/steps/{step_id}/result.pkl
+    {base}/{workflow_id}/steps/{step_id}/exception.pkl
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, List, Optional
+
+import cloudpickle
+
+STATUS_RUNNING = "RUNNING"
+STATUS_SUCCESS = "SUCCESS"
+STATUS_FAILED = "FAILED"
+STATUS_CANCELED = "CANCELED"
+
+
+class WorkflowStorage:
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+
+    # ------------------------------------------------------------ workflows
+    def _wf_dir(self, workflow_id: str) -> str:
+        return os.path.join(self.base_dir, workflow_id)
+
+    def create_workflow(self, workflow_id: str) -> None:
+        os.makedirs(os.path.join(self._wf_dir(workflow_id), "steps"),
+                    exist_ok=True)
+        self.set_status(workflow_id, STATUS_RUNNING)
+
+    def workflow_exists(self, workflow_id: str) -> bool:
+        return os.path.isdir(self._wf_dir(workflow_id))
+
+    def set_status(self, workflow_id: str, status: str) -> None:
+        self._atomic_write(
+            os.path.join(self._wf_dir(workflow_id), "status"),
+            status.encode())
+
+    def get_status(self, workflow_id: str) -> Optional[str]:
+        try:
+            with open(os.path.join(self._wf_dir(workflow_id), "status"),
+                      "rb") as f:
+                return f.read().decode()
+        except FileNotFoundError:
+            return None
+
+    def list_workflows(self) -> List[str]:
+        try:
+            return sorted(
+                d for d in os.listdir(self.base_dir)
+                if os.path.isdir(self._wf_dir(d)))
+        except FileNotFoundError:
+            return []
+
+    def delete_workflow(self, workflow_id: str) -> None:
+        shutil.rmtree(self._wf_dir(workflow_id), ignore_errors=True)
+
+    # ---------------------------------------------------------------- steps
+    def _step_dir(self, workflow_id: str, step_id: str) -> str:
+        return os.path.join(self._wf_dir(workflow_id), "steps", step_id)
+
+    def has_step_result(self, workflow_id: str, step_id: str) -> bool:
+        return os.path.exists(
+            os.path.join(self._step_dir(workflow_id, step_id), "result.pkl"))
+
+    def save_step_result(self, workflow_id: str, step_id: str,
+                         result: Any) -> None:
+        d = self._step_dir(workflow_id, step_id)
+        os.makedirs(d, exist_ok=True)
+        self._atomic_write(os.path.join(d, "result.pkl"),
+                           cloudpickle.dumps(result))
+
+    def load_step_result(self, workflow_id: str, step_id: str) -> Any:
+        with open(os.path.join(self._step_dir(workflow_id, step_id),
+                               "result.pkl"), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    def save_step_exception(self, workflow_id: str, step_id: str,
+                            err: BaseException) -> None:
+        d = self._step_dir(workflow_id, step_id)
+        os.makedirs(d, exist_ok=True)
+        try:
+            data = cloudpickle.dumps(err)
+        except Exception:  # noqa: BLE001 - unpicklable exception
+            data = cloudpickle.dumps(RuntimeError(repr(err)))
+        self._atomic_write(os.path.join(d, "exception.pkl"), data)
+
+    # ---------------------------------------------------------------- misc
+    @staticmethod
+    def _atomic_write(path: str, data: bytes) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)

@@ -1,0 +1,144 @@
+"""DAG authoring + durable Workflow tests.
+
+Modeled on reference python/ray/dag/tests and python/ray/workflow/tests
+(test_basic_workflows.py, test_recovery.py).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture
+def wf(tmp_path, ray_start_regular):
+    workflow.init(str(tmp_path / "wfs"))
+    yield ray_start_regular
+
+
+def test_function_dag_execute(ray_start_regular):
+    @ray_tpu.remote
+    def a(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def b(x, y):
+        return x * y
+
+    dag = b.bind(a.bind(1), a.bind(2))
+    assert ray_tpu.get(dag.execute()) == 6
+
+
+def test_input_node(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    with InputNode() as inp:
+        dag = double.bind(inp)
+    assert ray_tpu.get(dag.execute(21)) == 42
+
+
+def test_diamond_executes_shared_node_once(ray_start_regular):
+    @ray_tpu.remote
+    def source():
+        import os
+        return os.getpid(), id(object())
+
+    @ray_tpu.remote
+    def left(s):
+        return s
+
+    @ray_tpu.remote
+    def right(s):
+        return s
+
+    @ray_tpu.remote
+    def join(l, r):
+        return l == r
+
+    shared = source.bind()
+    dag = join.bind(left.bind(shared), right.bind(shared))
+    assert ray_tpu.get(dag.execute()) is True
+
+
+def test_actor_dag(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    node = Counter.bind(10)
+    dag = node.add.bind(5)
+    assert ray_tpu.get(dag.execute()) == 15
+
+
+def test_workflow_run_and_output(wf):
+    @ray_tpu.remote
+    def add(x, y):
+        return x + y
+
+    dag = add.bind(add.bind(1, 2), 3)
+    result = workflow.run(dag, workflow_id="w1")
+    assert result == 6
+    assert workflow.get_status("w1") == "SUCCESS"
+    assert workflow.get_output("w1") == 6
+    assert ("w1", "SUCCESS") in workflow.list_all()
+
+
+def test_workflow_resume_skips_completed_steps(wf, tmp_path):
+    marker = tmp_path / "ran_times"
+    marker.write_text("")
+
+    @ray_tpu.remote
+    def expensive(path):
+        with open(path, "a") as f:
+            f.write("x")
+        return 10
+
+    @ray_tpu.remote
+    def flaky(x, path):
+        import os
+        if not os.path.exists(path + ".ok"):
+            raise RuntimeError("injected failure")
+        return x * 2
+
+    dag = flaky.bind(expensive.bind(str(marker)), str(marker))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == "FAILED"
+    assert marker.read_text() == "x"  # expensive ran once
+
+    # heal the failure, resume: expensive must NOT re-run
+    (tmp_path / "ran_times.ok").write_text("")
+    result = workflow.resume("w2")
+    assert result == 20
+    assert marker.read_text() == "x"
+    assert workflow.get_status("w2") == "SUCCESS"
+
+
+def test_workflow_run_async(wf):
+    @ray_tpu.remote
+    def slow_add(x, y):
+        import time
+        time.sleep(0.2)
+        return x + y
+
+    wid, fut = workflow.run_async(slow_add.bind(20, 22), workflow_id="w3")
+    assert fut.result(timeout=30) == 42
+    assert workflow.get_status("w3") == "SUCCESS"
+
+
+def test_workflow_delete(wf):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="w4")
+    workflow.delete("w4")
+    assert workflow.get_status("w4") is None
