@@ -1,0 +1,31 @@
+"""Cluster autoscaler: demand-driven node launch/termination.
+
+TPU-native analog of the reference autoscaler
+(/root/reference/python/ray/autoscaler/_private/autoscaler.py:167
+``StandardAutoscaler``): the head-side Monitor polls the GCS for per-node
+availability and queued resource demand, binpacks the demand onto node
+*types*, and asks a pluggable NodeProvider to launch/terminate nodes.
+
+The TPU-specific twist (SURVEY.md §2.5): a TPU pod slice (e.g. ``v4-32``)
+is an *atomic* scaling unit — all its hosts come up and go down together —
+so node types may declare ``hosts_per_node > 1`` and the scheduler treats
+the whole slice as one launchable unit.
+"""
+
+from ray_tpu.autoscaler.config import (AutoscalerConfig, NodeTypeConfig,
+                                       load_config)
+from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.node_provider import (NodeProvider, NodeRecord,
+                                              register_node_provider,
+                                              get_node_provider)
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    ResourceDemandScheduler, binpack_residual)
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.monitor import Monitor
+
+__all__ = [
+    "AutoscalerConfig", "NodeTypeConfig", "load_config", "LoadMetrics",
+    "NodeProvider", "NodeRecord", "register_node_provider",
+    "get_node_provider", "ResourceDemandScheduler", "binpack_residual",
+    "StandardAutoscaler", "Monitor",
+]

@@ -1,0 +1,121 @@
+"""StandardAutoscaler: one reconcile step per ``update()`` call.
+
+Analog of /root/reference/python/ray/autoscaler/_private/autoscaler.py:167
+(``StandardAutoscaler.update`` :358): terminate idle/over-cap nodes, honor
+min_workers, binpack queued demand into node-type launches.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.config import AutoscalerConfig
+from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.node_provider import NodeProvider, NodeRecord
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    ResourceDemandScheduler)
+
+logger = logging.getLogger(__name__)
+
+
+class StandardAutoscaler:
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider):
+        config.validate()
+        self.config = config
+        self.provider = provider
+        self.scheduler = ResourceDemandScheduler(config)
+        self._launch_times: Dict[str, float] = {}  # provider node id -> t
+        self.last_status: dict = {}
+
+    # ------------------------------------------------------------------ util
+    def _nodes_by_type(self, records: List[NodeRecord]) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for rec in records:
+            counts[rec.node_type] = counts.get(rec.node_type, 0) + 1
+        return counts
+
+    def _record_for(self, records: List[NodeRecord],
+                    view_labels: Dict[str, str]) -> Optional[NodeRecord]:
+        nid = view_labels.get("autoscaler-node-id")
+        for rec in records:
+            if rec.node_id == nid:
+                return rec
+        return None
+
+    # ---------------------------------------------------------------- update
+    def update(self, lm: LoadMetrics) -> dict:
+        records = self.provider.non_terminated_nodes()
+
+        # 1. idle termination: every host of a launch unit must be idle past
+        #    the timeout (slice-atomic: one busy host keeps the slice)
+        idle_by_unit: Dict[str, List[float]] = {}
+        for view in lm.alive_nodes():
+            rec = self._record_for(records, view.labels)
+            if rec is None:
+                continue  # head node or externally-managed
+            idle_by_unit.setdefault(rec.node_id, []).append(view.idle_s)
+        counts = self._nodes_by_type(records)
+        terminated = []
+        for rec in list(records):
+            idles = idle_by_unit.get(rec.node_id)
+            if rec.state != "running" or not idles:
+                continue
+            nt = self.config.node_types.get(rec.node_type)
+            if nt and counts.get(rec.node_type, 0) <= nt.min_workers:
+                continue
+            if min(idles) > self.config.idle_timeout_s:
+                logger.info("terminating idle node %s (%s)", rec.node_id,
+                            rec.node_type)
+                self.provider.terminate_node(rec.node_id)
+                counts[rec.node_type] -= 1
+                records.remove(rec)
+                terminated.append(rec.node_id)
+
+        # 2. launches: free capacity = available of alive autoscaled nodes +
+        #    head; launch units for min_workers + residual queued demand.
+        #    Nodes terminated in step 1 must not absorb demand (lm was
+        #    snapshotted before the termination).
+        gone = set(terminated)
+        free_caps = [dict(v.available) for v in lm.alive_nodes()
+                     if v.labels.get("autoscaler-node-id") not in gone]
+        # in-flight launches (units not yet registered with the GCS) count
+        # with their full capacity so repeated updates are idempotent
+        registered = set(idle_by_unit)
+        for rec in records:
+            if rec.node_id not in registered:
+                nt = self.config.node_types.get(rec.node_type)
+                if nt is not None:
+                    free_caps.append(dict(nt.total_resources))
+        to_launch = self.scheduler.get_nodes_to_launch(
+            [dict(d) for d in lm.pending_demand], free_caps,
+            self._nodes_by_type(records))
+        # upscaling_speed bounds launches per tick as a multiple of the
+        # current cluster size (reference autoscaler semantics): at least 1,
+        # so a cold cluster can always start
+        num_pending = sum(1 for r in records if r.state == "pending")
+        allowance = max(1, math.ceil(
+            self.config.upscaling_speed * max(1, len(records)))) - num_pending
+        launched = []
+        for type_name, count in to_launch.items():
+            nt = self.config.node_types[type_name]
+            for _ in range(count):
+                if allowance <= 0:
+                    break
+                rec = self.provider.create_node(
+                    type_name, nt.node_config, nt.resources,
+                    nt.hosts_per_node, nt.labels)
+                self._launch_times[rec.node_id] = time.time()
+                launched.append(rec.node_id)
+                allowance -= 1
+
+        self.last_status = {
+            "nodes": {rec.node_id: rec.node_type for rec in records},
+            "launched": launched,
+            "terminated": terminated,
+            "pending_demand": len(lm.pending_demand),
+            "usage": lm.summary(),
+        }
+        return self.last_status

@@ -1,0 +1,106 @@
+"""Autoscaler cluster config (analog of the reference's cluster YAML +
+ray-schema.json validation, /root/reference/python/ray/autoscaler/ray-schema.json).
+
+A config is a plain dict (or YAML file) of the shape::
+
+    cluster_name: demo
+    max_workers: 8
+    idle_timeout_s: 300
+    provider: {type: fake, ...}
+    available_node_types:
+      cpu-worker:
+        resources: {CPU: 4}
+        min_workers: 0
+        max_workers: 8
+      tpu-v4-32:
+        resources: {TPU: 4, CPU: 8}   # per host
+        hosts_per_node: 4             # slice = 4 hosts, atomic
+        min_workers: 0
+        max_workers: 2
+    head_node_type: cpu-worker
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 2 ** 30
+    # TPU pod slices: how many hosts one launched "node" expands into.
+    # All hosts of a slice are created/terminated together (atomic).
+    hosts_per_node: int = 1
+    labels: Dict[str, str] = field(default_factory=dict)
+    node_config: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def total_resources(self) -> Dict[str, float]:
+        """Aggregate resources of one launch unit (whole slice)."""
+        return {r: v * self.hosts_per_node for r, v in self.resources.items()}
+
+
+@dataclass
+class AutoscalerConfig:
+    cluster_name: str = "default"
+    max_workers: int = 8
+    idle_timeout_s: float = 300.0
+    upscaling_speed: float = 1.0
+    provider: Dict[str, Any] = field(default_factory=lambda: {"type": "fake"})
+    node_types: Dict[str, NodeTypeConfig] = field(default_factory=dict)
+    head_node_type: Optional[str] = None
+
+    def validate(self) -> None:
+        if self.max_workers < 0:
+            raise ValueError("max_workers must be >= 0")
+        for nt in self.node_types.values():
+            if nt.min_workers > nt.max_workers:
+                raise ValueError(
+                    f"node type {nt.name}: min_workers > max_workers")
+            if nt.hosts_per_node < 1:
+                raise ValueError(f"node type {nt.name}: hosts_per_node < 1")
+            if not nt.resources:
+                raise ValueError(f"node type {nt.name}: empty resources")
+        if self.head_node_type and self.head_node_type not in self.node_types:
+            raise ValueError(f"unknown head_node_type {self.head_node_type}")
+
+
+def load_config(source: Any) -> AutoscalerConfig:
+    """Build an AutoscalerConfig from a dict or a YAML file path."""
+    if isinstance(source, AutoscalerConfig):
+        source.validate()
+        return source
+    if isinstance(source, str):
+        import yaml
+        with open(source) as f:
+            source = yaml.safe_load(f)
+    if not isinstance(source, dict):
+        raise TypeError(f"config must be dict/path, got {type(source)}")
+    types = {}
+    for name, spec in (source.get("available_node_types") or {}).items():
+        types[name] = NodeTypeConfig(
+            name=name,
+            resources=dict(spec.get("resources", {})),
+            min_workers=int(spec.get("min_workers", 0)),
+            max_workers=int(spec.get("max_workers", 2 ** 30)),
+            hosts_per_node=int(spec.get("hosts_per_node", 1)),
+            labels=dict(spec.get("labels", {})),
+            node_config=dict(spec.get("node_config", {})),
+        )
+    cfg = AutoscalerConfig(
+        cluster_name=source.get("cluster_name", "default"),
+        max_workers=int(source.get("max_workers", 8)),
+        idle_timeout_s=float(
+            source.get("idle_timeout_s",
+                       60.0 * source.get("idle_timeout_minutes", 5))),
+        upscaling_speed=float(source.get("upscaling_speed", 1.0)),
+        provider=dict(source.get("provider", {"type": "fake"})),
+        node_types=types,
+        head_node_type=source.get("head_node_type"),
+    )
+    cfg.validate()
+    return cfg

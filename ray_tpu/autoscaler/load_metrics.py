@@ -1,0 +1,60 @@
+"""LoadMetrics: the autoscaler's view of cluster utilization + demand.
+
+Analog of /root/reference/python/ray/autoscaler/_private/load_metrics.py:65 —
+but fed from our GCS ``list_nodes`` snapshot (each node carries ``available``,
+``load`` demand shapes, and ``idle_s`` from its raylet heartbeats) instead of
+parsed heartbeat protos.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class NodeView:
+    node_id: str                       # raylet node id (hex)
+    resources: Dict[str, float]
+    available: Dict[str, float]
+    labels: Dict[str, str]
+    alive: bool
+    idle_s: float
+
+
+@dataclass
+class LoadMetrics:
+    nodes: List[NodeView] = field(default_factory=list)
+    # flattened queued demand: one resource-dict per queued lease request
+    pending_demand: List[Dict[str, float]] = field(default_factory=list)
+
+    @classmethod
+    def from_gcs_snapshot(cls, nodes: List[dict]) -> "LoadMetrics":
+        views, demand = [], []
+        for n in nodes:
+            views.append(NodeView(
+                node_id=n["node_id"],
+                resources=dict(n.get("resources", {})),
+                available=dict(n.get("available", {})),
+                labels=dict(n.get("labels", {})),
+                alive=bool(n.get("alive")),
+                idle_s=float(n.get("idle_s", 0.0)),
+            ))
+            for entry in n.get("load", []):
+                demand.extend([dict(entry["shape"])] * int(entry["count"]))
+        return cls(nodes=views, pending_demand=demand)
+
+    def alive_nodes(self) -> List[NodeView]:
+        return [n for n in self.nodes if n.alive]
+
+    def summary(self) -> dict:
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in self.alive_nodes():
+            for r, v in n.resources.items():
+                total[r] = total.get(r, 0) + v
+            for r, v in n.available.items():
+                avail[r] = avail.get(r, 0) + v
+        return {"total": total, "available": avail,
+                "pending_demand": len(self.pending_demand),
+                "num_nodes": len(self.alive_nodes())}

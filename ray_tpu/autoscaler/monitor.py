@@ -1,0 +1,73 @@
+"""Autoscaler Monitor: the head-side polling loop.
+
+Analog of /root/reference/python/ray/autoscaler/_private/monitor.py:126 —
+polls the GCS for the cluster snapshot, feeds LoadMetrics into
+StandardAutoscaler.update, and publishes a status blob into the GCS KV for
+``ray status`` to read.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Optional
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.config import load_config
+from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.node_provider import get_node_provider
+
+STATUS_KEY = "__autoscaler_status"
+
+
+class Monitor:
+    def __init__(self, gcs_address, config: Any, *,
+                 session_dir: Optional[str] = None,
+                 poll_period_s: float = 1.0):
+        from ray_tpu.runtime.gcs import GcsClient
+        self.config = load_config(config)
+        self.gcs = GcsClient(tuple(gcs_address))
+        provider_kwargs = {}
+        if self.config.provider.get("type", "fake") == "fake":
+            provider_kwargs = {"gcs_address": tuple(gcs_address),
+                               "session_dir": session_dir}
+        self.provider = get_node_provider(self.config.provider,
+                                          self.config.cluster_name,
+                                          **provider_kwargs)
+        self.autoscaler = StandardAutoscaler(self.config, self.provider)
+        self.poll_period_s = poll_period_s
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run_once(self) -> dict:
+        nodes = self.gcs.call("list_nodes")
+        lm = LoadMetrics.from_gcs_snapshot(nodes)
+        status = self.autoscaler.update(lm)
+        status["time"] = time.time()
+        try:
+            self.gcs.kv_put(STATUS_KEY, json.dumps(status).encode())
+        except Exception:
+            pass
+        return status
+
+    def start(self) -> None:
+        def loop():
+            while not self._stopped.wait(self.poll_period_s):
+                try:
+                    self.run_once()
+                except (ConnectionError, OSError):
+                    return  # GCS gone; monitor dies with the head
+                except Exception:  # autoscaler must never crash the head
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "autoscaler update failed")
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.provider.shutdown()
+        self.gcs.close()

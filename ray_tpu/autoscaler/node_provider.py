@@ -1,0 +1,111 @@
+"""Pluggable node providers (analog of
+/root/reference/python/ray/autoscaler/node_provider.py:13 ``NodeProvider``).
+
+A provider owns the cloud-side lifecycle of worker nodes. One *node* here is
+one launch unit: for a TPU pod-slice type it expands to ``hosts_per_node``
+raylet hosts that are created and destroyed together.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class NodeRecord:
+    node_id: str                     # provider-side id (one launch unit)
+    node_type: str
+    state: str = "pending"           # pending | running | terminated
+    tags: Dict[str, str] = field(default_factory=dict)
+    # raylet node ids (hex) of the hosts backing this launch unit, once up
+    raylet_ids: List[str] = field(default_factory=list)
+
+
+class NodeProvider:
+    """Abstract provider interface."""
+
+    def __init__(self, provider_config: Dict[str, Any], cluster_name: str):
+        self.provider_config = provider_config
+        self.cluster_name = cluster_name
+
+    def non_terminated_nodes(self) -> List[NodeRecord]:
+        raise NotImplementedError
+
+    def create_node(self, node_type: str, node_config: Dict[str, Any],
+                    resources: Dict[str, float], hosts: int,
+                    labels: Dict[str, str]) -> NodeRecord:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+_PROVIDERS: Dict[str, Callable[..., NodeProvider]] = {}
+
+
+def register_node_provider(name: str,
+                           factory: Callable[..., NodeProvider]) -> None:
+    _PROVIDERS[name] = factory
+
+
+def get_node_provider(provider_config: Dict[str, Any],
+                      cluster_name: str, **kwargs) -> NodeProvider:
+    ptype = provider_config.get("type", "fake")
+    if ptype not in _PROVIDERS:
+        # lazy-register built-ins
+        if ptype == "fake":
+            from ray_tpu.autoscaler.fake_provider import FakeMultiNodeProvider
+            register_node_provider("fake", FakeMultiNodeProvider)
+        elif ptype in ("tpu", "gce-tpu"):
+            from ray_tpu.autoscaler.tpu_provider import TpuPodSliceProvider
+            register_node_provider(ptype, TpuPodSliceProvider)
+        else:
+            raise ValueError(f"unknown node provider type: {ptype}")
+    return _PROVIDERS[ptype](provider_config, cluster_name, **kwargs)
+
+
+class InMemoryNodeProvider(NodeProvider):
+    """Bookkeeping-only provider for unit tests: nodes are records, nothing
+    is launched. ``mark_running`` simulates cloud boot completion."""
+
+    def __init__(self, provider_config: Dict[str, Any],
+                 cluster_name: str = "default"):
+        super().__init__(provider_config, cluster_name)
+        self._nodes: Dict[str, NodeRecord] = {}
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def non_terminated_nodes(self) -> List[NodeRecord]:
+        with self._lock:
+            return [n for n in self._nodes.values()
+                    if n.state != "terminated"]
+
+    def create_node(self, node_type, node_config, resources, hosts,
+                    labels) -> NodeRecord:
+        with self._lock:
+            nid = f"mem-{self._next}"
+            self._next += 1
+            rec = NodeRecord(node_id=nid, node_type=node_type,
+                             tags={"hosts": str(hosts)})
+            self._nodes[nid] = rec
+            return rec
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            if node_id in self._nodes:
+                self._nodes[node_id].state = "terminated"
+
+    def mark_running(self, node_id: str,
+                     raylet_ids: Optional[List[str]] = None) -> None:
+        with self._lock:
+            rec = self._nodes[node_id]
+            rec.state = "running"
+            rec.raylet_ids = raylet_ids or []
+
+
+register_node_provider("mem", InMemoryNodeProvider)

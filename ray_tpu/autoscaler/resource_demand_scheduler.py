@@ -1,0 +1,125 @@
+"""Demand→node-type binpacking (analog of
+/root/reference/python/ray/autoscaler/_private/resource_demand_scheduler.py:103
+``ResourceDemandScheduler.get_nodes_to_launch`` / ``_resource_based_utilization_scorer``).
+
+Strategy: first-fit the queued demand onto the free capacity of existing
+nodes; for the residual, greedily pick the node type with the best
+utilization score per launch unit until the demand is covered or caps are
+hit. A TPU pod-slice type scores with its whole-slice resources so a demand
+for 32 chips maps to one v4-32 unit, not 8 loose hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.autoscaler.config import AutoscalerConfig, NodeTypeConfig
+
+
+def _fits(free: Dict[str, float], need: Dict[str, float]) -> bool:
+    return all(free.get(r, 0.0) >= v for r, v in need.items())
+
+
+def _consume(free: Dict[str, float], need: Dict[str, float]) -> None:
+    for r, v in need.items():
+        free[r] = free.get(r, 0.0) - v
+
+
+def binpack_residual(free_caps: List[Dict[str, float]],
+                     demands: List[Dict[str, float]]
+                     ) -> List[Dict[str, float]]:
+    """First-fit demands onto free capacities; return the unfit residual."""
+    caps = [dict(c) for c in free_caps]
+    residual = []
+    for need in demands:
+        placed = False
+        for cap in caps:
+            if _fits(cap, need):
+                _consume(cap, need)
+                placed = True
+                break
+        if not placed:
+            residual.append(need)
+    return residual
+
+
+def _utilization_score(nt: NodeTypeConfig,
+                       demands: List[Dict[str, float]]
+                       ) -> Optional[Tuple[int, int, float]]:
+    """(-wasted resource kinds, num demands that fit, mean utilization).
+
+    Leading term steers CPU-only demand away from accelerator hosts: a type
+    whose TPU/GPU would sit entirely unused scores below a plain CPU type
+    (same idea as the reference's _resource_based_utilization_scorer
+    matching-resource-types term). None if no demand fits.
+    """
+    cap = dict(nt.total_resources)
+    fit = 0
+    for need in demands:
+        if _fits(cap, need):
+            _consume(cap, need)
+            fit += 1
+    if fit == 0:
+        return None
+    total = nt.total_resources
+    used_frac = [1.0 - cap[r] / total[r] for r in total if total[r] > 0]
+    mean_util = sum(used_frac) / max(len(used_frac), 1)
+    wasted = sum(1 for r in total if total[r] > 0 and cap[r] == total[r])
+    return (-wasted, fit, mean_util)
+
+
+class ResourceDemandScheduler:
+    def __init__(self, config: AutoscalerConfig):
+        self.config = config
+
+    def get_nodes_to_launch(
+            self,
+            demands: List[Dict[str, float]],
+            free_caps: List[Dict[str, float]],
+            current_by_type: Dict[str, int],
+    ) -> Dict[str, int]:
+        """Decide launches: cover min_workers, then binpack residual demand.
+
+        current_by_type counts non-terminated launch units per node type
+        (pending launches included, so repeated calls are idempotent).
+        """
+        to_launch: Dict[str, int] = {}
+        counts = dict(current_by_type)
+        total_units = sum(counts.values())
+
+        def can_launch(nt: NodeTypeConfig) -> bool:
+            return (counts.get(nt.name, 0) < nt.max_workers
+                    and total_units < self.config.max_workers)
+
+        # 1. honor per-type min_workers
+        planned_caps = list(free_caps)
+        for nt in self.config.node_types.values():
+            while counts.get(nt.name, 0) < nt.min_workers and \
+                    total_units < self.config.max_workers:
+                to_launch[nt.name] = to_launch.get(nt.name, 0) + 1
+                counts[nt.name] = counts.get(nt.name, 0) + 1
+                total_units += 1
+                planned_caps.append(dict(nt.total_resources))
+
+        # 2. binpack queued demand onto existing + planned capacity
+        residual = binpack_residual(planned_caps, demands)
+
+        # 3. greedily launch the best-scoring type for the residual
+        while residual:
+            best: Optional[Tuple[Tuple[int, float], NodeTypeConfig]] = None
+            for nt in self.config.node_types.values():
+                if not can_launch(nt):
+                    continue
+                score = _utilization_score(nt, residual)
+                if score is None:
+                    continue
+                if best is None or score > best[0]:
+                    best = (score, nt)
+            if best is None:
+                break  # infeasible residual (report upward, don't spin)
+            nt = best[1]
+            to_launch[nt.name] = to_launch.get(nt.name, 0) + 1
+            counts[nt.name] = counts.get(nt.name, 0) + 1
+            total_units += 1
+            residual = binpack_residual([dict(nt.total_resources)], residual)
+        return to_launch

@@ -105,3 +105,33 @@ class Cluster:
             except Exception:
                 pass
         self._node_procs.stop()
+
+
+class AutoscalingCluster:
+    """A head node plus a live autoscaler Monitor over the fake provider.
+
+    Analog of /root/reference/python/ray/cluster_utils.py:24
+    ``AutoscalingCluster``: runs the real StandardAutoscaler loop against
+    raylet subprocesses so tests exercise demand-driven scale-up/down
+    (SURVEY.md §4, test_autoscaler_fake_multinode.py).
+    """
+
+    def __init__(self, config: dict,
+                 head_resources: Optional[Dict[str, float]] = None,
+                 poll_period_s: float = 0.5):
+        from ray_tpu.autoscaler.monitor import Monitor
+        self.cluster = Cluster(head_resources=head_resources or {"CPU": 1})
+        cfg = dict(config)
+        cfg.setdefault("provider", {"type": "fake"})
+        self.monitor = Monitor(self.cluster.gcs_address, cfg,
+                               session_dir=self.cluster.session_dir,
+                               poll_period_s=poll_period_s)
+        self.monitor.start()
+
+    @property
+    def address(self) -> str:
+        return self.cluster.address
+
+    def shutdown(self) -> None:
+        self.monitor.stop()
+        self.cluster.shutdown()

@@ -104,6 +104,8 @@ class GcsServer:
                 "labels": dict(p.get("labels", {})),
                 "alive": True,
                 "last_heartbeat": time.monotonic(),
+                "last_busy": time.monotonic(),
+                "load": [],
             }
             self._node_conns[node_id] = conn
             conn.peer = ("node", node_id)
@@ -144,11 +146,22 @@ class GcsServer:
                 return {"ok": False, "dead": True}
             node["last_heartbeat"] = time.monotonic()
             node["available"] = dict(p.get("available", node["available"]))
+            node["load"] = list(p.get("load", []))
+            busy = bool(p.get("busy"))
+            if busy or node.get("busy"):
+                node["last_busy"] = time.monotonic()
+            node["busy"] = busy
         return {"ok": True}
 
     def _rpc_list_nodes(self, conn, p):
+        now = time.monotonic()
         with self._lock:
-            return [dict(n) for n in self._nodes.values()]
+            out = []
+            for n in self._nodes.values():
+                d = dict(n)
+                d["idle_s"] = now - n.get("last_busy", now)
+                out.append(d)
+            return out
 
     def _health_loop(self) -> None:
         period = CONFIG.heartbeat_period_ms / 1000.0

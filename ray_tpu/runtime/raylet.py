@@ -119,6 +119,8 @@ class Raylet:
         self._hb_thread.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
+        self._spiller = threading.Thread(target=self._spill_loop, daemon=True)
+        self._spiller.start()
 
     # --------------------------------------------------------------- serving
     def _handle(self, conn: rpc.Connection, method: str, p: Any) -> Any:
@@ -145,9 +147,32 @@ class Raylet:
             try:
                 with self._res_lock:
                     avail = dict(self.available)
+                with self._lock:
+                    # aggregate queued lease demand by resource shape so the
+                    # autoscaler can binpack it (reference: resource_load_by_shape
+                    # carried in heartbeats to GCS for the monitor)
+                    shapes: Dict[tuple, int] = {}
+                    for req in self._pending_leases:
+                        need = dict(req["resources"])
+                        need.setdefault("CPU", 1.0)
+                        key = tuple(sorted(need.items()))
+                        shapes[key] = shapes.get(key, 0) + 1
+                    load = [{"shape": dict(k), "count": c}
+                            for k, c in shapes.items()]
+                    busy = bool(self._leases) or bool(self._bundle_pools)
+                if not busy:
+                    # a node whose store still holds live objects is not idle:
+                    # terminating it would strand ObjectRefs (no lineage
+                    # re-execution recovers a deleted primary copy)
+                    try:
+                        busy = self.store.stats()["num_objects"] > 0
+                    except Exception:
+                        busy = True
                 reply = self.gcs.call("heartbeat",
                                       {"node_id": self.node_id.hex(),
-                                       "available": avail})
+                                       "available": avail,
+                                       "load": load,
+                                       "busy": busy})
                 if reply and reply.get("dead"):
                     # the GCS declared us dead and restarted our actors
                     # elsewhere; fate-share instead of running split-brain
@@ -159,6 +184,59 @@ class Raylet:
                 if self._stopped.is_set():
                     return
                 logger.warning("heartbeat to GCS failed")
+
+    def _spill_loop(self) -> None:
+        """Dedicated thread: never blocks heartbeats (a slow GCS list_nodes
+        here must not delay liveness reporting past the death threshold)."""
+        while not self._stopped.wait(1.0):
+            try:
+                self._spill_scan()
+            except Exception:
+                logger.exception("spill scan failed")
+
+    def _spill_scan(self) -> None:
+        """Redirect stale queued leases to nodes that now have capacity.
+
+        When the autoscaler (ray_tpu/autoscaler/) brings a node up, requests
+        queued here before it existed would otherwise sit until their lease
+        timeout; this is the queued-side half of the reference's spillback
+        (cluster_task_manager spilling queued work on cluster view changes).
+        """
+        with self._lock:
+            stale = [r for r in self._pending_leases
+                     if r.get("pool") is None and r.get("spillback", 0) < 2
+                     and time.monotonic() - r.get("t_queued", 0) > 1.0]
+        if not stale:
+            return
+        # one cluster snapshot per scan, shared across all stale requests
+        try:
+            nodes = self.gcs.call("list_nodes", timeout=2)
+        except (ConnectionError, rpc.RemoteError, TimeoutError):
+            return
+        remote_nodes = [n for n in nodes
+                        if n["node_id"] != self.node_id.hex() and n["alive"]]
+        for req in stale:
+            need = dict(req["resources"])
+            need.setdefault("CPU", 1.0)
+            with self._res_lock:
+                local_ok = all(self.available.get(r, 0) >= v
+                               for r, v in need.items())
+            if local_ok:
+                continue
+            target = None
+            for node in remote_nodes:
+                if all(node["available"].get(r, 0) >= v
+                       for r, v in need.items()):
+                    target = tuple(node["address"])
+                    break
+            if target is None:
+                continue
+            with self._lock:
+                if req not in self._pending_leases:
+                    continue  # granted concurrently
+                self._pending_leases.remove(req)
+                req["out"]["grant"] = {"retry_at": list(target)}
+                req["event"].set()
 
     def _reap_loop(self) -> None:
         """Detect dead worker processes (cf. WorkerPool child monitoring)."""
@@ -378,7 +456,8 @@ class Raylet:
         event = threading.Event()
         req = {"key": p.get("key", ""), "resources": p.get("resources", {}),
                "job_id": p.get("job_id"), "env": p.get("env") or {},
-               "pool": pool_key,
+               "pool": pool_key, "spillback": spillback,
+               "t_queued": time.monotonic(),
                "event": event, "out": fut_holder}
         with self._lock:
             self._pending_leases.append(req)
@@ -598,13 +677,15 @@ def main():  # pragma: no cover - subprocess entry
     parser.add_argument("--resources", default="{}")
     parser.add_argument("--object-store-memory", type=int, default=0)
     parser.add_argument("--address-file", default=None)
+    parser.add_argument("--labels", default="{}")
     args = parser.parse_args()
     from ray_tpu._private.logging_utils import setup_component_logging
     setup_component_logging("raylet", args.session_dir)
     resources = json.loads(args.resources) or None
     raylet = Raylet((args.gcs_host, args.gcs_port), args.session_dir,
                     resources=resources,
-                    object_store_memory=args.object_store_memory or None)
+                    object_store_memory=args.object_store_memory or None,
+                    labels=json.loads(args.labels) or None)
     if args.address_file:
         tmp = args.address_file + ".tmp"
         with open(tmp, "w") as f:

@@ -45,14 +45,19 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     """Run a DAG durably to completion; returns the final value."""
     storage = _get_storage()
     workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    dag_bytes = cloudpickle.dumps((dag, input_value))
     if not storage.workflow_exists(workflow_id):
         storage.create_workflow(workflow_id)
     else:
+        # re-running an existing id: stale checkpoints from a *different*
+        # DAG must not be served (step ids are positional and would collide)
+        import hashlib
+        if storage.dag_digest(workflow_id) != \
+                hashlib.sha256(dag_bytes).hexdigest():
+            storage.clear_steps(workflow_id)
         storage.set_status(workflow_id, st.STATUS_RUNNING)
     # always persist THIS dag so a later resume() replays what actually ran
-    storage._atomic_write(
-        os.path.join(storage._wf_dir(workflow_id), "dag.pkl"),
-        cloudpickle.dumps((dag, input_value)))
+    storage.save_dag(workflow_id, dag_bytes)
     return execute_workflow(storage, workflow_id, dag, input_value)
 
 
@@ -93,9 +98,7 @@ def resume(workflow_id: str) -> Any:
     storage = _get_storage()
     if not storage.workflow_exists(workflow_id):
         raise ValueError(f"no workflow {workflow_id!r}")
-    with open(os.path.join(storage._wf_dir(workflow_id), "dag.pkl"),
-              "rb") as f:
-        dag, input_value = cloudpickle.loads(f.read())
+    dag, input_value = cloudpickle.loads(storage.load_dag(workflow_id))
     storage.set_status(workflow_id, st.STATUS_RUNNING)
     return execute_workflow(storage, workflow_id, dag, input_value)
 

@@ -12,6 +12,7 @@ Layout:
 
 from __future__ import annotations
 
+import hashlib
 import os
 import shutil
 from typing import Any, List, Optional
@@ -94,6 +95,28 @@ class WorkflowStorage:
         except Exception:  # noqa: BLE001 - unpicklable exception
             data = cloudpickle.dumps(RuntimeError(repr(err)))
         self._atomic_write(os.path.join(d, "exception.pkl"), data)
+
+    # ------------------------------------------------------------------ dag
+    def save_dag(self, workflow_id: str, dag_bytes: bytes) -> None:
+        self._atomic_write(
+            os.path.join(self._wf_dir(workflow_id), "dag.pkl"), dag_bytes)
+
+    def load_dag(self, workflow_id: str) -> bytes:
+        with open(os.path.join(self._wf_dir(workflow_id), "dag.pkl"),
+                  "rb") as f:
+            return f.read()
+
+    def dag_digest(self, workflow_id: str) -> Optional[str]:
+        try:
+            return hashlib.sha256(self.load_dag(workflow_id)).hexdigest()
+        except FileNotFoundError:
+            return None
+
+    def clear_steps(self, workflow_id: str) -> None:
+        """Drop all step checkpoints (the DAG changed; old results would be
+        silently wrong for new step ids that happen to collide)."""
+        shutil.rmtree(os.path.join(self._wf_dir(workflow_id), "steps"),
+                      ignore_errors=True)
 
     # ---------------------------------------------------------------- misc
     @staticmethod

@@ -1,0 +1,215 @@
+"""Autoscaler tests (cf. reference python/ray/tests/test_resource_demand_scheduler.py
+and test_autoscaler_fake_multinode.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (AutoscalerConfig, LoadMetrics, NodeTypeConfig,
+                                ResourceDemandScheduler, StandardAutoscaler,
+                                binpack_residual, load_config)
+from ray_tpu.autoscaler.load_metrics import NodeView
+from ray_tpu.autoscaler.node_provider import InMemoryNodeProvider
+from ray_tpu.autoscaler.tpu_provider import TpuPodSliceProvider
+
+
+def make_config(**kw):
+    return load_config({
+        "cluster_name": "t",
+        "max_workers": kw.pop("max_workers", 8),
+        "idle_timeout_s": kw.pop("idle_timeout_s", 300),
+        "provider": {"type": "mem"},
+        "available_node_types": kw.pop("types", {
+            "cpu4": {"resources": {"CPU": 4}, "max_workers": 8},
+        }),
+        **kw,
+    })
+
+
+def view(node_id, resources, available=None, idle_s=0.0, labels=None):
+    return NodeView(node_id=node_id, resources=dict(resources),
+                    available=dict(available
+                                   if available is not None else resources),
+                    labels=labels or {}, alive=True, idle_s=idle_s)
+
+
+def test_binpack_residual():
+    free = [{"CPU": 4}, {"CPU": 2}]
+    demands = [{"CPU": 2}] * 4
+    assert binpack_residual(free, demands) == [{"CPU": 2}]
+    assert binpack_residual([], [{"CPU": 1}]) == [{"CPU": 1}]
+    # resource the capacity lacks entirely
+    assert binpack_residual([{"CPU": 8}], [{"TPU": 1}]) == [{"TPU": 1}]
+
+
+def test_demand_launches_best_fit_type():
+    cfg = make_config(types={
+        "cpu4": {"resources": {"CPU": 4}, "max_workers": 8},
+        "tpu-host": {"resources": {"TPU": 4, "CPU": 8}, "max_workers": 8},
+    })
+    sched = ResourceDemandScheduler(cfg)
+    # CPU-only demand should pick the CPU type, not burn a TPU host
+    out = sched.get_nodes_to_launch([{"CPU": 4}] * 2, [], {})
+    assert out == {"cpu4": 2}
+    # TPU demand must pick the TPU type
+    out = sched.get_nodes_to_launch([{"TPU": 4}], [], {})
+    assert out == {"tpu-host": 1}
+
+
+def test_existing_capacity_absorbs_demand():
+    cfg = make_config()
+    sched = ResourceDemandScheduler(cfg)
+    out = sched.get_nodes_to_launch([{"CPU": 2}] * 2, [{"CPU": 4}], {})
+    assert out == {}
+
+
+def test_min_and_max_workers():
+    cfg = make_config(types={
+        "cpu4": {"resources": {"CPU": 4}, "min_workers": 2, "max_workers": 3},
+    })
+    sched = ResourceDemandScheduler(cfg)
+    # min_workers honored with zero demand
+    assert sched.get_nodes_to_launch([], [], {}) == {"cpu4": 2}
+    # cap at per-type max_workers despite huge demand
+    out = sched.get_nodes_to_launch([{"CPU": 4}] * 10, [], {"cpu4": 2})
+    assert out == {"cpu4": 1}
+    # global max_workers caps too
+    cfg2 = make_config(max_workers=1)
+    out = ResourceDemandScheduler(cfg2).get_nodes_to_launch(
+        [{"CPU": 4}] * 10, [], {})
+    assert out == {"cpu4": 1}
+
+
+def test_tpu_slice_is_atomic_unit():
+    """A v4-32-style slice (4 hosts x TPU:4) launches as ONE unit and its
+    whole-slice resources satisfy a 16-chip demand."""
+    cfg = make_config(types={
+        "v4-32": {"resources": {"TPU": 4, "CPU": 8}, "hosts_per_node": 4,
+                  "max_workers": 2},
+    })
+    sched = ResourceDemandScheduler(cfg)
+    out = sched.get_nodes_to_launch([{"TPU": 4}] * 4, [], {})
+    assert out == {"v4-32": 1}
+    # 8 host-demands -> 2 slices
+    out = sched.get_nodes_to_launch([{"TPU": 4}] * 8, [], {})
+    assert out == {"v4-32": 2}
+
+
+def test_infeasible_demand_does_not_spin():
+    cfg = make_config()
+    sched = ResourceDemandScheduler(cfg)
+    assert sched.get_nodes_to_launch([{"GPU": 1}], [], {}) == {}
+
+
+def test_idle_termination_respects_min_workers_and_slices():
+    cfg = make_config(idle_timeout_s=10, types={
+        "cpu4": {"resources": {"CPU": 4}, "min_workers": 1, "max_workers": 4},
+    })
+    provider = InMemoryNodeProvider({"type": "mem"})
+    auto = StandardAutoscaler(cfg, provider)
+    a = provider.create_node("cpu4", {}, {"CPU": 4}, 1, {})
+    b = provider.create_node("cpu4", {}, {"CPU": 4}, 1, {})
+    provider.mark_running(a.node_id)
+    provider.mark_running(b.node_id)
+    lm = LoadMetrics(nodes=[
+        view("ra", {"CPU": 4}, idle_s=100,
+             labels={"autoscaler-node-id": a.node_id}),
+        view("rb", {"CPU": 4}, idle_s=100,
+             labels={"autoscaler-node-id": b.node_id}),
+    ])
+    status = auto.update(lm)
+    # exactly one terminated: min_workers=1 keeps the other
+    assert len(status["terminated"]) == 1
+    # a busy host keeps its whole slice alive
+    c = provider.create_node("cpu4", {}, {"CPU": 4}, 2, {})
+    provider.mark_running(c.node_id)
+    lm2 = LoadMetrics(nodes=[
+        view("rc0", {"CPU": 4}, idle_s=100,
+             labels={"autoscaler-node-id": c.node_id}),
+        view("rc1", {"CPU": 4}, idle_s=1,
+             labels={"autoscaler-node-id": c.node_id}),
+    ])
+    status = auto.update(lm2)
+    assert c.node_id not in status["terminated"]
+
+
+def test_autoscaler_launches_for_pending_demand():
+    cfg = make_config()
+    provider = InMemoryNodeProvider({"type": "mem"})
+    auto = StandardAutoscaler(cfg, provider)
+    lm = LoadMetrics(nodes=[view("head", {"CPU": 1}, available={"CPU": 0})],
+                     pending_demand=[{"CPU": 4}])
+    status = auto.update(lm)
+    assert len(status["launched"]) == 1
+    # idempotent: pending launch counts against further demand
+    status = auto.update(lm)
+    assert status["launched"] == []
+
+
+def test_tpu_provider_dry_run_records_gcloud_calls():
+    p = TpuPodSliceProvider({"type": "tpu", "project": "proj",
+                             "zone": "us-central2-b", "dry_run": True})
+    rec = p.create_node("v4-32", {"accelerator_type": "v4-32"},
+                        {"TPU": 4}, 4, {})
+    assert rec.state == "running"
+    assert any("create" in c for c in p.calls[0])
+    assert "--accelerator-type" in p.calls[0]
+    p.terminate_node(rec.node_id)
+    assert p.non_terminated_nodes() == []
+    assert any("delete" in c for c in p.calls[1])
+    # topology mismatch rejected (slice atomicity check)
+    with pytest.raises(ValueError):
+        p.create_node("v4-32", {"accelerator_type": "v4-32"},
+                      {"TPU": 4}, 2, {})
+
+
+def test_load_metrics_from_gcs_snapshot():
+    lm = LoadMetrics.from_gcs_snapshot([
+        {"node_id": "a", "resources": {"CPU": 4}, "available": {"CPU": 1},
+         "labels": {}, "alive": True, "idle_s": 3.0,
+         "load": [{"shape": {"CPU": 2}, "count": 3}]},
+        {"node_id": "b", "resources": {"CPU": 4}, "available": {"CPU": 4},
+         "labels": {}, "alive": False, "idle_s": 0.0, "load": []},
+    ])
+    assert len(lm.pending_demand) == 3
+    assert len(lm.alive_nodes()) == 1
+    assert lm.summary()["total"] == {"CPU": 4}
+
+
+@pytest.mark.slow
+def test_fake_multinode_scale_up_and_down():
+    """End-to-end: queued tasks drive a real launch; idle node terminates.
+
+    cf. reference python/ray/tests/test_autoscaler_fake_multinode.py.
+    """
+    from ray_tpu.cluster_utils import AutoscalingCluster
+    cluster = AutoscalingCluster({
+        "max_workers": 2,
+        "idle_timeout_s": 5,
+        "available_node_types": {
+            "cpu4": {"resources": {"CPU": 4}, "max_workers": 2},
+        },
+    }, head_resources={"CPU": 0})
+    try:
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=3)
+        def f():
+            return 1
+
+        # head has no CPU: this demand can only be served by a new node
+        assert ray_tpu.get([f.remote() for _ in range(2)],
+                           timeout=120) == [1, 1]
+        records = cluster.monitor.provider.non_terminated_nodes()
+        assert len(records) >= 1
+        # after going idle, the worker node should be reclaimed
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if not cluster.monitor.provider.non_terminated_nodes():
+                break
+            time.sleep(0.5)
+        assert cluster.monitor.provider.non_terminated_nodes() == []
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
