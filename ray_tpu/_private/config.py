@@ -87,6 +87,10 @@ _declare("log_to_driver", bool, True, "Forward worker stdout/stderr to the drive
 _declare("event_stats", bool, False, "Record per-handler event-loop stats.")
 _declare("task_events_buffer_size", int, 10000,
          "Ring-buffer capacity of per-worker task state-transition events.")
+_declare("task_events_flush_interval_ms", int, 500,
+         "Period at which workers flush task events to the GCS task table.")
+_declare("gcs_max_task_events", int, 100000,
+         "Max per-task records the GCS task table keeps before GC.")
 
 # --------------------------------------------------------------------------- #
 # TPU / device model                                                          #

@@ -52,8 +52,10 @@ class ActorClass:
                  max_restarts: int = 0, name: Optional[str] = None,
                  namespace: str = "", lifetime: Optional[str] = None,
                  max_concurrency: int = 1,
-                 scheduling_strategy=None):
+                 scheduling_strategy=None,
+                 runtime_env: Optional[Dict[str, Any]] = None):
         self._cls = cls
+        self._runtime_env = runtime_env
         self._resources = dict(resources or {})
         self._resources["CPU"] = num_cpus
         if num_tpus:
@@ -81,7 +83,8 @@ class ActorClass:
             max_restarts=self._max_restarts,
             max_concurrency=self._max_concurrency,
             resources=self._resources,
-            scheduling_strategy=encode_strategy(self._scheduling_strategy))
+            scheduling_strategy=encode_strategy(self._scheduling_strategy),
+            runtime_env=worker.prepare_runtime_env(self._runtime_env))
         return ActorHandle(actor_id)
 
     def bind(self, *args, **kwargs):
@@ -104,7 +107,8 @@ class ActorClass:
             max_concurrency=opts.get("max_concurrency",
                                      self._max_concurrency),
             scheduling_strategy=opts.get("scheduling_strategy",
-                                         self._scheduling_strategy))
+                                         self._scheduling_strategy),
+            runtime_env=opts.get("runtime_env", self._runtime_env))
 
 
 def get_actor(name: str, namespace: str = "") -> ActorHandle:

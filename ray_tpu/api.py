@@ -32,6 +32,7 @@ def init(address: Optional[str] = None, *,
          resources: Optional[Dict[str, float]] = None,
          object_store_memory: Optional[int] = None,
          system_config: Optional[Dict[str, Any]] = None,
+         runtime_env: Optional[Dict[str, Any]] = None,
          namespace: str = "") -> Dict[str, Any]:
     """Start (or connect to) a cluster and attach this process as a driver.
 
@@ -97,6 +98,10 @@ def init(address: Optional[str] = None, *,
             session_dir=session_dir,
         )
         worker.namespace = namespace
+        if runtime_env:
+            # job-level default env, inherited by every task/actor that
+            # doesn't set its own (reference job_config.runtime_env)
+            worker.job_runtime_env = worker.prepare_runtime_env(runtime_env)
         worker.gcs.call("register_job", {
             "job_id": job_id.hex(),
             "driver_address": list(worker.address),
@@ -147,7 +152,8 @@ def remote(*args, **kwargs):
                 namespace=kwargs.get("namespace", ""),
                 lifetime=kwargs.get("lifetime"),
                 max_concurrency=kwargs.get("max_concurrency", 1),
-                scheduling_strategy=kwargs.get("scheduling_strategy"))
+                scheduling_strategy=kwargs.get("scheduling_strategy"),
+                runtime_env=kwargs.get("runtime_env"))
         return RemoteFunction(
             target,
             num_returns=kwargs.get("num_returns", 1),
@@ -155,7 +161,8 @@ def remote(*args, **kwargs):
             num_tpus=kwargs.get("num_tpus", 0.0),
             resources=kwargs.get("resources"),
             max_retries=kwargs.get("max_retries", 3),
-            scheduling_strategy=kwargs.get("scheduling_strategy"))
+            scheduling_strategy=kwargs.get("scheduling_strategy"),
+            runtime_env=kwargs.get("runtime_env"))
 
     if len(args) == 1 and not kwargs and callable(args[0]):
         return decorate(args[0])

@@ -1,0 +1,4 @@
+"""Experimental APIs: state introspection, internal KV.
+
+Analog of /root/reference/python/ray/experimental/.
+"""

@@ -1,0 +1,193 @@
+"""State API: `list_*` / `summarize_*` / `memory_summary` / `timeline`.
+
+Analog of /root/reference/python/ray/experimental/state/api.py (list_tasks
+etc.), state_cli.py (`ray list tasks`), _private/state.py:829 (`ray
+timeline` Chrome-trace export) and `ray memory` (refcount debugging).
+
+Data sources: the GCS tables (tasks/actors/nodes/jobs/placement groups) and
+live fan-out to raylets (`list_workers`) and core workers
+(`core_worker_stats`) for objects — mirroring the reference's
+StateDataSourceClient (state_manager.py:130).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu.runtime import core_worker as cw
+
+
+def _gcs():
+    return cw.get_global_worker().gcs
+
+
+# --------------------------------------------------------------- GCS tables
+def list_tasks(*, job_id: Optional[str] = None, state: Optional[str] = None,
+               name: Optional[str] = None, limit: int = 10000) -> List[dict]:
+    return _gcs().call("list_task_events", {
+        "job_id": job_id, "state": state, "name": name, "limit": limit})
+
+
+def list_actors(*, state: Optional[str] = None,
+                limit: int = 10000) -> List[dict]:
+    actors = _gcs().call("list_actors")
+    if state:
+        actors = [a for a in actors if a.get("state") == state]
+    return actors[:limit]
+
+
+def list_nodes(*, limit: int = 10000) -> List[dict]:
+    return _gcs().call("list_nodes")[:limit]
+
+
+def list_jobs(*, limit: int = 10000) -> List[dict]:
+    return _gcs().call("list_jobs")[:limit]
+
+
+def list_placement_groups(*, limit: int = 10000) -> List[dict]:
+    return _gcs().call("list_placement_groups")[:limit]
+
+
+# ----------------------------------------------------------------- fan-outs
+def _each_raylet(fn):
+    out = []
+    for node in list_nodes():
+        if not node.get("alive"):
+            continue
+        try:
+            conn = rpc.connect(tuple(node["address"]))
+        except OSError:
+            continue
+        try:
+            out.append((node, fn(conn)))
+        except (rpc.RpcError, ConnectionError, TimeoutError):
+            pass
+        finally:
+            conn.close()
+    return out
+
+
+def list_workers(*, limit: int = 10000) -> List[dict]:
+    workers: List[dict] = []
+    for node, rows in _each_raylet(
+            lambda c: c.call("list_workers", timeout=5)):
+        for row in rows:
+            row["node_id"] = node["node_id"]
+            workers.append(row)
+    return workers[:limit]
+
+
+def _worker_stats() -> List[dict]:
+    """core_worker_stats from every live worker + the local driver."""
+    stats = []
+    me = cw.get_global_worker()
+    stats.append(me._rpc_core_worker_stats({}))
+    for w in list_workers():
+        if not w.get("alive") or not w.get("address"):
+            continue
+        try:
+            conn = rpc.connect(tuple(w["address"]))
+        except OSError:
+            continue
+        try:
+            stats.append(conn.call("core_worker_stats", {}, timeout=5))
+        except (rpc.RpcError, ConnectionError, TimeoutError):
+            pass
+        finally:
+            conn.close()
+    return stats
+
+
+def list_objects(*, limit: int = 10000) -> List[dict]:
+    objects: List[dict] = []
+    for st in _worker_stats():
+        for obj in st["objects"]:
+            obj["owner_worker_id"] = st["worker_id"]
+            obj["owner_mode"] = st["mode"]
+            objects.append(obj)
+    return objects[:limit]
+
+
+# ---------------------------------------------------------------- summaries
+def summarize_tasks(*, job_id: Optional[str] = None) -> Dict[str, Any]:
+    summary: Dict[str, Dict[str, int]] = {}
+    for t in list_tasks(job_id=job_id):
+        per = summary.setdefault(t.get("name") or "<unknown>", {})
+        per[t["state"]] = per.get(t["state"], 0) + 1
+    return {"cluster": {"summary": summary,
+                        "total_tasks": sum(sum(v.values())
+                                           for v in summary.values())}}
+
+
+def summarize_actors() -> Dict[str, Any]:
+    summary: Dict[str, Dict[str, int]] = {}
+    for a in list_actors():
+        key = a.get("class_name") or a.get("name") or "<actor>"
+        per = summary.setdefault(key, {})
+        per[a["state"]] = per.get(a["state"], 0) + 1
+    return {"cluster": {"summary": summary}}
+
+
+def summarize_objects() -> Dict[str, Any]:
+    total = count = inline = 0
+    for o in list_objects():
+        count += 1
+        total += o.get("size", 0)
+        inline += int(bool(o.get("inline")))
+    return {"cluster": {"total_objects": count, "total_size_bytes": total,
+                        "inline_objects": inline}}
+
+
+def memory_summary() -> str:
+    """Human-readable owned-object table (analog of `ray memory`)."""
+    objects = list_objects()  # one cluster sweep for both table and totals
+    lines = ["%-18s %-10s %-8s %-5s %-10s %s" % (
+        "OBJECT_ID", "OWNER", "STATE", "REFS", "SIZE", "LOCATIONS")]
+    total = 0
+    for o in objects:
+        total += o.get("size", 0)
+        lines.append("%-18s %-10s %-8s %-5d %-10d %s" % (
+            o["object_id"][:16] + "..", o["owner_worker_id"][:8],
+            o["state"], o["refcount"], o.get("size", 0),
+            ",".join(loc[:8] for loc in o.get("locations", []))))
+    lines.append(f"--- {len(objects)} objects, {total} inline bytes ---")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- timeline
+def timeline(path: Optional[str] = None) -> List[dict]:
+    """Chrome-trace (catapult) events from the GCS task table.
+
+    Analog of `ray timeline` (/root/reference/python/ray/_private/
+    state.py:829): each task's RUNNING->FINISHED span becomes a complete
+    ("X") event on its worker's row; load the output in chrome://tracing
+    or Perfetto.
+    """
+    events: List[dict] = []
+    for t in list_tasks():
+        start = end = None
+        for ev in t.get("events", []):
+            if ev["state"] == "RUNNING":
+                start = ev["ts"]
+            elif ev["state"] in ("FINISHED", "FAILED"):
+                end = ev["ts"]
+        if start is None:
+            continue
+        if end is None or end < start:
+            end = start
+        events.append({
+            "name": t.get("name", "task"),
+            "cat": "task",
+            "ph": "X",
+            "ts": start * 1e6,
+            "dur": (end - start) * 1e6,
+            "pid": t.get("node_id", "node")[:8],
+            "tid": t.get("worker_id", "worker")[:8],
+            "args": {"task_id": t["task_id"], "state": t["state"]},
+        })
+    if path:
+        with open(path, "w") as f:
+            json.dump(events, f)
+    return events

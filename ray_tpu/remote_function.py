@@ -17,7 +17,8 @@ class RemoteFunction:
                  num_cpus: float = 1.0, num_tpus: float = 0.0,
                  resources: Optional[Dict[str, float]] = None,
                  max_retries: int = 3,
-                 scheduling_strategy: Any = None):
+                 scheduling_strategy: Any = None,
+                 runtime_env: Optional[Dict[str, Any]] = None):
         self._func = func
         self._num_returns = num_returns
         self._resources = dict(resources or {})
@@ -26,6 +27,7 @@ class RemoteFunction:
             self._resources["TPU"] = num_tpus
         self._max_retries = max_retries
         self._scheduling_strategy = scheduling_strategy
+        self._runtime_env = runtime_env
         functools.update_wrapper(self, func)
 
     def __call__(self, *args, **kwargs):
@@ -42,7 +44,8 @@ class RemoteFunction:
             resources=self._resources,
             max_retries=self._max_retries,
             name=self._func.__name__,
-            scheduling_strategy=encode_strategy(self._scheduling_strategy))
+            scheduling_strategy=encode_strategy(self._scheduling_strategy),
+            runtime_env=worker.prepare_runtime_env(self._runtime_env))
         if self._num_returns == 1:
             return refs[0]
         return refs
@@ -63,5 +66,6 @@ class RemoteFunction:
                                 if k not in ("CPU", "TPU")}),
             max_retries=opts.get("max_retries", self._max_retries),
             scheduling_strategy=opts.get("scheduling_strategy",
-                                         self._scheduling_strategy))
+                                         self._scheduling_strategy),
+            runtime_env=opts.get("runtime_env", self._runtime_env))
         return new

@@ -199,12 +199,25 @@ class CoreWorker:
         self._actor_pipes: Dict[str, "_ActorPipe"] = {}
         self._actor_lock = threading.Lock()
 
-        self._task_events = deque(maxlen=CONFIG.task_events_buffer_size)
+        # job-level default runtime env (prepared descriptor) + prepare cache
+        self.job_runtime_env: Optional[dict] = None
+        self._runtime_env_cache: Dict[str, Optional[dict]] = {}
+
+        from ray_tpu._private.task_events import TaskEventBuffer
+        # only drivers know the true job id; worker-side CoreWorkers get a
+        # random one, which must not overwrite the owner's in the task table
+        self.events = TaskEventBuffer(
+            self.gcs, job_id=self.job_id.hex() if mode == "driver" else "",
+            node_id=node_id, worker_id=self.worker_id.hex())
         self._shutdown = threading.Event()
 
     # ------------------------------------------------------------ lifecycle
     def shutdown(self) -> None:
         self._shutdown.set()
+        try:
+            self.events.stop()
+        except Exception:
+            pass
         with self._sched_lock:
             leases = [l for s in self._sched.values() for l in s["leases"]]
             self._sched.clear()
@@ -504,11 +517,13 @@ class CoreWorker:
                     max_retries: int = 3,
                     name: str = "",
                     scheduling_key: Optional[str] = None,
-                    scheduling_strategy: Optional[dict] = None
+                    scheduling_strategy: Optional[dict] = None,
+                    runtime_env: Optional[dict] = None
                     ) -> List[ObjectRef]:
         fn_key = self.register_function(func)
         task_id = TaskID.from_random()
         resources = dict(resources or {})
+        runtime_env = runtime_env or self.job_runtime_env
         # scheduling key = resource footprint (not the function): workers are
         # fungible across functions, so leases and the raylet's idle pool are
         # shared by everything with the same shape (cf. reference
@@ -520,6 +535,11 @@ class CoreWorker:
             key += "|" + ",".join(
                 f"{k}={scheduling_strategy[k]}"
                 for k in sorted(scheduling_strategy))
+        if runtime_env:
+            # workers are env-specific: a different runtime_env must never
+            # reuse another env's idle workers (reference SchedulingKey
+            # includes the serialized runtime env)
+            key += "|env=" + runtime_env["hash"]
         arg_blob, live_refs = self._serialize_args(args, kwargs)
         if live_refs:
             self._arg_refs[task_id.binary()] = live_refs
@@ -539,14 +559,12 @@ class CoreWorker:
                 entry.task_spec = cloudpickle.dumps(
                     {"spec": spec, "resources": resources, "key": key,
                      "retries_left": max_retries,
-                     "strategy": scheduling_strategy})
+                     "strategy": scheduling_strategy, "env": runtime_env})
                 self._owned[oid] = entry
                 return_refs.append(ObjectRef(oid, self.address, self))
         self._enqueue_task(key, resources, spec, max_retries,
-                           strategy=scheduling_strategy)
-        self._task_events.append(
-            {"task_id": task_id.hex(), "name": spec["name"],
-             "state": "SUBMITTED", "ts": time.time()})
+                           strategy=scheduling_strategy, env=runtime_env)
+        self.events.record(task_id.hex(), "SUBMITTED", name=spec["name"])
         return return_refs
 
     def _serialize_args(self, args: tuple, kwargs: dict):
@@ -580,6 +598,8 @@ class CoreWorker:
     def _store_task_error(self, spec, error: BaseException) -> None:
         task_id = TaskID(spec["task_id"])
         self._arg_refs.pop(spec["task_id"], None)
+        self.events.record(task_id.hex(), "FAILED", name=spec.get("name", ""),
+                           error_type=type(error).__name__)
         head, views = ser.serialize(error, error_type=ser.ERROR_TASK)
         data = ser.to_flat_bytes(head, views)
         with self._owned_lock:
@@ -594,18 +614,21 @@ class CoreWorker:
 
     # ----- per-key scheduling queue: leased workers pull pending specs -----
     def _sched_state(self, key: str, resources,
-                     strategy: Optional[dict] = None) -> Dict[str, Any]:
+                     strategy: Optional[dict] = None,
+                     env: Optional[dict] = None) -> Dict[str, Any]:
         with self._sched_lock:
             st = self._sched.get(key)
             if st is None:
                 st = {"queue": deque(), "leases": [], "requesting": False,
-                      "resources": dict(resources), "strategy": strategy}
+                      "resources": dict(resources), "strategy": strategy,
+                      "env": env}
                 self._sched[key] = st
             return st
 
     def _enqueue_task(self, key, resources, spec, retries: int,
-                      strategy: Optional[dict] = None) -> None:
-        st = self._sched_state(key, resources, strategy)
+                      strategy: Optional[dict] = None,
+                      env: Optional[dict] = None) -> None:
+        st = self._sched_state(key, resources, strategy, env)
         with self._sched_lock:
             st["queue"].append((spec, retries))
         self._maybe_request_lease(key, st)
@@ -678,7 +701,7 @@ class CoreWorker:
                 return grant
             # soft affinity fall-through: default path below
         payload = {"key": key, "resources": st["resources"],
-                   "job_id": self.job_id.hex()}
+                   "job_id": self.job_id.hex(), "env": st.get("env")}
         target_addr = None  # None -> local raylet
         for hop in range(3):
             if target_addr is None:
@@ -725,7 +748,8 @@ class CoreWorker:
         node; node_affinity -> lease from that raylet (soft falls back by
         returning None); spread -> least-loaded feasible node."""
         base = {"key": key, "resources": st["resources"],
-                "job_id": self.job_id.hex(), "spillback": 2}
+                "job_id": self.job_id.hex(), "spillback": 2,
+                "env": st.get("env")}
         kind = strategy.get("type")
         if kind == "placement_group":
             pg_id = strategy["pg_id"]
@@ -886,9 +910,29 @@ class CoreWorker:
                     entry.locations.add(result["location"])
                 entry.state = "ready"
                 entry.event.set()
-        self._task_events.append(
-            {"task_id": task_id.hex(), "name": spec["name"],
-             "state": "FINISHED", "ts": time.time()})
+        failed = any(r.get("error") for r in results)
+        self.events.record(task_id.hex(), "FAILED" if failed else "FINISHED",
+                           name=spec["name"])
+
+    def prepare_runtime_env(self, raw: Optional[dict]) -> Optional[dict]:
+        """Package+upload a raw runtime_env; memoised on the spec plus a
+        cheap mtime/size fingerprint of any local paths, so edits to a
+        working_dir between submits re-upload instead of serving stale
+        code, while unchanged trees skip the zip+upload entirely."""
+        if not raw:
+            return None
+        import json as _json
+        from ray_tpu.runtime_env.packaging import tree_fingerprint
+        paths = list(raw.get("py_modules") or [])
+        if raw.get("working_dir"):
+            paths.append(raw["working_dir"])
+        cache_key = _json.dumps(
+            [dict(raw), [tree_fingerprint(p) for p in paths]],
+            sort_keys=True, default=str)
+        if cache_key not in self._runtime_env_cache:
+            from ray_tpu.runtime_env import prepare_runtime_env as _prep
+            self._runtime_env_cache[cache_key] = _prep(raw, self.gcs)
+        return self._runtime_env_cache[cache_key]
 
     # --------------------------------------------------------------- actors
     def create_actor(self, cls, args, kwargs, *, name: Optional[str] = None,
@@ -896,7 +940,8 @@ class CoreWorker:
                      max_restarts: int = 0,
                      max_concurrency: int = 1,
                      resources: Optional[Dict[str, float]] = None,
-                     scheduling_strategy: Optional[dict] = None) -> "ActorID":
+                     scheduling_strategy: Optional[dict] = None,
+                     runtime_env: Optional[dict] = None) -> "ActorID":
         actor_id = ActorID.from_random()
         bundle = None
         strategy = None
@@ -927,6 +972,7 @@ class CoreWorker:
             "max_restarts": max_restarts,
             "bundle": bundle,
             "strategy": strategy,
+            "runtime_env": runtime_env or self.job_runtime_env,
         }, timeout=CONFIG.actor_creation_timeout_s)
         return actor_id
 
@@ -976,11 +1022,16 @@ class CoreWorker:
                 pipe = _ActorPipe(self, aid)
                 self._actor_pipes[aid] = pipe
         pipe.enqueue(spec, max_task_retries)
+        self.events.record(task_id.hex(), "SUBMITTED", name=method_name,
+                           actor_id=aid)
         return refs
 
     def _store_actor_error(self, spec, error: BaseException) -> None:
         task_id = TaskID(spec["task_id"])
         self._arg_refs.pop(spec["task_id"], None)
+        self.events.record(task_id.hex(), "FAILED", name=spec.get("name", ""),
+                           actor_id=spec.get("actor_id", ""),
+                           error_type=type(error).__name__)
         head, views = ser.serialize(error, error_type=ser.ERROR_ACTOR_DIED)
         data = ser.to_flat_bytes(head, views)
         with self._owned_lock:
@@ -995,12 +1046,58 @@ class CoreWorker:
 
     def kill_actor(self, actor_id: ActorID) -> None:
         self.gcs.call("kill_actor", {"actor_id": actor_id.hex()})
+        # The GCS marks the actor DEAD before replying, but our pipe may
+        # still hold a live connection to the (not-yet-exited) worker —
+        # sever it so calls submitted after kill() returns deterministically
+        # re-resolve via the GCS and fail with ActorDiedError instead of
+        # racing the worker's exit.
+        with self._actor_lock:
+            pipe = self._actor_pipes.get(actor_id.hex())
+        if pipe is not None:
+            with pipe.cv:
+                conn = pipe.conn
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
 
     # ----------------------------------------------------------- rpc server
     def _handle_rpc(self, conn: rpc.Connection, method: str, p: Any) -> Any:
         if method == "get_object":
             return self._rpc_get_object(p or {})
+        if method == "core_worker_stats":
+            return self._rpc_core_worker_stats(p or {})
         raise rpc.RpcError(f"core_worker: unknown method {method}")
+
+    def _rpc_core_worker_stats(self, p) -> dict:
+        """Owned-object + submission introspection for the state API's
+        `list objects` / `memory` views (cf. reference
+        CoreWorkerService.GetCoreWorkerStats, core_worker.proto)."""
+        objects = []
+        with self._owned_lock:
+            for oid, entry in self._owned.items():
+                objects.append({
+                    "object_id": oid.hex(),
+                    "state": entry.state,
+                    "refcount": entry.refcount,
+                    "size": len(entry.data) if entry.data is not None else 0,
+                    "inline": entry.data is not None,
+                    "locations": sorted(entry.locations),
+                })
+        with self._sched_lock:
+            pending = sum(len(s["queue"]) for s in self._sched.values())
+            leases = sum(len(s["leases"]) for s in self._sched.values())
+        return {
+            "worker_id": self.worker_id.hex(),
+            "job_id": self.job_id.hex(),
+            "mode": self.mode,
+            "address": list(self.address),
+            "num_owned_objects": len(objects),
+            "objects": objects,
+            "pending_tasks": pending,
+            "active_leases": leases,
+        }
 
     def _rpc_get_object(self, p) -> Optional[dict]:
         """Owner side of borrower gets: inline data or known locations."""
@@ -1030,7 +1127,7 @@ class CoreWorker:
 
     # -------------------------------------------------------------- events
     def task_events(self) -> List[dict]:
-        return list(self._task_events)
+        return self.events.snapshot()
 
 
 class _ActorPipe:

@@ -48,6 +48,8 @@ class GcsServer:
         self._named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> id
         self._jobs: Dict[str, Dict[str, Any]] = {}
         self._kv: Dict[str, bytes] = {}
+        from ray_tpu._private.task_events import GcsTaskTable
+        self._task_table = GcsTaskTable()
         self._placement_groups: Dict[str, Dict[str, Any]] = {}
         # channel -> list of (conn, subscriber key)
         self._subs: Dict[str, List[rpc.Connection]] = {}
@@ -272,6 +274,17 @@ class GcsServer:
         with self._lock:
             return [dict(j) for j in self._jobs.values()]
 
+    # ----------------------------------------------------------- task events
+    def _rpc_task_events_put(self, conn, p):
+        """Workers flush TaskEventBuffer batches here (cf. reference
+        TaskInfoGcsService.AddTaskEventData, gcs_service.proto:635)."""
+        return {"dropped": self._task_table.put_events(p["events"])}
+
+    def _rpc_list_task_events(self, conn, p):
+        return self._task_table.list(
+            job_id=p.get("job_id"), state=p.get("state"),
+            name=p.get("name"), limit=int(p.get("limit", 10000)))
+
     # ------------------------------------------------------------------- kv
     def _rpc_kv_put(self, conn, p):
         with self._lock:
@@ -345,6 +358,7 @@ class GcsServer:
                 "death_cause": None,
                 "bundle": p.get("bundle"),  # [pg_id_hex, index] or None
                 "strategy": p.get("strategy"),  # node_affinity/spread dict
+                "runtime_env": p.get("runtime_env"),
             }
             self._actors[aid] = entry
             if name:
@@ -454,6 +468,7 @@ class GcsServer:
                     "spec": entry["spec"],
                     "resources": entry["resources"],
                     "bundle": cand_bundle,
+                    "runtime_env": entry.get("runtime_env"),
                 }, timeout=CONFIG.actor_creation_timeout_s)
                 with self._lock:
                     entry.pop("retry_delay", None)

@@ -274,9 +274,14 @@ class Raylet:
         worker_id = WorkerID.from_random()
         from ray_tpu.runtime.node import package_pythonpath
         env = dict(os.environ)
-        env["RAY_TPU_SYSTEM_CONFIG"] = CONFIG.overrides_env_blob()
-        env["PYTHONPATH"] = package_pythonpath()
         env.update(env_overrides or {})
+        # system-critical keys win over runtime_env env_vars: the child must
+        # always be able to import ray_tpu and see the config blob; a user
+        # PYTHONPATH is appended, not substituted
+        user_pp = (env_overrides or {}).get("PYTHONPATH")
+        env["RAY_TPU_SYSTEM_CONFIG"] = CONFIG.overrides_env_blob()
+        env["PYTHONPATH"] = package_pythonpath() + (
+            os.pathsep + user_pp if user_pp else "")
         log_prefix = os.path.join(self.session_dir, "logs",
                                   f"worker-{worker_id.hex()[:12]}")
         os.makedirs(os.path.dirname(log_prefix), exist_ok=True)
@@ -533,8 +538,9 @@ class Raylet:
                     if handle is not None:
                         break
             if handle is None:
-                handle = self._spawn_worker(req["job_id"],
-                                            self._tpu_env(need))
+                handle = self._spawn_worker(
+                    req["job_id"],
+                    self._merged_env(need, req.get("env")))
                 if not self._wait_worker_ready(handle):
                     self._give_back(need, pool_key)
                     req["out"]["error"] = "worker failed to start"
@@ -573,6 +579,17 @@ class Raylet:
             return {}
         return {"JAX_PLATFORMS": "cpu"}
 
+    def _merged_env(self, need: Dict[str, float],
+                    runtime_env: Optional[dict]) -> Dict[str, str]:
+        """TPU visibility env + runtime_env env_vars + the serialized
+        descriptor the worker applies at startup (working_dir/py_modules)."""
+        env = self._tpu_env(need)
+        if runtime_env:
+            env.update(runtime_env.get("env_vars", {}))
+            import json as _json
+            env["RAY_TPU_RUNTIME_ENV"] = _json.dumps(runtime_env)
+        return env
+
     def _rpc_return_worker(self, conn, p):
         lease_id = p["lease_id"]
         wid = p["worker_id"]
@@ -595,7 +612,8 @@ class Raylet:
         pool_key = f"{bundle[0]}:{int(bundle[1])}" if bundle else None
         if not self._try_acquire(need, pool_key):
             raise rpc.RpcError("resources unavailable for actor")
-        handle = self._spawn_worker(None, self._tpu_env(need))
+        handle = self._spawn_worker(
+            None, self._merged_env(need, p.get("runtime_env")))
         if not self._wait_worker_ready(handle):
             self._give_back(need, pool_key)
             raise rpc.RpcError("actor worker failed to start")
@@ -630,6 +648,18 @@ class Raylet:
         finally:
             buf.release()
             self.store.release(oid)
+
+    def _rpc_list_workers(self, conn, p):
+        """Registered worker processes on this node (state API fan-out)."""
+        with self._lock:
+            return [{
+                "worker_id": wid,
+                "address": list(h.address) if h.address else None,
+                "actor_id": h.actor_id,
+                "job_id": h.job_id,
+                "pid": h.proc.pid,
+                "alive": h.proc.poll() is None,
+            } for wid, h in self._workers.items()]
 
     def _rpc_store_stats(self, conn, p):
         return self.store.stats()

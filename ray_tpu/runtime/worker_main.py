@@ -14,6 +14,7 @@ calls in submission order.
 from __future__ import annotations
 
 import argparse
+import os
 import threading
 import traceback
 from typing import Any, Dict, Optional
@@ -44,6 +45,19 @@ class WorkerProcess:
             session_dir=args.session_dir,
         )
         cw.set_global_worker(self.core)
+
+        # apply the runtime env (working_dir/py_modules/env_vars) BEFORE any
+        # user code loads — cf. reference runtime-env agent setup happening
+        # before the worker reports ready
+        renv_blob = os.environ.get("RAY_TPU_RUNTIME_ENV")
+        if renv_blob:
+            import json
+            from ray_tpu.runtime_env import setup_runtime_env
+            desc = json.loads(renv_blob)
+            setup_runtime_env(desc, self.core.gcs, args.session_dir)
+            # nested tasks/actors submitted from this worker inherit the
+            # same env (reference: job/parent runtime_env inheritance)
+            self.core.job_runtime_env = desc
         # actor state
         self.actor_instance: Any = None
         self.actor_id: Optional[str] = None
@@ -157,6 +171,8 @@ class WorkerProcess:
     def _execute(self, spec) -> dict:
         fn = self.core.load_function(spec["fn_key"])
         self.core.current_task_id = TaskID(spec["task_id"])
+        self.core.events.record(TaskID(spec["task_id"]).hex(), "RUNNING",
+                                name=spec.get("name", ""))
         borrowed = []
         try:
             args, kwargs, borrowed = self._resolve_args(spec["args"])
@@ -271,6 +287,9 @@ class WorkerProcess:
             return self._package_error(
                 spec, exc.ActorDiedError("actor not initialized"))
         self.core.current_task_id = TaskID(spec["task_id"])
+        self.core.events.record(TaskID(spec["task_id"]).hex(), "RUNNING",
+                                name=spec.get("method", ""),
+                                actor_id=spec.get("actor_id", ""))
         borrowed = []
         try:
             args, kwargs, borrowed = self._resolve_args(spec["args"])

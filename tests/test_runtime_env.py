@@ -1,0 +1,72 @@
+"""Runtime environments (SURVEY.md §2.3 runtime_env row: reference
+python/ray/runtime_env/ + _private/runtime_env/packaging.py)."""
+
+import os
+import sys
+
+import pytest
+
+
+def test_runtime_env_validation(tmp_path):
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"A": 1})
+    with pytest.raises(ValueError):
+        RuntimeEnv(working_dir=str(tmp_path / "nope"))
+    with pytest.raises(ValueError):
+        RuntimeEnv(pip=["requests"])
+    with pytest.raises(ValueError):
+        RuntimeEnv.from_dict({"bogus_field": 1})
+    env = RuntimeEnv(env_vars={"A": "1"}, working_dir=str(tmp_path))
+    assert env.to_dict()["env_vars"] == {"A": "1"}
+
+
+def test_env_vars_in_task(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_PROBE": "tpu42"}})
+    def read_env():
+        return os.environ.get("RTENV_PROBE")
+
+    @ray_tpu.remote
+    def read_env_plain():
+        return os.environ.get("RTENV_PROBE")
+
+    assert ray_tpu.get(read_env.remote()) == "tpu42"
+    # a different env hash must not reuse the env-carrying worker
+    assert ray_tpu.get(read_env_plain.remote()) is None
+
+
+def test_working_dir_and_py_modules(ray_start_regular, tmp_path):
+    import ray_tpu
+
+    wd = tmp_path / "app"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload-123")
+    mod = tmp_path / "mymod_rtenv_test.py"
+    mod.write_text("MAGIC = 777\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd),
+                                 "py_modules": [str(mod)]})
+    def probe():
+        import mymod_rtenv_test
+        with open("data.txt") as f:
+            return f.read(), mymod_rtenv_test.MAGIC
+
+    data, magic = ray_tpu.get(probe.remote())
+    assert data == "payload-123"
+    assert magic == 777
+    assert "mymod_rtenv_test" not in sys.modules
+
+
+def test_env_vars_in_actor(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_RTENV": "yes"}})
+    class Probe:
+        def read(self):
+            return os.environ.get("ACTOR_RTENV")
+
+    a = Probe.remote()
+    assert ray_tpu.get(a.read.remote()) == "yes"

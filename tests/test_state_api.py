@@ -1,0 +1,114 @@
+"""State API + internal KV + task events (SURVEY.md §2.3 state API row,
+§5 tracing: reference python/ray/experimental/state/api.py,
+_private/state.py:829 timeline)."""
+
+import time
+
+import pytest
+
+
+def _wait_for(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_list_tasks_and_timeline(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.experimental.state import (list_tasks, summarize_tasks,
+                                            timeline)
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get([add.remote(i, i) for i in range(4)]) == \
+        [0, 2, 4, 6]
+
+    def _done_with_running():
+        tasks = [t for t in list_tasks(name="add")
+                 if t["state"] == "FINISHED"
+                 and any(ev["state"] == "RUNNING" for ev in t["events"])]
+        return len(tasks) >= 4
+
+    # worker-side RUNNING events flush on their own clock; wait for both
+    _wait_for(_done_with_running,
+              msg="4 finished add tasks (with RUNNING spans) in task table")
+    tasks = list_tasks(name="add")
+    assert all(t["name"] == "add" for t in tasks)
+    done = [t for t in tasks if t["state"] == "FINISHED"]
+    assert {"SUBMITTED", "RUNNING", "FINISHED"} <= {
+        ev["state"] for t in done for ev in t["events"]}
+
+    summary = summarize_tasks()
+    assert summary["cluster"]["summary"]["add"]["FINISHED"] >= 4
+
+    spans = timeline()
+    assert any(e["name"] == "add" and e["ph"] == "X" and e["dur"] >= 0
+               for e in spans)
+
+
+def test_failed_task_state(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.experimental.state import list_tasks
+
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("nope")
+
+    with pytest.raises(ray_tpu.exceptions.TaskError):
+        ray_tpu.get(boom.remote())
+    _wait_for(lambda: any(t["state"] == "FAILED"
+                          for t in list_tasks(name="boom")),
+              msg="FAILED boom task")
+
+
+def test_list_actors_workers_objects(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.experimental.state import (list_actors, list_objects,
+                                            list_workers, memory_summary,
+                                            summarize_objects)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+
+    actors = list_actors(state="ALIVE")
+    assert len(actors) == 1
+
+    workers = list_workers()
+    assert any(w["alive"] and w["actor_id"] for w in workers)
+
+    big = ray_tpu.put(b"x" * 512 * 1024)  # above inline threshold
+    objs = list_objects()
+    assert any(o["object_id"] == big.hex() for o in objs)
+    assert summarize_objects()["cluster"]["total_objects"] >= 1
+    assert "OBJECT_ID" in memory_summary()
+    del big
+
+
+def test_internal_kv(ray_start_regular):
+    from ray_tpu.experimental import internal_kv as kv
+
+    assert kv._internal_kv_initialized()
+    assert kv._internal_kv_put("k1", b"v1") is False  # fresh key
+    assert kv._internal_kv_put("k1", b"v2") is True   # existed
+    assert kv._internal_kv_get("k1") == b"v2"
+    assert kv._internal_kv_put("k1", b"v3", overwrite=False) is True
+    assert kv._internal_kv_get("k1") == b"v2"
+    assert kv._internal_kv_exists("k1")
+    assert "k1" in kv._internal_kv_list("k")
+    assert kv._internal_kv_del("k1")
+    assert not kv._internal_kv_exists("k1")
+    assert kv._internal_kv_get("k1") is None
