@@ -57,6 +57,7 @@ class GcsServer:
         self._server = rpc.Server(self._handle, host=host, port=port,
                                   on_disconnect=self._on_disconnect)
         self._stopped = threading.Event()
+        self._retry_inflight = threading.Event()
         self._health_thread = threading.Thread(target=self._health_loop,
                                                daemon=True)
         self._health_thread.start()
@@ -168,6 +169,7 @@ class GcsServer:
     def _health_loop(self) -> None:
         period = CONFIG.heartbeat_period_ms / 1000.0
         threshold = CONFIG.health_check_failure_threshold
+        ticks = 0
         while not self._stopped.wait(period):
             now = time.monotonic()
             dead = []
@@ -176,8 +178,32 @@ class GcsServer:
                     if node["alive"] and \
                             now - node["last_heartbeat"] > period * threshold:
                         dead.append(nid)
+                have_pending = any(
+                    a["state"] in (PENDING_CREATION, RESTARTING)
+                    and not a.get("dispatched")
+                    for a in self._actors.values()) or any(
+                    pg["state"] == "PENDING"
+                    for pg in self._placement_groups.values())
             for nid in dead:
                 self._mark_node_dead(nid)
+            # actors/pgs parked with "no feasible node" are otherwise only
+            # retried on node registration — also retry as resources free
+            # up (freshly reported by heartbeats), else a full-but-draining
+            # cluster livelocks pending actors forever.  Off-thread: a
+            # create_actor dispatch can block for actor_creation_timeout_s
+            # and must not stall dead-node detection.
+            ticks += 1
+            if have_pending and ticks % 2 == 0 and \
+                    not self._retry_inflight.is_set():
+                self._retry_inflight.set()
+
+                def _retry_and_clear():
+                    try:
+                        self._retry_pending_actors()
+                    finally:
+                        self._retry_inflight.clear()
+                threading.Thread(target=_retry_and_clear,
+                                 daemon=True).start()
 
     def _mark_node_dead(self, node_id: str) -> None:
         with self._lock:

@@ -4,7 +4,9 @@ Analog of /root/reference/python/ray/util/ (actor_pool.py, queue.py,
 placement_group.py, scheduling_strategies.py, collective/).
 """
 
+from ray_tpu.util.actor_group import ActorGroup  # noqa: F401
 from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
+from ray_tpu.util.check_serialize import inspect_serializability  # noqa: F401
 from ray_tpu.util.placement_group import (  # noqa: F401
     PlacementGroup, get_placement_group, placement_group,
     placement_group_table, remove_placement_group)
@@ -13,7 +15,8 @@ from ray_tpu.util.scheduling_strategies import (  # noqa: F401
     NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
 
 __all__ = [
-    "ActorPool", "Queue", "Empty", "Full",
+    "ActorPool", "ActorGroup", "inspect_serializability",
+    "Queue", "Empty", "Full",
     "PlacementGroup", "placement_group", "remove_placement_group",
     "placement_group_table", "get_placement_group",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",

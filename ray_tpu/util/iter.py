@@ -1,0 +1,216 @@
+"""Parallel iterators over actor-hosted shards.
+
+Analog of /root/reference/python/ray/util/iter.py (from_items :20,
+from_range, from_iterators, ParallelIterator, LocalIterator): a
+ParallelIterator holds N shard actors, each lazily evaluating a chain of
+transforms over its local stream; gather_sync/gather_async pull the shards
+back to the driver.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, List, TypeVar
+
+import ray_tpu
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+
+@ray_tpu.remote
+class _ShardActor:
+    """Owns one shard's item stream and applies the transform chain."""
+
+    def __init__(self, items_fn, transforms):
+        self._items_fn = items_fn
+        self._transforms = list(transforms)
+        self._it = None
+
+    def reset(self):
+        it = iter(self._items_fn())
+        for kind, fn in self._transforms:
+            if kind == "for_each":
+                it = map(fn, it)
+            elif kind == "filter":
+                it = filter(fn, it)
+            elif kind == "flatten":
+                it = (x for batch in it for x in batch)
+            elif kind == "batch":
+                it = _batched(it, fn)
+        self._it = it
+        return True
+
+    def next_batch(self, n: int):
+        """Returns (items, done)."""
+        if self._it is None:
+            self.reset()
+        out = []
+        for _ in range(n):
+            try:
+                out.append(next(self._it))
+            except StopIteration:
+                return out, True
+        return out, False
+
+
+def _reap(actors) -> None:
+    """Free shard actors (and their CPU leases) as soon as a gather ends."""
+    for a in actors:
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+
+
+def _batched(it: Iterator, n: int) -> Iterator[list]:
+    buf = []
+    for x in it:
+        buf.append(x)
+        if len(buf) >= n:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
+class ParallelIterator:
+    """Lazy, sharded iterator; transforms run inside the shard actors."""
+
+    def __init__(self, items_fns: List[Callable[[], Iterable]],
+                 transforms: List[tuple] = None, name: str = "iter"):
+        self._items_fns = items_fns
+        self._transforms = list(transforms or [])
+        self.name = name
+
+    def __repr__(self):
+        return f"ParallelIterator[{self.name}, {self.num_shards()} shards]"
+
+    def num_shards(self) -> int:
+        return len(self._items_fns)
+
+    def _with(self, kind: str, fn) -> "ParallelIterator":
+        return ParallelIterator(self._items_fns,
+                                self._transforms + [(kind, fn)],
+                                name=f"{self.name}.{kind}()")
+
+    def for_each(self, fn: Callable[[T], U]) -> "ParallelIterator":
+        return self._with("for_each", fn)
+
+    def filter(self, fn: Callable[[T], bool]) -> "ParallelIterator":
+        return self._with("filter", fn)
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return self._with("batch", n)
+
+    def flatten(self) -> "ParallelIterator":
+        return self._with("flatten", None)
+
+    def _make_actors(self):
+        actors = [_ShardActor.remote(fn, self._transforms)
+                  for fn in self._items_fns]
+        ray_tpu.get([a.reset.remote() for a in actors])
+        return actors
+
+    def gather_sync(self, batch: int = 64) -> Iterator:
+        """Round-robin over shards, in order, until all exhaust."""
+        actors = self._make_actors()
+        try:
+            live = {i: a for i, a in enumerate(actors)}
+            while live:
+                for i in list(live):
+                    items, done = ray_tpu.get(
+                        live[i].next_batch.remote(batch))
+                    yield from items
+                    if done:
+                        del live[i]
+        finally:
+            _reap(actors)
+
+    def gather_async(self, batch: int = 64) -> Iterator:
+        """Yield from whichever shard responds first."""
+        actors = self._make_actors()
+        try:
+            pending = {a.next_batch.remote(batch): a for a in actors}
+            while pending:
+                done, _ = ray_tpu.wait(list(pending), num_returns=1)
+                actor = pending.pop(done[0])
+                items, exhausted = ray_tpu.get(done[0])
+                yield from items
+                if not exhausted:
+                    pending[actor.next_batch.remote(batch)] = actor
+        finally:
+            _reap(actors)
+
+    def take(self, n: int) -> List:
+        out = []
+        gen = self.gather_sync()
+        try:
+            for x in gen:
+                out.append(x)
+                if len(out) >= n:
+                    break
+        finally:
+            gen.close()  # frees the shard actors immediately
+        return out
+
+    def show(self, n: int = 20) -> None:
+        for x in self.take(n):
+            print(x)
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        if self._transforms or other._transforms:
+            # materialize transform chains into the item fns so a union of
+            # differently-transformed iterators stays correct
+            return _materialized(self).union(_materialized(other))
+        return ParallelIterator(self._items_fns + other._items_fns,
+                                name=f"{self.name}+{other.name}")
+
+
+def _materialized(it: ParallelIterator) -> ParallelIterator:
+    fns = []
+    for items_fn in it._items_fns:
+        def make(fn=items_fn, transforms=tuple(it._transforms)):
+            def run():
+                stream: Iterator = iter(fn())
+                for kind, f in transforms:
+                    if kind == "for_each":
+                        stream = map(f, stream)
+                    elif kind == "filter":
+                        stream = filter(f, stream)
+                    elif kind == "flatten":
+                        stream = (x for b in stream for x in b)
+                    elif kind == "batch":
+                        stream = _batched(stream, f)
+                return stream
+            return run
+        fns.append(make())
+    return ParallelIterator(fns, name=it.name)
+
+
+def from_items(items: List[T], num_shards: int = 2,
+               repeat: bool = False) -> ParallelIterator:
+    shards: List[List] = [[] for _ in range(num_shards)]
+    for i, item in enumerate(items):
+        shards[i % num_shards].append(item)
+
+    def make(shard):
+        if repeat:
+            def gen():
+                while True:
+                    yield from shard
+            return gen
+        return lambda: list(shard)
+    return ParallelIterator([make(s) for s in shards], name="from_items")
+
+
+def from_range(n: int, num_shards: int = 2,
+               repeat: bool = False) -> ParallelIterator:
+    return from_items(list(range(n)), num_shards=num_shards, repeat=repeat)
+
+
+def from_iterators(generators: List[Callable[[], Iterable]],
+                   name: str = "from_iterators") -> ParallelIterator:
+    return ParallelIterator(list(generators), name=name)
+
+
+__all__ = ["ParallelIterator", "from_items", "from_range", "from_iterators"]

@@ -1,0 +1,21 @@
+"""joblib backend running sklearn/joblib workloads on the cluster.
+
+Analog of /root/reference/python/ray/util/joblib/ (register_ray +
+ray_backend.RayBackend): `register_ray(); with joblib.parallel_backend
+("ray_tpu"): ...` fans GridSearchCV etc. out as cluster tasks.
+"""
+
+from __future__ import annotations
+
+__all__ = ["register_ray"]
+
+
+def register_ray() -> None:
+    """Register the "ray_tpu" joblib parallel backend."""
+    try:
+        from joblib.parallel import register_parallel_backend
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "joblib is required for the ray_tpu joblib backend") from e
+    from ray_tpu.util.joblib.backend import RayTpuBackend
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,61 @@
+"""joblib ParallelBackend over ray_tpu tasks.
+
+Cite: /root/reference/python/ray/util/joblib/ray_backend.py (RayBackend
+subclasses MultiprocessingBackend and plugs its pool in). Same trick here:
+we substitute our cluster Pool for the local process pool.
+"""
+
+from __future__ import annotations
+
+from joblib._parallel_backends import MultiprocessingBackend
+from joblib.pool import PicklingPool
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+class RayTpuBackend(MultiprocessingBackend):
+    """`joblib.parallel_backend("ray_tpu")` — tasks instead of processes."""
+
+    supports_timeout = True
+
+    def effective_n_jobs(self, n_jobs: int) -> int:
+        eff = super().effective_n_jobs(n_jobs)
+        if n_jobs == -1:
+            eff = max(1, int(ray_tpu.cluster_resources().get("CPU", 1))) \
+                if ray_tpu.is_initialized() else eff
+        return eff
+
+    def configure(self, n_jobs: int = 1, parallel=None, prefer=None,
+                  require=None, **memmapping_pool_args):
+        n_jobs = self.effective_n_jobs(n_jobs)
+        # joblib's memmapping args target local /dev/shm pools; our pool
+        # ships args through the object store instead, so they are dropped.
+        self._pool = _JoblibPool(n_jobs)
+        self.parallel = parallel
+        return n_jobs
+
+    def terminate(self) -> None:
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool = None
+
+
+class _JoblibPool(Pool):
+    """Adapts our Pool to the subset of the PicklingPool API joblib uses."""
+
+    def __init__(self, processes: int):
+        super().__init__(processes=processes)
+
+    def apply_async(self, func, args=(), kwds=None, callback=None,
+                    error_callback=None):
+        # joblib passes a zero-arg BatchedCalls callable
+        return super().apply_async(func, args, kwds, callback=callback,
+                                   error_callback=error_callback)
+
+    # joblib probes this attr on cleanup
+    _temp_folder = None
+
+
+# referenced so the import is exercised (joblib internals move around)
+_ = PicklingPool

@@ -1,0 +1,11 @@
+"""Drop-in ``multiprocessing.Pool`` backed by cluster tasks.
+
+Analog of /root/reference/python/ray/util/multiprocessing/ (Pool): same
+surface (apply/apply_async/map/map_async/starmap/imap/imap_unordered),
+but work is scheduled as ray_tpu tasks, so a Pool transparently spans the
+whole cluster instead of one host.
+"""
+
+from ray_tpu.util.multiprocessing.pool import Pool, AsyncResult  # noqa: F401
+
+__all__ = ["Pool", "AsyncResult"]

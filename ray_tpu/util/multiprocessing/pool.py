@@ -1,0 +1,245 @@
+"""Pool implementation over ray_tpu tasks.
+
+Cite: /root/reference/python/ray/util/multiprocessing/pool.py (Pool,
+AsyncResult, chunking logic). Design difference: the reference runs a pool
+of PoolActor processes; here chunks are plain stateless tasks — idiomatic
+for a lease-reusing scheduler (workers are pooled by the raylet anyway),
+and it inherits task retries for free. `processes` bounds the number of
+chunks in flight, preserving multiprocessing's concurrency/memory cap.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as _mp
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+import ray_tpu
+
+
+def _run_chunk(fn, chunk, star):
+    if star:
+        return [fn(*item) for item in chunk]
+    return [fn(item) for item in chunk]
+
+
+def _window(task, fn, chunks: List[list], star: bool,
+            max_inflight: int) -> Iterator[Any]:
+    """Submit chunks with at most `max_inflight` outstanding; yield chunk
+    results in order."""
+    results: dict = {}
+    inflight: dict = {}  # ref -> index
+    next_submit = 0
+    next_yield = 0
+    n = len(chunks)
+    while next_yield < n:
+        while next_submit < n and len(inflight) < max_inflight:
+            ref = task.remote(fn, chunks[next_submit], star)
+            inflight[ref] = next_submit
+            next_submit += 1
+        while next_yield in results:
+            yield results.pop(next_yield)
+            next_yield += 1
+        if next_yield >= n:
+            break
+        done, _ = ray_tpu.wait(list(inflight), num_returns=1)
+        idx = inflight.pop(done[0])
+        results[idx] = ray_tpu.get(done[0])
+
+
+class AsyncResult:
+    """Matches multiprocessing.pool.AsyncResult's get/wait/ready/successful."""
+
+    def __init__(self, collect: Callable[[], Any],
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None,
+                 pool: Optional["Pool"] = None):
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._callback = callback
+        self._error_callback = error_callback
+        self._pool = pool
+        if pool is not None:
+            pool._outstanding.add(self)
+        threading.Thread(target=self._collect, args=(collect,),
+                         daemon=True).start()
+
+    def _collect(self, collect) -> None:
+        try:
+            self._result = collect()
+            if self._callback is not None:
+                self._callback(self._result)
+        except BaseException as e:  # noqa: BLE001 - surfaced via get()
+            self._error = e
+            if self._error_callback is not None:
+                self._error_callback(e)
+        finally:
+            self._done.set()
+            if self._pool is not None:
+                self._pool._outstanding.discard(self)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._done.wait(timeout)
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        return self._error is None
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        if not self._done.wait(timeout):
+            # drop-in callers catch multiprocessing.TimeoutError
+            raise _mp.TimeoutError("result not ready within timeout")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class Pool:
+    """``with Pool(8) as p: p.map(f, xs)`` — cluster-wide.
+
+    `processes` bounds in-flight chunks (defaults to cluster CPU count);
+    `ray_remote_args` forwards @remote options (resources, retries, ...).
+    """
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = (),
+                 ray_remote_args: Optional[dict] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            processes = max(1, int(
+                ray_tpu.cluster_resources().get("CPU", 1)))
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._processes = processes
+        self._closed = False
+        self._outstanding: set = set()
+        remote_args = dict(ray_remote_args or {})
+        if initializer is not None:
+            def _chunk_with_init(fn, chunk, star,
+                                 _init=initializer, _ia=initargs):
+                _init(*_ia)
+                return _run_chunk(fn, chunk, star)
+            body = _chunk_with_init
+        else:
+            body = _run_chunk
+        self._task = ray_tpu.remote(**remote_args)(body) \
+            if remote_args else ray_tpu.remote(body)
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        """Blocks until all outstanding async work has completed."""
+        if not self._closed:
+            raise ValueError("Pool is still running")
+        for r in list(self._outstanding):
+            r.wait()
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()
+
+    def _check_running(self) -> None:
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    # ------------------------------------------------------------- chunking
+    def _chunks(self, iterable: Iterable,
+                chunksize: Optional[int]) -> List[list]:
+        items = list(iterable)
+        if chunksize is None:
+            chunksize, extra = divmod(len(items), self._processes * 4)
+            if extra:
+                chunksize += 1
+            chunksize = max(1, chunksize)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def _gather(self, fn, iterable, chunksize, star=False) -> List[Any]:
+        chunks = self._chunks(iterable, chunksize)
+        out: List[Any] = []
+        for chunk_result in _window(self._task, fn, chunks, star,
+                                    self._processes):
+            out.extend(chunk_result)
+        return out
+
+    # ----------------------------------------------------------------- api
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None) -> Any:
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict = None, callback=None,
+                    error_callback=None) -> AsyncResult:
+        self._check_running()
+        kwds = kwds or {}
+        ref = self._task.remote(lambda _: fn(*args, **kwds), [None], False)
+        return AsyncResult(lambda: ray_tpu.get(ref)[0],
+                           callback=callback, error_callback=error_callback,
+                           pool=self)
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        self._check_running()
+        return self._gather(fn, iterable, chunksize)
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None, callback=None,
+                  error_callback=None) -> AsyncResult:
+        self._check_running()
+        return AsyncResult(
+            lambda: self._gather(fn, iterable, chunksize),
+            callback=callback, error_callback=error_callback, pool=self)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        self._check_running()
+        return self._gather(fn, iterable, chunksize, star=True)
+
+    def starmap_async(self, fn: Callable, iterable: Iterable[tuple],
+                      chunksize: Optional[int] = None) -> AsyncResult:
+        self._check_running()
+        return AsyncResult(
+            lambda: self._gather(fn, iterable, chunksize, star=True),
+            pool=self)
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: int = 1) -> Iterator[Any]:
+        self._check_running()
+        chunks = self._chunks(iterable, chunksize)
+        for chunk_result in _window(self._task, fn, chunks, False,
+                                    self._processes):
+            yield from chunk_result
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: int = 1) -> Iterator[Any]:
+        self._check_running()
+        chunks = self._chunks(iterable, chunksize)
+        inflight = {}
+        it = iter(chunks)
+        exhausted = False
+        while inflight or not exhausted:
+            while not exhausted and len(inflight) < self._processes:
+                try:
+                    chunk = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                inflight[self._task.remote(fn, chunk, False)] = True
+            if not inflight:
+                break
+            done, _ = ray_tpu.wait(list(inflight), num_returns=1)
+            del inflight[done[0]]
+            yield from ray_tpu.get(done[0])

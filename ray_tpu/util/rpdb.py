@@ -1,0 +1,96 @@
+"""Remote pdb: break inside a task/actor and attach from another terminal.
+
+Analog of /root/reference/python/ray/util/rpdb.py (set_trace + the
+`ray debug` attach flow): ``ray_tpu.util.rpdb.set_trace()`` in worker code
+opens a telnet-able pdb on a free port and registers
+host:port in the GCS KV under ``RAY_PDB:<task_id>``; attach with
+``python -m ray_tpu.scripts debug`` or plain ``nc host port``.
+"""
+
+from __future__ import annotations
+
+import pdb
+import socket
+import sys
+from typing import List, Tuple
+
+
+class _SocketIO:
+    def __init__(self, conn: socket.socket):
+        self._file = conn.makefile("rw", buffering=1)
+
+    def readline(self):
+        return self._file.readline()
+
+    def read(self, *a):
+        return self._file.read(*a)
+
+    def write(self, data):
+        self._file.write(data)
+
+    def flush(self):
+        self._file.flush()
+
+
+class RemotePdb(pdb.Pdb):
+    def __init__(self, conn: socket.socket):
+        io = _SocketIO(conn)
+        super().__init__(stdin=io, stdout=io)
+        self.use_rawinput = False
+
+
+def set_trace(breakpoint_uuid: str = "") -> None:
+    """Block the current worker on a socket pdb session."""
+    from ray_tpu.runtime import core_worker as cw
+    worker = cw._global_worker
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    # bind all interfaces; advertise the address this worker is reachable
+    # at cluster-wide (its RPC host), not loopback
+    server.bind(("0.0.0.0", 0))
+    server.listen(1)
+    port = server.getsockname()[1]
+    host = worker.address[0] if worker is not None else "127.0.0.1"
+
+    key = None
+    conn = None
+    try:
+        if worker is not None:
+            tid = worker.current_task_id.hex()
+            key = f"RAY_PDB:{breakpoint_uuid or tid}"
+            worker.gcs.kv_put(key, f"{host}:{port}".encode())
+        print(f"ray_tpu debugger waiting on {host}:{port} "
+              f"(attach: nc {host} {port})", file=sys.stderr, flush=True)
+        conn, _ = server.accept()
+        dbg = RemotePdb(conn)
+        dbg.reset()  # initializes bdb state (botframe) for interaction()
+        # Blocking interaction at this frame: inspect stack/locals, then
+        # `c` (or n/s) resumes the task.  Post-resume line stepping is not
+        # supported — the session ends when interaction returns, so the
+        # sockets can be closed deterministically (no fd leak per hit).
+        dbg.interaction(sys._getframe().f_back, None)
+    finally:
+        if worker is not None and key:
+            try:
+                worker.gcs.kv_del(key)
+            except Exception:
+                pass
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        server.close()
+
+
+def list_breakpoints() -> List[Tuple[str, str]]:
+    """Active (id, host:port) debugger sessions, from the driver."""
+    from ray_tpu.runtime import core_worker as cw
+    gcs = cw.get_global_worker().gcs
+    out = []
+    for key in gcs.kv_keys("RAY_PDB:"):
+        val = gcs.kv_get(key)
+        if val:
+            out.append((key[len("RAY_PDB:"):], val.decode()))
+    return out

@@ -1,0 +1,6 @@
+"""Distributed tracing hooks (SURVEY.md §5 tracing row)."""
+
+from ray_tpu.util.tracing.tracing_helper import (  # noqa: F401
+    span, get_trace_context, propagate_trace_context)
+
+__all__ = ["span", "get_trace_context", "propagate_trace_context"]

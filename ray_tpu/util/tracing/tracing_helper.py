@@ -1,0 +1,70 @@
+"""Span helper: OpenTelemetry when installed, task-event spans otherwise.
+
+Analog of /root/reference/python/ray/util/tracing/tracing_helper.py
+(_OpenTelemetryProxy :33, _inject_tracing_into_function :324). The
+reference wraps every remote call in an OTel span and propagates context
+in task metadata. Here the core already records every task transition in
+the GCS task table (our timeline source), so this module adds *user-level*
+spans: with `span("preprocess")`, the block is recorded as a task event
+and — if opentelemetry happens to be importable — mirrored to a real OTel
+span as well.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import uuid
+from typing import Dict, Iterator, Optional
+
+try:  # pragma: no cover - image does not bundle opentelemetry
+    from opentelemetry import trace as _otel_trace
+    _tracer = _otel_trace.get_tracer("ray_tpu")
+except ImportError:
+    _otel_trace = None
+    _tracer = None
+
+_local = threading.local()
+
+
+def get_trace_context() -> Dict[str, str]:
+    """Current trace/span ids, for propagation into submitted tasks."""
+    ctx = getattr(_local, "ctx", None)
+    return dict(ctx) if ctx else {}
+
+
+def propagate_trace_context(ctx: Optional[Dict[str, str]]) -> None:
+    """Install a parent context received with a task."""
+    _local.ctx = dict(ctx) if ctx else None
+
+
+@contextlib.contextmanager
+def span(name: str, attributes: Optional[Dict] = None) -> Iterator[None]:
+    """Record a named span around a block of worker/driver code."""
+    parent = get_trace_context()
+    trace_id = parent.get("trace_id") or uuid.uuid4().hex
+    span_id = uuid.uuid4().hex[:16]
+    _local.ctx = {"trace_id": trace_id, "span_id": span_id}
+    start = time.time()
+    otel_cm = _tracer.start_as_current_span(name) if _tracer else None
+    if otel_cm:
+        otel_cm.__enter__()
+    try:
+        yield
+    finally:
+        if otel_cm:
+            otel_cm.__exit__(None, None, None)
+        _local.ctx = parent or None
+        end = time.time()
+        from ray_tpu.runtime import core_worker as cw
+        worker = cw._global_worker
+        if worker is not None:
+            # user attributes go under a single "attrs" key so they can
+            # never collide with the record's own fields
+            worker.events.record(
+                span_id, "RUNNING", name=f"span:{name}", ts=start,
+                trace_id=trace_id, attrs=dict(attributes or {}))
+            worker.events.record(
+                span_id, "FINISHED", name=f"span:{name}", ts=end,
+                trace_id=trace_id)

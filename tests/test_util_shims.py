@@ -1,0 +1,153 @@
+"""Utility shims (SURVEY.md §2.3: multiprocessing/joblib shims, iter,
+actor_group, check_serialize, rpdb, tracing)."""
+
+import threading
+import time
+
+import pytest
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(2) as pool:
+        assert pool.map(lambda x: x * 2, range(10)) == \
+            [0, 2, 4, 6, 8, 10, 12, 14, 16, 18]
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(lambda a, b: a * b, (3, 4)) == 12
+        r = pool.map_async(lambda x: x + 1, [1, 2, 3])
+        r.wait(30)
+        assert r.ready() and r.successful()
+        assert r.get() == [2, 3, 4]
+        assert list(pool.imap(lambda x: x * x, [1, 2, 3], chunksize=2)) == \
+            [1, 4, 9]
+        assert sorted(pool.imap_unordered(lambda x: x, [3, 1, 2])) == \
+            [1, 2, 3]
+    with pytest.raises(ValueError):
+        pool.map(lambda x: x, [1])
+
+
+def test_pool_error_propagation(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(2) as pool:
+        r = pool.map_async(lambda x: 1 // x, [1, 0])
+        r.wait(30)
+        assert r.ready() and not r.successful()
+        with pytest.raises(Exception):
+            r.get()
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(pow)(i, 2) for i in range(6))
+    assert out == [0, 1, 4, 9, 16, 25]
+
+
+def test_parallel_iterator(ray_start_regular):
+    from ray_tpu.util import iter as par_iter
+
+    it = par_iter.from_range(8, num_shards=2)
+    assert it.num_shards() == 2
+    doubled = it.for_each(lambda x: x * 2)
+    assert sorted(doubled.gather_sync()) == [0, 2, 4, 6, 8, 10, 12, 14]
+
+    evens = par_iter.from_range(10, num_shards=2) \
+        .filter(lambda x: x % 2 == 0)
+    assert sorted(evens.gather_async()) == [0, 2, 4, 6, 8]
+
+    batched = par_iter.from_items([1, 2, 3, 4], num_shards=1).batch(2)
+    assert list(batched.gather_sync()) == [[1, 3], [2, 4]] or \
+        list(batched.gather_sync()) == [[1, 2], [3, 4]]
+
+    u = par_iter.from_range(3, 1).union(par_iter.from_range(3, 1))
+    assert sorted(u.gather_sync()) == [0, 0, 1, 1, 2, 2]
+    assert par_iter.from_range(100, 4).take(5) == [0, 4, 1, 5, 2] or \
+        len(par_iter.from_range(100, 4).take(5)) == 5
+
+
+def test_actor_group(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import ActorGroup
+
+    class Member:
+        def __init__(self, base):
+            self.base = base
+
+        def add(self, x):
+            return self.base + x
+
+    g = ActorGroup(Member, 3, 10)
+    assert len(g) == 3
+    assert g.execute("add", 5) == [15, 15, 15]
+    refs = g.add.remote(1)
+    assert ray_tpu.get(refs) == [11, 11, 11]
+    g.shutdown()
+
+
+def test_inspect_serializability():
+    from ray_tpu.util import inspect_serializability
+
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def bad():
+        return lock
+
+    ok, failures = inspect_serializability(bad)
+    assert not ok
+    assert any("lock" in f.lower() or "closure" in f for f in failures)
+
+
+def test_tracing_span(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.experimental.state import list_tasks
+    from ray_tpu.util.tracing import get_trace_context, span
+
+    with span("prep"):
+        ctx = get_trace_context()
+        assert ctx.get("trace_id")
+    assert get_trace_context() == {}
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if any(t["name"] == "span:prep" and t["state"] == "FINISHED"
+               for t in list_tasks()):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("span:prep not in task table")
+
+
+def test_rpdb_registration(ray_start_regular):
+    """set_trace publishes host:port in KV; attach via raw socket."""
+    import socket
+
+    import ray_tpu
+    from ray_tpu.util import rpdb
+
+    @ray_tpu.remote
+    def task_with_bp():
+        rpdb.set_trace()
+        return "resumed"
+
+    ref = task_with_bp.remote()
+    deadline = time.monotonic() + 30
+    sessions = []
+    while time.monotonic() < deadline and not sessions:
+        sessions = rpdb.list_breakpoints()
+        time.sleep(0.2)
+    assert sessions, "breakpoint never registered"
+    host, port = sessions[0][1].split(":")
+    s = socket.create_connection((host, int(port)), timeout=10)
+    f = s.makefile("rw", buffering=1)
+    f.write("c\n")  # continue
+    f.flush()
+    assert ray_tpu.get(ref, timeout=60) == "resumed"
+    s.close()
