@@ -1,0 +1,63 @@
+"""Usage-stats collection (local-only).
+
+Analog of /root/reference/python/ray/_private/usage/usage_lib.py: the
+reference collects cluster metadata and (opt-out) uploads a ping. TPU pods
+run with zero egress, so this implementation only ever writes the report
+to the session directory — there is no network path, by design. Opt out
+entirely with RAY_TPU_USAGE_STATS_ENABLED=0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import time
+from typing import Any, Dict
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def collect_usage_payload(gcs=None) -> Dict[str, Any]:
+    import ray_tpu
+    payload: Dict[str, Any] = {
+        "schema_version": "0.1",
+        "source": "ray_tpu",
+        "version": ray_tpu.__version__,
+        "python_version": platform.python_version(),
+        "os": platform.system().lower(),
+        "collect_time": time.time(),
+    }
+    try:
+        import jax
+        payload["jax_version"] = jax.__version__
+        payload["device_kinds"] = sorted(
+            {getattr(d, "device_kind", d.platform) for d in jax.devices()})
+    except Exception:
+        pass
+    if gcs is not None:
+        try:
+            nodes = gcs.call("list_nodes", timeout=5)
+            alive = [n for n in nodes if n.get("alive")]
+            payload["num_nodes"] = len(alive)
+            payload["total_resources"] = {
+                r: sum(n["resources"].get(r, 0) for n in alive)
+                for r in ("CPU", "TPU")}
+        except Exception:
+            pass
+    return payload
+
+
+def record_usage_report(session_dir: str, gcs=None) -> str:
+    """Write the report file; returns its path ('' when disabled)."""
+    if not usage_stats_enabled() or not session_dir:
+        return ""
+    path = os.path.join(session_dir, "usage_stats.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(collect_usage_payload(gcs), f, indent=1)
+    except OSError:
+        return ""
+    return path

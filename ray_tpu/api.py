@@ -47,6 +47,16 @@ def init(address: Optional[str] = None, *,
             CONFIG.update(system_config)
 
         if address is None:
+            # submitted job drivers inherit their cluster from the
+            # supervisor (reference RAY_ADDRESS semantics)
+            import os as _os
+            address = _os.environ.get("RAY_TPU_ADDRESS") or None
+        if address == "auto":
+            from ray_tpu.job_submission.job_manager import \
+                latest_session_address
+            address = latest_session_address()
+
+        if address is None:
             session_dir = new_session_dir()
             node = NodeProcesses(session_dir)
             gcs_addr = node.start_gcs()
@@ -98,6 +108,17 @@ def init(address: Optional[str] = None, *,
             session_dir=session_dir,
         )
         worker.namespace = namespace
+        if CONFIG.log_to_driver:
+            from ray_tpu._private.log_monitor import (LOG_CHANNEL,
+                                                      print_to_driver)
+            import functools as _functools
+            try:
+                worker.gcs.subscribe(
+                    LOG_CHANNEL,
+                    _functools.partial(print_to_driver,
+                                       job_id=worker.job_id.hex()))
+            except Exception:
+                pass  # observability only; never fail init over it
         if runtime_env:
             # job-level default env, inherited by every task/actor that
             # doesn't set its own (reference job_config.runtime_env)

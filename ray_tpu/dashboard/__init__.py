@@ -1,0 +1,12 @@
+"""Dashboard backend: HTTP JSON API + Prometheus scrape endpoint.
+
+Analog of /root/reference/python/ray/dashboard/ (head.py,
+http_server_head.py aiohttp app + modules/). No React frontend is shipped;
+the JSON API mirrors the reference module routes (nodes, actors, jobs,
+tasks, cluster_status) and `/metrics` serves Prometheus text exposition —
+the piece Grafana actually scrapes.
+"""
+
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard  # noqa: F401
+
+__all__ = ["DashboardHead", "start_dashboard"]

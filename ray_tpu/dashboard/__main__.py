@@ -1,0 +1,24 @@
+"""Standalone dashboard daemon: `python -m ray_tpu.dashboard --gcs ...`."""
+
+import argparse
+import threading
+
+from ray_tpu.dashboard.head import DashboardHead
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8265)
+    args = parser.parse_args()
+    head = DashboardHead((args.gcs_host, args.gcs_port),
+                         host=args.host, port=args.port)
+    host, port = head.start()
+    print(f"dashboard serving at http://{host}:{port}", flush=True)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

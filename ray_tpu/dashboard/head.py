@@ -1,0 +1,243 @@
+"""Dashboard head: aiohttp server over GCS state.
+
+Cite: /root/reference/python/ray/dashboard/head.py + http_server_head.py
+(aiohttp), modules/node, modules/actor, modules/job (job_head.py REST),
+modules/metrics. The server needs no driver attachment: it reads the GCS
+tables with a plain GcsClient and fans out to raylets/workers over RPC —
+same data sources as the reference's StateAPIManager.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+from ray_tpu.runtime.gcs import GcsClient
+
+
+class DashboardHead:
+    def __init__(self, gcs_address: Tuple[str, int],
+                 host: str = "127.0.0.1", port: int = 8265):
+        self.gcs = GcsClient(gcs_address)
+        self.gcs_address = tuple(gcs_address)
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> Tuple[str, int]:
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="dashboard-head")
+        self._thread.start()
+        if not self._started.wait(15):
+            raise TimeoutError("dashboard failed to start")
+        return (self.host, self.port)
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        try:
+            self.gcs.close()
+        except Exception:
+            pass
+
+    def _serve(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        app = web.Application()
+        app.add_routes([
+            web.get("/api/version", self._version),
+            web.get("/api/nodes", self._nodes),
+            web.get("/api/actors", self._actors),
+            web.get("/api/tasks", self._tasks),
+            web.get("/api/placement_groups", self._pgs),
+            web.get("/api/cluster_status", self._cluster_status),
+            web.get("/api/jobs", self._jobs),
+            web.post("/api/jobs", self._submit_job),
+            web.get("/api/jobs/{submission_id}", self._job_info),
+            web.get("/api/jobs/{submission_id}/logs", self._job_logs),
+            web.post("/api/jobs/{submission_id}/stop", self._job_stop),
+            web.get("/metrics", self._metrics),
+            web.get("/", self._index),
+        ])
+        runner = web.AppRunner(app)
+        self._loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        self._loop.run_until_complete(site.start())
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._runner = runner
+        self._started.set()
+        self._loop.run_forever()
+        self._loop.run_until_complete(runner.cleanup())
+
+    # ------------------------------------------------------------ blocking
+    # GCS/RPC calls are synchronous; run them off the event loop.
+    async def _call(self, fn, *args):
+        return await asyncio.get_event_loop().run_in_executor(
+            None, fn, *args)
+
+    # ------------------------------------------------------------- handlers
+    async def _index(self, request) -> web.Response:
+        return web.json_response({
+            "service": "ray_tpu dashboard",
+            "routes": ["/api/version", "/api/nodes", "/api/actors",
+                       "/api/tasks", "/api/placement_groups",
+                       "/api/cluster_status", "/api/jobs", "/metrics"]})
+
+    async def _version(self, request) -> web.Response:
+        import ray_tpu
+        return web.json_response({"version": ray_tpu.__version__})
+
+    async def _nodes(self, request) -> web.Response:
+        nodes = await self._call(self.gcs.call, "list_nodes")
+        return web.json_response({"nodes": nodes})
+
+    async def _actors(self, request) -> web.Response:
+        actors = await self._call(self.gcs.call, "list_actors")
+        return web.json_response({"actors": actors})
+
+    async def _tasks(self, request) -> web.Response:
+        limit = int(request.query.get("limit", 1000))
+        tasks = await self._call(
+            lambda: self.gcs.call("list_task_events", {"limit": limit}))
+        return web.json_response({"tasks": tasks})
+
+    async def _pgs(self, request) -> web.Response:
+        pgs = await self._call(self.gcs.call, "list_placement_groups")
+        return web.json_response({"placement_groups": pgs})
+
+    async def _cluster_status(self, request) -> web.Response:
+        nodes = await self._call(self.gcs.call, "list_nodes")
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in nodes:
+            if not n.get("alive"):
+                continue
+            for r, v in n.get("resources", {}).items():
+                total[r] = total.get(r, 0) + v
+            for r, v in n.get("available", {}).items():
+                avail[r] = avail.get(r, 0) + v
+        return web.json_response({
+            "alive_nodes": sum(bool(n.get("alive")) for n in nodes),
+            "dead_nodes": sum(not n.get("alive") for n in nodes),
+            "total_resources": total,
+            "available_resources": avail,
+        })
+
+    # ---------------------------------------------------------------- jobs
+    def _job_kv(self, prefix: str) -> List[dict]:
+        out = []
+        for key in self.gcs.kv_keys(prefix):
+            raw = self.gcs.kv_get(key)
+            if raw:
+                out.append(json.loads(raw))
+        return out
+
+    async def _jobs(self, request) -> web.Response:
+        jobs = await self._call(self._job_kv, "job_submission:")
+        return web.json_response({"jobs": jobs})
+
+    async def _job_info(self, request) -> web.Response:
+        sid = request.match_info["submission_id"]
+        raw = await self._call(self.gcs.kv_get, "job_submission:" + sid)
+        if raw is None:
+            raise web.HTTPNotFound(text=f"job {sid} not found")
+        return web.json_response(json.loads(raw))
+
+    async def _job_logs(self, request) -> web.Response:
+        sid = request.match_info["submission_id"]
+        raw = await self._call(self.gcs.kv_get, "job_logs:" + sid)
+        return web.Response(text=(raw or b"").decode("utf-8", "replace"))
+
+    async def _job_stop(self, request) -> web.Response:
+        sid = request.match_info["submission_id"]
+        await self._call(
+            lambda: self.gcs.kv_put("job_stop:" + sid, b"1"))
+        return web.json_response({"stopped": True})
+
+    async def _submit_job(self, request) -> web.Response:
+        """REST job submission (reference job_head.py POST /api/jobs/)."""
+        body = await request.json()
+        entrypoint = body.get("entrypoint")
+        if not entrypoint:
+            raise web.HTTPBadRequest(text="missing 'entrypoint'")
+
+        def _submit() -> str:
+            import ray_tpu
+            from ray_tpu.job_submission import JobSubmissionClient
+            if not ray_tpu.is_initialized():
+                client = JobSubmissionClient(
+                    f"{self.gcs_address[0]}:{self.gcs_address[1]}")
+            else:
+                client = JobSubmissionClient()
+            return client.submit_job(
+                entrypoint=entrypoint,
+                submission_id=body.get("submission_id"),
+                metadata=body.get("metadata"),
+                runtime_env=body.get("runtime_env"))
+
+        sid = await self._call(_submit)
+        return web.json_response({"submission_id": sid})
+
+    # -------------------------------------------------------------- metrics
+    async def _metrics(self, request) -> web.Response:
+        """Prometheus text exposition of user metrics + cluster gauges
+        (reference modules/metrics + metrics_agent prometheus_exporter)."""
+        def build() -> str:
+            lines: List[str] = []
+            seen_meta = set()
+            for key in self.gcs.kv_keys("metrics/"):
+                raw = self.gcs.kv_get(key)
+                if not raw:
+                    continue
+                _, name, worker = key.split("/", 2)
+                data = json.loads(raw)
+                if name not in seen_meta:
+                    seen_meta.add(name)
+                    if data.get("description"):
+                        lines.append(
+                            f"# HELP {name} {data['description']}")
+                    mtype = data.get("type", "untyped")
+                    if mtype not in ("counter", "gauge", "histogram"):
+                        mtype = "untyped"
+                    lines.append(f"# TYPE {name} {mtype}")
+                for tagjson, value in data.get("values", {}).items():
+                    tags = dict(json.loads(tagjson))
+                    tags["worker"] = worker
+                    tag_str = ",".join(
+                        f'{k}="{v}"' for k, v in sorted(tags.items()))
+                    lines.append(f"{name}{{{tag_str}}} {value}")
+            # built-in cluster gauges
+            nodes = self.gcs.call("list_nodes")
+            alive = [n for n in nodes if n.get("alive")]
+            lines.append("# TYPE ray_tpu_cluster_nodes gauge")
+            lines.append(f"ray_tpu_cluster_nodes {len(alive)}")
+            for res in ("CPU", "TPU"):
+                total = sum(n["resources"].get(res, 0) for n in alive)
+                avail = sum(n["available"].get(res, 0) for n in alive)
+                lines.append(f"# TYPE ray_tpu_{res.lower()}_total gauge")
+                lines.append(f"ray_tpu_{res.lower()}_total {total}")
+                lines.append(
+                    f"# TYPE ray_tpu_{res.lower()}_available gauge")
+                lines.append(f"ray_tpu_{res.lower()}_available {avail}")
+            return "\n".join(lines) + "\n"
+
+        text = await self._call(build)
+        return web.Response(text=text,
+                            content_type="text/plain")
+
+
+def start_dashboard(gcs_address: Tuple[str, int], host: str = "127.0.0.1",
+                    port: int = 8265) -> DashboardHead:
+    head = DashboardHead(gcs_address, host=host, port=port)
+    head.start()
+    return head

@@ -43,7 +43,7 @@ class RemoteFunction:
             num_returns=self._num_returns,
             resources=self._resources,
             max_retries=self._max_retries,
-            name=self._func.__name__,
+            name=getattr(self._func, "__name__", "task"),
             scheduling_strategy=encode_strategy(self._scheduling_strategy),
             runtime_env=worker.prepare_runtime_env(self._runtime_env))
         if self._num_returns == 1:

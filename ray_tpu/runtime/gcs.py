@@ -120,9 +120,13 @@ class GcsServer:
 
     def _retry_pending_actors(self) -> None:
         with self._lock:
+            # entries holding a retry_delay already have a backoff Timer
+            # scheduled (resources-unavailable path) — re-dispatching them
+            # here would defeat the backoff and hammer the full node
             pending = [aid for aid, a in self._actors.items()
                        if a["state"] in (PENDING_CREATION, RESTARTING)
-                       and not a.get("dispatched")]
+                       and not a.get("dispatched")
+                       and not a.get("retry_delay")]
             pending_pgs = [pgid for pgid, pg in self._placement_groups.items()
                            if pg["state"] == "PENDING"]
         for aid in pending:
@@ -419,6 +423,7 @@ class GcsServer:
                 elif pg["state"] != "CREATED":
                     logger.info("actor %s pending: placement group pending",
                                 aid[:8])
+                    entry.pop("retry_delay", None)
                     return
                 else:
                     idx = int(bundle[1])
@@ -447,6 +452,7 @@ class GcsServer:
                                 candidates.append(
                                     (node["node_id"], [bundle[0], i]))
                         if not candidates and fail_reason is None:
+                            entry.pop("retry_delay", None)
                             return  # bundle nodes gone; pg will reschedule
             elif strategy.get("type") == "node_affinity":
                 node = self._nodes.get(strategy["node_id"])
@@ -474,6 +480,9 @@ class GcsServer:
             if fail_reason is None and not candidates:
                 # no feasible node now; retried on the next node registration
                 logger.info("actor %s pending: no feasible node", aid[:8])
+                # hand the entry back to _retry_pending_actors (a stale
+                # retry_delay would park it forever: nothing else retries)
+                entry.pop("retry_delay", None)
                 return
             if fail_reason is None:
                 entry["dispatched"] = True

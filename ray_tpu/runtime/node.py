@@ -99,6 +99,18 @@ class NodeProcesses:
             self.session_dir, "gcs_server")
         info = _wait_address_file(addr_file, self.gcs_proc)
         self.gcs_address = (info["host"], info["port"])
+        # advertise the most recent local session for address auto-discovery
+        # (reference: session_latest symlink + RAY_ADDRESS resolution)
+        try:
+            latest = os.path.join("/tmp", "ray_tpu_sessions", "latest.json")
+            tmp = latest + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"gcs_host": info["host"],
+                           "gcs_port": info["port"],
+                           "session_dir": self.session_dir}, f)
+            os.replace(tmp, latest)
+        except OSError:
+            pass
         return self.gcs_address
 
     def start_raylet(self, gcs_address: Tuple[str, int],

@@ -121,6 +121,21 @@ class Raylet:
         self._reaper.start()
         self._spiller = threading.Thread(target=self._spill_loop, daemon=True)
         self._spiller.start()
+        if CONFIG.log_to_driver:
+            from ray_tpu._private.log_monitor import LogMonitor
+
+            def job_of(worker_prefix: str):
+                with self._lock:
+                    for wid, h in self._workers.items():
+                        if wid.startswith(worker_prefix):
+                            return h.job_id
+                return None
+
+            self._log_monitor = LogMonitor(session_dir, self.gcs,
+                                           self.node_id.hex(), job_of)
+            self._log_monitor.start()
+        else:
+            self._log_monitor = None
 
     # --------------------------------------------------------------- serving
     def _handle(self, conn: rpc.Connection, method: str, p: Any) -> Any:
@@ -675,6 +690,8 @@ class Raylet:
     # ------------------------------------------------------------------ stop
     def shutdown(self) -> None:
         self._stopped.set()
+        if self._log_monitor is not None:
+            self._log_monitor.stop()
         with self._lock:
             handles = list(self._workers.values())
             self._workers.clear()

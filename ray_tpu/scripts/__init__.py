@@ -1,0 +1,5 @@
+"""CLI: `python -m ray_tpu.scripts <command>`.
+
+Analog of /root/reference/python/ray/scripts/scripts.py (`ray start` :529,
+stop, status, memory, timeline, job ...).
+"""

@@ -1,0 +1,4 @@
+from ray_tpu.scripts.scripts import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,342 @@
+"""ray_tpu CLI.
+
+Cite: /root/reference/python/ray/scripts/scripts.py — `ray start` (:529),
+`ray stop`, `ray status`, `ray memory`, `ray timeline`, plus the job CLI
+(/root/reference/python/ray/dashboard/modules/job/cli.py) and the state
+CLI (`ray list ...`, experimental/state/state_cli.py) folded in as
+subcommands.
+
+Usage:
+  python -m ray_tpu.scripts start --head [--num-cpus N] [--dashboard] [--block]
+  python -m ray_tpu.scripts start --address HOST:PORT       # join as worker node
+  python -m ray_tpu.scripts stop
+  python -m ray_tpu.scripts status [--address ...]
+  python -m ray_tpu.scripts list tasks|actors|nodes|jobs|objects|workers|placement-groups
+  python -m ray_tpu.scripts summary tasks|actors|objects
+  python -m ray_tpu.scripts memory
+  python -m ray_tpu.scripts timeline [-o trace.json]
+  python -m ray_tpu.scripts job submit|status|logs|stop|list ...
+  python -m ray_tpu.scripts debug
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or \
+        os.environ.get("RAY_TPU_ADDRESS")
+    if addr:
+        return addr
+    from ray_tpu.job_submission.job_manager import latest_session_address
+    return latest_session_address()
+
+
+def _connect(args) -> None:
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=_resolve_address(args))
+
+
+# ------------------------------------------------------------------ start
+def cmd_start(args) -> None:
+    from ray_tpu.runtime.node import NodeProcesses, new_session_dir
+    import atexit
+
+    session_dir = new_session_dir()
+    node = NodeProcesses(session_dir)
+    # the daemons must outlive this CLI process unless --block
+    if not args.block:
+        atexit.unregister(node.stop)
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.num_tpus is not None:
+        resources["TPU"] = float(args.num_tpus)
+
+    if args.head:
+        gcs_addr = node.start_gcs(port=args.port)
+        print(f"GCS listening at {gcs_addr[0]}:{gcs_addr[1]}")
+    else:
+        if not args.address:
+            sys.exit("--address required to join an existing cluster "
+                     "(or pass --head)")
+        host, port = args.address.rsplit(":", 1)
+        gcs_addr = (host, int(port))
+    node.start_raylet(gcs_addr, resources=resources or None,
+                      object_store_memory=args.object_store_memory or None)
+    print(f"node {node.node_id[:12]} started (session: {session_dir})")
+
+    dashboard = None
+    if args.head and args.dashboard:
+        if args.block:
+            from ray_tpu.dashboard import start_dashboard
+            dashboard = start_dashboard(gcs_addr, port=args.dashboard_port)
+            print(f"dashboard at http://{dashboard.host}:{dashboard.port}")
+        else:
+            # must outlive this CLI process -> own daemon
+            from ray_tpu.runtime.node import _spawn
+            proc = _spawn(
+                [sys.executable, "-m", "ray_tpu.dashboard",
+                 "--gcs-host", gcs_addr[0],
+                 "--gcs-port", str(gcs_addr[1]),
+                 "--port", str(args.dashboard_port)],
+                session_dir, "dashboard")
+            node.dashboard_proc = proc
+            print(f"dashboard at http://127.0.0.1:{args.dashboard_port}")
+    _write_pids(session_dir, node)
+
+    if args.head:
+        from ray_tpu._private.usage.usage_lib import record_usage_report
+        from ray_tpu.runtime.gcs import GcsClient
+        probe = GcsClient(gcs_addr)
+        try:
+            record_usage_report(session_dir, probe)
+        finally:
+            probe.close()
+        print(f"connect with: ray_tpu.init(address="
+              f"\"{gcs_addr[0]}:{gcs_addr[1]}\")")
+
+    if args.block:
+        print("--block: press Ctrl-C to stop this node")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            if dashboard is not None:
+                dashboard.stop()
+            node.stop()
+
+
+def _write_pids(session_dir: str, node) -> None:
+    pids = [p.pid for p in (node.gcs_proc, node.raylet_proc,
+                            getattr(node, "dashboard_proc", None))
+            if p is not None]
+    with open(os.path.join(session_dir, "pids.json"), "w") as f:
+        json.dump(pids, f)
+
+
+def cmd_stop(args) -> None:
+    """Kill daemons of the latest session (plus their workers)."""
+    import subprocess
+    killed = 0
+    base = "/tmp/ray_tpu_sessions"
+    sessions = []
+    if args.all and os.path.isdir(base):
+        sessions = [os.path.join(base, d) for d in os.listdir(base)
+                    if d.startswith("session_")]
+    else:
+        try:
+            with open(os.path.join(base, "latest.json")) as f:
+                sessions = [json.load(f)["session_dir"]]
+        except (OSError, ValueError, KeyError):
+            pass
+    for sess in sessions:
+        pid_file = os.path.join(sess, "pids.json")
+        try:
+            with open(pid_file) as f:
+                pids = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+                killed += 1
+            except ProcessLookupError:
+                pass
+    # workers/daemons not tracked by pid files (started via init())
+    subprocess.run(
+        ["pkill", "-f",
+         "ray_tpu.(runtime.(gcs|raylet|worker_main)|dashboard)"],
+        check=False)
+    print(f"stopped {killed} tracked daemon(s)")
+
+
+# ----------------------------------------------------------------- status
+def cmd_status(args) -> None:
+    _connect(args)
+    import ray_tpu
+    nodes = ray_tpu.nodes()
+    alive = [n for n in nodes if n["alive"]]
+    print(f"Nodes: {len(alive)} alive / {len(nodes)} total")
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print("Resources:")
+    for r in sorted(total):
+        print(f"  {r}: {avail.get(r, 0):g} / {total[r]:g} available")
+    for n in alive:
+        print(f"  node {n['node_id'][:12]} @ "
+              f"{n['address'][0]}:{n['address'][1]} {n['resources']}")
+
+
+def cmd_list(args) -> None:
+    _connect(args)
+    from ray_tpu.experimental import state
+    fn = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "jobs": state.list_jobs,
+        "objects": state.list_objects,
+        "workers": state.list_workers,
+        "placement-groups": state.list_placement_groups,
+    }[args.resource]
+    rows = fn(limit=args.limit)
+    for row in rows:
+        row = {k: v for k, v in row.items() if k != "events"}
+        print(json.dumps(row, default=str))
+    print(f"({len(rows)} {args.resource})", file=sys.stderr)
+
+
+def cmd_summary(args) -> None:
+    _connect(args)
+    from ray_tpu.experimental import state
+    fn = {"tasks": state.summarize_tasks,
+          "actors": state.summarize_actors,
+          "objects": state.summarize_objects}[args.resource]
+    print(json.dumps(fn(), indent=1, default=str))
+
+
+def cmd_memory(args) -> None:
+    _connect(args)
+    from ray_tpu.experimental.state import memory_summary
+    print(memory_summary())
+
+
+def cmd_timeline(args) -> None:
+    _connect(args)
+    from ray_tpu.experimental.state import timeline
+    out = args.output or f"timeline-{int(time.time())}.json"
+    events = timeline(out)
+    print(f"wrote {len(events)} trace events to {out} "
+          "(open in chrome://tracing or ui.perfetto.dev)")
+
+
+def cmd_debug(args) -> None:
+    _connect(args)
+    from ray_tpu.util.rpdb import list_breakpoints
+    sessions = list_breakpoints()
+    if not sessions:
+        print("no active breakpoints")
+        return
+    for bid, addr in sessions:
+        print(f"{bid}  {addr}   (attach: nc {addr.replace(':', ' ')})")
+
+
+# ------------------------------------------------------------------- jobs
+def cmd_job(args) -> None:
+    from ray_tpu.job_submission import JobSubmissionClient
+    client = JobSubmissionClient(getattr(args, "address", None))
+    if args.job_cmd == "submit":
+        import shlex
+        entrypoint = list(args.entrypoint)
+        if entrypoint and entrypoint[0] == "--":
+            entrypoint = entrypoint[1:]
+        sid = client.submit_job(
+            entrypoint=shlex.join(entrypoint),
+            runtime_env=json.loads(args.runtime_env)
+            if args.runtime_env else None)
+        print(f"submitted: {sid}")
+        if args.wait:
+            status = client.wait_until_finished(sid, timeout=args.timeout)
+            print(f"{sid}: {status}")
+            print(client.get_job_logs(sid), end="")
+            sys.exit(0 if status == "SUCCEEDED" else 1)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+    elif args.job_cmd == "stop":
+        print("stopping" if client.stop_job(args.submission_id)
+              else "not running")
+    elif args.job_cmd == "list":
+        for info in client.list_jobs():
+            print(f"{info.submission_id}  {info.status:10s}  "
+                  f"{info.entrypoint}")
+
+
+# ------------------------------------------------------------------ parser
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray_tpu",
+                                description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="GCS host:port to join")
+    sp.add_argument("--port", type=int, default=0, help="GCS port (head)")
+    sp.add_argument("--num-cpus", type=float)
+    sp.add_argument("--num-tpus", type=float)
+    sp.add_argument("--resources", help="extra resources as JSON")
+    sp.add_argument("--object-store-memory", type=int)
+    sp.add_argument("--dashboard", action="store_true")
+    sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop local daemons")
+    sp.add_argument("--all", action="store_true",
+                    help="stop every session, not just the latest")
+    sp.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("memory", cmd_memory),
+                     ("debug", cmd_debug)):
+        sp = sub.add_parser(name)
+        sp.add_argument("--address")
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("resource", choices=[
+        "tasks", "actors", "nodes", "jobs", "objects", "workers",
+        "placement-groups"])
+    sp.add_argument("--address")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="summarize cluster state")
+    sp.add_argument("resource", choices=["tasks", "actors", "objects"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="export Chrome trace")
+    sp.add_argument("-o", "--output")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address")
+    j.add_argument("--runtime-env", help="runtime env as JSON")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=3600.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("--address")
+        j.add_argument("submission_id")
+    j = jsub.add_parser("list")
+    j.add_argument("--address")
+    sp.set_defaults(fn=cmd_job)
+
+    return p
+
+
+def main(argv: Optional[list] = None) -> None:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,23 @@ T = TypeVar("T")
 U = TypeVar("U")
 
 
+def _apply_transforms(it: Iterator, transforms) -> Iterator:
+    """The one interpreter for the transform chain (shard- and
+    driver-side use the same dispatch so they can never diverge)."""
+    for kind, fn in transforms:
+        if kind == "for_each":
+            it = map(fn, it)
+        elif kind == "filter":
+            it = filter(fn, it)
+        elif kind == "flatten":
+            it = (x for batch in it for x in batch)
+        elif kind == "batch":
+            it = _batched(it, fn)
+        else:
+            raise ValueError(f"unknown transform kind {kind!r}")
+    return it
+
+
 @ray_tpu.remote
 class _ShardActor:
     """Owns one shard's item stream and applies the transform chain."""
@@ -27,17 +44,8 @@ class _ShardActor:
         self._it = None
 
     def reset(self):
-        it = iter(self._items_fn())
-        for kind, fn in self._transforms:
-            if kind == "for_each":
-                it = map(fn, it)
-            elif kind == "filter":
-                it = filter(fn, it)
-            elif kind == "flatten":
-                it = (x for batch in it for x in batch)
-            elif kind == "batch":
-                it = _batched(it, fn)
-        self._it = it
+        self._it = _apply_transforms(iter(self._items_fn()),
+                                     self._transforms)
         return True
 
     def next_batch(self, n: int):
@@ -170,19 +178,7 @@ def _materialized(it: ParallelIterator) -> ParallelIterator:
     fns = []
     for items_fn in it._items_fns:
         def make(fn=items_fn, transforms=tuple(it._transforms)):
-            def run():
-                stream: Iterator = iter(fn())
-                for kind, f in transforms:
-                    if kind == "for_each":
-                        stream = map(f, stream)
-                    elif kind == "filter":
-                        stream = filter(f, stream)
-                    elif kind == "flatten":
-                        stream = (x for b in stream for x in b)
-                    elif kind == "batch":
-                        stream = _batched(stream, f)
-                return stream
-            return run
+            return lambda: _apply_transforms(iter(fn()), transforms)
         fns.append(make())
     return ParallelIterator(fns, name=it.name)
 

@@ -23,25 +23,45 @@ def _run_chunk(fn, chunk, star):
     return [fn(item) for item in chunk]
 
 
-def _window(task, fn, chunks: List[list], star: bool,
+# Per-worker-process record of pools whose initializer already ran: the
+# chunk task below is a module function, so workers share this global and
+# each worker runs a pool's initializer exactly once (stdlib semantics).
+_initialized_pools: set = set()
+
+
+def _run_chunk_with_init(pool_id, initializer, initargs, fn, chunk, star):
+    if pool_id not in _initialized_pools:
+        initializer(*initargs)
+        _initialized_pools.add(pool_id)
+    return _run_chunk(fn, chunk, star)
+
+
+def _window(task, fn, chunks: Iterator[list], star: bool,
             max_inflight: int) -> Iterator[Any]:
-    """Submit chunks with at most `max_inflight` outstanding; yield chunk
-    results in order."""
+    """Submit chunks (a lazy iterator) with at most `max_inflight`
+    outstanding; yield chunk results in order."""
     results: dict = {}
     inflight: dict = {}  # ref -> index
     next_submit = 0
     next_yield = 0
-    n = len(chunks)
-    while next_yield < n:
-        while next_submit < n and len(inflight) < max_inflight:
-            ref = task.remote(fn, chunks[next_submit], star)
-            inflight[ref] = next_submit
+    exhausted = False
+    chunks = iter(chunks)
+    while not exhausted or inflight or next_yield in results:
+        while not exhausted and len(inflight) < max_inflight:
+            try:
+                chunk = next(chunks)
+            except StopIteration:
+                exhausted = True
+                break
+            inflight[task.remote(fn, chunk, star)] = next_submit
             next_submit += 1
         while next_yield in results:
             yield results.pop(next_yield)
             next_yield += 1
-        if next_yield >= n:
-            break
+        if not inflight:
+            if exhausted and next_yield not in results:
+                break
+            continue
         done, _ = ray_tpu.wait(list(inflight), num_returns=1)
         idx = inflight.pop(done[0])
         results[idx] = ray_tpu.get(done[0])
@@ -122,11 +142,11 @@ class Pool:
         self._outstanding: set = set()
         remote_args = dict(ray_remote_args or {})
         if initializer is not None:
-            def _chunk_with_init(fn, chunk, star,
-                                 _init=initializer, _ia=initargs):
-                _init(*_ia)
-                return _run_chunk(fn, chunk, star)
-            body = _chunk_with_init
+            import uuid
+            pool_id = uuid.uuid4().hex
+            import functools
+            body = functools.partial(_run_chunk_with_init, pool_id,
+                                     initializer, initargs)
         else:
             body = _run_chunk
         self._task = ray_tpu.remote(**remote_args)(body) \
@@ -167,6 +187,18 @@ class Pool:
             chunksize = max(1, chunksize)
         return [items[i:i + chunksize]
                 for i in range(0, len(items), chunksize)]
+
+    @staticmethod
+    def _lazy_chunks(iterable: Iterable, chunksize: int) -> Iterator[list]:
+        """Chunk without materializing (imap over generators/streams)."""
+        buf: List[Any] = []
+        for item in iterable:
+            buf.append(item)
+            if len(buf) >= chunksize:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
 
     def _gather(self, fn, iterable, chunksize, star=False) -> List[Any]:
         chunks = self._chunks(iterable, chunksize)
@@ -217,29 +249,34 @@ class Pool:
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: int = 1) -> Iterator[Any]:
-        self._check_running()
-        chunks = self._chunks(iterable, chunksize)
-        for chunk_result in _window(self._task, fn, chunks, False,
-                                    self._processes):
-            yield from chunk_result
+        self._check_running()  # eager, like stdlib — not on first next()
+
+        def gen():
+            chunks = self._lazy_chunks(iterable, chunksize)
+            for chunk_result in _window(self._task, fn, chunks, False,
+                                        self._processes):
+                yield from chunk_result
+        return gen()
 
     def imap_unordered(self, fn: Callable, iterable: Iterable,
                        chunksize: int = 1) -> Iterator[Any]:
         self._check_running()
-        chunks = self._chunks(iterable, chunksize)
-        inflight = {}
-        it = iter(chunks)
-        exhausted = False
-        while inflight or not exhausted:
-            while not exhausted and len(inflight) < self._processes:
-                try:
-                    chunk = next(it)
-                except StopIteration:
-                    exhausted = True
+
+        def gen():
+            inflight = {}
+            it = self._lazy_chunks(iterable, chunksize)
+            exhausted = False
+            while inflight or not exhausted:
+                while not exhausted and len(inflight) < self._processes:
+                    try:
+                        chunk = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    inflight[self._task.remote(fn, chunk, False)] = True
+                if not inflight:
                     break
-                inflight[self._task.remote(fn, chunk, False)] = True
-            if not inflight:
-                break
-            done, _ = ray_tpu.wait(list(inflight), num_returns=1)
-            del inflight[done[0]]
-            yield from ray_tpu.get(done[0])
+                done, _ = ray_tpu.wait(list(inflight), num_returns=1)
+                del inflight[done[0]]
+                yield from ray_tpu.get(done[0])
+        return gen()

@@ -50,11 +50,18 @@ def span(name: str, attributes: Optional[Dict] = None) -> Iterator[None]:
     otel_cm = _tracer.start_as_current_span(name) if _tracer else None
     if otel_cm:
         otel_cm.__enter__()
+    exc_info = (None, None, None)
     try:
         yield
+    except BaseException as e:
+        # capture only exceptions raised from the span body — sys.exc_info()
+        # in the finally would also report an outer in-flight exception when
+        # the span runs inside an except handler
+        exc_info = (type(e), e, e.__traceback__)
+        raise
     finally:
         if otel_cm:
-            otel_cm.__exit__(None, None, None)
+            otel_cm.__exit__(*exc_info)
         _local.ctx = parent or None
         end = time.time()
         from ray_tpu.runtime import core_worker as cw
@@ -65,6 +72,7 @@ def span(name: str, attributes: Optional[Dict] = None) -> Iterator[None]:
             worker.events.record(
                 span_id, "RUNNING", name=f"span:{name}", ts=start,
                 trace_id=trace_id, attrs=dict(attributes or {}))
+            end_state = "FAILED" if exc_info[0] is not None else "FINISHED"
             worker.events.record(
-                span_id, "FINISHED", name=f"span:{name}", ts=end,
+                span_id, end_state, name=f"span:{name}", ts=end,
                 trace_id=trace_id)

@@ -1,0 +1,164 @@
+"""L5 tooling: dashboard HTTP API, job submission, CLI, log-to-driver,
+usage stats (SURVEY.md §2.5)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.util.metrics import Counter
+
+    host, port = ray_tpu.context()["gcs_address"].rsplit(":", 1)
+    head = start_dashboard((host, int(port)), port=0)
+    try:
+        base = f"http://{head.host}:{head.port}"
+        assert _get_json(base + "/api/version")["version"]
+        nodes = _get_json(base + "/api/nodes")["nodes"]
+        assert len(nodes) == 1 and nodes[0]["alive"]
+
+        status = _get_json(base + "/api/cluster_status")
+        assert status["alive_nodes"] == 1
+        assert status["total_resources"]["CPU"] == 4
+
+        # run a task so the task table has rows
+        @ray_tpu.remote
+        def noop():
+            return 1
+        ray_tpu.get(noop.remote())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if _get_json(base + "/api/tasks?limit=10")["tasks"]:
+                break
+            time.sleep(0.3)
+        assert _get_json(base + "/api/tasks")["tasks"]
+
+        c = Counter("dash_test_counter", description="testing")
+        c.inc(3)
+        c.flush()
+        time.sleep(0.2)
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "dash_test_counter" in text
+        assert "ray_tpu_cluster_nodes 1" in text
+    finally:
+        head.stop()
+
+
+def test_job_submission(ray_start_regular):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    status = client.wait_until_finished(sid, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(sid)
+    info = client.get_job_info(sid)
+    assert info.driver_exit_code == 0
+
+    jobs = client.list_jobs()
+    assert any(j.submission_id == sid for j in jobs)
+
+
+def test_job_submission_with_cluster_driver(ray_start_regular):
+    """The submitted script connects back to this cluster and runs tasks."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    script = ("import ray_tpu; ray_tpu.init(); "
+              "f = ray_tpu.remote(lambda: 40 + 2); "
+              "print('answer =', ray_tpu.get(f.remote()))")
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"{script}\"")
+    assert client.wait_until_finished(sid, timeout=180) == \
+        JobStatus.SUCCEEDED
+    assert "answer = 42" in client.get_job_logs(sid)
+
+
+def test_job_stop(ray_start_regular):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(600)\"")
+    deadline = time.monotonic() + 60
+    while client.get_job_status(sid) == JobStatus.PENDING and \
+            time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert client.stop_job(sid)
+    assert client.wait_until_finished(sid, timeout=60) == JobStatus.STOPPED
+
+
+def test_log_to_driver(ray_start_regular, capfd):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def shout():
+        print("LOUD-AND-CLEAR", flush=True)
+        return 1
+
+    assert ray_tpu.get(shout.remote()) == 1
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        out = capfd.readouterr().out
+        if "LOUD-AND-CLEAR" in out:
+            return
+        time.sleep(0.5)
+    raise AssertionError("worker stdout never reached the driver")
+
+
+def test_usage_stats(tmp_path):
+    from ray_tpu._private.usage.usage_lib import (record_usage_report,
+                                                  usage_stats_enabled)
+
+    assert usage_stats_enabled()
+    path = record_usage_report(str(tmp_path))
+    payload = json.loads(open(path).read())
+    assert payload["source"] == "ray_tpu"
+    assert payload["version"]
+    os.environ["RAY_TPU_USAGE_STATS_ENABLED"] = "0"
+    try:
+        assert record_usage_report(str(tmp_path)) == ""
+    finally:
+        del os.environ["RAY_TPU_USAGE_STATS_ENABLED"]
+
+
+@pytest.mark.slow
+def test_cli_start_status_stop():
+    """Full head lifecycle through the CLI (reference `ray start/stop`)."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    run = lambda *cmd, **kw: subprocess.run(  # noqa: E731
+        [sys.executable, "-m", "ray_tpu.scripts", *cmd],
+        env=env, capture_output=True, text=True, timeout=180, **kw)
+
+    out = run("start", "--head", "--num-cpus", "2")
+    assert out.returncode == 0, out.stderr
+    assert "GCS listening" in out.stdout
+    addr = [ln for ln in out.stdout.splitlines()
+            if "ray_tpu.init" in ln][0].split('"')[1]
+    try:
+        st = run("status", "--address", addr)
+        assert st.returncode == 0, st.stderr
+        assert "Nodes: 1 alive" in st.stdout
+
+        ls = run("list", "nodes", "--address", addr)
+        assert ls.returncode == 0, ls.stderr
+        assert ls.stdout.strip()
+    finally:
+        sp = run("stop")
+        assert sp.returncode == 0, sp.stderr

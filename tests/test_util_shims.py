@@ -27,6 +27,40 @@ def test_multiprocessing_pool(ray_start_regular):
         pool.map(lambda x: x, [1])
 
 
+def test_pool_imap_streams_lazily(ray_start_regular):
+    """imap must not materialize the input (stdlib semantics)."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    def gen():
+        yield from range(10 ** 9)  # effectively infinite
+
+    with Pool(2) as pool:
+        it = pool.imap(lambda x: x * 2, gen(), chunksize=4)
+        assert [next(it) for _ in range(6)] == [0, 2, 4, 6, 8, 10]
+
+
+def test_pool_initializer_once_per_worker(ray_start_regular):
+    import os
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    def init_marker():
+        os.environ["POOL_INIT_COUNT"] = str(
+            int(os.environ.get("POOL_INIT_COUNT", "0")) + 1)
+
+    def read_marker(_):
+        return (os.getpid(), int(os.environ.get("POOL_INIT_COUNT", "0")))
+
+    with Pool(2, initializer=init_marker) as pool:
+        # many chunks per worker: initializer must still run once each
+        out = pool.map(read_marker, range(16), chunksize=1)
+    per_pid = {}
+    for pid, count in out:
+        per_pid.setdefault(pid, set()).add(count)
+    for pid, counts in per_pid.items():
+        assert counts == {1}, f"worker {pid} saw init counts {counts}"
+
+
 def test_pool_error_propagation(ray_start_regular):
     from ray_tpu.util.multiprocessing import Pool
 
