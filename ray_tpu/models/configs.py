@@ -26,11 +26,17 @@ class TransformerConfig:
     norm_eps: float = 1e-6
     dtype: jnp.dtype = jnp.bfloat16        # activation dtype
     param_dtype: jnp.dtype = jnp.float32
-    attention_impl: str = "auto"           # auto | xla | flash | ring
+    attention_impl: str = "auto"           # auto | xla | flash | ring | ulysses
     remat: bool = True                     # checkpoint each block (HBM <-> FLOPs)
     scan_layers: bool = True               # lax.scan over layers
     tie_embeddings: bool = False
     z_loss: float = 1e-4
+    # Mixture-of-experts (0 -> dense MLP).  Experts shard over the mesh's
+    # data axes (expert parallelism, ray_tpu/ops/moe.py).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     def __post_init__(self):
         if self.n_kv_heads is None:
@@ -58,6 +64,17 @@ PRESETS = {
     "tiny": TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
                               n_heads=4, d_ff=128, max_seq_len=128,
                               dtype=jnp.float32, remat=False),
+    # test-size MoE (8 experts, top-2)
+    "tiny-moe": TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                  n_heads=4, d_ff=128, max_seq_len=128,
+                                  dtype=jnp.float32, remat=False,
+                                  moe_experts=8, moe_top_k=2),
+    # Mixtral-8x7B shapes (headline open MoE family)
+    "mixtral-8x7b": TransformerConfig(vocab_size=32000, d_model=4096,
+                                      n_layers=32, n_heads=32, n_kv_heads=8,
+                                      d_ff=14336, max_seq_len=8192,
+                                      rope_theta=1e6, moe_experts=8,
+                                      moe_top_k=2),
     # ~124M GPT-2 small shapes
     "gpt-small": TransformerConfig(vocab_size=50304, d_model=768, n_layers=12,
                                    n_heads=12, max_seq_len=1024),

@@ -79,14 +79,24 @@ class Attention(nn.Module):
     def _train_attend(self, q, k, v):
         cfg = self.cfg
         impl = cfg.attention_impl
-        if impl == "ring":
+        if impl in ("ring", "ulysses"):
             if self.mesh is None:
-                raise ValueError("ring attention requires a mesh")
-            from ray_tpu.ops.ring_attention import ring_attention
-            if cfg.n_kv_heads != cfg.n_heads:
+                raise ValueError(f"{impl} attention requires a mesh")
+            if impl == "ring":
+                # the ring accumulator needs matched head counts
+                if cfg.n_kv_heads != cfg.n_heads:
+                    k = repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
+                    v = repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
+                from ray_tpu.ops.ring_attention import ring_attention
+                return ring_attention(q, k, v, mesh=self.mesh, causal=True)
+            # ulysses handles GQA natively (KV all-to-all stays at kv_heads);
+            # only expand when kv_heads doesn't divide the context axis
+            ctx = self.mesh.shape.get("context", 1)
+            if cfg.n_kv_heads % ctx:
                 k = repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
                 v = repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
-            return ring_attention(q, k, v, mesh=self.mesh, causal=True)
+            from ray_tpu.ops.ulysses import ulysses_attention
+            return ulysses_attention(q, k, v, mesh=self.mesh, causal=True)
         from ray_tpu.ops.attention import attention
         return attention(q, k, v, causal=True, impl=impl)
 
@@ -128,13 +138,21 @@ class Block(nn.Module):
             y, cos, sin, positions)
         x = x + y
         y = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
-        gate = _dense(cfg.d_ff, ("embed", "mlp"), "w_gate",
-                      dtype=cfg.dtype, param_dtype=cfg.param_dtype)(y)
-        up = _dense(cfg.d_ff, ("embed", "mlp"), "w_up",
-                    dtype=cfg.dtype, param_dtype=cfg.param_dtype)(y)
-        y = _dense(cfg.d_model, ("mlp", "embed"), "w_down",
-                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)(
-            nn.silu(gate) * up)
+        if cfg.moe_experts > 0:
+            from ray_tpu.ops.moe import MoEMLP
+            y = MoEMLP(cfg.moe_experts, cfg.d_ff, top_k=cfg.moe_top_k,
+                       capacity_factor=cfg.moe_capacity_factor,
+                       aux_loss_coef=cfg.moe_aux_coef,
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="moe")(y)
+        else:
+            gate = _dense(cfg.d_ff, ("embed", "mlp"), "w_gate",
+                          dtype=cfg.dtype, param_dtype=cfg.param_dtype)(y)
+            up = _dense(cfg.d_ff, ("embed", "mlp"), "w_up",
+                        dtype=cfg.dtype, param_dtype=cfg.param_dtype)(y)
+            y = _dense(cfg.d_model, ("mlp", "embed"), "w_down",
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype)(
+                nn.silu(gate) * up)
         x = x + y
         if self.mesh is not None and not self.decode:
             x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),
@@ -174,7 +192,7 @@ class GPT(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry, cos, sin, positions), None),
-                variable_axes={"params": 0, "cache": 0},
+                variable_axes={"params": 0, "cache": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: None},

@@ -1,0 +1,118 @@
+"""Mixture-of-experts MLP with expert parallelism (Switch/Mixtral-style).
+
+Expert parallelism is absent from the reference (SURVEY.md §2.6: "Expert
+parallel (EP/MoE): absent").  TPU-first design: routing is a *dense*,
+static-shape dispatch — top-k gating builds [tokens, experts, capacity]
+one-hot dispatch/combine tensors and the expert FFNs run as one batched
+einsum over the expert dimension.  Expert parameters carry the ``expert``
+logical axis (sharded over the data axes by the default rule table,
+ray_tpu/parallel/sharding.py), so under GSPMD the dispatch einsum lowers to
+the expert all-to-all on ICI; no ragged host-side routing, everything stays
+on the MXU with shapes known at compile time.
+
+The router's load-balancing auxiliary loss (Switch Transformer eq. 4) is
+exported via ``self.sow("intermediates", "moe_aux_loss", ...)``; the train
+step collects and adds it (ray_tpu/train/step.py lm_loss_fn).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Drop-in SwiGLU MLP with ``n_experts`` experts and top-k routing.
+
+    Input/output: [B, S, d_model].  Tokens overflowing an expert's capacity
+    ``ceil(top_k * S * capacity_factor / n_experts)`` are dropped (their
+    residual stream passes through unchanged), the standard static-shape
+    TPU formulation.
+    """
+
+    n_experts: int
+    d_ff: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    router_jitter: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, d = x.shape
+        e, k = self.n_experts, self.top_k
+        capacity = max(int(k * s * self.capacity_factor / e), 1)
+        capacity = min(capacity, s * k)
+
+        router = nn.DenseGeneral(
+            e, axis=-1, use_bias=False, name="router",
+            dtype=jnp.float32, param_dtype=self.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", None)))
+        logits = router(x.astype(jnp.float32))          # [B, S, E]
+        if (self.router_jitter > 0.0 and not self.is_initializing()
+                and self.has_rng("router")):
+            # jitter only when the caller provides a "router" rng stream
+            # (the default train step passes none — jitter then degrades to
+            # deterministic routing instead of raising inside jit)
+            noise = jax.random.uniform(
+                self.make_rng("router"), logits.shape,
+                minval=1.0 - self.router_jitter, maxval=1.0 + self.router_jitter)
+            logits = logits * noise
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)   # [B, S, K]
+        gate_vals = gate_vals / jnp.clip(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+        # Position of each (token, slot) in its expert's queue, in
+        # slot-major order so a token's first choice wins capacity first.
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [B,S,K,E]
+        slot_major = onehot.transpose(0, 2, 1, 3).reshape(b, k * s, e)
+        pos = jnp.cumsum(slot_major, axis=1) - 1.0                 # [B,KS,E]
+        pos = (pos * slot_major).sum(-1).reshape(b, k, s).transpose(0, 2, 1)
+        pos = pos.astype(jnp.int32)
+        within_cap = pos < capacity                                # [B, S, K]
+
+        keep = onehot * within_cap[..., None]                      # [B,S,K,E]
+        pos_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+        # dispatch: [B, S, E, C]; combine adds the gate weights.
+        dispatch = jnp.einsum("bske,bskc->bsec", keep, pos_onehot)
+        combine = jnp.einsum("bsk,bske,bskc->bsec",
+                             gate_vals, keep, pos_onehot)
+
+        w_gate = self.param(
+            "w_gate", nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "expert_in", "expert_mlp")),
+            (e, d, self.d_ff), self.param_dtype)
+        w_up = self.param(
+            "w_up", nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "expert_in", "expert_mlp")),
+            (e, d, self.d_ff), self.param_dtype)
+        w_down = self.param(
+            "w_down", nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "expert_mlp", "expert_in")),
+            (e, self.d_ff, d), self.param_dtype)
+
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(self.dtype),
+                               x.astype(self.dtype))
+        gate_h = jnp.einsum("ebcd,edf->ebcf", expert_in,
+                            w_gate.astype(self.dtype))
+        up_h = jnp.einsum("ebcd,edf->ebcf", expert_in,
+                          w_up.astype(self.dtype))
+        expert_out = jnp.einsum("ebcf,efd->ebcd", nn.silu(gate_h) * up_h,
+                                w_down.astype(self.dtype))
+        y = jnp.einsum("bsec,ebcd->bsd", combine.astype(self.dtype),
+                       expert_out)
+
+        # Switch load-balancing loss: E * sum_e f_e * P_e, where f_e is the
+        # fraction of tokens whose top-1 choice is e and P_e the mean router
+        # probability for e.
+        top1 = jax.nn.one_hot(expert_idx[..., 0], e, dtype=jnp.float32)
+        f = jnp.mean(top1, axis=(0, 1))
+        p = jnp.mean(probs, axis=(0, 1))
+        aux = self.aux_loss_coef * e * jnp.sum(f * p)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return y.astype(x.dtype)

@@ -39,6 +39,8 @@ DEFAULT_RULES: Tuple[Tuple[str, MeshAxes], ...] = (
     ("head_dim", None),
     ("vocab", "tensor"),
     ("expert", ("data", "fsdp")),  # expert-parallel: experts across data axes
+    ("expert_in", None),           # expert weight model dim (expert axis
+                                   # already consumes the data axes)
     ("expert_mlp", "tensor"),
     ("stage", None),               # pipeline stage axis (pipeline.py overrides)
     ("norm", None),

@@ -81,9 +81,19 @@ def lm_loss_fn(apply_fn: Callable, params: Any, batch: Dict[str, jax.Array],
     mask = batch.get("mask")
     if mask is not None:
         mask = mask[:, 1:].astype(jnp.float32)
-    logits = apply_fn({"params": params}, inputs)
+    logits, mutated = apply_fn({"params": params}, inputs,
+                               mutable=["intermediates"])
     loss, denom = softmax_cross_entropy(logits, targets, mask, z_loss)
-    return loss, {"loss": loss, "tokens": denom}
+    metrics = {"loss": loss, "tokens": denom}
+    # MoE routers sow per-layer load-balancing losses (ray_tpu/ops/moe.py)
+    aux_leaves = [jnp.sum(a) for path, a in jax.tree_util.tree_leaves_with_path(
+        mutated.get("intermediates", {})) if "moe_aux_loss" in str(path)]
+    if aux_leaves:
+        aux = sum(aux_leaves)
+        loss = loss + aux
+        metrics["moe_aux_loss"] = aux
+        metrics["loss"] = loss
+    return loss, metrics
 
 
 def make_sharded_train(model: nn.Module,
