@@ -9,6 +9,7 @@ per-framework DDP wrappers (/root/reference/python/ray/train/torch/config.py:29)
 
 from ray_tpu.parallel.mesh import (MeshConfig, build_mesh, local_mesh,
                                    mesh_shape_for)
+from ray_tpu.parallel.pipeline import pipelined_lm_forward, spmd_pipeline
 from ray_tpu.parallel.sharding import (LOGICAL_RULES, ShardingRules,
                                        logical_sharding, logical_spec,
                                        shard_pytree_like, with_sharding)
@@ -16,5 +17,6 @@ from ray_tpu.parallel.sharding import (LOGICAL_RULES, ShardingRules,
 __all__ = [
     "MeshConfig", "build_mesh", "local_mesh", "mesh_shape_for",
     "ShardingRules", "LOGICAL_RULES", "logical_spec", "logical_sharding",
-    "with_sharding", "shard_pytree_like",
+    "with_sharding", "shard_pytree_like", "spmd_pipeline",
+    "pipelined_lm_forward",
 ]

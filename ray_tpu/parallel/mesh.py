@@ -24,7 +24,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXIS_ORDER: Tuple[str, ...] = ("data", "fsdp", "context", "tensor")
+AXIS_ORDER: Tuple[str, ...] = ("stage", "data", "fsdp", "context", "tensor")
 
 
 @dataclasses.dataclass
@@ -41,11 +41,12 @@ class MeshConfig:
     fsdp: int = 1
     context: int = 1
     tensor: int = 1
+    stage: int = 1                 # pipeline stages (outermost: slowest links)
 
-    def sizes(self) -> Tuple[int, int, int, int]:
-        return (self.data, self.fsdp, self.context, self.tensor)
+    def sizes(self) -> Tuple[int, ...]:
+        return (self.stage, self.data, self.fsdp, self.context, self.tensor)
 
-    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
+    def resolve(self, n_devices: int) -> Tuple[int, ...]:
         sizes = list(self.sizes())
         wildcard = [i for i, s in enumerate(sizes) if s == -1]
         if len(wildcard) > 1:
@@ -63,7 +64,7 @@ class MeshConfig:
 
 
 def mesh_shape_for(n_devices: int, config: Optional[MeshConfig] = None
-                   ) -> Tuple[int, int, int, int]:
+                   ) -> Tuple[int, ...]:
     return (config or MeshConfig()).resolve(n_devices)
 
 

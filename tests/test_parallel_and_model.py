@@ -14,9 +14,11 @@ from ray_tpu.train.step import OptimizerConfig, make_sharded_train
 
 
 def test_mesh_config_resolution():
-    assert MeshConfig(data=-1).resolve(8) == (8, 1, 1, 1)
-    assert MeshConfig(data=-1, fsdp=2, tensor=2).resolve(8) == (2, 2, 1, 2)
-    assert MeshConfig(data=2, fsdp=2, context=2, tensor=1).resolve(8) == (2, 2, 2, 1)
+    assert MeshConfig(data=-1).resolve(8) == (1, 8, 1, 1, 1)
+    assert MeshConfig(data=-1, fsdp=2, tensor=2).resolve(8) == (1, 2, 2, 1, 2)
+    assert MeshConfig(data=2, fsdp=2, context=2, tensor=1).resolve(8) == \
+        (1, 2, 2, 2, 1)
+    assert MeshConfig(stage=2, data=-1).resolve(8) == (2, 4, 1, 1, 1)
     with pytest.raises(ValueError):
         MeshConfig(data=3).resolve(8)
     with pytest.raises(ValueError):
