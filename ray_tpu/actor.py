@@ -73,6 +73,21 @@ class ActorClass:
             "directly; use .remote()")
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu.util import client as client_mod
+        ctx = client_mod.current()
+        if ctx is not None:
+            # remote-driver mode is decided at *call* time (see
+            # RemoteFunction.remote)
+            return ctx.remote(
+                self._cls,
+                num_cpus=self._resources.get("CPU", 1.0),
+                num_tpus=self._resources.get("TPU", 0.0),
+                resources={k: v for k, v in self._resources.items()
+                           if k not in ("CPU", "TPU")},
+                max_restarts=self._max_restarts,
+                name=self._name,
+                max_concurrency=self._max_concurrency,
+            ).remote(*args, **kwargs)
         from ray_tpu.util.scheduling_strategies import encode_strategy
         worker = get_global_worker()
         actor_id = worker.create_actor(
@@ -121,6 +136,11 @@ def get_actor(name: str, namespace: str = "") -> ActorHandle:
     return ActorHandle(ActorID.from_hex(info["actor_id"]))
 
 
-def kill(handle: ActorHandle) -> None:
+def kill(handle) -> None:
     """Forcibly terminate an actor (cf. ray.kill)."""
+    from ray_tpu.util import client as client_mod
+    ctx = client_mod.current()
+    if ctx is not None:
+        ctx.kill(handle)
+        return
     get_global_worker().kill_actor(handle._actor_id)

@@ -23,7 +23,10 @@ _node: Optional[NodeProcesses] = None
 
 
 def is_initialized() -> bool:
-    return cw._global_worker is not None
+    if cw._global_worker is not None:
+        return True
+    from ray_tpu.util import client as client_mod
+    return client_mod.current() is not None
 
 
 def init(address: Optional[str] = None, *,
@@ -40,6 +43,28 @@ def init(address: Optional[str] = None, *,
     ``address="host:port"`` connects to an existing GCS.
     """
     global _node
+    if address and (address.startswith("client://")
+                    or address.startswith("ray://")):
+        # remote-driver mode: everything proxies through the client server
+        # (cf. reference ray://, python/ray/util/client/client_builder.py)
+        from ray_tpu._private.logging_utils import get_logger
+        from ray_tpu.util import client as client_mod
+        ignored = {"num_cpus": num_cpus, "num_tpus": num_tpus,
+                   "resources": resources,
+                   "object_store_memory": object_store_memory,
+                   "system_config": system_config,
+                   "runtime_env": runtime_env,
+                   "namespace": namespace or None}
+        ignored = [k for k, v in ignored.items() if v]
+        if ignored:
+            get_logger("client").warning(
+                "init(address=%r) ignores %s in remote-driver mode; "
+                "configure them on the cluster / client server instead",
+                address, ignored)
+        ctx = client_mod.current()
+        if ctx is None:
+            ctx = client_mod.connect(address)
+        return {"address": address, "client": ctx}
     with _init_lock:
         if is_initialized():
             return context()
@@ -142,8 +167,18 @@ def context() -> Dict[str, Any]:
     }
 
 
+def _client():
+    """Active remote-driver context, if init was called with client://."""
+    from ray_tpu.util import client as client_mod
+    return client_mod.current()
+
+
 def shutdown() -> None:
     global _node
+    from ray_tpu.util import client as client_mod
+    if client_mod.current() is not None:
+        client_mod.disconnect()
+        return
     with _init_lock:
         worker = cw._global_worker
         if worker is not None:
@@ -193,11 +228,17 @@ def remote(*args, **kwargs):
 
 
 def put(value: Any) -> ObjectRef:
+    ctx = _client()
+    if ctx is not None:
+        return ctx.put(value)
     return cw.get_global_worker().put(value)
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None) -> Any:
+    ctx = _client()
+    if ctx is not None:
+        return ctx.get(refs, timeout=timeout)
     worker = cw.get_global_worker()
     if isinstance(refs, ObjectRef):
         return worker.get([refs], timeout=timeout)[0]
@@ -207,12 +248,18 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None,
          fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    ctx = _client()
+    if ctx is not None:
+        return ctx.wait(refs, num_returns=num_returns, timeout=timeout)
     return cw.get_global_worker().wait(
         list(refs), num_returns=num_returns, timeout=timeout,
         fetch_local=fetch_local)
 
 
 def nodes() -> List[dict]:
+    ctx = _client()
+    if ctx is not None:
+        return ctx.nodes()
     return cw.get_global_worker().gcs.call("list_nodes")
 
 

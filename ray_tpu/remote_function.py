@@ -36,6 +36,20 @@ class RemoteFunction:
             "directly; use .remote()")
 
     def remote(self, *args, **kwargs):
+        from ray_tpu.util import client as client_mod
+        ctx = client_mod.current()
+        if ctx is not None:
+            # remote-driver mode is decided at *call* time so functions
+            # decorated before init("client://...") still route correctly
+            return ctx.remote(
+                self._func,
+                num_returns=self._num_returns,
+                num_cpus=self._resources.get("CPU", 1.0),
+                num_tpus=self._resources.get("TPU", 0.0),
+                resources={k: v for k, v in self._resources.items()
+                           if k not in ("CPU", "TPU")},
+                max_retries=self._max_retries,
+            ).remote(*args, **kwargs)
         from ray_tpu.util.scheduling_strategies import encode_strategy
         worker = get_global_worker()
         refs = worker.submit_task(

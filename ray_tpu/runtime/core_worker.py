@@ -458,6 +458,10 @@ class CoreWorker:
              fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         deadline = None if timeout is None else time.monotonic() + timeout
         refs = list(refs)
+        if len({r.id for r in refs}) != len(refs):
+            # reference parity (worker.py wait): duplicates would also make
+            # num_returns unsatisfiable and spin forever
+            raise ValueError("wait() requires a list of unique object refs")
         ready: List[ObjectRef] = []
         while True:
             ready = [r for r in refs if self._is_ready(r)]

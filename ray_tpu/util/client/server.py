@@ -1,0 +1,207 @@
+"""Client server: hosts remote drivers over the control-plane RPC layer.
+
+Analog of the reference's Ray Client server (/root/reference/python/ray/util/
+client/server/, proxier.py; wire protocol ray_client.proto:324
+``RayletDriver``): a thin process inside the cluster that executes
+put/get/wait/task/actor calls on behalf of drivers connecting from outside
+(laptops, notebooks).  One shared embedded driver serves every client
+connection; per-connection registries pin ObjectRefs/actor handles so a
+client disconnect releases everything it created.
+
+Run standalone:  ``python -m ray_tpu.util.client.server --port 10001``
+(connects to the latest local session, or pass ``--address host:port``).
+"""
+
+from __future__ import annotations
+
+import cloudpickle
+import pickle
+import threading
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private import rpc
+
+
+class _Ref:
+    """Wire tag for a client-held object ref inside pickled args."""
+
+    def __init__(self, ref_id: str):
+        self.ref_id = ref_id
+
+    def __reduce__(self):
+        return (_Ref, (self.ref_id,))
+
+
+class _ActorRef:
+    """Wire tag for a client-held actor handle inside pickled args."""
+
+    def __init__(self, actor_id: str):
+        self.actor_id = actor_id
+
+    def __reduce__(self):
+        return (_ActorRef, (self.actor_id,))
+
+
+def _map_structure(value, fn):
+    """Resolve wire tags recursively through plain containers (tags buried
+    inside arbitrary user objects are not found — same as the reference)."""
+    if isinstance(value, (_Ref, _ActorRef)):
+        return fn(value)
+    if isinstance(value, (list, tuple)):
+        return type(value)(_map_structure(v, fn) for v in value)
+    if isinstance(value, dict):
+        return {k: _map_structure(v, fn) for k, v in value.items()}
+    return value
+
+
+class ClientServer:
+    """Serves client drivers; embeds (or joins) a cluster as their proxy."""
+
+    def __init__(self, address: Optional[str] = None, host: str = "0.0.0.0",
+                 port: int = 10001, **init_kwargs):
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address, **init_kwargs)
+        self._lock = threading.Lock()
+        # per-connection state: refs and actor handles created by the client
+        self._refs: Dict[rpc.Connection, Dict[str, Any]] = {}
+        self._actors: Dict[rpc.Connection, Dict[str, Any]] = {}
+        self._server = rpc.Server(self._handle, host=host, port=port,
+                                  on_disconnect=self._disconnected)
+        self.address: Tuple[str, int] = self._server.address
+
+    # ------------------------------------------------------------- plumbing
+    def _conn_refs(self, conn) -> Dict[str, Any]:
+        with self._lock:
+            return self._refs.setdefault(conn, {})
+
+    def _register(self, conn, ref) -> str:
+        rid = uuid.uuid4().hex
+        self._conn_refs(conn)[rid] = ref
+        return rid
+
+    def _resolve(self, conn, value):
+        refs = self._conn_refs(conn)
+
+        def one(tag):
+            if isinstance(tag, _ActorRef):
+                return self._actor(conn, tag.actor_id)
+            try:
+                return refs[tag.ref_id]
+            except KeyError:
+                raise rpc.RpcError(f"unknown ref {tag.ref_id[:8]}")
+        return _map_structure(value, one)
+
+    def _disconnected(self, conn) -> None:
+        with self._lock:
+            self._refs.pop(conn, None)
+            self._actors.pop(conn, None)
+
+    # ------------------------------------------------------------- handlers
+    def _handle(self, conn, method: str, p: Any) -> Any:
+        return getattr(self, f"_rpc_{method}")(conn, p or {})
+
+    def _rpc_put(self, conn, p):
+        import ray_tpu
+        ref = ray_tpu.put(pickle.loads(p["data"]))
+        return {"ref_id": self._register(conn, ref)}
+
+    def _rpc_get(self, conn, p):
+        import ray_tpu
+        refs = [self._resolve(conn, _Ref(r)) for r in p["ref_ids"]]
+        values = ray_tpu.get(refs, timeout=p.get("timeout"))
+        return {"data": cloudpickle.dumps(values)}
+
+    def _rpc_wait(self, conn, p):
+        import ray_tpu
+        id_of = {id(v): rid for rid, v in self._conn_refs(conn).items()}
+        refs = [self._resolve(conn, _Ref(r)) for r in p["ref_ids"]]
+        ready, pending = ray_tpu.wait(refs,
+                                      num_returns=p.get("num_returns", 1),
+                                      timeout=p.get("timeout"))
+        return {"ready": [id_of[id(r)] for r in ready],
+                "pending": [id_of[id(r)] for r in pending]}
+
+    def _rpc_task(self, conn, p):
+        import ray_tpu
+        fn = pickle.loads(p["func"])
+        args = self._resolve(conn, pickle.loads(p["args"]))
+        kwargs = self._resolve(conn, pickle.loads(p["kwargs"]))
+        remote_fn = ray_tpu.remote(fn)
+        if p.get("options"):
+            remote_fn = remote_fn.options(**p["options"])
+        out = remote_fn.remote(*args, **kwargs)
+        refs = out if isinstance(out, list) else [out]
+        return {"ref_ids": [self._register(conn, r) for r in refs]}
+
+    def _rpc_create_actor(self, conn, p):
+        import ray_tpu
+        cls = pickle.loads(p["cls"])
+        args = self._resolve(conn, pickle.loads(p["args"]))
+        kwargs = self._resolve(conn, pickle.loads(p["kwargs"]))
+        actor_cls = ray_tpu.remote(cls)
+        if p.get("options"):
+            actor_cls = actor_cls.options(**p["options"])
+        handle = actor_cls.remote(*args, **kwargs)
+        aid = uuid.uuid4().hex
+        with self._lock:
+            self._actors.setdefault(conn, {})[aid] = handle
+        return {"actor_id": aid}
+
+    def _actor(self, conn, aid):
+        with self._lock:
+            handle = self._actors.get(conn, {}).get(aid)
+        if handle is None:
+            raise rpc.RpcError(f"unknown actor {aid[:8]}")
+        return handle
+
+    def _rpc_actor_call(self, conn, p):
+        handle = self._actor(conn, p["actor_id"])
+        args = self._resolve(conn, pickle.loads(p["args"]))
+        kwargs = self._resolve(conn, pickle.loads(p["kwargs"]))
+        ref = getattr(handle, p["method"]).remote(*args, **kwargs)
+        return {"ref_id": self._register(conn, ref)}
+
+    def _rpc_kill_actor(self, conn, p):
+        import ray_tpu
+        ray_tpu.kill(self._actor(conn, p["actor_id"]))
+        with self._lock:
+            self._actors.get(conn, {}).pop(p["actor_id"], None)
+        return {}
+
+    def _rpc_nodes(self, conn, p):
+        import ray_tpu
+        return {"nodes": ray_tpu.nodes()}
+
+    def _rpc_cluster_info(self, conn, p):
+        import ray_tpu
+        return {"nodes": len(ray_tpu.nodes()),
+                "resources": ray_tpu.cluster_resources()}
+
+    def stop(self) -> None:
+        self._server.stop()
+
+
+def main() -> None:
+    import argparse
+    import time
+    parser = argparse.ArgumentParser(description="ray_tpu client server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=10001)
+    parser.add_argument("--address", default="auto",
+                        help="cluster GCS address (default: latest session)")
+    args = parser.parse_args()
+    server = ClientServer(address=args.address, host=args.host,
+                          port=args.port)
+    print(f"client server listening on {server.address[0]}:{server.address[1]}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Ray-Client-mode tests: remote driver over the client server."""
+
+import subprocess
+import sys
+
+import pytest
+
+
+CLIENT_DRIVER = """
+import ray_tpu
+
+# decorated BEFORE init: client dispatch must happen at call time
+@ray_tpu.remote
+def double(x):
+    return 2 * x
+
+@ray_tpu.remote
+def poke(acc, v):
+    # acc arrives as a real server-side actor handle
+    import ray_tpu as rt
+    return rt.get(acc.add.remote(v))
+
+@ray_tpu.remote
+class Acc:
+    def __init__(self, start):
+        self.n = start
+    def add(self, v):
+        self.n += v
+        return self.n
+
+ray_tpu.init(address="client://127.0.0.1:__PORT__")
+assert ray_tpu.is_initialized()
+ref = ray_tpu.put(21)
+assert ray_tpu.get(double.remote(ref)) == 42
+refs = [double.remote(i) for i in range(4)]
+ready, pending = ray_tpu.wait(refs, num_returns=4, timeout=30)
+assert len(ready) == 4 and not pending
+assert ray_tpu.get(refs) == [0, 2, 4, 6]
+
+a = Acc.remote(10)
+assert ray_tpu.get(a.add.remote(5)) == 15
+# a client ref passed into an actor call resolves server-side
+assert ray_tpu.get(a.add.remote(ref)) == 36
+
+# a ref nested two containers deep still resolves
+@ray_tpu.remote
+def deep(d):
+    import ray_tpu as rt
+    return rt.get(d["xs"][0]) + 1
+
+assert ray_tpu.get(deep.remote({"xs": [ref]})) == 22
+# actor handles ship into tasks as wire tags
+assert ray_tpu.get(poke.remote(a, 4)) == 40
+assert len(ray_tpu.nodes()) >= 1
+ray_tpu.kill(a)
+import pytest_unused  # noqa
+"""
+CLIENT_DRIVER = CLIENT_DRIVER.replace("import pytest_unused  # noqa",
+                                      "ray_tpu.shutdown()\nprint('CLIENT-OK')")
+
+
+@pytest.fixture
+def client_server():
+    import ray_tpu
+    from ray_tpu.util.client.server import ClientServer
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    server = ClientServer(host="127.0.0.1", port=0)
+    yield server
+    server.stop()
+    ray_tpu.shutdown()
+
+
+def test_client_driver_end_to_end(client_server):
+    out = subprocess.run(
+        [sys.executable, "-c",
+         CLIENT_DRIVER.replace("__PORT__", str(client_server.address[1]))],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "CLIENT-OK" in out.stdout
+
+
+def test_client_refs_release_on_disconnect(client_server):
+    from ray_tpu.util import client as client_mod
+    ctx = client_mod.ClientContext(client_server.address)
+    ref = ctx.put({"k": 1})
+    assert ctx.get(ref) == {"k": 1}
+    conns = list(client_server._refs)
+    assert conns and client_server._refs[conns[0]]
+    ctx.disconnect()
+    import time
+    for _ in range(50):
+        if not client_server._refs:
+            break
+        time.sleep(0.1)
+    assert not client_server._refs  # registry dropped with the connection
