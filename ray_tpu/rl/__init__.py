@@ -2,15 +2,17 @@
 
 Analog of /root/reference/rllib (SURVEY.md §2.4): AlgorithmConfig builder,
 Algorithm driver (Tune-compatible), WorkerSet of fault-tolerant rollout
-actors, PPO (sync, mesh-sharded SGD) and IMPALA (async, V-trace), replay
+actors, PPO (sync, mesh-sharded SGD), IMPALA (async, V-trace), DQN (replay +
+target net + double/dueling Q), replay
 buffers, in-repo gymnasium-compatible envs.
 """
 
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from ray_tpu.rl.env import (Box, CartPoleEnv, Discrete, Env,  # noqa: F401
                             PendulumEnv, VectorEnv, make_env, register_env)
+from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.impala import Impala, ImpalaConfig, vtrace  # noqa: F401
-from ray_tpu.rl.policy import JaxPolicy  # noqa: F401
+from ray_tpu.rl.policy import JaxPolicy, QPolicy  # noqa: F401
 from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer,  # noqa: F401
                                       ReplayBuffer)
@@ -20,7 +22,8 @@ from ray_tpu.rl.worker_set import WorkerSet  # noqa: F401
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
-    "ImpalaConfig", "vtrace", "RolloutWorker", "WorkerSet", "JaxPolicy",
+    "ImpalaConfig", "DQN", "DQNConfig", "vtrace", "RolloutWorker",
+    "WorkerSet", "JaxPolicy", "QPolicy",
     "SampleBatch", "compute_gae", "ReplayBuffer", "PrioritizedReplayBuffer",
     "Env", "Box", "Discrete", "CartPoleEnv", "PendulumEnv", "VectorEnv",
     "make_env", "register_env",

@@ -106,14 +106,16 @@ class Algorithm:
         self.config = config
         if config.env_spec is None:
             raise ValueError("config.environment(env) is required")
+        worker_kwargs = dict(
+            num_envs=config.num_envs_per_worker,
+            rollout_fragment_length=config.rollout_fragment_length,
+            gamma=config.gamma, lam=config.lam,
+            hidden=config.hidden, seed=config.seed)
+        worker_kwargs.update(self.extra_worker_kwargs(config))
         self.workers = WorkerSet(
             config.env_spec,
             num_workers=max(config.num_rollout_workers, 1),
-            worker_kwargs=dict(
-                num_envs=config.num_envs_per_worker,
-                rollout_fragment_length=config.rollout_fragment_length,
-                gamma=config.gamma, lam=config.lam,
-                hidden=config.hidden, seed=config.seed),
+            worker_kwargs=worker_kwargs,
             recreate_failed_workers=config.recreate_failed_workers)
         self.iteration = 0
         self._timesteps_total = 0
@@ -122,6 +124,11 @@ class Algorithm:
         self.workers.sync_weights(self.get_weights())
 
     # -- subclass surface --------------------------------------------------
+    @classmethod
+    def extra_worker_kwargs(cls, config: AlgorithmConfig) -> Dict[str, Any]:
+        """Extra RolloutWorker kwargs (e.g. DQN selects the Q policy)."""
+        return {}
+
     def setup_learner(self) -> None:
         raise NotImplementedError
 

@@ -1,0 +1,189 @@
+"""DQN: off-policy Q-learning with replay, target network, double-Q, dueling.
+
+Analog of /root/reference/rllib/algorithms/dqn/dqn.py (training_step:
+sample → store → replay → TD update → periodic target sync) with the loss
+of dqn_torch_policy.py (Huber TD error, double-Q action selection).
+TPU-native: the TD step is one jitted function over the mesh's data axis;
+rollout actors run the epsilon-greedy QPolicy on CPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as M
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import Box, make_env
+from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer, ReplayBuffer,
+                                      SampleBatch)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DQN
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.buffer_size = 50_000
+        self.learning_starts = 1000
+        self.target_update_freq = 500        # in sampled env steps
+        self.n_updates_per_iter = 32         # TD steps per training_step
+        self.double_q = True
+        self.dueling = True
+        self.prioritized_replay = False
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.02
+        self.epsilon_timesteps = 10_000
+        self.rollout_fragment_length = 32
+        self.num_sgd_iter = 1                # unused; kept for config parity
+
+
+class DQN(Algorithm):
+    @classmethod
+    def extra_worker_kwargs(cls, config: AlgorithmConfig) -> Dict[str, Any]:
+        return {"policy": "q",
+                "policy_kwargs": {"dueling": getattr(config, "dueling",
+                                                     True)}}
+
+    def setup_learner(self) -> None:
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        cfg: DQNConfig = self.config
+        probe = make_env(cfg.env_spec)
+        if isinstance(probe.action_space, Box):
+            raise ValueError("DQN requires a discrete action space")
+        act_dim = probe.action_space.n
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        probe.close()
+
+        self.model = M.QNetwork(action_dim=act_dim, hidden=tuple(cfg.hidden),
+                                dueling=cfg.dueling)
+        params = self.model.init(jax.random.PRNGKey(cfg.seed or 0),
+                                 jnp.zeros((1, obs_dim)))["params"]
+        self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                              optax.adam(cfg.lr))
+
+        n_dev = jax.device_count()
+        shape = cfg.mesh_shape or {"data": n_dev}
+        self.mesh = Mesh(mesh_utils.create_device_mesh(
+            tuple(shape.values())), tuple(shape.keys()))
+        self.batch_sharding = NamedSharding(self.mesh, P("data"))
+        repl = NamedSharding(self.mesh, P())
+        self.params = jax.device_put(params, repl)
+        self.target_params = jax.device_put(params, repl)
+        self.opt_state = jax.device_put(self.tx.init(self.params), repl)
+
+        buffer_cls = PrioritizedReplayBuffer if cfg.prioritized_replay \
+            else ReplayBuffer
+        self.buffer = buffer_cls(cfg.buffer_size, seed=cfg.seed)
+        self._steps_since_target_sync = 0
+
+        model, tx = self.model, self.tx
+        gamma, double_q = cfg.gamma, cfg.double_q
+
+        def loss_fn(params, target_params, batch):
+            q = model.apply({"params": params}, batch[SB.OBS])
+            q_taken = jnp.take_along_axis(
+                q, batch[SB.ACTIONS][:, None].astype(jnp.int32), axis=-1)[:, 0]
+            q_next_target = model.apply({"params": target_params},
+                                        batch[SB.NEXT_OBS])
+            if double_q:
+                # online net picks the action, target net evaluates it
+                q_next_online = model.apply({"params": params},
+                                            batch[SB.NEXT_OBS])
+                next_a = jnp.argmax(q_next_online, axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_next_target, next_a[:, None], axis=-1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_target, axis=-1)
+            not_done = 1.0 - batch[SB.TERMINATEDS].astype(jnp.float32)
+            target = batch[SB.REWARDS] + gamma * not_done * \
+                jax.lax.stop_gradient(q_next)
+            td = q_taken - target
+            weights = batch.get("weights")
+            huber = optax.huber_loss(q_taken, target, delta=1.0)
+            loss = jnp.mean(huber * weights) if weights is not None \
+                else jnp.mean(huber)
+            return loss, {"mean_q": q_taken.mean(), "td_error": td}
+
+        @jax.jit
+        def td_step(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["loss"] = loss
+            aux["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, aux
+
+        self._td_step = td_step
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(self.mesh, P())
+        self.params = jax.device_put(jax.tree.map(jnp.asarray, weights), repl)
+        self.target_params = self.params
+
+    def _epsilon(self) -> float:
+        cfg: DQNConfig = self.config
+        frac = min(self._timesteps_total / max(cfg.epsilon_timesteps, 1), 1.0)
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: DQNConfig = self.config
+        # 1. sample transitions with the current epsilon
+        self.workers.foreach_worker("set_epsilon", self._epsilon())
+        batches = self.workers.foreach_worker("sample_transitions")
+        for b in batches:
+            self.buffer.add(b)
+            self._timesteps_total += b.count
+            self._steps_since_target_sync += b.count
+
+        info: Dict[str, Any] = {"epsilon": self._epsilon(),
+                                "buffer_size": len(self.buffer)}
+        if len(self.buffer) < cfg.learning_starts:
+            return {"info": info}
+
+        # 2. replayed TD updates on the mesh
+        n_shards = self.mesh.devices.size
+        mb = max(cfg.train_batch_size, n_shards)
+        mb -= mb % n_shards
+        prioritized = isinstance(self.buffer, PrioritizedReplayBuffer)
+        aux_last: Dict[str, Any] = {}
+        for _ in range(cfg.n_updates_per_iter):
+            sample = self.buffer.sample(mb)
+            device_batch = {
+                k: jax.device_put(np.asarray(v), self.batch_sharding)
+                for k, v in sample.items()
+                if k in (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS,
+                         SB.TERMINATEDS, "weights")}
+            self.params, self.opt_state, aux = self._td_step(
+                self.params, self.target_params, self.opt_state, device_batch)
+            if prioritized and "batch_indexes" in sample:
+                self.buffer.update_priorities(
+                    sample["batch_indexes"],
+                    np.abs(np.asarray(aux["td_error"])) + 1e-6)
+            aux_last = aux
+
+        # 3. periodic hard target sync (dqn.py target_network_update_freq)
+        if self._steps_since_target_sync >= cfg.target_update_freq:
+            self.target_params = self.params
+            self._steps_since_target_sync = 0
+            info["target_synced"] = True
+
+        # 4. fresh online weights to the epsilon-greedy rollouts
+        self.workers.sync_weights(self.get_weights())
+        info.update({k: float(np.mean(np.asarray(v)))
+                     for k, v in aux_last.items() if k != "td_error"})
+        return {"info": info}

@@ -75,3 +75,24 @@ def diag_gaussian_logp(logits, actions):
 def diag_gaussian_entropy(logits):
     _, log_std = jnp.split(logits, 2, axis=-1)
     return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+
+class QNetwork(nn.Module):
+    """MLP state-action value head for DQN-family algorithms
+    (cf. reference rllib/algorithms/dqn/dqn_torch_model.py; dueling
+    decomposition Q = V + A - mean(A) when ``dueling``)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+    dueling: bool = True
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"q_{i}")(x))
+        adv = nn.Dense(self.action_dim, name="q_out")(x)
+        if not self.dueling:
+            return adv
+        v = nn.Dense(1, name="v_out")(x)
+        return v + adv - jnp.mean(adv, axis=-1, keepdims=True)

@@ -77,3 +77,61 @@ class JaxPolicy:
 
     def set_weights(self, weights: Any) -> None:
         self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class QPolicy:
+    """Epsilon-greedy policy over a QNetwork (DQN-family rollouts).
+
+    Exposes the same ``compute_actions`` triple as JaxPolicy so
+    RolloutWorker can drive either; logp is zeros (no likelihoods) and the
+    value column carries max-Q (useful for metrics only).
+    """
+
+    def __init__(self, observation_space, action_space,
+                 hidden=(256, 256), seed: int = 0, epsilon: float = 1.0,
+                 dueling: bool = True):
+        if isinstance(action_space, Box):
+            raise ValueError("QPolicy requires a discrete action space")
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.epsilon = epsilon
+        # dueling must match the learner's QNetwork or weight sync breaks
+        self.model = M.QNetwork(action_dim=action_space.n,
+                                hidden=tuple(hidden), dueling=dueling)
+        obs_dim = int(np.prod(observation_space.shape))
+        self._rng = jax.random.PRNGKey(seed)
+        self.params = self.model.init(
+            self._rng, jnp.zeros((1, obs_dim)))["params"]
+
+        @jax.jit
+        def _greedy(params, obs):
+            q = self.model.apply({"params": params}, obs)
+            return jnp.argmax(q, axis=-1), jnp.max(q, axis=-1)
+
+        self._greedy = _greedy
+
+    def set_epsilon(self, epsilon: float) -> None:
+        self.epsilon = float(epsilon)
+
+    def compute_actions(self, obs: np.ndarray, *, explore: bool = True
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        obs = jnp.asarray(obs)
+        greedy, maxq = self._greedy(self.params, obs)
+        greedy = np.asarray(greedy)
+        if explore and self.epsilon > 0.0:
+            self._rng, key = jax.random.split(self._rng)
+            n = greedy.shape[0]
+            k1, k2 = jax.random.split(key)
+            randoms = np.asarray(jax.random.randint(
+                k1, (n,), 0, self.action_space.n))
+            flip = np.asarray(jax.random.uniform(k2, (n,))) < self.epsilon
+            actions = np.where(flip, randoms, greedy)
+        else:
+            actions = greedy
+        return actions, np.zeros(actions.shape[0]), np.asarray(maxq)
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)

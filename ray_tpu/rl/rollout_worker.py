@@ -23,7 +23,8 @@ class RolloutWorker:
     def __init__(self, env_spec, *, num_envs: int = 1,
                  rollout_fragment_length: int = 200,
                  gamma: float = 0.99, lam: float = 0.95,
-                 hidden=(256, 256),
+                 hidden=(256, 256), policy: str = "ac",
+                 policy_kwargs: Optional[Dict[str, Any]] = None,
                  worker_index: int = 0, seed: Optional[int] = None):
         # rollout actors must never grab the TPU
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -32,9 +33,15 @@ class RolloutWorker:
         self.worker_index = worker_index
         seed = (seed if seed is not None else 1234) + worker_index * 1000
         self.vec = VectorEnv(env_spec, num_envs, seed=seed)
-        self.policy = JaxPolicy(self.vec.observation_space,
-                                self.vec.action_space, hidden=hidden,
-                                seed=seed)
+        if policy == "q":
+            from ray_tpu.rl.policy import QPolicy
+            self.policy = QPolicy(self.vec.observation_space,
+                                  self.vec.action_space, hidden=hidden,
+                                  seed=seed, **(policy_kwargs or {}))
+        else:
+            self.policy = JaxPolicy(self.vec.observation_space,
+                                    self.vec.action_space, hidden=hidden,
+                                    seed=seed, **(policy_kwargs or {}))
         self.fragment = rollout_fragment_length
         self.gamma, self.lam = gamma, lam
         self._obs = self.vec.reset()
@@ -133,6 +140,47 @@ class RolloutWorker:
         out = {k: np.stack(v) for k, v in cols.items()}
         out["bootstrap_obs"] = self._obs.copy()
         return out
+
+    def set_epsilon(self, epsilon: float) -> None:
+        """Exploration schedule hook (QPolicy only; no-op otherwise)."""
+        if hasattr(self.policy, "set_epsilon"):
+            self.policy.set_epsilon(epsilon)
+
+    def sample_transitions(self) -> SampleBatch:
+        """(obs, action, reward, next_obs, terminated) rows for replay-based
+        algorithms — no GAE, truncations bootstrap (terminated=False)."""
+        cols: Dict[str, List[np.ndarray]] = {
+            SB.OBS: [], SB.ACTIONS: [], SB.REWARDS: [], SB.NEXT_OBS: [],
+            SB.TERMINATEDS: []}
+        for _ in range(self.fragment):
+            actions, _, _ = self.policy.compute_actions(self._obs)
+            next_obs, rewards, terms, truncs, infos = self.vec.step(actions)
+            # auto-reset replaced ended envs' obs with the NEXT episode's
+            # start — TD targets must bootstrap from the real final obs
+            # (truncated rows especially: terminated=False there)
+            row_next = next_obs.copy()
+            for i, info in enumerate(infos):
+                if "terminal_observation" in info:
+                    row_next[i] = info["terminal_observation"]
+            cols[SB.OBS].append(self._obs)
+            cols[SB.ACTIONS].append(actions)
+            cols[SB.REWARDS].append(rewards)
+            cols[SB.NEXT_OBS].append(row_next)
+            cols[SB.TERMINATEDS].append(terms)
+            self._ep_rewards += rewards
+            self._ep_lens += 1
+            for i in range(self.vec.num_envs):
+                if terms[i] or truncs[i]:
+                    self._completed.append(
+                        {"episode_reward": float(self._ep_rewards[i]),
+                         "episode_len": int(self._ep_lens[i])})
+                    self._ep_rewards[i] = 0.0
+                    self._ep_lens[i] = 0
+            self._obs = next_obs
+        # flatten [T, N, ...] -> [T*N, ...]
+        out = {k: np.concatenate(v) if np.asarray(v[0]).ndim > 1
+               else np.stack(v).reshape(-1) for k, v in cols.items()}
+        return SampleBatch(out)
 
     def get_metrics(self) -> List[Dict[str, float]]:
         out, self._completed = self._completed, []
