@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import cloudpickle
 import pickle
 import shutil
 import tarfile
@@ -94,19 +95,21 @@ class Checkpoint:
                 shutil.copytree(self._path, path, dirs_exist_ok=True)
             return path
         with open(os.path.join(path, _DICT_FILE), "wb") as f:
-            pickle.dump(self._data, f, protocol=pickle.HIGHEST_PROTOCOL)
+            cloudpickle.dump(self._data, f,
+                             protocol=pickle.HIGHEST_PROTOCOL)
         return path
 
     def to_bytes(self) -> bytes:
         if self._data is not None:
-            return pickle.dumps(self._data, protocol=pickle.HIGHEST_PROTOCOL)
+            return cloudpickle.dumps(self._data,
+                                 protocol=pickle.HIGHEST_PROTOCOL)
         # tar the directory into bytes (small checkpoints / tests only)
         import io
         buf = io.BytesIO()
         with tarfile.open(fileobj=buf, mode="w") as tar:
             tar.add(self._path, arcname=".")
-        return pickle.dumps({"__dir_tar__": buf.getvalue()},
-                            protocol=pickle.HIGHEST_PROTOCOL)
+        return cloudpickle.dumps({"__dir_tar__": buf.getvalue()},
+                                 protocol=pickle.HIGHEST_PROTOCOL)
 
     def to_jax(self, target: Any = None, *, shardings: Any = None) -> Any:
         """Restore a pytree saved with ``from_jax``. ``target`` (an abstract

@@ -2,5 +2,8 @@
 
 from ray_tpu.models.configs import PRESETS, TransformerConfig, get_config
 from ray_tpu.models.gpt import GPT
+from ray_tpu.models.resnet import (ResNet, ResNet18, ResNet34, ResNet50,
+                                   ResNet101)
 
-__all__ = ["GPT", "TransformerConfig", "PRESETS", "get_config"]
+__all__ = ["GPT", "TransformerConfig", "PRESETS", "get_config",
+           "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101"]

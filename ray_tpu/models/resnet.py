@@ -1,0 +1,119 @@
+"""ResNet family (v1.5): the vision baseline model.
+
+BASELINE.md's vision reference is ResNet-50 data-parallel training (the
+reference's GPU training benchmark, doc/source/ray-air/benchmarks.rst
+:158-174); SURVEY.md §7 phase 4 names ResNet-50/CIFAR-10 as the first
+end-to-end slice.  Flax implementation, NHWC (TPU-native conv layout),
+bfloat16 activations with fp32 batch-norm statistics; trains under the same
+make_sharded_train harness as the transformers via ``classification_loss_fn``
+(ray_tpu/train/step.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """images [B, H, W, C] (NHWC) -> logits [B, num_classes]."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    small_inputs: bool = False     # CIFAR-style stem (3x3, no maxpool)
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 padding="SAME")
+        norm = functools.partial(nn.BatchNorm, use_running_average=not
+                                 self.train, momentum=0.9, epsilon=1e-5,
+                                 dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i, conv=conv,
+                                   norm=norm, strides=strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     kernel_init=nn.with_logical_partitioning(
+                         nn.initializers.lecun_normal(),
+                         ("embed", "vocab")))(x)
+        return x.astype(jnp.float32)
+
+
+def _preset(stages, block) -> Callable[..., ResNet]:
+    def make(num_classes: int = 1000, **kwargs) -> ResNet:
+        return ResNet(stage_sizes=stages, block_cls=block,
+                      num_classes=num_classes, **kwargs)
+    return make
+
+
+ResNet18 = _preset([2, 2, 2, 2], BasicBlock)
+ResNet34 = _preset([3, 4, 6, 3], BasicBlock)
+ResNet50 = _preset([3, 4, 6, 3], BottleneckBlock)
+ResNet101 = _preset([3, 4, 23, 3], BottleneckBlock)

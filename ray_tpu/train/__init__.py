@@ -11,8 +11,11 @@ from ray_tpu.train.base_trainer import (BackendConfig,  # noqa: F401
                                         TrainingFailedError)
 from ray_tpu.train.jax_trainer import (JaxConfig, JaxTrainer,  # noqa: F401
                                        get_mesh)
+from ray_tpu.train.predictor import (BatchPredictor,  # noqa: F401
+                                     JaxPredictor, Predictor)
 from ray_tpu.train.step import (OptimizerConfig,  # noqa: F401
-                                lm_loss_fn, make_sharded_train)
+                                classification_loss_fn, lm_loss_fn,
+                                make_sharded_train, make_vision_train)
 from ray_tpu.train.torch_trainer import (TorchConfig,  # noqa: F401
                                          TorchTrainer, prepare_data_loader,
                                          prepare_model)
@@ -23,5 +26,7 @@ __all__ = [
     "TrainingFailedError", "JaxTrainer", "JaxConfig", "get_mesh",
     "TorchTrainer", "TorchConfig", "prepare_model", "prepare_data_loader",
     "WorkerGroup", "TrainWorker", "make_sharded_train", "OptimizerConfig",
+    "make_vision_train", "classification_loss_fn", "Predictor",
+    "JaxPredictor", "BatchPredictor",
     "lm_loss_fn",
 ]

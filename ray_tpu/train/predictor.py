@@ -1,0 +1,139 @@
+"""Predictors: checkpoint -> batch inference, locally or over a Dataset.
+
+Analog of /root/reference/python/ray/train/predictor.py (Predictor) and
+batch_predictor.py (BatchPredictor: map_batches with an actor pool so each
+actor deserializes the model once).  TPU-shaped: JaxPredictor jits the
+apply function on first call; BatchPredictor rides Dataset.map_batches'
+stateful-actor path, so scoring N blocks costs one model load per actor,
+not per block.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Type
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class Predictor:
+    """Base: subclass implements ``from_checkpoint`` and ``predict``."""
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kwargs) -> "Predictor":
+        raise NotImplementedError
+
+    def predict(self, batch: Dict[str, np.ndarray], **kwargs) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+
+class JaxPredictor(Predictor):
+    """Runs a flax module's apply with checkpointed params.
+
+    ``checkpoint`` must hold {"params": pytree} (e.g. Checkpoint.from_jax of
+    a train state); input batches use ``input_column`` and predictions are
+    written to ``output_column``.
+    """
+
+    def __init__(self, model, params: Any, *, input_column: str = "features",
+                 output_column: str = "predictions",
+                 extra_collections: Optional[Dict[str, Any]] = None,
+                 apply_fn: Optional[Callable] = None):
+        import jax
+        self.model = model
+        self.params = params
+        # batch_stats etc. — models with normalization state must be built
+        # in eval mode (e.g. ResNet(train=False)) so apply reads, not writes
+        self.extra_collections = dict(extra_collections or {})
+        self.input_column = input_column
+        self.output_column = output_column
+        raw = apply_fn or (
+            lambda variables, x: model.apply(variables, x))
+        self._apply = jax.jit(raw)
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, model=None,
+                        **kwargs) -> "JaxPredictor":
+        data = checkpoint.to_dict()
+        params = data.get("params")
+        extras = {k: v for k, v in data.items()
+                  if k in ("batch_stats",) and v}
+        if params is None and "state" in data:
+            state = data["state"]
+            params = getattr(state, "params", None)
+            stats = getattr(state, "batch_stats", None)
+            if stats:
+                extras["batch_stats"] = stats
+        if params is None:
+            raise ValueError("checkpoint has no 'params' entry")
+        if model is None:
+            model = data.get("model")
+        if model is None:
+            raise ValueError("pass model= or store it in the checkpoint")
+        return cls(model, params, extra_collections=extras, **kwargs)
+
+    def predict(self, batch: Dict[str, np.ndarray], **kwargs) -> Dict[str, np.ndarray]:
+        x = np.asarray(batch[self.input_column])
+        variables = {"params": self.params, **self.extra_collections}
+        out = np.asarray(self._apply(variables, x))
+        result = dict(batch)
+        result[self.output_column] = out
+        return result
+
+
+class BatchPredictor:
+    """Distributed inference: score a Dataset with an actor pool.
+
+    ``BatchPredictor.from_checkpoint(ckpt, JaxPredictor, model=...)``
+    then ``.predict(ds)`` — one predictor per pool actor (reference
+    batch_predictor.py semantics).
+    """
+
+    def __init__(self, checkpoint: Checkpoint,
+                 predictor_cls: Type[Predictor], **predictor_kwargs):
+        self.checkpoint = checkpoint
+        self.predictor_cls = predictor_cls
+        self.predictor_kwargs = predictor_kwargs
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint,
+                        predictor_cls: Type[Predictor],
+                        **predictor_kwargs) -> "BatchPredictor":
+        return cls(checkpoint, predictor_cls, **predictor_kwargs)
+
+    def predict(self, ds, *, batch_size: Optional[int] = 4096,
+                min_scoring_workers: int = 1,
+                max_scoring_workers: int = 2,
+                num_cpus_per_worker: float = 1.0):
+        from ray_tpu.data.dataset import ActorPoolStrategy
+        ckpt, pcls, pkw = self.checkpoint, self.predictor_cls, \
+            self.predictor_kwargs
+
+        class _Scorer:
+            def __init__(self):
+                self._p = pcls.from_checkpoint(ckpt, **pkw)
+
+            def __call__(self, batch):
+                return self._p.predict(batch)
+
+        return ds.map_batches(
+            _Scorer, batch_size=batch_size, batch_format="numpy",
+            compute=ActorPoolStrategy(min_scoring_workers,
+                                      max_scoring_workers),
+            num_cpus=num_cpus_per_worker)
+
+    def predict_pipelined(self, ds, *, blocks_per_window: int = 10, **kwargs):
+        """Windowed scoring over a DatasetPipeline (streaming ingest)."""
+        ckpt, pcls, pkw = self.checkpoint, self.predictor_cls, \
+            self.predictor_kwargs
+        holder: Dict[str, Predictor] = {}
+
+        def score(batch):
+            # one predictor per scoring process, not per batch
+            if "p" not in holder:
+                holder["p"] = pcls.from_checkpoint(ckpt, **pkw)
+            return holder["p"].predict(batch)
+
+        pipe = ds.window(blocks_per_window=blocks_per_window)
+        return pipe.map_batches(score, batch_format="numpy")

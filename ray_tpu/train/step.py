@@ -96,6 +96,30 @@ def lm_loss_fn(apply_fn: Callable, params: Any, batch: Dict[str, jax.Array],
     return loss, metrics
 
 
+def _born_sharded(build_state, step, example_batch, mesh: Mesh,
+                  rules: ShardingRules, batch_axes=("batch",)):
+    """Shared construction: trace the state abstractly, read logical
+    PartitionSpecs, jit init (born sharded) and step (donated state)."""
+    if example_batch is None:
+        raise ValueError("example_batch is required to trace shapes")
+    abstract = jax.eval_shape(build_state, jax.random.PRNGKey(0),
+                              example_batch)
+    logical = nn.get_partition_spec(abstract)
+    state_shardings = tree_mesh_shardings(logical, mesh, rules)
+    batch_sharding = jax.tree.map(
+        lambda _: NamedSharding(mesh, logical_spec(batch_axes, mesh, rules)),
+        example_batch)
+    repl = NamedSharding(mesh, PartitionSpec())
+    init_fn = jax.jit(build_state, out_shardings=state_shardings)
+    step_fn = jax.jit(
+        step,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=(state_shardings, repl),
+        donate_argnums=(0,),
+    )
+    return init_fn, step_fn, state_shardings, batch_sharding
+
+
 def make_sharded_train(model: nn.Module,
                        mesh: Mesh,
                        optimizer: Optional[OptimizerConfig] = None,
@@ -119,21 +143,6 @@ def make_sharded_train(model: nn.Module,
         return TrainState.create(apply_fn=model.apply,
                                  params=variables["params"], tx=tx)
 
-    if example_batch is None:
-        raise ValueError("example_batch is required to trace shapes")
-
-    abstract = jax.eval_shape(build_state, jax.random.PRNGKey(0),
-                              example_batch)
-    logical = nn.get_partition_spec(abstract)
-    state_shardings = tree_mesh_shardings(logical, mesh, rules)
-    batch_sharding = jax.tree.map(
-        lambda _: NamedSharding(mesh, logical_spec(("batch", None), mesh,
-                                                   rules)),
-        example_batch)
-    repl = NamedSharding(mesh, PartitionSpec())
-
-    init_fn = jax.jit(build_state, out_shardings=state_shardings)
-
     def step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
         grad_fn = jax.value_and_grad(
             lambda p: loss_fn(state.apply_fn, p, batch, z_loss), has_aux=True)
@@ -143,10 +152,65 @@ def make_sharded_train(model: nn.Module,
         metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
-    step_fn = jax.jit(
-        step,
-        in_shardings=(state_shardings, batch_sharding),
-        out_shardings=(state_shardings, repl),
-        donate_argnums=(0,),
-    )
-    return init_fn, step_fn, state_shardings, batch_sharding
+    return _born_sharded(build_state, step, example_batch, mesh, rules,
+                         batch_axes=("batch", None))
+
+
+def classification_loss_fn(logits: jax.Array, labels: jax.Array
+                           ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Softmax CE + accuracy for label classification (vision models)."""
+    one_hot = jax.nn.one_hot(labels, logits.shape[-1])
+    loss = jnp.mean(optax.softmax_cross_entropy(logits, one_hot))
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+class TrainStateBN(TrainState):
+    """TrainState plus mutable normalization statistics (BatchNorm)."""
+
+    batch_stats: Any = None
+
+
+def make_vision_train(model: nn.Module,
+                      mesh: Mesh,
+                      optimizer: Optional[OptimizerConfig] = None,
+                      rules: ShardingRules = LOGICAL_RULES,
+                      example_batch: Optional[Dict[str, jax.Array]] = None):
+    """make_sharded_train for image classifiers with BatchNorm state.
+
+    batch: {"image": [B, H, W, C], "label": [B]}.  Same born-sharded
+    construction as make_sharded_train; the step threads ``batch_stats``
+    through the jitted update (cf. flax imagenet example semantics, built
+    on this repo's sharding rules).
+    """
+    optimizer = optimizer or OptimizerConfig()
+    tx = optimizer.make()
+    if example_batch is None:
+        raise ValueError("example_batch is required to trace shapes")
+
+    def build_state(rng, batch) -> TrainStateBN:
+        variables = model.init(rng, batch["image"])
+        return TrainStateBN.create(
+            apply_fn=model.apply, params=variables["params"], tx=tx,
+            batch_stats=variables.get("batch_stats", {}))
+
+    def step(state: TrainStateBN, batch):
+        def lf(p):
+            logits, mutated = state.apply_fn(
+                {"params": p, "batch_stats": state.batch_stats},
+                batch["image"], mutable=["batch_stats"])
+            loss, metrics = classification_loss_fn(logits, batch["label"])
+            return loss, (metrics, mutated.get("batch_stats", {}))
+
+        (loss, (metrics, new_stats)), grads = jax.value_and_grad(
+            lf, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads=grads).replace(
+            batch_stats=new_stats)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    # batch leaves have mixed rank (image rank-4, label rank-1): shard dim 0
+    # only, trailing dims stay unsharded implicitly
+    return _born_sharded(build_state, step, example_batch, mesh, rules,
+                         batch_axes=("batch",))
