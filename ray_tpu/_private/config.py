@@ -57,6 +57,10 @@ _declare("object_store_fallback_dir", str, "/tmp",
          "Directory for fallback-allocated (spilled) store segments.")
 _declare("object_spill_threshold", float, 0.8,
          "Fraction of store capacity above which primary copies spill to disk.")
+_declare("scheduler_spill_threshold", float, 0.5,
+         "Hybrid scheduling: local/packing preference holds until a node's "
+         "critical-resource utilization crosses this fraction (cf. reference "
+         "scheduler_spread_threshold, ray_config_def.h).")
 _declare("worker_pool_prestart", int, 0,
          "Number of workers each node daemon prestarts eagerly.")
 _declare("worker_pool_max_idle", int, 8,

@@ -967,6 +967,7 @@ class CoreWorker:
         })
         self.gcs.call("register_actor", {
             "actor_id": actor_id.hex(),
+            "caller_node_id": self.node_id,
             "job_id": self.job_id.hex(),
             "name": name,
             "namespace": namespace,

@@ -58,6 +58,9 @@ class GcsServer:
                                   on_disconnect=self._on_disconnect)
         self._stopped = threading.Event()
         self._retry_inflight = threading.Event()
+        from ray_tpu._core.scheduler import make_scheduler
+        self._cluster_scheduler = make_scheduler(
+            spill_threshold=CONFIG.scheduler_spill_threshold)
         self._health_thread = threading.Thread(target=self._health_loop,
                                                daemon=True)
         self._health_thread.start()
@@ -112,6 +115,9 @@ class GcsServer:
             }
             self._node_conns[node_id] = conn
             conn.peer = ("node", node_id)
+            self._cluster_scheduler.update_node(
+                node_id, self._nodes[node_id]["resources"],
+                self._nodes[node_id]["available"], True)
         self._publish("node", {"node_id": node_id, "state": "ALIVE"})
         # a new node may unblock pending actors / placement groups
         threading.Thread(target=self._retry_pending_actors,
@@ -153,6 +159,8 @@ class GcsServer:
                 return {"ok": False, "dead": True}
             node["last_heartbeat"] = time.monotonic()
             node["available"] = dict(p.get("available", node["available"]))
+            self._cluster_scheduler.update_node(
+                p["node_id"], node["resources"], node["available"], True)
             node["load"] = list(p.get("load", []))
             busy = bool(p.get("busy"))
             if busy or node.get("busy"):
@@ -215,6 +223,7 @@ class GcsServer:
             if not node or not node["alive"]:
                 return
             node["alive"] = False
+            self._cluster_scheduler.remove_node(node_id)
             affected = [aid for aid, a in self._actors.items()
                         if a.get("node_id") == node_id
                         and a["state"] in (ALIVE, PENDING_CREATION)]
@@ -374,6 +383,7 @@ class GcsServer:
                 raise ValueError(f"actor name {name!r} already taken")
             entry = {
                 "actor_id": aid,
+                "caller_node_id": p.get("caller_node_id"),
                 "job_id": p.get("job_id"),
                 "name": name,
                 "namespace": ns,
@@ -467,19 +477,42 @@ class GcsServer:
                     strategy = {}
             if not candidates and fail_reason is None and bundle is None \
                     and strategy.get("type") != "node_affinity":
-                feasible = [
-                    node for node in self._nodes.values() if node["alive"]
-                    and all(node["available"].get(r, 0) >= v
-                            for r, v in need.items())]
-                if strategy.get("type") == "spread":
+                def _fits(node):
+                    # milli-unit rounding to match the scheduler's fixed-
+                    # point arithmetic (csrc/scheduler.cc) exactly
+                    return all(
+                        int(round(node["available"].get(r, 0) * 1000))
+                        >= int(round(v * 1000)) for r, v in need.items())
+                feasible = [node for node in self._nodes.values()
+                            if node["alive"] and _fits(node)]
+                spread = strategy.get("type") == "spread"
+                if spread:
                     # most-available-CPU first (cf. SpreadSchedulingPolicy)
                     feasible.sort(
                         key=lambda n: -n["available"].get("CPU", 0))
+                elif len(feasible) > 1:
+                    # rank the primary choice with the native hybrid policy
+                    # (csrc/scheduler.cc; cf. hybrid_scheduling_policy.h:48):
+                    # pack near the creator until it crosses the spill
+                    # threshold; remaining feasible nodes stay as fallbacks
+                    best = self._cluster_scheduler.best_node(
+                        need, local_id=entry.get("caller_node_id"))
+                    if best is not None:
+                        feasible.sort(
+                            key=lambda n: n["node_id"] != best)
                 for node in feasible:
                     candidates.append((node["node_id"], None))
             if fail_reason is None and not candidates:
                 # no feasible node now; retried on the next node registration
-                logger.info("actor %s pending: no feasible node", aid[:8])
+                # (kept pending even if infeasible against total capacity —
+                # the autoscaler scales from pending demand — but say which)
+                if not self._cluster_scheduler.feasible_anywhere(need):
+                    logger.warning(
+                        "actor %s pending: infeasible with current cluster "
+                        "total resources (%s); waiting for the cluster to "
+                        "grow", aid[:8], need)
+                else:
+                    logger.info("actor %s pending: no feasible node", aid[:8])
                 # hand the entry back to _retry_pending_actors (a stale
                 # retry_delay would park it forever: nothing else retries)
                 entry.pop("retry_delay", None)
