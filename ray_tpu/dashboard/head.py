@@ -66,6 +66,7 @@ class DashboardHead:
             web.get("/api/jobs/{submission_id}", self._job_info),
             web.get("/api/jobs/{submission_id}/logs", self._job_logs),
             web.post("/api/jobs/{submission_id}/stop", self._job_stop),
+            web.get("/api/serve/applications", self._serve_status),
             web.get("/metrics", self._metrics),
             web.get("/", self._index),
         ])
@@ -91,7 +92,8 @@ class DashboardHead:
             "service": "ray_tpu dashboard",
             "routes": ["/api/version", "/api/nodes", "/api/actors",
                        "/api/tasks", "/api/placement_groups",
-                       "/api/cluster_status", "/api/jobs", "/metrics"]})
+                       "/api/cluster_status", "/api/jobs",
+                       "/api/serve/applications", "/metrics"]})
 
     async def _version(self, request) -> web.Response:
         import ray_tpu
@@ -132,6 +134,13 @@ class DashboardHead:
             "total_resources": total,
             "available_resources": avail,
         })
+
+    async def _serve_status(self, request) -> web.Response:
+        """Serve controller status (published to GCS KV each reconcile)."""
+        import json
+        raw = await self._call(self.gcs.kv_get, "serve:status")
+        deployments = json.loads(raw) if raw else {}
+        return web.json_response({"deployments": deployments})
 
     # ---------------------------------------------------------------- jobs
     def _job_kv(self, prefix: str) -> List[dict]:

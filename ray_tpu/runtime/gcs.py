@@ -570,9 +570,10 @@ class GcsServer:
             timer.daemon = True
             timer.start()
             return
+        reason = repr(last_err) if last_err is not None else "no candidates"
         logger.warning("actor %s creation dispatch failed: %s",
-                       aid[:8], last_err)
-        self._on_actor_failure(aid, f"creation failed: {last_err}")
+                       aid[:8], reason)
+        self._on_actor_failure(aid, f"creation failed: {reason}")
 
     def _rpc_actor_ready(self, conn, p):
         """Called by the actor's worker once __init__ completed."""

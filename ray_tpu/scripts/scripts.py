@@ -265,6 +265,42 @@ def cmd_job(args) -> None:
                   f"{info.entrypoint}")
 
 
+def cmd_serve(args) -> None:
+    """serve status / run / deploy / shutdown (reference `serve` CLI)."""
+    _connect(args)
+    from ray_tpu import serve as serve_api
+    from ray_tpu.serve.schema import ServeApplicationSchema
+
+    if args.serve_cmd == "status":
+        for name, st in sorted(serve_api.status().items()):
+            print(f"{name:24s} {st['status']:10s} "
+                  f"{st['running_replicas']}/{st['target_replicas']} replicas "
+                  f"v{st['version']}")
+    elif args.serve_cmd == "run":
+        schema = ServeApplicationSchema(import_path=args.import_path)
+        schema.apply()
+        print(f"deployed {args.import_path}")
+        if args.blocking:
+            import time as _time
+            try:
+                while True:
+                    _time.sleep(3600)
+            except KeyboardInterrupt:
+                serve_api.shutdown()
+                print("serve shut down")
+    elif args.serve_cmd == "deploy":
+        import yaml
+        with open(args.config_file) as f:
+            cfg = yaml.safe_load(f)
+        apps = cfg.get("applications", [cfg])
+        for app in apps:
+            ServeApplicationSchema.from_dict(app).apply()
+            print(f"deployed {app.get('name', 'default')}")
+    elif args.serve_cmd == "shutdown":
+        serve_api.shutdown()
+        print("serve shut down")
+
+
 # ------------------------------------------------------------------ parser
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ray_tpu",
@@ -313,6 +349,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("serve", help="serve deployments")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    s = ssub.add_parser("status")
+    s.add_argument("--address")
+    s = ssub.add_parser("run")
+    s.add_argument("import_path", help="module:app bound Application")
+    s.add_argument("--address")
+    s.add_argument("--blocking", action="store_true")
+    s = ssub.add_parser("deploy")
+    s.add_argument("config_file", help="YAML app config")
+    s.add_argument("--address")
+    s = ssub.add_parser("shutdown")
+    s.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("job", help="job submission")
     jsub = sp.add_subparsers(dest="job_cmd", required=True)

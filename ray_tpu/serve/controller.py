@@ -117,6 +117,11 @@ class ServeController:
             self._shutdown = True
             deployments = list(self._deployments.values())
             self._deployments.clear()
+        try:
+            from ray_tpu.experimental import internal_kv
+            internal_kv._internal_kv_del("serve:status")
+        except Exception:
+            pass  # dashboard may briefly show stale status
         for state in deployments:
             for info in state["replicas"].values():
                 self._kill_replica(info["name"])
@@ -129,10 +134,26 @@ class ServeController:
         while not self._shutdown:
             try:
                 self._reconcile_once()
+                self._publish_status()
             except Exception:  # noqa: BLE001 - loop must survive
                 import traceback
                 traceback.print_exc()
             time.sleep(0.25)
+
+    def _publish_status(self):
+        """Snapshot status into GCS internal KV so non-driver processes
+        (dashboard REST, `ray serve status`) can read it — the role of the
+        reference controller's GCS-KV checkpoints (serve controller.py:61
+        'owns state in GCS KV')."""
+        import json
+
+        from ray_tpu.experimental import internal_kv
+        snap = json.dumps(self.status(), sort_keys=True)
+        if snap != getattr(self, "_last_status_snap", None):
+            # put first: a failed put must retry next pass, not wait for
+            # the next status transition
+            internal_kv._internal_kv_put("serve:status", snap.encode())
+            self._last_status_snap = snap
 
     def _reconcile_once(self):
         import ray_tpu

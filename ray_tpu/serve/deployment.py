@@ -54,6 +54,8 @@ class Deployment:
                 continue
             if not hasattr(cfg, k):
                 raise ValueError(f"unknown deployment option {k!r}")
+            if k == "autoscaling_config" and isinstance(v, dict):
+                v = AutoscalingConfig(**v)
             setattr(cfg, k, v)
         return Deployment(self.func_or_class,
                           opts.get("name", self.name), cfg)

@@ -11,6 +11,9 @@ from ray_tpu.train.base_trainer import (BackendConfig,  # noqa: F401
                                         TrainingFailedError)
 from ray_tpu.train.jax_trainer import (JaxConfig, JaxTrainer,  # noqa: F401
                                        get_mesh)
+from ray_tpu.train.gbdt_trainer import (GBDTTrainer,  # noqa: F401
+                                        LightGBMTrainer, SklearnPredictor,
+                                        XGBoostTrainer)
 from ray_tpu.train.predictor import (BatchPredictor,  # noqa: F401
                                      JaxPredictor, Predictor)
 from ray_tpu.train.step import (OptimizerConfig,  # noqa: F401
@@ -27,6 +30,7 @@ __all__ = [
     "TorchTrainer", "TorchConfig", "prepare_model", "prepare_data_loader",
     "WorkerGroup", "TrainWorker", "make_sharded_train", "OptimizerConfig",
     "make_vision_train", "classification_loss_fn", "Predictor",
-    "JaxPredictor", "BatchPredictor",
+    "JaxPredictor", "BatchPredictor", "GBDTTrainer", "XGBoostTrainer",
+    "LightGBMTrainer", "SklearnPredictor",
     "lm_loss_fn",
 ]

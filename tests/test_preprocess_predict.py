@@ -129,3 +129,35 @@ def test_resnet_trains_cifar_shapes():
     # batch_stats were updated away from init
     bs = jax.tree.leaves(state.batch_stats)
     assert any(float(jnp.abs(x).sum()) > 0 for x in bs)
+
+
+def test_gbdt_trainer_end_to_end(ray_start_regular):
+    """GBDTTrainer fits on a Dataset (sklearn backend) and its checkpoint
+    scores through SklearnPredictor/BatchPredictor."""
+    from ray_tpu.train import BatchPredictor, GBDTTrainer, SklearnPredictor
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for _ in range(200):
+        a, b = rng.normal(), rng.normal()
+        rows.append({"a": a, "b": b, "y": int(a + b > 0)})
+    ds = rdata.from_items(rows, parallelism=4)
+    train_ds, val_ds = ds.train_test_split(0.25)
+
+    trainer = GBDTTrainer(label_column="y",
+                          params={"max_iter": 40},
+                          objective="classification",
+                          datasets={"train": train_ds, "valid": val_ds})
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["backend"] == "sklearn"
+    assert result.metrics["valid-score"] > 0.8, result.metrics
+
+    scored = BatchPredictor.from_checkpoint(
+        result.checkpoint, SklearnPredictor).predict(
+        ds.drop_columns(["y"]), batch_size=64)
+    out = scored.take_all()
+    assert len(out) == 200 and set(r["predictions"] for r in out) <= {0, 1}
+    acc = np.mean([r["predictions"] == (1 if r["a"] + r["b"] > 0 else 0)
+                   for r in out])
+    assert acc > 0.85, acc

@@ -174,3 +174,51 @@ def test_http_proxy(serve_instance):
             last = e
             time.sleep(0.3)
     raise AssertionError(f"http proxy never served: {last}")
+
+
+SCHEMA_APP_MODULE = "serve_schema_test_app"
+
+
+def test_schema_roundtrip_and_apply(serve_instance, tmp_path, monkeypatch):
+    """ServeApplicationSchema: dict roundtrip, import-path apply with
+    overrides, and the controller's KV status snapshot."""
+    import sys
+    import textwrap
+
+    from ray_tpu.serve.schema import ServeApplicationSchema
+
+    mod = tmp_path / f"{SCHEMA_APP_MODULE}.py"
+    mod.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment
+        def shout(s: str) -> str:
+            return s.upper()
+
+        app = shout.bind()
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    sys.modules.pop(SCHEMA_APP_MODULE, None)
+
+    d = {"import_path": f"{SCHEMA_APP_MODULE}:app",
+         "deployments": [{"name": "shout", "num_replicas": 2}]}
+    schema = ServeApplicationSchema.from_dict(d)
+    assert schema.to_dict()["import_path"] == f"{SCHEMA_APP_MODULE}:app"
+
+    handle = schema.apply()
+    assert ray_tpu.get(handle.remote("hi"), timeout=30) == "HI"
+    st = serve.status()
+    assert st["shout"]["target_replicas"] == 2
+
+    # controller publishes status into GCS KV for non-driver readers
+    import json
+
+    from ray_tpu.experimental import internal_kv
+    for _ in range(40):
+        raw = internal_kv._internal_kv_get("serve:status")
+        if raw and json.loads(raw).get("shout", {}).get(
+                "running_replicas") == 2:
+            break
+        time.sleep(0.25)
+    assert raw is not None
+    assert json.loads(raw)["shout"]["status"] == "HEALTHY"
