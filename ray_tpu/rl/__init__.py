@@ -3,7 +3,7 @@
 Analog of /root/reference/rllib (SURVEY.md §2.4): AlgorithmConfig builder,
 Algorithm driver (Tune-compatible), WorkerSet of fault-tolerant rollout
 actors, PPO (sync, mesh-sharded SGD), IMPALA (async, V-trace), DQN (replay +
-target net + double/dueling Q), replay
+target net + double/dueling Q), SAC (max-entropy continuous control), replay
 buffers, in-repo gymnasium-compatible envs.
 """
 
@@ -12,18 +12,20 @@ from ray_tpu.rl.env import (Box, CartPoleEnv, Discrete, Env,  # noqa: F401
                             PendulumEnv, VectorEnv, make_env, register_env)
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.impala import Impala, ImpalaConfig, vtrace  # noqa: F401
-from ray_tpu.rl.policy import JaxPolicy, QPolicy  # noqa: F401
+from ray_tpu.rl.policy import (JaxPolicy, QPolicy,  # noqa: F401
+                               SACPolicy)
 from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer,  # noqa: F401
                                       ReplayBuffer)
 from ray_tpu.rl.rollout_worker import RolloutWorker  # noqa: F401
+from ray_tpu.rl.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rl.sample_batch import SampleBatch, compute_gae  # noqa: F401
 from ray_tpu.rl.worker_set import WorkerSet  # noqa: F401
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
     "ImpalaConfig", "DQN", "DQNConfig", "vtrace", "RolloutWorker",
-    "WorkerSet", "JaxPolicy", "QPolicy",
+    "WorkerSet", "JaxPolicy", "QPolicy", "SAC", "SACConfig",
     "SampleBatch", "compute_gae", "ReplayBuffer", "PrioritizedReplayBuffer",
     "Env", "Box", "Discrete", "CartPoleEnv", "PendulumEnv", "VectorEnv",
     "make_env", "register_env",

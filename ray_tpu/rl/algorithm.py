@@ -123,6 +123,31 @@ class Algorithm:
         self.setup_learner()
         self.workers.sync_weights(self.get_weights())
 
+    # -- learner plumbing shared by the algorithms -------------------------
+    def build_learner_mesh(self) -> None:
+        """Set self.mesh / self.batch_sharding / self.repl_sharding from
+        config.mesh_shape (default: data-parallel over all devices)."""
+        import jax
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        shape = self.config.mesh_shape or {"data": jax.device_count()}
+        self.mesh = Mesh(mesh_utils.create_device_mesh(
+            tuple(shape.values())), tuple(shape.keys()))
+        self.batch_sharding = NamedSharding(self.mesh, P("data"))
+        self.repl_sharding = NamedSharding(self.mesh, P())
+
+    def round_minibatch(self, size: int) -> int:
+        """Largest size >= n_shards divisible by the data-axis shard count."""
+        n_shards = self.mesh.devices.size
+        size = max(size, n_shards)
+        return size - size % n_shards
+
+    def stage_batch(self, sample, keys) -> Dict[str, Any]:
+        """device_put selected columns sharded over the data axis."""
+        import jax
+        return {k: jax.device_put(np.asarray(v), self.batch_sharding)
+                for k, v in sample.items() if k in keys}
+
     # -- subclass surface --------------------------------------------------
     @classmethod
     def extra_worker_kwargs(cls, config: AlgorithmConfig) -> Dict[str, Any]:

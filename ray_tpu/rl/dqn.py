@@ -52,9 +52,6 @@ class DQN(Algorithm):
                                                      True)}}
 
     def setup_learner(self) -> None:
-        from jax.experimental import mesh_utils
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
         cfg: DQNConfig = self.config
         probe = make_env(cfg.env_spec)
         if isinstance(probe.action_space, Box):
@@ -70,12 +67,8 @@ class DQN(Algorithm):
         self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
                               optax.adam(cfg.lr))
 
-        n_dev = jax.device_count()
-        shape = cfg.mesh_shape or {"data": n_dev}
-        self.mesh = Mesh(mesh_utils.create_device_mesh(
-            tuple(shape.values())), tuple(shape.keys()))
-        self.batch_sharding = NamedSharding(self.mesh, P("data"))
-        repl = NamedSharding(self.mesh, P())
+        self.build_learner_mesh()
+        repl = self.repl_sharding
         self.params = jax.device_put(params, repl)
         self.target_params = jax.device_put(params, repl)
         self.opt_state = jax.device_put(self.tx.init(self.params), repl)
@@ -129,9 +122,8 @@ class DQN(Algorithm):
         return jax.tree.map(np.asarray, self.params)
 
     def set_weights(self, weights: Any) -> None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        repl = NamedSharding(self.mesh, P())
-        self.params = jax.device_put(jax.tree.map(jnp.asarray, weights), repl)
+        self.params = jax.device_put(jax.tree.map(jnp.asarray, weights),
+                                     self.repl_sharding)
         self.target_params = self.params
 
     def _epsilon(self) -> float:
@@ -156,18 +148,14 @@ class DQN(Algorithm):
             return {"info": info}
 
         # 2. replayed TD updates on the mesh
-        n_shards = self.mesh.devices.size
-        mb = max(cfg.train_batch_size, n_shards)
-        mb -= mb % n_shards
+        mb = self.round_minibatch(cfg.train_batch_size)
         prioritized = isinstance(self.buffer, PrioritizedReplayBuffer)
         aux_last: Dict[str, Any] = {}
         for _ in range(cfg.n_updates_per_iter):
             sample = self.buffer.sample(mb)
-            device_batch = {
-                k: jax.device_put(np.asarray(v), self.batch_sharding)
-                for k, v in sample.items()
-                if k in (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS,
-                         SB.TERMINATEDS, "weights")}
+            device_batch = self.stage_batch(
+                sample, (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS,
+                         SB.TERMINATEDS, "weights"))
             self.params, self.opt_state, aux = self._td_step(
                 self.params, self.target_params, self.opt_state, device_batch)
             if prioritized and "batch_indexes" in sample:

@@ -96,3 +96,59 @@ class QNetwork(nn.Module):
             return adv
         v = nn.Dense(1, name="v_out")(x)
         return v + adv - jnp.mean(adv, axis=-1, keepdims=True)
+
+
+class SquashedGaussianActor(nn.Module):
+    """SAC actor: tanh-squashed diagonal Gaussian (cf. reference
+    rllib/algorithms/sac/sac_torch_model.py policy head)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+    log_std_min: float = -20.0
+    log_std_max: float = 2.0
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"pi_{i}")(x))
+        mean = nn.Dense(self.action_dim, name="pi_mean")(x)
+        log_std = nn.Dense(self.action_dim, name="pi_log_std")(x)
+        log_std = jnp.clip(log_std, self.log_std_min, self.log_std_max)
+        return mean, log_std
+
+
+def squashed_sample_logp(rng, mean, log_std):
+    """Reparameterized tanh-Gaussian sample + its log-prob."""
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(rng, mean.shape)
+    pre = mean + std * eps
+    act = jnp.tanh(pre)
+    logp = (-0.5 * (eps ** 2) - log_std
+            - 0.5 * jnp.log(2.0 * jnp.pi)).sum(-1)
+    # tanh change of variables (numerically stable form)
+    logp -= (2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre))).sum(-1)
+    return act, logp
+
+
+class ContinuousQ(nn.Module):
+    """Q(s, a) tower for SAC twin critics."""
+
+    hidden: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, act: jax.Array) -> jax.Array:
+        x = jnp.concatenate([obs, act], axis=-1)
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"q_{i}")(x))
+        return nn.Dense(1, name="q_out")(x)[..., 0]
+
+
+class TwinQ(nn.Module):
+    hidden: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, act: jax.Array):
+        q1 = ContinuousQ(self.hidden, name="q1")(obs, act)
+        q2 = ContinuousQ(self.hidden, name="q2")(obs, act)
+        return q1, q2

@@ -135,3 +135,66 @@ class QPolicy:
 
     def set_weights(self, weights: Any) -> None:
         self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class SACPolicy:
+    """Stochastic squashed-Gaussian policy for SAC rollouts (CPU side).
+
+    Actions are sampled from tanh(N(mean, std)) and rescaled to the Box
+    bounds; same compute_actions triple as JaxPolicy (logp of the squashed
+    sample; value column is zeros — SAC's critics live on the learner).
+    """
+
+    def __init__(self, observation_space, action_space,
+                 hidden=(256, 256), seed: int = 0):
+        if not isinstance(action_space, Box):
+            raise ValueError("SACPolicy requires a continuous action space")
+        self.observation_space = observation_space
+        self.action_space = action_space
+        act_dim = int(np.prod(action_space.shape))
+        self.model = M.SquashedGaussianActor(action_dim=act_dim,
+                                             hidden=tuple(hidden))
+        obs_dim = int(np.prod(observation_space.shape))
+        self._rng = jax.random.PRNGKey(seed)
+        self.params = self.model.init(
+            self._rng, jnp.zeros((1, obs_dim)))["params"]
+        # actions are flattened to (B, prod(shape)); bounds follow suit
+        self._low = np.asarray(action_space.low, np.float32).reshape(-1)
+        self._high = np.asarray(action_space.high, np.float32).reshape(-1)
+
+        @jax.jit
+        def _sample(params, rng, obs):
+            mean, log_std = self.model.apply({"params": params}, obs)
+            act, logp = M.squashed_sample_logp(rng, mean, log_std)
+            return act, logp
+
+        @jax.jit
+        def _deterministic(params, obs):
+            mean, _ = self.model.apply({"params": params}, obs)
+            return jnp.tanh(mean)
+
+        self._sample = _sample
+        self._deterministic = _deterministic
+
+    def _rescale(self, act: np.ndarray) -> np.ndarray:
+        return self._low + (np.asarray(act) + 1.0) * 0.5 * \
+            (self._high - self._low)
+
+    def compute_actions(self, obs: np.ndarray, *, explore: bool = True
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        obs = jnp.asarray(obs)
+        if explore:
+            self._rng, key = jax.random.split(self._rng)
+            act, logp = self._sample(self.params, key, obs)
+        else:
+            act = self._deterministic(self.params, obs)
+            logp = jnp.zeros(act.shape[0])
+        act = np.asarray(act)
+        return self._rescale(act), np.asarray(logp), \
+            np.zeros(act.shape[0], np.float32)
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)

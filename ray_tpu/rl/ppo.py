@@ -37,9 +37,6 @@ class PPOConfig(AlgorithmConfig):
 
 class PPO(Algorithm):
     def setup_learner(self) -> None:
-        from jax.experimental import mesh_utils
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
         cfg: PPOConfig = self.config
         probe = make_env(cfg.env_spec)
         continuous = isinstance(probe.action_space, Box)
@@ -58,15 +55,10 @@ class PPO(Algorithm):
             optax.adam(cfg.lr))
 
         # learner mesh: data-parallel over every local device
-        n_dev = jax.device_count()
-        shape = cfg.mesh_shape or {"data": n_dev}
-        sizes = tuple(shape.values())
-        self.mesh = Mesh(mesh_utils.create_device_mesh(sizes),
-                         tuple(shape.keys()))
-        self.batch_sharding = NamedSharding(self.mesh, P("data"))
-        repl = NamedSharding(self.mesh, P())
-        params = jax.device_put(params, repl)
-        self.opt_state = jax.device_put(self.tx.init(params), repl)
+        self.build_learner_mesh()
+        params = jax.device_put(params, self.repl_sharding)
+        self.opt_state = jax.device_put(self.tx.init(params),
+                                        self.repl_sharding)
         self.params = params
 
         if continuous:
@@ -116,10 +108,8 @@ class PPO(Algorithm):
         return jax.tree.map(np.asarray, self.params)
 
     def set_weights(self, weights: Any) -> None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        repl = NamedSharding(self.mesh, P())
         self.params = jax.device_put(
-            jax.tree.map(jnp.asarray, weights), repl)
+            jax.tree.map(jnp.asarray, weights), self.repl_sharding)
 
     def training_step(self) -> Dict[str, Any]:
         cfg: PPOConfig = self.config
@@ -134,9 +124,7 @@ class PPO(Algorithm):
         self._timesteps_total += train_batch.count
 
         # 2. minibatch SGD epochs on the mesh (train_ops.py:26)
-        n_shards = self.mesh.devices.size
-        mb = max(cfg.sgd_minibatch_size, n_shards)
-        mb -= mb % n_shards   # divisible by the data axis
+        mb = self.round_minibatch(cfg.sgd_minibatch_size)
         aux_last: Dict[str, Any] = {}
         n_updates = 0
         for epoch in range(cfg.num_sgd_iter):

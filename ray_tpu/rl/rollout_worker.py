@@ -38,6 +38,11 @@ class RolloutWorker:
             self.policy = QPolicy(self.vec.observation_space,
                                   self.vec.action_space, hidden=hidden,
                                   seed=seed, **(policy_kwargs or {}))
+        elif policy == "sac":
+            from ray_tpu.rl.policy import SACPolicy
+            self.policy = SACPolicy(self.vec.observation_space,
+                                    self.vec.action_space, hidden=hidden,
+                                    seed=seed, **(policy_kwargs or {}))
         else:
             self.policy = JaxPolicy(self.vec.observation_space,
                                     self.vec.action_space, hidden=hidden,

@@ -1,0 +1,200 @@
+"""SAC: off-policy maximum-entropy RL for continuous control.
+
+Analog of /root/reference/rllib/algorithms/sac/sac.py (+ sac_torch_policy.py
+losses): twin Q critics with soft target updates, tanh-Gaussian actor
+trained by reparameterization, and automatic entropy-temperature tuning
+toward -|A| target entropy.  TPU-native like DQN: one jitted update over
+the mesh's data axis; CPU rollout actors run the squashed-Gaussian policy
+(ray_tpu/rl/policy.py SACPolicy) and feed the replay buffer via
+sample_transitions().
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as M
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import Box, make_env
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = SAC
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.buffer_size = 100_000
+        self.learning_starts = 1000
+        self.tau = 0.005                    # soft target update rate
+        self.initial_alpha = 1.0
+        self.target_entropy = "auto"        # -> -action_dim
+        self.n_updates_per_iter = 32
+        self.rollout_fragment_length = 64
+
+
+class SAC(Algorithm):
+    @classmethod
+    def extra_worker_kwargs(cls, config: AlgorithmConfig) -> Dict[str, Any]:
+        return {"policy": "sac"}
+
+    def setup_learner(self) -> None:
+        cfg: SACConfig = self.config
+        probe = make_env(cfg.env_spec)
+        if not isinstance(probe.action_space, Box):
+            raise ValueError("SAC requires a continuous action space")
+        act_dim = int(np.prod(probe.action_space.shape))
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        low = np.asarray(probe.action_space.low, np.float32).reshape(-1)
+        high = np.asarray(probe.action_space.high, np.float32).reshape(-1)
+        probe.close()
+
+        self.actor = M.SquashedGaussianActor(action_dim=act_dim,
+                                             hidden=tuple(cfg.hidden))
+        self.critic = M.TwinQ(hidden=tuple(cfg.hidden))
+        rng = jax.random.PRNGKey(cfg.seed or 0)
+        r1, r2 = jax.random.split(rng)
+        actor_params = self.actor.init(r1, jnp.zeros((1, obs_dim)))["params"]
+        critic_params = self.critic.init(
+            r2, jnp.zeros((1, obs_dim)), jnp.zeros((1, act_dim)))["params"]
+        log_alpha = jnp.asarray(np.log(cfg.initial_alpha), jnp.float32)
+        target_entropy = -float(act_dim) if cfg.target_entropy == "auto" \
+            else float(cfg.target_entropy)
+
+        self.actor_tx = optax.adam(cfg.lr)
+        self.critic_tx = optax.adam(cfg.lr)
+        self.alpha_tx = optax.adam(cfg.lr)
+
+        self.build_learner_mesh()
+        put = lambda t: jax.device_put(t, self.repl_sharding)  # noqa: E731
+        self.state = {
+            "actor": put(actor_params),
+            "critic": put(critic_params),
+            # distinct buffers: the donated update would otherwise see the
+            # same buffer twice (critic and target start identical)
+            "target_critic": put(jax.tree.map(jnp.copy, critic_params)),
+            "log_alpha": put(log_alpha),
+            "actor_opt": put(self.actor_tx.init(actor_params)),
+            "critic_opt": put(self.critic_tx.init(critic_params)),
+            "alpha_opt": put(self.alpha_tx.init(log_alpha)),
+        }
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+
+        actor, critic = self.actor, self.critic
+        actor_tx, critic_tx, alpha_tx = self.actor_tx, self.critic_tx, \
+            self.alpha_tx
+        gamma, tau = cfg.gamma, cfg.tau
+        scale, shift = (high - low) / 2.0, (high + low) / 2.0
+
+        def rescale(a_tanh):
+            return a_tanh * scale + shift
+
+        def update(state, batch, rng):
+            r_next, r_pi = jax.random.split(rng)
+            alpha = jnp.exp(state["log_alpha"])
+
+            # -- critic: soft Bellman target from the fresh policy ---------
+            mean_n, log_std_n = actor.apply({"params": state["actor"]},
+                                            batch[SB.NEXT_OBS])
+            a_next, logp_next = M.squashed_sample_logp(r_next, mean_n,
+                                                       log_std_n)
+            q1_t, q2_t = critic.apply({"params": state["target_critic"]},
+                                      batch[SB.NEXT_OBS], rescale(a_next))
+            q_next = jnp.minimum(q1_t, q2_t) - alpha * logp_next
+            not_done = 1.0 - batch[SB.TERMINATEDS].astype(jnp.float32)
+            target = batch[SB.REWARDS] + gamma * not_done * q_next
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(p):
+                q1, q2 = critic.apply({"params": p}, batch[SB.OBS],
+                                      batch[SB.ACTIONS])
+                return (jnp.square(q1 - target)
+                        + jnp.square(q2 - target)).mean() * 0.5, \
+                    (q1.mean() + q2.mean()) * 0.5
+
+            (c_loss, mean_q), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(state["critic"])
+            c_updates, critic_opt = critic_tx.update(
+                c_grads, state["critic_opt"], state["critic"])
+            critic_params = optax.apply_updates(state["critic"], c_updates)
+
+            # -- actor: reparameterized max-entropy objective --------------
+            def actor_loss(p):
+                mean, log_std = actor.apply({"params": p}, batch[SB.OBS])
+                a, logp = M.squashed_sample_logp(r_pi, mean, log_std)
+                q1, q2 = critic.apply({"params": critic_params},
+                                      batch[SB.OBS], rescale(a))
+                return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+            (a_loss, logp_pi), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(state["actor"])
+            a_updates, actor_opt = actor_tx.update(
+                a_grads, state["actor_opt"], state["actor"])
+            actor_params = optax.apply_updates(state["actor"], a_updates)
+
+            # -- temperature: drive entropy toward the target --------------
+            def alpha_loss(log_a):
+                return -(log_a * jax.lax.stop_gradient(
+                    logp_pi + target_entropy)).mean()
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss)(
+                state["log_alpha"])
+            al_updates, alpha_opt = alpha_tx.update(
+                al_grad, state["alpha_opt"], state["log_alpha"])
+            log_alpha = optax.apply_updates(state["log_alpha"], al_updates)
+
+            target_critic = jax.tree.map(
+                lambda t, o: t * (1.0 - tau) + o * tau,
+                state["target_critic"], critic_params)
+            new_state = {
+                "actor": actor_params, "critic": critic_params,
+                "target_critic": target_critic, "log_alpha": log_alpha,
+                "actor_opt": actor_opt, "critic_opt": critic_opt,
+                "alpha_opt": alpha_opt,
+            }
+            metrics = {"critic_loss": c_loss, "actor_loss": a_loss,
+                       "alpha_loss": al_loss, "alpha": alpha,
+                       "mean_q": mean_q, "entropy": -logp_pi.mean()}
+            return new_state, metrics
+
+        self._update = jax.jit(update, donate_argnums=(0,))
+        self._rng = jax.random.PRNGKey((cfg.seed or 0) + 17)
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.state["actor"])
+
+    def set_weights(self, weights: Any) -> None:
+        self.state["actor"] = jax.device_put(
+            jax.tree.map(jnp.asarray, weights), self.repl_sharding)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: SACConfig = self.config
+        batches = self.workers.foreach_worker("sample_transitions")
+        for b in batches:
+            self.buffer.add(b)
+            self._timesteps_total += b.count
+
+        info: Dict[str, Any] = {"buffer_size": len(self.buffer)}
+        if len(self.buffer) < cfg.learning_starts:
+            return {"info": info}
+
+        mb = self.round_minibatch(cfg.train_batch_size)
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.n_updates_per_iter):
+            sample = self.buffer.sample(mb)
+            device_batch = self.stage_batch(
+                sample, (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS,
+                         SB.TERMINATEDS))
+            self._rng, key = jax.random.split(self._rng)
+            self.state, metrics = self._update(self.state, device_batch, key)
+
+        self.workers.sync_weights(self.get_weights())
+        info.update({k: float(v) for k, v in metrics.items()})
+        return {"info": info}
