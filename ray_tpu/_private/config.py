@@ -57,6 +57,9 @@ _declare("object_store_fallback_dir", str, "/tmp",
          "Directory for fallback-allocated (spilled) store segments.")
 _declare("object_spill_threshold", float, 0.8,
          "Fraction of store capacity above which primary copies spill to disk.")
+_declare("object_transfer_chunk_bytes", int, 8 * 1024 * 1024,
+         "Inter-node object pushes move in chunks of this size (bounds "
+         "per-message memory; cf. reference object_manager chunked Push).")
 _declare("scheduler_spill_threshold", float, 0.5,
          "Hybrid scheduling: local/packing preference holds until a node's "
          "critical-resource utilization crosses this fraction (cf. reference "

@@ -13,6 +13,8 @@ from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,  # noqa: F401
                                   ExecutionPlan, GroupedData,
                                   TaskPoolStrategy)
 from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
+from ray_tpu.data.random_access_dataset import \
+    RandomAccessDataset  # noqa: F401
 from ray_tpu.data.preprocessors import (BatchMapper, Chain,  # noqa: F401
                                         Concatenator, LabelEncoder,
                                         MinMaxScaler, OneHotEncoder,
@@ -89,6 +91,6 @@ __all__ = [
     "range", "from_items", "from_pandas", "from_numpy", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_numpy", "read_text",
     "read_binary_files",
-    "Preprocessor", "StandardScaler", "MinMaxScaler", "LabelEncoder",
+    "RandomAccessDataset", "Preprocessor", "StandardScaler", "MinMaxScaler", "LabelEncoder",
     "OneHotEncoder", "SimpleImputer", "Concatenator", "BatchMapper", "Chain",
 ]

@@ -565,6 +565,12 @@ class Dataset:
                             for i, r in enumerate(refs)])
 
     # ---------------------------------------------------------- pipeline
+    def to_random_access_dataset(self, key: str, num_workers: int = 2):
+        """Sorted actor-served point lookups (reference
+        random_access_dataset.py)."""
+        from ray_tpu.data.random_access_dataset import RandomAccessDataset
+        return RandomAccessDataset(self, key, num_workers=num_workers)
+
     def window(self, *, blocks_per_window: int = 10) -> "DatasetPipeline":
         from ray_tpu.data.dataset_pipeline import DatasetPipeline
         return DatasetPipeline.from_dataset(self, blocks_per_window)

@@ -397,21 +397,48 @@ class CoreWorker:
 
     def _fetch_remote(self, node_hex: str, oid: ObjectID,
                       deadline: Optional[float]) -> Optional[bytes]:
+        """Pull one object from a remote raylet, chunk by chunk: each RPC
+        frame carries at most object_transfer_chunk_bytes, so large objects
+        stream with bounded memory on both sides (reference PullManager /
+        chunked ObjectManager::Push semantics)."""
         addr = self._node_address(node_hex)
         if addr is None:
             return None
+        chunk = CONFIG.object_transfer_chunk_bytes
         try:
             conn = rpc.connect(addr, timeout=5.0)
             try:
-                res = conn.call("fetch_object",
-                                {"object_id": oid.binary(),
-                                 "timeout": 0.0},
-                                timeout=CONFIG.raylet_rpc_timeout_s)
+                first = conn.call("fetch_object_chunk",
+                                  {"object_id": oid.binary(),
+                                   "offset": 0, "length": chunk,
+                                   "timeout": 0.0},
+                                  timeout=CONFIG.raylet_rpc_timeout_s)
+                if first is None:
+                    return None
+                total = first["total"]
+                if total <= chunk:
+                    return first["data"]
+                out = bytearray(total)
+                out[:len(first["data"])] = first["data"]
+                off = len(first["data"])
+                while off < total:
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        return None   # honor get(timeout=) between chunks
+                    res = conn.call("fetch_object_chunk",
+                                    {"object_id": oid.binary(),
+                                     "offset": off, "length": chunk,
+                                     "timeout": 0.0},
+                                    timeout=CONFIG.raylet_rpc_timeout_s)
+                    if res is None or not res["data"]:
+                        return None   # evicted mid-transfer; caller retries
+                    out[off:off + len(res["data"])] = res["data"]
+                    off += len(res["data"])
+                return bytes(out)
             finally:
                 conn.close()
         except (ConnectionError, rpc.RemoteError, TimeoutError, OSError):
             return None
-        return res["data"] if res else None
 
     def _owner_conn(self, addr: Tuple[str, int]) -> rpc.Connection:
         addr = tuple(addr)

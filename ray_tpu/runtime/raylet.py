@@ -648,10 +648,14 @@ class Raylet:
 
     # ---------------------------------------------------------------- objects
     def _rpc_fetch_object(self, conn, p):
-        """Inter-node data plane: return a local object's serialized bytes.
+        """Whole-object fetch: one chunk spanning the object."""
+        return self._rpc_fetch_object_chunk(conn, p)
 
-        cf. ObjectManager::Push chunked transfer (object_manager.cc:338) —
-        here a single framed message; chunking is a follow-up."""
+    def _rpc_fetch_object_chunk(self, conn, p):
+        """Chunked inter-node transfer: one [offset, offset+length) slice
+        per call, so a multi-GB object never occupies a multi-GB RPC frame
+        on either side (cf. ObjectManager::Push chunked transfer,
+        object_manager.cc:338 / push_manager.h:29)."""
         from ray_tpu._private.ids import ObjectID
         oid = ObjectID(p["object_id"])
         res = self.store.get(oid, timeout=p.get("timeout", 0.0))
@@ -659,7 +663,11 @@ class Raylet:
             return None
         buf, meta = res
         try:
-            return {"data": bytes(buf), "meta": meta}
+            total = len(buf)
+            off = int(p.get("offset", 0))
+            length = int(p.get("length", total))
+            return {"total": total, "meta": meta,
+                    "data": bytes(buf[off:off + length])}
         finally:
             buf.release()
             self.store.release(oid)

@@ -224,3 +224,19 @@ def test_dataset_feeds_trainer(ray_start_regular):
                         datasets={"train": ds}).fit()
     assert result.error is None
     assert result.metrics["total"] == sum(range(32))
+
+
+def test_random_access_dataset(ray_start_regular):
+    from ray_tpu import data as rdata
+    rows = [{"id": i * 3, "value": f"v{i}"} for i in range(50)]
+    import random
+    random.Random(0).shuffle(rows)
+    ds = rdata.from_items(rows, parallelism=4)
+    rad = ds.to_random_access_dataset("id", num_workers=3)
+    import ray_tpu as rt
+    assert rt.get(rad.get_async(27), timeout=30)["value"] == "v9"
+    assert rt.get(rad.get_async(28), timeout=30) is None   # absent key
+    got = rad.multiget([0, 3, 146, 147, 99])
+    assert [g["value"] if g else None for g in got] == \
+        ["v0", "v1", None, "v49", "v33"]
+    assert "50 rows" in rad.stats()
