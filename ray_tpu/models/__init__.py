@@ -1,9 +1,11 @@
 """Model families: flagship GPT (LLaMA-style) LM, ResNet vision models."""
 
 from ray_tpu.models.configs import PRESETS, TransformerConfig, get_config
+from ray_tpu.models.generate import Generator, generate, sample_logits
 from ray_tpu.models.gpt import GPT
 from ray_tpu.models.resnet import (ResNet, ResNet18, ResNet34, ResNet50,
                                    ResNet101)
 
 __all__ = ["GPT", "TransformerConfig", "PRESETS", "get_config",
+           "Generator", "generate", "sample_logits",
            "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101"]

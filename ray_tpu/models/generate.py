@@ -1,0 +1,122 @@
+"""Autoregressive decoding: KV-cache generation with standard samplers.
+
+Inference counterpart of the GPT decode path (ray_tpu/models/gpt.py
+``decode=True``: cache collection + rotary offsets).  The per-token step is
+one jitted function (prefill is a single wide step at offset 0), and the
+sampler supports temperature / top-k / nucleus (top-p) — the decoding
+surface an LLM Serve deployment needs (the reference's Serve LLM benchmark
+surface, BASELINE.md llama3-8b row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.configs import TransformerConfig
+from ray_tpu.models.gpt import GPT
+
+
+def sample_logits(rng: jax.Array, logits: jax.Array, *,
+                  temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 1.0) -> jax.Array:
+    """Sample token ids from [B, V] logits (greedy when temperature == 0)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+class Generator:
+    """Holds a decode-mode model + jitted prefill/step for repeated calls."""
+
+    def __init__(self, cfg: TransformerConfig, params,
+                 mesh=None):
+        self.cfg = cfg
+        self.params = params
+        self.model = GPT(cfg, mesh=mesh, decode=True)
+
+        def prefill(params, cache, tokens):
+            b, s = tokens.shape
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            logits, mut = self.model.apply(
+                {"params": params, "cache": cache}, tokens, positions,
+                mutable=["cache"])
+            return logits[:, -1], mut["cache"]
+
+        def step(params, cache, token, pos):
+            positions = pos[:, None]
+            logits, mut = self.model.apply(
+                {"params": params, "cache": cache}, token[:, None],
+                positions, mutable=["cache"])
+            return logits[:, -1], mut["cache"]
+
+        self._prefill = jax.jit(prefill)
+        self._step = jax.jit(step, donate_argnums=(1,))
+
+    def init_cache(self, batch_size: int):
+        """Zeroed KV cache built from shapes alone (eval_shape — no second
+        copy of the parameters is ever materialized)."""
+        tokens = jnp.zeros((batch_size, 1), jnp.int32)
+        abstract = jax.eval_shape(
+            lambda t: self.model.init(jax.random.PRNGKey(0), t), tokens)
+        return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype),
+                            abstract["cache"])
+
+    def generate(self, prompt_tokens, *, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_id: Optional[int] = None,
+                 rng: Optional[jax.Array] = None) -> jnp.ndarray:
+        """prompt_tokens [B, S] -> generated ids [B, <=max_new_tokens].
+
+        Stops early only when *every* row has emitted ``eos_id``; rows that
+        finished earlier keep their first eos and are padded with it.
+        """
+        prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
+        b, s = prompt_tokens.shape
+        if s + max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {s} + {max_new_tokens} new > max_seq_len "
+                f"{self.cfg.max_seq_len}")
+        if max_new_tokens <= 0:
+            return jnp.zeros((b, 0), jnp.int32)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        sampler = functools.partial(sample_logits, temperature=temperature,
+                                    top_k=top_k, top_p=top_p)
+        cache = self.init_cache(b)
+        logits, cache = self._prefill(self.params, cache, prompt_tokens)
+        out = []
+        done = jnp.zeros((b,), bool)
+        for i in range(max_new_tokens):
+            rng, key = jax.random.split(rng)
+            token = sampler(key, logits)
+            if eos_id is not None:
+                token = jnp.where(done, eos_id, token)
+                done = done | (token == eos_id)
+            out.append(token)
+            last = i == max_new_tokens - 1
+            if last or (eos_id is not None and bool(done.all())):
+                break   # the logits for a further token are never needed
+            pos = jnp.full((b,), s + i, jnp.int32)
+            logits, cache = self._step(self.params, cache, token, pos)
+        return jnp.stack(out, axis=1)
+
+
+def generate(cfg: TransformerConfig, params, prompt_tokens,
+             **kwargs) -> jnp.ndarray:
+    """One-shot convenience wrapper around Generator."""
+    return Generator(cfg, params).generate(prompt_tokens, **kwargs)
