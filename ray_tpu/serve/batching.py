@@ -12,7 +12,9 @@ sees a padded batch it can feed to a jitted forward step.
 
 from __future__ import annotations
 
+import asyncio
 import functools
+import inspect
 import threading
 import time
 from typing import Any, Callable, List, Optional
@@ -68,6 +70,10 @@ class _BatchQueue:
                     results = self._fn(instance, args)
                 else:
                     results = self._fn(args)
+                if inspect.iscoroutine(results):
+                    # reference @serve.batch functions are `async def`;
+                    # drive the coroutine to completion on this loop thread
+                    results = asyncio.run(results)
                 if len(results) != len(args):
                     raise ValueError(
                         f"@serve.batch function returned {len(results)} "

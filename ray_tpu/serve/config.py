@@ -40,6 +40,9 @@ class DeploymentConfig:
     user_config: Optional[Any] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 2.0
+    # replicas loading big models (LLM weights + first TPU compile) need a
+    # long startup window before health checks can kill them
+    health_check_grace_period_s: float = 120.0
     graceful_shutdown_timeout_s: float = 5.0
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
 

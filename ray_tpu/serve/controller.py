@@ -189,10 +189,10 @@ class ServeController:
                     total_ongoing += metrics["num_ongoing"]
                 except Exception:
                     info["fails"] = info.get("fails", 0) + 1
+                    grace_s = config.get("health_check_grace_period_s", 120.0)
                     grace = (time.monotonic() - info.get("created_at", 0.0)
-                             < 30.0)
-                    if info["fails"] >= 3 and not (grace and info["fails"]
-                                                   < 30):
+                             < grace_s)
+                    if info["fails"] >= 3 and not grace:
                         info["healthy"] = False
                 if info["healthy"] and info["version"] == version:
                     healthy_current.append(tag)
