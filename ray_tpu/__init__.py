@@ -10,12 +10,14 @@ from ray_tpu.actor import get_actor, kill  # noqa: F401
 from ray_tpu.api import (available_resources, cluster_resources, context,  # noqa: F401
                          get, init, is_initialized, nodes, put, remote,
                          shutdown, wait)
-from ray_tpu.runtime.core_worker import ObjectRef  # noqa: F401
+from ray_tpu.runtime.core_worker import (ObjectRef,  # noqa: F401
+                                         ObjectRefGenerator)
 
 __version__ = "0.1.0"
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "get_actor", "kill", "nodes", "cluster_resources",
-    "available_resources", "context", "ObjectRef", "CONFIG", "__version__",
+    "available_resources", "context", "ObjectRef", "ObjectRefGenerator",
+    "CONFIG", "__version__",
 ]

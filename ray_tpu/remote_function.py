@@ -13,7 +13,7 @@ from ray_tpu.runtime.core_worker import get_global_worker
 
 
 class RemoteFunction:
-    def __init__(self, func, *, num_returns: int = 1,
+    def __init__(self, func, *, num_returns=1,
                  num_cpus: float = 1.0, num_tpus: float = 0.0,
                  resources: Optional[Dict[str, float]] = None,
                  max_retries: int = 3,
@@ -60,7 +60,7 @@ class RemoteFunction:
             name=getattr(self._func, "__name__", "task"),
             scheduling_strategy=encode_strategy(self._scheduling_strategy),
             runtime_env=worker.prepare_runtime_env(self._runtime_env))
-        if self._num_returns == 1:
+        if self._num_returns == 1 or self._num_returns == "dynamic":
             return refs[0]
         return refs
 

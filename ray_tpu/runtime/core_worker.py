@@ -102,6 +102,32 @@ def _rebuild_ref(id_bytes: bytes, owner_addr) -> "ObjectRef":
     return ObjectRef(ObjectID(id_bytes), tuple(owner_addr), worker)
 
 
+def num_return_slots(num_returns) -> int:
+    """Owner-side return slots: "dynamic" reserves one (the generator)."""
+    return 1 if num_returns == "dynamic" else num_returns
+
+
+class ObjectRefGenerator:
+    """The value of a ``num_returns="dynamic"`` task: an iterable of the
+    refs the task produced, one per yielded item (cf. reference
+    ObjectRefGenerator, _raylet.pyx:169)."""
+
+    def __init__(self, refs: List["ObjectRef"]):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+
 _global_worker: Optional["CoreWorker"] = None
 
 
@@ -118,7 +144,7 @@ def set_global_worker(worker: Optional["CoreWorker"]) -> None:
 
 class _OwnedObject:
     __slots__ = ("state", "data", "error", "locations", "event", "refcount",
-                 "task_spec")
+                 "task_spec", "dynamic_children")
 
     def __init__(self):
         self.state = "pending"       # pending | ready
@@ -128,6 +154,9 @@ class _OwnedObject:
         self.event = threading.Event()
         self.refcount = 0
         self.task_spec: Optional[bytes] = None  # lineage for reconstruction
+        # sub-object ids of a num_returns="dynamic" task: freed with slot 0
+        # unless a deserialized generator bound its own refs to them
+        self.dynamic_children: Optional[list] = None
 
 
 class _Lease:
@@ -258,6 +287,14 @@ class CoreWorker:
                     del self._owned[oid]
                     self._memory_cache.pop(oid, None)
                     free = True
+                    for child in entry.dynamic_children or ():
+                        child_entry = self._owned.get(child)
+                        if child_entry is not None and \
+                                child_entry.refcount <= 0:
+                            # generator never deserialized: nothing else
+                            # will ever free these
+                            del self._owned[child]
+                            self._memory_cache.pop(child, None)
         if free:
             self._release_pins(oid)
             # release primary shm copy if we placed one locally
@@ -543,7 +580,7 @@ class CoreWorker:
 
     # ------------------------------------------------------ task submission
     def submit_task(self, func, args: tuple, kwargs: dict, *,
-                    num_returns: int = 1,
+                    num_returns=1,
                     resources: Optional[Dict[str, float]] = None,
                     max_retries: int = 3,
                     name: str = "",
@@ -583,8 +620,9 @@ class CoreWorker:
             "name": name or getattr(func, "__name__", "task"),
         }
         return_refs = []
+        n_slots = num_return_slots(num_returns)
         with self._owned_lock:
-            for i in range(num_returns):
+            for i in range(n_slots):
                 oid = ObjectID.for_task_return(task_id, i)
                 entry = _OwnedObject()
                 entry.task_spec = cloudpickle.dumps(
@@ -634,7 +672,7 @@ class CoreWorker:
         head, views = ser.serialize(error, error_type=ser.ERROR_TASK)
         data = ser.to_flat_bytes(head, views)
         with self._owned_lock:
-            for i in range(spec["num_returns"]):
+            for i in range(num_return_slots(spec["num_returns"])):
                 oid = ObjectID.for_task_return(task_id, i)
                 entry = self._owned.get(oid)
                 if entry is not None:
@@ -933,17 +971,54 @@ class CoreWorker:
                 entry = self._owned.get(oid)
                 if entry is None:
                     continue
-                entry.error = result.get("error", 0)
-                if result.get("data") is not None:
-                    entry.data = result["data"]
+                if "dynamic" in result:
+                    # num_returns="dynamic": adopt ownership of each yielded
+                    # object (slots 1..N) and resolve slot 0 to the
+                    # generator of their refs
+                    refs = self._adopt_dynamic_returns_locked(
+                        task_id, entry, result["dynamic"])
+                    entry.dynamic_children = [r.id for r in refs]
+                    head, views = ser.serialize(ObjectRefGenerator(refs))
+                    entry.data = ser.to_flat_bytes(head, views)
+                    entry.error = 0
                     self._memory_cache.pop(oid, None)
                 else:
-                    entry.locations.add(result["location"])
+                    entry.error = result.get("error", 0)
+                    if result.get("data") is not None:
+                        entry.data = result["data"]
+                        self._memory_cache.pop(oid, None)
+                    else:
+                        entry.locations.add(result["location"])
                 entry.state = "ready"
                 entry.event.set()
         failed = any(r.get("error") for r in results)
         self.events.record(task_id.hex(), "FAILED" if failed else "FINISHED",
                            name=spec["name"])
+
+    def _adopt_dynamic_returns_locked(self, task_id: TaskID, slot0_entry,
+                                      sub_results) -> List[ObjectRef]:
+        refs = []
+        for j, sub in enumerate(sub_results):
+            sub_oid = ObjectID.for_task_return(task_id, j + 1)
+            sub_entry = self._owned.get(sub_oid)
+            if sub_entry is None:
+                sub_entry = _OwnedObject()
+                # re-running the task regenerates every dynamic return
+                sub_entry.task_spec = slot0_entry.task_spec
+                self._owned[sub_oid] = sub_entry
+            sub_entry.error = sub.get("error", 0)
+            if sub.get("data") is not None:
+                sub_entry.data = sub["data"]
+            else:
+                sub_entry.locations.add(sub["location"])
+            sub_entry.state = "ready"
+            sub_entry.event.set()
+            # unbound refs (worker=None): these only exist to be serialized
+            # into slot 0 — binding them would register/unregister a local
+            # refcount whose drop-to-zero frees the entry before the caller
+            # ever deserializes the generator
+            refs.append(ObjectRef(sub_oid, self.address, None))
+        return refs
 
     def prepare_runtime_env(self, raw: Optional[dict]) -> Optional[dict]:
         """Package+upload a raw runtime_env; memoised on the spec plus a
@@ -1031,6 +1106,10 @@ class CoreWorker:
                           args: tuple, kwargs: dict, *,
                           num_returns: int = 1,
                           max_task_retries: int = 0) -> List[ObjectRef]:
+        if num_returns == "dynamic":
+            raise ValueError(
+                'num_returns="dynamic" is only supported for tasks, '
+                'not actor methods')
         task_id = TaskID.from_random()
         aid = actor_id.hex()
         spec = {

@@ -188,11 +188,15 @@ class WorkerProcess:
         err = exc.TaskError(spec.get("name", ""), e, tb)
         head, views = ser.serialize(err, error_type=ser.ERROR_TASK)
         data = ser.to_flat_bytes(head, views)
+        from ray_tpu.runtime.core_worker import num_return_slots
         return {"results": [{"data": data, "error": ser.ERROR_TASK}
-                            for _ in range(spec["num_returns"])]}
+                            for _ in range(
+                                num_return_slots(spec["num_returns"]))]}
 
     def _package_results(self, spec, result) -> dict:
         n = spec["num_returns"]
+        if n == "dynamic":
+            return self._package_dynamic(spec, result)
         if n == 0:
             values = []
         elif n == 1:
@@ -215,6 +219,32 @@ class WorkerProcess:
                 self.core.store.put_serialized(oid, head, views)
                 results.append({"location": self.core.node_id})
         return {"results": results}
+
+    def _package_dynamic(self, spec, result) -> dict:
+        """num_returns="dynamic": each yielded item becomes its own object
+        at return index j+1; the caller's slot-0 ref resolves to an
+        ObjectRefGenerator over them (reference _raylet.pyx:169 semantics —
+        the generator is consumed to completion, not streamed)."""
+        try:
+            iterator = iter(result)
+        except TypeError:
+            return self._package_error(spec, TypeError(
+                'num_returns="dynamic" requires the task to return an '
+                f"iterable, got {type(result).__name__}"))
+        # user exceptions raised while iterating surface as themselves
+        values = list(iterator)
+        task_id = TaskID(spec["task_id"])
+        subs = []
+        for j, value in enumerate(values):
+            head, views = ser.serialize(value)
+            size = ser.serialized_size(head, views)
+            if size <= CONFIG.inline_object_max_bytes:
+                subs.append({"data": ser.to_flat_bytes(head, views)})
+            else:
+                oid = ObjectID.for_task_return(task_id, j + 1)
+                self.core.store.put_serialized(oid, head, views)
+                subs.append({"location": self.core.node_id})
+        return {"results": [{"dynamic": subs}]}
 
     # --------------------------------------------------------------- actors
     def _create_actor(self, p) -> dict:

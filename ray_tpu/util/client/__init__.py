@@ -40,6 +40,25 @@ class ClientObjectRef:
         return (_Ref, (self.ref_id,))
 
 
+class ClientObjectRefGenerator:
+    """Client-side view of a num_returns="dynamic" result."""
+
+    def __init__(self, ctx: "ClientContext", ref_ids):
+        self._refs = [ClientObjectRef(ctx, rid) for rid in ref_ids]
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __repr__(self):
+        return f"ClientObjectRefGenerator({len(self._refs)} refs)"
+
+
 class ClientActorMethod:
     def __init__(self, handle: "ClientActorHandle", name: str):
         self._handle = handle
@@ -139,8 +158,14 @@ class ClientContext:
         ref_list = [refs] if single else list(refs)
         r = self._call("get", {"ref_ids": [x.ref_id for x in ref_list],
                                "timeout": timeout})
-        values = pickle.loads(r["data"])
+        values = [self._unwrap(v) for v in pickle.loads(r["data"])]
         return values[0] if single else values
+
+    def _unwrap(self, value):
+        if isinstance(value, dict) and "__client_ref_generator__" in value:
+            return ClientObjectRefGenerator(
+                self, value["__client_ref_generator__"])
+        return value
 
     def wait(self, refs: Sequence[ClientObjectRef], *, num_returns: int = 1,
              timeout: Optional[float] = None):

@@ -111,7 +111,17 @@ class ClientServer:
         import ray_tpu
         refs = [self._resolve(conn, _Ref(r)) for r in p["ref_ids"]]
         values = ray_tpu.get(refs, timeout=p.get("timeout"))
+        values = [self._wrap_value(conn, v) for v in values]
         return {"data": cloudpickle.dumps(values)}
+
+    def _wrap_value(self, conn, value):
+        """Dynamic-return generators carry server-side ObjectRefs the client
+        cannot resolve; register each and ship a marker of client ref ids."""
+        from ray_tpu.runtime.core_worker import ObjectRefGenerator
+        if isinstance(value, ObjectRefGenerator):
+            return {"__client_ref_generator__":
+                    [self._register(conn, r) for r in value]}
+        return value
 
     def _rpc_wait(self, conn, p):
         import ray_tpu

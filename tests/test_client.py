@@ -93,3 +93,21 @@ def test_client_refs_release_on_disconnect(client_server):
             break
         time.sleep(0.1)
     assert not client_server._refs  # registry dropped with the connection
+
+
+def test_client_dynamic_num_returns(client_server):
+    """num_returns="dynamic" through the remote driver: the generator's
+    refs arrive as client refs resolvable over the same connection."""
+    from ray_tpu.util import client as client_mod
+    ctx = client_mod.ClientContext(client_server.address)
+    try:
+        def gen(n):
+            for i in range(n):
+                yield i * 11
+
+        remote_gen = ctx.remote(gen, num_returns="dynamic")
+        g = ctx.get(remote_gen.remote(3), timeout=60)
+        assert len(g) == 3
+        assert ctx.get(list(g), timeout=60) == [0, 11, 22]
+    finally:
+        ctx.disconnect()
