@@ -88,12 +88,15 @@ class DashboardHead:
 
     # ------------------------------------------------------------- handlers
     async def _index(self, request) -> web.Response:
-        return web.json_response({
-            "service": "ray_tpu dashboard",
-            "routes": ["/api/version", "/api/nodes", "/api/actors",
-                       "/api/tasks", "/api/placement_groups",
-                       "/api/cluster_status", "/api/jobs",
-                       "/api/serve/applications", "/metrics"]})
+        if "application/json" in request.headers.get("Accept", ""):
+            return web.json_response({
+                "service": "ray_tpu dashboard",
+                "routes": ["/api/version", "/api/nodes", "/api/actors",
+                           "/api/tasks", "/api/placement_groups",
+                           "/api/cluster_status", "/api/jobs",
+                           "/api/serve/applications", "/metrics"]})
+        from ray_tpu.dashboard.static_page import INDEX_HTML
+        return web.Response(text=INDEX_HTML, content_type="text/html")
 
     async def _version(self, request) -> web.Response:
         import ray_tpu

@@ -1,0 +1,98 @@
+"""Single-file dashboard UI served at `/`.
+
+Stands in for the reference's React SPA (/root/reference/dashboard/client,
+~30k LoC TS): one dependency-free HTML page that polls the same REST
+endpoints the SPA would (nodes / cluster status / actors / jobs / serve)
+and renders live tables.  The REST JSON remains the programmatic surface.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  body { font: 13px/1.5 system-ui, sans-serif; margin: 2rem; color: #222; }
+  h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.6rem; }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: 3px 10px 3px 0;
+           border-bottom: 1px solid #e5e5e5; font-variant-numeric: tabular-nums; }
+  th { color: #666; font-weight: 600; }
+  .ok { color: #0a7d33; } .bad { color: #c0392b; }
+  #meta { color: #666; }
+  code { background: #f5f5f5; padding: 1px 4px; border-radius: 3px; }
+</style>
+</head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="meta">loading…</div>
+<h2>Cluster</h2><div id="cluster"></div>
+<h2>Nodes</h2><div id="nodes"></div>
+<h2>Actors</h2><div id="actors"></div>
+<h2>Jobs</h2><div id="jobs"></div>
+<h2>Serve</h2><div id="serve"></div>
+<script>
+const fmt = (o) => typeof o === "object" ?
+    Object.entries(o || {}).map(([k, v]) => k + ": " +
+        (typeof v === "number" ? (+v.toFixed ? +v.toFixed(1) : v) : v))
+        .join(", ") : String(o);
+function table(rows, cols) {
+  if (!rows || !rows.length) return "<em>none</em>";
+  let h = "<table><tr>" + cols.map(c => "<th>" + c[0] + "</th>").join("")
+          + "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c => "<td>" + c[1](r) + "</td>").join("") + "</tr>";
+  return h + "</table>";
+}
+const alive = a => a ? '<span class="ok">ALIVE</span>'
+                     : '<span class="bad">DEAD</span>';
+async function refresh() {
+  try {
+    const [ver, cs, nodes, actors, jobs, serve] = await Promise.all([
+      "/api/version", "/api/cluster_status", "/api/nodes", "/api/actors",
+      "/api/jobs", "/api/serve/applications",
+    ].map(u => fetch(u).then(r => r.json())));
+    document.getElementById("meta").textContent =
+      "version " + ver.version + " — refreshed " +
+      new Date().toLocaleTimeString();
+    document.getElementById("cluster").innerHTML = table([cs], [
+      ["alive nodes", r => r.alive_nodes],
+      ["dead nodes", r => r.dead_nodes],
+      ["total", r => fmt(r.total_resources)],
+      ["available", r => fmt(r.available_resources)]]);
+    document.getElementById("nodes").innerHTML = table(nodes.nodes, [
+      ["node", r => "<code>" + r.node_id.slice(0, 12) + "</code>"],
+      ["state", r => alive(r.alive)],
+      ["address", r => r.address.join(":")],
+      ["resources", r => fmt(r.resources)],
+      ["available", r => fmt(r.available)]]);
+    document.getElementById("actors").innerHTML = table(actors.actors, [
+      ["actor", r => "<code>" + r.actor_id.slice(0, 12) + "</code>"],
+      ["name", r => r.name || ""],
+      ["state", r => r.state === "ALIVE" ?
+          '<span class="ok">ALIVE</span>' : r.state],
+      ["restarts", r => r.restarts || 0],
+      ["node", r => r.node_id ? r.node_id.slice(0, 12) : ""]]);
+    document.getElementById("jobs").innerHTML = table(jobs.jobs, [
+      ["job", r => "<code>" + (r.submission_id || r.job_id ||
+                               "").slice(0, 16) + "</code>"],
+      ["status", r => r.status],
+      ["entrypoint", r => r.entrypoint || ""]]);
+    const sd = Object.entries(serve.deployments || {}).map(
+        ([name, s]) => ({name, ...s}));
+    document.getElementById("serve").innerHTML = table(sd, [
+      ["deployment", r => r.name],
+      ["status", r => r.status === "HEALTHY" ?
+          '<span class="ok">HEALTHY</span>' : r.status],
+      ["replicas", r => r.running_replicas + "/" + r.target_replicas],
+      ["version", r => "v" + r.version]]);
+  } catch (e) {
+    document.getElementById("meta").textContent = "refresh failed: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 3000);
+</script>
+</body>
+</html>
+"""

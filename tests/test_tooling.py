@@ -55,6 +55,16 @@ def test_dashboard_endpoints(ray_start_regular):
             text = r.read().decode()
         assert "dash_test_counter" in text
         assert "ray_tpu_cluster_nodes 1" in text
+
+        # "/" serves the HTML UI to browsers, JSON to API clients
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            html = r.read().decode()
+        assert "<!doctype html>" in html and "ray_tpu dashboard" in html
+        req = urllib.request.Request(base + "/",
+                                     headers={"Accept": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            import json as _json
+            assert "routes" in _json.loads(r.read())
     finally:
         head.stop()
 
