@@ -32,10 +32,12 @@ INDEX_HTML = """<!doctype html>
 <h2>Jobs</h2><div id="jobs"></div>
 <h2>Serve</h2><div id="serve"></div>
 <script>
-const fmt = (o) => typeof o === "object" ?
+const esc = (s) => String(s).replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
+const fmt = (o) => esc(typeof o === "object" ?
     Object.entries(o || {}).map(([k, v]) => k + ": " +
-        (typeof v === "number" ? (+v.toFixed ? +v.toFixed(1) : v) : v))
-        .join(", ") : String(o);
+        (typeof v === "number" && !Number.isInteger(v) ? v.toFixed(1) : v))
+        .join(", ") : String(o));
 function table(rows, cols) {
   if (!rows || !rows.length) return "<em>none</em>";
   let h = "<table><tr>" + cols.map(c => "<th>" + c[0] + "</th>").join("")
@@ -68,24 +70,24 @@ async function refresh() {
       ["available", r => fmt(r.available)]]);
     document.getElementById("actors").innerHTML = table(actors.actors, [
       ["actor", r => "<code>" + r.actor_id.slice(0, 12) + "</code>"],
-      ["name", r => r.name || ""],
+      ["name", r => esc(r.name || "")],
       ["state", r => r.state === "ALIVE" ?
-          '<span class="ok">ALIVE</span>' : r.state],
+          '<span class="ok">ALIVE</span>' : esc(r.state)],
       ["restarts", r => r.restarts || 0],
       ["node", r => r.node_id ? r.node_id.slice(0, 12) : ""]]);
     document.getElementById("jobs").innerHTML = table(jobs.jobs, [
       ["job", r => "<code>" + (r.submission_id || r.job_id ||
                                "").slice(0, 16) + "</code>"],
-      ["status", r => r.status],
-      ["entrypoint", r => r.entrypoint || ""]]);
+      ["status", r => esc(r.status)],
+      ["entrypoint", r => esc(r.entrypoint || "")]]);
     const sd = Object.entries(serve.deployments || {}).map(
         ([name, s]) => ({name, ...s}));
     document.getElementById("serve").innerHTML = table(sd, [
-      ["deployment", r => r.name],
+      ["deployment", r => esc(r.name)],
       ["status", r => r.status === "HEALTHY" ?
-          '<span class="ok">HEALTHY</span>' : r.status],
+          '<span class="ok">HEALTHY</span>' : esc(r.status)],
       ["replicas", r => r.running_replicas + "/" + r.target_replicas],
-      ["version", r => "v" + r.version]]);
+      ["version", r => esc("v" + r.version)]]);
   } catch (e) {
     document.getElementById("meta").textContent = "refresh failed: " + e;
   }

@@ -2,31 +2,49 @@
 
 Analog of /root/reference/rllib (SURVEY.md §2.4): AlgorithmConfig builder,
 Algorithm driver (Tune-compatible), WorkerSet of fault-tolerant rollout
-actors, PPO (sync, mesh-sharded SGD), IMPALA (async, V-trace), DQN (replay +
-target net + double/dueling Q), SAC (max-entropy continuous control), replay
-buffers, in-repo gymnasium-compatible envs.
+actors, on-policy (PG, A2C/A3C, PPO, IMPALA, APPO), off-policy (SimpleQ,
+DQN, DDPG, TD3, SAC), offline (BC, MARWIL, CQL + IS/WIS estimators),
+black-box (ES, ARS), replay buffers, in-repo gymnasium-compatible envs,
+and the name registry used by the CLI/Tune.
 """
 
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from ray_tpu.rl.env import (Box, CartPoleEnv, Discrete, Env,  # noqa: F401
                             PendulumEnv, VectorEnv, make_env, register_env)
+from ray_tpu.rl.a2c import A2C, A2CConfig, A3C, A3CConfig  # noqa: F401
+from ray_tpu.rl.appo import APPO, APPOConfig  # noqa: F401
+from ray_tpu.rl.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rl.ddpg import DDPG, DDPGConfig, TD3, TD3Config  # noqa: F401
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rl.es import ARS, ARSConfig, ES, ESConfig  # noqa: F401
 from ray_tpu.rl.impala import Impala, ImpalaConfig, vtrace  # noqa: F401
-from ray_tpu.rl.policy import (JaxPolicy, QPolicy,  # noqa: F401
+from ray_tpu.rl.offline import (BC, BCConfig, MARWIL,  # noqa: F401
+                                MARWILConfig, JsonReader, JsonWriter,
+                                collect_dataset,
+                                importance_sampling_estimate)
+from ray_tpu.rl.pg import PG, PGConfig  # noqa: F401
+from ray_tpu.rl.policy import (DDPGPolicy, JaxPolicy, QPolicy,  # noqa: F401
                                SACPolicy)
 from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rl.registry import get_algorithm_class  # noqa: F401
 from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer,  # noqa: F401
                                       ReplayBuffer)
 from ray_tpu.rl.rollout_worker import RolloutWorker  # noqa: F401
 from ray_tpu.rl.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rl.sample_batch import SampleBatch, compute_gae  # noqa: F401
+from ray_tpu.rl.simple_q import SimpleQ, SimpleQConfig  # noqa: F401
 from ray_tpu.rl.worker_set import WorkerSet  # noqa: F401
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
-    "ImpalaConfig", "DQN", "DQNConfig", "vtrace", "RolloutWorker",
-    "WorkerSet", "JaxPolicy", "QPolicy", "SAC", "SACConfig",
-    "SampleBatch", "compute_gae", "ReplayBuffer", "PrioritizedReplayBuffer",
-    "Env", "Box", "Discrete", "CartPoleEnv", "PendulumEnv", "VectorEnv",
-    "make_env", "register_env",
+    "ImpalaConfig", "APPO", "APPOConfig", "DQN", "DQNConfig", "SimpleQ",
+    "SimpleQConfig", "vtrace", "RolloutWorker", "WorkerSet", "JaxPolicy",
+    "QPolicy", "DDPGPolicy", "SAC", "SACConfig", "DDPG", "DDPGConfig",
+    "TD3", "TD3Config", "PG", "PGConfig", "A2C", "A2CConfig", "A3C",
+    "A3CConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL",
+    "CQLConfig", "ES", "ESConfig", "ARS", "ARSConfig", "JsonReader",
+    "JsonWriter", "collect_dataset", "importance_sampling_estimate",
+    "get_algorithm_class", "SampleBatch", "compute_gae", "ReplayBuffer",
+    "PrioritizedReplayBuffer", "Env", "Box", "Discrete", "CartPoleEnv",
+    "PendulumEnv", "VectorEnv", "make_env", "register_env",
 ]

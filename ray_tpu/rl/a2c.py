@@ -1,0 +1,158 @@
+"""A2C / A3C: (a)synchronous advantage actor-critic.
+
+Analog of /root/reference/rllib/algorithms/a2c/a2c.py and a3c/a3c.py
+(a3c_torch_policy.py loss: pg + 0.5*vf - entropy, single pass per batch).
+A2C is the synchronous variant: gather one on-policy batch from all
+workers, one fused update. A3C keeps RLlib's semantics the TPU-native
+way: instead of lock-free HogWild gradient application (a poor fit for a
+jitted learner), each worker's fragment is applied the moment it arrives
+— same staleness profile, deterministic learner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = A2C
+        self.lr = 1e-3
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.train_batch_size = 500
+        self.rollout_fragment_length = 50
+
+
+class A2C(Algorithm):
+    def setup_learner(self) -> None:
+        cfg: A2CConfig = self.config
+        self.model, params, _, logp_fn, ent_fn = self.init_actor_critic()
+        self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                              optax.rmsprop(cfg.lr, decay=0.99))
+        self.build_learner_mesh()
+        self.params = jax.device_put(params, self.repl_sharding)
+        self.opt_state = jax.device_put(self.tx.init(params),
+                                        self.repl_sharding)
+        model, tx = self.model, self.tx
+        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+
+        def loss_fn(params, batch):
+            logits, values = model.apply({"params": params}, batch[SB.OBS])
+            logp = logp_fn(logits, batch[SB.ACTIONS])
+            pg_loss = -(logp * batch[SB.ADVANTAGES]).mean()
+            vf_loss = 0.5 * jnp.square(
+                values - batch[SB.VALUE_TARGETS]).mean()
+            entropy = ent_fn(logits).mean()
+            total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        @jax.jit
+        def sgd_step(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            aux["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, aux
+
+        self._sgd_step = sgd_step
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.device_put(
+            jax.tree.map(jnp.asarray, weights), self.repl_sharding)
+
+    def _apply_batch(self, batch: SampleBatch) -> Dict[str, Any]:
+        n = self.round_minibatch(batch.count)
+        device_batch = self.stage_batch(
+            batch.slice(0, n),
+            (SB.OBS, SB.ACTIONS, SB.ADVANTAGES, SB.VALUE_TARGETS))
+        self.params, self.opt_state, aux = self._sgd_step(
+            self.params, self.opt_state, device_batch)
+        return aux
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: A2CConfig = self.config
+        train_batch = self.gather_on_policy_batch(cfg.train_batch_size)
+        aux = self._apply_batch(train_batch)
+        self.workers.sync_weights(self.get_weights())
+        info = {k: float(v) for k, v in aux.items()}
+        info["train_batch_size"] = train_batch.count
+        return {"info": info}
+
+
+class A3CConfig(A2CConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = A3C
+        self.batches_per_step = 8
+
+
+class A3C(A2C):
+    """Async variant: per-worker fragments applied as they arrive, fresh
+    weights pushed back to the producing worker only (no global barrier) —
+    the async-update semantics of a3c.py without HogWild races."""
+
+    def setup_learner(self) -> None:
+        super().setup_learner()
+        self._inflight: Dict[Any, int] = {}
+
+    def _submit(self, idx: int) -> None:
+        ref = self.workers.workers[idx].sample.remote()
+        self._inflight[ref] = idx
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+        cfg: A3CConfig = self.config
+        live = set(self._inflight.values())
+        for i in range(len(self.workers.workers)):
+            if i not in live:
+                self._submit(i)
+        aux_last: Dict[str, Any] = {}
+        processed = 0
+        while processed < cfg.batches_per_step:
+            ready, _ = ray_tpu.wait(list(self._inflight.keys()),
+                                    num_returns=1, timeout=60.0)
+            if not ready:
+                break
+            ref = ready[0]
+            idx = self._inflight.pop(ref)
+            try:
+                fragment = ray_tpu.get(ref, timeout=30.0)
+            except Exception:
+                # push current weights to the replacement before it samples
+                # (A3C has no importance correction for off-policy data)
+                self.workers.restart_worker(idx, self.get_weights())
+                self._submit(idx)
+                continue
+            aux_last = self._apply_batch(fragment)
+            self._timesteps_total += fragment.count
+            processed += 1
+            try:
+                self.workers.workers[idx].set_weights.remote(
+                    self.get_weights())
+            except Exception:
+                pass
+            self._submit(idx)
+        info = {k: float(v) for k, v in aux_last.items()}
+        info["batches_processed"] = processed
+        return {"info": info}
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()

@@ -136,6 +136,45 @@ class Algorithm:
         self.batch_sharding = NamedSharding(self.mesh, P("data"))
         self.repl_sharding = NamedSharding(self.mesh, P())
 
+    def init_actor_critic(self):
+        """Probe the env and build the shared ActorCritic tower: returns
+        (model, params, continuous, logp_fn, ent_fn). Used by the whole
+        on-policy family (PG/A2C/PPO/IMPALA/APPO)."""
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.rl import models as M
+        from ray_tpu.rl.env import Box, make_env
+        cfg = self.config
+        probe = make_env(cfg.env_spec)
+        continuous = isinstance(probe.action_space, Box)
+        act_dim = int(np.prod(probe.action_space.shape)) if continuous \
+            else probe.action_space.n
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        probe.close()
+        model = M.ActorCritic(action_dim=act_dim, hidden=tuple(cfg.hidden),
+                              continuous=continuous)
+        params = model.init(jax.random.PRNGKey(cfg.seed or 0),
+                            jnp.zeros((1, obs_dim)))["params"]
+        if continuous:
+            logp_fn, ent_fn = M.diag_gaussian_logp, M.diag_gaussian_entropy
+        else:
+            logp_fn, ent_fn = M.categorical_logp, M.categorical_entropy
+        return model, params, continuous, logp_fn, ent_fn
+
+    def gather_on_policy_batch(self, min_size: int):
+        """synchronous_parallel_sample: pull worker fragments until the
+        batch reaches ``min_size`` rows (rollout_ops.py:21)."""
+        from ray_tpu.rl.sample_batch import SampleBatch
+        batches = self.workers.foreach_worker("sample")
+        train_batch = SampleBatch.concat_samples(batches)
+        while train_batch.count < min_size:
+            more = self.workers.foreach_worker("sample")
+            if not more:
+                break
+            train_batch = SampleBatch.concat_samples([train_batch] + more)
+        self._timesteps_total += train_batch.count
+        return train_batch
+
     def round_minibatch(self, size: int) -> int:
         """Largest size >= n_shards divisible by the data-axis shard count."""
         n_shards = self.mesh.devices.size

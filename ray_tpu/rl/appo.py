@@ -1,0 +1,115 @@
+"""APPO: asynchronous PPO — IMPALA's actor-learner pipeline with the
+clipped-surrogate loss computed on V-trace-corrected advantages.
+
+Analog of /root/reference/rllib/algorithms/appo/appo.py (+
+appo_torch_policy.py): off-policy fragments stream in asynchronously; the
+importance ratio is taken against the behavior policy's logp and clipped
+PPO-style; a slow-moving target policy network anchors the V-trace
+correction (appo.py target_update_frequency). Inherits IMPALA's async
+submit/consume loop; only the jitted loss differs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import AlgorithmConfig
+from ray_tpu.rl.impala import Impala, vtrace
+
+
+class APPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.lr = 5e-4
+        self.clip_param = 0.3
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.vtrace_rho_bar = 1.0
+        self.vtrace_c_bar = 1.0
+        self.batches_per_step = 8
+        self.rollout_fragment_length = 50
+        self.target_update_frequency = 4   # learner steps between syncs
+
+
+class APPO(Impala):
+    def setup_learner(self) -> None:
+        cfg: APPOConfig = self.config
+        self.model, self.params, _, logp_fn, ent_fn = \
+            self.init_actor_critic()
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                              optax.adam(cfg.lr))
+        self.opt_state = self.tx.init(self.params)
+        self._inflight: Dict = {}
+        self._learner_steps = 0
+
+        model, gamma = self.model, cfg.gamma
+        clip = cfg.clip_param
+        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+        rho_bar, c_bar = cfg.vtrace_rho_bar, cfg.vtrace_c_bar
+        tx = self.tx
+
+        def loss_fn(params, target_params, batch):
+            T, B = batch[SB.REWARDS].shape
+            obs = batch[SB.OBS]
+            flat_obs = obs.reshape((T * B,) + obs.shape[2:])
+            logits, values = model.apply({"params": params}, flat_obs)
+            logits = logits.reshape((T, B) + logits.shape[1:])
+            values = values.reshape(T, B)
+            _, boot_value = model.apply({"params": params},
+                                        batch["bootstrap_obs"])
+            # target policy anchors the V-trace correction (appo.py)
+            t_logits, _ = model.apply({"params": target_params}, flat_obs)
+            t_logits = t_logits.reshape((T, B) + t_logits.shape[1:])
+            target_logp_anchor = logp_fn(t_logits, batch[SB.ACTIONS])
+            discounts = gamma * (1.0 - batch[SB.TERMINATEDS]
+                                 .astype(jnp.float32))
+            vs, pg_adv = vtrace(
+                jax.lax.stop_gradient(target_logp_anchor),
+                batch[SB.ACTION_LOGP], batch[SB.REWARDS], values,
+                boot_value, discounts, rho_bar, c_bar)
+            # PPO clipped surrogate against the behavior policy
+            logp = logp_fn(logits, batch[SB.ACTIONS])
+            ratio = jnp.exp(logp - batch[SB.ACTION_LOGP])
+            surr = jnp.minimum(
+                ratio * pg_adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * pg_adv)
+            pg_loss = -surr.mean()
+            vf_loss = 0.5 * jnp.square(vs - values).mean()
+            entropy = ent_fn(logits).mean()
+            total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy,
+                           "mean_ratio": ratio.mean()}
+
+        @jax.jit
+        def sgd_step(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._appo_step = sgd_step
+        # adapter so Impala.training_step's 3-arg call keeps working
+        self._sgd_step = self._appo_adapter
+
+    def _appo_adapter(self, params, opt_state, batch):
+        cfg: APPOConfig = self.config
+        params, opt_state, aux = self._appo_step(
+            params, self.target_params, opt_state, batch)
+        self._learner_steps += 1
+        if self._learner_steps % max(cfg.target_update_frequency, 1) == 0:
+            self.target_params = jax.tree.map(jnp.copy, params)
+        return params, opt_state, aux
+
+    def set_weights(self, weights) -> None:
+        super().set_weights(weights)
+        self.target_params = jax.tree.map(jnp.copy, self.params)

@@ -1,0 +1,220 @@
+"""CQL: conservative Q-learning for offline continuous control.
+
+Analog of /root/reference/rllib/algorithms/cql/cql.py (+
+cql_torch_policy.py): SAC's twin-critic max-entropy update plus the
+conservative regularizer  E_s[logsumexp_a Q(s,a)] - E_(s,a)~D[Q(s,a)],
+estimated with `num_actions` sampled random + policy actions. Trains from
+a JsonReader dataset (no rollout workers); one jitted update per
+minibatch on the mesh's data axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.env import Box, make_env
+from ray_tpu.rl.offline import JsonReader, MARWILConfig
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class CQLConfig(MARWILConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = CQL
+        self.lr = 3e-4
+        self.cql_alpha = 1.0            # weight of the conservative term
+        self.num_actions = 4            # sampled actions for logsumexp
+        self.tau = 0.005
+        self.initial_alpha = 1.0
+        self.train_batch_size = 256
+        self.num_sgd_iter = 64          # updates per train() call
+
+
+class CQL:
+    def __init__(self, config: CQLConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.rl import models as M
+
+        self.config = config
+        if config.input_path is None:
+            raise ValueError("config.offline_data(input_path=...) required")
+        self.dataset = JsonReader(config.input_path).read_all()
+        self.iteration = 0
+        self._timesteps_total = 0
+
+        probe = make_env(config.env_spec)
+        if not isinstance(probe.action_space, Box):
+            raise ValueError("CQL requires a continuous action space")
+        act_dim = int(np.prod(probe.action_space.shape))
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        low = np.asarray(probe.action_space.low, np.float32).reshape(-1)
+        high = np.asarray(probe.action_space.high, np.float32).reshape(-1)
+        probe.close()
+        self.continuous = True
+
+        # dataset actions must live in tanh space for the critic
+        self._low, self._high = low, high
+        scale, shift = (high - low) / 2.0, (high + low) / 2.0
+
+        self.actor = M.SquashedGaussianActor(action_dim=act_dim,
+                                             hidden=tuple(config.hidden))
+        self.critic = M.TwinQ(hidden=tuple(config.hidden))
+        rng = jax.random.PRNGKey(config.seed or 0)
+        r1, r2 = jax.random.split(rng)
+        actor_params = self.actor.init(r1, jnp.zeros((1, obs_dim)))["params"]
+        critic_params = self.critic.init(
+            r2, jnp.zeros((1, obs_dim)), jnp.zeros((1, act_dim)))["params"]
+        self.actor_tx = optax.adam(config.lr)
+        self.critic_tx = optax.adam(config.lr)
+        self.state = {
+            "actor": actor_params,
+            "critic": critic_params,
+            "target_critic": jax.tree.map(jnp.copy, critic_params),
+            "actor_opt": self.actor_tx.init(actor_params),
+            "critic_opt": self.critic_tx.init(critic_params),
+        }
+
+        actor, critic = self.actor, self.critic
+        actor_tx, critic_tx = self.actor_tx, self.critic_tx
+        gamma, tau = config.gamma, config.tau
+        alpha_ent = 0.1                  # fixed entropy weight (offline)
+        cql_alpha = config.cql_alpha
+        n_act = config.num_actions
+
+        def rescale(a_tanh):
+            return a_tanh * scale + shift
+
+        def update(state, batch, rng):
+            r_next, r_pi, r_rand, r_cql = jax.random.split(rng, 4)
+            B = batch[SB.REWARDS].shape[0]
+
+            # -- soft Bellman target --------------------------------------
+            mean_n, log_std_n = actor.apply({"params": state["actor"]},
+                                            batch[SB.NEXT_OBS])
+            a_next, logp_next = M.squashed_sample_logp(r_next, mean_n,
+                                                       log_std_n)
+            q1_t, q2_t = critic.apply({"params": state["target_critic"]},
+                                      batch[SB.NEXT_OBS], rescale(a_next))
+            q_next = jnp.minimum(q1_t, q2_t) - alpha_ent * logp_next
+            not_done = 1.0 - batch[SB.TERMINATEDS].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch[SB.REWARDS] + gamma * not_done * q_next)
+
+            def critic_loss(p):
+                q1, q2 = critic.apply({"params": p}, batch[SB.OBS],
+                                      batch[SB.ACTIONS])
+                bellman = (jnp.square(q1 - target)
+                           + jnp.square(q2 - target)).mean() * 0.5
+                # conservative term: logsumexp over random + policy actions
+                rand_a = jax.random.uniform(
+                    r_rand, (n_act, B, act_dim), minval=-1.0, maxval=1.0)
+                mean_c, log_std_c = actor.apply(
+                    {"params": state["actor"]}, batch[SB.OBS])
+                keys = jax.random.split(r_cql, n_act)
+                pol_a = jnp.stack([
+                    M.squashed_sample_logp(k, mean_c, log_std_c)[0]
+                    for k in keys])                       # [n_act, B, A]
+                all_a = jnp.concatenate([rand_a, pol_a], axis=0)
+
+                def q_of(a):
+                    q1s, q2s = critic.apply({"params": p}, batch[SB.OBS],
+                                            rescale(a))
+                    return q1s, q2s
+
+                q1_all, q2_all = jax.vmap(q_of)(all_a)    # [2n, B]
+                lse1 = jax.scipy.special.logsumexp(q1_all, axis=0)
+                lse2 = jax.scipy.special.logsumexp(q2_all, axis=0)
+                conservative = ((lse1 - q1) + (lse2 - q2)).mean() * 0.5
+                return bellman + cql_alpha * conservative, \
+                    (bellman, conservative, q1.mean())
+
+            (c_loss, (bellman, conservative, mean_q)), c_grads = \
+                jax.value_and_grad(critic_loss, has_aux=True)(
+                    state["critic"])
+            c_updates, critic_opt = critic_tx.update(
+                c_grads, state["critic_opt"], state["critic"])
+            critic_params = optax.apply_updates(state["critic"], c_updates)
+
+            # -- actor (SAC objective) ------------------------------------
+            def actor_loss(p):
+                mean, log_std = actor.apply({"params": p}, batch[SB.OBS])
+                a, logp = M.squashed_sample_logp(r_pi, mean, log_std)
+                q1, q2 = critic.apply({"params": critic_params},
+                                      batch[SB.OBS], rescale(a))
+                return (alpha_ent * logp - jnp.minimum(q1, q2)).mean()
+
+            a_loss, a_grads = jax.value_and_grad(actor_loss)(state["actor"])
+            a_updates, actor_opt = actor_tx.update(
+                a_grads, state["actor_opt"], state["actor"])
+            actor_params = optax.apply_updates(state["actor"], a_updates)
+
+            target_critic = jax.tree.map(
+                lambda t, o: t * (1.0 - tau) + o * tau,
+                state["target_critic"], critic_params)
+            new_state = {
+                "actor": actor_params, "critic": critic_params,
+                "target_critic": target_critic,
+                "actor_opt": actor_opt, "critic_opt": critic_opt,
+            }
+            return new_state, {"critic_loss": c_loss,
+                               "bellman_loss": bellman,
+                               "cql_loss": conservative,
+                               "actor_loss": a_loss, "mean_q": mean_q}
+
+        import jax as _jax
+        self._update = _jax.jit(update, donate_argnums=(0,))
+        self._rng = _jax.random.PRNGKey((config.seed or 0) + 31)
+        self._jnp = jnp
+        self._jax = jax
+
+        if SB.NEXT_OBS not in self.dataset:
+            raise ValueError("CQL needs next_obs in the offline dataset "
+                             "(collect with collect_dataset)")
+
+    def get_weights(self) -> Any:
+        import jax
+        return jax.tree.map(np.asarray, self.state["actor"])
+
+    def set_weights(self, weights: Any) -> None:
+        self.state["actor"] = self._jax.tree.map(self._jnp.asarray, weights)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        jnp = self._jnp
+        metrics: Dict[str, Any] = {}
+        rng = np.random.default_rng(
+            (cfg.seed or 0) + self.iteration * 1000)
+        n = self.dataset.count
+        keep = (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS, SB.TERMINATEDS)
+        for i in range(cfg.num_sgd_iter):
+            # index-gather a minibatch; no full-dataset shuffle copies
+            idx = rng.choice(n, size=min(cfg.train_batch_size, n),
+                             replace=False)
+            mb = SampleBatch({k: np.asarray(self.dataset[k])[idx]
+                              for k in keep if k in self.dataset})
+            device_batch = {k: jnp.asarray(v) for k, v in mb.items()}
+            self._rng, key = self._jax.random.split(self._rng)
+            self.state, metrics = self._update(self.state, device_batch, key)
+            self._timesteps_total += mb.count
+        self.iteration += 1
+        info = {k: float(v) for k, v in metrics.items()}
+        return {"info": info, "training_iteration": self.iteration,
+                "timesteps_total": self._timesteps_total}
+
+    def save(self) -> Checkpoint:
+        return Checkpoint.from_dict({
+            "weights": self.get_weights(), "iteration": self.iteration})
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        d = checkpoint.to_dict()
+        self.set_weights(d["weights"])
+        self.iteration = d.get("iteration", 0)
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,218 @@
+"""DDPG + TD3: deterministic-policy off-policy continuous control.
+
+Analog of /root/reference/rllib/algorithms/ddpg/ddpg.py and td3/td3.py
+(ddpg_torch_policy.py losses): deterministic actor trained through the
+critic, target networks with soft (tau) updates; TD3 layers on twin
+critics with min-Q targets, target-policy smoothing noise, and delayed
+actor updates (td3.py: policy_delay=2). Same TPU shape as SAC: one jitted
+update on the mesh's data axis, DDPGPolicy rollouts on CPU actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as M
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import Box, make_env
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+
+
+class DDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DDPG
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.train_batch_size = 256
+        self.buffer_size = 100_000
+        self.learning_starts = 1000
+        self.tau = 0.005
+        self.exploration_noise = 0.1
+        self.n_updates_per_iter = 32
+        self.rollout_fragment_length = 64
+        # TD3 extensions (off for plain DDPG)
+        self.twin_q = False
+        self.policy_delay = 1
+        self.target_noise = 0.0
+        self.target_noise_clip = 0.5
+
+
+class TD3Config(DDPGConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = TD3
+        self.twin_q = True
+        self.policy_delay = 2
+        self.target_noise = 0.2
+
+
+class DDPG(Algorithm):
+    @classmethod
+    def extra_worker_kwargs(cls, config: AlgorithmConfig) -> Dict[str, Any]:
+        return {"policy": "ddpg",
+                "policy_kwargs": {
+                    "exploration_noise": getattr(config, "exploration_noise",
+                                                 0.1)}}
+
+    def setup_learner(self) -> None:
+        cfg: DDPGConfig = self.config
+        probe = make_env(cfg.env_spec)
+        if not isinstance(probe.action_space, Box):
+            raise ValueError("DDPG requires a continuous action space")
+        act_dim = int(np.prod(probe.action_space.shape))
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        low = np.asarray(probe.action_space.low, np.float32).reshape(-1)
+        high = np.asarray(probe.action_space.high, np.float32).reshape(-1)
+        probe.close()
+
+        self.actor = M.DeterministicActor(action_dim=act_dim,
+                                          hidden=tuple(cfg.hidden))
+        self.critic = M.TwinQ(hidden=tuple(cfg.hidden))
+        rng = jax.random.PRNGKey(cfg.seed or 0)
+        r1, r2 = jax.random.split(rng)
+        actor_params = self.actor.init(r1, jnp.zeros((1, obs_dim)))["params"]
+        critic_params = self.critic.init(
+            r2, jnp.zeros((1, obs_dim)), jnp.zeros((1, act_dim)))["params"]
+        self.actor_tx = optax.adam(cfg.actor_lr)
+        self.critic_tx = optax.adam(cfg.critic_lr)
+
+        self.build_learner_mesh()
+        put = lambda t: jax.device_put(t, self.repl_sharding)  # noqa: E731
+        self.state = {
+            "actor": put(actor_params),
+            "critic": put(critic_params),
+            "target_actor": put(jax.tree.map(jnp.copy, actor_params)),
+            "target_critic": put(jax.tree.map(jnp.copy, critic_params)),
+            "actor_opt": put(self.actor_tx.init(actor_params)),
+            "critic_opt": put(self.critic_tx.init(critic_params)),
+        }
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._updates = 0
+
+        actor, critic = self.actor, self.critic
+        actor_tx, critic_tx = self.actor_tx, self.critic_tx
+        gamma, tau = cfg.gamma, cfg.tau
+        twin_q = cfg.twin_q
+        target_noise = cfg.target_noise
+        noise_clip = cfg.target_noise_clip
+        scale, shift = (high - low) / 2.0, (high + low) / 2.0
+
+        def rescale(a_tanh):
+            return a_tanh * scale + shift
+
+        def update(state, batch, rng, do_actor):
+            # -- critic: TD target from the target actor -------------------
+            a_next = actor.apply({"params": state["target_actor"]},
+                                 batch[SB.NEXT_OBS])
+            if target_noise > 0.0:
+                # TD3 target-policy smoothing
+                noise = jnp.clip(
+                    target_noise * jax.random.normal(rng, a_next.shape),
+                    -noise_clip, noise_clip)
+                a_next = jnp.clip(a_next + noise, -1.0, 1.0)
+            q1_t, q2_t = critic.apply({"params": state["target_critic"]},
+                                      batch[SB.NEXT_OBS], rescale(a_next))
+            q_next = jnp.minimum(q1_t, q2_t) if twin_q else q1_t
+            not_done = 1.0 - batch[SB.TERMINATEDS].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch[SB.REWARDS] + gamma * not_done * q_next)
+
+            def critic_loss(p):
+                q1, q2 = critic.apply({"params": p}, batch[SB.OBS],
+                                      batch[SB.ACTIONS])
+                loss = jnp.square(q1 - target).mean()
+                if twin_q:
+                    loss = loss + jnp.square(q2 - target).mean()
+                return loss, q1.mean()
+
+            (c_loss, mean_q), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(state["critic"])
+            c_updates, critic_opt = critic_tx.update(
+                c_grads, state["critic_opt"], state["critic"])
+            critic_params = optax.apply_updates(state["critic"], c_updates)
+
+            # -- actor: maximize Q1 of the fresh critic (delayed for TD3) --
+            def actor_loss(p):
+                a = actor.apply({"params": p}, batch[SB.OBS])
+                q1, _ = critic.apply({"params": critic_params},
+                                     batch[SB.OBS], rescale(a))
+                return -q1.mean()
+
+            def do_actor_update(_):
+                a_loss, a_grads = jax.value_and_grad(actor_loss)(
+                    state["actor"])
+                a_updates, actor_opt = actor_tx.update(
+                    a_grads, state["actor_opt"], state["actor"])
+                actor_params = optax.apply_updates(state["actor"], a_updates)
+                target_actor = jax.tree.map(
+                    lambda t, o: t * (1.0 - tau) + o * tau,
+                    state["target_actor"], actor_params)
+                return actor_params, actor_opt, target_actor, a_loss
+
+            def skip_actor_update(_):
+                return (state["actor"], state["actor_opt"],
+                        state["target_actor"], jnp.float32(0.0))
+
+            actor_params, actor_opt, target_actor, a_loss = jax.lax.cond(
+                do_actor, do_actor_update, skip_actor_update, None)
+
+            target_critic = jax.tree.map(
+                lambda t, o: t * (1.0 - tau) + o * tau,
+                state["target_critic"], critic_params)
+            new_state = {
+                "actor": actor_params, "critic": critic_params,
+                "target_actor": target_actor,
+                "target_critic": target_critic,
+                "actor_opt": actor_opt, "critic_opt": critic_opt,
+            }
+            return new_state, {"critic_loss": c_loss, "actor_loss": a_loss,
+                               "mean_q": mean_q}
+
+        self._update = jax.jit(update, donate_argnums=(0,))
+        self._rng = jax.random.PRNGKey((cfg.seed or 0) + 23)
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.state["actor"])
+
+    def set_weights(self, weights: Any) -> None:
+        self.state["actor"] = jax.device_put(
+            jax.tree.map(jnp.asarray, weights), self.repl_sharding)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: DDPGConfig = self.config
+        batches = self.workers.foreach_worker("sample_transitions")
+        for b in batches:
+            self.buffer.add(b)
+            self._timesteps_total += b.count
+
+        info: Dict[str, Any] = {"buffer_size": len(self.buffer)}
+        if len(self.buffer) < cfg.learning_starts:
+            return {"info": info}
+
+        mb = self.round_minibatch(cfg.train_batch_size)
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.n_updates_per_iter):
+            sample = self.buffer.sample(mb)
+            device_batch = self.stage_batch(
+                sample, (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS,
+                         SB.TERMINATEDS))
+            self._rng, key = jax.random.split(self._rng)
+            self._updates += 1
+            do_actor = (self._updates % max(cfg.policy_delay, 1)) == 0
+            self.state, metrics = self._update(self.state, device_batch,
+                                               key, do_actor)
+
+        self.workers.sync_weights(self.get_weights())
+        info.update({k: float(v) for k, v in metrics.items()})
+        return {"info": info}
+
+
+class TD3(DDPG):
+    pass

@@ -1,0 +1,188 @@
+"""ES + ARS: black-box evolution-strategy policy search.
+
+Analog of /root/reference/rllib/algorithms/es/es.py (OpenAI-ES: antithetic
+Gaussian perturbations, centered-rank fitness shaping, shared-noise-style
+seeded sampling) and ars/ars.py (Augmented Random Search: top-k direction
+selection, reward-std scaling). Embarrassingly parallel by construction —
+each rollout actor evaluates a (theta + sigma*eps) candidate; the "learner"
+is a numpy vector update on the driver, no device mesh needed. The noise
+table is reproduced from seeds on the driver rather than shipped (the
+shared-noise-table trick without shared memory).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+
+
+def centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Fitness shaping: map rewards to ranks in [-0.5, 0.5] (es.py
+    compute_centered_ranks)."""
+    ranks = np.empty(len(x), dtype=np.float32)
+    ranks[x.argsort()] = np.arange(len(x), dtype=np.float32)
+    return ranks / max(len(x) - 1, 1) - 0.5
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = ES
+        self.noise_stdev = 0.05
+        self.step_size = 0.02           # SGD step on the ES gradient
+        self.episodes_per_candidate = 1
+        self.candidates_per_iteration = 16   # antithetic pairs = n/2
+        self.l2_coeff = 0.005
+
+
+class ARSConfig(ESConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = ARS
+        self.top_k = 8                  # directions kept per update
+
+
+class ES(Algorithm):
+    """Driver holds theta as a flat vector; workers score perturbations."""
+
+    def setup_learner(self) -> None:
+        import jax
+        from jax.flatten_util import ravel_pytree
+        from ray_tpu.rl.env import make_env
+        from ray_tpu.rl.policy import JaxPolicy
+
+        cfg: ESConfig = self.config
+        probe = make_env(cfg.env_spec)
+        pol = JaxPolicy(probe.observation_space, probe.action_space,
+                        hidden=tuple(cfg.hidden), seed=cfg.seed or 0)
+        probe.close()
+        flat, unravel = ravel_pytree(pol.get_weights())
+        self.theta = np.asarray(flat, np.float32)
+        self._unravel = lambda v: jax.tree.map(
+            np.asarray, unravel(np.asarray(v, np.float32)))
+        self._np_rng = np.random.default_rng(cfg.seed or 0)
+
+    def get_weights(self) -> Any:
+        return self._unravel(self.theta)
+
+    def set_weights(self, weights: Any) -> None:
+        from jax.flatten_util import ravel_pytree
+        flat, _ = ravel_pytree(weights)
+        self.theta = np.asarray(flat, np.float32)
+
+    def _perturbations(self, n_pairs: int) -> np.ndarray:
+        return self._np_rng.standard_normal(
+            (n_pairs, self.theta.size)).astype(np.float32)
+
+    def _evaluate(self, candidates: List[np.ndarray]) -> np.ndarray:
+        """Round-robin candidates over the worker set; mean return each.
+        Also accumulates real env steps into _timesteps_total."""
+        import ray_tpu
+        cfg: ESConfig = self.config
+        workers = self.workers.workers
+        n_workers = len(workers)
+        refs = []
+        for i, cand in enumerate(candidates):
+            w = workers[i % n_workers]
+            refs.append(w.evaluate_rollout.remote(
+                self._unravel(cand),
+                n_episodes=cfg.episodes_per_candidate))
+        rewards = np.zeros(len(candidates), np.float32)
+        restarted = set()
+        for i, ref in enumerate(refs):
+            try:
+                out = ray_tpu.get(ref, timeout=120.0)
+                rewards[i] = float(np.mean(out["returns"]))
+                self._timesteps_total += int(out["steps"])
+            except Exception:
+                idx = i % n_workers
+                # a dead worker fails every ref it holds: restart once
+                if idx not in restarted:
+                    self.workers.restart_worker(idx)
+                    restarted.add(idx)
+                rewards[i] = np.nan
+        # failed evaluations contribute the mean (no gradient pull); if
+        # every evaluation failed this round, zero out so the rank update
+        # is a no-op instead of poisoning theta with NaN
+        if np.isnan(rewards).all():
+            rewards = np.zeros_like(rewards)
+        elif np.isnan(rewards).any():
+            rewards = np.where(np.isnan(rewards),
+                               np.nanmean(rewards), rewards)
+        return rewards
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: ESConfig = self.config
+        n_pairs = max(cfg.candidates_per_iteration // 2, 1)
+        eps = self._perturbations(n_pairs)
+        candidates = []
+        for e in eps:
+            candidates.append(self.theta + cfg.noise_stdev * e)
+            candidates.append(self.theta - cfg.noise_stdev * e)
+        rewards = self._evaluate(candidates)
+        r_pos, r_neg = rewards[0::2], rewards[1::2]
+        shaped = centered_ranks(rewards)
+        s_pos, s_neg = shaped[0::2], shaped[1::2]
+        grad = ((s_pos - s_neg)[:, None] * eps).sum(0) / (
+            n_pairs * cfg.noise_stdev)
+        self.theta = ((1.0 - cfg.l2_coeff) * self.theta
+                      + cfg.step_size * grad)
+        # keep the workers' default policy on the new mean for get_metrics
+        self.workers.sync_weights(self.get_weights())
+        return {"info": {
+            "reward_mean_candidates": float(rewards.mean()),
+            "reward_best_candidate": float(rewards.max()),
+            "grad_norm": float(np.linalg.norm(grad)),
+            "theta_norm": float(np.linalg.norm(self.theta))},
+            "episode_reward_mean_candidates": float(
+                np.maximum(r_pos, r_neg).mean())}
+
+    def _collect_episode_metrics(self) -> Dict[str, Any]:
+        """ES rollouts happen via evaluate_rollout (no persistent episode
+        stats on workers) — score the current mean instead."""
+        import ray_tpu
+        try:
+            out = ray_tpu.get(
+                self.workers.workers[0].evaluate_rollout.remote(
+                    self.get_weights(), n_episodes=2), timeout=120.0)
+            rewards = out["returns"]
+        except Exception:
+            return {"episode_reward_mean": float("nan"),
+                    "episode_len_mean": float("nan"), "episodes_total": 0}
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episode_reward_max": float(np.max(rewards)),
+                "episode_reward_min": float(np.min(rewards)),
+                "episode_len_mean": float(out["steps"] / len(rewards)),
+                "episodes_total": len(rewards)}
+
+
+class ARS(ES):
+    def training_step(self) -> Dict[str, Any]:
+        cfg: ARSConfig = self.config
+        n_pairs = max(cfg.candidates_per_iteration // 2, 1)
+        eps = self._perturbations(n_pairs)
+        candidates = []
+        for e in eps:
+            candidates.append(self.theta + cfg.noise_stdev * e)
+            candidates.append(self.theta - cfg.noise_stdev * e)
+        rewards = self._evaluate(candidates)
+        r_pos, r_neg = rewards[0::2], rewards[1::2]
+        # top-k directions by best-of-pair (ars.py)
+        k = min(cfg.top_k, n_pairs)
+        order = np.argsort(-np.maximum(r_pos, r_neg))[:k]
+        sel = np.concatenate([r_pos[order], r_neg[order]])
+        sigma_r = max(float(sel.std()), 1e-6)
+        grad = ((r_pos[order] - r_neg[order])[:, None]
+                * eps[order]).sum(0) / (k * sigma_r)
+        self.theta = self.theta + cfg.step_size * grad
+        self.workers.sync_weights(self.get_weights())
+        return {"info": {
+            "reward_mean_candidates": float(rewards.mean()),
+            "reward_best_candidate": float(rewards.max()),
+            "sigma_r": sigma_r,
+            "grad_norm": float(np.linalg.norm(grad))},
+            "episode_reward_mean_candidates": float(
+                np.maximum(r_pos, r_neg).mean())}

@@ -17,10 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rl import models as M
 from ray_tpu.rl import sample_batch as SB
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rl.env import Box, make_env
 
 
 def vtrace(target_logp, behavior_logp, rewards, values, bootstrap_value,
@@ -67,27 +65,13 @@ class ImpalaConfig(AlgorithmConfig):
 class Impala(Algorithm):
     def setup_learner(self) -> None:
         cfg: ImpalaConfig = self.config
-        probe = make_env(cfg.env_spec)
-        continuous = isinstance(probe.action_space, Box)
-        act_dim = int(np.prod(probe.action_space.shape)) if continuous \
-            else probe.action_space.n
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        probe.close()
-        self.model = M.ActorCritic(action_dim=act_dim,
-                                   hidden=tuple(cfg.hidden),
-                                   continuous=continuous)
-        self.params = self.model.init(
-            jax.random.PRNGKey(cfg.seed or 0),
-            jnp.zeros((1, obs_dim)))["params"]
+        self.model, self.params, _, logp_fn, ent_fn = \
+            self.init_actor_critic()
         self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
                               optax.rmsprop(cfg.lr, decay=0.99))
         self.opt_state = self.tx.init(self.params)
         self._inflight: Dict[Any, int] = {}   # ref -> worker index
 
-        if continuous:
-            logp_fn, ent_fn = M.diag_gaussian_logp, M.diag_gaussian_entropy
-        else:
-            logp_fn, ent_fn = M.categorical_logp, M.categorical_entropy
         model, gamma = self.model, cfg.gamma
         vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
         rho_bar, c_bar = cfg.vtrace_rho_bar, cfg.vtrace_c_bar
@@ -158,8 +142,7 @@ class Impala(Algorithm):
                 fragment = ray_tpu.get(ref, timeout=30.0)
             except Exception:
                 # worker died mid-fragment: replace it and move on
-                self.workers.workers[idx] = self.workers._make(idx)
-                self.workers.num_restarts += 1
+                self.workers.restart_worker(idx, self.get_weights())
                 self._submit(idx)
                 continue
             batch = {k: jnp.asarray(v) for k, v in fragment.items()}

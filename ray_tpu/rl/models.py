@@ -131,6 +131,21 @@ def squashed_sample_logp(rng, mean, log_std):
     return act, logp
 
 
+class DeterministicActor(nn.Module):
+    """DDPG/TD3 actor: MLP → tanh, output in [-1, 1]^action_dim (cf.
+    reference rllib/algorithms/ddpg/ddpg_torch_model.py policy head)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"pi_{i}")(x))
+        return jnp.tanh(nn.Dense(self.action_dim, name="pi_out")(x))
+
+
 class ContinuousQ(nn.Module):
     """Q(s, a) tower for SAC twin critics."""
 

@@ -1,0 +1,92 @@
+"""PG: vanilla policy gradient (REINFORCE with value-function baseline).
+
+Analog of /root/reference/rllib/algorithms/pg/pg.py (+ pg_torch_policy.py:
+loss = -logp * advantages, no clipping, single pass). The simplest
+on-policy algorithm; kept for parity and as the reference point for the
+actor-critic family. TPU-native like PPO: the update is one jitted step
+over the mesh's data axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = PG
+        self.lr = 4e-4
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.num_sgd_iter = 1          # single pass: on-policy REINFORCE
+        self.train_batch_size = 2000
+
+
+class PG(Algorithm):
+    def setup_learner(self) -> None:
+        cfg: PGConfig = self.config
+        self.model, params, _, logp_fn, ent_fn = self.init_actor_critic()
+        self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                              optax.adam(cfg.lr))
+        self.build_learner_mesh()
+        self.params = jax.device_put(params, self.repl_sharding)
+        self.opt_state = jax.device_put(self.tx.init(params),
+                                        self.repl_sharding)
+        model, tx = self.model, self.tx
+        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+
+        def loss_fn(params, batch):
+            logits, values = model.apply({"params": params}, batch[SB.OBS])
+            logp = logp_fn(logits, batch[SB.ACTIONS])
+            adv = batch[SB.ADVANTAGES]
+            adv = (adv - adv.mean()) / jnp.maximum(adv.std(), 1e-4)
+            pg_loss = -(logp * adv).mean()
+            vf_loss = 0.5 * jnp.square(
+                values - batch[SB.VALUE_TARGETS]).mean()
+            entropy = ent_fn(logits).mean()
+            total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        @jax.jit
+        def sgd_step(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._sgd_step = sgd_step
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.device_put(
+            jax.tree.map(jnp.asarray, weights), self.repl_sharding)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: PGConfig = self.config
+        train_batch = self.gather_on_policy_batch(cfg.train_batch_size)
+        n = self.round_minibatch(train_batch.count)
+        device_batch = self.stage_batch(
+            train_batch.slice(0, n),
+            (SB.OBS, SB.ACTIONS, SB.ADVANTAGES, SB.VALUE_TARGETS))
+        aux: Dict[str, Any] = {}
+        for _ in range(cfg.num_sgd_iter):
+            self.params, self.opt_state, aux = self._sgd_step(
+                self.params, self.opt_state, device_batch)
+        self.workers.sync_weights(self.get_weights())
+        info = {k: float(v) for k, v in aux.items()}
+        info["train_batch_size"] = train_batch.count
+        return {"info": info}

@@ -137,6 +137,61 @@ class QPolicy:
         self.params = jax.tree.map(jnp.asarray, weights)
 
 
+class DDPGPolicy:
+    """Deterministic policy + additive Gaussian exploration noise for
+    DDPG/TD3 rollouts (cf. reference
+    rllib/algorithms/ddpg/ddpg_torch_policy.py exploration:
+    ornstein-uhlenbeck/gaussian; we use the TD3 default of plain Gaussian
+    scaled to the action range). Same compute_actions triple as JaxPolicy.
+    """
+
+    def __init__(self, observation_space, action_space,
+                 hidden=(256, 256), seed: int = 0,
+                 exploration_noise: float = 0.1):
+        if not isinstance(action_space, Box):
+            raise ValueError("DDPGPolicy requires a continuous action space")
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.noise_scale = float(exploration_noise)
+        act_dim = int(np.prod(action_space.shape))
+        self.model = M.DeterministicActor(action_dim=act_dim,
+                                          hidden=tuple(hidden))
+        obs_dim = int(np.prod(observation_space.shape))
+        self._rng = jax.random.PRNGKey(seed)
+        self.params = self.model.init(
+            self._rng, jnp.zeros((1, obs_dim)))["params"]
+        self._low = np.asarray(action_space.low, np.float32).reshape(-1)
+        self._high = np.asarray(action_space.high, np.float32).reshape(-1)
+
+        @jax.jit
+        def _act(params, obs):
+            return self.model.apply({"params": params}, obs)
+
+        self._act = _act
+
+    def set_noise_scale(self, scale: float) -> None:
+        self.noise_scale = float(scale)
+
+    def compute_actions(self, obs: np.ndarray, *, explore: bool = True
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        obs = jnp.asarray(obs)
+        act = np.asarray(self._act(self.params, obs))
+        if explore and self.noise_scale > 0.0:
+            self._rng, key = jax.random.split(self._rng)
+            act = act + self.noise_scale * np.asarray(
+                jax.random.normal(key, act.shape))
+            act = np.clip(act, -1.0, 1.0)
+        scaled = self._low + (act + 1.0) * 0.5 * (self._high - self._low)
+        return scaled, np.zeros(act.shape[0], np.float32), \
+            np.zeros(act.shape[0], np.float32)
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
 class SACPolicy:
     """Stochastic squashed-Gaussian policy for SAC rollouts (CPU side).
 

@@ -16,11 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rl import models as M
 from ray_tpu.rl import sample_batch as SB
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rl.env import Box, make_env
-from ray_tpu.rl.sample_batch import SampleBatch
 
 
 class PPOConfig(AlgorithmConfig):
@@ -38,18 +35,8 @@ class PPOConfig(AlgorithmConfig):
 class PPO(Algorithm):
     def setup_learner(self) -> None:
         cfg: PPOConfig = self.config
-        probe = make_env(cfg.env_spec)
-        continuous = isinstance(probe.action_space, Box)
-        act_dim = int(np.prod(probe.action_space.shape)) if continuous \
-            else probe.action_space.n
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        probe.close()
-        self.model = M.ActorCritic(action_dim=act_dim,
-                                   hidden=tuple(cfg.hidden),
-                                   continuous=continuous)
-        self.continuous = continuous
-        params = self.model.init(jax.random.PRNGKey(cfg.seed or 0),
-                                 jnp.zeros((1, obs_dim)))["params"]
+        self.model, params, self.continuous, logp_fn, ent_fn = \
+            self.init_actor_critic()
         self.tx = optax.chain(
             optax.clip_by_global_norm(cfg.grad_clip),
             optax.adam(cfg.lr))
@@ -60,11 +47,6 @@ class PPO(Algorithm):
         self.opt_state = jax.device_put(self.tx.init(params),
                                         self.repl_sharding)
         self.params = params
-
-        if continuous:
-            logp_fn, ent_fn = M.diag_gaussian_logp, M.diag_gaussian_entropy
-        else:
-            logp_fn, ent_fn = M.categorical_logp, M.categorical_entropy
         model = self.model
         clip, vf_clip = cfg.clip_param, cfg.vf_clip_param
         vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
@@ -114,14 +96,7 @@ class PPO(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         cfg: PPOConfig = self.config
         # 1. synchronous parallel sample (rollout_ops.py:21)
-        batches = self.workers.foreach_worker("sample")
-        train_batch = SampleBatch.concat_samples(batches)
-        while train_batch.count < cfg.train_batch_size:
-            more = self.workers.foreach_worker("sample")
-            if not more:
-                break
-            train_batch = SampleBatch.concat_samples([train_batch] + more)
-        self._timesteps_total += train_batch.count
+        train_batch = self.gather_on_policy_batch(cfg.train_batch_size)
 
         # 2. minibatch SGD epochs on the mesh (train_ops.py:26)
         mb = self.round_minibatch(cfg.sgd_minibatch_size)

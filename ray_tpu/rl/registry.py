@@ -1,0 +1,124 @@
+"""Algorithm registry: name → (Algorithm, AlgorithmConfig).
+
+Analog of /root/reference/rllib/algorithms/registry.py (get_algorithm_class)
+— the string lookup used by the CLI, Tune experiment specs, and tests.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Type
+
+
+def get_algorithm_class(name: str, return_config: bool = False):
+    """Look up an algorithm by its registry name (case-insensitive)."""
+    key = name.lower().replace("-", "").replace("_", "")
+    try:
+        algo_cls, cfg_cls = _REGISTRY[key]()
+    except KeyError:
+        raise ValueError(
+            f"unknown algorithm {name!r}; known: {sorted(_REGISTRY)}")
+    if return_config:
+        return algo_cls, cfg_cls
+    return algo_cls
+
+
+def _ppo():
+    from ray_tpu.rl.ppo import PPO, PPOConfig
+    return PPO, PPOConfig
+
+
+def _impala():
+    from ray_tpu.rl.impala import Impala, ImpalaConfig
+    return Impala, ImpalaConfig
+
+
+def _appo():
+    from ray_tpu.rl.appo import APPO, APPOConfig
+    return APPO, APPOConfig
+
+
+def _dqn():
+    from ray_tpu.rl.dqn import DQN, DQNConfig
+    return DQN, DQNConfig
+
+
+def _simple_q():
+    from ray_tpu.rl.simple_q import SimpleQ, SimpleQConfig
+    return SimpleQ, SimpleQConfig
+
+
+def _sac():
+    from ray_tpu.rl.sac import SAC, SACConfig
+    return SAC, SACConfig
+
+
+def _ddpg():
+    from ray_tpu.rl.ddpg import DDPG, DDPGConfig
+    return DDPG, DDPGConfig
+
+
+def _td3():
+    from ray_tpu.rl.ddpg import TD3, TD3Config
+    return TD3, TD3Config
+
+
+def _pg():
+    from ray_tpu.rl.pg import PG, PGConfig
+    return PG, PGConfig
+
+
+def _a2c():
+    from ray_tpu.rl.a2c import A2C, A2CConfig
+    return A2C, A2CConfig
+
+
+def _a3c():
+    from ray_tpu.rl.a2c import A3C, A3CConfig
+    return A3C, A3CConfig
+
+
+def _bc():
+    from ray_tpu.rl.offline import BC, BCConfig
+    return BC, BCConfig
+
+
+def _marwil():
+    from ray_tpu.rl.offline import MARWIL, MARWILConfig
+    return MARWIL, MARWILConfig
+
+
+def _cql():
+    from ray_tpu.rl.cql import CQL, CQLConfig
+    return CQL, CQLConfig
+
+
+def _es():
+    from ray_tpu.rl.es import ES, ESConfig
+    return ES, ESConfig
+
+
+def _ars():
+    from ray_tpu.rl.es import ARS, ARSConfig
+    return ARS, ARSConfig
+
+
+_REGISTRY = {
+    "ppo": _ppo,
+    "impala": _impala,
+    "appo": _appo,
+    "dqn": _dqn,
+    "simpleq": _simple_q,
+    "sac": _sac,
+    "ddpg": _ddpg,
+    "td3": _td3,
+    "pg": _pg,
+    "a2c": _a2c,
+    "a3c": _a3c,
+    "bc": _bc,
+    "marwil": _marwil,
+    "cql": _cql,
+    "es": _es,
+    "ars": _ars,
+}
+
+POLICIES: Tuple[str, ...] = tuple(sorted(_REGISTRY))

@@ -31,6 +31,7 @@ class RolloutWorker:
         import jax
         jax.config.update("jax_platforms", "cpu")
         self.worker_index = worker_index
+        self._env_spec = env_spec
         seed = (seed if seed is not None else 1234) + worker_index * 1000
         self.vec = VectorEnv(env_spec, num_envs, seed=seed)
         if policy == "q":
@@ -38,6 +39,11 @@ class RolloutWorker:
             self.policy = QPolicy(self.vec.observation_space,
                                   self.vec.action_space, hidden=hidden,
                                   seed=seed, **(policy_kwargs or {}))
+        elif policy == "ddpg":
+            from ray_tpu.rl.policy import DDPGPolicy
+            self.policy = DDPGPolicy(self.vec.observation_space,
+                                     self.vec.action_space, hidden=hidden,
+                                     seed=seed, **(policy_kwargs or {}))
         elif policy == "sac":
             from ray_tpu.rl.policy import SACPolicy
             self.policy = SACPolicy(self.vec.observation_space,
@@ -186,6 +192,32 @@ class RolloutWorker:
         out = {k: np.concatenate(v) if np.asarray(v[0]).ndim > 1
                else np.stack(v).reshape(-1) for k, v in cols.items()}
         return SampleBatch(out)
+
+    def evaluate_rollout(self, weights, *, n_episodes: int = 1,
+                         explore: bool = False,
+                         max_steps: int = 1000) -> Dict[str, Any]:
+        """Episode returns + env-step count under ``weights`` (ES/ARS
+        fitness evaluation — cf. reference rllib/algorithms/es/es.py
+        Worker.do_rollouts)."""
+        from ray_tpu.rl.env import make_env
+        self.policy.set_weights(weights)
+        env = make_env(self._env_spec)
+        returns = []
+        total_steps = 0
+        for ep in range(n_episodes):
+            obs, _ = env.reset(seed=self.worker_index * 7919 + ep)
+            total, done, steps = 0.0, False, 0
+            while not done and steps < max_steps:
+                a, _, _ = self.policy.compute_actions(
+                    np.asarray(obs, np.float32)[None], explore=explore)
+                obs, r, term, trunc, _ = env.step(a[0])
+                total += r
+                done = term or trunc
+                steps += 1
+            returns.append(float(total))
+            total_steps += steps
+        env.close()
+        return {"returns": returns, "steps": total_steps}
 
     def get_metrics(self) -> List[Dict[str, float]]:
         out, self._completed = self._completed, []

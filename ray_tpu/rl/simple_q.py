@@ -1,0 +1,25 @@
+"""SimpleQ: the minimal DQN variant (no double-Q, no dueling, no PER).
+
+Analog of /root/reference/rllib/algorithms/simple_q/simple_q.py — kept as
+a distinct entry point because RLlib treats it as the pedagogical baseline
+the full DQN is measured against. Implementation shares the DQN learner
+with the extensions switched off.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rl.dqn import DQN, DQNConfig
+
+
+class SimpleQConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = SimpleQ
+        self.double_q = False
+        self.dueling = False
+        self.prioritized_replay = False
+        self.target_update_freq = 500
+
+
+class SimpleQ(DQN):
+    pass

@@ -47,6 +47,24 @@ class WorkerSet:
                 self.num_restarts += 1
         return out
 
+    def restart_worker(self, index: int, weights=None) -> bool:
+        """Replace a dead worker in place (honors recreate_failed_workers;
+        returns False and raises if recreation is disabled). Pushes
+        ``weights`` to the replacement so its first fragment is on-policy.
+        """
+        if not self._recreate:
+            raise RuntimeError(
+                f"rollout worker {index} died and "
+                "recreate_failed_workers=False")
+        self.workers[index] = self._make(index)
+        self.num_restarts += 1
+        if weights is not None:
+            try:
+                self.workers[index].set_weights.remote(weights)
+            except Exception:
+                pass
+        return True
+
     def sync_weights(self, weights) -> None:
         import ray_tpu
         wref = ray_tpu.put(weights)
