@@ -1,11 +1,21 @@
-"""Model families: flagship GPT (LLaMA-style) LM, ResNet vision models."""
+"""Model families: flagship GPT (LLaMA-style) LM, encoder family (BERT,
+ViT), T5 encoder-decoder, ResNet vision models."""
 
+from ray_tpu.models.bert import BERT, masked_batch, mlm_loss_fn
 from ray_tpu.models.configs import PRESETS, TransformerConfig, get_config
+from ray_tpu.models.encoder import Encoder, EncoderBlock
 from ray_tpu.models.generate import Generator, generate, sample_logits
 from ray_tpu.models.gpt import GPT
 from ray_tpu.models.resnet import (ResNet, ResNet18, ResNet34, ResNet50,
                                    ResNet101)
+from ray_tpu.models.t5 import (T5, greedy_decode, seq2seq_loss_fn,
+                               t5_init_inputs)
+from ray_tpu.models.vit import VIT_PRESETS, ViT, ViTConfig, get_vit_config
 
 __all__ = ["GPT", "TransformerConfig", "PRESETS", "get_config",
            "Generator", "generate", "sample_logits",
-           "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101"]
+           "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
+           "BERT", "mlm_loss_fn", "masked_batch",
+           "Encoder", "EncoderBlock",
+           "T5", "seq2seq_loss_fn", "t5_init_inputs", "greedy_decode",
+           "ViT", "ViTConfig", "VIT_PRESETS", "get_vit_config"]

@@ -1,0 +1,97 @@
+"""BERT-family masked-LM encoder.
+
+The reference serves/trains BERT through HF wrappers
+(/root/reference/python/ray/train/huggingface/huggingface_trainer.py);
+here it is native: learned positions + shared bidirectional Encoder (same
+sharded kernels as the LM) + tied-embedding MLM head. Padding flows as a
+key mask into the attention op. ``mlm_loss_fn`` plugs into
+make_sharded_train; ``masked_batch`` builds BERT's 80/10/10 corruption.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ray_tpu.models.configs import TransformerConfig
+from ray_tpu.models.encoder import Encoder, learned_positions
+from ray_tpu.models.gpt import RMSNorm, _dense
+from ray_tpu.parallel.sharding import LOGICAL_RULES, ShardingRules, with_sharding
+
+IGNORE = -100                      # label value for unmasked positions
+
+
+class BERT(nn.Module):
+    """__call__(tokens [B, S], attn_mask [B, S]?) -> logits [B, S, vocab]."""
+
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+    rules: ShardingRules = LOGICAL_RULES
+
+    @nn.compact
+    def __call__(self, tokens, attn_mask=None):
+        cfg = self.cfg
+        embed = self.param(
+            "embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        x = x + learned_positions(cfg, self, cfg.max_seq_len)[
+            : tokens.shape[1]].astype(cfg.dtype)
+        if self.mesh is not None:
+            x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),
+                              self.rules)
+        mask = None if attn_mask is None else attn_mask.astype(jnp.bool_)
+        x = Encoder(cfg, self.mesh, self.rules, name="encoder")(x, mask)
+        x = _dense(cfg.d_model, ("embed", "act_embed"), "mlm_transform",
+                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        x = nn.gelu(x)
+        x = RMSNorm(cfg.norm_eps, name="mlm_norm")(x)
+        logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))
+        return logits.astype(jnp.float32)
+
+
+def mlm_loss_fn(apply_fn, params, batch: Dict[str, jax.Array],
+                z_loss: float = 0.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Masked-LM loss: batch {"tokens", "labels" (IGNORE=-100 elsewhere),
+    "attn_mask"?}. Plugs into make_sharded_train(loss_fn=...)."""
+    logits = apply_fn({"params": params}, batch["tokens"],
+                      batch.get("attn_mask"))
+    labels = batch["labels"]
+    valid = labels != IGNORE
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(valid.sum(), 1)
+    loss = jnp.where(valid, nll, 0.0).sum() / denom
+    if z_loss:
+        zl = jnp.square(jax.scipy.special.logsumexp(logits, axis=-1))
+        loss = loss + z_loss * jnp.where(valid, zl, 0.0).sum() / denom
+    acc = (jnp.where(valid, jnp.argmax(logits, -1) == safe, False).sum()
+           / denom)
+    return loss, {"loss": loss, "mlm_accuracy": acc,
+                  "masked_tokens": denom}
+
+
+def masked_batch(tokens: np.ndarray, vocab_size: int, *,
+                 mask_token: int, mask_prob: float = 0.15,
+                 seed: int = 0) -> Dict[str, np.ndarray]:
+    """BERT corruption: of the selected 15%, 80% -> [MASK], 10% -> random,
+    10% unchanged; labels carry the original ids, IGNORE elsewhere."""
+    rng = np.random.default_rng(seed)
+    tokens = np.asarray(tokens)
+    sel = rng.random(tokens.shape) < mask_prob
+    labels = np.where(sel, tokens, IGNORE)
+    r = rng.random(tokens.shape)
+    corrupted = tokens.copy()
+    corrupted[sel & (r < 0.8)] = mask_token
+    rand_ids = rng.integers(0, vocab_size, tokens.shape)
+    swap = sel & (r >= 0.8) & (r < 0.9)
+    corrupted[swap] = rand_ids[swap]
+    return {"tokens": corrupted, "labels": labels}

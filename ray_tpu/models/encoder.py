@@ -1,0 +1,97 @@
+"""Shared bidirectional transformer encoder stack (ViT / BERT / T5 body).
+
+The reference frames these families through torch/HF integrations
+(/root/reference/python/ray/train/huggingface/, air examples); here they
+are first-class flax modules sharing the decoder's TPU design: logical
+axis names on every kernel (DP/FSDP/TP from the one rule table in
+ray_tpu/parallel/sharding.py), bf16 activations, lax.scan over layers,
+optional remat, and attention dispatched to the same kernels
+(ray_tpu/ops/attention.py) — just non-causal.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.models.configs import TransformerConfig
+from ray_tpu.models.gpt import MLP, RMSNorm, _dense, stack_layers
+from ray_tpu.ops.attention import attention, repeat_kv
+from ray_tpu.parallel.sharding import LOGICAL_RULES, ShardingRules, with_sharding
+
+
+class EncoderAttention(nn.Module):
+    """Bidirectional (optionally cross-) attention with logical sharding."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, kv=None, mask=None):
+        cfg = self.cfg
+        h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        kv_in = x if kv is None else kv
+        q = _dense((h, hd), ("embed", "heads", "head_dim"), "wq",
+                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        k = _dense((kvh, hd), ("embed", "kv", "head_dim"), "wk",
+                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)(kv_in)
+        v = _dense((kvh, hd), ("embed", "kv", "head_dim"), "wv",
+                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)(kv_in)
+        if kvh != h:
+            k = repeat_kv(k, h // kvh)
+            v = repeat_kv(v, h // kvh)
+        impl = cfg.attention_impl
+        if impl in ("ring", "ulysses"):       # context axes are causal-LM
+            impl = "auto"                      # machinery; encoders use core
+        out = attention(q, k, v, causal=False, impl=impl, mask=mask)
+        out = out.reshape(*out.shape[:2], h * hd)
+        return _dense(cfg.d_model, ("heads_embed", "embed"), "wo",
+                      dtype=cfg.dtype, param_dtype=cfg.param_dtype)(out)
+
+
+class EncoderBlock(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+    rules: ShardingRules = LOGICAL_RULES
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        y = RMSNorm(cfg.norm_eps, name="attn_norm")(x)
+        y = EncoderAttention(cfg, name="attn")(y, mask=mask)
+        x = x + y
+        y = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
+        x = x + MLP(cfg, name="mlp")(y)
+        if self.mesh is not None:
+            x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),
+                              self.rules)
+        return x
+
+
+class Encoder(nn.Module):
+    """Stack of bidirectional blocks; input is an embedded sequence."""
+
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+    rules: ShardingRules = LOGICAL_RULES
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        x = stack_layers(EncoderBlock, cfg,
+                         dict(mesh=self.mesh, rules=self.rules),
+                         x, (mask,))
+        return RMSNorm(cfg.norm_eps, name="final_norm")(x)
+
+
+def learned_positions(cfg: TransformerConfig, module: nn.Module,
+                      length: int) -> jax.Array:
+    """Learned absolute position table (BERT/ViT style)."""
+    return module.param(
+        "pos_embed",
+        nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), (None, "embed")),
+        (length, cfg.d_model), cfg.param_dtype)

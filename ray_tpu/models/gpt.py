@@ -49,6 +49,55 @@ class RMSNorm(nn.Module):
         return rms_norm(x, scale, self.eps)
 
 
+class MLP(nn.Module):
+    """SwiGLU feed-forward (shared by the decoder, encoder, and T5)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, y):
+        cfg = self.cfg
+        gate = _dense(cfg.d_ff, ("embed", "mlp"), "w_gate",
+                      dtype=cfg.dtype, param_dtype=cfg.param_dtype)(y)
+        up = _dense(cfg.d_ff, ("embed", "mlp"), "w_up",
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype)(y)
+        return _dense(cfg.d_model, ("mlp", "embed"), "w_down",
+                      dtype=cfg.dtype, param_dtype=cfg.param_dtype)(
+            nn.silu(gate) * up)
+
+
+def stack_layers(block_cls, cfg: TransformerConfig, ctor_kwargs, x,
+                 call_args, *, remat: Optional[bool] = None,
+                 cache: bool = False, name: str = "blocks"):
+    """Apply cfg.n_layers blocks under the repo's standard stacking: remat
+    per cfg.remat (HBM<->FLOPs), one ``lax.scan``'d block when
+    cfg.scan_layers (O(1) compile time in depth). Must be called from a
+    parent's ``@nn.compact`` __call__. Blocks are invoked ``mdl(x, *call_args)``.
+    """
+    if remat is None:
+        remat = cfg.remat
+    if remat:
+        block_cls = nn.remat(
+            block_cls, prevent_cse=False,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if cfg.scan_layers:
+        variable_axes = {"params": 0, "intermediates": 0}
+        if cache:
+            variable_axes["cache"] = 0
+        x, _ = nn.scan(
+            lambda mdl, carry, _: (mdl(carry, *call_args), None),
+            variable_axes=variable_axes,
+            split_rngs={"params": True},
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: None},
+        )(block_cls(cfg, **ctor_kwargs, name=name), x, None)
+    else:
+        for i in range(cfg.n_layers):
+            x = block_cls(cfg, **ctor_kwargs,
+                          name=f"{name[:-1]}_{i}")(x, *call_args)
+    return x
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
@@ -146,13 +195,7 @@ class Block(nn.Module):
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        name="moe")(y)
         else:
-            gate = _dense(cfg.d_ff, ("embed", "mlp"), "w_gate",
-                          dtype=cfg.dtype, param_dtype=cfg.param_dtype)(y)
-            up = _dense(cfg.d_ff, ("embed", "mlp"), "w_up",
-                        dtype=cfg.dtype, param_dtype=cfg.param_dtype)(y)
-            y = _dense(cfg.d_model, ("mlp", "embed"), "w_down",
-                       dtype=cfg.dtype, param_dtype=cfg.param_dtype)(
-                nn.silu(gate) * up)
+            y = MLP(cfg, name="mlp")(y)
         x = x + y
         if self.mesh is not None and not self.decode:
             x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),
@@ -183,25 +226,11 @@ class GPT(nn.Module):
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                     cfg.rope_theta)
 
-        block_cls = Block
-        if cfg.remat and not self.decode:
-            block_cls = nn.remat(
-                Block, prevent_cse=False,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-
-        if cfg.scan_layers:
-            x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, cos, sin, positions), None),
-                variable_axes={"params": 0, "cache": 0, "intermediates": 0},
-                split_rngs={"params": True},
-                length=cfg.n_layers,
-                metadata_params={nn.PARTITION_NAME: None},
-            )(block_cls(cfg, self.mesh, self.rules, self.decode,
-                        name="blocks"), x, None)
-        else:
-            for i in range(cfg.n_layers):
-                x = block_cls(cfg, self.mesh, self.rules, self.decode,
-                              name=f"block_{i}")(x, cos, sin, positions)
+        x = stack_layers(
+            Block, cfg,
+            dict(mesh=self.mesh, rules=self.rules, decode=self.decode),
+            x, (cos, sin, positions),
+            remat=cfg.remat and not self.decode, cache=True)
 
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if cfg.tie_embeddings:

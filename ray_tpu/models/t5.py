@@ -1,0 +1,155 @@
+"""T5-style encoder-decoder for seq2seq.
+
+The reference reaches seq2seq via HF Transformers wrappers
+(/root/reference/python/ray/train/huggingface/); this is the native
+TPU-first version: shared bidirectional Encoder for the source, a decoder
+whose blocks are causal self-attention (RoPE, same kernels as the LM) +
+cross-attention over the encoder memory + the shared SwiGLU MLP, all
+carrying the logical sharding axes of ray_tpu/parallel/sharding.py. RoPE
+replaces T5's relative-position bias — same role, better fit for the fused
+attention kernels. ``seq2seq_loss_fn`` plugs into make_sharded_train.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.models.configs import TransformerConfig
+from ray_tpu.models.encoder import Encoder, EncoderAttention
+from ray_tpu.models.gpt import MLP, Attention, RMSNorm, stack_layers
+from ray_tpu.ops.layers import rope_frequencies
+from ray_tpu.ops.losses import softmax_cross_entropy
+from ray_tpu.parallel.sharding import LOGICAL_RULES, ShardingRules, with_sharding
+
+
+class DecoderBlock(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+    rules: ShardingRules = LOGICAL_RULES
+
+    @nn.compact
+    def __call__(self, x, memory, cos, sin, enc_mask=None):
+        cfg = self.cfg
+        y = RMSNorm(cfg.norm_eps, name="self_norm")(x)
+        y = Attention(cfg, self.mesh, self.rules, name="self_attn")(
+            y, cos, sin)
+        x = x + y
+        y = RMSNorm(cfg.norm_eps, name="cross_norm")(x)
+        y = EncoderAttention(cfg, name="cross_attn")(y, kv=memory,
+                                                     mask=enc_mask)
+        x = x + y
+        y = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
+        x = x + MLP(cfg, name="mlp")(y)
+        if self.mesh is not None:
+            x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),
+                              self.rules)
+        return x
+
+
+class T5(nn.Module):
+    """__call__(enc_tokens [B, Se], dec_tokens [B, Sd], enc_mask [B, Se]?)
+    -> logits [B, Sd, vocab]. One shared vocab/embedding (t5 convention).
+
+    ``memory=...`` skips the encoder (decode loops encode once, then feed
+    the cached memory); ``return_memory=True`` returns the encoder output
+    instead of logits. Both are Python-level (static) switches.
+    """
+
+    cfg: TransformerConfig
+    mesh: Optional[Mesh] = None
+    rules: ShardingRules = LOGICAL_RULES
+
+    @nn.compact
+    def __call__(self, enc_tokens, dec_tokens, enc_mask=None,
+                 memory=None, return_memory: bool = False):
+        cfg = self.cfg
+        embed = self.param(
+            "embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+
+        mask = None if enc_mask is None else enc_mask.astype(jnp.bool_)
+        if memory is None:
+            src = jnp.take(embed, enc_tokens, axis=0).astype(cfg.dtype)
+            if self.mesh is not None:
+                src = with_sharding(self.mesh, src,
+                                    ("batch", "seq", "act_embed"),
+                                    self.rules)
+            memory = Encoder(cfg, self.mesh, self.rules, name="encoder")(
+                src, mask)
+        if return_memory:
+            return memory
+
+        x = jnp.take(embed, dec_tokens, axis=0).astype(cfg.dtype)
+        if self.mesh is not None:
+            x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),
+                              self.rules)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+        x = stack_layers(DecoderBlock, cfg,
+                         dict(mesh=self.mesh, rules=self.rules),
+                         x, (memory, cos, sin, mask))
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))
+        return logits.astype(jnp.float32)
+
+
+def seq2seq_loss_fn(apply_fn, params, batch: Dict[str, jax.Array],
+                    z_loss: float = 0.0
+                    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Teacher-forced seq2seq loss: batch {"enc_tokens" [B, Se],
+    "dec_tokens" [B, Sd+1], "enc_mask"?, "dec_mask"? [B, Sd+1]}.
+    Plugs into make_sharded_train(loss_fn=..., init_inputs=t5_init_inputs).
+    """
+    dec = batch["dec_tokens"]
+    inputs, targets = dec[:, :-1], dec[:, 1:]
+    mask = batch.get("dec_mask")
+    if mask is not None:
+        mask = mask[:, 1:].astype(jnp.float32)
+    logits = apply_fn({"params": params}, batch["enc_tokens"], inputs,
+                      batch.get("enc_mask"))
+    loss, denom = softmax_cross_entropy(logits, targets, mask, z_loss)
+    return loss, {"loss": loss, "tokens": denom}
+
+
+def t5_init_inputs(batch):
+    """make_sharded_train init_inputs for T5's (enc, dec) signature."""
+    return (batch["enc_tokens"], batch["dec_tokens"][:, :-1],
+            batch.get("enc_mask"))
+
+
+def greedy_decode(model: T5, variables, enc_tokens, *, max_len: int,
+                  bos_id: int, eos_id: Optional[int] = None,
+                  enc_mask=None):
+    """Greedy decoding with the encoder run once and a fixed-shape decoder
+    buffer (one compile for the whole loop; causal attention makes the
+    trailing zero-padding inert). The flagship KV-cached decode path lives
+    in models/generate.py for the decoder-only family.
+    """
+    b = enc_tokens.shape[0]
+    encode = jax.jit(lambda v, e, m: model.apply(
+        v, e, jnp.zeros((e.shape[0], 1), jnp.int32), m, return_memory=True))
+    step = jax.jit(lambda v, e, d, m, mem, i: jnp.argmax(
+        model.apply(v, e, d, m, memory=mem)[
+            jnp.arange(d.shape[0]), i], axis=-1).astype(jnp.int32))
+
+    memory = encode(variables, enc_tokens, enc_mask)
+    dec = jnp.zeros((b, max_len + 1), jnp.int32).at[:, 0].set(bos_id)
+    finished = jnp.zeros((b,), bool)
+    n_emitted = 0
+    for i in range(max_len):
+        nxt = step(variables, enc_tokens, dec, enc_mask, memory, i)
+        if eos_id is not None:
+            nxt = jnp.where(finished, eos_id, nxt)
+            finished = finished | (nxt == eos_id)
+        dec = dec.at[:, i + 1].set(nxt)
+        n_emitted = i + 1
+        if eos_id is not None and bool(finished.all()):
+            break
+    return dec[:, 1:n_emitted + 1]

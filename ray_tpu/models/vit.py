@@ -1,0 +1,103 @@
+"""ViT: Vision Transformer image classifier.
+
+The reference's vision path is torchvision models driven through Train/AIR
+(/root/reference/doc/source/ray-air/benchmarks.rst GPU image training;
+python/ray/train/torch/). This is the TPU-native counterpart to its
+transformer-based vision models: patchify → shared bidirectional Encoder
+(ray_tpu/models/encoder.py, same sharded kernels as the LM) → mean-pool →
+linear head. Drop-in for make_vision_train (no BatchNorm state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.models.configs import TransformerConfig
+from ray_tpu.models.encoder import Encoder, learned_positions
+from ray_tpu.models.gpt import _dense
+from ray_tpu.parallel.sharding import LOGICAL_RULES, ShardingRules, with_sharding
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    encoder: TransformerConfig = None          # set in __post_init__
+
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: Optional[int] = None
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    remat: bool = False
+    scan_layers: bool = True
+
+    def __post_init__(self):
+        assert self.image_size % self.patch_size == 0
+        if self.encoder is None:
+            self.encoder = TransformerConfig(
+                vocab_size=1,  # unused by the encoder body
+                d_model=self.d_model, n_layers=self.n_layers,
+                n_heads=self.n_heads, d_ff=self.d_ff,
+                max_seq_len=self.num_patches,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                remat=self.remat, scan_layers=self.scan_layers)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+VIT_PRESETS = {
+    "vit-tiny-test": ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                               d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                               dtype=jnp.float32),
+    "vit-s16": ViTConfig(d_model=384, n_layers=12, n_heads=6),
+    "vit-b16": ViTConfig(d_model=768, n_layers=12, n_heads=12),
+    "vit-l16": ViTConfig(d_model=1024, n_layers=24, n_heads=16),
+}
+
+
+def get_vit_config(name: str, **overrides) -> ViTConfig:
+    base = VIT_PRESETS[name]
+    return dataclasses.replace(base, encoder=None, **overrides) \
+        if overrides else base
+
+
+class ViT(nn.Module):
+    """__call__(images [B, H, W, C]) -> logits [B, num_classes]."""
+
+    cfg: ViTConfig
+    mesh: Optional[Mesh] = None
+    rules: ShardingRules = LOGICAL_RULES
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.cfg
+        enc = cfg.encoder
+        p = cfg.patch_size
+        b, hh, ww, c = images.shape
+        # patchify: [B, H/p, W/p, p*p*C] — a reshape, not a conv; the
+        # projection below is then one big MXU matmul
+        x = images.reshape(b, hh // p, p, ww // p, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, cfg.num_patches, p * p * c)
+        x = x.astype(enc.dtype)
+        x = _dense(enc.d_model, ("conv_io", "embed"), "patch_proj",
+                   dtype=enc.dtype, param_dtype=enc.param_dtype)(x)
+        x = x + learned_positions(enc, self, cfg.num_patches).astype(enc.dtype)
+        if self.mesh is not None:
+            x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),
+                              self.rules)
+        x = Encoder(enc, self.mesh, self.rules, name="encoder")(x)
+        x = jnp.mean(x, axis=1)                # mean-pool (no cls token)
+        logits = _dense(cfg.num_classes, ("embed", "vocab"), "head",
+                        dtype=enc.dtype, param_dtype=enc.param_dtype)(x)
+        return logits.astype(jnp.float32)

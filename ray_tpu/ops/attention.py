@@ -39,11 +39,14 @@ def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
 
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                   causal: bool = True, sm_scale: Optional[float] = None,
-                  q_offset: int = 0) -> jax.Array:
+                  q_offset: int = 0,
+                  mask: Optional[jax.Array] = None) -> jax.Array:
     """Reference einsum attention (fp32 logits/softmax, input-dtype output).
 
     ``q_offset``: global position of q[0] relative to k[0] — used by the ring
     attention fallback and by decode (q_len==1 at position offset).
+    ``mask``: optional key-padding mask [B, Kv] (True = attend) or an
+    additive/boolean [B, 1|H, Q, Kv] mask (encoders: BERT/T5 padding).
     """
     *_, q_len, heads, head_dim = q.shape
     kv_len, kv_heads = k.shape[-3], k.shape[-2]
@@ -57,6 +60,15 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         q_pos = jnp.arange(q_len)[:, None] + q_offset
         k_pos = jnp.arange(kv_len)[None, :]
         logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    if mask is not None:
+        if mask.ndim == 2:                      # [B, Kv] key padding
+            # 0/1 integer padding masks are boolean in intent — coerce,
+            # else they'd fall into the additive branch and mask nothing
+            mask = mask.astype(jnp.bool_)[:, None, None, :]
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, NEG_INF)
+        else:
+            logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)
@@ -64,10 +76,13 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
               causal: bool = True, sm_scale: Optional[float] = None,
-              impl: str = "auto") -> jax.Array:
+              impl: str = "auto",
+              mask: Optional[jax.Array] = None) -> jax.Array:
     """Public fused attention entry point (see module docstring)."""
     if impl == "auto":
         impl = "flash" if _on_tpu() else "xla"
+    if impl == "flash" and mask is not None:
+        impl = "xla"       # the Pallas kernel has no padding-mask path
     if impl == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
         heads, kv_heads = q.shape[-2], k.shape[-2]
@@ -76,5 +91,6 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             v = repeat_kv(v, heads // kv_heads)
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     if impl == "xla":
-        return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                             mask=mask)
     raise ValueError(f"unknown attention impl: {impl!r}")

@@ -126,11 +126,14 @@ def make_sharded_train(model: nn.Module,
                        rules: ShardingRules = LOGICAL_RULES,
                        loss_fn: Callable = lm_loss_fn,
                        example_batch: Optional[Dict[str, jax.Array]] = None,
-                       z_loss: Optional[float] = None):
+                       z_loss: Optional[float] = None,
+                       init_inputs: Optional[Callable] = None):
     """Returns (init_fn, step_fn, state_shardings, batch_sharding).
 
     ``init_fn(rng, batch) -> TrainState`` born sharded over ``mesh``;
     ``step_fn(state, batch) -> (state, metrics)`` jitted with donated state.
+    ``init_inputs(batch) -> args tuple`` overrides how model.init is called
+    (default: next-token LM convention, ``batch["tokens"][:, :-1]``).
     """
     optimizer = optimizer or OptimizerConfig()
     tx = optimizer.make()
@@ -138,8 +141,10 @@ def make_sharded_train(model: nn.Module,
         z_loss = getattr(getattr(model, "cfg", None), "z_loss", 0.0)
 
     def build_state(rng, batch) -> TrainState:
-        inputs = batch["tokens"][:, :-1]
-        variables = model.init(rng, inputs)
+        if init_inputs is not None:
+            variables = model.init(rng, *init_inputs(batch))
+        else:
+            variables = model.init(rng, batch["tokens"][:, :-1])
         return TrainState.create(apply_fn=model.apply,
                                  params=variables["params"], tx=tx)
 
