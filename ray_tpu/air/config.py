@@ -80,6 +80,7 @@ class RunConfig:
     stop: Optional[Dict[str, Any]] = None
     verbose: int = 1
     log_to_file: bool = False
+    callbacks: Optional[List[Any]] = None
 
     def __post_init__(self):
         if self.failure_config is None:

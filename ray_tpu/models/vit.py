@@ -66,9 +66,8 @@ VIT_PRESETS = {
 
 
 def get_vit_config(name: str, **overrides) -> ViTConfig:
-    base = VIT_PRESETS[name]
-    return dataclasses.replace(base, encoder=None, **overrides) \
-        if overrides else base
+    # always copy: presets are shared mutable dataclasses
+    return dataclasses.replace(VIT_PRESETS[name], encoder=None, **overrides)
 
 
 class ViT(nn.Module):

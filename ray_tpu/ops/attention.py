@@ -63,7 +63,14 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if mask is not None:
         if mask.ndim == 2:                      # [B, Kv] key padding
             # 0/1 integer padding masks are boolean in intent — coerce,
-            # else they'd fall into the additive branch and mask nothing
+            # else they'd fall into the additive branch and mask nothing.
+            # A float 2-D mask is ambiguous (additive -1e9 convention
+            # would be silently inverted): refuse it loudly.
+            if jnp.issubdtype(mask.dtype, jnp.floating):
+                raise ValueError(
+                    "2-D attention masks must be bool/int key-padding "
+                    "masks (True/1 = attend); pass additive float masks "
+                    "as [B, 1|H, Q, Kv]")
             mask = mask.astype(jnp.bool_)[:, None, None, :]
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, NEG_INF)

@@ -1,21 +1,27 @@
 """ray_tpu.tune: hyperparameter search over trial actors.
 
 Analog of /root/reference/python/ray/tune (SURVEY.md §2.4): Tuner.fit →
-TrialRunner event loop → trial actors; searchers + schedulers (ASHA, PBT,
-median stopping); JSONL/CSV logging; checkpoint-aware exploit/restore.
+TrialRunner event loop → trial actors; searchers (random, grid, TPE/BOHB)
++ schedulers (ASHA, HyperBand, PBT, median stopping); JSONL/CSV/TBX
+logging callbacks; checkpoint-aware exploit/restore; ExperimentAnalysis.
 """
 
 from ray_tpu.air.result import Result  # noqa: F401
+from ray_tpu.tune.analysis import ExperimentAnalysis  # noqa: F401
+from ray_tpu.tune.callback import (Callback, CSVLoggerCallback,  # noqa: F401
+                                   JsonLoggerCallback, TBXLoggerCallback)
 from ray_tpu.tune.sample import (choice, grid_search, loguniform,  # noqa: F401
                                  quniform, randint, randn, sample_from,
                                  uniform)
 from ray_tpu.tune.schedulers import (ASHAScheduler,  # noqa: F401
-                                     FIFOScheduler, MedianStoppingRule,
+                                     FIFOScheduler, HyperBandScheduler,
+                                     MedianStoppingRule,
                                      PopulationBasedTraining,
                                      TrialScheduler)
 from ray_tpu.tune.search import (BasicVariantGenerator,  # noqa: F401
                                  ConcurrencyLimiter, HyperOptStyleSearch,
-                                 RandomSearch, Searcher)
+                                 RandomSearch, Searcher, TPESearcher,
+                                 TuneBOHB)
 from ray_tpu.tune.trial import Trial  # noqa: F401
 from ray_tpu.tune.tuner import (ResultGrid, TuneConfig, TuneError,  # noqa: F401
                                 Tuner)
@@ -25,8 +31,10 @@ __all__ = [
     "uniform", "loguniform", "quniform", "randint", "randn", "choice",
     "sample_from", "grid_search",
     "Searcher", "BasicVariantGenerator", "RandomSearch",
-    "ConcurrencyLimiter", "HyperOptStyleSearch",
+    "ConcurrencyLimiter", "HyperOptStyleSearch", "TPESearcher", "TuneBOHB",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining",
+    "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "Callback", "JsonLoggerCallback", "CSVLoggerCallback",
+    "TBXLoggerCallback", "ExperimentAnalysis",
     "Result",
 ]

@@ -29,6 +29,9 @@ class TrialScheduler:
             self.mode = mode
         return True
 
+    def on_trial_add(self, runner, trial) -> None:
+        """Called when the runner creates a trial (before it starts)."""
+
     def on_trial_result(self, runner, trial,
                         result: Dict[str, Any]) -> str:
         return self.CONTINUE
@@ -103,6 +106,150 @@ class ASHAScheduler(TrialScheduler):
         ordered = sorted(rung, reverse=self.mode == "max")
         k = max(1, int(len(ordered) / self.rf))
         return ordered[k - 1]
+
+
+class _Bracket:
+    """One successive-halving bracket: members climb rung milestones; at
+    each full rung the top 1/eta are promoted, the rest stopped."""
+
+    def __init__(self, milestones: List[int], eta: float):
+        self.milestones = milestones
+        self.eta = eta
+        self.members: List[str] = []                # trial ids
+        self.rung_of: Dict[str, int] = {}           # trial id -> rung idx
+        self.recorded: Dict[int, Dict[str, float]] = {}  # rung -> id -> val
+        self.done: set = set()                      # ids out of the bracket
+        self.promoted: set = set()                  # ids cleared to resume
+        self.closed = False                         # no new members
+        self.completed: set = set()                 # rungs already promoted
+
+    def add(self, trial_id: str) -> None:
+        self.members.append(trial_id)
+        self.rung_of[trial_id] = 0
+
+    def pending(self, rung: int) -> List[str]:
+        rec = self.recorded.get(rung, {})
+        return [m for m in self.members
+                if m not in rec and m not in self.done
+                and self.rung_of.get(m, 0) == rung]
+
+    def record(self, trial_id: str, rung: int, value: float,
+               mode: str) -> Optional[List[str]]:
+        """Record a rung entry, then try to complete the rung."""
+        self.recorded.setdefault(rung, {})[trial_id] = value
+        return self.maybe_complete(rung, mode)
+
+    def maybe_complete(self, rung: int, mode: str) -> Optional[List[str]]:
+        """Promote the rung's top 1/eta exactly once, when every live
+        member has recorded it. Single path for both the result and the
+        early-completion (trial left the bracket) triggers."""
+        rec = self.recorded.get(rung, {})
+        if rung in self.completed or not rec or self.pending(rung):
+            return None
+        self.completed.add(rung)
+        self.closed = True
+        ordered = sorted(rec, key=rec.get, reverse=mode == "max")
+        k = max(1, int(math.ceil(len(ordered) / self.eta)))
+        winners = [m for m in ordered[:k] if m not in self.done]
+        for m in rec:
+            if m in winners:
+                self.rung_of[m] = rung + 1
+                self.promoted.add(m)
+            elif m not in self.done:
+                self.done.add(m)
+        return winners
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand / successive halving (cf. reference
+    tune/schedulers/hyperband.py; with TuneBOHB as the searcher this is
+    the reference's BOHB pairing, HyperBandForBOHB).
+
+    Trials join the open bracket until its first rung completes. At each
+    milestone (grace * eta^k) a trial pauses; when every live bracket
+    member has reported the rung, the top 1/eta resume and the rest stop.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, grace_period: int = 1,
+                 reduction_factor: float = 3):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.milestones: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(int(t))
+            t = math.ceil(t * reduction_factor)
+        self.brackets: List[_Bracket] = []
+        self._bracket_of: Dict[str, _Bracket] = {}
+        self._stop_on_resume: set = set()
+
+    def on_trial_add(self, runner, trial) -> None:
+        self._assign(trial.trial_id)
+
+    def _assign(self, trial_id: str) -> _Bracket:
+        b = self._bracket_of.get(trial_id)
+        if b is not None:
+            return b
+        for b in self.brackets:
+            if not b.closed:
+                break
+        else:
+            b = _Bracket(self.milestones, self.eta)
+            self.brackets.append(b)
+        b.add(trial_id)
+        self._bracket_of[trial_id] = b
+        return b
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        value = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if value is None:
+            return self.CONTINUE
+        if t >= self.max_t:
+            return self.STOP
+        b = self._assign(trial.trial_id)
+        rung = b.rung_of.get(trial.trial_id, 0)
+        if rung >= len(b.milestones):
+            return self.CONTINUE
+        if t < b.milestones[rung]:
+            return self.CONTINUE
+        winners = b.record(trial.trial_id, rung, value, self.mode)
+        if winners is None:
+            return self.PAUSE          # wait for bracket peers
+        # rung complete: this trial either advances now or stops now; its
+        # paused peers are resolved in choose_trial_to_run
+        if trial.trial_id in b.promoted:
+            b.promoted.discard(trial.trial_id)
+            return self.CONTINUE
+        return self.STOP
+
+    def on_trial_complete(self, runner, trial, result) -> None:
+        b = self._bracket_of.get(trial.trial_id)
+        if b is None:
+            return
+        b.done.add(trial.trial_id)
+        b.promoted.discard(trial.trial_id)
+        # the departure may complete the current rung for the others
+        b.maybe_complete(b.rung_of.get(trial.trial_id, 0), self.mode)
+
+    def choose_trial_to_run(self, runner):
+        for t in runner.trials:
+            if t.status != "PAUSED":
+                continue
+            b = self._bracket_of.get(t.trial_id)
+            if b is None:
+                return t
+            if t.trial_id in b.promoted:
+                b.promoted.discard(t.trial_id)
+                return t
+            if t.trial_id in b.done:
+                # lost its rung while paused: terminate instead of resume
+                runner._stop_trial(t, "TERMINATED")
+        return None
 
 
 class MedianStoppingRule(TrialScheduler):

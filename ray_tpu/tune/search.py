@@ -118,6 +118,109 @@ class ConcurrencyLimiter(Searcher):
         self.searcher.on_trial_complete(trial_id, result, error)
 
 
+def _gridless(space: Dict[str, Any]) -> Dict[str, Any]:
+    """Replace grid_search leaves with Choice so per-trial sampling covers
+    every grid value (grid expansion is a BasicVariant concept; model-based
+    searchers draw one config at a time)."""
+    from ray_tpu.tune.sample import Choice, _grid_values, _is_grid
+    out = {}
+    for k, v in space.items():
+        if _is_grid(v):
+            out[k] = Choice(_grid_values(v))
+        elif isinstance(v, dict):
+            out[k] = _gridless(v)
+        else:
+            out[k] = v
+    return out
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (the algorithm behind the
+    reference's hyperopt/optuna integrations — search/hyperopt/,
+    search/optuna/ — implemented natively so no external package is
+    needed). Observations are split into good/bad sets at quantile
+    ``gamma``; numeric dims get Gaussian Parzen windows, categorical dims
+    get smoothed count ratios; candidates maximize l(x)/g(x).
+    """
+
+    def __init__(self, space: Dict[str, Any], metric: Optional[str] = None,
+                 mode: str = "max", n_initial: int = 10,
+                 n_candidates: int = 24, gamma: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self._space = _gridless(space)
+        self._rng = random.Random(seed)
+        self._n_initial = n_initial
+        self._n_candidates = n_candidates
+        self._gamma = gamma
+        self._observations: List[Any] = []   # (config, score)
+        self._pending: Dict[str, Dict[str, Any]] = {}
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config and not self._space:
+            self._space = _gridless(config)
+        return super().set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._observations) < self._n_initial:
+            cfg = generate_variants(self._space, self._rng, 1)[0]
+        else:
+            cands = [generate_variants(self._space, self._rng, 1)[0]
+                     for _ in range(self._n_candidates)]
+            cfg = max(cands, key=self._ei_score)
+        self._pending[trial_id] = cfg
+        return cfg
+
+    def _split(self):
+        obs = sorted(self._observations, key=lambda o: o[1],
+                     reverse=self.mode == "max")
+        k = max(1, int(len(obs) * self._gamma))
+        return obs[:k], obs[k:]
+
+    def _ei_score(self, cand: Dict[str, Any]) -> float:
+        """log l(x) - log g(x) under per-dimension Parzen estimators."""
+        import math as _m
+        good, bad = self._split()
+
+        def log_density(value, obs_values):
+            nums = [v for v in obs_values
+                    if isinstance(v, (int, float)) and not isinstance(v, bool)]
+            if isinstance(value, (int, float)) and not isinstance(value, bool) \
+                    and nums:
+                lo, hi = min(nums), max(nums)
+                bw = max((hi - lo) / max(len(nums) ** 0.5, 1.0),
+                         abs(value) * 1e-3, 1e-12)
+                dens = sum(_m.exp(-0.5 * ((value - m) / bw) ** 2)
+                           for m in nums) / (len(nums) * bw)
+                return _m.log(dens + 1e-300)
+            # categorical: smoothed frequency
+            count = sum(1 for v in obs_values if v == value)
+            return _m.log((count + 1.0) / (len(obs_values) + 2.0))
+
+        score = 0.0
+        for key in self._space:
+            if not isinstance(self._space[key], Domain):
+                continue
+            gv = [cfg.get(key) for cfg, _ in good]
+            bv = [cfg.get(key) for cfg, _ in bad]
+            if not gv or not bv:
+                continue
+            score += log_density(cand.get(key), gv) \
+                - log_density(cand.get(key), bv)
+        return score
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is not None and result and self.metric in result and not error:
+            self._observations.append((cfg, result[self.metric]))
+
+
+# BOHB pairs the TPE model with the HyperBand scheduler
+# (ray_tpu.tune.schedulers.HyperBandScheduler), mirroring the reference's
+# TuneBOHB searcher + HyperBandForBOHB pairing (search/bohb/).
+TuneBOHB = TPESearcher
+
+
 class HyperOptStyleSearch(Searcher):
     """A dependency-free TPE-flavored searcher: explores randomly for
     ``n_initial`` trials, then samples candidates and picks the one closest
@@ -130,7 +233,7 @@ class HyperOptStyleSearch(Searcher):
                  n_initial: int = 10, n_candidates: int = 24,
                  seed: Optional[int] = None):
         super().__init__(metric, mode)
-        self._space = space
+        self._space = _gridless(space)
         self._rng = random.Random(seed)
         self._n_initial = n_initial
         self._n_candidates = n_candidates

@@ -131,6 +131,8 @@ class TrialRunner:
 
         self._csv_path = os.path.join(self.experiment_dir, "progress.csv")
         self._csv_fields: Optional[List[str]] = None
+        self.callbacks = list(run_config.callbacks or [])
+        self._iteration = 0
 
     # -- trial lifecycle ---------------------------------------------------
     def _make_trial(self) -> Optional[Trial]:
@@ -145,6 +147,7 @@ class TrialRunner:
             return None
         t.config = cfg
         self.trials.append(t)
+        self.scheduler.on_trial_add(self, t)
         return t
 
     def _start_trial(self, trial: Trial,
@@ -165,6 +168,8 @@ class TrialRunner:
             checkpoint=checkpoint if checkpoint is not None
             else trial.checkpoint)
         trial.status = RUNNING
+        for cb in self.callbacks:
+            cb.on_trial_start(self._iteration, self.trials, trial)
 
     def _stop_trial(self, trial: Trial, status: str,
                     error: Optional[str] = None) -> None:
@@ -182,6 +187,11 @@ class TrialRunner:
         self.searcher.on_trial_complete(trial.trial_id, done_result,
                                         error=bool(error))
         self.scheduler.on_trial_complete(self, trial, done_result)
+        for cb in self.callbacks:
+            if error:
+                cb.on_trial_error(self._iteration, self.trials, trial)
+            else:
+                cb.on_trial_complete(self._iteration, self.trials, trial)
 
     def request_exploit(self, trial: Trial, donor: Trial,
                         new_config: Dict[str, Any]) -> None:
@@ -262,6 +272,8 @@ class TrialRunner:
         if ckpt is not None:
             trial.checkpoint = ckpt
         self._log_result(trial, metrics)
+        for cb in self.callbacks:
+            cb.on_trial_result(self._iteration, self.trials, trial, metrics)
         self.searcher.on_trial_result(trial.trial_id, metrics)
         decision = self.scheduler.on_trial_result(self, trial, metrics)
         if self._hit_stop_criteria(metrics):
@@ -309,7 +321,9 @@ class TrialRunner:
     # -- results -----------------------------------------------------------
     def run(self) -> List[Result]:
         while self.step():
-            pass
+            self._iteration += 1
+        for cb in self.callbacks:
+            cb.on_experiment_end(self.trials)
         out = []
         for t in self.trials:
             out.append(Result(

@@ -1,0 +1,153 @@
+"""Tests for the TPE searcher, HyperBand scheduler, callbacks, and
+ExperimentAnalysis (model: reference tune/tests/test_trial_scheduler.py,
+test_searchers.py, test_callbacks.py, test_experiment_analysis.py)."""
+
+import json
+import os
+
+from ray_tpu.air import RunConfig, session
+from ray_tpu.tune import (Callback, CSVLoggerCallback, ExperimentAnalysis,
+                          HyperBandScheduler, JsonLoggerCallback,
+                          TPESearcher, TuneBOHB, TuneConfig, Tuner,
+                          grid_search, uniform)
+
+
+def test_tpe_searcher_biases_toward_optimum():
+    space = {"x": uniform(-1.0, 1.0)}
+    s = TPESearcher(space, metric="score", mode="max", n_initial=6,
+                    n_candidates=16, seed=0)
+    for i in range(20):
+        cfg = s.suggest(f"t{i}")
+        s.on_trial_complete(f"t{i}", {"score": -abs(cfg["x"] - 0.5)})
+    later = [s.suggest(f"u{i}")["x"] for i in range(10)]
+    # suggestions concentrate near the optimum at 0.5
+    assert sum(abs(x - 0.5) for x in later) / len(later) < 0.4
+    assert TuneBOHB is TPESearcher
+
+
+def test_tpe_categorical_dims():
+    from ray_tpu.tune import choice
+    space = {"c": choice(["good", "bad"])}
+    s = TPESearcher(space, metric="score", mode="max", n_initial=6, seed=1)
+    for i in range(20):
+        cfg = s.suggest(f"t{i}")
+        s.on_trial_complete(
+            f"t{i}", {"score": 1.0 if cfg["c"] == "good" else 0.0})
+    later = [s.suggest(f"u{i}")["c"] for i in range(12)]
+    assert later.count("good") > later.count("bad")
+
+
+def test_hyperband_bracket_unit():
+    sched = HyperBandScheduler(metric="score", mode="max", grace_period=1,
+                               reduction_factor=2, max_t=8)
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+            self.status = "RUNNING"
+
+    class R:
+        trials = []
+
+    a, b = T("a"), T("b")
+    # both trials join the bracket at creation (on_trial_add)
+    sched.on_trial_add(R, a)
+    sched.on_trial_add(R, b)
+    # first to hit the rung waits for its peer
+    d1 = sched.on_trial_result(R, a, {"training_iteration": 1, "score": 1.0})
+    assert d1 == "PAUSE"
+    # when b reports the rung, the rung completes: b (better) advances
+    d2 = sched.on_trial_result(R, b, {"training_iteration": 1, "score": 5.0})
+    assert d2 == "CONTINUE"
+    bracket = sched._bracket_of[a.trial_id]
+    assert a.trial_id in bracket.done
+    assert bracket is sched._bracket_of[b.trial_id]
+
+
+def test_hyperband_integration(ray_start_regular):
+    def trainable(config):
+        for i in range(8):
+            session.report({"score": config["q"] * (i + 1)})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"q": grid_search([1.0, 4.0, 8.0, 16.0])},
+        tune_config=TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=4,
+            scheduler=HyperBandScheduler(metric="score", mode="max",
+                                         grace_period=2,
+                                         reduction_factor=2, max_t=8)))
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["config"]["q"] == 16.0
+
+
+def test_callbacks_and_analysis(ray_start_regular, tmp_path):
+    events = []
+
+    class Probe(Callback):
+        def on_trial_start(self, iteration, trials, trial):
+            events.append(("start", trial.trial_id))
+
+        def on_trial_result(self, iteration, trials, trial, result):
+            events.append(("result", trial.trial_id))
+
+        def on_trial_complete(self, iteration, trials, trial):
+            events.append(("complete", trial.trial_id))
+
+        def on_experiment_end(self, trials):
+            events.append(("end", None))
+
+    def trainable(config):
+        for i in range(2):
+            session.report({"score": config["lr"] * (i + 1)})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": grid_search([1.0, 2.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            name="cbexp", storage_path=str(tmp_path),
+            callbacks=[Probe(), JsonLoggerCallback(),
+                       CSVLoggerCallback()]))
+    grid = tuner.fit()
+    assert not grid.errors
+    kinds = [k for k, _ in events]
+    assert kinds.count("start") >= 2
+    assert kinds.count("complete") == 2
+    assert kinds[-1] == "end"
+    assert "result" in kinds
+
+    exp_dir = os.path.join(str(tmp_path), "cbexp")
+    # logger callbacks wrote per-trial files
+    trial_dirs = [d for d in os.listdir(exp_dir) if d.startswith("trial_")]
+    assert trial_dirs
+    for d in trial_dirs:
+        assert os.path.exists(os.path.join(exp_dir, d, "results.json"))
+        assert os.path.exists(os.path.join(exp_dir, d, "progress.csv"))
+
+    # analysis over the written experiment
+    ana = ExperimentAnalysis(exp_dir, default_metric="score",
+                             default_mode="max")
+    assert len(ana.trial_ids) == 2
+    best_cfg = ana.get_best_config()
+    assert best_cfg["lr"] == 2.0
+    last = ana.get_last_results()
+    assert all(r["score"] > 0 for r in last.values())
+
+
+def test_tpe_tuner_integration(ray_start_regular):
+    def trainable(config):
+        session.report({"score": -(config["x"] - 0.3) ** 2})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": uniform(-1.0, 1.0)},
+        tune_config=TuneConfig(
+            metric="score", mode="max", num_samples=12,
+            search_alg=TPESearcher({"x": uniform(-1.0, 1.0)},
+                                   metric="score", mode="max",
+                                   n_initial=4, seed=0)))
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert abs(best.metrics["config"]["x"] - 0.3) < 0.6
