@@ -233,6 +233,11 @@ def cmd_debug(args) -> None:
         print(f"{bid}  {addr}   (attach: nc {addr.replace(':', ' ')})")
 
 
+def cmd_microbenchmark(args) -> None:
+    from ray_tpu._private.ray_perf import main as perf_main
+    perf_main(min_time=args.min_time)
+
+
 # ------------------------------------------------------------------- jobs
 def cmd_job(args) -> None:
     from ray_tpu.job_submission import JobSubmissionClient
@@ -349,6 +354,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("microbenchmark",
+                        help="core-runtime ops/s suite (ray_perf analog)")
+    sp.add_argument("--min-time", type=float, default=2.0)
+    sp.set_defaults(fn=cmd_microbenchmark)
 
     sp = sub.add_parser("serve", help="serve deployments")
     ssub = sp.add_subparsers(dest="serve_cmd", required=True)

@@ -32,9 +32,24 @@ class ExperimentAnalysis:
                 for line in f:
                     line = line.strip()
                     if line:
-                        rows.append(json.loads(line))
+                        rows.append(self._coerce(json.loads(line)))
             if rows:
                 self.trial_dataframes[trial_id] = rows
+
+    @staticmethod
+    def _coerce(row: Dict[str, Any]) -> Dict[str, Any]:
+        """The runner serializes with default=str, so numpy/JAX scalars
+        arrive as strings — parse numeric-looking strings back to float
+        or metric comparisons would be lexicographic."""
+        out = {}
+        for k, v in row.items():
+            if isinstance(v, str):
+                try:
+                    v = float(v)
+                except ValueError:
+                    pass
+            out[k] = v
+        return out
 
     @property
     def trial_ids(self) -> List[str]:
@@ -65,8 +80,15 @@ class ExperimentAnalysis:
 
     def get_best_config(self, metric: Optional[str] = None,
                         mode: Optional[str] = None) -> Dict[str, Any]:
+        metric, mode = self._metric_mode(metric, mode)
         rows = self.trial_dataframes[self.best_trial_id(metric, mode)]
-        return rows[-1].get("config", {})
+        # the config of the row that achieved the best value — under PBT
+        # the trial's config mutates over time, so rows[-1] can be a
+        # config that never produced the best metric
+        scored = [r for r in rows if metric in r]
+        best_row = (max if mode == "max" else min)(
+            scored, key=lambda r: r[metric])
+        return best_row.get("config", {})
 
     def get_last_results(self) -> Dict[str, Dict[str, Any]]:
         return {tid: rows[-1]

@@ -185,7 +185,6 @@ class HyperBandScheduler(TrialScheduler):
             t = math.ceil(t * reduction_factor)
         self.brackets: List[_Bracket] = []
         self._bracket_of: Dict[str, _Bracket] = {}
-        self._stop_on_resume: set = set()
 
     def on_trial_add(self, runner, trial) -> None:
         self._assign(trial.trial_id)

@@ -165,9 +165,16 @@ class TPESearcher(Searcher):
         if len(self._observations) < self._n_initial:
             cfg = generate_variants(self._space, self._rng, 1)[0]
         else:
+            # the good/bad split and per-key value lists depend only on
+            # the observations: build once, score all candidates with it
+            good, bad = self._split()
+            values = {key: ([cfg.get(key) for cfg, _ in good],
+                            [cfg.get(key) for cfg, _ in bad])
+                      for key in self._space
+                      if isinstance(self._space[key], Domain)}
             cands = [generate_variants(self._space, self._rng, 1)[0]
                      for _ in range(self._n_candidates)]
-            cfg = max(cands, key=self._ei_score)
+            cfg = max(cands, key=lambda c: self._ei_score(c, values))
         self._pending[trial_id] = cfg
         return cfg
 
@@ -177,10 +184,10 @@ class TPESearcher(Searcher):
         k = max(1, int(len(obs) * self._gamma))
         return obs[:k], obs[k:]
 
-    def _ei_score(self, cand: Dict[str, Any]) -> float:
+    def _ei_score(self, cand: Dict[str, Any],
+                  values: Dict[str, Any]) -> float:
         """log l(x) - log g(x) under per-dimension Parzen estimators."""
         import math as _m
-        good, bad = self._split()
 
         def log_density(value, obs_values):
             nums = [v for v in obs_values
@@ -198,11 +205,7 @@ class TPESearcher(Searcher):
             return _m.log((count + 1.0) / (len(obs_values) + 2.0))
 
         score = 0.0
-        for key in self._space:
-            if not isinstance(self._space[key], Domain):
-                continue
-            gv = [cfg.get(key) for cfg, _ in good]
-            bv = [cfg.get(key) for cfg, _ in bad]
+        for key, (gv, bv) in values.items():
             if not gv or not bv:
                 continue
             score += log_density(cand.get(key), gv) \

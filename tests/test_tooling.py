@@ -172,3 +172,15 @@ def test_cli_start_status_stop():
     finally:
         sp = run("stop")
         assert sp.returncode == 0, sp.stderr
+
+
+def test_microbenchmark_suite_runs():
+    """ray_perf analog reports the reference's metric names
+    (BASELINE.md microbenchmark section)."""
+    from ray_tpu._private.ray_perf import main
+    results = main(min_time=0.05)
+    names = {r["name"] for r in results}
+    assert "single client get calls (Plasma Store)" in names
+    assert "1:1 actor calls sync" in names
+    assert "multi client tasks async" in names
+    assert all(r["ops_per_s"] > 0 for r in results)
