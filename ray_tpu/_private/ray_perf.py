@@ -38,7 +38,7 @@ def timeit(name: str, fn: Callable, multiplier: float = 1,
     rate = count * multiplier / dt
     entry = {"name": name, "ops_per_s": round(rate, 2),
              "calls": count, "seconds": round(dt, 3)}
-    print(f"{name}: {rate:,.2f} +- per second")
+    print(f"{name}: {rate:,.2f} per second")
     if results is not None:
         results.append(entry)
     return results if results is not None else [entry]

@@ -12,8 +12,14 @@ from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from ray_tpu.rl.env import (Box, CartPoleEnv, Discrete, Env,  # noqa: F401
                             PendulumEnv, VectorEnv, make_env, register_env)
 from ray_tpu.rl.a2c import A2C, A2CConfig, A3C, A3CConfig  # noqa: F401
+from ray_tpu.rl.apex_dqn import ApexDQN, ApexDQNConfig  # noqa: F401
 from ray_tpu.rl.appo import APPO, APPOConfig  # noqa: F401
+from ray_tpu.rl.bandit import (BanditConfig, BanditLinTS,  # noqa: F401
+                               BanditLinTSConfig, BanditLinUCB,
+                               LinearDiscreteEnv)
 from ray_tpu.rl.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rl.crr import CRR, CRRConfig  # noqa: F401
+from ray_tpu.rl.dt import DT, DTConfig  # noqa: F401
 from ray_tpu.rl.ddpg import DDPG, DDPGConfig, TD3, TD3Config  # noqa: F401
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.es import ARS, ARSConfig, ES, ESConfig  # noqa: F401
@@ -22,6 +28,10 @@ from ray_tpu.rl.offline import (BC, BCConfig, MARWIL,  # noqa: F401
                                 MARWILConfig, JsonReader, JsonWriter,
                                 collect_dataset,
                                 importance_sampling_estimate)
+from ray_tpu.rl.multi_agent import (MultiAgentCartPole,  # noqa: F401
+                                    MultiAgentEnv, MultiAgentPPO,
+                                    MultiAgentPPOConfig,
+                                    MultiAgentRolloutWorker)
 from ray_tpu.rl.pg import PG, PGConfig  # noqa: F401
 from ray_tpu.rl.policy import (DDPGPolicy, JaxPolicy, QPolicy,  # noqa: F401
                                SACPolicy)
@@ -44,6 +54,10 @@ __all__ = [
     "A3CConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL",
     "CQLConfig", "ES", "ESConfig", "ARS", "ARSConfig", "JsonReader",
     "JsonWriter", "collect_dataset", "importance_sampling_estimate",
+    "ApexDQN", "ApexDQNConfig", "CRR", "CRRConfig", "DT", "DTConfig",
+    "BanditLinUCB", "BanditLinTS", "BanditConfig", "BanditLinTSConfig",
+    "LinearDiscreteEnv", "MultiAgentEnv", "MultiAgentCartPole",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentRolloutWorker",
     "get_algorithm_class", "SampleBatch", "compute_gae", "ReplayBuffer",
     "PrioritizedReplayBuffer", "Env", "Box", "Discrete", "CartPoleEnv",
     "PendulumEnv", "VectorEnv", "make_env", "register_env",

@@ -1,0 +1,123 @@
+"""Ape-X DQN: distributed prioritized experience replay.
+
+Analog of /root/reference/rllib/algorithms/apex_dqn/apex_dqn.py
+(Horgan et al.): many rollout workers free-run with a per-worker epsilon
+ladder (worker i explores at eps^(1 + i*alpha/(N-1))), transitions stream
+asynchronously into a prioritized replay buffer, and the learner performs
+TD updates continuously — no sampling/learning barrier. Reuses the DQN
+learner (double-Q/dueling/PER) with IMPALA-style async collection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.dqn import DQN, DQNConfig
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer
+
+
+class ApexDQNConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = ApexDQN
+        self.prioritized_replay = True
+        self.num_rollout_workers = 4
+        self.epsilon_base = 0.4         # Ape-X ladder: eps^(1+i*alpha/(N-1))
+        self.epsilon_alpha = 7.0
+        self.n_updates_per_iter = 64
+        self.learning_starts = 1000
+        self.rollout_fragment_length = 50
+        self.max_pending_per_worker = 1
+
+
+class ApexDQN(DQN):
+    def setup_learner(self) -> None:
+        super().setup_learner()
+        assert isinstance(self.buffer, PrioritizedReplayBuffer)
+        self._inflight: Dict[Any, int] = {}
+        # fixed per-worker epsilon ladder (Horgan et al. eq. 1)
+        cfg: ApexDQNConfig = self.config
+        n = max(len(self.workers.workers), 1)
+        self._epsilons = [
+            cfg.epsilon_base ** (1.0 + (i * cfg.epsilon_alpha) / max(n - 1, 1))
+            for i in range(n)]
+
+    def _submit(self, idx: int) -> None:
+        ref = self.workers.workers[idx].sample_transitions.remote()
+        self._inflight[ref] = idx
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+        cfg: ApexDQNConfig = self.config
+
+        # keep every worker busy at its ladder epsilon
+        live = set(self._inflight.values())
+        for i in range(len(self.workers.workers)):
+            if i not in live:
+                self.workers.workers[i].set_epsilon.remote(self._epsilons[i])
+                self._submit(i)
+
+        # drain whatever has landed (don't block on stragglers)
+        ready, _ = ray_tpu.wait(list(self._inflight.keys()),
+                                num_returns=len(self._inflight),
+                                timeout=2.0)
+        wref = ray_tpu.put(self.get_weights()) if ready else None
+        for ref in ready:
+            idx = self._inflight.pop(ref)
+            try:
+                batch = ray_tpu.get(ref, timeout=30.0)
+            except Exception:
+                # the replacement needs its ladder epsilon back, or it
+                # would explore at QPolicy's default epsilon=1.0 forever
+                self.workers.restart_worker(idx, self.get_weights())
+                self.workers.workers[idx].set_epsilon.remote(
+                    self._epsilons[idx])
+                self._submit(idx)
+                continue
+            self.buffer.add(batch)
+            self._timesteps_total += batch.count
+            self._steps_since_target_sync += batch.count
+            # push fresh weights only to the producer (async, no barrier);
+            # one shared object-store put serves every ready worker
+            try:
+                self.workers.workers[idx].set_weights.remote(wref)
+            except Exception:
+                pass
+            self._submit(idx)
+
+        info: Dict[str, Any] = {"buffer_size": len(self.buffer),
+                                "batches_received": len(ready),
+                                "epsilons": self._epsilons}
+        if len(self.buffer) < cfg.learning_starts:
+            return {"info": info}
+
+        mb = self.round_minibatch(cfg.train_batch_size)
+        aux_last: Dict[str, Any] = {}
+        for _ in range(cfg.n_updates_per_iter):
+            sample = self.buffer.sample(mb)
+            device_batch = self.stage_batch(
+                sample, (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS,
+                         SB.TERMINATEDS, "weights"))
+            self.params, self.opt_state, aux = self._td_step(
+                self.params, self.target_params, self.opt_state,
+                device_batch)
+            if "batch_indexes" in sample:
+                self.buffer.update_priorities(
+                    sample["batch_indexes"],
+                    np.abs(np.asarray(aux["td_error"])) + 1e-6)
+            aux_last = aux
+
+        if self._steps_since_target_sync >= cfg.target_update_freq:
+            self.target_params = self.params
+            self._steps_since_target_sync = 0
+            info["target_synced"] = True
+        info.update({k: float(np.mean(np.asarray(v)))
+                     for k, v in aux_last.items() if k != "td_error"})
+        return {"info": info}
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()

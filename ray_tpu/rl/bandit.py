@@ -1,0 +1,142 @@
+"""Contextual bandits: LinUCB and LinTS.
+
+Analog of /root/reference/rllib/algorithms/bandit/ (bandit_torch_policy.py,
+lin_ucb / lin_ts exploration): closed-form linear-Gaussian posteriors per
+arm — A = I + sum x x^T, b = sum r x — with UCB or Thompson-sampling arm
+selection. Pure numpy on the driver (the posteriors are tiny); the env
+steps locally, no rollout actors needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rl.algorithm import AlgorithmConfig
+from ray_tpu.rl.env import Box, Discrete, Env, make_env
+
+
+class LinearDiscreteEnv(Env):
+    """Contextual bandit test env: reward = context . theta_arm + noise
+    (cf. reference rllib/env/wrappers/recsim... simplest linear testbed).
+    """
+
+    def __init__(self, n_arms: int = 5, dim: int = 8,
+                 noise: float = 0.1, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.theta = rng.normal(size=(n_arms, dim)) / np.sqrt(dim)
+        self.noise = noise
+        self.observation_space = Box(low=-1.0, high=1.0, shape=(dim,))
+        self.action_space = Discrete(n_arms)
+        self._rng = rng
+        self._ctx = None
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._ctx = self._rng.normal(size=self.theta.shape[1]).astype(
+            np.float32)
+        return self._ctx, {}
+
+    def step(self, action):
+        r = float(self.theta[int(action)] @ self._ctx
+                  + self.noise * self._rng.normal())
+        # bandit: every step is its own episode; next context arrives
+        self._ctx = self._rng.normal(size=self.theta.shape[1]).astype(
+            np.float32)
+        return self._ctx, r, True, False, {}
+
+    def best_reward(self, ctx) -> float:
+        return float(np.max(self.theta @ ctx))
+
+
+class BanditConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = BanditLinUCB
+        self.alpha = 1.0               # UCB exploration width
+        self.steps_per_iteration = 100
+
+
+class BanditLinTSConfig(BanditConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = BanditLinTS
+
+
+class BanditLinUCB:
+    """Driver-local bandit: per-arm ridge posterior + UCB selection."""
+
+    exploration = "ucb"
+
+    def __init__(self, config: BanditConfig):
+        self.config = config
+        self.env = make_env(config.env_spec)
+        if not isinstance(self.env.action_space, Discrete):
+            raise ValueError("bandits require a discrete action space")
+        self.n_arms = self.env.action_space.n
+        self.dim = int(np.prod(self.env.observation_space.shape))
+        # A = I + sum x x^T (precision), b = sum r x, per arm
+        self.A = np.stack([np.eye(self.dim) for _ in range(self.n_arms)])
+        self.b = np.zeros((self.n_arms, self.dim))
+        self._rng = np.random.default_rng(config.seed or 0)
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._obs, _ = self.env.reset(seed=config.seed or 0)
+        self._reward_window: List[float] = []
+        self._regret_window: List[float] = []
+
+    def _select_arm(self, x: np.ndarray) -> int:
+        scores = np.zeros(self.n_arms)
+        for a in range(self.n_arms):
+            A_inv = np.linalg.inv(self.A[a])
+            theta = A_inv @ self.b[a]
+            if self.exploration == "ucb":
+                width = self.config.alpha * np.sqrt(x @ A_inv @ x)
+                scores[a] = theta @ x + width
+            else:                      # Thompson sampling
+                sample = self._rng.multivariate_normal(
+                    theta, self.config.alpha ** 2 * A_inv)
+                scores[a] = sample @ x
+        return int(np.argmax(scores))
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        for _ in range(cfg.steps_per_iteration):
+            x = np.asarray(self._obs, np.float64).reshape(-1)
+            arm = self._select_arm(x)
+            obs, r, *_ = self.env.step(arm)
+            self.A[arm] += np.outer(x, x)
+            self.b[arm] += r * x
+            self._reward_window.append(r)
+            if hasattr(self.env, "best_reward"):
+                self._regret_window.append(self.env.best_reward(x) - r)
+            self._obs = obs
+            self._timesteps_total += 1
+        self.iteration += 1
+        self._reward_window = self._reward_window[-500:]
+        self._regret_window = self._regret_window[-500:]
+        out = {"training_iteration": self.iteration,
+               "timesteps_total": self._timesteps_total,
+               "episode_reward_mean": float(np.mean(self._reward_window))}
+        if self._regret_window:
+            out["mean_regret"] = float(np.mean(self._regret_window))
+        return out
+
+    def save(self) -> Checkpoint:
+        return Checkpoint.from_dict({"A": self.A, "b": self.b,
+                                     "iteration": self.iteration})
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        d = checkpoint.to_dict()
+        self.A, self.b = d["A"], d["b"]
+        self.iteration = d.get("iteration", 0)
+
+    def stop(self) -> None:
+        self.env.close()
+
+
+class BanditLinTS(BanditLinUCB):
+    exploration = "ts"

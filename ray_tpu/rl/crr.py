@@ -1,0 +1,208 @@
+"""CRR: Critic-Regularized Regression for offline continuous control.
+
+Analog of /root/reference/rllib/algorithms/crr/ (crr_torch_policy.py):
+twin-critic TD learning plus an actor trained by advantage-weighted
+behavior cloning — weight = exp(A(s,a)/beta) (clipped) or the binary
+1[A>0] indicator, with A(s,a) = Q(s,a) - mean_k Q(s, pi_k(s)). Offline:
+trains from a JsonReader dataset, one jitted update per minibatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.cql import CQLConfig
+from ray_tpu.rl.env import Box, make_env
+from ray_tpu.rl.offline import JsonReader
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class CRRConfig(CQLConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = CRR
+        self.beta = 1.0                 # advantage temperature
+        self.weight_clip = 20.0
+        self.advantage_type = "exp"     # "exp" | "binary"
+        self.n_action_samples = 4       # for the advantage baseline
+        self.tau = 0.005
+
+
+class CRR:
+    def __init__(self, config: CRRConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.rl import models as M
+
+        self.config = config
+        if config.input_path is None:
+            raise ValueError("config.offline_data(input_path=...) required")
+        self.dataset = JsonReader(config.input_path).read_all()
+        if SB.NEXT_OBS not in self.dataset:
+            raise ValueError("CRR needs next_obs in the offline dataset "
+                             "(collect with collect_dataset)")
+        self.iteration = 0
+        self._timesteps_total = 0
+
+        probe = make_env(config.env_spec)
+        if not isinstance(probe.action_space, Box):
+            raise ValueError("CRR requires a continuous action space")
+        act_dim = int(np.prod(probe.action_space.shape))
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        low = np.asarray(probe.action_space.low, np.float32).reshape(-1)
+        high = np.asarray(probe.action_space.high, np.float32).reshape(-1)
+        probe.close()
+
+        self.actor = M.SquashedGaussianActor(action_dim=act_dim,
+                                             hidden=tuple(config.hidden))
+        self.critic = M.TwinQ(hidden=tuple(config.hidden))
+        rng = jax.random.PRNGKey(config.seed or 0)
+        r1, r2 = jax.random.split(rng)
+        actor_params = self.actor.init(r1, jnp.zeros((1, obs_dim)))["params"]
+        critic_params = self.critic.init(
+            r2, jnp.zeros((1, obs_dim)), jnp.zeros((1, act_dim)))["params"]
+        self.actor_tx = optax.adam(config.lr)
+        self.critic_tx = optax.adam(config.lr)
+        self.state = {
+            "actor": actor_params,
+            "critic": critic_params,
+            "target_critic": jax.tree.map(jnp.copy, critic_params),
+            "actor_opt": self.actor_tx.init(actor_params),
+            "critic_opt": self.critic_tx.init(critic_params),
+        }
+
+        actor, critic = self.actor, self.critic
+        actor_tx, critic_tx = self.actor_tx, self.critic_tx
+        gamma, tau, beta = config.gamma, config.tau, config.beta
+        w_clip = config.weight_clip
+        n_samp = config.n_action_samples
+        binary = config.advantage_type == "binary"
+        scale, shift = (high - low) / 2.0, (high + low) / 2.0
+
+        def rescale(a_tanh):
+            return a_tanh * scale + shift
+
+        # logp of the (tanh-space-mapped) dataset action under the actor
+        def data_logp(params, obs, act_env):
+            mean, log_std = actor.apply({"params": params}, obs)
+            a_tanh = jnp.clip((act_env - shift) / jnp.maximum(scale, 1e-8),
+                              -1.0 + 1e-6, 1.0 - 1e-6)
+            pre = jnp.arctanh(a_tanh)
+            std = jnp.exp(log_std)
+            logp = (-0.5 * jnp.square((pre - mean) / std) - log_std
+                    - 0.5 * jnp.log(2.0 * jnp.pi)).sum(-1)
+            logp -= (2.0 * (jnp.log(2.0) - pre
+                            - jax.nn.softplus(-2.0 * pre))).sum(-1)
+            return logp
+
+        def update(state, batch, rng):
+            r_next, r_base = jax.random.split(rng)
+
+            # -- critic: TD target from the current policy ----------------
+            mean_n, log_std_n = actor.apply({"params": state["actor"]},
+                                            batch[SB.NEXT_OBS])
+            a_next, _ = M.squashed_sample_logp(r_next, mean_n, log_std_n)
+            q1_t, q2_t = critic.apply({"params": state["target_critic"]},
+                                      batch[SB.NEXT_OBS], rescale(a_next))
+            q_next = jnp.minimum(q1_t, q2_t)
+            not_done = 1.0 - batch[SB.TERMINATEDS].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch[SB.REWARDS] + gamma * not_done * q_next)
+
+            def critic_loss(p):
+                q1, q2 = critic.apply({"params": p}, batch[SB.OBS],
+                                      batch[SB.ACTIONS])
+                return (jnp.square(q1 - target)
+                        + jnp.square(q2 - target)).mean() * 0.5, q1
+
+            (c_loss, q_data), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(state["critic"])
+            c_updates, critic_opt = critic_tx.update(
+                c_grads, state["critic_opt"], state["critic"])
+            critic_params = optax.apply_updates(state["critic"], c_updates)
+
+            # -- advantage: Q(s, a_data) - E_k Q(s, pi_k(s)) --------------
+            mean_c, log_std_c = actor.apply({"params": state["actor"]},
+                                            batch[SB.OBS])
+            keys = jax.random.split(r_base, n_samp)
+            q_base = jnp.mean(jnp.stack([
+                critic.apply({"params": critic_params}, batch[SB.OBS],
+                             rescale(M.squashed_sample_logp(
+                                 k, mean_c, log_std_c)[0]))[0]
+                for k in keys]), axis=0)
+            adv = jax.lax.stop_gradient(q_data - q_base)
+            if binary:
+                weights = (adv > 0).astype(jnp.float32)
+            else:
+                weights = jnp.minimum(jnp.exp(adv / beta), w_clip)
+
+            # -- actor: advantage-weighted regression ---------------------
+            def actor_loss(p):
+                logp = data_logp(p, batch[SB.OBS], batch[SB.ACTIONS])
+                return -(weights * logp).mean()
+
+            a_loss, a_grads = jax.value_and_grad(actor_loss)(state["actor"])
+            a_updates, actor_opt = actor_tx.update(
+                a_grads, state["actor_opt"], state["actor"])
+            actor_params = optax.apply_updates(state["actor"], a_updates)
+
+            target_critic = jax.tree.map(
+                lambda t, o: t * (1.0 - tau) + o * tau,
+                state["target_critic"], critic_params)
+            new_state = {
+                "actor": actor_params, "critic": critic_params,
+                "target_critic": target_critic,
+                "actor_opt": actor_opt, "critic_opt": critic_opt,
+            }
+            return new_state, {"critic_loss": c_loss, "actor_loss": a_loss,
+                               "mean_advantage": adv.mean(),
+                               "mean_weight": weights.mean(),
+                               "mean_q": q_data.mean()}
+
+        self._update = jax.jit(update, donate_argnums=(0,))
+        self._rng = jax.random.PRNGKey((config.seed or 0) + 41)
+        self._jax, self._jnp = jax, jnp
+
+    def get_weights(self) -> Any:
+        return self._jax.tree.map(np.asarray, self.state["actor"])
+
+    def set_weights(self, weights: Any) -> None:
+        self.state["actor"] = self._jax.tree.map(self._jnp.asarray, weights)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        jnp = self._jnp
+        rng = np.random.default_rng((cfg.seed or 0) + self.iteration * 1000)
+        n = self.dataset.count
+        keep = (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS, SB.TERMINATEDS)
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.num_sgd_iter):
+            idx = rng.choice(n, size=min(cfg.train_batch_size, n),
+                             replace=False)
+            mb = SampleBatch({k: np.asarray(self.dataset[k])[idx]
+                              for k in keep if k in self.dataset})
+            device_batch = {k: jnp.asarray(v) for k, v in mb.items()}
+            self._rng, key = self._jax.random.split(self._rng)
+            self.state, metrics = self._update(self.state, device_batch, key)
+            self._timesteps_total += mb.count
+        self.iteration += 1
+        info = {k: float(v) for k, v in metrics.items()}
+        return {"info": info, "training_iteration": self.iteration,
+                "timesteps_total": self._timesteps_total}
+
+    def save(self) -> Checkpoint:
+        return Checkpoint.from_dict({
+            "weights": self.get_weights(), "iteration": self.iteration})
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        d = checkpoint.to_dict()
+        self.set_weights(d["weights"])
+        self.iteration = d.get("iteration", 0)
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,265 @@
+"""DT: Decision Transformer — RL as conditional sequence modeling.
+
+Analog of /root/reference/rllib/algorithms/dt/ (dt.py, the
+return-conditioned transformer of Chen et al. 2021): interleaved
+(return-to-go, state, action) token triples through a causal transformer
+(the repo's GPT block stack — RoPE provides the timestep geometry),
+action predicted at each state token. Offline: trains from a JsonReader
+dataset; evaluation rolls the env conditioned on a target return.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import flax.linen as nn
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import AlgorithmConfig
+from ray_tpu.rl.env import Box, make_env
+from ray_tpu.rl.offline import JsonReader
+
+
+class DTConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DT
+        self.input_path: Optional[str] = None
+        self.context_len = 20           # K timesteps of (R, s, a) context
+        self.d_model = 128
+        self.n_layers = 3
+        self.n_heads = 4
+        self.lr = 1e-4
+        self.train_batch_size = 64
+        self.num_sgd_iter = 50
+        self.target_return: Optional[float] = None   # None -> dataset max
+
+    def offline_data(self, *, input_path: Optional[str] = None,
+                     **kwargs) -> "DTConfig":
+        if input_path is not None:
+            self.input_path = input_path
+        self.extra.update(kwargs)
+        return self
+
+
+class _DTModel(nn.Module):
+    """(rtg, obs, act) triples -> per-state-token action logits."""
+
+    obs_dim: int
+    act_dim: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    context_len: int
+
+    @nn.compact
+    def __call__(self, rtg, obs, act):
+        import jax.numpy as jnp
+        from ray_tpu.models.configs import TransformerConfig
+        from ray_tpu.models.gpt import Block, RMSNorm, stack_layers
+        from ray_tpu.ops.layers import rope_frequencies
+
+        B, K = rtg.shape[:2]
+        cfg = TransformerConfig(
+            vocab_size=1, d_model=self.d_model, n_layers=self.n_layers,
+            n_heads=self.n_heads, d_ff=4 * self.d_model,
+            max_seq_len=3 * self.context_len,
+            dtype=jnp.float32, remat=False, scan_layers=True)
+        e_r = nn.Dense(self.d_model, name="embed_rtg")(rtg[..., None])
+        e_s = nn.Dense(self.d_model, name="embed_obs")(obs)
+        e_a = nn.Dense(self.d_model, name="embed_act")(act)
+        # interleave [r_1, s_1, a_1, r_2, ...] -> [B, 3K, D]
+        x = jnp.stack([e_r, e_s, e_a], axis=2).reshape(B, 3 * K,
+                                                       self.d_model)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len)
+        x = stack_layers(Block, cfg, dict(mesh=None), x, (cos, sin, None))
+        x = RMSNorm(name="final_norm")(x)
+        # state tokens sit at positions 3t+1; predict a_t there
+        state_tokens = x[:, 1::3]
+        return nn.Dense(self.act_dim, name="action_head")(state_tokens)
+
+
+class DT:
+    def __init__(self, config: DTConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        if config.input_path is None:
+            raise ValueError("config.offline_data(input_path=...) required")
+        probe = make_env(config.env_spec)
+        if isinstance(probe.action_space, Box):
+            raise ValueError("this DT implementation handles discrete "
+                             "action spaces (reference dt targets d4rl; "
+                             "the discrete path covers the in-repo envs)")
+        self.act_dim = probe.action_space.n
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        probe.close()
+
+        self._episodes = self._load_episodes(config)
+        self._ep_returns = [float(ep["rtg"][0]) for ep in self._episodes]
+        self.target_return = (config.target_return
+                              if config.target_return is not None
+                              else max(self._ep_returns))
+
+        K = config.context_len
+        self.model = _DTModel(obs_dim=self.obs_dim, act_dim=self.act_dim,
+                              d_model=config.d_model,
+                              n_layers=config.n_layers,
+                              n_heads=config.n_heads, context_len=K)
+        rng = jax.random.PRNGKey(config.seed or 0)
+        self.params = self.model.init(
+            rng, jnp.zeros((1, K)), jnp.zeros((1, K, self.obs_dim)),
+            jnp.zeros((1, K, self.act_dim)))["params"]
+        self.tx = optax.chain(optax.clip_by_global_norm(1.0),
+                              optax.adamw(config.lr, weight_decay=1e-4))
+        self.opt_state = self.tx.init(self.params)
+        self.iteration = 0
+        self._timesteps_total = 0
+
+        model, tx = self.model, self.tx
+
+        def loss_fn(params, rtg, obs, act_onehot, act_labels, mask):
+            logits = model.apply({"params": params}, rtg, obs, act_onehot)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, act_labels[..., None], axis=-1)[..., 0]
+            denom = jnp.maximum(mask.sum(), 1.0)
+            loss = (nll * mask).sum() / denom
+            acc = ((jnp.argmax(logits, -1) == act_labels)
+                   * mask).sum() / denom
+            return loss, acc
+
+        @jax.jit
+        def sgd_step(params, opt_state, rtg, obs, act_onehot, labels, mask):
+            (loss, acc), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, rtg, obs, act_onehot,
+                                       labels, mask)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, acc
+
+        self._sgd_step = sgd_step
+        self._np_rng = np.random.default_rng(config.seed or 0)
+        self._jnp = jnp
+        self._jax = jax
+
+    @staticmethod
+    def _load_episodes(config) -> List[Dict[str, np.ndarray]]:
+        data = JsonReader(config.input_path).read_all()
+        episodes = []
+        for ep in data.split_by_episode():
+            rew = np.asarray(ep[SB.REWARDS], np.float32)
+            rtg = np.cumsum(rew[::-1])[::-1].copy()   # returns-to-go
+            episodes.append({
+                "obs": np.asarray(ep[SB.OBS], np.float32),
+                "act": np.asarray(ep[SB.ACTIONS], np.int64),
+                "rtg": rtg})
+        return episodes
+
+    def _sample_batch(self, batch_size: int):
+        K = self.config.context_len
+        rtg = np.zeros((batch_size, K), np.float32)
+        obs = np.zeros((batch_size, K, self.obs_dim), np.float32)
+        act = np.zeros((batch_size, K), np.int64)
+        mask = np.zeros((batch_size, K), np.float32)
+        for i in range(batch_size):
+            ep = self._episodes[self._np_rng.integers(len(self._episodes))]
+            T = len(ep["act"])
+            start = int(self._np_rng.integers(max(T, 1)))
+            seg = slice(start, min(start + K, T))
+            n = seg.stop - seg.start
+            rtg[i, :n] = ep["rtg"][seg]
+            obs[i, :n] = ep["obs"][seg]
+            act[i, :n] = ep["act"][seg]
+            mask[i, :n] = 1.0
+        onehot = np.eye(self.act_dim, dtype=np.float32)[act]
+        # teacher forcing: the action token at t carries a_t; the
+        # prediction at the state token sees only r<=t, s<=t, a<t (causal)
+        return rtg, obs, onehot, act, mask
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        jnp = self._jnp
+        loss = acc = 0.0
+        for _ in range(cfg.num_sgd_iter):
+            rtg, obs, onehot, labels, mask = self._sample_batch(
+                cfg.train_batch_size)
+            self.params, self.opt_state, loss, acc = self._sgd_step(
+                self.params, self.opt_state, jnp.asarray(rtg),
+                jnp.asarray(obs), jnp.asarray(onehot),
+                jnp.asarray(labels), jnp.asarray(mask))
+            self._timesteps_total += int(mask.sum())
+        self.iteration += 1
+        result = {"info": {"loss": float(loss),
+                           "action_accuracy": float(acc),
+                           "target_return": self.target_return},
+                  "training_iteration": self.iteration,
+                  "timesteps_total": self._timesteps_total}
+        result.update(self.evaluate())
+        return result
+
+    def evaluate(self, episodes: int = 3,
+                 max_steps: int = 500) -> Dict[str, Any]:
+        """Return-conditioned rollout at the target return."""
+        jnp = self._jnp
+        K = self.config.context_len
+        env = make_env(self.config.env_spec)
+        totals = []
+        for ep in range(episodes):
+            ob, _ = env.reset(seed=2000 + ep)
+            rtg_hist = [float(self.target_return)]
+            obs_hist = [np.asarray(ob, np.float32)]
+            act_hist: List[int] = []
+            total, done, steps = 0.0, False, 0
+            while not done and steps < max_steps:
+                n = len(obs_hist)
+                lo = max(n - K, 0)
+                rtg = np.zeros((1, K), np.float32)
+                obs = np.zeros((1, K, self.obs_dim), np.float32)
+                act = np.zeros((1, K), np.int64)
+                seg_n = n - lo
+                rtg[0, :seg_n] = rtg_hist[lo:]
+                obs[0, :seg_n] = np.stack(obs_hist[lo:])
+                acts = act_hist[lo:]
+                if acts:
+                    act[0, :len(acts)] = acts
+                onehot = np.eye(self.act_dim, dtype=np.float32)[act]
+                logits = self.model.apply(
+                    {"params": self.params}, jnp.asarray(rtg),
+                    jnp.asarray(obs), jnp.asarray(onehot))
+                a = int(np.argmax(np.asarray(logits)[0, seg_n - 1]))
+                ob, r, term, trunc, _ = env.step(a)
+                total += r
+                act_hist.append(a)
+                rtg_hist.append(rtg_hist[-1] - r)
+                obs_hist.append(np.asarray(ob, np.float32))
+                done = term or trunc
+                steps += 1
+            totals.append(total)
+        env.close()
+        return {"episode_reward_mean": float(np.mean(totals)),
+                "episodes_total": episodes}
+
+    def get_weights(self) -> Any:
+        return self._jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = self._jax.tree.map(self._jnp.asarray, weights)
+
+    def save(self) -> Checkpoint:
+        return Checkpoint.from_dict({
+            "weights": self.get_weights(), "iteration": self.iteration,
+            "target_return": self.target_return})
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        d = checkpoint.to_dict()
+        self.set_weights(d["weights"])
+        self.iteration = d.get("iteration", 0)
+        self.target_return = d.get("target_return", self.target_return)
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,402 @@
+"""Multi-agent RL: MultiAgentEnv API + independent-PPO training.
+
+Analog of /root/reference/rllib/env/multi_agent_env.py (dict-keyed
+obs/reward/termination with the "__all__" convention) and the
+policy-mapping machinery of rllib/policy/policy_map.py: each agent maps
+to a policy id via ``policy_mapping_fn``; policies with multiple mapped
+agents learn from their pooled experience (parameter sharing). Training
+is independent PPO per policy — each policy's update is the same
+mesh-jitted clipped-surrogate step the single-agent PPO uses.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import AlgorithmConfig
+from ray_tpu.rl.env import CartPoleEnv, Env
+from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
+
+__all_done__ = "__all__"
+
+
+class MultiAgentEnv:
+    """reset() -> (obs_dict, infos); step(action_dict) ->
+    (obs, rewards, terminateds, truncateds, infos), all keyed by agent id;
+    ``terminateds["__all__"]`` ends the episode."""
+
+    agent_ids: List[str] = []
+    observation_spaces: Dict[str, Any] = {}
+    action_spaces: Dict[str, Any] = {}
+
+    def reset(self, *, seed: Optional[int] = None
+              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, Any]):
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MultiAgentCartPole(MultiAgentEnv):
+    """N independent CartPoles, one per agent (the reference's standard
+    multi-agent smoke env, rllib/examples/env/multi_agent.py)."""
+
+    def __init__(self, num_agents: int = 2, max_steps: int = 200):
+        self.agent_ids = [f"agent_{i}" for i in range(num_agents)]
+        self._envs: Dict[str, Env] = {
+            aid: CartPoleEnv(max_steps=max_steps) for aid in self.agent_ids}
+        self.observation_spaces = {
+            aid: e.observation_space for aid, e in self._envs.items()}
+        self.action_spaces = {
+            aid: e.action_space for aid, e in self._envs.items()}
+        self._done: Dict[str, bool] = {}
+
+    def reset(self, *, seed: Optional[int] = None):
+        obs = {}
+        for i, (aid, e) in enumerate(self._envs.items()):
+            o, _ = e.reset(seed=None if seed is None else seed + i)
+            obs[aid] = o
+        self._done = {aid: False for aid in self.agent_ids}
+        return obs, {}
+
+    def step(self, actions: Dict[str, Any]):
+        obs, rews, terms, truncs, infos = {}, {}, {}, {}, {}
+        for aid, act in actions.items():
+            if self._done.get(aid, True):
+                continue
+            o, r, term, trunc, info = self._envs[aid].step(act)
+            obs[aid], rews[aid] = o, r
+            terms[aid], truncs[aid], infos[aid] = term, trunc, info
+            if term or trunc:
+                self._done[aid] = True
+        terms[__all_done__] = all(self._done.values())
+        truncs[__all_done__] = False
+        return obs, rews, terms, truncs, infos
+
+    def close(self):
+        for e in self._envs.values():
+            e.close()
+
+
+def _make_ma_env(spec) -> MultiAgentEnv:
+    return spec() if callable(spec) else spec
+
+
+class MultiAgentRolloutWorker:
+    """Steps a MultiAgentEnv with one JaxPolicy per policy id; returns
+    per-policy GAE-postprocessed SampleBatches."""
+
+    def __init__(self, env_spec, policy_mapping: Dict[str, str], *,
+                 hidden=(64, 64), gamma: float = 0.99, lam: float = 0.95,
+                 episodes_per_sample: int = 2, max_steps: int = 500,
+                 worker_index: int = 0, seed: Optional[int] = None):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from ray_tpu.rl.policy import JaxPolicy
+
+        self.env = _make_ma_env(env_spec)
+        self.mapping = dict(policy_mapping)
+        self.gamma, self.lam = gamma, lam
+        self.episodes_per_sample = episodes_per_sample
+        self.max_steps = max_steps
+        self.worker_index = worker_index
+        self._seed = (seed if seed is not None else 1234) + worker_index
+        self._episode_count = 0
+        self._completed: List[Dict[str, float]] = []
+        self.policies: Dict[str, Any] = {}
+        for aid, pid in self.mapping.items():
+            if pid not in self.policies:
+                self.policies[pid] = JaxPolicy(
+                    self.env.observation_spaces[aid],
+                    self.env.action_spaces[aid],
+                    hidden=tuple(hidden), seed=self._seed)
+
+    def set_weights(self, weights: Dict[str, Any]) -> None:
+        for pid, w in weights.items():
+            if pid in self.policies:
+                self.policies[pid].set_weights(w)
+
+    def sample(self) -> Dict[str, SampleBatch]:
+        # stable agent indices (hash() is per-process randomized)
+        agent_index = {aid: i for i, aid in enumerate(sorted(self.mapping))}
+        parts: Dict[str, List[SampleBatch]] = {
+            pid: [] for pid in self.policies}
+        keys = (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.TERMINATEDS,
+                SB.VF_PREDS, SB.ACTION_LOGP, SB.EPS_ID)
+        for _ in range(self.episodes_per_sample):
+            self._episode_count += 1
+            base_eps = (self.worker_index * 1_000_000
+                        + self._episode_count) * 100
+            obs, _ = self.env.reset(
+                seed=self._seed * 7919 + self._episode_count)
+            # per-agent trajectory buffers: contiguous per agent, so GAE
+            # sees real temporal structure even under parameter sharing
+            traj = {aid: {k: [] for k in keys} for aid in self.mapping}
+            alive = set(obs)
+            ep_reward = 0.0
+            steps = 0
+            while steps < self.max_steps and alive:
+                actions, logps, values = {}, {}, {}
+                for aid in sorted(alive):
+                    pid = self.mapping[aid]
+                    a, lp, v = self.policies[pid].compute_actions(
+                        np.asarray(obs[aid], np.float32)[None])
+                    actions[aid] = int(a[0]) if np.asarray(a[0]).ndim == 0 \
+                        else a[0]
+                    logps[aid], values[aid] = float(lp[0]), float(v[0])
+                nobs, rews, terms, truncs, _ = self.env.step(actions)
+                for aid in actions:
+                    t = traj[aid]
+                    t[SB.OBS].append(np.asarray(obs[aid], np.float32))
+                    t[SB.ACTIONS].append(actions[aid])
+                    t[SB.REWARDS].append(rews.get(aid, 0.0))
+                    t[SB.TERMINATEDS].append(terms.get(aid, False))
+                    t[SB.VF_PREDS].append(values[aid])
+                    t[SB.ACTION_LOGP].append(logps[aid])
+                    t[SB.EPS_ID].append(base_eps + agent_index[aid])
+                    ep_reward += rews.get(aid, 0.0)
+                    # a finished agent takes no more actions: no phantom
+                    # post-terminal rows
+                    if terms.get(aid) or truncs.get(aid):
+                        alive.discard(aid)
+                for aid, ob in nobs.items():
+                    obs[aid] = ob
+                steps += 1
+                if terms.get(__all_done__) or truncs.get(__all_done__):
+                    break
+            for aid, t in traj.items():
+                if not t[SB.REWARDS]:
+                    continue
+                batch = SampleBatch({k: np.asarray(v)
+                                     for k, v in t.items()})
+                parts[self.mapping[aid]].append(
+                    compute_gae(batch, gamma=self.gamma, lam=self.lam))
+            self._completed.append({"episode_reward": ep_reward,
+                                    "episode_len": steps})
+        return {pid: SampleBatch.concat_samples(p)
+                for pid, p in parts.items() if p}
+
+    def get_metrics(self) -> List[Dict[str, float]]:
+        out, self._completed = self._completed, []
+        return out
+
+    def ping(self) -> bool:
+        return True
+
+
+class MultiAgentPPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MultiAgentPPO
+        self.policy_mapping_fn: Callable[[str], str] = lambda aid: "shared"
+        self.episodes_per_sample = 2
+        self.clip_param = 0.2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.lr = 3e-4
+        self.num_sgd_iter = 6
+        self.sgd_minibatch_size = 128
+        self.hidden = (64, 64)
+
+    def multi_agent(self, *, policy_mapping_fn=None,
+                    **kwargs) -> "MultiAgentPPOConfig":
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        self.extra.update(kwargs)
+        return self
+
+
+class MultiAgentPPO:
+    """Independent PPO over the policy map (shared-parameter when several
+    agents map to one policy id)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        import ray_tpu
+        self.config = config
+        if config.env_spec is None:
+            raise ValueError("config.environment(env) is required")
+        probe = _make_ma_env(config.env_spec)
+        self.mapping = {aid: config.policy_mapping_fn(aid)
+                        for aid in probe.agent_ids}
+        # one representative agent per policy for space probing
+        self._spaces = {}
+        for aid, pid in self.mapping.items():
+            self._spaces.setdefault(
+                pid, (probe.observation_spaces[aid],
+                      probe.action_spaces[aid]))
+        probe.close()
+
+        self._worker_cls = ray_tpu.remote(num_cpus=1)(
+            MultiAgentRolloutWorker)
+        self.workers = [
+            self._worker_cls.remote(
+                config.env_spec, self.mapping,
+                hidden=tuple(config.hidden), gamma=config.gamma,
+                lam=config.lam,
+                episodes_per_sample=config.episodes_per_sample,
+                worker_index=i, seed=config.seed)
+            for i in range(max(config.num_rollout_workers, 1))]
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._episode_history: List[Dict[str, float]] = []
+        self._setup_learners()
+        self._sync()
+
+    def _setup_learners(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.rl import models as M
+        from ray_tpu.rl.env import Box
+
+        cfg = self.config
+        self._learners: Dict[str, Dict[str, Any]] = {}
+        clip, vf_c, ent_c = (cfg.clip_param, cfg.vf_loss_coeff,
+                             cfg.entropy_coeff)
+        # stable per-policy seeds (hash() is per-process randomized)
+        pid_index = {pid: i for i, pid in enumerate(sorted(self._spaces))}
+        for pid, (obs_space, act_space) in self._spaces.items():
+            continuous = isinstance(act_space, Box)
+            act_dim = int(np.prod(act_space.shape)) if continuous \
+                else act_space.n
+            obs_dim = int(np.prod(obs_space.shape))
+            model = M.ActorCritic(action_dim=act_dim,
+                                  hidden=tuple(cfg.hidden),
+                                  continuous=continuous)
+            params = model.init(
+                jax.random.PRNGKey((cfg.seed or 0) + pid_index[pid]),
+                jnp.zeros((1, obs_dim)))["params"]
+            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                             optax.adam(cfg.lr))
+            logp_fn = M.diag_gaussian_logp if continuous \
+                else M.categorical_logp
+            ent_fn = M.diag_gaussian_entropy if continuous \
+                else M.categorical_entropy
+
+            def make_step(model=model, tx=tx, logp_fn=logp_fn,
+                          ent_fn=ent_fn):
+                def loss_fn(params, batch):
+                    logits, values = model.apply({"params": params},
+                                                 batch[SB.OBS])
+                    logp = logp_fn(logits, batch[SB.ACTIONS])
+                    ratio = jnp.exp(logp - batch[SB.ACTION_LOGP])
+                    adv = batch[SB.ADVANTAGES]
+                    adv = (adv - adv.mean()) / jnp.maximum(adv.std(), 1e-4)
+                    surr = jnp.minimum(
+                        ratio * adv,
+                        jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+                    vf_loss = 0.5 * jnp.square(
+                        values - batch[SB.VALUE_TARGETS]).mean()
+                    entropy = ent_fn(logits).mean()
+                    total = (-surr.mean() + vf_c * vf_loss
+                             - ent_c * entropy)
+                    return total, {"policy_loss": -surr.mean(),
+                                   "vf_loss": vf_loss, "entropy": entropy}
+
+                @jax.jit
+                def sgd_step(params, opt_state, batch):
+                    (loss, aux), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params, batch)
+                    updates, opt_state = tx.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    aux["total_loss"] = loss
+                    return params, opt_state, aux
+                return sgd_step
+
+            self._learners[pid] = {
+                "params": params, "opt_state": tx.init(params),
+                "step": make_step(),
+            }
+
+    def get_weights(self) -> Dict[str, Any]:
+        import jax
+        return {pid: jax.tree.map(np.asarray, st["params"])
+                for pid, st in self._learners.items()}
+
+    def set_weights(self, weights: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+        import jax
+        for pid, w in weights.items():
+            if pid in self._learners:
+                self._learners[pid]["params"] = jax.tree.map(jnp.asarray, w)
+
+    def _sync(self) -> None:
+        import ray_tpu
+        wref = ray_tpu.put(self.get_weights())
+        for w in self.workers:
+            w.set_weights.remote(wref)
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        import ray_tpu
+        cfg = self.config
+
+        per_policy: Dict[str, List[SampleBatch]] = {}
+        refs = [w.sample.remote() for w in self.workers]
+        for ref in refs:
+            batches = ray_tpu.get(ref, timeout=120.0)
+            for pid, b in batches.items():
+                per_policy.setdefault(pid, []).append(b)
+
+        info: Dict[str, Any] = {}
+        for pid, parts in per_policy.items():
+            batch = SampleBatch.concat_samples(parts)
+            self._timesteps_total += batch.count
+            st = self._learners[pid]
+            aux = {}
+            for epoch in range(cfg.num_sgd_iter):
+                for mb in batch.minibatches(
+                        min(cfg.sgd_minibatch_size, batch.count),
+                        seed=None if cfg.seed is None
+                        else cfg.seed + self.iteration * 100 + epoch):
+                    device_batch = {
+                        k: jnp.asarray(v) for k, v in mb.items()
+                        if k in (SB.OBS, SB.ACTIONS, SB.ACTION_LOGP,
+                                 SB.ADVANTAGES, SB.VALUE_TARGETS)}
+                    st["params"], st["opt_state"], aux = st["step"](
+                        st["params"], st["opt_state"], device_batch)
+            info[pid] = {k: float(v) for k, v in aux.items()}
+        self._sync()
+        self.iteration += 1
+
+        metrics_refs = [w.get_metrics.remote() for w in self.workers]
+        for ref in metrics_refs:
+            try:
+                self._episode_history.extend(ray_tpu.get(ref, timeout=30.0))
+            except Exception:
+                pass
+        self._episode_history = self._episode_history[-100:]
+        rewards = [e["episode_reward"] for e in self._episode_history]
+        return {"info": info, "training_iteration": self.iteration,
+                "timesteps_total": self._timesteps_total,
+                "episode_reward_mean": float(np.mean(rewards))
+                if rewards else float("nan"),
+                "episodes_total": len(self._episode_history)}
+
+    def save(self) -> Checkpoint:
+        return Checkpoint.from_dict({"weights": self.get_weights(),
+                                     "iteration": self.iteration})
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        d = checkpoint.to_dict()
+        self.set_weights(d["weights"])
+        self.iteration = d.get("iteration", 0)
+        self._sync()
+
+    def stop(self) -> None:
+        import ray_tpu
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

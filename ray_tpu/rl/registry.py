@@ -92,6 +92,31 @@ def _cql():
     return CQL, CQLConfig
 
 
+def _apex_dqn():
+    from ray_tpu.rl.apex_dqn import ApexDQN, ApexDQNConfig
+    return ApexDQN, ApexDQNConfig
+
+
+def _crr():
+    from ray_tpu.rl.crr import CRR, CRRConfig
+    return CRR, CRRConfig
+
+
+def _dt():
+    from ray_tpu.rl.dt import DT, DTConfig
+    return DT, DTConfig
+
+
+def _bandit_linucb():
+    from ray_tpu.rl.bandit import BanditConfig, BanditLinUCB
+    return BanditLinUCB, BanditConfig
+
+
+def _bandit_lints():
+    from ray_tpu.rl.bandit import BanditLinTS, BanditLinTSConfig
+    return BanditLinTS, BanditLinTSConfig
+
+
 def _es():
     from ray_tpu.rl.es import ES, ESConfig
     return ES, ESConfig
@@ -118,6 +143,11 @@ _REGISTRY = {
     "marwil": _marwil,
     "cql": _cql,
     "es": _es,
+    "apexdqn": _apex_dqn,
+    "crr": _crr,
+    "dt": _dt,
+    "banditlinucb": _bandit_linucb,
+    "banditlints": _bandit_lints,
     "ars": _ars,
 }
 

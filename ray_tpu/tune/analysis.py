@@ -36,16 +36,24 @@ class ExperimentAnalysis:
             if rows:
                 self.trial_dataframes[trial_id] = rows
 
-    @staticmethod
-    def _coerce(row: Dict[str, Any]) -> Dict[str, Any]:
+    _STRING_KEYS = frozenset({"trial_id", "experiment_tag", "logdir",
+                              "date", "hostname", "node_ip"})
+
+    @classmethod
+    def _coerce(cls, row: Dict[str, Any]) -> Dict[str, Any]:
         """The runner serializes with default=str, so numpy/JAX scalars
         arrive as strings — parse numeric-looking strings back to float
-        or metric comparisons would be lexicographic."""
+        or metric comparisons would be lexicographic. Known string fields
+        (a hex trial_id can be all digits, or parse as 1e45678) and
+        non-finite parses are left alone."""
+        import math
         out = {}
         for k, v in row.items():
-            if isinstance(v, str):
+            if isinstance(v, str) and k not in cls._STRING_KEYS:
                 try:
-                    v = float(v)
+                    f = float(v)
+                    if math.isfinite(f):
+                        v = f
                 except ValueError:
                     pass
             out[k] = v
