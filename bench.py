@@ -39,8 +39,10 @@ def main():
 
     n_dev = len(jax.devices())
     if on_tpu:
-        batch, seq = 8 * n_dev, 1024
-        cfg = get_config("gpt-small", max_seq_len=seq, remat=False,
+        # measured sweep on v5e (16 GiB): batch 16 + remat beats batch 8
+        # no-remat (47.7% vs 45.1% MFU); batch 32 OOMs on fp32 logits
+        batch, seq = 16 * n_dev, 1024
+        cfg = get_config("gpt-small", max_seq_len=seq, remat=True,
                          attention_impl="flash")
         steps, warmup = 20, 3
     else:  # CI smoke fallback

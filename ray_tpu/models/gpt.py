@@ -204,7 +204,9 @@ class Block(nn.Module):
 
 
 class GPT(nn.Module):
-    """Decoder-only LM.  ``__call__`` returns logits [B, S, vocab]."""
+    """Decoder-only LM.  ``__call__`` returns logits [B, S, vocab], or the
+    post-final-norm hidden states [B, S, d_model] with
+    ``return_hidden=True`` (the chunked-loss head path)."""
 
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
@@ -212,7 +214,7 @@ class GPT(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, return_hidden: bool = False):
         cfg = self.cfg
         embed = self.param(
             "embed",
@@ -233,6 +235,11 @@ class GPT(nn.Module):
             remat=cfg.remat and not self.decode, cache=True)
 
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if return_hidden:
+            # memory-lean loss path: the caller projects per sequence
+            # chunk (ops/losses.py chunked_lm_loss) so [B, S, vocab]
+            # logits never materialize
+            return x
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))
         else:

@@ -29,7 +29,7 @@ import optax
 from flax.training import train_state as flax_train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ray_tpu.ops.losses import softmax_cross_entropy
+from ray_tpu.ops.losses import chunked_lm_loss, softmax_cross_entropy
 from ray_tpu.parallel.sharding import (LOGICAL_RULES, ShardingRules,
                                        logical_spec, tree_mesh_shardings)
 
@@ -73,19 +73,19 @@ class OptimizerConfig:
         return tx
 
 
-def lm_loss_fn(apply_fn: Callable, params: Any, batch: Dict[str, jax.Array],
-               z_loss: float = 0.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Next-token LM loss. batch: {"tokens": [B, S+1] or [B, S], "mask"?}."""
+def _lm_loss_body(apply_fn: Callable, params: Any,
+                  batch: Dict[str, jax.Array], z_loss: float,
+                  head: Callable) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Shared next-token plumbing: slice tokens/mask, run the model via
+    ``head(inputs, mask, targets) -> (loss, denom, mutated)``, thread the
+    MoE routers' sown aux losses (ray_tpu/ops/moe.py) into the total."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     mask = batch.get("mask")
     if mask is not None:
         mask = mask[:, 1:].astype(jnp.float32)
-    logits, mutated = apply_fn({"params": params}, inputs,
-                               mutable=["intermediates"])
-    loss, denom = softmax_cross_entropy(logits, targets, mask, z_loss)
+    loss, denom, mutated = head(inputs, mask, targets)
     metrics = {"loss": loss, "tokens": denom}
-    # MoE routers sow per-layer load-balancing losses (ray_tpu/ops/moe.py)
     aux_leaves = [jnp.sum(a) for path, a in jax.tree_util.tree_leaves_with_path(
         mutated.get("intermediates", {})) if "moe_aux_loss" in str(path)]
     if aux_leaves:
@@ -94,6 +94,59 @@ def lm_loss_fn(apply_fn: Callable, params: Any, batch: Dict[str, jax.Array],
         metrics["moe_aux_loss"] = aux
         metrics["loss"] = loss
     return loss, metrics
+
+
+def lm_loss_fn(apply_fn: Callable, params: Any, batch: Dict[str, jax.Array],
+               z_loss: float = 0.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token LM loss. batch: {"tokens": [B, S+1] or [B, S], "mask"?}."""
+    def head(inputs, mask, targets):
+        logits, mutated = apply_fn({"params": params}, inputs,
+                                   mutable=["intermediates"])
+        loss, denom = softmax_cross_entropy(logits, targets, mask, z_loss)
+        return loss, denom, mutated
+
+    return _lm_loss_body(apply_fn, params, batch, z_loss, head)
+
+
+def lm_loss_chunked_fn(apply_fn: Callable, params: Any,
+                       batch: Dict[str, jax.Array],
+                       z_loss: float = 0.0,
+                       chunk_size: int = 256,
+                       head_weight: Optional[Callable] = None
+                       ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token LM loss with the chunked projection head
+    (ops/losses.py chunked_lm_loss): the logits tensor's peak HBM drops
+    by ~S/chunk_size, enabling larger per-chip batches. Same batch
+    contract as lm_loss_fn; the model must support
+    ``apply(..., return_hidden=True)`` (GPT does).
+
+    ``head_weight(params) -> (weight, transpose_weight)`` selects the
+    projection weight. The default follows GPT's naming — an untied
+    ``lm_head`` Dense, else the tied ``embed`` table — and raises for
+    models that match neither; pass an explicit selector (e.g. via
+    functools.partial) for other architectures.
+    """
+    def head(inputs, mask, targets):
+        hidden, mutated = apply_fn({"params": params}, inputs,
+                                   mutable=["intermediates"],
+                                   return_hidden=True)
+        raw = nn.meta.unbox(params)
+        if head_weight is not None:
+            weight, transpose = head_weight(raw)
+        elif "lm_head" in raw:
+            weight, transpose = raw["lm_head"]["kernel"], False
+        elif "embed" in raw:
+            weight, transpose = raw["embed"], True
+        else:
+            raise ValueError(
+                "lm_loss_chunked_fn could not find the projection head "
+                "(no 'lm_head' or 'embed' in params); pass head_weight=")
+        loss, denom = chunked_lm_loss(hidden, weight, targets, mask,
+                                      z_loss, chunk_size,
+                                      transpose_weight=transpose)
+        return loss, denom, mutated
+
+    return _lm_loss_body(apply_fn, params, batch, z_loss, head)
 
 
 def _born_sharded(build_state, step, example_batch, mesh: Mesh,

@@ -145,3 +145,71 @@ def test_moe_layer_routes_and_balances():
     gnorm = jax.tree.reduce(lambda a, b: a + float(jnp.sum(jnp.abs(b))),
                             g["params"], 0.0)
     assert gnorm > 0.0
+
+
+def test_chunked_lm_loss_matches_dense():
+    """chunked projection head == materialized logits + CE, values and
+    gradients (the memory-lean path must be numerically identical)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.ops.losses import chunked_lm_loss, softmax_cross_entropy
+
+    rng = np.random.default_rng(0)
+    B, S, D, V = 2, 48, 16, 64          # S not a multiple of chunk_size
+    hidden = jnp.asarray(rng.normal(size=(B, S, D)), jnp.float32)
+    W = jnp.asarray(rng.normal(size=(D, V)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+    mask = jnp.asarray(rng.integers(0, 2, (B, S)), jnp.float32)
+
+    def dense(h, w):
+        return softmax_cross_entropy(
+            jnp.einsum("bsd,dv->bsv", h, w), labels, mask, z_loss=1e-4)[0]
+
+    def chunked(h, w):
+        return chunked_lm_loss(h, w, labels, mask, z_loss=1e-4,
+                               chunk_size=32)[0]
+
+    ld, gd = jax.value_and_grad(dense, argnums=(0, 1))(hidden, W)
+    lc, gc = jax.value_and_grad(chunked, argnums=(0, 1))(hidden, W)
+    np.testing.assert_allclose(float(ld), float(lc), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gd[0]), np.asarray(gc[0]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gd[1]), np.asarray(gc[1]),
+                               rtol=1e-4, atol=1e-6)
+    # tied-embedding orientation
+    lt = chunked_lm_loss(hidden, W.T, labels, mask, z_loss=1e-4,
+                         chunk_size=32, transpose_weight=True)[0]
+    np.testing.assert_allclose(float(ld), float(lt), rtol=1e-5)
+
+
+def test_lm_loss_chunked_fn_trains():
+    """The chunked head plugs into make_sharded_train and the loss
+    tracks the dense head's trajectory."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.models import GPT, get_config
+    from ray_tpu.parallel import MeshConfig, build_mesh
+    from ray_tpu.train.step import (OptimizerConfig, lm_loss_chunked_fn,
+                                    make_sharded_train)
+
+    cfg = get_config("tiny", max_seq_len=64)
+    mesh = build_mesh(MeshConfig(data=-1))
+    rng = np.random.default_rng(0)
+    batch = {"tokens": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (8, 65)), jnp.int32)}
+    losses = {}
+    for name, loss_fn in (("dense", None), ("chunked", lm_loss_chunked_fn)):
+        model = GPT(cfg, mesh=mesh)
+        kwargs = {} if loss_fn is None else {"loss_fn": loss_fn}
+        init_fn, step_fn, _, _ = make_sharded_train(
+            model, mesh, OptimizerConfig(warmup_steps=1, decay_steps=20),
+            example_batch=batch, **kwargs)
+        state = init_fn(jax.random.PRNGKey(0), batch)
+        for _ in range(3):
+            state, m = step_fn(state, batch)
+        losses[name] = float(m["loss"])
+    # same init/data/optimizer: trajectories must agree closely
+    np.testing.assert_allclose(losses["dense"], losses["chunked"],
+                               rtol=1e-3)
