@@ -85,12 +85,38 @@ def read_binary_files(paths) -> Dataset:
     return _from_tasks(_dsrc.binary_tasks(paths))
 
 
+def read_images(paths, *, size=None, mode: Optional[str] = None) -> Dataset:
+    """Decode an image folder into {"image", "path"} blocks (PIL)."""
+    return _from_tasks(_dsrc.image_tasks(paths, size=size, mode=mode))
+
+
+def from_torch(torch_dataset, *, parallelism: int = 8) -> Dataset:
+    """Materialize a torch map-style Dataset (cf. reference
+    read_api.from_torch): rows are whatever __getitem__ yields."""
+    import builtins
+    # NB: ``range`` here is ray_tpu.data.range (the dataset constructor)
+    items = [torch_dataset[i]
+             for i in builtins.range(len(torch_dataset))]
+    return from_items(items, parallelism=parallelism)
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """Wrap a Hugging Face datasets.Dataset (cf. reference
+    read_api.from_huggingface) via its Arrow table."""
+    import ray_tpu
+    try:
+        table = hf_dataset.data.table
+    except AttributeError:
+        table = hf_dataset.with_format("arrow")[:]
+    return Dataset(ExecutionPlan(block_refs=[ray_tpu.put(table)]))
+
+
 __all__ = [
     "Dataset", "DatasetPipeline", "BlockAccessor", "Block",
     "TaskPoolStrategy", "ActorPoolStrategy", "GroupedData",
     "range", "from_items", "from_pandas", "from_numpy", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_numpy", "read_text",
-    "read_binary_files",
+    "read_binary_files", "read_images", "from_torch", "from_huggingface",
     "RandomAccessDataset", "Preprocessor", "StandardScaler", "MinMaxScaler", "LabelEncoder",
     "OneHotEncoder", "SimpleImputer", "Concatenator", "BatchMapper", "Chain",
 ]

@@ -451,6 +451,18 @@ class Dataset:
         for batch in self.iter_batches(batch_format="numpy", **kwargs):
             yield {k: torch.as_tensor(v) for k, v in batch.items()}
 
+    def to_torch(self, **iter_kwargs):
+        """A torch IterableDataset over this dataset's batches (cf.
+        reference dataset.py to_torch): each item is a dict of tensors."""
+        import torch
+        ds = self
+
+        class _TorchIterable(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                return ds.iter_torch_batches(**iter_kwargs)
+
+        return _TorchIterable()
+
     # ---------------------------------------------------------- splitting
     def split(self, n: int, *, equal: bool = False,
               locality_hints: Optional[List[Any]] = None) -> List["Dataset"]:

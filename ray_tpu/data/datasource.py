@@ -145,6 +145,45 @@ def binary_tasks(paths) -> List[ReadTask]:
 
 # -- writers (run as remote tasks, one file per block) ----------------------
 
+def image_tasks(paths, *, size=None, mode: Optional[str] = None
+                ) -> List[ReadTask]:
+    """Image folder reader (cf. reference data/datasource/
+    image_datasource.py): one block of {"image": [N,H,W,C], "path": [N]}
+    per batch of files; PIL decodes, optional resize + mode conversion."""
+    files = [f for f in _expand_paths(paths)
+             if f.lower().endswith((".png", ".jpg", ".jpeg", ".bmp",
+                                    ".gif", ".webp"))]
+    if not files:
+        raise ValueError(f"no image files under {paths!r}")
+    batch = max(1, len(files) // 8)
+    tasks = []
+    for start in range(0, len(files), batch):
+        chunk = files[start:start + batch]
+
+        def read_chunk(chunk=chunk):
+            from PIL import Image
+            imgs, names = [], []
+            for f in chunk:
+                im = Image.open(f)
+                if mode:
+                    im = im.convert(mode)
+                if size:
+                    im = im.resize(size)
+                imgs.append(np.asarray(im))
+                names.append(f)
+            shapes = {a.shape for a in imgs}
+            if len(shapes) > 1:
+                raise ValueError(
+                    f"images have differing shapes {sorted(shapes)}; "
+                    "pass size=(W, H) and/or mode='RGB' to read_images "
+                    "to homogenize them")
+            return {"image": np.stack(imgs), "path": np.asarray(names)}
+
+        tasks.append(ReadTask(read_chunk, num_rows=len(chunk),
+                              input_files=chunk))
+    return tasks
+
+
 def write_parquet_block(block, path: str, idx: int) -> str:
     from ray_tpu.data.block import BlockAccessor
     import pyarrow.parquet as pq

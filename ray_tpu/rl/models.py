@@ -98,6 +98,35 @@ class QNetwork(nn.Module):
         return v + adv - jnp.mean(adv, axis=-1, keepdims=True)
 
 
+class RecurrentQNetwork(nn.Module):
+    """LSTM Q-network for R2D2 (cf. reference rllib/algorithms/r2d2 +
+    rllib/models/torch/recurrent_net.py): obs -> MLP -> LSTM -> Q values.
+
+    __call__ operates on [B, T, obs] sequences with an explicit carry;
+    ``initial_state(batch)`` builds the zero carry.
+    """
+
+    action_dim: int
+    hidden: Sequence[int] = (64,)
+    lstm_size: int = 64
+
+    @nn.compact
+    def __call__(self, obs_seq: jax.Array, carry):
+        x = obs_seq
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+        rnn = nn.RNN(nn.OptimizedLSTMCell(self.lstm_size), name="lstm",
+                     return_carry=True)
+        carry, outs = rnn(x, initial_carry=carry)
+        q = nn.Dense(self.action_dim, name="q_out")(outs)
+        return q, carry
+
+    @nn.nowrap
+    def initial_state(self, batch_size: int):
+        shape = (batch_size, self.lstm_size)
+        return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+
 class SquashedGaussianActor(nn.Module):
     """SAC actor: tanh-squashed diagonal Gaussian (cf. reference
     rllib/algorithms/sac/sac_torch_model.py policy head)."""

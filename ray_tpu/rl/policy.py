@@ -137,6 +137,72 @@ class QPolicy:
         self.params = jax.tree.map(jnp.asarray, weights)
 
 
+class R2D2Policy:
+    """Recurrent epsilon-greedy policy (R2D2 rollouts): an LSTM carry per
+    env, stepped one timestep at a time; carries reset at episode ends.
+    Same compute_actions triple as QPolicy plus carry management.
+    """
+
+    def __init__(self, observation_space, action_space,
+                 hidden=(64,), seed: int = 0, epsilon: float = 1.0,
+                 lstm_size: int = 64, num_envs: int = 1):
+        if isinstance(action_space, Box):
+            raise ValueError("R2D2Policy requires a discrete action space")
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.epsilon = epsilon
+        self.num_envs = num_envs
+        self.model = M.RecurrentQNetwork(action_dim=action_space.n,
+                                         hidden=tuple(hidden),
+                                         lstm_size=lstm_size)
+        obs_dim = int(np.prod(observation_space.shape))
+        self._rng = jax.random.PRNGKey(seed)
+        self.carry = self.model.initial_state(num_envs)
+        self.params = self.model.init(
+            self._rng, jnp.zeros((num_envs, 1, obs_dim)),
+            self.carry)["params"]
+
+        @jax.jit
+        def _step(params, carry, obs):
+            q, carry = self.model.apply({"params": params},
+                                        obs[:, None, :], carry)
+            return q[:, 0], carry
+
+        self._step = _step
+
+    def set_epsilon(self, epsilon: float) -> None:
+        self.epsilon = float(epsilon)
+
+    def reset_carry(self, done_mask: np.ndarray) -> None:
+        """Zero the carry for envs whose episode just ended."""
+        keep = 1.0 - np.asarray(done_mask, np.float32)[:, None]
+        self.carry = tuple(c * keep for c in self.carry)
+
+    def compute_actions(self, obs: np.ndarray, *, explore: bool = True
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        q, self.carry = self._step(self.params, self.carry,
+                                   jnp.asarray(obs, jnp.float32))
+        greedy = np.asarray(jnp.argmax(q, axis=-1))
+        if explore and self.epsilon > 0.0:
+            self._rng, key = jax.random.split(self._rng)
+            n = greedy.shape[0]
+            k1, k2 = jax.random.split(key)
+            randoms = np.asarray(jax.random.randint(
+                k1, (n,), 0, self.action_space.n))
+            flip = np.asarray(jax.random.uniform(k2, (n,))) < self.epsilon
+            actions = np.where(flip, randoms, greedy)
+        else:
+            actions = greedy
+        return actions, np.zeros(actions.shape[0]), \
+            np.asarray(jnp.max(q, axis=-1))
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
 class DDPGPolicy:
     """Deterministic policy + additive Gaussian exploration noise for
     DDPG/TD3 rollouts (cf. reference

@@ -1,0 +1,187 @@
+"""R2D2: recurrent replay distributed DQN.
+
+Analog of /root/reference/rllib/algorithms/r2d2/r2d2.py (Kapturowski et
+al.): LSTM Q-network trained on replayed fixed-length sequences with the
+zero-start-state strategy — each sequence replays from a zero carry, the
+first ``burn_in`` steps only warm the hidden state (no loss). Double-Q
+targets from a periodically synced target network; per-worker epsilon
+rollouts via the recurrent policy (ray_tpu/rl/policy.py R2D2Policy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models as M
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import Box, make_env
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = R2D2
+        self.lr = 5e-4
+        self.lstm_size = 64
+        self.hidden = (64,)
+        self.rollout_fragment_length = 40   # sequence length L
+        self.burn_in = 8                    # carry warmup, no loss
+        self.train_batch_size = 16          # sequences per update
+        self.buffer_size = 2000             # stored sequences
+        self.learning_starts = 64           # sequences before updates
+        self.target_update_freq = 1000      # env steps between syncs
+        self.n_updates_per_iter = 16
+        self.double_q = True
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 20_000
+
+
+class R2D2(Algorithm):
+    @classmethod
+    def extra_worker_kwargs(cls, config: AlgorithmConfig) -> Dict[str, Any]:
+        return {"policy": "r2d2",
+                "policy_kwargs": {"lstm_size": getattr(config, "lstm_size",
+                                                       64)}}
+
+    def setup_learner(self) -> None:
+        cfg: R2D2Config = self.config
+        probe = make_env(cfg.env_spec)
+        if isinstance(probe.action_space, Box):
+            raise ValueError("R2D2 requires a discrete action space")
+        act_dim = probe.action_space.n
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        probe.close()
+
+        self.model = M.RecurrentQNetwork(action_dim=act_dim,
+                                         hidden=tuple(cfg.hidden),
+                                         lstm_size=cfg.lstm_size)
+        carry0 = self.model.initial_state(1)
+        params = self.model.init(jax.random.PRNGKey(cfg.seed or 0),
+                                 jnp.zeros((1, 1, obs_dim)),
+                                 carry0)["params"]
+        self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                              optax.adam(cfg.lr))
+        self.build_learner_mesh()
+        repl = self.repl_sharding
+        self.params = jax.device_put(params, repl)
+        self.target_params = jax.device_put(params, repl)
+        self.opt_state = jax.device_put(self.tx.init(self.params), repl)
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._steps_since_target_sync = 0
+
+        model, tx = self.model, self.tx
+        gamma, double_q = cfg.gamma, cfg.double_q
+        burn_in = cfg.burn_in
+
+        def loss_fn(params, target_params, batch):
+            B, L = batch[SB.REWARDS].shape
+            carry = model.initial_state(B)
+            # replay the whole sequence from the zero start state
+            q_seq, _ = model.apply({"params": params}, batch[SB.OBS],
+                                   carry)
+            q_taken = jnp.take_along_axis(
+                q_seq, batch[SB.ACTIONS][..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            # targets: value of next step within the same sequence replay
+            tq_seq, _ = model.apply({"params": target_params},
+                                    batch[SB.OBS], carry)
+            # step the networks once more on NEXT_OBS's final column by
+            # shifting: q(s_{t+1}) comes from position t+1 of the replay;
+            # the last position bootstraps through its own next_obs pass
+            q_next_online = jnp.concatenate(
+                [q_seq[:, 1:], q_seq[:, -1:]], axis=1)
+            q_next_target = jnp.concatenate(
+                [tq_seq[:, 1:], tq_seq[:, -1:]], axis=1)
+            if double_q:
+                next_a = jnp.argmax(q_next_online, axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_next_target, next_a[..., None], axis=-1)[..., 0]
+            else:
+                q_next = jnp.max(q_next_target, axis=-1)
+            not_done = 1.0 - batch[SB.TERMINATEDS].astype(jnp.float32)
+            # the final step of a sequence has no in-sequence successor:
+            # exclude it from the loss (mask below) rather than bootstrap
+            # from a stale column
+            target = batch[SB.REWARDS] + gamma * not_done * \
+                jax.lax.stop_gradient(q_next)
+            mask = batch["seq_valid"].astype(jnp.float32)
+            mask = mask.at[:, :burn_in].set(0.0)     # carry warmup only
+            mask = mask.at[:, -1].set(0.0)           # no successor
+            # truncated steps would bootstrap from the auto-reset
+            # episode's first obs at t+1 — exclude them from the loss
+            # (true terminations are handled by not_done above)
+            mask = mask * (1.0 - batch[SB.TRUNCATEDS].astype(jnp.float32))
+            huber = optax.huber_loss(q_taken, target, delta=1.0)
+            denom = jnp.maximum(mask.sum(), 1.0)
+            loss = (huber * mask).sum() / denom
+            return loss, {"mean_q": (q_taken * mask).sum() / denom,
+                          "trained_steps": denom}
+
+        @jax.jit
+        def td_step(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["loss"] = loss
+            aux["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, aux
+
+        self._td_step = td_step
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.device_put(jax.tree.map(jnp.asarray, weights),
+                                     self.repl_sharding)
+        self.target_params = self.params
+
+    def _epsilon(self) -> float:
+        cfg: R2D2Config = self.config
+        frac = min(self._timesteps_total / max(cfg.epsilon_timesteps, 1),
+                   1.0)
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: R2D2Config = self.config
+        self.workers.foreach_worker("set_epsilon", self._epsilon())
+        batches = self.workers.foreach_worker("sample_sequences")
+        for b in batches:
+            self.buffer.add(b)          # rows are [L, ...] sequences
+            self._timesteps_total += int(np.sum(b["seq_valid"]))
+            self._steps_since_target_sync += int(np.sum(b["seq_valid"]))
+
+        info: Dict[str, Any] = {"epsilon": self._epsilon(),
+                                "buffer_sequences": len(self.buffer)}
+        if len(self.buffer) < cfg.learning_starts:
+            return {"info": info}
+
+        n = self.round_minibatch(cfg.train_batch_size)
+        aux_last: Dict[str, Any] = {}
+        for _ in range(cfg.n_updates_per_iter):
+            sample = self.buffer.sample(n)
+            device_batch = self.stage_batch(
+                sample, (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.TERMINATEDS,
+                         SB.TRUNCATEDS, "seq_valid"))
+            self.params, self.opt_state, aux = self._td_step(
+                self.params, self.target_params, self.opt_state,
+                device_batch)
+            aux_last = aux
+
+        if self._steps_since_target_sync >= cfg.target_update_freq:
+            self.target_params = self.params
+            self._steps_since_target_sync = 0
+            info["target_synced"] = True
+        self.workers.sync_weights(self.get_weights())
+        info.update({k: float(v) for k, v in aux_last.items()})
+        return {"info": info}

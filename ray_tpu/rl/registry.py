@@ -117,6 +117,11 @@ def _bandit_lints():
     return BanditLinTS, BanditLinTSConfig
 
 
+def _r2d2():
+    from ray_tpu.rl.r2d2 import R2D2, R2D2Config
+    return R2D2, R2D2Config
+
+
 def _es():
     from ray_tpu.rl.es import ES, ESConfig
     return ES, ESConfig
@@ -143,6 +148,7 @@ _REGISTRY = {
     "marwil": _marwil,
     "cql": _cql,
     "es": _es,
+    "r2d2": _r2d2,
     "apexdqn": _apex_dqn,
     "crr": _crr,
     "dt": _dt,

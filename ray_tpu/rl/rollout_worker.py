@@ -39,6 +39,12 @@ class RolloutWorker:
             self.policy = QPolicy(self.vec.observation_space,
                                   self.vec.action_space, hidden=hidden,
                                   seed=seed, **(policy_kwargs or {}))
+        elif policy == "r2d2":
+            from ray_tpu.rl.policy import R2D2Policy
+            self.policy = R2D2Policy(self.vec.observation_space,
+                                     self.vec.action_space, hidden=hidden,
+                                     seed=seed, num_envs=num_envs,
+                                     **(policy_kwargs or {}))
         elif policy == "ddpg":
             from ray_tpu.rl.policy import DDPGPolicy
             self.policy = DDPGPolicy(self.vec.observation_space,
@@ -191,6 +197,52 @@ class RolloutWorker:
         # flatten [T, N, ...] -> [T*N, ...]
         out = {k: np.concatenate(v) if np.asarray(v[0]).ndim > 1
                else np.stack(v).reshape(-1) for k, v in cols.items()}
+        return SampleBatch(out)
+
+    def sample_sequences(self) -> SampleBatch:
+        """Fixed-length recurrent sequences for R2D2: one sequence of
+        ``rollout_fragment_length`` timesteps per env, the LSTM carry
+        zeroed at sequence start; steps after the first episode end are
+        masked invalid (the next episode needs a fresh zero carry, which
+        the learner can only supply at sequence starts). Rows are
+        [num_envs, L, ...]."""
+        if not hasattr(self.policy, "reset_carry"):
+            raise ValueError("sample_sequences needs the r2d2 policy")
+        n_envs = self.vec.num_envs
+        L = self.fragment
+        # fresh zero state at every sequence start so the learner can
+        # replay from zeros (the R2D2 zero-start-state strategy)
+        self.policy.reset_carry(np.ones(n_envs))
+        cols = {k: [] for k in (SB.OBS, SB.ACTIONS, SB.REWARDS,
+                                SB.TERMINATEDS, SB.TRUNCATEDS)}
+        valid_rows = []
+        alive = np.ones(n_envs, np.float32)
+        for _ in range(L):
+            actions, _, _ = self.policy.compute_actions(self._obs)
+            next_obs, rewards, terms, truncs, infos = self.vec.step(actions)
+            cols[SB.OBS].append(self._obs)
+            cols[SB.ACTIONS].append(actions)
+            cols[SB.REWARDS].append(rewards)
+            cols[SB.TERMINATEDS].append(terms)
+            cols[SB.TRUNCATEDS].append(truncs)
+            valid_rows.append(alive.copy())
+            # episode metrics track every step — including steps of the
+            # auto-reset episode that the sequence no longer trains on
+            self._ep_rewards += rewards
+            self._ep_lens += 1
+            done = np.asarray(terms) | np.asarray(truncs)
+            for i in range(n_envs):
+                if done[i]:
+                    self._completed.append(
+                        {"episode_reward": float(self._ep_rewards[i]),
+                         "episode_len": int(self._ep_lens[i])})
+                    self._ep_rewards[i] = 0.0
+                    self._ep_lens[i] = 0
+            alive = alive * (1.0 - done.astype(np.float32))
+            self._obs = next_obs
+        # [T, N, ...] -> [N, T, ...]
+        out = {k: np.swapaxes(np.stack(v), 0, 1) for k, v in cols.items()}
+        out["seq_valid"] = np.swapaxes(np.stack(valid_rows), 0, 1)
         return SampleBatch(out)
 
     def evaluate_rollout(self, weights, *, n_episodes: int = 1,

@@ -73,8 +73,7 @@ class OptimizerConfig:
         return tx
 
 
-def _lm_loss_body(apply_fn: Callable, params: Any,
-                  batch: Dict[str, jax.Array], z_loss: float,
+def _lm_loss_body(batch: Dict[str, jax.Array],
                   head: Callable) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Shared next-token plumbing: slice tokens/mask, run the model via
     ``head(inputs, mask, targets) -> (loss, denom, mutated)``, thread the
@@ -105,7 +104,7 @@ def lm_loss_fn(apply_fn: Callable, params: Any, batch: Dict[str, jax.Array],
         loss, denom = softmax_cross_entropy(logits, targets, mask, z_loss)
         return loss, denom, mutated
 
-    return _lm_loss_body(apply_fn, params, batch, z_loss, head)
+    return _lm_loss_body(batch, head)
 
 
 def lm_loss_chunked_fn(apply_fn: Callable, params: Any,
@@ -146,7 +145,7 @@ def lm_loss_chunked_fn(apply_fn: Callable, params: Any,
                                       transpose_weight=transpose)
         return loss, denom, mutated
 
-    return _lm_loss_body(apply_fn, params, batch, z_loss, head)
+    return _lm_loss_body(batch, head)
 
 
 def _born_sharded(build_state, step, example_batch, mesh: Mesh,

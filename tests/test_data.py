@@ -240,3 +240,53 @@ def test_random_access_dataset(ray_start_regular):
     assert [g["value"] if g else None for g in got] == \
         ["v0", "v1", None, "v49", "v33"]
     assert "50 rows" in rad.stats()
+
+
+def test_read_images(ray_start_regular, tmp_path):
+    from PIL import Image
+    import numpy as np
+    import ray_tpu.data as rdata
+    for i in range(6):
+        Image.fromarray(
+            np.full((8, 8, 3), i * 20, np.uint8)).save(
+            tmp_path / f"im{i}.png")
+    ds = rdata.read_images(str(tmp_path), mode="RGB")
+    assert ds.count() == 6
+    batch = next(ds.iter_batches(batch_size=6, batch_format="numpy"))
+    assert batch["image"].shape == (6, 8, 8, 3)
+    assert len(batch["path"]) == 6
+
+
+def test_from_torch_and_to_torch(ray_start_regular):
+    import numpy as np
+    import torch
+    import ray_tpu.data as rdata
+
+    class Sq(torch.utils.data.Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return {"x": float(i), "y": float(i * i)}
+
+    ds = rdata.from_torch(Sq())
+    assert ds.count() == 10
+    got = sorted(r["y"] for r in ds.take_all())
+    assert got == [float(i * i) for i in range(10)]
+
+    tds = rdata.from_numpy(np.arange(12).reshape(12, 1)).to_torch(
+        batch_size=4)
+    batches = list(iter(tds))
+    assert len(batches) == 3
+    assert batches[0]["data"].shape == (4, 1)
+    assert str(batches[0]["data"].dtype).startswith("torch")
+
+
+def test_from_huggingface(ray_start_regular):
+    import datasets as hfd
+    import ray_tpu.data as rdata
+    hf = hfd.Dataset.from_dict({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    ds = rdata.from_huggingface(hf)
+    assert ds.count() == 3
+    batch = next(ds.iter_batches(batch_size=3, batch_format="pandas"))
+    assert list(batch["a"]) == [1, 2, 3]
