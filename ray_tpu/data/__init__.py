@@ -102,12 +102,13 @@ def from_torch(torch_dataset, *, parallelism: int = 8) -> Dataset:
 
 def from_huggingface(hf_dataset) -> Dataset:
     """Wrap a Hugging Face datasets.Dataset (cf. reference
-    read_api.from_huggingface) via its Arrow table."""
+    read_api.from_huggingface) via its Arrow table. Datasets carrying an
+    indices mapping (select/shuffle/filter results) are flattened first —
+    the raw table ignores the mapping and would return the wrong rows."""
     import ray_tpu
-    try:
-        table = hf_dataset.data.table
-    except AttributeError:
-        table = hf_dataset.with_format("arrow")[:]
+    if getattr(hf_dataset, "_indices", None) is not None:
+        hf_dataset = hf_dataset.flatten_indices()
+    table = hf_dataset.data.table
     return Dataset(ExecutionPlan(block_refs=[ray_tpu.put(table)]))
 
 

@@ -36,6 +36,7 @@ from ray_tpu.rl.pg import PG, PGConfig  # noqa: F401
 from ray_tpu.rl.policy import (DDPGPolicy, JaxPolicy, QPolicy,  # noqa: F401
                                R2D2Policy, SACPolicy)
 from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rl.qmix import QMix, QMixConfig, TwoStepGame  # noqa: F401
 from ray_tpu.rl.r2d2 import R2D2, R2D2Config  # noqa: F401
 from ray_tpu.rl.registry import get_algorithm_class  # noqa: F401
 from ray_tpu.rl.replay_buffer import (PrioritizedReplayBuffer,  # noqa: F401
@@ -59,7 +60,8 @@ __all__ = [
     "BanditLinUCB", "BanditLinTS", "BanditConfig", "BanditLinTSConfig",
     "LinearDiscreteEnv", "MultiAgentEnv", "MultiAgentCartPole",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentRolloutWorker",
-    "R2D2", "R2D2Config", "R2D2Policy",
+    "R2D2", "R2D2Config", "R2D2Policy", "QMix", "QMixConfig",
+    "TwoStepGame",
     "get_algorithm_class", "SampleBatch", "compute_gae", "ReplayBuffer",
     "PrioritizedReplayBuffer", "Env", "Box", "Discrete", "CartPoleEnv",
     "PendulumEnv", "VectorEnv", "make_env", "register_env",

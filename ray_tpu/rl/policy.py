@@ -20,6 +20,17 @@ from ray_tpu.rl import models as M
 from ray_tpu.rl.env import Box, Discrete
 
 
+def _epsilon_greedy(rng, greedy: np.ndarray, n_actions: int,
+                    epsilon: float):
+    """Shared epsilon-greedy mix-in: returns (actions, next_rng)."""
+    rng, key = jax.random.split(rng)
+    n = greedy.shape[0]
+    k1, k2 = jax.random.split(key)
+    randoms = np.asarray(jax.random.randint(k1, (n,), 0, n_actions))
+    flip = np.asarray(jax.random.uniform(k2, (n,))) < epsilon
+    return np.where(flip, randoms, greedy), rng
+
+
 class JaxPolicy:
     def __init__(self, observation_space, action_space,
                  hidden=(256, 256), seed: int = 0):
@@ -119,13 +130,8 @@ class QPolicy:
         greedy, maxq = self._greedy(self.params, obs)
         greedy = np.asarray(greedy)
         if explore and self.epsilon > 0.0:
-            self._rng, key = jax.random.split(self._rng)
-            n = greedy.shape[0]
-            k1, k2 = jax.random.split(key)
-            randoms = np.asarray(jax.random.randint(
-                k1, (n,), 0, self.action_space.n))
-            flip = np.asarray(jax.random.uniform(k2, (n,))) < self.epsilon
-            actions = np.where(flip, randoms, greedy)
+            actions, self._rng = _epsilon_greedy(
+                self._rng, greedy, self.action_space.n, self.epsilon)
         else:
             actions = greedy
         return actions, np.zeros(actions.shape[0]), np.asarray(maxq)
@@ -184,13 +190,8 @@ class R2D2Policy:
                                    jnp.asarray(obs, jnp.float32))
         greedy = np.asarray(jnp.argmax(q, axis=-1))
         if explore and self.epsilon > 0.0:
-            self._rng, key = jax.random.split(self._rng)
-            n = greedy.shape[0]
-            k1, k2 = jax.random.split(key)
-            randoms = np.asarray(jax.random.randint(
-                k1, (n,), 0, self.action_space.n))
-            flip = np.asarray(jax.random.uniform(k2, (n,))) < self.epsilon
-            actions = np.where(flip, randoms, greedy)
+            actions, self._rng = _epsilon_greedy(
+                self._rng, greedy, self.action_space.n, self.epsilon)
         else:
             actions = greedy
         return actions, np.zeros(actions.shape[0]), \

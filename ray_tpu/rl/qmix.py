@@ -1,0 +1,331 @@
+"""QMIX: monotonic value decomposition for cooperative multi-agent RL.
+
+Analog of /root/reference/rllib/algorithms/qmix/qmix.py (Rashid et al.):
+per-agent Q networks (shared parameters + agent-id one-hot) whose chosen
+Qs feed a mixing network — hypernetworks conditioned on the global state
+emit |W| (monotonicity) — trained end-to-end on the team reward with a
+target mixer. Includes the QMIX paper's TwoStepGame (the reference's
+canonical QMIX testbed, rllib/examples/two_step_game.py): coordination
+pays 8, the greedy-independent solution only 7.
+
+Envs are tiny matrix/grid games: stepping runs driver-local (like the
+bandits); the jitted mixer update is the compute path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rl.algorithm import AlgorithmConfig
+from ray_tpu.rl.multi_agent import MultiAgentEnv
+from ray_tpu.rl.env import Box, Discrete
+
+
+class TwoStepGame(MultiAgentEnv):
+    """QMIX paper matrix game. Step 1: agent_0 picks the branch. Step 2:
+    payoff 7 in branch A regardless; branch B pays [[0,1],[1,8]] — the
+    8 needs both agents to coordinate on action 1."""
+
+    payoff_b = np.array([[0.0, 1.0], [1.0, 8.0]])
+
+    def __init__(self):
+        self.agent_ids = ["agent_0", "agent_1"]
+        obs_space = Box(low=0.0, high=1.0, shape=(3,))
+        self.observation_spaces = {a: obs_space for a in self.agent_ids}
+        self.action_spaces = {a: Discrete(2) for a in self.agent_ids}
+        self._stage = 0
+        self._branch = 0
+
+    def state(self) -> np.ndarray:
+        """Global state for the mixer: one-hot over {s1, s2A, s2B}."""
+        s = np.zeros(3, np.float32)
+        s[0 if self._stage == 0 else 1 + self._branch] = 1.0
+        return s
+
+    def _obs(self):
+        return {a: self.state() for a in self.agent_ids}
+
+    def reset(self, *, seed: Optional[int] = None):
+        self._stage = 0
+        self._branch = 0
+        return self._obs(), {}
+
+    def step(self, actions: Dict[str, int]):
+        if self._stage == 0:
+            self._branch = int(actions["agent_0"])
+            self._stage = 1
+            zeros = {a: 0.0 for a in self.agent_ids}
+            return self._obs(), zeros, \
+                {"__all__": False, **{a: False for a in self.agent_ids}}, \
+                {"__all__": False}, {}
+        if self._branch == 0:
+            r = 7.0
+        else:
+            r = float(self.payoff_b[int(actions["agent_0"]),
+                                    int(actions["agent_1"])])
+        rews = {a: r / 2.0 for a in self.agent_ids}   # team reward split
+        terms = {"__all__": True, **{a: True for a in self.agent_ids}}
+        return self._obs(), rews, terms, {"__all__": False}, {}
+
+
+class QMixConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = QMix
+        self.lr = 5e-4
+        self.mixing_embed_dim = 16
+        self.hidden = (32,)
+        self.buffer_size = 2000          # stored joint episodes
+        self.train_batch_size = 32
+        self.learning_starts = 32
+        self.target_update_freq = 200    # env episodes between syncs
+        self.n_updates_per_iter = 16
+        self.episodes_per_iter = 32
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 3000
+
+
+class QMix:
+    """Driver-local cooperative Q-learner with a monotonic mixer."""
+
+    def __init__(self, config: QMixConfig):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        env = config.env_spec() if callable(config.env_spec) \
+            else config.env_spec
+        if not isinstance(env, MultiAgentEnv):
+            raise ValueError("QMIX requires a MultiAgentEnv")
+        self.env = env
+        self.agents: List[str] = list(env.agent_ids)
+        n_agents = len(self.agents)
+        a0 = self.agents[0]
+        self.n_actions = env.action_spaces[a0].n
+        obs_dim = int(np.prod(env.observation_spaces[a0].shape))
+        state_dim = len(env.state()) if hasattr(env, "state") \
+            else obs_dim * n_agents
+        self._has_state = hasattr(env, "state")
+        in_dim = obs_dim + n_agents      # obs + agent-id one-hot
+
+        class AgentQ(nn.Module):
+            n_actions_: int
+            hidden_: Tuple[int, ...]
+
+            @nn.compact
+            def __call__(self, x):
+                for i, h in enumerate(self.hidden_):
+                    x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+                return nn.Dense(self.n_actions_, name="q")(x)
+
+        class Mixer(nn.Module):
+            """Q_tot = w2 . elu(|W1| q + b1) + b2, |W| from state
+            hypernets (monotonic in each agent Q)."""
+            embed: int
+            n_agents_: int
+
+            @nn.compact
+            def __call__(self, agent_qs, state):
+                # agent_qs: [B, n_agents]; state: [B, state_dim]
+                e, n = self.embed, self.n_agents_
+                w1 = jnp.abs(nn.Dense(e * n, name="hyper_w1")(state))
+                w1 = w1.reshape(-1, n, e)
+                b1 = nn.Dense(e, name="hyper_b1")(state)
+                hid = nn.elu(jnp.einsum("bn,bne->be", agent_qs, w1) + b1)
+                w2 = jnp.abs(nn.Dense(e, name="hyper_w2")(state))
+                b2 = nn.Dense(1, name="hyper_b2")(
+                    nn.relu(nn.Dense(e, name="hyper_b2_h")(state)))[:, 0]
+                return jnp.einsum("be,be->b", hid, w2) + b2
+
+        self.agent_q = AgentQ(n_actions_=self.n_actions,
+                              hidden_=tuple(config.hidden))
+        self.mixer = Mixer(embed=config.mixing_embed_dim,
+                           n_agents_=n_agents)
+        rng = jax.random.PRNGKey(config.seed or 0)
+        r1, r2 = jax.random.split(rng)
+        q_params = self.agent_q.init(r1, jnp.zeros((1, in_dim)))["params"]
+        m_params = self.mixer.init(r2, jnp.zeros((1, n_agents)),
+                                   jnp.zeros((1, state_dim)))["params"]
+        self.params = {"q": q_params, "mixer": m_params}
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.chain(optax.clip_by_global_norm(config.grad_clip),
+                              optax.adam(config.lr))
+        self.opt_state = self.tx.init(self.params)
+
+        agent_q, mixer = self.agent_q, self.mixer
+        gamma = config.gamma
+        eye = np.eye(n_agents, dtype=np.float32)
+
+        def agent_inputs(obs):              # [B, n, obs] -> [B, n, in]
+            ids = jnp.broadcast_to(jnp.asarray(eye),
+                                   obs.shape[:1] + eye.shape)
+            return jnp.concatenate([obs, ids], axis=-1)
+
+        def q_all(params, obs):             # -> [B, n, n_actions]
+            return agent_q.apply({"params": params}, agent_inputs(obs))
+
+        def loss_fn(params, target_params, batch):
+            q = q_all(params["q"], batch["obs"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][..., None].astype(jnp.int32),
+                axis=-1)[..., 0]                        # [B, n]
+            q_tot = mixer.apply({"params": params["mixer"]},
+                                q_taken, batch["state"])
+            q_next = q_all(target_params["q"], batch["next_obs"])
+            q_next_max = jnp.max(q_next, axis=-1)       # [B, n]
+            target_tot = mixer.apply({"params": target_params["mixer"]},
+                                     q_next_max, batch["next_state"])
+            not_done = 1.0 - batch["dones"].astype(jnp.float32)
+            y = batch["rewards"] + gamma * not_done * \
+                jax.lax.stop_gradient(target_tot)
+            loss = jnp.mean(jnp.square(q_tot - y))
+            return loss, {"mean_q_tot": q_tot.mean()}
+
+        @jax.jit
+        def td_step(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["loss"] = loss
+            return params, opt_state, aux
+
+        @jax.jit
+        def greedy(params, obs):
+            return jnp.argmax(q_all(params, obs[None]), axis=-1)[0]
+
+        self._td_step = td_step
+        self._greedy = greedy
+        self._jnp = jnp
+        self._jax = jax
+        self._np_rng = np.random.default_rng(config.seed or 0)
+        self._buffer: List[Dict[str, np.ndarray]] = []
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._episodes_total = 0
+        self._episodes_since_sync = 0
+        self._reward_window: List[float] = []
+
+    # -- acting ------------------------------------------------------------
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(self._timesteps_total / max(cfg.epsilon_timesteps, 1),
+                   1.0)
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+
+    def _act(self, obs_stack: np.ndarray, explore: bool) -> np.ndarray:
+        greedy = np.asarray(self._greedy(self.params["q"],
+                                         self._jnp.asarray(obs_stack)))
+        if explore:
+            eps = self._epsilon()
+            flip = self._np_rng.random(len(self.agents)) < eps
+            randoms = self._np_rng.integers(0, self.n_actions,
+                                            len(self.agents))
+            return np.where(flip, randoms, greedy)
+        return greedy
+
+    def _run_episode(self, explore: bool = True) -> float:
+        env = self.env
+        obs, _ = env.reset()
+        total = 0.0
+        steps = 0
+        while steps < 200:
+            obs_stack = np.stack([np.asarray(obs[a], np.float32).reshape(-1)
+                                  for a in self.agents])
+            state = env.state() if self._has_state else obs_stack.reshape(-1)
+            acts = self._act(obs_stack, explore)
+            action_dict = {a: int(acts[i])
+                           for i, a in enumerate(self.agents)}
+            nobs, rews, terms, truncs, _ = env.step(action_dict)
+            team_r = float(sum(rews.values()))
+            done = bool(terms.get("__all__")) or bool(truncs.get("__all__"))
+            nobs_stack = np.stack(
+                [np.asarray(nobs.get(a, obs[a]), np.float32).reshape(-1)
+                 for a in self.agents])
+            nstate = env.state() if self._has_state \
+                else nobs_stack.reshape(-1)
+            if explore:
+                self._buffer.append({
+                    "obs": obs_stack, "actions": acts.astype(np.int64),
+                    "state": state, "next_obs": nobs_stack,
+                    "next_state": nstate,
+                    "rewards": np.float32(team_r),
+                    "dones": np.float32(done)})
+                if len(self._buffer) > self.config.buffer_size:
+                    self._buffer.pop(0)
+            total += team_r
+            if explore:
+                # eval rollouts must not advance the epsilon schedule
+                # or the reported training timesteps
+                self._timesteps_total += 1
+            obs = nobs
+            steps += 1
+            if done:
+                break
+        return total
+
+    # -- training ----------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        jnp = self._jnp
+        for _ in range(cfg.episodes_per_iter):
+            self._reward_window.append(self._run_episode(explore=True))
+            self._episodes_total += 1
+            self._episodes_since_sync += 1
+        self._reward_window = self._reward_window[-200:]
+
+        info: Dict[str, Any] = {"epsilon": self._epsilon(),
+                                "buffer_size": len(self._buffer)}
+        aux: Dict[str, Any] = {}
+        if len(self._buffer) >= cfg.learning_starts:
+            for _ in range(cfg.n_updates_per_iter):
+                idx = self._np_rng.choice(
+                    len(self._buffer),
+                    size=min(cfg.train_batch_size, len(self._buffer)),
+                    replace=False)
+                rows = [self._buffer[i] for i in idx]
+                batch = {k: jnp.asarray(np.stack([r[k] for r in rows]))
+                         for k in rows[0]}
+                self.params, self.opt_state, aux = self._td_step(
+                    self.params, self.target_params, self.opt_state, batch)
+            info.update({k: float(v) for k, v in aux.items()})
+        if self._episodes_since_sync >= cfg.target_update_freq:
+            self.target_params = self._jax.tree.map(jnp.copy, self.params)
+            self._episodes_since_sync = 0
+            info["target_synced"] = True
+        self.iteration += 1
+        return {"info": info, "training_iteration": self.iteration,
+                "timesteps_total": self._timesteps_total,
+                "episodes_total": self._episodes_total,
+                "episode_reward_mean": float(np.mean(self._reward_window))}
+
+    def evaluate(self, episodes: int = 10) -> float:
+        return float(np.mean([self._run_episode(explore=False)
+                              for _ in range(episodes)]))
+
+    def get_weights(self) -> Any:
+        return self._jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = self._jax.tree.map(self._jnp.asarray, weights)
+        # TD targets must come from the restored weights, not a stale net
+        self.target_params = self._jax.tree.map(self._jnp.copy, self.params)
+
+    def save(self) -> Checkpoint:
+        return Checkpoint.from_dict({"weights": self.get_weights(),
+                                     "iteration": self.iteration})
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        d = checkpoint.to_dict()
+        self.set_weights(d["weights"])
+        self.iteration = d.get("iteration", 0)
+
+    def stop(self) -> None:
+        self.env.close()

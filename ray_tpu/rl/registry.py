@@ -117,6 +117,11 @@ def _bandit_lints():
     return BanditLinTS, BanditLinTSConfig
 
 
+def _qmix():
+    from ray_tpu.rl.qmix import QMix, QMixConfig
+    return QMix, QMixConfig
+
+
 def _r2d2():
     from ray_tpu.rl.r2d2 import R2D2, R2D2Config
     return R2D2, R2D2Config
@@ -149,6 +154,7 @@ _REGISTRY = {
     "cql": _cql,
     "es": _es,
     "r2d2": _r2d2,
+    "qmix": _qmix,
     "apexdqn": _apex_dqn,
     "crr": _crr,
     "dt": _dt,
