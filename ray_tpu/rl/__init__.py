@@ -12,6 +12,8 @@ from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from ray_tpu.rl.env import (Box, CartPoleEnv, Discrete, Env,  # noqa: F401
                             PendulumEnv, VectorEnv, make_env, register_env)
 from ray_tpu.rl.a2c import A2C, A2CConfig, A3C, A3CConfig  # noqa: F401
+from ray_tpu.rl.alpha_zero import (MCTS, AlphaZero,  # noqa: F401
+                                   AlphaZeroConfig, TicTacToe)
 from ray_tpu.rl.apex_dqn import ApexDQN, ApexDQNConfig  # noqa: F401
 from ray_tpu.rl.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rl.bandit import (BanditConfig, BanditLinTS,  # noqa: F401
@@ -60,6 +62,7 @@ __all__ = [
     "BanditLinUCB", "BanditLinTS", "BanditConfig", "BanditLinTSConfig",
     "LinearDiscreteEnv", "MultiAgentEnv", "MultiAgentCartPole",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentRolloutWorker",
+    "AlphaZero", "AlphaZeroConfig", "MCTS", "TicTacToe",
     "R2D2", "R2D2Config", "R2D2Policy", "QMix", "QMixConfig",
     "TwoStepGame",
     "get_algorithm_class", "SampleBatch", "compute_gae", "ReplayBuffer",

@@ -117,6 +117,11 @@ def _bandit_lints():
     return BanditLinTS, BanditLinTSConfig
 
 
+def _alpha_zero():
+    from ray_tpu.rl.alpha_zero import AlphaZero, AlphaZeroConfig
+    return AlphaZero, AlphaZeroConfig
+
+
 def _qmix():
     from ray_tpu.rl.qmix import QMix, QMixConfig
     return QMix, QMixConfig
@@ -155,6 +160,7 @@ _REGISTRY = {
     "es": _es,
     "r2d2": _r2d2,
     "qmix": _qmix,
+    "alphazero": _alpha_zero,
     "apexdqn": _apex_dqn,
     "crr": _crr,
     "dt": _dt,
