@@ -187,6 +187,11 @@ class AlphaZero:
 
         self.config = config
         env_ctor = config.env_spec or TicTacToe
+        if isinstance(env_ctor, str):
+            raise ValueError(
+                "AlphaZero needs a board-env class/callable with the "
+                "TicTacToe interface (legal_actions/winner/clone), not a "
+                f"registered env name ({env_ctor!r})")
         self.env_ctor = env_ctor
         probe = env_ctor()
         self.n_actions = probe.n_actions
@@ -326,7 +331,7 @@ class AlphaZero:
             az_player = 1 if g % 2 == 0 else -1
             mcts = MCTS(self._predict,
                         num_simulations=self.config.num_simulations,
-                        rng=self._np_rng)
+                        c_puct=self.config.c_puct, rng=self._np_rng)
             while True:
                 if env.player == az_player:
                     if use_search:
@@ -357,13 +362,17 @@ class AlphaZero:
         self.params = self._jax.tree.map(self._jnp.asarray, weights)
 
     def save(self) -> Checkpoint:
-        return Checkpoint.from_dict({"weights": self.get_weights(),
-                                     "iteration": self.iteration})
+        return Checkpoint.from_dict({
+            "weights": self.get_weights(), "iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "episodes_total": self._episodes_total})
 
     def restore(self, checkpoint: Checkpoint) -> None:
         d = checkpoint.to_dict()
         self.set_weights(d["weights"])
         self.iteration = d.get("iteration", 0)
+        self._timesteps_total = d.get("timesteps_total", 0)
+        self._episodes_total = d.get("episodes_total", 0)
 
     def stop(self) -> None:
         pass

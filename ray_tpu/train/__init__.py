@@ -9,6 +9,8 @@ parity; ray_tpu.train.step holds the sharded train-step builder.
 from ray_tpu.train.base_trainer import (BackendConfig,  # noqa: F401
                                         BaseTrainer, DataParallelTrainer,
                                         TrainingFailedError)
+from ray_tpu.train.huggingface_trainer import \
+    HuggingFaceTrainer  # noqa: F401
 from ray_tpu.train.jax_trainer import (JaxConfig, JaxTrainer,  # noqa: F401
                                        get_mesh)
 from ray_tpu.train.gbdt_trainer import (GBDTTrainer,  # noqa: F401
@@ -17,8 +19,9 @@ from ray_tpu.train.gbdt_trainer import (GBDTTrainer,  # noqa: F401
 from ray_tpu.train.predictor import (BatchPredictor,  # noqa: F401
                                      JaxPredictor, Predictor)
 from ray_tpu.train.step import (OptimizerConfig,  # noqa: F401
-                                classification_loss_fn, lm_loss_fn,
-                                make_sharded_train, make_vision_train)
+                                classification_loss_fn, lm_loss_chunked_fn,
+                                lm_loss_fn, make_sharded_train,
+                                make_vision_train)
 from ray_tpu.train.torch_trainer import (TorchConfig,  # noqa: F401
                                          TorchTrainer, prepare_data_loader,
                                          prepare_model)
@@ -32,5 +35,5 @@ __all__ = [
     "make_vision_train", "classification_loss_fn", "Predictor",
     "JaxPredictor", "BatchPredictor", "GBDTTrainer", "XGBoostTrainer",
     "LightGBMTrainer", "SklearnPredictor",
-    "lm_loss_fn",
+    "lm_loss_fn", "lm_loss_chunked_fn", "HuggingFaceTrainer",
 ]

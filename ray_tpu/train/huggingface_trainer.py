@@ -1,0 +1,86 @@
+"""HuggingFaceTrainer: run a transformers.Trainer inside Train workers.
+
+Analog of /root/reference/python/ray/train/huggingface/
+huggingface_trainer.py: the user supplies
+``trainer_init_per_worker(train_dataset, eval_dataset, **config) ->
+transformers.Trainer``; each Train worker builds it against its dataset
+shard, a TrainerCallback forwards every transformers log to
+``session.report`` (with a checkpoint at save events), and the standard
+Train result/checkpoint plumbing applies. CPU torch here (this image);
+the TPU-native path is JaxTrainer — this wrapper exists for drop-in
+parity with HF training code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.torch_trainer import TorchConfig, TorchTrainer
+
+
+def _make_loop(trainer_init_per_worker: Callable):
+    def train_loop(config: Dict[str, Any]):
+        import transformers
+        from ray_tpu.air import session
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        train_ds = session.get_dataset_shard("train")
+        eval_ds = session.get_dataset_shard("evaluation")
+        if train_ds is not None and hasattr(train_ds, "to_torch"):
+            train_ds = train_ds.to_torch()
+        if eval_ds is not None and hasattr(eval_ds, "to_torch"):
+            eval_ds = eval_ds.to_torch()
+        trainer: "transformers.Trainer" = trainer_init_per_worker(
+            train_ds, eval_ds, **(config or {}))
+
+        class _ReportCallback(transformers.TrainerCallback):
+            def on_log(self, args, state, control, logs=None, **kwargs):
+                if not logs:
+                    return
+                metrics = {k: v for k, v in logs.items()
+                           if isinstance(v, (int, float))}
+                metrics["step"] = state.global_step
+                metrics["epoch"] = float(state.epoch or 0.0)
+                session.report(metrics)
+
+            def on_save(self, args, state, control, **kwargs):
+                import os
+                ckpt_dir = os.path.join(
+                    args.output_dir,
+                    f"checkpoint-{state.global_step}")
+                if os.path.isdir(ckpt_dir):
+                    session.report(
+                        {"step": state.global_step, "saved": True},
+                        checkpoint=Checkpoint.from_directory(ckpt_dir))
+
+        trainer.add_callback(_ReportCallback())
+        result = trainer.train()
+        final = {k: v for k, v in (result.metrics or {}).items()
+                 if isinstance(v, (int, float))}
+        final["done"] = True
+        session.report(final)
+
+    return train_loop
+
+
+class HuggingFaceTrainer(TorchTrainer):
+    """``HuggingFaceTrainer(trainer_init_per_worker, scaling_config=...,
+    datasets={"train": ds}).fit()`` (cf. reference
+    huggingface_trainer.py)."""
+
+    def __init__(self, trainer_init_per_worker: Callable, *,
+                 trainer_init_config: Optional[Dict[str, Any]] = None,
+                 torch_config: Optional[TorchConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint=None):
+        super().__init__(
+            _make_loop(trainer_init_per_worker),
+            train_loop_config=trainer_init_config,
+            torch_config=torch_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint)

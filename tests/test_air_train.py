@@ -164,3 +164,52 @@ def test_multi_worker_group(ray_start_regular):
     assert result.error is None
     assert result.metrics["rank"] == 0
     assert result.metrics["ws"] == 2
+
+
+def test_huggingface_trainer(ray_start_regular, tmp_path):
+    """HuggingFaceTrainer runs a real transformers.Trainer in a Train
+    worker, forwarding its logs as session reports (cf. reference
+    train/huggingface/huggingface_trainer.py)."""
+    from ray_tpu.air import RunConfig, ScalingConfig
+    from ray_tpu.train import HuggingFaceTrainer
+
+    def trainer_init(train_ds, eval_ds, **config):
+        import torch
+        import transformers
+
+        class Tiny(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = torch.nn.Linear(4, 2)
+
+            def forward(self, x=None, labels=None, **kw):
+                logits = self.lin(x)
+                loss = torch.nn.functional.cross_entropy(logits, labels)
+                return {"loss": loss, "logits": logits}
+
+        class Ds(torch.utils.data.Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                g = torch.Generator().manual_seed(i)
+                x = torch.randn(4, generator=g)
+                return {"x": x, "labels": int(x.sum() > 0)}
+
+        args = transformers.TrainingArguments(
+            output_dir=config["out"], num_train_epochs=2,
+            per_device_train_batch_size=8, logging_steps=2,
+            save_strategy="no", report_to=[], disable_tqdm=True,
+            use_cpu=True)
+        return transformers.Trainer(model=Tiny(), args=args,
+                                    train_dataset=Ds())
+
+    trainer = HuggingFaceTrainer(
+        trainer_init,
+        trainer_init_config={"out": str(tmp_path / "hf")},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="hfexp", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics.get("done") is True
+    assert "train_loss" in result.metrics or "loss" in result.metrics
