@@ -30,6 +30,8 @@ from ray_tpu.rl.offline import (BC, BCConfig, MARWIL,  # noqa: F401
                                 MARWILConfig, JsonReader, JsonWriter,
                                 collect_dataset,
                                 importance_sampling_estimate)
+from ray_tpu.rl.maddpg import (MADDPG, CooperativeNav,  # noqa: F401
+                               MADDPGConfig)
 from ray_tpu.rl.multi_agent import (MultiAgentCartPole,  # noqa: F401
                                     MultiAgentEnv, MultiAgentPPO,
                                     MultiAgentPPOConfig,
@@ -63,6 +65,7 @@ __all__ = [
     "LinearDiscreteEnv", "MultiAgentEnv", "MultiAgentCartPole",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentRolloutWorker",
     "AlphaZero", "AlphaZeroConfig", "MCTS", "TicTacToe",
+    "MADDPG", "MADDPGConfig", "CooperativeNav",
     "R2D2", "R2D2Config", "R2D2Policy", "QMix", "QMixConfig",
     "TwoStepGame",
     "get_algorithm_class", "SampleBatch", "compute_gae", "ReplayBuffer",

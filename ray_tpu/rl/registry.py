@@ -122,6 +122,11 @@ def _alpha_zero():
     return AlphaZero, AlphaZeroConfig
 
 
+def _maddpg():
+    from ray_tpu.rl.maddpg import MADDPG, MADDPGConfig
+    return MADDPG, MADDPGConfig
+
+
 def _qmix():
     from ray_tpu.rl.qmix import QMix, QMixConfig
     return QMix, QMixConfig
@@ -161,6 +166,7 @@ _REGISTRY = {
     "r2d2": _r2d2,
     "qmix": _qmix,
     "alphazero": _alpha_zero,
+    "maddpg": _maddpg,
     "apexdqn": _apex_dqn,
     "crr": _crr,
     "dt": _dt,

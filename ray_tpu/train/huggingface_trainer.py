@@ -55,7 +55,12 @@ def _make_loop(trainer_init_per_worker: Callable):
                         checkpoint=Checkpoint.from_directory(ckpt_dir))
 
         trainer.add_callback(_ReportCallback())
-        result = trainer.train()
+        resume_dir = None
+        ckpt = session.get_checkpoint()
+        if ckpt is not None:
+            # resume transformers' own optimizer/scheduler/step state
+            resume_dir = ckpt.to_directory()
+        result = trainer.train(resume_from_checkpoint=resume_dir)
         final = {k: v for k, v in (result.metrics or {}).items()
                  if isinstance(v, (int, float))}
         final["done"] = True
