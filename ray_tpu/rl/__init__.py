@@ -32,6 +32,7 @@ from ray_tpu.rl.offline import (BC, BCConfig, MARWIL,  # noqa: F401
                                 importance_sampling_estimate)
 from ray_tpu.rl.maddpg import (MADDPG, CooperativeNav,  # noqa: F401
                                MADDPGConfig)
+from ray_tpu.rl.maml import MAML, MAMLConfig, SinusoidTasks  # noqa: F401
 from ray_tpu.rl.multi_agent import (MultiAgentCartPole,  # noqa: F401
                                     MultiAgentEnv, MultiAgentPPO,
                                     MultiAgentPPOConfig,
@@ -66,6 +67,7 @@ __all__ = [
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentRolloutWorker",
     "AlphaZero", "AlphaZeroConfig", "MCTS", "TicTacToe",
     "MADDPG", "MADDPGConfig", "CooperativeNav",
+    "MAML", "MAMLConfig", "SinusoidTasks",
     "R2D2", "R2D2Config", "R2D2Policy", "QMix", "QMixConfig",
     "TwoStepGame",
     "get_algorithm_class", "SampleBatch", "compute_gae", "ReplayBuffer",

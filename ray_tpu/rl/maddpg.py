@@ -99,6 +99,8 @@ class MADDPG:
         from ray_tpu.rl import models as M
 
         self.config = config
+        self._env_ctor = config.env_spec if callable(config.env_spec) \
+            else None
         env = config.env_spec() if callable(config.env_spec) \
             else config.env_spec
         if not isinstance(env, MultiAgentEnv):
@@ -229,8 +231,9 @@ class MADDPG:
         self._act_all = act_all
         self._jnp = jnp
         self._jax = jax
+        from ray_tpu.rl.replay_buffer import ReplayBuffer
         self._np_rng = np.random.default_rng(config.seed or 0)
-        self._buffer: List[Dict[str, np.ndarray]] = []
+        self._buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
         self.iteration = 0
         self._timesteps_total = 0
         self._episodes_total = 0
@@ -249,8 +252,12 @@ class MADDPG:
         return np.clip(acts, -1.0, 1.0), obs_stack
 
     def train(self) -> Dict[str, Any]:
+        from ray_tpu.rl.sample_batch import SampleBatch
         cfg = self.config
         jnp = self._jnp
+        rows: Dict[str, List[np.ndarray]] = {
+            k: [] for k in ("obs", "actions", "rewards", "next_obs",
+                            "dones")}
         for _ in range(cfg.steps_per_iter):
             acts, obs_stack = self._actions(explore=True)
             action_dict = {a: acts[i] for i, a in enumerate(self.agents)}
@@ -261,15 +268,12 @@ class MADDPG:
             done = bool(terms.get("__all__")) or bool(
                 truncs.get("__all__"))
             terminal = bool(terms.get("__all__"))
-            self._buffer.append({
-                "obs": obs_stack.astype(np.float32),
-                "actions": acts.astype(np.float32),
-                "rewards": np.asarray(
-                    [rews.get(a, 0.0) for a in self.agents], np.float32),
-                "next_obs": nobs_stack.astype(np.float32),
-                "dones": np.float32(terminal)})
-            if len(self._buffer) > cfg.buffer_size:
-                self._buffer.pop(0)
+            rows["obs"].append(obs_stack.astype(np.float32))
+            rows["actions"].append(acts.astype(np.float32))
+            rows["rewards"].append(np.asarray(
+                [rews.get(a, 0.0) for a in self.agents], np.float32))
+            rows["next_obs"].append(nobs_stack.astype(np.float32))
+            rows["dones"].append(np.float32(terminal))
             self._ep_reward += float(sum(rews.values()))
             self._timesteps_total += 1
             self._obs = nobs
@@ -279,18 +283,16 @@ class MADDPG:
                 self._ep_reward = 0.0
                 self._obs, _ = self.env.reset()
         self._reward_window = self._reward_window[-100:]
+        self._buffer.add(SampleBatch(
+            {k: np.stack(v) for k, v in rows.items()}))
 
         info: Dict[str, Any] = {"buffer_size": len(self._buffer)}
         aux: Dict[str, Any] = {}
         if len(self._buffer) >= cfg.learning_starts:
             for _ in range(cfg.n_updates_per_iter):
-                idx = self._np_rng.choice(
-                    len(self._buffer),
-                    size=min(cfg.train_batch_size, len(self._buffer)),
-                    replace=False)
-                rows = [self._buffer[i] for i in idx]
-                batch = {k: jnp.asarray(np.stack([r[k] for r in rows]))
-                         for k in rows[0]}
+                sample = self._buffer.sample(
+                    min(cfg.train_batch_size, len(self._buffer)))
+                batch = {k: jnp.asarray(v) for k, v in sample.items()}
                 self.state, aux = self._update(self.state, batch)
             info.update({k: float(v) for k, v in aux.items()})
         self.iteration += 1
@@ -302,21 +304,31 @@ class MADDPG:
                 "episodes_total": self._episodes_total}
 
     def evaluate(self, episodes: int = 5) -> float:
+        # a dedicated env instance: seeding the shared training env would
+        # leave its RNG in the same state after every evaluate() call
+        env = self._env_ctor() if self._env_ctor is not None else self.env
         totals = []
         for ep in range(episodes):
-            self._obs, _ = self.env.reset(seed=5000 + ep)
+            obs, _ = env.reset(seed=5000 + ep)
             total = 0.0
             for _ in range(200):
-                acts, _ = self._actions(explore=False)
-                self._obs, rews, terms, truncs, _ = self.env.step(
+                obs_stack = np.stack([np.asarray(obs[a], np.float32)
+                                      for a in self.agents])
+                acts = np.clip(np.asarray(self._act_all(
+                    self.state["actor"], self._jnp.asarray(obs_stack))),
+                    -1.0, 1.0)
+                obs, rews, terms, truncs, _ = env.step(
                     {a: acts[i] for i, a in enumerate(self.agents)})
                 total += float(sum(rews.values()))
                 if terms.get("__all__") or truncs.get("__all__"):
                     break
             totals.append(total)
-        # leave internal stepping state consistent for further training
-        self._obs, _ = self.env.reset()
-        self._ep_reward = 0.0
+        if env is self.env:
+            # fell back to the shared env: restore training state
+            self._obs, _ = self.env.reset()
+            self._ep_reward = 0.0
+        else:
+            env.close()
         return float(np.mean(totals))
 
     def get_weights(self) -> Any:

@@ -122,6 +122,11 @@ def _alpha_zero():
     return AlphaZero, AlphaZeroConfig
 
 
+def _maml():
+    from ray_tpu.rl.maml import MAML, MAMLConfig
+    return MAML, MAMLConfig
+
+
 def _maddpg():
     from ray_tpu.rl.maddpg import MADDPG, MADDPGConfig
     return MADDPG, MADDPGConfig
@@ -167,6 +172,7 @@ _REGISTRY = {
     "qmix": _qmix,
     "alphazero": _alpha_zero,
     "maddpg": _maddpg,
+    "maml": _maml,
     "apexdqn": _apex_dqn,
     "crr": _crr,
     "dt": _dt,
