@@ -50,6 +50,8 @@ from ray_tpu.rl.rollout_worker import RolloutWorker  # noqa: F401
 from ray_tpu.rl.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rl.sample_batch import SampleBatch, compute_gae  # noqa: F401
 from ray_tpu.rl.simple_q import SimpleQ, SimpleQConfig  # noqa: F401
+from ray_tpu.rl.slateq import (InterestEvolutionEnv, SlateQ,  # noqa: F401
+                               SlateQConfig)
 from ray_tpu.rl.worker_set import WorkerSet  # noqa: F401
 
 __all__ = [
@@ -68,6 +70,7 @@ __all__ = [
     "AlphaZero", "AlphaZeroConfig", "MCTS", "TicTacToe",
     "MADDPG", "MADDPGConfig", "CooperativeNav",
     "MAML", "MAMLConfig", "SinusoidTasks",
+    "SlateQ", "SlateQConfig", "InterestEvolutionEnv",
     "R2D2", "R2D2Config", "R2D2Policy", "QMix", "QMixConfig",
     "TwoStepGame",
     "get_algorithm_class", "SampleBatch", "compute_gae", "ReplayBuffer",

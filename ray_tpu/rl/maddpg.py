@@ -241,8 +241,9 @@ class MADDPG:
         self._obs, _ = self.env.reset(seed=config.seed or 0)
         self._ep_reward = 0.0
 
-    def _actions(self, explore: bool) -> Tuple[np.ndarray, np.ndarray]:
-        obs_stack = np.stack([np.asarray(self._obs[a], np.float32)
+    def _actions(self, obs: Dict[str, Any],
+                 explore: bool) -> Tuple[np.ndarray, np.ndarray]:
+        obs_stack = np.stack([np.asarray(obs[a], np.float32)
                               for a in self.agents])
         acts = np.asarray(self._act_all(self.state["actor"],
                                         self._jnp.asarray(obs_stack)))
@@ -259,7 +260,7 @@ class MADDPG:
             k: [] for k in ("obs", "actions", "rewards", "next_obs",
                             "dones")}
         for _ in range(cfg.steps_per_iter):
-            acts, obs_stack = self._actions(explore=True)
+            acts, obs_stack = self._actions(self._obs, explore=True)
             action_dict = {a: acts[i] for i, a in enumerate(self.agents)}
             nobs, rews, terms, truncs, _ = self.env.step(action_dict)
             nobs_stack = np.stack(
@@ -312,11 +313,7 @@ class MADDPG:
             obs, _ = env.reset(seed=5000 + ep)
             total = 0.0
             for _ in range(200):
-                obs_stack = np.stack([np.asarray(obs[a], np.float32)
-                                      for a in self.agents])
-                acts = np.clip(np.asarray(self._act_all(
-                    self.state["actor"], self._jnp.asarray(obs_stack))),
-                    -1.0, 1.0)
+                acts, _ = self._actions(obs, explore=False)
                 obs, rews, terms, truncs, _ = env.step(
                     {a: acts[i] for i, a in enumerate(self.agents)})
                 total += float(sum(rews.values()))

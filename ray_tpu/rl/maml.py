@@ -70,15 +70,24 @@ class MAML:
 
         self.config = config
         ctor = config.env_spec or SinusoidTasks
-        if callable(ctor):
+
+        def build(seed_offset: int):
+            if not callable(ctor):
+                return ctor
+            import inspect
             try:
-                self.tasks = ctor(seed=config.seed or 0)
-            except TypeError:
-                # the contract only requires .sample(n, k, q); task
-                # distributions without a seed kwarg are fine
-                self.tasks = ctor()
-        else:
-            self.tasks = ctor
+                takes_seed = "seed" in inspect.signature(ctor).parameters
+            except (TypeError, ValueError):
+                takes_seed = False
+            # the contract only requires .sample(n, k, q); seed is
+            # threaded through when the ctor advertises it
+            return ctor(seed=(config.seed or 0) + seed_offset) \
+                if takes_seed else ctor()
+
+        self.tasks = build(0)
+        # held-out distribution: evaluate() must not consume (or even
+        # share) the training task stream's RNG
+        self._eval_tasks = build(10_000) if callable(ctor) else self.tasks
 
         class RegNet(nn.Module):
             hidden_: Tuple[int, ...]
@@ -171,7 +180,7 @@ class MAML:
         """Pre- vs post-adaptation query MSE on held-out tasks — the
         meta-learning signal is the adaptation gain."""
         jnp = self._jnp
-        batch = {k: jnp.asarray(v) for k, v in self.tasks.sample(
+        batch = {k: jnp.asarray(v) for k, v in self._eval_tasks.sample(
             n_tasks, self.config.k_shot, self.config.k_query).items()}
         pre, post = self._jax.vmap(
             lambda t: self._eval_task(self.params, t))(batch)

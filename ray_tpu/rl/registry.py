@@ -122,6 +122,11 @@ def _alpha_zero():
     return AlphaZero, AlphaZeroConfig
 
 
+def _slateq():
+    from ray_tpu.rl.slateq import SlateQ, SlateQConfig
+    return SlateQ, SlateQConfig
+
+
 def _maml():
     from ray_tpu.rl.maml import MAML, MAMLConfig
     return MAML, MAMLConfig
@@ -173,6 +178,7 @@ _REGISTRY = {
     "alphazero": _alpha_zero,
     "maddpg": _maddpg,
     "maml": _maml,
+    "slateq": _slateq,
     "apexdqn": _apex_dqn,
     "crr": _crr,
     "dt": _dt,
