@@ -21,6 +21,7 @@ from ray_tpu.rl.bandit import (BanditConfig, BanditLinTS,  # noqa: F401
                                LinearDiscreteEnv)
 from ray_tpu.rl.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rl.crr import CRR, CRRConfig  # noqa: F401
+from ray_tpu.rl.dreamer import Dreamer, DreamerConfig  # noqa: F401
 from ray_tpu.rl.dt import DT, DTConfig  # noqa: F401
 from ray_tpu.rl.ddpg import DDPG, DDPGConfig, TD3, TD3Config  # noqa: F401
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
@@ -71,6 +72,7 @@ __all__ = [
     "MADDPG", "MADDPGConfig", "CooperativeNav",
     "MAML", "MAMLConfig", "SinusoidTasks",
     "SlateQ", "SlateQConfig", "InterestEvolutionEnv",
+    "Dreamer", "DreamerConfig",
     "R2D2", "R2D2Config", "R2D2Policy", "QMix", "QMixConfig",
     "TwoStepGame",
     "get_algorithm_class", "SampleBatch", "compute_gae", "ReplayBuffer",

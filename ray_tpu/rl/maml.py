@@ -76,7 +76,10 @@ class MAML:
                 return ctor
             import inspect
             try:
-                takes_seed = "seed" in inspect.signature(ctor).parameters
+                params = inspect.signature(ctor).parameters
+                takes_seed = "seed" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values())
             except (TypeError, ValueError):
                 takes_seed = False
             # the contract only requires .sample(n, k, q); seed is

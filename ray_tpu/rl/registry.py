@@ -122,6 +122,11 @@ def _alpha_zero():
     return AlphaZero, AlphaZeroConfig
 
 
+def _dreamer():
+    from ray_tpu.rl.dreamer import Dreamer, DreamerConfig
+    return Dreamer, DreamerConfig
+
+
 def _slateq():
     from ray_tpu.rl.slateq import SlateQ, SlateQConfig
     return SlateQ, SlateQConfig
@@ -179,6 +184,7 @@ _REGISTRY = {
     "maddpg": _maddpg,
     "maml": _maml,
     "slateq": _slateq,
+    "dreamer": _dreamer,
     "apexdqn": _apex_dqn,
     "crr": _crr,
     "dt": _dt,
