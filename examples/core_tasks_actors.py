@@ -1,0 +1,38 @@
+"""Core API tour: tasks, objects, actors (cf. reference docs quickstart)."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def add(self, k=1):
+        self.n += k
+        return self.n
+
+
+def main():
+    ray_tpu.init(num_cpus=2)
+    try:
+        print("tasks:", ray_tpu.get([square.remote(i) for i in range(5)]))
+        big = ray_tpu.put(list(range(10_000)))      # object store
+        print("object len:", len(ray_tpu.get(big)))
+        c = Counter.remote()
+        for _ in range(3):
+            c.add.remote()
+        print("counter:", ray_tpu.get(c.add.remote(0)))
+        ready, rest = ray_tpu.wait(
+            [square.remote(i) for i in range(4)], num_returns=2)
+        print("wait:", len(ready), "ready,", len(rest), "pending")
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

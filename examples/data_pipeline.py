@@ -1,0 +1,26 @@
+"""Dataset pipeline: read -> transform -> shuffle -> split -> iterate
+(cf. reference data quickstart)."""
+import numpy as np
+
+import ray_tpu
+import ray_tpu.data as rdata
+
+
+def main():
+    ray_tpu.init(num_cpus=2)
+    try:
+        ds = rdata.range(1000, parallelism=4)      # rows: {"id": i}
+        ds = ds.map(lambda r: {"x": r["id"], "y": r["id"] * 2})
+        ds = ds.filter(lambda r: r["x"] % 3 == 0)
+        ds = ds.random_shuffle(seed=0)
+        train, test = ds.train_test_split(test_size=0.25)
+        print("train rows:", train.count(), "test rows:", test.count())
+        batch = next(train.iter_batches(batch_size=32,
+                                        batch_format="pandas"))
+        print("first batch mean y:", float(np.mean(batch["y"])))
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""RL: PPO with rollout actors + the mesh-jitted learner (cf. reference
+rllib quickstart)."""
+import ray_tpu
+from ray_tpu.rl import PPOConfig
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    try:
+        algo = (PPOConfig()
+                .environment("CartPole-v1")
+                .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                          rollout_fragment_length=100)
+                .training(train_batch_size=400, sgd_minibatch_size=128,
+                          num_sgd_iter=6, entropy_coeff=0.01)
+                .debugging(seed=0)
+                .build())
+        for i in range(5):
+            result = algo.train()
+            print(f"iter {i}: reward_mean="
+                  f"{result['episode_reward_mean']:.1f} "
+                  f"steps={result['timesteps_total']}")
+        algo.stop()
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Model serving: deployment + handle + HTTP (cf. reference serve
+quickstart)."""
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=2)
+class Doubler:
+    def __call__(self, request):
+        return {"result": 2 * int(request["x"])}
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    try:
+        handle = serve.run(Doubler.bind(), name="doubler")
+        out = ray_tpu.get(handle.remote({"x": 21}))
+        print("handle call:", out)
+        status = serve.status()
+        print("serve status:", {k: v["status"]
+                                for k, v in status.items()})
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Hyperparameter search: Tuner + ASHA early stopping (cf. reference
+tune quickstart)."""
+import ray_tpu
+from ray_tpu.air import session
+from ray_tpu.tune import ASHAScheduler, TuneConfig, Tuner, loguniform
+
+
+def trainable(config):
+    # a fake objective that rewards lr near 1e-2
+    import math
+    for i in range(10):
+        score = -abs(math.log10(config["lr"]) + 2) * (1 - i / 20)
+        session.report({"score": score})
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    try:
+        grid = Tuner(
+            trainable,
+            param_space={"lr": loguniform(1e-5, 1e-1)},
+            tune_config=TuneConfig(
+                metric="score", mode="max", num_samples=8,
+                max_concurrent_trials=4,
+                scheduler=ASHAScheduler(metric="score", mode="max",
+                                        grace_period=2,
+                                        reduction_factor=2, max_t=10)),
+        ).fit()
+        best = grid.get_best_result()
+        print("best lr:", best.metrics["config"]["lr"],
+              "score:", best.metrics["score"])
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

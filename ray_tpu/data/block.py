@@ -148,8 +148,11 @@ def build_block_like(template: Block, rows: List[Any]) -> Block:
         if not rows:
             return {k: np.empty((0,) + v.shape[1:], v.dtype)
                     for k, v in template.items()}
-        return {k: np.asarray([r[k] for r in rows])
-                for k in template.keys()}
+        if isinstance(rows[0], dict):
+            # the map fn may have CHANGED the row schema: build from the
+            # output rows' keys, not the input template's
+            return {k: np.asarray([r[k] for r in rows])
+                    for k in rows[0].keys()}
     return list(rows)
 
 

@@ -23,6 +23,13 @@ class TaskError(RayTpuError):
         super().__init__(
             f"task {function_name!r} failed: {cause!r}\n{traceback_str}")
 
+    def __reduce__(self):
+        # Exception's default reduce would reconstruct with the FORMATTED
+        # message as function_name, re-wrapping the error on every pickle
+        # round trip (messages grew exponentially down task chains).
+        return (TaskError, (self.function_name, self.cause,
+                            self.traceback_str))
+
 
 class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died (cf. WorkerCrashedError)."""

@@ -342,7 +342,12 @@ class Dreamer:
         acts = np.stack(self._ep_act)                    # [T, A]
         prev = np.concatenate([np.zeros((1, self.act_dim), np.float32),
                                acts[:-1]], 0)
-        rews = np.asarray(self._ep_rew, np.float32)
+        # align rewards with prev_actions: feat_t embeds a_{t-1}, so the
+        # reward head must be trained on a_{t-1}'s reward — imagination
+        # reads head(state-after-action) as that action's reward
+        rews = np.concatenate(
+            [np.zeros(1, np.float32),
+             np.asarray(self._ep_rew[:-1], np.float32)])
         rows = {"obs": [], "prev_actions": [], "rewards": []}
         for start in range(0, T - L + 1, L):
             rows["obs"].append(obs[start:start + L])

@@ -185,7 +185,14 @@ class WorkerProcess:
 
     def _package_error(self, spec, e: BaseException) -> dict:
         tb = traceback.format_exc()
-        err = exc.TaskError(spec.get("name", ""), e, tb)
+        if isinstance(e, exc.TaskError):
+            # an upstream dependency already failed: propagate ITS error
+            # unchanged (re-wrapping nests quoted tracebacks
+            # exponentially down a task chain; cf. Ray's RayTaskError
+            # propagation semantics)
+            err = e
+        else:
+            err = exc.TaskError(spec.get("name", ""), e, tb)
         head, views = ser.serialize(err, error_type=ser.ERROR_TASK)
         data = ser.to_flat_bytes(head, views)
         from ray_tpu.runtime.core_worker import num_return_slots

@@ -191,3 +191,28 @@ def test_dynamic_num_returns(ray_start_regular):
 
     with pytest.raises(Exception, match="iterable"):
         ray_tpu.get(ray_tpu.get(notiter.remote(), timeout=60), timeout=60)
+
+
+def test_task_error_propagates_root_not_wrapped(ray_start_regular):
+    """A failure at the root of a task chain surfaces as ONE TaskError
+    with the root cause — not re-wrapped per hop (TaskError.__reduce__
+    keeps pickle round trips idempotent; downstream workers forward an
+    upstream TaskError unchanged)."""
+    import ray_tpu
+    import pytest
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @ray_tpu.remote
+    def passthrough(x):
+        return x
+
+    ref = passthrough.remote(passthrough.remote(boom.remote()))
+    with pytest.raises(ray_tpu.exceptions.TaskError) as ei:
+        ray_tpu.get(ref, timeout=60)
+    msg = str(ei.value)
+    assert "root cause" in msg
+    assert msg.count("failed:") == 1
+    assert len(msg) < 2000

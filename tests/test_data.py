@@ -290,3 +290,14 @@ def test_from_huggingface(ray_start_regular):
     assert ds.count() == 3
     batch = next(ds.iter_batches(batch_size=3, batch_format="pandas"))
     assert list(batch["a"]) == [1, 2, 3]
+
+
+def test_map_can_change_row_schema(ray_start_regular):
+    """Dataset.map output blocks take the OUTPUT rows' schema (a map that
+    renames/adds columns used to rebuild blocks with the input keys)."""
+    import ray_tpu.data as rdata
+    ds = rdata.range(30, parallelism=3)
+    out = ds.map(lambda r: {"x": r["id"], "y": r["id"] * 2})
+    rows = out.take(3)
+    assert set(rows[0]) == {"x", "y"}
+    assert out.count() == 30
