@@ -254,16 +254,20 @@ static int evict_lru(Header* h, uint64_t needed) {
 // public object API (all lock internally)
 // ---------------------------------------------------------------------------
 
-// rc: 0 ok; -1 exists; -2 out of memory; -3 table full
+// rc: 0 ok; -1 exists; -2 out of memory; -3 table full.  allow_evict=0 keeps
+// LRU eviction out of the allocation path: primary copies must be spilled to
+// disk by the raylet (request_spill), never silently dropped — reference
+// semantics where the raylet pins primaries and plasma only evicts
+// secondary copies (local_object_manager.h).
 long long store_create(void* base, const uint8_t* id, uint64_t size,
-                       uint64_t meta) {
+                       uint64_t meta, int allow_evict) {
   Header* h = static_cast<Header*>(base);
   if (size == 0) size = 1;
   if (lock(h) != 0) return -4;
   ObjEntry* existing = find_entry(h, id, 0);
   if (existing) { unlock(h); return -1; }
   uint64_t off = alloc_block(h, size);
-  if (!off) {
+  if (!off && allow_evict) {
     evict_lru(h, size);
     off = alloc_block(h, size);
   }
@@ -362,6 +366,31 @@ int store_abort(void* base, const uint8_t* id) {
 
 uint64_t store_seal_count(void* base) {
   return static_cast<Header*>(base)->seal_count;
+}
+
+// Enumerate sealed objects for the spill manager's victim selection
+// (reference: LocalObjectManager::SpillObjectsOfSize walks the plasma
+// eviction policy's LRU list, local_object_manager.h).  Packs up to
+// `max_entries` records of [id (20B) | size u64 | lru_tick u64 | pins i32]
+// = 40 bytes each into out_buf, LRU order not guaranteed (caller sorts by
+// lru_tick).  Returns the number of entries written.
+uint32_t store_list(void* base, uint8_t* out_buf, uint32_t max_entries) {
+  Header* h = static_cast<Header*>(base);
+  if (lock(h) != 0) return 0;
+  ObjEntry* t = table_of(h);
+  uint32_t written = 0;
+  for (uint32_t i = 0; i < h->table_size && written < max_entries; i++) {
+    ObjEntry* e = &t[i];
+    if (e->state != SEALED) continue;
+    uint8_t* rec = out_buf + written * 40;
+    memcpy(rec, e->id, kIdLen);
+    memcpy(rec + 20, &e->size, 8);
+    memcpy(rec + 28, &e->lru_tick, 8);
+    memcpy(rec + 36, &e->pins, 4);
+    written++;
+  }
+  unlock(h);
+  return written;
 }
 
 void store_stats(void* base, uint64_t* out) {

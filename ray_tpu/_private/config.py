@@ -53,8 +53,9 @@ _declare("inline_object_max_bytes", int, 100 * 1024,
          "memory store instead of the shared-memory store.")
 _declare("object_store_memory_bytes", int, 2 * 1024**3,
          "Default per-node shared-memory object store capacity.")
-_declare("object_store_fallback_dir", str, "/tmp",
-         "Directory for fallback-allocated (spilled) store segments.")
+_declare("object_store_fallback_dir", str, "",
+         "Directory holding spilled-object files; empty means a spill_<node> "
+         "dir inside the session dir (removed at raylet shutdown).")
 _declare("object_spill_threshold", float, 0.8,
          "Fraction of store capacity above which primary copies spill to disk.")
 _declare("object_transfer_chunk_bytes", int, 8 * 1024 * 1024,
@@ -84,6 +85,11 @@ _declare("memory_monitor_refresh_ms", int, 250,
          "Period of the per-node host-memory monitor; 0 disables it.")
 _declare("memory_usage_threshold", float, 0.95,
          "Host-memory fraction above which the worker-killing policy engages.")
+_declare("fetch_fail_timeout_s", float, 60.0,
+         "Grace window for transient fetch failures (unreachable raylet on "
+         "an alive node) before an owned object is declared lost and lineage "
+         "reconstruction kicks in (cf. reference "
+         "fetch_fail_timeout_milliseconds).")
 _declare("lineage_max_bytes", int, 64 * 1024**2,
          "Cap on pinned lineage (task specs kept for object reconstruction).")
 _declare("free_objects_period_ms", int, 100,

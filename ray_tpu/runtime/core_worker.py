@@ -232,13 +232,31 @@ class CoreWorker:
         self.job_runtime_env: Optional[dict] = None
         self._runtime_env_cache: Dict[str, Optional[dict]] = {}
 
+        # lineage ledger (reference: TaskManager lineage pinning,
+        # task_manager.h:146 + object_recovery_manager.h:41): FIFO of task
+        # binaries whose specs are pinned for reconstruction, bounded by
+        # lineage_max_bytes; per-task slot sets so arg refs and specs are
+        # dropped when the last return object is freed.
+        self._lineage_bytes = 0
+        self._lineage_order: deque = deque()
+        self._lineage_meta: Dict[bytes, dict] = {}
+        self._alive_cache: Tuple[float, set] = (0.0, set())
+
+        # deferred remote frees: (node_hex, oid_binary) batched per node
+        # every free_objects_period_ms (reference: plasma Delete batching)
+        self._shutdown = threading.Event()
+        self._free_queue: List[Tuple[str, bytes]] = []
+        self._free_cv = threading.Condition()
+        self._free_thread = threading.Thread(target=self._free_loop,
+                                             daemon=True)
+        self._free_thread.start()
+
         from ray_tpu._private.task_events import TaskEventBuffer
         # only drivers know the true job id; worker-side CoreWorkers get a
         # random one, which must not overwrite the owner's in the task table
         self.events = TaskEventBuffer(
             self.gcs, job_id=self.job_id.hex() if mode == "driver" else "",
             node_id=node_id, worker_id=self.worker_id.hex())
-        self._shutdown = threading.Event()
 
     # ------------------------------------------------------------ lifecycle
     def shutdown(self) -> None:
@@ -278,7 +296,7 @@ class CoreWorker:
     def _ref_deleted(self, oid: ObjectID) -> None:
         if self._shutdown.is_set():
             return
-        free = False
+        freed: List[Tuple[ObjectID, set]] = []
         with self._owned_lock:
             entry = self._owned.get(oid)
             if entry is not None:
@@ -286,7 +304,8 @@ class CoreWorker:
                 if entry.refcount <= 0 and entry.state == "ready":
                     del self._owned[oid]
                     self._memory_cache.pop(oid, None)
-                    free = True
+                    freed.append((oid, set(entry.locations)))
+                    self._lineage_slot_freed_locked(oid)
                     for child in entry.dynamic_children or ():
                         child_entry = self._owned.get(child)
                         if child_entry is not None and \
@@ -295,13 +314,70 @@ class CoreWorker:
                             # will ever free these
                             del self._owned[child]
                             self._memory_cache.pop(child, None)
-        if free:
-            self._release_pins(oid)
-            # release primary shm copy if we placed one locally
+                            freed.append((child,
+                                          set(child_entry.locations)))
+                            self._lineage_slot_freed_locked(child)
+        for foid, locations in freed:
+            self._release_pins(foid)
+            # release the primary copies: local shm directly, remote nodes
+            # (and any spilled files) via batched free_objects RPCs
             try:
-                self.store.delete(oid)
+                self.store.delete(foid)
             except Exception:
                 pass
+            # every location gets a free RPC — including our own node, whose
+            # raylet may hold the copy as a spill file
+            if locations:
+                with self._free_cv:
+                    for node_hex in locations:
+                        self._free_queue.append((node_hex, foid.binary()))
+                    self._free_cv.notify()
+
+    def _lineage_slot_freed_locked(self, oid: ObjectID) -> None:
+        """owned_lock held: drop a task's lineage (spec + pinned arg refs)
+        once its last return object is freed."""
+        if oid.is_put():
+            return
+        tb = oid.task_id().binary()
+        meta = self._lineage_meta.get(tb)
+        if meta is None:
+            return
+        meta["slots"].discard(oid)
+        if any(o in self._owned for o in meta["slots"]):
+            return
+        self._lineage_meta.pop(tb, None)
+        if not meta["evicted"]:
+            self._lineage_bytes -= meta["size"]
+        self._arg_refs.pop(tb, None)
+
+    def _free_loop(self) -> None:
+        period = CONFIG.free_objects_period_ms / 1000.0
+        while not self._shutdown.is_set():
+            with self._free_cv:
+                if not self._free_queue:
+                    self._free_cv.wait(timeout=1.0)
+                batch, self._free_queue = self._free_queue, []
+            if not batch:
+                continue
+            time.sleep(period)  # let more frees accumulate
+            with self._free_cv:
+                batch += self._free_queue
+                self._free_queue = []
+            by_node: Dict[str, list] = {}
+            for node_hex, ob in batch:
+                by_node.setdefault(node_hex, []).append(ob)
+            for node_hex, obs in by_node.items():
+                # nothing here may escape: one bad node/GCS hiccup must not
+                # kill the only consumer of the free queue
+                try:
+                    addr = self._node_address(node_hex)
+                    if addr is None:
+                        continue
+                    conn = self._owner_conn(addr)
+                    conn.call("free_objects", {"object_ids": obs},
+                              timeout=5.0)
+                except Exception:
+                    pass
 
     def _note_pin(self, oid: ObjectID) -> None:
         with self._pins_lock:
@@ -342,10 +418,61 @@ class CoreWorker:
             entry.data = ser.to_flat_bytes(head, views)
             self._memory_cache[oid] = value
         else:
-            self.store.put_serialized(oid, head, views)
+            self._store_put(oid, head, views)
             entry.locations.add(self.node_id)
         entry.event.set()
         return ObjectRef(oid, self.address, self)
+
+    def _store_put(self, oid: ObjectID, head, views,
+                   error: bool = False) -> None:
+        """Write a primary copy into local shm.  Primaries are never
+        LRU-evicted (allow_evict=False); on a full store the raylet spills
+        LRU objects to disk and the create retries.  If spilling can't make
+        room (everything is pinned by readers), the copy is born on disk
+        instead of failing — the reference's plasma fallback allocation
+        (object_store_fallback_dir)."""
+        size = ser.serialized_size(head, views)
+        for _ in range(3):
+            try:
+                self.store.put_serialized(oid, head, views, error=error,
+                                          allow_evict=False)
+                return
+            except FileExistsError:
+                return  # immutable: an identical reconstruction beat us
+            except exc.ObjectStoreFullError:
+                try:
+                    reply = self._raylet.call(
+                        "request_spill", {"bytes": size},
+                        timeout=CONFIG.raylet_rpc_timeout_s)
+                    freed = reply.get("freed", 0)
+                except (ConnectionError, rpc.RpcError, TimeoutError,
+                        OSError):
+                    freed = 0
+                if freed < size:
+                    break  # nothing left to spill: fall back to disk
+                time.sleep(0.01)
+        self._put_fallback(oid, head, views, error)
+
+    def _put_fallback(self, oid: ObjectID, head, views,
+                      error: bool) -> None:
+        """Write the primary copy straight into the raylet's spill dir
+        (same host, shared filesystem) and register it; fetches stream or
+        restore it like any spilled object."""
+        import os
+        spill_dir = self._raylet.call("spill_dir", {},
+                                      timeout=CONFIG.raylet_rpc_timeout_s)
+        path = os.path.join(spill_dir, oid.hex())
+        tmp = f"{path}.tmp{os.getpid()}"
+        total = ser.serialized_size(head, views)
+        buf = bytearray(total)
+        ser.write_into(memoryview(buf), head, views)
+        with open(tmp, "wb") as f:
+            f.write(buf)
+        os.replace(tmp, path)
+        self._raylet.call("register_spilled",
+                          {"object_id": oid.binary(), "size": total,
+                           "meta": 1 if error else 0},
+                          timeout=CONFIG.raylet_rpc_timeout_s)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
             ) -> List[Any]:
@@ -390,13 +517,28 @@ class CoreWorker:
         with self._owned_lock:
             entry = self._owned.get(oid)
         if entry is not None:
-            t = self._remaining(deadline)
-            if not entry.event.wait(t if t is not None else None):
-                return None
-            if entry.data is not None:
-                return memoryview(entry.data)
-            # owned but stored in shm somewhere
-            return self._fetch_from_locations(oid, entry.locations, deadline)
+            while True:
+                t = self._remaining(deadline)
+                if not entry.event.wait(t if t is not None else None):
+                    return None
+                with self._owned_lock:
+                    data = entry.data
+                if data is not None:
+                    return memoryview(data)
+                # owned but stored in shm somewhere
+                res = self._fetch_from_locations(oid, entry, deadline)
+                if res is not None:
+                    return res
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
+                # every live copy is gone: recover via lineage or give up
+                # (reference ObjectRecoveryManager::RecoverObject,
+                # object_recovery_manager.h:41)
+                if not self._try_reconstruct(oid, entry):
+                    raise exc.ObjectLostError(
+                        f"object {oid.hex()[:16]} lost: all copies are gone "
+                        f"and it cannot be reconstructed (put objects and "
+                        f"tasks out of retries are unrecoverable)")
         # 2. local shm
         res = self.store.get(oid, timeout=0.0)
         if res is not None:
@@ -406,23 +548,94 @@ class CoreWorker:
         # 3. ask the owner
         return self._fetch_from_owner(ref, deadline)
 
-    def _fetch_from_locations(self, oid: ObjectID, locations: set,
-                              deadline: Optional[float]) -> Optional[memoryview]:
+    def _alive_node_ids(self, max_age: float = 1.0) -> set:
+        """Node liveness view, refreshed from the GCS at most every
+        ``max_age`` seconds.  Empty set means 'unknown' (GCS unreachable
+        before the first successful refresh) — callers must not prune on
+        an empty view."""
+        ts, cached = self._alive_cache
+        now = time.monotonic()
+        if now - ts <= max_age:
+            return cached
+        try:
+            nodes = self.gcs.call("list_nodes", timeout=5)
+        except (ConnectionError, rpc.RpcError, TimeoutError, OSError):
+            return cached
+        for n in nodes:
+            self._node_table[n["node_id"]] = n
+        cached = {n["node_id"] for n in nodes if n["alive"]}
+        self._alive_cache = (now, cached)
+        return cached
+
+    def _prune_dead_locations(self, entry: _OwnedObject) -> set:
+        """Drop locations on dead nodes from an owned entry; a dead node's
+        copy never comes back (its shm segment died with it)."""
+        alive = self._alive_node_ids()
+        with self._owned_lock:
+            if alive:
+                entry.locations &= alive
+            return set(entry.locations)
+
+    def _fetch_from_locations(self, oid: ObjectID, entry: _OwnedObject,
+                              deadline: Optional[float]
+                              ) -> Optional[memoryview]:
+        """Owner-side fetch of an owned shm object: try every live location
+        (local shm first, then raylets — including our own, which may hold
+        it as a spill file).  Returns None only once the object is genuinely
+        unavailable — every location is dead, or definitively reports the
+        copy gone, or has been unreachable past fetch_fail_timeout_s — so
+        the caller can decide between reconstruction and timeout.  A raylet
+        that *answers* "absent" drops that location immediately; a raylet
+        that can't be reached gets the grace window (its node may just be
+        restarting) instead of triggering a duplicate re-execution."""
+        grace = time.monotonic() + CONFIG.fetch_fail_timeout_s
+        attempt = 0
         while True:
+            locations = self._prune_dead_locations(entry)
+            if not locations:
+                return None
             if self.node_id in locations:
-                res = self.store.get(oid, timeout=self._remaining(deadline))
+                res = self.store.get(oid, timeout=0.0)
                 if res is not None:
                     self._note_pin(oid)
                     return res[0]
-            for node_hex in list(locations):
-                if node_hex == self.node_id:
-                    continue
-                data = self._fetch_remote(node_hex, oid, deadline)
-                if data is not None:
+            transient = False
+            for node_hex in locations:
+                status, data = self._fetch_remote(node_hex, oid, deadline)
+                if status == "ok":
                     return memoryview(data)
-            if deadline is not None and time.monotonic() >= deadline:
+                if status == "absent":
+                    # evicted/never there: that location is authoritative
+                    # about itself — forget it
+                    with self._owned_lock:
+                        entry.locations.discard(node_hex)
+                else:
+                    transient = True
+            if not transient:
+                return None  # every remaining location answered "absent"
+            now = time.monotonic()
+            if now >= grace or (deadline is not None and now >= deadline):
                 return None
-            time.sleep(0.005)
+            attempt += 1
+            time.sleep(min(0.05 * attempt, 1.0))
+
+    def _fetch_from_location_set(self, oid: ObjectID, locations: set,
+                                 deadline: Optional[float]
+                                 ) -> Optional[memoryview]:
+        """Borrower-side single pass over owner-reported locations."""
+        alive = self._alive_node_ids()
+        for node_hex in locations:
+            if alive and node_hex not in alive:
+                continue
+            if node_hex == self.node_id:
+                res = self.store.get(oid, timeout=0.0)
+                if res is not None:
+                    self._note_pin(oid)
+                    return res[0]
+            status, data = self._fetch_remote(node_hex, oid, deadline)
+            if status == "ok":
+                return memoryview(data)
+        return None
 
     def _node_address(self, node_hex: str) -> Optional[Tuple[str, int]]:
         node = self._node_table.get(node_hex)
@@ -433,14 +646,19 @@ class CoreWorker:
         return tuple(node["address"]) if node else None
 
     def _fetch_remote(self, node_hex: str, oid: ObjectID,
-                      deadline: Optional[float]) -> Optional[bytes]:
+                      deadline: Optional[float]
+                      ) -> Tuple[str, Optional[bytes]]:
         """Pull one object from a remote raylet, chunk by chunk: each RPC
         frame carries at most object_transfer_chunk_bytes, so large objects
         stream with bounded memory on both sides (reference PullManager /
-        chunked ObjectManager::Push semantics)."""
+        chunked ObjectManager::Push semantics).
+
+        Returns (status, data): "ok" with the bytes; "absent" when the
+        raylet answered but has no copy (authoritative — evicted or freed);
+        "error" on transport failures (transient: node may be restarting)."""
         addr = self._node_address(node_hex)
         if addr is None:
-            return None
+            return "error", None
         chunk = CONFIG.object_transfer_chunk_bytes
         try:
             conn = rpc.connect(addr, timeout=5.0)
@@ -451,31 +669,31 @@ class CoreWorker:
                                    "timeout": 0.0},
                                   timeout=CONFIG.raylet_rpc_timeout_s)
                 if first is None:
-                    return None
+                    return "absent", None
                 total = first["total"]
                 if total <= chunk:
-                    return first["data"]
+                    return "ok", first["data"]
                 out = bytearray(total)
                 out[:len(first["data"])] = first["data"]
                 off = len(first["data"])
                 while off < total:
                     if deadline is not None and \
                             time.monotonic() >= deadline:
-                        return None   # honor get(timeout=) between chunks
+                        return "error", None  # honor get(timeout=)
                     res = conn.call("fetch_object_chunk",
                                     {"object_id": oid.binary(),
                                      "offset": off, "length": chunk,
                                      "timeout": 0.0},
                                     timeout=CONFIG.raylet_rpc_timeout_s)
                     if res is None or not res["data"]:
-                        return None   # evicted mid-transfer; caller retries
+                        return "absent", None  # evicted mid-transfer
                     out[off:off + len(res["data"])] = res["data"]
                     off += len(res["data"])
-                return bytes(out)
+                return "ok", bytes(out)
             finally:
                 conn.close()
         except (ConnectionError, rpc.RemoteError, TimeoutError, OSError):
-            return None
+            return "error", None
 
     def _owner_conn(self, addr: Tuple[str, int]) -> rpc.Connection:
         addr = tuple(addr)
@@ -509,12 +727,80 @@ class CoreWorker:
                 if "data" in res:
                     return memoryview(res["data"])
                 # location answer
-                data = self._fetch_from_locations(
+                data = self._fetch_from_location_set(
                     ref.id, set(res["locations"]), deadline)
                 if data is not None:
                     return data
             if deadline is not None and time.monotonic() >= deadline:
                 return None
+            time.sleep(0.01)
+
+    # ------------------------------------------------------- reconstruction
+    def _try_reconstruct(self, oid: ObjectID, entry: _OwnedObject) -> bool:
+        """All copies of an owned object are gone: resubmit the task that
+        produced it from its pinned spec (reference
+        TaskManager::ResubmitTask, task_manager.h:146).  Returns True if a
+        recovery is in flight (the entry's event will be set again);
+        idempotent — concurrent callers piggyback on one resubmission."""
+        with self._owned_lock:
+            if entry.state == "pending":
+                return True  # recovery already in flight
+            blob = entry.task_spec
+            if blob is None:
+                return False
+            meta = cloudpickle.loads(blob)
+            if meta["retries_left"] <= 0:
+                return False
+            meta["retries_left"] -= 1
+            new_blob = cloudpickle.dumps(meta)
+            spec = meta["spec"]
+            task_id = TaskID(spec["task_id"])
+            # reset every return slot of the task (the resubmission
+            # regenerates them all), including adopted dynamic children
+            slots = {ObjectID.for_task_return(task_id, i)
+                     for i in range(num_return_slots(spec["num_returns"]))}
+            lmeta = self._lineage_meta.get(task_id.binary())
+            if lmeta is not None:
+                slots |= lmeta["slots"]
+            for sib_oid in slots:
+                sib = self._owned.get(sib_oid)
+                if sib is None:
+                    continue
+                sib.task_spec = new_blob
+                sib.state = "pending"
+                sib.data = None
+                sib.error = 0
+                sib.locations.clear()
+                sib.event.clear()
+                self._memory_cache.pop(sib_oid, None)
+        logger.info("reconstructing object %s: resubmitting task %s "
+                    "(%d retries left)", oid.hex()[:12],
+                    spec.get("name", ""), meta["retries_left"])
+        self.events.record(task_id.hex(), "RECONSTRUCTING",
+                           name=spec.get("name", ""))
+        self._enqueue_task(meta["key"], meta["resources"], spec,
+                           meta["retries_left"],
+                           strategy=meta.get("strategy"),
+                           env=meta.get("env"))
+        return True
+
+    def _recover_or_fail(self, oid: ObjectID, entry: _OwnedObject) -> None:
+        """Owner-side recovery entry point for borrower-driven gets: either
+        kick off reconstruction or resolve the entry to ObjectLostError so
+        every waiter (local and remote) gets a clean failure."""
+        if self._try_reconstruct(oid, entry):
+            return
+        err = exc.ObjectLostError(
+            f"object {oid.hex()[:16]} lost: all copies are gone and it "
+            f"cannot be reconstructed")
+        head, views = ser.serialize(err, error_type=ser.ERROR_OBJECT_LOST)
+        data = ser.to_flat_bytes(head, views)
+        with self._owned_lock:
+            if entry.state == "ready" and entry.data is None \
+                    and not entry.locations:
+                entry.data = data
+                entry.error = ser.ERROR_OBJECT_LOST
+                entry.event.set()
 
     # ------------------------------------------------------------- wait
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
@@ -621,20 +907,54 @@ class CoreWorker:
         }
         return_refs = []
         n_slots = num_return_slots(num_returns)
+        spec_blob = cloudpickle.dumps(
+            {"spec": spec, "resources": resources, "key": key,
+             "retries_left": max_retries,
+             "strategy": scheduling_strategy, "env": runtime_env})
         with self._owned_lock:
+            slots = set()
             for i in range(n_slots):
                 oid = ObjectID.for_task_return(task_id, i)
                 entry = _OwnedObject()
-                entry.task_spec = cloudpickle.dumps(
-                    {"spec": spec, "resources": resources, "key": key,
-                     "retries_left": max_retries,
-                     "strategy": scheduling_strategy, "env": runtime_env})
+                entry.task_spec = spec_blob
                 self._owned[oid] = entry
+                slots.add(oid)
                 return_refs.append(ObjectRef(oid, self.address, self))
+            self._lineage_meta[task_id.binary()] = {
+                "size": len(spec_blob), "slots": slots, "evicted": False}
+            self._lineage_order.append(task_id.binary())
+            self._lineage_bytes += len(spec_blob)
+            self._evict_lineage_locked()
         self._enqueue_task(key, resources, spec, max_retries,
                            strategy=scheduling_strategy, env=runtime_env)
         self.events.record(task_id.hex(), "SUBMITTED", name=spec["name"])
         return return_refs
+
+    def _evict_lineage_locked(self) -> None:
+        """owned_lock held: enforce lineage_max_bytes FIFO — evicted tasks'
+        objects become unrecoverable (their specs and pinned arg refs are
+        dropped), matching the reference's lineage eviction
+        (task_manager lineage footprint accounting)."""
+        budget = CONFIG.lineage_max_bytes
+        while self._lineage_bytes > budget and self._lineage_order:
+            tb = self._lineage_order[0]
+            meta = self._lineage_meta.get(tb)
+            if meta is None or meta["evicted"]:
+                self._lineage_order.popleft()
+                continue
+            # never evict lineage of a task whose outputs are still pending
+            # (its spec is also the retry path for worker death)
+            if any(self._owned[o].state == "pending"
+                   for o in meta["slots"] if o in self._owned):
+                break
+            self._lineage_order.popleft()
+            meta["evicted"] = True
+            self._lineage_bytes -= meta["size"]
+            for o in meta["slots"]:
+                e = self._owned.get(o)
+                if e is not None:
+                    e.task_spec = None
+            self._arg_refs.pop(tb, None)
 
     def _serialize_args(self, args: tuple, kwargs: dict):
         """Pickle args; ObjectRefs become markers resolved executor-side.
@@ -963,9 +1283,14 @@ class CoreWorker:
 
     def _on_task_reply(self, spec, reply) -> None:
         task_id = TaskID(spec["task_id"])
-        self._arg_refs.pop(spec["task_id"], None)
         results = reply["results"]
         with self._owned_lock:
+            # arg refs stay pinned while the task's lineage is retained:
+            # a reconstruction resubmits the same arg blob, so the owner
+            # must not free argument objects earlier (reference: lineage
+            # pinning keeps dependency refs alive, reference_count.h)
+            if spec["task_id"] not in self._lineage_meta:
+                self._arg_refs.pop(spec["task_id"], None)
             for i, result in enumerate(results):
                 oid = ObjectID.for_task_return(task_id, i)
                 entry = self._owned.get(oid)
@@ -991,6 +1316,9 @@ class CoreWorker:
                         entry.locations.add(result["location"])
                 entry.state = "ready"
                 entry.event.set()
+            # a completion may unblock FIFO lineage eviction that a pending
+            # head task was holding up at submit time
+            self._evict_lineage_locked()
         failed = any(r.get("error") for r in results)
         self.events.record(task_id.hex(), "FAILED" if failed else "FINISHED",
                            name=spec["name"])
@@ -998,6 +1326,7 @@ class CoreWorker:
     def _adopt_dynamic_returns_locked(self, task_id: TaskID, slot0_entry,
                                       sub_results) -> List[ObjectRef]:
         refs = []
+        lmeta = self._lineage_meta.get(task_id.binary())
         for j, sub in enumerate(sub_results):
             sub_oid = ObjectID.for_task_return(task_id, j + 1)
             sub_entry = self._owned.get(sub_oid)
@@ -1006,6 +1335,8 @@ class CoreWorker:
                 # re-running the task regenerates every dynamic return
                 sub_entry.task_spec = slot0_entry.task_spec
                 self._owned[sub_oid] = sub_entry
+            if lmeta is not None:
+                lmeta["slots"].add(sub_oid)
             sub_entry.error = sub.get("error", 0)
             if sub.get("data") is not None:
                 sub_entry.data = sub["data"]
@@ -1234,7 +1565,15 @@ class CoreWorker:
             return {"ready": True}
         if entry.data is not None:
             return {"data": entry.data}
-        return {"locations": list(entry.locations)}
+        locations = self._prune_dead_locations(entry)
+        if not locations:
+            # every copy died with its node: recover (or resolve the entry
+            # to ObjectLostError) off the RPC thread; the borrower keeps
+            # polling and picks up the recomputed value / error
+            threading.Thread(target=self._recover_or_fail,
+                             args=(oid, entry), daemon=True).start()
+            return None
+        return {"locations": list(locations)}
 
     # -------------------------------------------------------------- events
     def task_events(self) -> List[dict]:

@@ -43,7 +43,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.store_validate.argtypes = [ctypes.c_void_p]
     lib.store_create.restype = ctypes.c_longlong
     lib.store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                 ctypes.c_uint64, ctypes.c_uint64]
+                                 ctypes.c_uint64, ctypes.c_uint64,
+                                 ctypes.c_int]
     for name in ("store_seal", "store_release", "store_contains",
                  "store_delete", "store_abort"):
         fn = getattr(lib, name)
@@ -57,6 +58,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.store_stats.restype = None
     lib.store_stats.argtypes = [ctypes.c_void_p,
                                 ctypes.POINTER(ctypes.c_uint64)]
+    lib.store_list.restype = ctypes.c_uint32
+    lib.store_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint32]
     return lib
 
 
@@ -137,9 +141,10 @@ class SharedMemoryStore:
             pass
 
     # ------------------------------------------------------------- objects
-    def create(self, object_id: ObjectID, size: int,
-               meta: int = 0) -> memoryview:
-        rc = self._lib.store_create(self._base, object_id.binary(), size, meta)
+    def create(self, object_id: ObjectID, size: int, meta: int = 0,
+               allow_evict: bool = True) -> memoryview:
+        rc = self._lib.store_create(self._base, object_id.binary(), size,
+                                    meta, 1 if allow_evict else 0)
         if rc == -1:
             raise FileExistsError(f"object exists: {object_id}")
         if rc in (-2, -3):
@@ -186,6 +191,22 @@ class SharedMemoryStore:
     def delete(self, object_id: ObjectID) -> bool:
         return self._lib.store_delete(self._base, object_id.binary()) == 0
 
+    def list_objects(self, max_entries: int = 65536) -> list:
+        """Sealed objects as (ObjectID, size, lru_tick, pins) tuples — the
+        spill manager's victim-selection view (cf. reference eviction-policy
+        LRU walk feeding LocalObjectManager::SpillObjectsOfSize)."""
+        buf = ctypes.create_string_buffer(40 * max_entries)
+        n = self._lib.store_list(self._base, buf, max_entries)
+        out = []
+        raw = buf.raw
+        for i in range(n):
+            rec = raw[i * 40:(i + 1) * 40]
+            out.append((ObjectID(rec[:20]),
+                        int.from_bytes(rec[20:28], "little"),
+                        int.from_bytes(rec[28:36], "little"),
+                        int.from_bytes(rec[36:40], "little", signed=True)))
+        return out
+
     def stats(self) -> dict:
         out = (ctypes.c_uint64 * 5)()
         self._lib.store_stats(self._base, out)
@@ -195,10 +216,12 @@ class SharedMemoryStore:
 
     # --------------------------------------------------------- put helpers
     def put_serialized(self, object_id: ObjectID, head_payload: bytes,
-                       views, error: bool = False) -> None:
+                       views, error: bool = False,
+                       allow_evict: bool = True) -> None:
         from ray_tpu._private import serialization as ser
         total = ser.serialized_size(head_payload, views)
-        buf = self.create(object_id, total, meta=1 if error else 0)
+        buf = self.create(object_id, total, meta=1 if error else 0,
+                          allow_evict=allow_evict)
         try:
             ser.write_into(buf, head_payload, views)
         except BaseException:

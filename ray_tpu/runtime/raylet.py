@@ -119,8 +119,27 @@ class Raylet:
         self._hb_thread.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
-        self._spiller = threading.Thread(target=self._spill_loop, daemon=True)
+        self._spiller = threading.Thread(target=self._lease_spillback_loop,
+                                         daemon=True)
         self._spiller.start()
+
+        # object spilling (reference: LocalObjectManager,
+        # src/ray/raylet/local_object_manager.h:41 + external_storage.py:72):
+        # when shm usage crosses object_spill_threshold, LRU sealed unpinned
+        # objects move to disk files; fetches restore or stream them back.
+        self._spill_dir = os.path.join(
+            CONFIG.object_store_fallback_dir or session_dir,
+            f"spill_{self.node_id.hex()[:12]}")
+        os.makedirs(self._spill_dir, exist_ok=True)
+        self._spilled: Dict[bytes, Tuple[int, int]] = {}  # oid -> (size, meta)
+        # frees that couldn't complete yet (object pinned, e.g. mid-spill);
+        # retried by the spill loop so a free racing a spill can't leak the
+        # resulting file or shm copy
+        self._deferred_frees: set = set()
+        self._spill_mutex = threading.Lock()
+        self._obj_spiller = threading.Thread(target=self._object_spill_loop,
+                                             daemon=True)
+        self._obj_spiller.start()
         if CONFIG.log_to_driver:
             from ray_tpu._private.log_monitor import LogMonitor
 
@@ -176,11 +195,12 @@ class Raylet:
                             for k, c in shapes.items()]
                     busy = bool(self._leases) or bool(self._bundle_pools)
                 if not busy:
-                    # a node whose store still holds live objects is not idle:
-                    # terminating it would strand ObjectRefs (no lineage
-                    # re-execution recovers a deleted primary copy)
+                    # a node whose store (or spill dir) still holds live
+                    # objects is not idle: terminating it would strand
+                    # ObjectRefs on their primary copies
                     try:
-                        busy = self.store.stats()["num_objects"] > 0
+                        busy = (self.store.stats()["num_objects"] > 0
+                                or bool(self._spilled))
                     except Exception:
                         busy = True
                 reply = self.gcs.call("heartbeat",
@@ -200,16 +220,16 @@ class Raylet:
                     return
                 logger.warning("heartbeat to GCS failed")
 
-    def _spill_loop(self) -> None:
+    def _lease_spillback_loop(self) -> None:
         """Dedicated thread: never blocks heartbeats (a slow GCS list_nodes
         here must not delay liveness reporting past the death threshold)."""
         while not self._stopped.wait(1.0):
             try:
-                self._spill_scan()
+                self._lease_spillback_scan()
             except Exception:
-                logger.exception("spill scan failed")
+                logger.exception("lease spillback scan failed")
 
-    def _spill_scan(self) -> None:
+    def _lease_spillback_scan(self) -> None:
         """Redirect stale queued leases to nodes that now have capacity.
 
         When the autoscaler (ray_tpu/autoscaler/) brings a node up, requests
@@ -252,6 +272,193 @@ class Raylet:
                 self._pending_leases.remove(req)
                 req["out"]["grant"] = {"retry_at": list(target)}
                 req["event"].set()
+
+    # --------------------------------------------------------- object spill
+    def _object_spill_loop(self) -> None:
+        while not self._stopped.wait(0.2):
+            try:
+                self._retry_deferred_frees()
+                self._object_spill_scan()
+            except Exception:
+                logger.exception("object spill scan failed")
+
+    def _object_spill_scan(self) -> int:
+        """High-water spill: keep shm usage below object_spill_threshold by
+        moving LRU sealed unpinned objects to disk (hysteresis: spill down
+        to 90% of the threshold so the loop doesn't thrash at the line)."""
+        st = self.store.stats()
+        hi = CONFIG.object_spill_threshold * st["capacity"]
+        if st["bytes_in_use"] <= hi:
+            return 0
+        return self._spill_bytes(st["bytes_in_use"] - int(hi * 0.9))
+
+    def _spill_path(self, oid) -> str:
+        return os.path.join(self._spill_dir, oid.hex())
+
+    def _spill_bytes(self, needed: int) -> int:
+        """Spill LRU-first until ``needed`` bytes left shm (or no victims)."""
+        with self._spill_mutex:
+            objs = [o for o in self.store.list_objects() if o[3] == 0]
+            objs.sort(key=lambda t: t[2])  # oldest lru_tick first
+            freed = 0
+            for oid, size, _tick, _pins in objs:
+                if freed >= needed:
+                    break
+                if self._spill_one(oid, size):
+                    freed += size
+            return freed
+
+    def _spill_one(self, oid, size: int) -> bool:
+        with self._lock:
+            if oid.binary() in self._deferred_frees:
+                return False  # being freed: spilling it would leak the file
+        res = self.store.get(oid, timeout=0.0)
+        if res is None:
+            return False
+        buf, meta = res
+        path = self._spill_path(oid)
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(buf)
+            os.replace(tmp, path)
+        finally:
+            buf.release()
+            self.store.release(oid)
+        # record before delete: a fetch racing the handoff finds the object
+        # in at least one of the two places (both is harmless — immutable)
+        with self._lock:
+            self._spilled[oid.binary()] = (size, meta)
+        if not self.store.delete(oid):
+            # pinned between release and delete: keep it in shm
+            with self._lock:
+                self._spilled.pop(oid.binary(), None)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            return False
+        logger.debug("spilled %s (%d bytes)", oid.hex()[:12], size)
+        return True
+
+    def _fetch_spilled_chunk(self, oid, p) -> Optional[dict]:
+        with self._lock:
+            rec = self._spilled.get(oid.binary())
+        if rec is None:
+            return None
+        size, meta = rec
+        path = self._spill_path(oid)
+        # restore into shm when it fits under the spill threshold (reference
+        # LocalObjectManager restore / plasma re-create path) so subsequent
+        # local gets are zero-copy again
+        st = self.store.stats()
+        if st["bytes_in_use"] + size <= \
+                CONFIG.object_spill_threshold * st["capacity"]:
+            if self._restore_one(oid, size, meta, path):
+                res = self.store.get(oid, timeout=0.0)
+                if res is not None:
+                    buf, meta = res
+                    try:
+                        off = int(p.get("offset", 0))
+                        length = int(p.get("length", len(buf)))
+                        return {"total": len(buf), "meta": meta,
+                                "data": bytes(buf[off:off + length])}
+                    finally:
+                        buf.release()
+                        self.store.release(oid)
+        try:
+            with open(path, "rb") as f:
+                f.seek(int(p.get("offset", 0)))
+                data = f.read(int(p.get("length", size)))
+            return {"total": size, "meta": meta, "data": data}
+        except FileNotFoundError:
+            return None
+
+    def _restore_one(self, oid, size: int, meta: int, path: str) -> bool:
+        from ray_tpu.exceptions import ObjectStoreFullError
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return False
+        try:
+            buf = self.store.create(oid, size, meta=meta, allow_evict=False)
+        except FileExistsError:
+            return True  # restored concurrently
+        except (ObjectStoreFullError, OSError):
+            return False
+        try:
+            buf[:len(data)] = data
+        finally:
+            buf.release()
+        self.store.seal(oid)
+        with self._lock:
+            self._spilled.pop(oid.binary(), None)
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        logger.debug("restored %s (%d bytes)", oid.hex()[:12], size)
+        return True
+
+    def _rpc_spill_dir(self, conn, p):
+        """Clients writing fallback-allocated primaries need the dir."""
+        return self._spill_dir
+
+    def _rpc_register_spilled(self, conn, p):
+        """A client wrote a primary copy straight to the spill dir (plasma
+        fallback-allocation analog); track it like any spilled object."""
+        from ray_tpu._private.ids import ObjectID
+        oid = ObjectID(p["object_id"])
+        with self._lock:
+            self._spilled[oid.binary()] = (int(p["size"]),
+                                           int(p.get("meta", 0)))
+        return {"ok": True}
+
+    def _rpc_request_spill(self, conn, p):
+        """A client's create failed for lack of space: spill at least
+        ``bytes`` synchronously so its retry can succeed."""
+        freed = self._spill_bytes(int(p.get("bytes", 0)) or 1)
+        return {"freed": freed}
+
+    def _rpc_free_objects(self, conn, p):
+        """Owner says these objects' refcounts hit zero: drop the primary
+        copies (shm + spill files) on this node."""
+        from ray_tpu._private.ids import ObjectID
+        for ob in p.get("object_ids", ()):
+            oid = ObjectID(ob)
+            deleted = self.store.delete(oid)
+            with self._lock:
+                rec = self._spilled.pop(oid.binary(), None)
+            if rec is not None:
+                try:
+                    os.unlink(self._spill_path(oid))
+                except FileNotFoundError:
+                    pass
+            elif not deleted and self.store.contains(oid):
+                # pinned right now (a reader, or _spill_one mid-handoff):
+                # the single free RPC must still win eventually
+                with self._lock:
+                    self._deferred_frees.add(oid.binary())
+        return {"ok": True}
+
+    def _retry_deferred_frees(self) -> None:
+        from ray_tpu._private.ids import ObjectID
+        with self._lock:
+            pending = list(self._deferred_frees)
+        for ob in pending:
+            oid = ObjectID(ob)
+            self.store.delete(oid)
+            with self._lock:
+                rec = self._spilled.pop(ob, None)
+            if rec is not None:
+                try:
+                    os.unlink(self._spill_path(oid))
+                except FileNotFoundError:
+                    pass
+            if not self.store.contains(oid):
+                with self._lock:
+                    self._deferred_frees.discard(ob)
 
     def _reap_loop(self) -> None:
         """Detect dead worker processes (cf. WorkerPool child monitoring)."""
@@ -660,7 +867,7 @@ class Raylet:
         oid = ObjectID(p["object_id"])
         res = self.store.get(oid, timeout=p.get("timeout", 0.0))
         if res is None:
-            return None
+            return self._fetch_spilled_chunk(oid, p)
         buf, meta = res
         try:
             total = len(buf)
@@ -720,6 +927,8 @@ class Raylet:
             pass
         self.store.close()
         self.store.unlink()
+        import shutil
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
 
 
 def main():  # pragma: no cover - subprocess entry
