@@ -149,11 +149,17 @@ def init(address: Optional[str] = None, *,
             # job-level default env, inherited by every task/actor that
             # doesn't set its own (reference job_config.runtime_env)
             worker.job_runtime_env = worker.prepare_runtime_env(runtime_env)
-        worker.gcs.call("register_job", {
+        job_payload = {
             "job_id": job_id.hex(),
             "driver_address": list(worker.address),
             "entrypoint": " ".join(__import__("sys").argv[:2]),
-        })
+        }
+        worker.gcs.call("register_job", job_payload)
+        # a restarted GCS restores the job table but the fresh connection
+        # has no peer identity; re-registering is idempotent and restores
+        # the conn->job binding that drives job cleanup on driver exit
+        worker.gcs.on_reconnect = lambda client: client.call(
+            "register_job", job_payload, timeout=5)
         cw.set_global_worker(worker)
         return context()
 

@@ -79,6 +79,29 @@ class Cluster:
             self._node_procs.gcs_proc.kill()
             self._node_procs.gcs_proc.wait(timeout=10)
 
+    def restart_gcs(self) -> None:
+        """Kill the GCS and restart it on the same port: it replays its
+        file snapshot and raylets re-attach on their next heartbeat
+        (reference: test_gcs_fault_tolerance.py restart pattern)."""
+        import os
+        port = self.gcs_address[1]
+        self.kill_gcs()
+        addr_file = os.path.join(self.session_dir, "gcs_address.json")
+        try:
+            os.remove(addr_file)  # never report the dead server's address
+        except FileNotFoundError:
+            pass
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                self._node_procs.start_gcs(port=port)
+                return
+            except (RuntimeError, TimeoutError):
+                # the dead process's port may linger in TIME_WAIT briefly
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+
     def wait_for_nodes(self, count: Optional[int] = None,
                        timeout: float = 30.0) -> None:
         from ray_tpu.runtime.gcs import GcsClient

@@ -302,21 +302,28 @@ class CoreWorker:
             if entry is not None:
                 entry.refcount -= 1
                 if entry.refcount <= 0 and entry.state == "ready":
-                    del self._owned[oid]
-                    self._memory_cache.pop(oid, None)
-                    freed.append((oid, set(entry.locations)))
-                    self._lineage_slot_freed_locked(oid)
-                    for child in entry.dynamic_children or ():
-                        child_entry = self._owned.get(child)
-                        if child_entry is not None and \
-                                child_entry.refcount <= 0:
-                            # generator never deserialized: nothing else
-                            # will ever free these
-                            del self._owned[child]
-                            self._memory_cache.pop(child, None)
-                            freed.append((child,
-                                          set(child_entry.locations)))
-                            self._lineage_slot_freed_locked(child)
+                    self._free_with_children_locked(oid, entry, freed)
+        self._complete_frees(freed)
+
+    def _free_with_children_locked(self, oid: ObjectID,
+                                   entry: _OwnedObject,
+                                   freed: list) -> None:
+        self._free_entry_locked(oid, entry, freed)
+        for child in entry.dynamic_children or ():
+            child_entry = self._owned.get(child)
+            if child_entry is not None and child_entry.refcount <= 0:
+                # generator never deserialized: nothing else will ever
+                # free these
+                self._free_entry_locked(child, child_entry, freed)
+
+    def _free_entry_locked(self, oid: ObjectID, entry: _OwnedObject,
+                           freed: list) -> None:
+        del self._owned[oid]
+        self._memory_cache.pop(oid, None)
+        freed.append((oid, set(entry.locations)))
+        self._lineage_slot_freed_locked(oid)
+
+    def _complete_frees(self, freed: List[Tuple[ObjectID, set]]) -> None:
         for foid, locations in freed:
             self._release_pins(foid)
             # release the primary copies: local shm directly, remote nodes
@@ -325,8 +332,8 @@ class CoreWorker:
                 self.store.delete(foid)
             except Exception:
                 pass
-            # every location gets a free RPC — including our own node, whose
-            # raylet may hold the copy as a spill file
+            # every location gets a free RPC — including our own node,
+            # whose raylet may hold the copy as a spill file
             if locations:
                 with self._free_cv:
                     for node_hex in locations:
@@ -418,12 +425,12 @@ class CoreWorker:
             entry.data = ser.to_flat_bytes(head, views)
             self._memory_cache[oid] = value
         else:
-            self._store_put(oid, head, views)
+            self.store_put(oid, head, views)
             entry.locations.add(self.node_id)
         entry.event.set()
         return ObjectRef(oid, self.address, self)
 
-    def _store_put(self, oid: ObjectID, head, views,
+    def store_put(self, oid: ObjectID, head, views,
                    error: bool = False) -> None:
         """Write a primary copy into local shm.  Primaries are never
         LRU-evicted (allow_evict=False); on a full store the raylet spills
@@ -755,11 +762,15 @@ class CoreWorker:
             new_blob = cloudpickle.dumps(meta)
             spec = meta["spec"]
             task_id = TaskID(spec["task_id"])
+            lmeta = self._lineage_meta.get(task_id.binary())
+            if lmeta is not None and not lmeta["evicted"]:
+                # keep the byte ledger in sync with the re-pickled spec
+                self._lineage_bytes += len(new_blob) - lmeta["size"]
+                lmeta["size"] = len(new_blob)
             # reset every return slot of the task (the resubmission
             # regenerates them all), including adopted dynamic children
             slots = {ObjectID.for_task_return(task_id, i)
                      for i in range(num_return_slots(spec["num_returns"]))}
-            lmeta = self._lineage_meta.get(task_id.binary())
             if lmeta is not None:
                 slots |= lmeta["slots"]
             for sib_oid in slots:
@@ -991,6 +1002,7 @@ class CoreWorker:
                            error_type=type(error).__name__)
         head, views = ser.serialize(error, error_type=ser.ERROR_TASK)
         data = ser.to_flat_bytes(head, views)
+        freed: List[Tuple[ObjectID, set]] = []
         with self._owned_lock:
             for i in range(num_return_slots(spec["num_returns"])):
                 oid = ObjectID.for_task_return(task_id, i)
@@ -1000,6 +1012,9 @@ class CoreWorker:
                     entry.state = "ready"
                     entry.error = ser.ERROR_TASK
                     entry.event.set()
+                    if entry.refcount <= 0:
+                        self._free_entry_locked(oid, entry, freed)
+        self._complete_frees(freed)
 
     # ----- per-key scheduling queue: leased workers pull pending specs -----
     def _sched_state(self, key: str, resources,
@@ -1284,6 +1299,7 @@ class CoreWorker:
     def _on_task_reply(self, spec, reply) -> None:
         task_id = TaskID(spec["task_id"])
         results = reply["results"]
+        freed: List[Tuple[ObjectID, set]] = []
         with self._owned_lock:
             # arg refs stay pinned while the task's lineage is retained:
             # a reconstruction resubmits the same arg blob, so the owner
@@ -1316,9 +1332,15 @@ class CoreWorker:
                         entry.locations.add(result["location"])
                 entry.state = "ready"
                 entry.event.set()
+                # the last user ref may have been dropped while this slot
+                # was pending (e.g. mid-reconstruction): free now, or the
+                # entry and its unevictable primary copy leak forever
+                if entry.refcount <= 0:
+                    self._free_with_children_locked(oid, entry, freed)
             # a completion may unblock FIFO lineage eviction that a pending
             # head task was holding up at submit time
             self._evict_lineage_locked()
+        self._complete_frees(freed)
         failed = any(r.get("error") for r in results)
         self.events.record(task_id.hex(), "FAILED" if failed else "FINISHED",
                            name=spec["name"])
@@ -1476,6 +1498,7 @@ class CoreWorker:
                            error_type=type(error).__name__)
         head, views = ser.serialize(error, error_type=ser.ERROR_ACTOR_DIED)
         data = ser.to_flat_bytes(head, views)
+        freed: List[Tuple[ObjectID, set]] = []
         with self._owned_lock:
             for i in range(spec["num_returns"]):
                 oid = ObjectID.for_task_return(task_id, i)
@@ -1485,6 +1508,9 @@ class CoreWorker:
                     entry.state = "ready"
                     entry.error = ser.ERROR_ACTOR_DIED
                     entry.event.set()
+                    if entry.refcount <= 0:
+                        self._free_entry_locked(oid, entry, freed)
+        self._complete_frees(freed)
 
     def kill_actor(self, actor_id: ActorID) -> None:
         self.gcs.call("kill_actor", {"actor_id": actor_id.hex()})

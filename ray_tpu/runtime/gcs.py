@@ -37,10 +37,23 @@ DEAD = "DEAD"
 
 
 class GcsServer:
-    """All control state for one cluster; serves the RPC surface."""
+    """All control state for one cluster; serves the RPC surface.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    With ``persist_path`` set, every table mutation marks the state dirty
+    and a snapshot thread writes an atomic pickle (tmp+rename) of
+    nodes/actors/jobs/KV/placement-groups; a restarted GCS replays it
+    (reference: GcsInitData load at gcs_server.cc:121-181 over the
+    Redis/file store_client) and raylets re-attach via their next
+    heartbeat."""
+
+    SNAPSHOT_TABLES = ("_nodes", "_actors", "_named_actors", "_jobs",
+                      "_kv", "_placement_groups")
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
         self._lock = threading.RLock()
+        self._persist_path = persist_path
+        self._dirty = threading.Event()
         # node_id hex -> {address, resources, available, last_heartbeat, alive}
         self._nodes: Dict[str, Dict[str, Any]] = {}
         # actor_id hex -> actor table entry
@@ -61,9 +74,91 @@ class GcsServer:
         from ray_tpu._core.scheduler import make_scheduler
         self._cluster_scheduler = make_scheduler(
             spill_threshold=CONFIG.scheduler_spill_threshold)
+        if persist_path and os.path.exists(persist_path):
+            self._load_snapshot(persist_path)
         self._health_thread = threading.Thread(target=self._health_loop,
                                                daemon=True)
         self._health_thread.start()
+        if persist_path:
+            self._snap_thread = threading.Thread(target=self._snapshot_loop,
+                                                 daemon=True)
+            self._snap_thread.start()
+
+    # ------------------------------------------------------------ persistence
+    def _mark_dirty(self) -> None:
+        if self._persist_path:
+            self._dirty.set()
+
+    def _snapshot_loop(self) -> None:
+        while not self._stopped.wait(0.2):
+            if not self._dirty.is_set():
+                continue
+            self._dirty.clear()
+            try:
+                self._write_snapshot()
+            except Exception:
+                logger.exception("GCS snapshot write failed")
+        # final snapshot on clean stop so nothing since the last tick is lost
+        if self._dirty.is_set():
+            try:
+                self._write_snapshot()
+            except Exception:
+                pass
+
+    def _write_snapshot(self) -> None:
+        import pickle
+        with self._lock:
+            blob = pickle.dumps({t: getattr(self, t)
+                                 for t in self.SNAPSHOT_TABLES})
+        tmp = f"{self._persist_path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._persist_path)
+
+    def _load_snapshot(self, path: str) -> None:
+        import pickle
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        now = time.monotonic()
+        with self._lock:
+            for t in self.SNAPSHOT_TABLES:
+                getattr(self, t).update(state.get(t, {}))
+            for node in self._nodes.values():
+                # give restored nodes a fresh grace period to heartbeat in;
+                # monotonic timestamps from the old process are meaningless
+                node["last_heartbeat"] = now
+                node["last_busy"] = now
+                if node["alive"]:
+                    self._cluster_scheduler.update_node(
+                        node["node_id"], node["resources"],
+                        node["available"], True)
+            for a in self._actors.values():
+                # in-flight dispatches died with the old process: let the
+                # retry machinery re-drive anything not ALIVE/DEAD
+                if a.get("state") in (PENDING_CREATION, RESTARTING):
+                    a["dispatched"] = False
+                    a.pop("retry_delay", None)
+        logger.info("GCS state restored from %s: %d nodes, %d actors, "
+                    "%d jobs, %d kv keys, %d pgs", path, len(self._nodes),
+                    len(self._actors), len(self._jobs), len(self._kv),
+                    len(self._placement_groups))
+        threading.Thread(target=self._retry_after_reattach,
+                         daemon=True).start()
+
+    def _retry_after_reattach(self) -> None:
+        """Post-restore retry kick: wait for restored alive nodes to
+        re-attach their push connections (first heartbeat) before driving
+        pending actors — dispatching into an empty _node_conns would burn
+        every restart attempt in milliseconds on 'no connection to node'."""
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                alive = [n["node_id"] for n in self._nodes.values()
+                         if n["alive"]]
+                if alive and all(nid in self._node_conns for nid in alive):
+                    break
+            time.sleep(0.05)
+        self._retry_pending_actors()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -73,12 +168,22 @@ class GcsServer:
         self._stopped.set()
         self._server.stop()
 
+    # RPCs that change persisted tables; _handle marks the snapshot dirty
+    # after any of them (internal transitions call _mark_dirty directly)
+    _MUTATING_RPCS = frozenset({
+        "register_node", "register_job", "finish_job", "kv_put", "kv_del",
+        "register_actor", "actor_ready", "actor_failed", "kill_actor",
+        "create_placement_group", "remove_placement_group"})
+
     # ------------------------------------------------------------------ rpc
     def _handle(self, conn: rpc.Connection, method: str, p: Any) -> Any:
         fn = getattr(self, "_rpc_" + method, None)
         if fn is None:
             raise rpc.RpcError(f"GCS: unknown method {method}")
-        return fn(conn, p or {})
+        out = fn(conn, p or {})
+        if method in self._MUTATING_RPCS:
+            self._mark_dirty()
+        return out
 
     def _on_disconnect(self, conn: rpc.Connection) -> None:
         with self._lock:
@@ -158,6 +263,11 @@ class GcsServer:
                 # resurrected — tell it to shut down.
                 return {"ok": False, "dead": True}
             node["last_heartbeat"] = time.monotonic()
+            # after a GCS restart the duplex conns died with the old
+            # process: a heartbeat re-attaches this node's push channel
+            if self._node_conns.get(p["node_id"]) is not conn:
+                self._node_conns[p["node_id"]] = conn
+                conn.peer = ("node", p["node_id"])
             node["available"] = dict(p.get("available", node["available"]))
             self._cluster_scheduler.update_node(
                 p["node_id"], node["resources"], node["available"], True)
@@ -232,6 +342,7 @@ class GcsServer:
                           node_id in pg["placement"]]
         logger.warning("node %s marked dead (actors affected: %d)",
                        node_id[:8], len(affected))
+        self._mark_dirty()
         self._publish("node", {"node_id": node_id, "state": "DEAD"})
         for aid in affected:
             self._on_actor_failure(aid, f"node {node_id[:8]} died")
@@ -271,10 +382,14 @@ class GcsServer:
     def _rpc_register_job(self, conn, p):
         job_id = p["job_id"]
         with self._lock:
-            self._jobs[job_id] = {"job_id": job_id, "state": "RUNNING",
-                                  "driver_address": tuple(p.get("driver_address") or ()),
-                                  "start_time": time.time(),
-                                  "entrypoint": p.get("entrypoint", "")}
+            if job_id not in self._jobs:
+                self._jobs[job_id] = {
+                    "job_id": job_id, "state": "RUNNING",
+                    "driver_address": tuple(p.get("driver_address") or ()),
+                    "start_time": time.time(),
+                    "entrypoint": p.get("entrypoint", "")}
+            # idempotent re-register (e.g. after a GCS restart) must still
+            # bind this connection to the job for disconnect cleanup
             conn.peer = job_id
         return {"ok": True}
 
@@ -308,6 +423,7 @@ class GcsServer:
                     except ConnectionError:
                         pass
             self._publish("job", {"job_id": job_id, "state": "FINISHED"})
+            self._mark_dirty()
 
     def _rpc_list_jobs(self, conn, p):
         with self._lock:
@@ -608,6 +724,9 @@ class GcsServer:
                 entry["state"] = DEAD
                 entry["death_cause"] = reason
                 restart = False
+        # dirty AFTER the state transition: marking first lets the snapshot
+        # tick clear the flag and persist the pre-transition tables
+        self._mark_dirty()
         self._publish("actor", {"actor_id": aid,
                                 "state": RESTARTING if restart else DEAD,
                                 "reason": reason})
@@ -702,6 +821,8 @@ class GcsServer:
         finally:
             with self._lock:
                 pg["placing"] = False
+            # after the transition so the snapshot can't persist pre-state
+            self._mark_dirty()
 
     def _reserve_pg_bundles(self, pg, placement, conns) -> bool:
         pgid = pg["pg_id"]
@@ -865,18 +986,30 @@ class GcsServer:
 
 
 class GcsClient:
-    """Thin client; one duplex connection, also carries pubsub pushes."""
+    """Thin client; one duplex connection, also carries pubsub pushes.
+
+    Transport failures trigger transparent reconnects (bounded by the call
+    timeout) so clients ride through a GCS restart — the reference's
+    gcs_rpc_client reconnection/backoff behavior.  Subscriptions are
+    replayed on the fresh connection."""
 
     def __init__(self, address: Tuple[str, int],
                  push_handler=None, timeout: Optional[float] = None,
                  handler=None):
+        self._address = tuple(address)
         self._timeout = timeout or CONFIG.gcs_rpc_timeout_s
         self._sub_lock = threading.Lock()
         self._sub_handlers: Dict[str, List] = {}
         self._user_push = push_handler
+        self._handler = handler
+        self._conn_lock = threading.Lock()
+        self._closed = False
+        # called with this client after a successful reconnect, so owners
+        # of identity state (e.g. the driver's job binding) can restore it
+        self.on_reconnect = None
         # ``handler`` serves requests the GCS sends *to us* over this duplex
         # connection (e.g. create_actor dispatched to a raylet).
-        self._conn = rpc.connect(tuple(address),
+        self._conn = rpc.connect(self._address,
                                  push_handler=self._on_push,
                                  handler=handler)
 
@@ -893,10 +1026,47 @@ class GcsClient:
         elif self._user_push is not None:
             self._user_push(method, payload)
 
+    def _reconnect(self) -> None:
+        with self._conn_lock:
+            if self._closed or not self._conn.closed:
+                return
+            conn = rpc.connect(self._address, push_handler=self._on_push,
+                               handler=self._handler)
+            with self._sub_lock:
+                channels = list(self._sub_handlers)
+            for channel in channels:
+                conn.call("subscribe", {"channel": channel},
+                          timeout=self._timeout)
+            self._conn = conn
+            logger.info("GCS connection re-established to %s", self._address)
+        if self.on_reconnect is not None:
+            try:
+                self.on_reconnect(self)
+            except Exception:
+                logger.warning("GCS on_reconnect callback failed",
+                               exc_info=True)
+
     def call(self, method: str, payload: Any = None,
              timeout: Optional[float] = None) -> Any:
-        return self._conn.call(method, payload,
-                               timeout=timeout or self._timeout)
+        t = timeout or self._timeout
+        deadline = None if t is None else time.monotonic() + t
+        while True:
+            conn = self._conn
+            try:
+                if conn.closed:
+                    raise ConnectionError("GCS connection closed")
+                return conn.call(method, payload, timeout=t)
+            except (ConnectionError, OSError):
+                if self._closed or (deadline is not None
+                                    and time.monotonic() >= deadline):
+                    raise
+                try:
+                    self._reconnect()
+                except (ConnectionError, OSError, rpc.RpcError,
+                        TimeoutError):
+                    pass
+                if self._conn.closed:
+                    time.sleep(0.2)
 
     def subscribe(self, channel: str, handler) -> None:
         with self._sub_lock:
@@ -921,6 +1091,7 @@ class GcsClient:
         return self.call("kv_exists", {"key": key})
 
     def close(self) -> None:
+        self._closed = True
         self._conn.close()
 
     @property
@@ -938,7 +1109,9 @@ def main():  # pragma: no cover - spawned as a subprocess
     args = parser.parse_args()
     from ray_tpu._private.logging_utils import setup_component_logging
     setup_component_logging("gcs_server", args.session_dir)
-    server = GcsServer(args.host, args.port)
+    persist = (os.path.join(args.session_dir, "gcs_snapshot.pkl")
+               if args.session_dir else None)
+    server = GcsServer(args.host, args.port, persist_path=persist)
     if args.address_file:
         tmp = args.address_file + ".tmp"
         with open(tmp, "w") as f:

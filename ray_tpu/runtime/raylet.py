@@ -104,6 +104,7 @@ class Raylet:
         self.address = self._server.address
 
         self.gcs_address = tuple(gcs_address)
+        self.labels = dict(labels or {})
         self.gcs = GcsClient(gcs_address, push_handler=self._gcs_push,
                              handler=self._handle)
         self.gcs.call("register_node", {
@@ -111,7 +112,7 @@ class Raylet:
             "address": list(self.address),
             "store_path": self.store_path,
             "resources": self.resources,
-            "labels": labels or {},
+            "labels": self.labels,
         })
 
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
@@ -136,6 +137,7 @@ class Raylet:
         # retried by the spill loop so a free racing a spill can't leak the
         # resulting file or shm copy
         self._deferred_frees: set = set()
+        self._restoring: set = set()  # oids mid restore (file -> shm)
         self._spill_mutex = threading.Lock()
         self._obj_spiller = threading.Thread(target=self._object_spill_loop,
                                              daemon=True)
@@ -208,6 +210,20 @@ class Raylet:
                                        "available": avail,
                                        "load": load,
                                        "busy": busy})
+                if reply and reply.get("reregister"):
+                    # the GCS restarted without our node in its restored
+                    # state: introduce ourselves again
+                    try:
+                        self.gcs.call("register_node", {
+                            "node_id": self.node_id.hex(),
+                            "address": list(self.address),
+                            "store_path": self.store_path,
+                            "resources": self.resources,
+                            "labels": self.labels,
+                        })
+                    except (ConnectionError, rpc.RpcError, TimeoutError):
+                        pass
+                    continue
                 if reply and reply.get("dead"):
                     # the GCS declared us dead and restarted our actors
                     # elsewhere; fate-share instead of running split-brain
@@ -342,37 +358,58 @@ class Raylet:
         return True
 
     def _fetch_spilled_chunk(self, oid, p) -> Optional[dict]:
-        with self._lock:
-            rec = self._spilled.get(oid.binary())
-        if rec is None:
-            return None
-        size, meta = rec
-        path = self._spill_path(oid)
-        # restore into shm when it fits under the spill threshold (reference
-        # LocalObjectManager restore / plasma re-create path) so subsequent
-        # local gets are zero-copy again
-        st = self.store.stats()
-        if st["bytes_in_use"] + size <= \
-                CONFIG.object_spill_threshold * st["capacity"]:
-            if self._restore_one(oid, size, meta, path):
-                res = self.store.get(oid, timeout=0.0)
-                if res is not None:
-                    buf, meta = res
-                    try:
-                        off = int(p.get("offset", 0))
-                        length = int(p.get("length", len(buf)))
-                        return {"total": len(buf), "meta": meta,
-                                "data": bytes(buf[off:off + length])}
-                    finally:
-                        buf.release()
-                        self.store.release(oid)
+        """Serve a chunk of a spilled object, racing safely against a
+        concurrent restore (which removes the file and re-creates the shm
+        copy): a None return is authoritative 'absent' to owners, so every
+        transient mid-handoff window must be retried, never reported."""
+        for _ in range(3):
+            with self._lock:
+                rec = self._spilled.get(oid.binary())
+            if rec is None:
+                # not spilled (anymore): a concurrent restore may have just
+                # moved it to shm — block only if one is actually in flight
+                # (a plain absent object must answer fast: owners treat it
+                # as authoritative for reconstruction)
+                with self._lock:
+                    restoring = oid.binary() in self._restoring
+                res = self.store.get(oid, timeout=2.0 if restoring else 0.0)
+                if res is None:
+                    return None
+                return self._chunk_from_shm(oid, res, p)
+            size, meta = rec
+            path = self._spill_path(oid)
+            # restore into shm when it fits under the spill threshold
+            # (reference LocalObjectManager restore / plasma re-create
+            # path) so subsequent local gets are zero-copy again
+            st = self.store.stats()
+            if st["bytes_in_use"] + size <= \
+                    CONFIG.object_spill_threshold * st["capacity"]:
+                if self._restore_one(oid, size, meta, path):
+                    # blocking get: a concurrent restorer may not have
+                    # sealed yet
+                    res = self.store.get(oid, timeout=2.0)
+                    if res is not None:
+                        return self._chunk_from_shm(oid, res, p)
+                    continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(int(p.get("offset", 0)))
+                    data = f.read(int(p.get("length", size)))
+                return {"total": size, "meta": meta, "data": data}
+            except FileNotFoundError:
+                continue  # restored (or freed) under us: re-resolve
+        return None
+
+    def _chunk_from_shm(self, oid, res, p) -> dict:
+        buf, meta = res
         try:
-            with open(path, "rb") as f:
-                f.seek(int(p.get("offset", 0)))
-                data = f.read(int(p.get("length", size)))
-            return {"total": size, "meta": meta, "data": data}
-        except FileNotFoundError:
-            return None
+            off = int(p.get("offset", 0))
+            length = int(p.get("length", len(buf)))
+            return {"total": len(buf), "meta": meta,
+                    "data": bytes(buf[off:off + length])}
+        finally:
+            buf.release()
+            self.store.release(oid)
 
     def _restore_one(self, oid, size: int, meta: int, path: str) -> bool:
         from ray_tpu.exceptions import ObjectStoreFullError
@@ -381,25 +418,32 @@ class Raylet:
                 data = f.read()
         except FileNotFoundError:
             return False
-        try:
-            buf = self.store.create(oid, size, meta=meta, allow_evict=False)
-        except FileExistsError:
-            return True  # restored concurrently
-        except (ObjectStoreFullError, OSError):
-            return False
-        try:
-            buf[:len(data)] = data
-        finally:
-            buf.release()
-        self.store.seal(oid)
         with self._lock:
-            self._spilled.pop(oid.binary(), None)
+            self._restoring.add(oid.binary())
         try:
-            os.unlink(path)
-        except FileNotFoundError:
-            pass
-        logger.debug("restored %s (%d bytes)", oid.hex()[:12], size)
-        return True
+            try:
+                buf = self.store.create(oid, size, meta=meta,
+                                        allow_evict=False)
+            except FileExistsError:
+                return True  # restored concurrently
+            except (ObjectStoreFullError, OSError):
+                return False
+            try:
+                buf[:len(data)] = data
+            finally:
+                buf.release()
+            self.store.seal(oid)
+            with self._lock:
+                self._spilled.pop(oid.binary(), None)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            logger.debug("restored %s (%d bytes)", oid.hex()[:12], size)
+            return True
+        finally:
+            with self._lock:
+                self._restoring.discard(oid.binary())
 
     def _rpc_spill_dir(self, conn, p):
         """Clients writing fallback-allocated primaries need the dir."""

@@ -223,7 +223,7 @@ class WorkerProcess:
                 results.append({"data": ser.to_flat_bytes(head, views)})
             else:
                 oid = ObjectID.for_task_return(task_id, i)
-                self.core._store_put(oid, head, views)
+                self.core.store_put(oid, head, views)
                 results.append({"location": self.core.node_id})
         return {"results": results}
 
@@ -249,7 +249,7 @@ class WorkerProcess:
                 subs.append({"data": ser.to_flat_bytes(head, views)})
             else:
                 oid = ObjectID.for_task_return(task_id, j + 1)
-                self.core._store_put(oid, head, views)
+                self.core.store_put(oid, head, views)
                 subs.append({"location": self.core.node_id})
         return {"results": [{"dynamic": subs}]}
 
