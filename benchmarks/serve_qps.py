@@ -1,0 +1,108 @@
+"""Serve no-op throughput/latency benchmark.
+
+Counterpart of the reference's serve microbenchmark
+(/root/reference/python/ray/serve/benchmarks/microbenchmark.py) and the
+published numbers in doc/source/serve/performance.md:19-20 (1-2 ms handle
+overhead; 3-4k no-op qps with 1 proxy + 8 replicas on 8 cores).
+
+Prints one JSON line per scenario:
+  {"metric": "serve_handle_qps", "value": ..., "p50_ms": ..., "p99_ms": ...}
+"""
+
+import json
+import time
+
+
+def _percentiles(lat_s):
+    lat = sorted(lat_s)
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p / 100 * len(lat)))] * 1000.0
+
+    return pct(50), pct(99)
+
+
+def bench_handle(handle, n_warm=100, n=1000, concurrency=32):
+    """Closed-loop with `concurrency` in-flight calls through the
+    deployment handle (router + replica, no HTTP)."""
+    import ray_tpu
+    ray_tpu.get([handle.remote(i) for i in range(n_warm)], timeout=120)
+    lats = []
+    t0 = time.monotonic()
+    inflight = {handle.remote(time.monotonic()): None
+                for _ in range(concurrency)}
+    done = 0
+    while done < n:
+        ready, _ = ray_tpu.wait(list(inflight), num_returns=1, timeout=60)
+        for r in ready:
+            sent = ray_tpu.get(r, timeout=60)
+            lats.append(time.monotonic() - sent)
+            del inflight[r]
+            done += 1
+            if done + len(inflight) < n:
+                inflight[handle.remote(time.monotonic())] = None
+    elapsed = time.monotonic() - t0
+    p50, p99 = _percentiles(lats)
+    return n / elapsed, p50, p99
+
+
+def bench_http(port, n_warm=50, n=500, concurrency=16):
+    """aiohttp client closed-loop against the proxy."""
+    import asyncio
+
+    import aiohttp
+
+    async def run():
+        url = f"http://127.0.0.1:{port}/noop"
+        lats = []
+        async with aiohttp.ClientSession() as sess:
+            async def one():
+                t0 = time.monotonic()
+                async with sess.post(url, json=1) as resp:
+                    await resp.read()
+                lats.append(time.monotonic() - t0)
+
+            await asyncio.gather(*[one() for _ in range(n_warm)])
+            lats.clear()
+            t0 = time.monotonic()
+            sem = asyncio.Semaphore(concurrency)
+
+            async def bounded():
+                async with sem:
+                    await one()
+
+            await asyncio.gather(*[bounded() for _ in range(n)])
+            elapsed = time.monotonic() - t0
+        p50, p99 = _percentiles(lats)
+        return n / elapsed, p50, p99
+
+    return asyncio.run(run())
+
+
+def main():
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+
+    @serve.deployment(max_concurrent_queries=64, num_replicas=1)
+    def noop(x):
+        return x
+
+    handle = serve.run(noop.bind(),
+                       http_options=serve.HTTPOptions(port=18230))
+    qps, p50, p99 = bench_handle(handle)
+    print(json.dumps({"metric": "serve_handle_qps", "value": round(qps, 1),
+                      "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+                      "reference": "3-4k qps (8 replicas), 1-2ms overhead"}))
+    http_qps, hp50, hp99 = bench_http(18230)
+    print(json.dumps({"metric": "serve_http_qps",
+                      "value": round(http_qps, 1),
+                      "p50_ms": round(hp50, 2), "p99_ms": round(hp99, 2),
+                      "reference": "~1.9k req/s microbenchmark"}))
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,7 @@ attention, JaxTrainer, datasets, tuning, RL, and serving.
 """
 
 from ray_tpu._private.config import CONFIG  # noqa: F401
-from ray_tpu.actor import get_actor, kill  # noqa: F401
+from ray_tpu.actor import get_actor, kill, method  # noqa: F401
 from ray_tpu.api import (available_resources, cluster_resources, context,  # noqa: F401
                          get, init, is_initialized, nodes, put, remote,
                          shutdown, wait)

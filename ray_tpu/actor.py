@@ -12,38 +12,77 @@ from ray_tpu._private.ids import ActorID
 from ray_tpu.runtime.core_worker import get_global_worker
 
 
+def method(*args, **kwargs):
+    """``@ray_tpu.method(concurrency_group=..., num_returns=...)`` — method
+    options read worker-side at dispatch (cf. reference ray.method and
+    concurrency groups, src/ray/core_worker/transport/
+    concurrency_group_manager.h)."""
+    def decorate(fn):
+        fn.__ray_tpu_method_opts__ = dict(kwargs)
+        return fn
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@method takes keyword arguments only")
+    return decorate
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         worker = get_global_worker()
         refs = worker.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
-            num_returns=self._num_returns)
+            num_returns=self._num_returns,
+            concurrency_group=self._concurrency_group)
         return refs[0] if self._num_returns == 1 else refs
 
-    def options(self, num_returns: int = 1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int = 1,
+                concurrency_group: Optional[str] = None) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns,
+                           concurrency_group)
+
+
+def _collect_method_opts(cls) -> Dict[str, dict]:
+    """Per-method @ray_tpu.method(...) options, harvested from the class at
+    handle-creation time (the handle alone can't see the class later)."""
+    opts = {}
+    for name in dir(cls):
+        if name.startswith("__"):
+            continue
+        m = getattr(cls, name, None)
+        o = getattr(m, "__ray_tpu_method_opts__", None)
+        if o:
+            opts[name] = dict(o)
+    return opts
 
 
 class ActorHandle:
-    def __init__(self, actor_id: ActorID):
+    def __init__(self, actor_id: ActorID,
+                 method_opts: Optional[Dict[str, dict]] = None):
         self._actor_id = actor_id
+        self._method_opts = method_opts or {}
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        o = self._method_opts.get(name, {})
+        return ActorMethod(self, name,
+                           num_returns=o.get("num_returns", 1),
+                           concurrency_group=o.get("concurrency_group"))
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()[:12]})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id,))
+        return (ActorHandle, (self._actor_id, self._method_opts))
 
 
 class ActorClass:
@@ -51,7 +90,8 @@ class ActorClass:
                  resources: Optional[Dict[str, float]] = None,
                  max_restarts: int = 0, name: Optional[str] = None,
                  namespace: str = "", lifetime: Optional[str] = None,
-                 max_concurrency: int = 1,
+                 max_concurrency: Optional[int] = None,
+                 concurrency_groups: Optional[Dict[str, int]] = None,
                  scheduling_strategy=None,
                  runtime_env: Optional[Dict[str, Any]] = None):
         self._cls = cls
@@ -65,6 +105,7 @@ class ActorClass:
         self._namespace = namespace
         self._lifetime = lifetime
         self._max_concurrency = max_concurrency
+        self._concurrency_groups = dict(concurrency_groups or {})
         self._scheduling_strategy = scheduling_strategy
 
     def __call__(self, *args, **kwargs):
@@ -87,6 +128,7 @@ class ActorClass:
                 max_restarts=self._max_restarts,
                 name=self._name,
                 max_concurrency=self._max_concurrency,
+                concurrency_groups=self._concurrency_groups,
             ).remote(*args, **kwargs)
         from ray_tpu.util.scheduling_strategies import encode_strategy
         worker = get_global_worker()
@@ -97,10 +139,11 @@ class ActorClass:
             detached=self._lifetime == "detached",
             max_restarts=self._max_restarts,
             max_concurrency=self._max_concurrency,
+            concurrency_groups=self._concurrency_groups,
             resources=self._resources,
             scheduling_strategy=encode_strategy(self._scheduling_strategy),
             runtime_env=worker.prepare_runtime_env(self._runtime_env))
-        return ActorHandle(actor_id)
+        return ActorHandle(actor_id, _collect_method_opts(self._cls))
 
     def bind(self, *args, **kwargs):
         """Lazy DAG authoring (cf. reference dag/class_node.py)."""
@@ -121,6 +164,8 @@ class ActorClass:
             lifetime=opts.get("lifetime", self._lifetime),
             max_concurrency=opts.get("max_concurrency",
                                      self._max_concurrency),
+            concurrency_groups=opts.get("concurrency_groups",
+                                        self._concurrency_groups),
             scheduling_strategy=opts.get("scheduling_strategy",
                                          self._scheduling_strategy),
             runtime_env=opts.get("runtime_env", self._runtime_env))

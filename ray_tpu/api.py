@@ -214,7 +214,8 @@ def remote(*args, **kwargs):
                 name=kwargs.get("name"),
                 namespace=kwargs.get("namespace", ""),
                 lifetime=kwargs.get("lifetime"),
-                max_concurrency=kwargs.get("max_concurrency", 1),
+                max_concurrency=kwargs.get("max_concurrency"),
+                concurrency_groups=kwargs.get("concurrency_groups"),
                 scheduling_strategy=kwargs.get("scheduling_strategy"),
                 runtime_env=kwargs.get("runtime_env"))
         return RemoteFunction(

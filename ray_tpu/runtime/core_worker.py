@@ -1397,7 +1397,8 @@ class CoreWorker:
     def create_actor(self, cls, args, kwargs, *, name: Optional[str] = None,
                      namespace: str = "", detached: bool = False,
                      max_restarts: int = 0,
-                     max_concurrency: int = 1,
+                     max_concurrency: Optional[int] = None,
+                     concurrency_groups: Optional[Dict[str, int]] = None,
                      resources: Optional[Dict[str, float]] = None,
                      scheduling_strategy: Optional[dict] = None,
                      runtime_env: Optional[dict] = None) -> "ActorID":
@@ -1419,6 +1420,7 @@ class CoreWorker:
                                                  TaskID.from_random()),
             "owner_addr": list(self.address),
             "max_concurrency": max_concurrency,
+            "concurrency_groups": dict(concurrency_groups or {}),
         })
         self.gcs.call("register_actor", {
             "actor_id": actor_id.hex(),
@@ -1458,7 +1460,9 @@ class CoreWorker:
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict, *,
                           num_returns: int = 1,
-                          max_task_retries: int = 0) -> List[ObjectRef]:
+                          max_task_retries: int = 0,
+                          concurrency_group: Optional[str] = None
+                          ) -> List[ObjectRef]:
         if num_returns == "dynamic":
             raise ValueError(
                 'num_returns="dynamic" is only supported for tasks, '
@@ -1474,6 +1478,8 @@ class CoreWorker:
             "owner_addr": list(self.address),
             "name": method_name,
         }
+        if concurrency_group:
+            spec["group"] = concurrency_group
         refs = []
         with self._owned_lock:
             for i in range(num_returns):

@@ -61,7 +61,11 @@ class WorkerProcess:
         # actor state
         self.actor_instance: Any = None
         self.actor_id: Optional[str] = None
-        self._actor_pool = None  # ThreadPoolExecutor when max_concurrency > 1
+        self._actor_is_async = False
+        self._actor_event_loop = None   # asyncio loop for async actors
+        self._group_caps: Dict[str, int] = {}
+        self._group_sems: Dict[str, Any] = {}   # async: per-group Semaphore
+        self._group_pools: Optional[Dict[str, Any]] = None  # threaded
         # per caller-stream ordered queues (ActorSchedulingQueue analog):
         # {stream_id: {"next": int, "buf": {seq: work}}}
         self._actor_streams: Dict[str, Dict[str, Any]] = {}
@@ -255,20 +259,51 @@ class WorkerProcess:
 
     # --------------------------------------------------------------- actors
     def _create_actor(self, p) -> dict:
+        import inspect
+
         creation = cloudpickle.loads(p["spec"])
         cls = self.core.load_function(creation["cls_key"])
         args, kwargs, _borrowed = self._resolve_args(creation["args"])
         self.actor_id = p["actor_id"]
-        max_concurrency = int(creation.get("max_concurrency", 1) or 1)
-        if max_concurrency > 1:
+        groups = {str(g): int(c)
+                  for g, c in (creation.get("concurrency_groups")
+                               or {}).items()}
+        self._actor_is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _n, m in inspect.getmembers(cls, callable))
+        max_concurrency = creation.get("max_concurrency")
+        if max_concurrency is None:
+            # reference defaults: async actors allow 1000 concurrent
+            # coroutines, sync actors are serial — but an EXPLICIT
+            # max_concurrency=1 on an async actor is honored (the user
+            # asked for serialized execution)
+            max_concurrency = 1000 if self._actor_is_async else 1
+        max_concurrency = int(max_concurrency)
+        self._group_caps = {"_default": max_concurrency, **groups}
+        if self._actor_is_async:
+            # Async actor (cf. reference fiber.h + async actor event loop,
+            # _raylet.pyx:1121): one asyncio loop owns all method
+            # execution; up to the group's cap of coroutines interleave at
+            # await points, sync methods block the loop (reference
+            # semantics — actor state is only ever touched from this
+            # thread).
+            import asyncio
+            self._actor_event_loop = asyncio.new_event_loop()
+            threading.Thread(target=self._actor_event_loop.run_forever,
+                             daemon=True,
+                             name="actor-asyncio").start()
+            self._group_sems = {g: asyncio.Semaphore(c)
+                                for g, c in self._group_caps.items()}
+        elif max_concurrency > 1 or groups:
             # Threaded actor (cf. reference ConcurrencyGroupManager /
             # BoundedExecutor, src/ray/core_worker/transport/
             # concurrency_group_manager.h): methods dispatch in submission
-            # order but may execute concurrently on a bounded pool.
+            # order but may execute concurrently, bounded per group.
             from concurrent.futures import ThreadPoolExecutor
-            self._actor_pool = ThreadPoolExecutor(
-                max_workers=max_concurrency,
-                thread_name_prefix="actor-exec")
+            self._group_pools = {
+                g: ThreadPoolExecutor(max_workers=c,
+                                      thread_name_prefix=f"actor-{g}")
+                for g, c in self._group_caps.items()}
         self.actor_instance = cls(*args, **kwargs)
         self.core.gcs.call("actor_ready", {
             "actor_id": p["actor_id"],
@@ -307,10 +342,38 @@ class WorkerProcess:
                     self._actor_cv.wait()
                     work = self._next_actor_work()
             spec, done, out = work
-            if self._actor_pool is not None:
-                self._actor_pool.submit(self._run_actor_work, spec, done, out)
+            if self._actor_event_loop is not None:
+                self._dispatch_async(spec, done, out)
+            elif self._group_pools is not None:
+                try:
+                    group = self._method_group(spec)
+                except ValueError as e:
+                    out["reply"] = self._package_error(spec, e)
+                    done.set()
+                    continue
+                self._group_pools[group].submit(
+                    self._run_actor_work, spec, done, out)
             else:
                 self._run_actor_work(spec, done, out)
+
+    def _method_group(self, spec) -> str:
+        """Concurrency group for a call: per-call override, else the
+        @method(concurrency_group=...) declaration, else the default.
+        An undeclared group name is an error (reference semantics) — a
+        silent fallback would void the cap the caller relied on."""
+        g = spec.get("group")
+        if not g and self.actor_instance is not None:
+            m = getattr(type(self.actor_instance), spec.get("method", ""),
+                        None)
+            opts = getattr(m, "__ray_tpu_method_opts__", None) or {}
+            g = opts.get("concurrency_group")
+        if not g:
+            return "_default"
+        if g not in self._group_caps:
+            raise ValueError(
+                f"concurrency group {g!r} was not declared on this actor "
+                f"(declared: {sorted(k for k in self._group_caps if k != '_default')})")
+        return g
 
     def _run_actor_work(self, spec, done, out) -> None:
         try:
@@ -319,7 +382,31 @@ class WorkerProcess:
             out["raise"] = e
         done.set()
 
-    def _execute_actor(self, spec) -> dict:
+    def _dispatch_async(self, spec, done, out) -> None:
+        """Schedule one call onto the actor's event loop; the dispatcher
+        never blocks, so calls pipeline up to their group's semaphore."""
+        import asyncio
+
+        async def run():
+            try:
+                try:
+                    sem = self._group_sems[self._method_group(spec)]
+                except ValueError as e:
+                    out["reply"] = self._package_error(spec, e)
+                    return
+                async with sem:
+                    out["reply"] = await self._execute_actor_async(spec)
+            except BaseException as e:  # noqa: BLE001
+                out["raise"] = e
+            finally:
+                done.set()
+
+        asyncio.run_coroutine_threadsafe(run(), self._actor_event_loop)
+
+    def _begin_actor_call(self, spec):
+        """Shared prologue of sync/async actor execution: liveness guard
+        plus task bookkeeping.  Returns an error reply to short-circuit
+        with, or None to proceed."""
         if self.actor_instance is None:
             return self._package_error(
                 spec, exc.ActorDiedError("actor not initialized"))
@@ -327,6 +414,45 @@ class WorkerProcess:
         self.core.events.record(TaskID(spec["task_id"]).hex(), "RUNNING",
                                 name=spec.get("method", ""),
                                 actor_id=spec.get("actor_id", ""))
+        return None
+
+    async def _execute_actor_async(self, spec) -> dict:
+        """Async-actor execution: coroutine methods await on the loop
+        (interleaving with other calls of their group); sync methods run
+        inline on the loop thread, so actor state is single-threaded.
+        Arg resolution and result packaging do blocking IO (shm / RPC) and
+        run in the default executor to keep the loop responsive."""
+        import asyncio
+        import functools
+
+        err = self._begin_actor_call(spec)
+        if err is not None:
+            return err
+        loop = asyncio.get_running_loop()
+        borrowed = []
+        try:
+            args, kwargs, borrowed = await loop.run_in_executor(
+                None, self._resolve_args, spec["args"])
+            if spec["method"] == "__ray_terminate__":
+                import os
+                os._exit(0)
+            import inspect
+            method = getattr(self.actor_instance, spec["method"])
+            result = method(*args, **kwargs)
+            if inspect.isawaitable(result):
+                result = await result
+            return await loop.run_in_executor(
+                None, functools.partial(self._package_results, spec,
+                                        result))
+        except Exception as e:  # noqa: BLE001
+            return self._package_error(spec, e)
+        finally:
+            self.core.release_borrowed(borrowed)
+
+    def _execute_actor(self, spec) -> dict:
+        err = self._begin_actor_call(spec)
+        if err is not None:
+            return err
         borrowed = []
         try:
             args, kwargs, borrowed = self._resolve_args(spec["args"])

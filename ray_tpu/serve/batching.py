@@ -1,10 +1,10 @@
 """@serve.batch — coalesce concurrent calls into one batched invocation.
 
-Analog of /root/reference/python/ray/serve/batching.py (_BatchQueue). The
-reference coalesces asyncio tasks; here replicas are threaded actors
-(max_concurrency > 1), so the queue coalesces across concurrent threads:
-callers block on an event while a batcher thread drains the queue into
-calls of the wrapped function with a list of inputs.
+Analog of /root/reference/python/ray/serve/batching.py (_BatchQueue).
+Replicas are async actors, so concurrent handle_request coroutines each
+submit one input and await a per-call future; a batcher thread drains the
+queue into calls of the wrapped function with a list of inputs. Plain
+threads (threaded actors, driver-side use) block on an event instead.
 
 On TPU replicas this is the continuous-batching seam: the wrapped function
 sees a padded batch it can feed to a jitted forward step.
@@ -31,7 +31,7 @@ class _BatchQueue:
         self._max = max_batch_size
         self._wait = batch_wait_timeout_s
         self._lock = threading.Condition()
-        self._items: List[tuple] = []  # (arg, event, out)
+        self._items: List[tuple] = []  # (instance, arg, deliver)
         self._thread: Optional[threading.Thread] = None
 
     def _ensure_thread(self):
@@ -40,16 +40,48 @@ class _BatchQueue:
             self._thread.start()
 
     def submit(self, instance, arg) -> Any:
+        """From a plain thread: blocks until the batch result arrives.
+        From inside an event loop (async replica / async actor): returns an
+        awaitable instead — blocking would starve the very loop whose
+        concurrent calls form the batch (the reason the reference's
+        _BatchQueue is asyncio-native)."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            fut = loop.create_future()
+
+            def deliver(ok: bool, value: Any, _loop=loop, _fut=fut):
+                if ok:
+                    _loop.call_soon_threadsafe(
+                        lambda: None if _fut.done()
+                        else _fut.set_result(value))
+                else:
+                    _loop.call_soon_threadsafe(
+                        lambda: None if _fut.done()
+                        else _fut.set_exception(value))
+
+            self._enqueue(instance, arg, deliver)
+            return fut
         ev = threading.Event()
         out: dict = {}
-        with self._lock:
-            self._items.append((instance, arg, ev, out))
-            self._ensure_thread()
-            self._lock.notify()
+
+        def deliver(ok: bool, value: Any):
+            out["ok" if ok else "err"] = value
+            ev.set()
+
+        self._enqueue(instance, arg, deliver)
         ev.wait()
         if "err" in out:
             raise out["err"]
-        return out["val"]
+        return out["ok"]
+
+    def _enqueue(self, instance, arg, deliver) -> None:
+        with self._lock:
+            self._items.append((instance, arg, deliver))
+            self._ensure_thread()
+            self._lock.notify()
 
     def _loop(self):
         while True:
@@ -78,13 +110,11 @@ class _BatchQueue:
                     raise ValueError(
                         f"@serve.batch function returned {len(results)} "
                         f"results for a batch of {len(args)}")
-                for (_, _, ev, out), r in zip(batch, results):
-                    out["val"] = r
-                    ev.set()
+                for (_, _, deliver), r in zip(batch, results):
+                    deliver(True, r)
             except Exception as e:  # noqa: BLE001 - delivered to callers
-                for _, _, ev, out in batch:
-                    out["err"] = e
-                    ev.set()
+                for _, _, deliver in batch:
+                    deliver(False, e)
 
 
 def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,

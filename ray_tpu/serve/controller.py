@@ -220,7 +220,7 @@ class ServeController:
                         num_tpus=opts.get("num_tpus", 0.0),
                         resources=opts.get("resources"),
                     ).remote(state["serialized_init"], name, tag,
-                             config.get("user_config"))
+                             config.get("user_config"), max_cq)
                     replicas[tag] = {"name": actor_name, "version": version,
                                      "healthy": True, "fails": 0,
                                      "created_at": time.monotonic()}

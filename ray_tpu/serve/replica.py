@@ -8,26 +8,36 @@ reconfigure(user_config) and health checks.
 
 from __future__ import annotations
 
-import threading
+import asyncio
+import inspect
 import time
 from typing import Any, Dict
 
 
 class ReplicaActor:
-    """Runs as a threaded ray_tpu actor (max_concurrency =
-    max_concurrent_queries + house-keeping headroom) so queries execute
-    concurrently while metrics/health calls stay responsive."""
+    """Runs as an *async* ray_tpu actor (handle_request is a coroutine, so
+    the worker gives this actor an event loop): queries interleave at await
+    points up to the actor's max_concurrency, matching the reference
+    replica's asyncio execution model (replica.py:250). Sync user callables
+    still work — they just occupy the loop for their duration."""
 
     def __init__(self, serialized_init: bytes, deployment_name: str,
-                 replica_tag: str, user_config: Any = None):
+                 replica_tag: str, user_config: Any = None,
+                 max_concurrent_queries: int = 8):
         import cloudpickle
+        from concurrent.futures import ThreadPoolExecutor
         cls_or_fn, init_args, init_kwargs = cloudpickle.loads(serialized_init)
         self.deployment_name = deployment_name
         self.replica_tag = replica_tag
-        self._lock = threading.Lock()
         self._num_ongoing = 0
         self._num_processed = 0
         self._started = time.time()
+        # sync user callables run here so they parallelize up to
+        # max_concurrent_queries and never block the loop (metrics/health
+        # stay responsive); async callables run on the loop itself
+        self._sync_pool = ThreadPoolExecutor(
+            max_workers=max_concurrent_queries,
+            thread_name_prefix="replica-sync")
         if isinstance(cls_or_fn, type):
             self._callable = cls_or_fn(*init_args, **init_kwargs)
             self._is_function = False
@@ -38,10 +48,10 @@ class ReplicaActor:
             self.reconfigure(user_config)
 
     # ------------------------------------------------------------- requests
-    def handle_request(self, method_name: str, args: tuple,
-                       kwargs: dict) -> Any:
-        with self._lock:
-            self._num_ongoing += 1
+    async def handle_request(self, method_name: str, args: tuple,
+                             kwargs: dict) -> Any:
+        import functools
+        self._num_ongoing += 1
         try:
             if self._is_function:
                 target = self._callable
@@ -49,11 +59,22 @@ class ReplicaActor:
                 target = self._callable
             else:
                 target = getattr(self._callable, method_name)
-            return target(*args, **kwargs)
+            is_async = (inspect.iscoroutinefunction(target)
+                        or inspect.iscoroutinefunction(
+                            getattr(target, "__call__", None)))
+            if is_async:
+                result = await target(*args, **kwargs)
+            else:
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(
+                    self._sync_pool,
+                    functools.partial(target, *args, **kwargs))
+                if inspect.isawaitable(result):  # e.g. @serve.batch future
+                    result = await result
+            return result
         finally:
-            with self._lock:
-                self._num_ongoing -= 1
-                self._num_processed += 1
+            self._num_ongoing -= 1
+            self._num_processed += 1
 
     # ------------------------------------------------------------- control
     def reconfigure(self, user_config: Any) -> None:
@@ -68,19 +89,17 @@ class ReplicaActor:
     def get_metrics(self) -> Dict[str, Any]:
         """Queue metrics feeding the controller's autoscaling policy
         (cf. reference serve/_private/autoscaling_metrics.py)."""
-        with self._lock:
-            return {
-                "replica_tag": self.replica_tag,
-                "num_ongoing": self._num_ongoing,
-                "num_processed": self._num_processed,
-                "uptime_s": time.time() - self._started,
-            }
+        return {
+            "replica_tag": self.replica_tag,
+            "num_ongoing": self._num_ongoing,
+            "num_processed": self._num_processed,
+            "uptime_s": time.time() - self._started,
+        }
 
-    def prepare_for_shutdown(self) -> bool:
+    async def prepare_for_shutdown(self) -> bool:
         deadline = time.monotonic() + 5.0
         while time.monotonic() < deadline:
-            with self._lock:
-                if self._num_ongoing == 0:
-                    return True
-            time.sleep(0.05)
+            if self._num_ongoing == 0:
+                return True
+            await asyncio.sleep(0.05)
         return False
