@@ -85,6 +85,13 @@ _declare("memory_monitor_refresh_ms", int, 250,
          "Period of the per-node host-memory monitor; 0 disables it.")
 _declare("memory_usage_threshold", float, 0.95,
          "Host-memory fraction above which the worker-killing policy engages.")
+_declare("memory_monitor_test_usage_path", str, "",
+         "Fault-injection seam: path of a file holding a float usage "
+         "fraction the memory monitor reads instead of kernel counters.")
+_declare("task_oom_retries", int, 15,
+         "Separate retry budget for tasks whose worker was OOM-killed "
+         "(reference task_oom_retries, ray_config_def.h:104-111); regular "
+         "max_retries is not consumed by OOM kills.")
 _declare("fetch_fail_timeout_s", float, 60.0,
          "Grace window for transient fetch failures (unreachable raylet on "
          "an alive node) before an owned object is declared lost and lineage "
@@ -182,6 +189,14 @@ class Config:
     def update(self, overrides: Dict[str, Any]) -> None:
         for k, v in (overrides or {}).items():
             self.set(k, v)
+
+    def copy_overrides(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._overrides)
+
+    def set_overrides(self, overrides: Dict[str, Any]) -> None:
+        with self._lock:
+            self._overrides = dict(overrides)
 
     def overrides_env_blob(self) -> str:
         """Serialized overrides to pass to child processes via env."""

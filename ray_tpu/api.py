@@ -21,6 +21,7 @@ from ray_tpu.runtime.node import NodeProcesses, new_session_dir
 
 _init_lock = threading.Lock()
 _node: Optional[NodeProcesses] = None
+_pre_init_config: Optional[Dict[str, Any]] = None
 
 
 def is_initialized() -> bool:
@@ -70,6 +71,11 @@ def init(address: Optional[str] = None, *,
         if is_initialized():
             return context()
         if system_config:
+            # scoped to this cluster's lifetime: shutdown() restores the
+            # pre-init overrides so back-to-back init/shutdown cycles (the
+            # test pattern) don't leak one cluster's knobs into the next
+            global _pre_init_config
+            _pre_init_config = CONFIG.copy_overrides()
             CONFIG.update(system_config)
 
         if address is None:
@@ -181,7 +187,7 @@ def _client():
 
 
 def shutdown() -> None:
-    global _node
+    global _node, _pre_init_config
     from ray_tpu.util import client as client_mod
     if client_mod.current() is not None:
         client_mod.disconnect()
@@ -199,6 +205,9 @@ def shutdown() -> None:
         if _node is not None:
             _node.stop()
             _node = None
+        if _pre_init_config is not None:
+            CONFIG.set_overrides(_pre_init_config)
+            _pre_init_config = None
 
 
 def remote(*args, **kwargs):

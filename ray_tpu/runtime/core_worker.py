@@ -216,6 +216,9 @@ class CoreWorker:
         # RequestNewWorkerIfNeeded :325)
         self._sched: Dict[str, Dict[str, Any]] = {}
         self._sched_lock = threading.Lock()
+        # task binary -> remaining OOM-kill retries (separate budget from
+        # max_retries; reference task_oom_retries)
+        self._oom_retries: Dict[bytes, int] = {}
         self._fn_cache: Dict[str, Any] = {}
         self._node_table: Dict[str, Dict] = {}
 
@@ -995,12 +998,14 @@ class CoreWorker:
             self._arg_refs[task_id.binary()] = live_refs
         return blob
 
-    def _store_task_error(self, spec, error: BaseException) -> None:
+    def _store_task_error(self, spec, error: BaseException,
+                          error_code: int = ser.ERROR_TASK) -> None:
         task_id = TaskID(spec["task_id"])
         self._arg_refs.pop(spec["task_id"], None)
+        self._oom_retries.pop(spec["task_id"], None)
         self.events.record(task_id.hex(), "FAILED", name=spec.get("name", ""),
                            error_type=type(error).__name__)
-        head, views = ser.serialize(error, error_type=ser.ERROR_TASK)
+        head, views = ser.serialize(error, error_type=error_code)
         data = ser.to_flat_bytes(head, views)
         freed: List[Tuple[ObjectID, set]] = []
         with self._owned_lock:
@@ -1010,7 +1015,7 @@ class CoreWorker:
                 if entry is not None:
                     entry.data = data
                     entry.state = "ready"
-                    entry.error = ser.ERROR_TASK
+                    entry.error = error_code
                     entry.event.set()
                     if entry.refcount <= 0:
                         self._free_entry_locked(oid, entry, freed)
@@ -1256,8 +1261,27 @@ class CoreWorker:
                 if isinstance(e, rpc.RemoteError):
                     self._store_task_error(spec, exc.RayTpuError(str(e)))
                     continue
-                # worker died mid-task
-                if retries > 0:
+                # worker died mid-task.  An OOM kill draws from its own
+                # retry budget (task_oom_retries) and leaves max_retries
+                # untouched — the task didn't fail, the node ran dry
+                if self._lease_was_oom_killed(lease):
+                    left = self._oom_retries.get(spec["task_id"],
+                                                 CONFIG.task_oom_retries)
+                    if left > 0:
+                        self._oom_retries[spec["task_id"]] = left - 1
+                        logger.info(
+                            "task %s OOM-killed; retrying (%d OOM "
+                            "retries left)", spec["name"], left - 1)
+                        with self._sched_lock:
+                            st["queue"].appendleft((spec, retries))
+                    else:
+                        self._store_task_error(
+                            spec, exc.OutOfMemoryError(
+                                f"task {spec['name']} was OOM-killed "
+                                f"{CONFIG.task_oom_retries + 1} times "
+                                f"(host memory exhausted)"),
+                            error_code=ser.ERROR_OOM)
+                elif retries > 0:
                     logger.info("task %s worker died; retrying (%d left)",
                                 spec["name"], retries)
                     with self._sched_lock:
@@ -1275,6 +1299,22 @@ class CoreWorker:
                 return
         self._return_lease(lease)
         self._maybe_request_lease(key, st)
+
+    def _lease_was_oom_killed(self, lease: _Lease) -> bool:
+        payload = {"worker_id": lease.worker_id}
+        try:
+            if lease.granting_addr is None:
+                reply = self._raylet.call("was_oom_killed", payload,
+                                          timeout=5)
+            else:
+                conn = rpc.connect(tuple(lease.granting_addr))
+                try:
+                    reply = conn.call("was_oom_killed", payload, timeout=5)
+                finally:
+                    conn.close()
+            return bool(reply.get("oom"))
+        except (ConnectionError, rpc.RpcError, TimeoutError, OSError):
+            return False
 
     def _return_lease(self, lease: _Lease) -> None:
         payload = {"lease_id": lease.lease_id,
@@ -1307,6 +1347,7 @@ class CoreWorker:
             # pinning keeps dependency refs alive, reference_count.h)
             if spec["task_id"] not in self._lineage_meta:
                 self._arg_refs.pop(spec["task_id"], None)
+            self._oom_retries.pop(spec["task_id"], None)
             for i, result in enumerate(results):
                 oid = ObjectID.for_task_return(task_id, i)
                 entry = self._owned.get(oid)

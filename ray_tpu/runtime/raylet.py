@@ -65,6 +65,7 @@ class WorkerHandle:
         self.actor_id: Optional[str] = None
         self.job_id: Optional[str] = None
         self.last_idle = time.monotonic()
+        self.started_at = time.monotonic()
 
 
 class Raylet:
@@ -142,6 +143,18 @@ class Raylet:
         self._obj_spiller = threading.Thread(target=self._object_spill_loop,
                                              daemon=True)
         self._obj_spiller.start()
+
+        # host-memory monitor + OOM worker-killing policy (reference
+        # MemoryMonitor, memory_monitor.h:52 + worker_killing_policy.h)
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+        self._oom_kills: Dict[str, float] = {}   # worker_id -> kill time
+        self._oom_kill_count = 0
+        self._last_oom_kill = 0.0
+        self._memory_monitor = MemoryMonitor(self._on_memory_breach)
+        if self._memory_monitor.enabled:
+            self._mem_thread = threading.Thread(
+                target=self._memory_monitor_loop, daemon=True)
+            self._mem_thread.start()
         if CONFIG.log_to_driver:
             from ray_tpu._private.log_monitor import LogMonitor
 
@@ -504,6 +517,52 @@ class Raylet:
                 with self._lock:
                     self._deferred_frees.discard(ob)
 
+    # --------------------------------------------------------- memory / OOM
+    def _memory_monitor_loop(self) -> None:
+        while not self._stopped.wait(self._memory_monitor.refresh_s):
+            try:
+                self._memory_monitor.poll_once()
+            except Exception:
+                logger.exception("memory monitor poll failed")
+
+    def _on_memory_breach(self, usage: float) -> None:
+        """Kill one worker per refresh period at most — killing frees
+        memory asynchronously, so firing every poll would massacre the
+        pool before the first kill lands."""
+        now = time.monotonic()
+        if now - self._last_oom_kill < self._memory_monitor.refresh_s * 2:
+            return
+        from ray_tpu._private.memory_monitor import pick_oom_victim
+        with self._lock:
+            view = [(wid, h.actor_id is not None, h.started_at,
+                     h.lease_id is not None)
+                    for wid, h in self._workers.items()]
+        victim = pick_oom_victim(view)
+        if victim is None:
+            logger.warning("memory usage %.2f over threshold but no "
+                           "killable worker", usage)
+            return
+        self._last_oom_kill = now
+        with self._lock:
+            self._oom_kills[victim] = now
+            self._oom_kill_count += 1
+            # bound the ledger; owners query within seconds of the kill
+            if len(self._oom_kills) > 1024:
+                for k in sorted(self._oom_kills,
+                                key=self._oom_kills.get)[:512]:
+                    del self._oom_kills[k]
+        logger.warning("memory usage %.2f >= %.2f: OOM-killing worker %s "
+                       "(retriable-LIFO policy)", usage,
+                       self._memory_monitor.threshold, victim[:8])
+        self._kill_worker(victim, f"OOM-killed (host memory {usage:.0%})",
+                          force=True)
+
+    def _rpc_was_oom_killed(self, conn, p):
+        """Owners distinguish an OOM kill from a plain crash so the
+        OOM-specific retry counter applies (reference task_oom_retries)."""
+        with self._lock:
+            return {"oom": p.get("worker_id") in self._oom_kills}
+
     def _reap_loop(self) -> None:
         """Detect dead worker processes (cf. WorkerPool child monitoring)."""
         while not self._stopped.wait(0.1):
@@ -617,13 +676,21 @@ class Raylet:
                 pass
         self._dispatch_pending()
 
-    def _kill_worker(self, wid: str, reason: str) -> None:
+    def _kill_worker(self, wid: str, reason: str,
+                     force: bool = False) -> None:
         with self._lock:
             h = self._workers.get(wid)
         if h is None:
             return
         try:
-            h.proc.terminate()
+            # force=SIGKILL for OOM kills: a SIGTERM trap (or a long native
+            # call) would let the hog survive untracked while the monitor
+            # serially kills innocent workers (reference memory monitor
+            # kills with SIGKILL for the same reason)
+            if force:
+                h.proc.kill()
+            else:
+                h.proc.terminate()
         except OSError:
             pass
         self._on_worker_dead(wid, reason)
@@ -821,6 +888,10 @@ class Raylet:
             with self._lock:
                 self._leases[lease_id] = {"need": need, "pool": pool_key}
                 handle.lease_id = lease_id
+                # stamp at lease assignment, not spawn: the OOM policy's
+                # LIFO ranks by progress at risk, and a reused idle worker
+                # starts fresh work now
+                handle.started_at = time.monotonic()
                 handle.job_id = req["job_id"]
                 abandoned = req.get("abandoned", False)
                 if not abandoned:
@@ -887,6 +958,7 @@ class Raylet:
         with self._lock:
             self._leases[lease_id] = {"need": need, "pool": pool_key}
             handle.lease_id = lease_id
+            handle.started_at = time.monotonic()
             handle.actor_id = p["actor_id"]
         try:
             handle.conn.call("create_actor", {
@@ -944,6 +1016,8 @@ class Raylet:
                     "resources": dict(self.resources),
                     "available": dict(self.available),
                     "num_workers": len(self._workers),
+                    "oom_kill_count": self._oom_kill_count,
+                    "memory_usage": self._memory_monitor.last_usage,
                     "store_path": self.store_path}
 
     # ------------------------------------------------------------------ stop

@@ -1,0 +1,98 @@
+"""Memory monitor + OOM worker-killing tests (cf. reference
+python/ray/tests/test_memory_pressure.py and worker_killing_policy tests).
+
+Uses the memory_monitor_test_usage_path fault-injection seam instead of
+actually exhausting host memory."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.memory_monitor import pick_oom_victim
+from ray_tpu.exceptions import OutOfMemoryError
+
+
+def test_pick_oom_victim_retriable_lifo():
+    # (worker_id, is_actor, started_at, is_active)
+    workers = [
+        ("task-old", False, 10.0, True),
+        ("task-new", False, 20.0, True),
+        ("actor-new", True, 30.0, True),
+        ("idle", False, 40.0, False),
+    ]
+    # newest *task* first, even though the actor started later
+    assert pick_oom_victim(workers) == "task-new"
+    # actors are last-resort victims
+    assert pick_oom_victim([w for w in workers
+                            if not w[0].startswith("task")]) == "actor-new"
+    # nothing active -> nothing to kill
+    assert pick_oom_victim([("idle", False, 1.0, False)]) is None
+
+
+def test_oom_kill_retries_then_succeeds(tmp_path):
+    """A task whose worker is OOM-killed retries on its OOM budget and
+    succeeds once memory pressure clears."""
+    usage = tmp_path / "usage.txt"
+    usage.write_text("0.10")
+    marker = tmp_path / "runs.txt"
+    ray_tpu.init(
+        num_cpus=2, object_store_memory=64 * 1024 * 1024,
+        system_config={
+            "memory_monitor_test_usage_path": str(usage),
+            "memory_monitor_refresh_ms": 100,
+            "memory_usage_threshold": 0.9,
+        })
+
+    @ray_tpu.remote(num_cpus=1, max_retries=0)
+    def slow():
+        with open(marker, "a") as f:
+            f.write("x")
+        time.sleep(3.0)
+        return "done"
+
+    ref = slow.remote()
+    # wait until the task is actually running, then inject memory pressure
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not marker.exists():
+        time.sleep(0.05)
+    assert marker.exists()
+    usage.write_text("0.99")
+    time.sleep(0.6)   # monitor fires (>= one refresh period)
+    usage.write_text("0.10")
+    # the retry (on the OOM budget — max_retries=0!) must succeed
+    assert ray_tpu.get(ref, timeout=120) == "done"
+    assert marker.read_text().count("x") >= 2
+    ray_tpu.shutdown()
+
+
+def test_oom_budget_exhausted_raises(tmp_path):
+    """Permanent memory pressure exhausts task_oom_retries and surfaces
+    OutOfMemoryError (not WorkerCrashedError)."""
+    usage = tmp_path / "usage.txt"
+    usage.write_text("0.10")
+    marker = tmp_path / "runs.txt"
+    ray_tpu.init(
+        num_cpus=2, object_store_memory=64 * 1024 * 1024,
+        system_config={
+            "memory_monitor_test_usage_path": str(usage),
+            "memory_monitor_refresh_ms": 100,
+            "memory_usage_threshold": 0.9,
+            "task_oom_retries": 1,
+        })
+
+    @ray_tpu.remote(num_cpus=1, max_retries=0)
+    def hog():
+        with open(marker, "a") as f:
+            f.write("x")
+        time.sleep(30.0)
+        return "never"
+
+    ref = hog.remote()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not marker.exists():
+        time.sleep(0.05)
+    usage.write_text("0.99")  # pressure never clears
+    with pytest.raises(OutOfMemoryError):
+        ray_tpu.get(ref, timeout=120)
+    ray_tpu.shutdown()
