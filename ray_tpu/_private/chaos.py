@@ -1,0 +1,112 @@
+"""Chaos-injection utilities for failure testing.
+
+Analog of the reference's NodeKiller / get_and_run_node_killer
+(/root/reference/python/ray/_private/test_utils.py:1301): a background
+loop that periodically kills a random alive raylet (via the raylet's
+``die`` chaos RPC), sparing a protected set (the head node), so recovery
+paths — task retries, actor restarts, lineage reconstruction, PG
+rescheduling — are exercised under unplanned loss instead of only
+scripted removals.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.logging_utils import get_logger
+from ray_tpu.runtime.gcs import GcsClient
+
+logger = get_logger("chaos")
+
+
+class NodeKiller:
+    """Kills a random unprotected alive node every ``interval_s``."""
+
+    def __init__(self, gcs_address: Tuple[str, int],
+                 protected_node_ids: Sequence[str] = (),
+                 interval_s: float = 5.0,
+                 max_kills: Optional[int] = None,
+                 seed: int = 0):
+        self._gcs_address = tuple(gcs_address)
+        self._protected = set(protected_node_ids)
+        self._interval = interval_s
+        self._max_kills = max_kills
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills: list = []  # node_id hexes, in kill order
+
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        gcs = GcsClient(self._gcs_address)
+        try:
+            while not self._stop.wait(self._interval):
+                if self._max_kills is not None and \
+                        len(self.kills) >= self._max_kills:
+                    return
+                try:
+                    self.kill_one(gcs)
+                except Exception:
+                    logger.exception("node kill attempt failed")
+        finally:
+            gcs.close()
+
+    def kill_one(self, gcs: Optional[GcsClient] = None) -> Optional[str]:
+        """Kill one random eligible node now; returns its id or None."""
+        own = gcs is None
+        if own:
+            gcs = GcsClient(self._gcs_address)
+        try:
+            nodes = [n for n in gcs.call("list_nodes", timeout=10)
+                     if n["alive"] and n["node_id"] not in self._protected]
+            if not nodes:
+                return None
+            victim = self._rng.choice(nodes)
+            # a node the GCS hasn't noticed dying yet refuses the connect:
+            # that's not a kill, don't spend budget on it
+            if victim["node_id"] in self.kills:
+                return None
+            try:
+                conn = rpc.connect(tuple(victim["address"]), timeout=5.0)
+            except (ConnectionError, TimeoutError, OSError):
+                return None  # already down
+            try:
+                try:
+                    conn.call("die", {}, timeout=5)
+                except (ConnectionError, rpc.RpcError, TimeoutError,
+                        OSError):
+                    pass  # dying mid-reply is success
+            finally:
+                conn.close()
+            self.kills.append(victim["node_id"])
+            logger.warning("chaos: killed node %s", victim["node_id"][:8])
+            return victim["node_id"]
+        finally:
+            if own:
+                gcs.close()
+
+
+def kill_component(address: Tuple[str, int]) -> bool:
+    """One-shot kill of any daemon exposing the ``die`` RPC."""
+    try:
+        conn = rpc.connect(tuple(address), timeout=5.0)
+        try:
+            conn.call("die", {}, timeout=5)
+        finally:
+            conn.close()
+        return True
+    except (ConnectionError, rpc.RpcError, TimeoutError, OSError):
+        return True  # it died before replying — that's the point

@@ -1307,7 +1307,7 @@ class CoreWorker:
                 reply = self._raylet.call("was_oom_killed", payload,
                                           timeout=5)
             else:
-                conn = rpc.connect(tuple(lease.granting_addr))
+                conn = rpc.connect(tuple(lease.granting_addr), timeout=5.0)
                 try:
                     reply = conn.call("was_oom_killed", payload, timeout=5)
                 finally:

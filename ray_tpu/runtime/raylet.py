@@ -542,8 +542,13 @@ class Raylet:
             logger.warning("memory usage %.2f over threshold but no "
                            "killable worker", usage)
             return
-        self._last_oom_kill = now
         with self._lock:
+            # re-check under the lock: a victim that exited on its own
+            # since the snapshot must not be charged as an OOM kill (its
+            # owner would silently retry a crash on the OOM budget)
+            if victim not in self._workers:
+                return
+            self._last_oom_kill = now
             self._oom_kills[victim] = now
             self._oom_kill_count += 1
             # bound the ledger; owners query within seconds of the kill
@@ -556,6 +561,19 @@ class Raylet:
                        self._memory_monitor.threshold, victim[:8])
         self._kill_worker(victim, f"OOM-killed (host memory {usage:.0%})",
                           force=True)
+
+    def _rpc_die(self, conn, p):
+        """Chaos seam (reference NodeKiller, _private/test_utils.py:1301):
+        hard-exit the raylet as if the node vanished.  Workers fate-share
+        via their raylet connection; graceful=False skips all cleanup."""
+        logger.warning("raylet received die request (chaos)")
+
+        def _exit():
+            time.sleep(0.05)  # let the RPC reply flush
+            os._exit(1)
+
+        threading.Thread(target=_exit, daemon=True).start()
+        return {"ok": True}
 
     def _rpc_was_oom_killed(self, conn, p):
         """Owners distinguish an OOM kill from a plain crash so the
