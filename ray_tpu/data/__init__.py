@@ -91,13 +91,27 @@ def read_images(paths, *, size=None, mode: Optional[str] = None) -> Dataset:
 
 
 def from_torch(torch_dataset, *, parallelism: int = 8) -> Dataset:
-    """Materialize a torch map-style Dataset (cf. reference
-    read_api.from_torch): rows are whatever __getitem__ yields."""
+    """Read a torch map-style Dataset in parallel (cf. reference
+    read_api.from_torch): the index range splits into per-block read
+    tasks that call ``__getitem__`` inside workers, so the driver never
+    materializes the whole dataset (the dataset object itself must be
+    small enough to pickle to each task — true for the common
+    lazy-loading map-style datasets)."""
     import builtins
-    # NB: ``range`` here is ray_tpu.data.range (the dataset constructor)
-    items = [torch_dataset[i]
-             for i in builtins.range(len(torch_dataset))]
-    return from_items(items, parallelism=parallelism)
+    n = len(torch_dataset)
+    parallelism = max(1, min(parallelism, n or 1))
+    per = max(1, (n + parallelism - 1) // parallelism)
+
+    def make_read(start: int, stop: int):
+        def read_block():
+            return [torch_dataset[i] for i in builtins.range(start, stop)]
+        return _dsrc.ReadTask(read_block, num_rows=stop - start)
+
+    tasks = [make_read(s, min(s + per, n))
+             for s in builtins.range(0, n, per)]
+    if not tasks:
+        return from_items([])
+    return _from_tasks(tasks)
 
 
 def from_huggingface(hf_dataset) -> Dataset:

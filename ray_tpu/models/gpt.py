@@ -50,7 +50,13 @@ class RMSNorm(nn.Module):
 
 
 class MLP(nn.Module):
-    """SwiGLU feed-forward (shared by the decoder, encoder, and T5)."""
+    """SwiGLU feed-forward (shared by the decoder, encoder, and T5).
+
+    FORMAT BREAK (round 1): extracting this submodule renamed parameter
+    paths ``block_i/w_gate`` -> ``block_i/mlp/w_gate`` (same under scan).
+    Checkpoints written before that refactor need their keys re-nested
+    under ``mlp/`` to load; no shim is kept since no pre-break checkpoint
+    left the repo."""
 
     cfg: TransformerConfig
 

@@ -233,16 +233,39 @@ class Algorithm:
                 "episode_len_mean": float(np.mean(lens)),
                 "episodes_total": len(self._episode_history)}
 
+    def get_full_state(self):
+        """Complete training state for checkpointing — actor AND critics,
+        target networks, optimizer moments (reference semantics: a resumed
+        run continues training, it doesn't restart the critics from
+        scratch).  Defaults to host-mapping ``self.state`` when the
+        algorithm keeps one; weight-only algorithms return None and fall
+        back to get_weights."""
+        state = getattr(self, "state", None)
+        if state is None:
+            return None
+        import jax
+        return jax.tree.map(np.asarray, state)
+
+    def set_full_state(self, state) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.state = jax.tree.map(jnp.asarray, state)
+
     def save(self) -> Checkpoint:
         return Checkpoint.from_dict({
             "weights": self.get_weights(),
+            "state": self.get_full_state(),
             "iteration": self.iteration,
             "timesteps_total": self._timesteps_total,
         })
 
     def restore(self, checkpoint: Checkpoint) -> None:
         d = checkpoint.to_dict()
-        self.set_weights(d["weights"])
+        if d.get("state") is not None:
+            self.set_full_state(d["state"])
+        else:
+            # legacy weight-only checkpoint (or weight-only algorithm)
+            self.set_weights(d["weights"])
         self.iteration = d.get("iteration", 0)
         self._timesteps_total = d.get("timesteps_total", 0)
         self.workers.sync_weights(self.get_weights())

@@ -197,11 +197,16 @@ class CRR:
 
     def save(self) -> Checkpoint:
         return Checkpoint.from_dict({
+            "state": self._jax.tree.map(np.asarray, self.state),
             "weights": self.get_weights(), "iteration": self.iteration})
 
     def restore(self, checkpoint: Checkpoint) -> None:
         d = checkpoint.to_dict()
-        self.set_weights(d["weights"])
+        if d.get("state") is not None:
+            # full training state: actor + critics + targets + optimizers
+            self.state = self._jax.tree.map(self._jnp.asarray, d["state"])
+        else:  # legacy actor-only checkpoint
+            self.set_weights(d["weights"])
         self.iteration = d.get("iteration", 0)
 
     def stop(self) -> None:

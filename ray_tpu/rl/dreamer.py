@@ -197,7 +197,9 @@ class Dreamer:
             obs_hat, reward_hat = heads.apply(
                 {"params": model_p["heads"]}, feat)
             recon = jnp.square(obs_hat - batch["obs"]).sum(-1).mean()
-            rew = jnp.square(reward_hat - batch["rewards"]).mean()
+            mask = batch["reward_mask"]
+            rew = (mask * jnp.square(reward_hat - batch["rewards"])
+                   ).sum() / jnp.maximum(mask.sum(), 1.0)
             kl = jnp.maximum(kl_div(qm, qs, pm, ps), free_nats).mean()
             loss = recon + rew + kl_scale * kl
             return loss, (feat, {"recon_loss": recon, "reward_loss": rew,
@@ -336,23 +338,39 @@ class Dreamer:
         from ray_tpu.rl.sample_batch import SampleBatch
         L = self.config.seq_len
         T = len(self._ep_rew)
-        if T < L:
+        if T + 1 < L:
             return
-        obs = np.stack(self._ep_obs)                     # [T, obs]
-        acts = np.stack(self._ep_act)                    # [T, A]
+        # include the post-step terminal observation so every reward —
+        # including the episode's last (the only one in sparse tasks) —
+        # has a feat to be predicted from: feat_t embeds a_{t-1}, so the
+        # reward head is trained on a_{t-1}'s reward, and r_{T-1} aligns
+        # at feat_T (built from the terminal obs)
+        obs = np.stack(self._ep_obs
+                       + [np.asarray(self._obs, np.float32)])  # [T+1, obs]
+        acts = np.stack(self._ep_act)                          # [T, A]
         prev = np.concatenate([np.zeros((1, self.act_dim), np.float32),
-                               acts[:-1]], 0)
-        # align rewards with prev_actions: feat_t embeds a_{t-1}, so the
-        # reward head must be trained on a_{t-1}'s reward — imagination
-        # reads head(state-after-action) as that action's reward
+                               acts], 0)                       # [T+1, A]
         rews = np.concatenate(
             [np.zeros(1, np.float32),
-             np.asarray(self._ep_rew[:-1], np.float32)])
-        rows = {"obs": [], "prev_actions": [], "rewards": []}
-        for start in range(0, T - L + 1, L):
+             np.asarray(self._ep_rew, np.float32)])            # [T+1]
+        # row 0 has no previous action: its zero reward is synthetic and
+        # must not train the reward head
+        mask = np.ones(T + 1, np.float32)
+        mask[0] = 0.0
+        rows = {"obs": [], "prev_actions": [], "rewards": [],
+                "reward_mask": []}
+        starts = list(range(0, T + 1 - L + 1, L))
+        # anchor a final (possibly overlapping) window at the episode end:
+        # without it the terminal obs and last reward — the point of the
+        # T+1 extension, and the only reward in sparse tasks — are dropped
+        # whenever T+1 isn't a multiple of L
+        if starts[-1] != T + 1 - L:
+            starts.append(T + 1 - L)
+        for start in starts:
             rows["obs"].append(obs[start:start + L])
             rows["prev_actions"].append(prev[start:start + L])
             rows["rewards"].append(rews[start:start + L])
+            rows["reward_mask"].append(mask[start:start + L])
         self.buffer.add(SampleBatch(
             {k: np.stack(v).astype(np.float32) for k, v in rows.items()}))
 

@@ -327,6 +327,11 @@ class CoreWorker:
         self._lineage_slot_freed_locked(oid)
 
     def _complete_frees(self, freed: List[Tuple[ObjectID, set]]) -> None:
+        if self._shutdown.is_set():
+            # the store mapping may already be closed: touching it from a
+            # late reply/error path would fault, and the raylet reclaims
+            # everything at session teardown anyway
+            return
         for foid, locations in freed:
             self._release_pins(foid)
             # release the primary copies: local shm directly, remote nodes
