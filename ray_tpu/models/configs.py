@@ -28,6 +28,9 @@ class TransformerConfig:
     param_dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"           # auto | xla | flash | ring | ulysses
     remat: bool = True                     # checkpoint each block (HBM <-> FLOPs)
+    remat_policy: str = "dots"             # "dots": save no-batch-dim dots
+    # (cheap recompute, more HBM); "nothing": full per-block recompute —
+    # the memory-lean setting that fits ~1B params on one 16 GiB chip
     scan_layers: bool = True               # lax.scan over layers
     tie_embeddings: bool = False
     z_loss: float = 1e-4
@@ -78,6 +81,17 @@ PRESETS = {
     # ~124M GPT-2 small shapes
     "gpt-small": TransformerConfig(vocab_size=50304, d_model=768, n_layers=12,
                                    n_heads=12, max_seq_len=1024),
+    # ~350M GPT-2 medium shapes (largest config whose fp32 AdamW states +
+    # remat activations fit one 16 GiB v5e chip with headroom)
+    "gpt-medium": TransformerConfig(vocab_size=50304, d_model=1024,
+                                    n_layers=24, n_heads=16,
+                                    max_seq_len=1024),
+    # GPT-2 large shapes — ~1.07B params with the SwiGLU MLP (needs the
+    # memory-lean path on a single 16 GiB chip: full remat + chunked CE +
+    # adafactor; comfortable under fsdp on 2+)
+    "gpt-large": TransformerConfig(vocab_size=50304, d_model=1280,
+                                   n_layers=36, n_heads=20,
+                                   max_seq_len=1024),
     # ~1.3B
     "gpt-xl": TransformerConfig(vocab_size=50304, d_model=2048, n_layers=24,
                                 n_heads=16, max_seq_len=2048),

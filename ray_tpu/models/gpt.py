@@ -83,9 +83,9 @@ def stack_layers(block_cls, cfg: TransformerConfig, ctor_kwargs, x,
     if remat is None:
         remat = cfg.remat
     if remat:
-        block_cls = nn.remat(
-            block_cls, prevent_cse=False,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        policy = (None if cfg.remat_policy == "nothing"
+                  else jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        block_cls = nn.remat(block_cls, prevent_cse=False, policy=policy)
     if cfg.scan_layers:
         variable_axes = {"params": 0, "intermediates": 0}
         if cache:

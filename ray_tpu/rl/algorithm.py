@@ -18,6 +18,56 @@ from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.rl.worker_set import WorkerSet
 
 
+_STATE_ATTRS = ("params", "target_params", "opt_state")
+_COUNTER_ATTRS = ("_steps_since_target_sync",)
+
+
+def full_training_state(algo) -> Optional[dict]:
+    """Host-side snapshot of an algorithm's COMPLETE training state — a
+    versioned envelope around either a ``self.state`` dict or separate
+    params/target_params/opt_state attributes, plus schedule counters.
+    One implementation shared by Algorithm subclasses and the standalone
+    offline learners so the checkpoint protocol can't drift per-algo."""
+    import jax
+    out: dict = {"_format": "v2"}
+    if getattr(algo, "state", None) is not None:
+        out["state"] = jax.tree.map(np.asarray, algo.state)
+    elif hasattr(algo, "params") and hasattr(algo, "opt_state"):
+        for attr in _STATE_ATTRS:
+            if hasattr(algo, attr):
+                out[attr] = jax.tree.map(np.asarray, getattr(algo, attr))
+    else:
+        return None
+    counters = {c: int(getattr(algo, c)) for c in _COUNTER_ATTRS
+                if hasattr(algo, c)}
+    if counters:
+        out["_counters"] = counters
+    return out
+
+
+def apply_full_training_state(algo, full: dict) -> None:
+    import jax
+    import jax.numpy as jnp
+    sharding = getattr(algo, "repl_sharding", None)
+    if sharding is not None:
+        # keep the replicated placement donated jitted updates expect
+        put = lambda t: jax.device_put(  # noqa: E731
+            jax.tree.map(jnp.asarray, t), sharding)
+    else:
+        put = lambda t: jax.tree.map(jnp.asarray, t)  # noqa: E731
+    if not (isinstance(full, dict) and full.get("_format") == "v2"):
+        # pre-envelope full-state checkpoint: the bare self.state tree
+        algo.state = put(full)
+        return
+    if "state" in full:
+        algo.state = put(full["state"])
+    for attr in _STATE_ATTRS:
+        if attr in full:
+            setattr(algo, attr, put(full[attr]))
+    for c, v in (full.get("_counters") or {}).items():
+        setattr(algo, c, v)
+
+
 class AlgorithmConfig:
     """Fluent builder: ``PPOConfig().environment("CartPole-v1")
     .rollouts(num_rollout_workers=2).training(lr=5e-5).build()``."""
@@ -235,29 +285,28 @@ class Algorithm:
 
     def get_full_state(self):
         """Complete training state for checkpointing — actor AND critics,
-        target networks, optimizer moments (reference semantics: a resumed
-        run continues training, it doesn't restart the critics from
-        scratch).  Defaults to host-mapping ``self.state`` when the
-        algorithm keeps one; weight-only algorithms return None and fall
-        back to get_weights."""
-        state = getattr(self, "state", None)
-        if state is None:
-            return None
-        import jax
-        return jax.tree.map(np.asarray, state)
+        target networks, optimizer moments, sync counters (reference
+        semantics: a resumed run continues training, it doesn't restart
+        the critics/Adam moments from scratch).  Covers both storage
+        conventions: a ``self.state`` dict, or separate
+        params/target_params/opt_state attributes (PPO/DQN style).
+        Returns None only for algorithms with neither (they fall back to
+        weights-only checkpoints)."""
+        return full_training_state(self)
+
+    # (helpers defined at module scope so the standalone offline
+    # algorithms — CQL/CRR/MADDPG — share the exact same protocol)
 
     def set_full_state(self, state) -> None:
-        import jax
-        import jax.numpy as jnp
-        self.state = jax.tree.map(jnp.asarray, state)
+        apply_full_training_state(self, state)
 
     def save(self) -> Checkpoint:
-        return Checkpoint.from_dict({
-            "weights": self.get_weights(),
-            "state": self.get_full_state(),
-            "iteration": self.iteration,
-            "timesteps_total": self._timesteps_total,
-        })
+        full = self.get_full_state()
+        d = {"state": full, "iteration": self.iteration,
+             "timesteps_total": self._timesteps_total}
+        if full is None:
+            d["weights"] = self.get_weights()
+        return Checkpoint.from_dict(d)
 
     def restore(self, checkpoint: Checkpoint) -> None:
         d = checkpoint.to_dict()

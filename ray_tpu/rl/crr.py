@@ -196,15 +196,17 @@ class CRR:
                 "timesteps_total": self._timesteps_total}
 
     def save(self) -> Checkpoint:
+        from ray_tpu.rl.algorithm import full_training_state
         return Checkpoint.from_dict({
-            "state": self._jax.tree.map(np.asarray, self.state),
-            "weights": self.get_weights(), "iteration": self.iteration})
+            "state": full_training_state(self),
+            "iteration": self.iteration})
 
     def restore(self, checkpoint: Checkpoint) -> None:
+        from ray_tpu.rl.algorithm import apply_full_training_state
         d = checkpoint.to_dict()
         if d.get("state") is not None:
             # full training state: actor + critics + targets + optimizers
-            self.state = self._jax.tree.map(self._jnp.asarray, d["state"])
+            apply_full_training_state(self, d["state"])
         else:  # legacy actor-only checkpoint
             self.set_weights(d["weights"])
         self.iteration = d.get("iteration", 0)

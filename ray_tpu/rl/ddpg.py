@@ -185,11 +185,6 @@ class DDPG(Algorithm):
         self.state["actor"] = jax.device_put(
             jax.tree.map(jnp.asarray, weights), self.repl_sharding)
 
-    def set_full_state(self, state) -> None:
-        # keep the replicated sharding the jitted update step expects
-        self.state = jax.device_put(
-            jax.tree.map(jnp.asarray, state), self.repl_sharding)
-
     def training_step(self) -> Dict[str, Any]:
         cfg: DDPGConfig = self.config
         batches = self.workers.foreach_worker("sample_transitions")

@@ -118,18 +118,6 @@ class DQN(Algorithm):
 
         self._td_step = td_step
 
-    def get_full_state(self):
-        return jax.tree.map(np.asarray, {
-            "params": self.params, "target_params": self.target_params,
-            "opt_state": self.opt_state})
-
-    def set_full_state(self, state) -> None:
-        put = lambda t: jax.device_put(  # noqa: E731
-            jax.tree.map(jnp.asarray, t), self.repl_sharding)
-        self.params = put(state["params"])
-        self.target_params = put(state["target_params"])
-        self.opt_state = put(state["opt_state"])
-
     def get_weights(self) -> Any:
         return jax.tree.map(np.asarray, self.params)
 

@@ -167,11 +167,6 @@ class SAC(Algorithm):
         self._update = jax.jit(update, donate_argnums=(0,))
         self._rng = jax.random.PRNGKey((cfg.seed or 0) + 17)
 
-    def set_full_state(self, state) -> None:
-        # keep the replicated sharding the donated jitted update expects
-        self.state = jax.device_put(
-            jax.tree.map(jnp.asarray, state), self.repl_sharding)
-
     def get_weights(self) -> Any:
         return jax.tree.map(np.asarray, self.state["actor"])
 

@@ -56,16 +56,41 @@ class OptimizerConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     accum_steps: int = 1
+    # "bfloat16" halves the first-moment buffer — the standard memory-lean
+    # setting for fitting bigger models per chip (second moment stays fp32)
+    mu_dtype: str = "float32"
+    # "adafactor" replaces AdamW's two full-size moments with factored
+    # row/col statistics (Shazeer & Stern) — the TPU-native memory-lean
+    # optimizer (T5 heritage) that fits ~1B params on a 16 GiB chip
+    optimizer: str = "adamw"
 
     def make(self) -> optax.GradientTransformation:
         schedule = optax.warmup_cosine_decay_schedule(
             0.0, self.learning_rate, self.warmup_steps,
             max(self.decay_steps, self.warmup_steps + 1),
             self.learning_rate * self.min_lr_ratio)
+        if self.optimizer == "adafactor":
+            # optax applies adafactor's weight_decay_rate as a RAW per-step
+            # multiplicative decay (not lr-scaled, unlike adamw's decoupled
+            # decay): passing 0.1 would shrink kernels by 10% per step and
+            # collapse the model.  Approximate decoupled decay with
+            # lr * weight_decay, the AdamW-equivalent magnitude at peak lr.
+            decay = (self.weight_decay * self.learning_rate
+                     if self.weight_decay else None)
+            tx = optax.chain(
+                optax.clip_by_global_norm(self.grad_clip),
+                optax.adafactor(schedule, min_dim_size_to_factor=128,
+                                weight_decay_rate=decay,
+                                weight_decay_mask=_decay_mask),
+            )
+            if self.accum_steps > 1:
+                tx = optax.MultiSteps(tx, self.accum_steps)
+            return tx
         tx = optax.chain(
             optax.clip_by_global_norm(self.grad_clip),
             optax.adamw(schedule, b1=self.b1, b2=self.b2,
                         weight_decay=self.weight_decay,
+                        mu_dtype=self.mu_dtype,
                         mask=_decay_mask),
         )
         if self.accum_steps > 1:
@@ -158,6 +183,19 @@ def _born_sharded(build_state, step, example_batch, mesh: Mesh,
                               example_batch)
     logical = nn.get_partition_spec(abstract)
     state_shardings = tree_mesh_shardings(logical, mesh, rules)
+
+    # optimizer states that don't mirror the param's shape (adafactor's
+    # factored v_row/v_col, scalar counters) still inherit the param's
+    # logical spec from the boxed metadata; a spec longer than the leaf's
+    # rank is invalid — replicate those
+    def _fit_rank(sh, leaf):
+        ndim = getattr(leaf, "ndim", None)
+        if ndim is not None and hasattr(sh, "spec") and len(sh.spec) > ndim:
+            return NamedSharding(mesh, PartitionSpec())
+        return sh
+
+    state_shardings = jax.tree.map(_fit_rank, state_shardings,
+                                   nn.meta.unbox(abstract))
     batch_sharding = jax.tree.map(
         lambda _: NamedSharding(mesh, logical_spec(batch_axes, mesh, rules)),
         example_batch)
