@@ -19,11 +19,11 @@ class ActorPool:
 
     def __init__(self, actors: List[Any]):
         self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List[tuple] = []
+        self._inflight_owner = {}
+        self._submit_order_refs = {}
+        self._submit_counter = 0
+        self._deliver_counter = 0
+        self._backlog: List[tuple] = []
 
     # ------------------------------------------------------------- mapping
     def map(self, fn: Callable[[Any, Any], Any],
@@ -45,25 +45,25 @@ class ActorPool:
 
     # ---------------------------------------------------------- scheduling
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
-        if not self._idle and not self._future_to_actor \
-                and not self._pending_submits:
+        if not self._idle and not self._inflight_owner \
+                and not self._backlog:
             raise ValueError("cannot submit to an ActorPool with no actors")
         if self._idle:
             actor = self._idle.pop()
             future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
+            self._inflight_owner[future] = (self._submit_counter, actor)
+            self._submit_order_refs[self._submit_counter] = future
+            self._submit_counter += 1
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor) or bool(self._pending_submits)
+        return bool(self._inflight_owner) or bool(self._backlog)
 
     def _return_actor(self, actor) -> None:
         self._idle.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.pop(0))
+        if self._backlog:
+            self.submit(*self._backlog.pop(0))
 
     def get_next(self, timeout: float = None) -> Any:
         """Next result in submission order (skipping results already taken
@@ -72,33 +72,33 @@ class ActorPool:
             raise StopIteration("no pending results")
         # indices assigned at submit time but absent from the map were
         # consumed by get_next_unordered: skip them
-        while self._next_return_index < self._next_task_index and \
-                self._next_return_index not in self._index_to_future:
-            self._next_return_index += 1
-        future = self._index_to_future.get(self._next_return_index)
+        while self._deliver_counter < self._submit_counter and \
+                self._deliver_counter not in self._submit_order_refs:
+            self._deliver_counter += 1
+        future = self._submit_order_refs.get(self._deliver_counter)
         if future is None:
             # every indexed task was consumed; anything left is parked,
             # which with a non-empty pool implies in-flight futures exist —
             # so this means has_next() lied (defensive)
             raise StopIteration("no pending results")
         value = ray_tpu.get(future, timeout=timeout)
-        del self._index_to_future[self._next_return_index]
-        self._next_return_index += 1
-        _, actor = self._future_to_actor.pop(future)
+        del self._submit_order_refs[self._deliver_counter]
+        self._deliver_counter += 1
+        _, actor = self._inflight_owner.pop(future)
         self._return_actor(actor)
         return value
 
     def get_next_unordered(self, timeout: float = None) -> Any:
         """Any completed result (completion order)."""
-        if not self._future_to_actor:
+        if not self._inflight_owner:
             raise StopIteration("no pending results")
-        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+        ready, _ = ray_tpu.wait(list(self._inflight_owner),
                                 num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("no result within timeout")
         future = ready[0]
-        i, actor = self._future_to_actor.pop(future)
-        del self._index_to_future[i]
+        i, actor = self._inflight_owner.pop(future)
+        del self._submit_order_refs[i]
         self._return_actor(actor)
         return ray_tpu.get(future)
 
