@@ -58,6 +58,10 @@ _declare("object_store_fallback_dir", str, "",
          "dir inside the session dir (removed at raylet shutdown).")
 _declare("object_spill_threshold", float, 0.8,
          "Fraction of store capacity above which primary copies spill to disk.")
+_declare("object_spill_fault", str, "",
+         "Fault-injection seam for spill IO: 'unstable' fails every other "
+         "spill write, 'slow' adds latency per spill (reference unstable/"
+         "slow external-storage fakes, external_storage.py:587/608).")
 _declare("object_transfer_chunk_bytes", int, 8 * 1024 * 1024,
          "Inter-node object pushes move in chunks of this size (bounds "
          "per-message memory; cf. reference object_manager chunked Push).")
@@ -103,6 +107,10 @@ _declare("free_objects_period_ms", int, 100,
          "Batching period for releasing store objects whose refcount hit zero.")
 _declare("pull_chunk_bytes", int, 4 * 1024**2,
          "Chunk size for inter-node object transfer.")
+_declare("pull_memory_cap_bytes", int, 512 * 1024**2,
+         "Admission cap on the total bytes of concurrently in-flight remote "
+         "object pulls per process (reference PullManager's bounded pull "
+         "quota, pull_manager.h:52); pulls beyond it queue FIFO.")
 _declare("log_to_driver", bool, True, "Forward worker stdout/stderr to the driver.")
 _declare("event_stats", bool, False, "Record per-handler event-loop stats.")
 _declare("task_events_buffer_size", int, 10000,

@@ -28,6 +28,8 @@ class RemoteFunction:
         self._max_retries = max_retries
         self._scheduling_strategy = scheduling_strategy
         self._runtime_env = runtime_env
+        # every .options(...) key as given, carried into .bind() nodes
+        self._bound_options: Dict[str, Any] = {}
         functools.update_wrapper(self, func)
 
     def __call__(self, *args, **kwargs):
@@ -65,9 +67,13 @@ class RemoteFunction:
         return refs
 
     def bind(self, *args, **kwargs):
-        """Lazy DAG authoring (cf. reference dag/function_node.py)."""
+        """Lazy DAG authoring (cf. reference dag/function_node.py).  The
+        accumulated .options(...) ride along so DAG consumers (Serve,
+        Workflow) see them — including extension keys like the Workflow
+        step options ("_workflow") that plain .remote() ignores."""
         from ray_tpu.dag import FunctionNode
-        return FunctionNode(self, args, kwargs)
+        return FunctionNode(self, args, kwargs,
+                            options=dict(self._bound_options))
 
     def options(self, **opts) -> "RemoteFunction":
         new = RemoteFunction(
@@ -82,4 +88,5 @@ class RemoteFunction:
             scheduling_strategy=opts.get("scheduling_strategy",
                                          self._scheduling_strategy),
             runtime_env=opts.get("runtime_env", self._runtime_env))
+        new._bound_options = dict(self._bound_options, **opts)
         return new

@@ -159,6 +159,51 @@ class _OwnedObject:
         self.dynamic_children: Optional[list] = None
 
 
+class _PullBudget:
+    """Admission control over concurrently buffered pull bytes (reference
+    PullManager's bounded quota, pull_manager.h:52): N parallel gets of
+    large objects queue here instead of overcommitting process memory.
+    An object larger than the whole cap is admitted alone (capped at the
+    full budget) so it can never deadlock."""
+
+    def __init__(self, cap: int):
+        self.cap = max(1, cap)
+        self.used = 0
+        self.cv = threading.Condition()
+        self._waiters: deque = deque()  # FIFO tickets
+
+    def acquire(self, n: int, deadline: Optional[float]) -> bool:
+        n = min(n, self.cap)
+        ticket = object()
+        with self.cv:
+            self._waiters.append(ticket)
+            try:
+                while True:
+                    # strict FIFO: only the head ticket may admit — a big
+                    # pull can't be starved by a stream of smaller ones
+                    # slipping past it whenever they happen to fit
+                    if self._waiters[0] is ticket and \
+                            (self.used + n <= self.cap or self.used == 0):
+                        self.used += n
+                        return True
+                    t = None if deadline is None \
+                        else max(0.0, deadline - time.monotonic())
+                    if t is not None and t <= 0:
+                        return False
+                    if not self.cv.wait(timeout=t if t is not None
+                                        else 5.0) and deadline is not None:
+                        return False
+            finally:
+                self._waiters.remove(ticket)
+                self.cv.notify_all()
+
+    def release(self, n: int) -> None:
+        n = min(n, self.cap)
+        with self.cv:
+            self.used = max(0, self.used - n)
+            self.cv.notify_all()
+
+
 class _Lease:
     __slots__ = ("lease_id", "worker_id", "address", "conn", "key",
                  "granting_addr")
@@ -200,6 +245,7 @@ class CoreWorker:
         self._arg_refs: Dict[bytes, list] = {}
         self._owner_conns: Dict[Tuple[str, int], rpc.Connection] = {}
         self._owner_conns_lock = threading.Lock()
+        self._pull_budget = _PullBudget(CONFIG.pull_memory_cap_bytes)
 
         self.store = SharedMemoryStore.attach(store_path)
         self._server = rpc.Server(self._handle_rpc, host=host)
@@ -688,23 +734,32 @@ class CoreWorker:
                 total = first["total"]
                 if total <= chunk:
                     return "ok", first["data"]
-                out = bytearray(total)
-                out[:len(first["data"])] = first["data"]
-                off = len(first["data"])
-                while off < total:
-                    if deadline is not None and \
-                            time.monotonic() >= deadline:
-                        return "error", None  # honor get(timeout=)
-                    res = conn.call("fetch_object_chunk",
-                                    {"object_id": oid.binary(),
-                                     "offset": off, "length": chunk,
-                                     "timeout": 0.0},
-                                    timeout=CONFIG.raylet_rpc_timeout_s)
-                    if res is None or not res["data"]:
-                        return "absent", None  # evicted mid-transfer
-                    out[off:off + len(res["data"])] = res["data"]
-                    off += len(res["data"])
-                return "ok", bytes(out)
+                # admission: multi-chunk pulls reserve their full buffer
+                # from the process-wide quota before allocating, so N
+                # concurrent gets of large objects queue instead of
+                # overcommitting memory
+                if not self._pull_budget.acquire(total, deadline):
+                    return "error", None  # quota wait timed out: transient
+                try:
+                    out = bytearray(total)
+                    out[:len(first["data"])] = first["data"]
+                    off = len(first["data"])
+                    while off < total:
+                        if deadline is not None and \
+                                time.monotonic() >= deadline:
+                            return "error", None  # honor get(timeout=)
+                        res = conn.call("fetch_object_chunk",
+                                        {"object_id": oid.binary(),
+                                         "offset": off, "length": chunk,
+                                         "timeout": 0.0},
+                                        timeout=CONFIG.raylet_rpc_timeout_s)
+                        if res is None or not res["data"]:
+                            return "absent", None  # evicted mid-transfer
+                        out[off:off + len(res["data"])] = res["data"]
+                        off += len(res["data"])
+                    return "ok", bytes(out)
+                finally:
+                    self._pull_budget.release(total)
             finally:
                 conn.close()
         except (ConnectionError, rpc.RemoteError, TimeoutError, OSError):
@@ -924,6 +979,12 @@ class CoreWorker:
             "owner_addr": list(self.address),
             "name": name or getattr(func, "__name__", "task"),
         }
+        trace_ctx = _current_trace_context()
+        if trace_ctx:
+            # auto span injection (reference _inject_tracing_into_function,
+            # tracing_helper.py:324): the submitting span's context rides
+            # the spec so worker-side events/spans join the same trace
+            spec["trace_ctx"] = trace_ctx
         return_refs = []
         n_slots = num_return_slots(num_returns)
         spec_blob = cloudpickle.dumps(
@@ -1526,6 +1587,9 @@ class CoreWorker:
         }
         if concurrency_group:
             spec["group"] = concurrency_group
+        trace_ctx = _current_trace_context()
+        if trace_ctx:
+            spec["trace_ctx"] = trace_ctx
         refs = []
         with self._owned_lock:
             for i in range(num_returns):
@@ -1778,6 +1842,11 @@ class _ActorPipe:
                 conn.close()
             except Exception:
                 pass
+
+
+def _current_trace_context() -> dict:
+    from ray_tpu.util.tracing.tracing_helper import get_trace_context
+    return get_trace_context()
 
 
 def _maybe_big(value: Any) -> bool:

@@ -347,6 +347,16 @@ class Raylet:
         buf, meta = res
         path = self._spill_path(oid)
         try:
+            fault = CONFIG.object_spill_fault
+            if fault == "slow":
+                time.sleep(0.5)
+            elif fault == "unstable":
+                self._spill_fault_tick = \
+                    getattr(self, "_spill_fault_tick", 0) + 1
+                if self._spill_fault_tick % 2 == 1:
+                    logger.warning("spill fault injection: dropping write "
+                                   "of %s", oid.hex()[:12])
+                    return False  # retried by the next scan
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(buf)

@@ -173,10 +173,18 @@ class WorkerProcess:
         return tuple(resolved), rkw, borrowed
 
     def _execute(self, spec) -> dict:
+        from ray_tpu.util.tracing.tracing_helper import \
+            propagate_trace_context
         fn = self.core.load_function(spec["fn_key"])
         self.core.current_task_id = TaskID(spec["task_id"])
+        trace_ctx = spec.get("trace_ctx")
         self.core.events.record(TaskID(spec["task_id"]).hex(), "RUNNING",
-                                name=spec.get("name", ""))
+                                name=spec.get("name", ""),
+                                **({"trace_id": trace_ctx["trace_id"]}
+                                   if trace_ctx else {}))
+        # join the submitter's trace: user spans inside the task nest
+        # under the caller's span (auto span injection)
+        propagate_trace_context(trace_ctx)
         borrowed = []
         try:
             args, kwargs, borrowed = self._resolve_args(spec["args"])
@@ -185,6 +193,7 @@ class WorkerProcess:
         except Exception as e:  # noqa: BLE001 - user errors cross the wire
             return self._package_error(spec, e)
         finally:
+            propagate_trace_context(None)
             self.core.release_borrowed(borrowed)
 
     def _package_error(self, spec, e: BaseException) -> dict:
@@ -405,15 +414,21 @@ class WorkerProcess:
 
     def _begin_actor_call(self, spec):
         """Shared prologue of sync/async actor execution: liveness guard
-        plus task bookkeeping.  Returns an error reply to short-circuit
-        with, or None to proceed."""
+        plus task bookkeeping (incl. joining the caller's trace).  Returns
+        an error reply to short-circuit with, or None to proceed."""
+        from ray_tpu.util.tracing.tracing_helper import \
+            propagate_trace_context
         if self.actor_instance is None:
             return self._package_error(
                 spec, exc.ActorDiedError("actor not initialized"))
         self.core.current_task_id = TaskID(spec["task_id"])
+        trace_ctx = spec.get("trace_ctx")
         self.core.events.record(TaskID(spec["task_id"]).hex(), "RUNNING",
                                 name=spec.get("method", ""),
-                                actor_id=spec.get("actor_id", ""))
+                                actor_id=spec.get("actor_id", ""),
+                                **({"trace_id": trace_ctx["trace_id"]}
+                                   if trace_ctx else {}))
+        propagate_trace_context(trace_ctx)
         return None
 
     async def _execute_actor_async(self, spec) -> dict:
@@ -425,6 +440,8 @@ class WorkerProcess:
         import asyncio
         import functools
 
+        from ray_tpu.util.tracing.tracing_helper import \
+            propagate_trace_context
         err = self._begin_actor_call(spec)
         if err is not None:
             return err
@@ -447,9 +464,12 @@ class WorkerProcess:
         except Exception as e:  # noqa: BLE001
             return self._package_error(spec, e)
         finally:
+            propagate_trace_context(None)
             self.core.release_borrowed(borrowed)
 
     def _execute_actor(self, spec) -> dict:
+        from ray_tpu.util.tracing.tracing_helper import \
+            propagate_trace_context
         err = self._begin_actor_call(spec)
         if err is not None:
             return err
@@ -465,6 +485,7 @@ class WorkerProcess:
         except Exception as e:  # noqa: BLE001
             return self._package_error(spec, e)
         finally:
+            propagate_trace_context(None)
             self.core.release_borrowed(borrowed)
 
 

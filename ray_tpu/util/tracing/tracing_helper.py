@@ -13,7 +13,7 @@ span as well.
 from __future__ import annotations
 
 import contextlib
-import threading
+import contextvars
 import time
 import uuid
 from typing import Dict, Iterator, Optional
@@ -25,18 +25,22 @@ except ImportError:
     _otel_trace = None
     _tracer = None
 
-_local = threading.local()
+# a ContextVar, not threading.local: async-actor calls interleave on one
+# event-loop thread, and each asyncio Task must keep its own trace context
+# (a thread-local would let concurrent calls clobber each other's ids)
+_ctx_var: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None)
 
 
 def get_trace_context() -> Dict[str, str]:
     """Current trace/span ids, for propagation into submitted tasks."""
-    ctx = getattr(_local, "ctx", None)
+    ctx = _ctx_var.get()
     return dict(ctx) if ctx else {}
 
 
 def propagate_trace_context(ctx: Optional[Dict[str, str]]) -> None:
     """Install a parent context received with a task."""
-    _local.ctx = dict(ctx) if ctx else None
+    _ctx_var.set(dict(ctx) if ctx else None)
 
 
 @contextlib.contextmanager
@@ -45,7 +49,7 @@ def span(name: str, attributes: Optional[Dict] = None) -> Iterator[None]:
     parent = get_trace_context()
     trace_id = parent.get("trace_id") or uuid.uuid4().hex
     span_id = uuid.uuid4().hex[:16]
-    _local.ctx = {"trace_id": trace_id, "span_id": span_id}
+    _ctx_var.set({"trace_id": trace_id, "span_id": span_id})
     start = time.time()
     otel_cm = _tracer.start_as_current_span(name) if _tracer else None
     if otel_cm:
@@ -62,7 +66,7 @@ def span(name: str, attributes: Optional[Dict] = None) -> Iterator[None]:
     finally:
         if otel_cm:
             otel_cm.__exit__(*exc_info)
-        _local.ctx = parent or None
+        _ctx_var.set(parent or None)
         end = time.time()
         from ray_tpu.runtime import core_worker as cw
         worker = cw._global_worker

@@ -7,9 +7,14 @@ persisted to workflow storage before dependents run, so a crashed or
 cancelled workflow resumes from its last completed step.
 """
 
-from ray_tpu.workflow.api import (cancel, delete, get_output, get_status,
-                                  init, list_all, resume, run, run_async)
+from ray_tpu.workflow.api import (EventListener, cancel, delete,
+                                  get_output, get_status,
+                                  get_virtual_actor, init, list_all,
+                                  options, resume, run, run_async,
+                                  virtual_actor, wait_for_event)
 from ray_tpu.workflow.storage import WorkflowStorage
 
 __all__ = ["init", "run", "run_async", "resume", "get_output", "get_status",
-           "list_all", "cancel", "delete", "WorkflowStorage"]
+           "list_all", "cancel", "delete", "WorkflowStorage", "options",
+           "EventListener", "wait_for_event", "virtual_actor",
+           "get_virtual_actor"]

@@ -121,6 +121,140 @@ def list_all() -> List[Tuple[str, str]]:
             for wid in storage.list_workflows()]
 
 
+def options(*, max_retries: int = 0, retry_backoff_s: float = 0.2,
+            catch_exceptions: bool = False) -> dict:
+    """Step-level durability options, merged into a bound node's options:
+    ``fn.options(**workflow.options(max_retries=3)).bind(...)``
+    (cf. reference workflow.options / step max_retries+catch_exceptions).
+    Retries re-run the step task with exponential backoff;
+    ``catch_exceptions`` turns the step's value into ``(result, None)`` /
+    ``(None, exception)`` instead of failing the workflow."""
+    return {"_workflow": {"max_retries": int(max_retries),
+                          "retry_backoff_s": float(retry_backoff_s),
+                          "catch_exceptions": bool(catch_exceptions)}}
+
+
+class EventListener:
+    """Poll-based event source (cf. reference workflow.event listeners,
+    python/ray/workflow/event_listener.py — asyncio there, polling here).
+    Subclass and implement ``poll_for_event() -> Optional[Any]``: return
+    None while the event hasn't happened, the payload once it has.  The
+    payload checkpoints like any step result, so a resumed workflow sees
+    the event exactly once and never re-waits."""
+
+    def poll_for_event(self):
+        raise NotImplementedError
+
+
+def _wait_for_event_step(packed):
+    import time as _time
+
+    import cloudpickle as _cp
+    cls, a, interval, timeout = _cp.loads(packed)
+    listener = cls(*a)
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    while True:
+        event = listener.poll_for_event()
+        if event is not None:
+            return event
+        if deadline is not None and _time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"no event from {cls.__name__} within {timeout}s")
+        _time.sleep(interval)
+
+
+# module-level remote fns (one function export total, not one per call)
+_wait_for_event_remote = ray_tpu.remote(_wait_for_event_step)
+
+
+def wait_for_event(listener_cls, *args, poll_interval_s: float = 0.5,
+                   timeout_s: Optional[float] = None) -> DAGNode:
+    """A DAG node that completes when the listener observes its event.
+
+    Runs as a normal (durable) workflow step: a remote task instantiates
+    ``listener_cls(*args)`` and polls until the event arrives (or
+    ``timeout_s`` expires -> TimeoutError fails the step)."""
+    blob = cloudpickle.dumps((listener_cls, args, poll_interval_s,
+                              timeout_s))
+    return _wait_for_event_remote.bind(blob)
+
+
+# ------------------------------------------------------------ virtual actors
+def _virtual_actor_step(packed):
+    import cloudpickle as _cp
+    cls, state, meth, a, kw = _cp.loads(packed)
+    instance = cls.__new__(cls)
+    instance.__dict__.update(_cp.loads(state))
+    result = getattr(instance, meth)(*a, **kw)
+    return _cp.dumps(instance.__dict__), result
+
+
+_virtual_actor_remote = ray_tpu.remote(_virtual_actor_step)
+
+
+class VirtualActorMethod:
+    def __init__(self, handle: "VirtualActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def run(self, *args, **kwargs) -> Any:
+        return self._handle._invoke(self._name, args, kwargs)
+
+
+class VirtualActorHandle:
+    """Durable actor: state lives in workflow storage, each method call is
+    a step that loads state -> executes in a remote task -> checkpoints the
+    new state before returning (cf. reference experimental workflow virtual
+    actors).  Single-writer per actor id; state must be cloudpicklable."""
+
+    def __init__(self, cls, actor_id: str):
+        self._cls = cls
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> VirtualActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return VirtualActorMethod(self, name)
+
+    def _invoke(self, method: str, args: tuple, kwargs: dict) -> Any:
+        storage = _get_storage()
+        state_bytes = storage.load_actor_state(self._actor_id)
+        blob = cloudpickle.dumps((self._cls, state_bytes, method, args,
+                                  kwargs))
+        new_state, result = ray_tpu.get(_virtual_actor_remote.remote(blob))
+        storage.save_actor_state(self._actor_id, new_state)
+        return result
+
+
+class VirtualActorClass:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def get_or_create(self, actor_id: str, *args, **kwargs
+                      ) -> VirtualActorHandle:
+        storage = _get_storage()
+        if not storage.actor_exists(actor_id):
+            instance = self._cls(*args, **kwargs)
+            storage.save_actor_state(
+                actor_id, cloudpickle.dumps(instance.__dict__))
+        return VirtualActorHandle(self._cls, actor_id)
+
+
+def virtual_actor(cls) -> VirtualActorClass:
+    """``@workflow.virtual_actor`` — durable-state actor decorator."""
+    return VirtualActorClass(cls)
+
+
+def get_virtual_actor(cls_or_vac, actor_id: str) -> VirtualActorHandle:
+    """Handle to an existing virtual actor (raises if it doesn't exist)."""
+    storage = _get_storage()
+    if not storage.actor_exists(actor_id):
+        raise ValueError(f"no virtual actor {actor_id!r}")
+    cls = cls_or_vac._cls if isinstance(cls_or_vac, VirtualActorClass) \
+        else cls_or_vac
+    return VirtualActorHandle(cls, actor_id)
+
+
 def cancel(workflow_id: str) -> None:
     """Flag a workflow canceled; the executor checks before each step and
     stops with WorkflowCancellationError (already-submitted step tasks run

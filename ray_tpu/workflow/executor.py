@@ -37,6 +37,33 @@ def _step_ids(dag: DAGNode) -> Dict[str, str]:
     return ids
 
 
+def _run_step_with_retries(storage, workflow_id, step_id, fn, args, kwargs,
+                           wf_opts: Dict[str, Any]) -> Any:
+    """One durable step attempt loop: re-submit up to max_retries times
+    with exponential backoff; with catch_exceptions the step resolves to
+    ``(result, None)`` / ``(None, exception)`` instead of failing."""
+    import time
+
+    max_retries = int(wf_opts.get("max_retries", 0))
+    backoff = float(wf_opts.get("retry_backoff_s", 0.2))
+    catch = bool(wf_opts.get("catch_exceptions", False))
+    attempt = 0
+    while True:
+        try:
+            value = ray_tpu.get(fn.remote(*args, **kwargs))
+            return (value, None) if catch else value
+        except Exception as e:  # noqa: BLE001 - user step errors
+            # negative max_retries means retry forever (reference
+            # convention for infinite step retries)
+            if 0 <= max_retries <= attempt:
+                if catch:
+                    return None, e
+                storage.save_step_exception(workflow_id, step_id, e)
+                raise
+            time.sleep(min(backoff * (2 ** min(attempt, 16)), 30.0))
+            attempt += 1
+
+
 def execute_workflow(storage: st.WorkflowStorage, workflow_id: str,
                      dag: DAGNode, input_value: Any = None) -> Any:
     """Run the DAG durably; returns the final result value.
@@ -80,15 +107,15 @@ def execute_workflow(storage: st.WorkflowStorage, workflow_id: str,
             value = storage.load_step_result(workflow_id, step_id)
         elif isinstance(node, FunctionNode):
             args, kwargs = _resolve(node)
+            opts = dict(node._options or {})
+            # step durability options (workflow.options(...)): retries
+            # with backoff + catch_exceptions (reference step options)
+            wf_opts = opts.pop("_workflow", {})
             fn = node._remote_function
-            if node._options:
-                fn = fn.options(**node._options)
-            ref = fn.remote(*args, **kwargs)
-            try:
-                value = ray_tpu.get(ref)
-            except Exception as e:
-                storage.save_step_exception(workflow_id, step_id, e)
-                raise
+            if opts:
+                fn = fn.options(**opts)
+            value = _run_step_with_retries(
+                storage, workflow_id, step_id, fn, args, kwargs, wf_opts)
             storage.save_step_result(workflow_id, step_id, value)
         else:
             raise TypeError(f"cannot execute {type(node).__name__}")

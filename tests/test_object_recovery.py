@@ -204,3 +204,18 @@ def test_task_output_spills_under_pressure():
     for i, v in enumerate(values):
         assert float(v[0]) == float(i)
     ray_tpu.shutdown()
+
+
+def test_spill_survives_unstable_storage():
+    """The unstable-storage fault seam drops every other spill write; the
+    spill loop retries and the working set still round-trips (reference
+    unstable external-storage fake semantics)."""
+    ray_tpu.init(num_cpus=2, object_store_memory=48 * 1024 * 1024,
+                 system_config={"object_spill_fault": "unstable"})
+    refs = [ray_tpu.put(np.full(1024 * 1024, i, dtype=np.float64))
+            for i in range(12)]
+    for i, ref in enumerate(refs):
+        v = ray_tpu.get(ref, timeout=120)
+        assert float(v[0]) == float(i)
+        del v
+    ray_tpu.shutdown()

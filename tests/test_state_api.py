@@ -112,3 +112,34 @@ def test_internal_kv(ray_start_regular):
     assert kv._internal_kv_del("k1")
     assert not kv._internal_kv_exists("k1")
     assert kv._internal_kv_get("k1") is None
+
+
+def test_trace_context_propagates_into_tasks(ray_start_regular):
+    """Auto span injection: a task submitted inside a driver span joins
+    the driver's trace (reference _inject_tracing_into_function)."""
+    import ray_tpu
+    from ray_tpu.util.tracing.tracing_helper import (get_trace_context,
+                                                     span)
+
+    @ray_tpu.remote
+    def inner_trace():
+        from ray_tpu.util.tracing.tracing_helper import get_trace_context
+        return get_trace_context().get("trace_id")
+
+    with span("driver-section"):
+        driver_trace = get_trace_context()["trace_id"]
+        task_trace = ray_tpu.get(inner_trace.remote(), timeout=60)
+    assert task_trace == driver_trace
+
+    @ray_tpu.remote
+    class A:
+        def trace(self):
+            from ray_tpu.util.tracing.tracing_helper import \
+                get_trace_context
+            return get_trace_context().get("trace_id")
+
+    a = A.remote()
+    with span("actor-section"):
+        driver_trace = get_trace_context()["trace_id"]
+        actor_trace = ray_tpu.get(a.trace.remote(), timeout=60)
+    assert actor_trace == driver_trace
