@@ -34,6 +34,8 @@ from ray_tpu.rl.offline import (BC, BCConfig, MARWIL,  # noqa: F401
 from ray_tpu.rl.maddpg import (MADDPG, CooperativeNav,  # noqa: F401
                                MADDPGConfig)
 from ray_tpu.rl.maml import MAML, MAMLConfig, SinusoidTasks  # noqa: F401
+from ray_tpu.rl.alpha_star import AlphaStar, AlphaStarConfig  # noqa: F401
+from ray_tpu.rl.mbmpo import MBMPO, MBMPOConfig  # noqa: F401
 from ray_tpu.rl.multi_agent import (MultiAgentCartPole,  # noqa: F401
                                     MultiAgentEnv, MultiAgentPPO,
                                     MultiAgentPPOConfig,
@@ -71,6 +73,8 @@ __all__ = [
     "AlphaZero", "AlphaZeroConfig", "MCTS", "TicTacToe",
     "MADDPG", "MADDPGConfig", "CooperativeNav",
     "MAML", "MAMLConfig", "SinusoidTasks",
+    "MBMPO", "MBMPOConfig",
+    "AlphaStar", "AlphaStarConfig",
     "SlateQ", "SlateQConfig", "InterestEvolutionEnv",
     "Dreamer", "DreamerConfig",
     "R2D2", "R2D2Config", "R2D2Policy", "QMix", "QMixConfig",

@@ -1,0 +1,407 @@
+"""AlphaStar-style league training: populations + prioritized fictitious
+self-play.
+
+Analog of /root/reference/rllib/algorithms/alpha_star (Vinyals et al.
+2019's league, scoped to the repo's board env): a population of learners —
+**main agents** (the product), **main exploiters** (attack the current
+mains), and **league exploiters** (attack the whole league) — trains by
+playing matchups drawn with prioritized fictitious self-play (PFSP):
+opponents are sampled by a weighting of the historical win-rate, so
+learners spend their games where they are weakest. Learners are
+periodically frozen into the league as past players (exploiters reset
+after snapshotting, per the paper), and a payoff matrix of running
+win-rates drives both matchmaking and snapshot gating.
+
+TPU shape: one jitted masked-softmax policy-gradient update shared by all
+learners (REINFORCE + value baseline + entropy); games are cheap CPU
+board rollouts, the league bookkeeping is plain Python.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rl.algorithm import AlgorithmConfig
+from ray_tpu.rl.alpha_zero import TicTacToe
+
+
+class AlphaStarConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = AlphaStar
+        self.num_main_agents = 1
+        self.num_main_exploiters = 1
+        self.num_league_exploiters = 1
+        self.games_per_iter = 64        # per learner, per iteration
+        self.snapshot_interval = 5      # iterations between league freezes
+        self.pfsp_weighting = "variance"  # p(1-p); or "hard": (1-p)^2
+        self.lr = 3e-3
+        self.entropy_coef = 0.01
+        self.value_coef = 0.5
+        self.hidden = (64, 64)
+        self.self_play_prob = 0.5       # mains: self-play vs PFSP split
+
+    def environment(self, env=None, **kwargs):
+        return super().environment(env or TicTacToe, **kwargs)
+
+
+class AlphaStar:
+    def __init__(self, config: AlphaStarConfig):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        cfg = config
+        if cfg.env_spec not in (None, TicTacToe):
+            # the lockstep board mechanics are TicTacToe-specific; fail
+            # loudly rather than silently training on the wrong game
+            raise ValueError(
+                "AlphaStar league play currently supports only the "
+                f"TicTacToe board env, got {cfg.env_spec!r}")
+        env = TicTacToe()
+        obs_dim = int(np.prod(env.obs_shape))
+        n_actions = env.n_actions
+        self._obs_dim = obs_dim
+
+        class PVNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                for h in cfg.hidden:
+                    x = nn.relu(nn.Dense(h)(x))
+                return nn.Dense(n_actions)(x), nn.Dense(1)(x)[..., 0]
+
+        self.net = PVNet()
+        self.tx = optax.adam(cfg.lr)
+        rng = jax.random.PRNGKey(cfg.seed or 0)
+
+        def init_params(key):
+            return self.net.init(key, jnp.zeros((1, obs_dim)))["params"]
+
+        # learners: name -> {"params", "opt"}; league: name -> params
+        self.learners: Dict[str, Dict[str, Any]] = {}
+        names = ([f"main_{i}" for i in range(cfg.num_main_agents)]
+                 + [f"main_exploiter_{i}"
+                    for i in range(cfg.num_main_exploiters)]
+                 + [f"league_exploiter_{i}"
+                    for i in range(cfg.num_league_exploiters)])
+        keys = jax.random.split(rng, len(names) + 1)
+        for name, key in zip(names, keys[:-1]):
+            params = init_params(key)
+            self.learners[name] = {"params": params,
+                                   "opt": self.tx.init(params)}
+        self._init_key = keys[-1]
+        self.league: Dict[str, Any] = {}      # frozen past players
+        # payoff[(a, b)] = (wins_a, games) running counts, a vs b
+        self.payoff: Dict[Tuple[str, str], Tuple[float, int]] = {}
+
+        def pg_update(params, opt, obs, mask, actions, returns):
+            def loss_fn(p):
+                logits, values = self.net.apply({"params": p}, obs)
+                logits = jnp.where(mask > 0, logits, -1e9)
+                logp = jax.nn.log_softmax(logits)
+                lp_a = jnp.take_along_axis(
+                    logp, actions[:, None], axis=1)[:, 0]
+                adv = returns - jax.lax.stop_gradient(values)
+                pg = -(lp_a * adv).mean()
+                v_loss = jnp.square(values - returns).mean()
+                probs = jax.nn.softmax(logits)
+                entropy = -(probs * jnp.where(mask > 0, logp, 0.0)
+                            ).sum(-1).mean()
+                return (pg + cfg.value_coef * v_loss
+                        - cfg.entropy_coef * entropy), entropy
+
+            (loss, ent), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt = self.tx.update(grads, opt, params)
+            return optax.apply_updates(params, updates), opt, loss, ent
+
+        self._pg_update = jax.jit(pg_update, donate_argnums=(0, 1))
+        # per-move forward must be one compiled call, not op-by-op dispatch
+        self._policy_logits = jax.jit(
+            lambda p, o: self.net.apply({"params": p}, o)[0])
+        self._jax, self._jnp = jax, jnp
+        self._np_rng = np.random.default_rng((cfg.seed or 0) + 5)
+
+    # ---------------------------------------------------------- matchmaking
+    def _win_rate(self, a: str, b: str) -> float:
+        wins, games = self.payoff.get((a, b), (0.0, 0))
+        return 0.5 if games == 0 else wins / games
+
+    def _pfsp_pick(self, learner: str, pool: List[str]) -> Optional[str]:
+        """Prioritized fictitious self-play: sample an opponent weighted
+        toward the ones this learner beats least (AlphaStar's f_hard /
+        variance weightings)."""
+        if not pool:
+            return None
+        ps = np.array([self._win_rate(learner, o) for o in pool])
+        if self.config.pfsp_weighting == "hard":
+            w = np.square(1.0 - ps)
+        else:
+            w = ps * (1.0 - ps) + 1e-3  # variance weighting
+        w = w / w.sum()
+        return pool[int(self._np_rng.choice(len(pool), p=w))]
+
+    def _pick_opponent(self, name: str) -> Tuple[str, Any]:
+        """Returns (opponent_name, opponent_params) per league role."""
+        mains = [n for n in self.learners if n.startswith("main_")
+                 and "exploiter" not in n]
+        if name.startswith("main_exploiter"):
+            # attacks current main agents only
+            opp = mains[int(self._np_rng.integers(len(mains)))]
+            return opp, self.learners[opp]["params"]
+        if name.startswith("league_exploiter"):
+            pool = list(self.league)
+            opp = self._pfsp_pick(name, pool)
+            if opp is not None:
+                return opp, self.league[opp]
+            opp = mains[int(self._np_rng.integers(len(mains)))]
+            return opp, self.learners[opp]["params"]
+        # main agent: self-play or PFSP vs league snapshots
+        if self.league and \
+                self._np_rng.random() > self.config.self_play_prob:
+            opp = self._pfsp_pick(name, list(self.league))
+            return opp, self.league[opp]
+        opp = mains[int(self._np_rng.integers(len(mains)))]
+        return opp, self.learners[opp]["params"]
+
+    # ---------------------------------------------------------------- games
+    _LINES = np.array([[0, 1, 2], [3, 4, 5], [6, 7, 8], [0, 3, 6],
+                       [1, 4, 7], [2, 5, 8], [0, 4, 8], [2, 4, 6]])
+
+    @staticmethod
+    def _vec_obs(boards: np.ndarray, player: np.ndarray) -> np.ndarray:
+        mine = (boards == player[:, None]).astype(np.float32)
+        theirs = (boards == -player[:, None]).astype(np.float32)
+        return np.concatenate([mine, theirs], 1)
+
+    def _apply_moves(self, boards, player, side, active, z,
+                     idxs, acts) -> None:
+        """Apply one move per game in ``idxs`` (in place), resolve games
+        that just finished (z from the learner/eval side's perspective:
+        1 win / 0.5 draw / 0 loss), and flip whose turn it is."""
+        boards[idxs, acts] = player[idxs]
+        sums = boards[idxs][:, self._LINES].sum(2)
+        won = (sums * player[idxs, None] == 3).any(1)
+        full = (boards[idxs] != 0).all(1)
+        done = won | full
+        if done.any():
+            d = idxs[done]
+            z[d] = np.where(won[done],
+                            (player[d] == side[d]).astype(np.float64), 0.5)
+            active[d] = False
+        player[idxs] = -player[idxs]
+
+    def _batch_sample(self, params, boards, player,
+                      greedy: bool = False) -> np.ndarray:
+        """One batched policy call for a set of same-params games; masked
+        Gumbel sampling keeps the draw fully vectorized."""
+        jnp = self._jnp
+        obs = self._vec_obs(boards, player)
+        # pad to power-of-two buckets: group sizes vary per ply, and each
+        # distinct batch shape would otherwise recompile the jitted call
+        n = len(obs)
+        bucket = 1 << max(0, (n - 1).bit_length())
+        if bucket != n:
+            obs = np.concatenate(
+                [obs, np.zeros((bucket - n, obs.shape[1]), np.float32)])
+        logits = np.asarray(self._policy_logits(
+            params, jnp.asarray(obs, jnp.float32)))[:n]
+        masked = np.where(boards == 0, logits, -np.inf)
+        if greedy:
+            return masked.argmax(1)
+        gumbel = -np.log(-np.log(
+            self._np_rng.random(masked.shape) + 1e-12) + 1e-12)
+        return (masked + gumbel).argmax(1)
+
+    def _play_matches(self, learner_params, matches
+                      ) -> Tuple[List, List, List, List[Tuple[str, float]]]:
+        """Play every game of this iteration in lockstep: at each ply one
+        batched policy call per distinct parameter set (the learner plus
+        each sampled opponent) instead of one per move — the difference
+        between thousands of device round-trips and ~9*(1+K).
+
+        ``matches``: list of (opp_name, opp_params, n_games). Returns the
+        learner's (obs, masks, actions) across all games and a per-game
+        (opp_name, z) outcome list."""
+        opp_of_game: List[int] = []
+        for i, (_name, _params, n) in enumerate(matches):
+            opp_of_game += [i] * n
+        opp_of_game = np.asarray(opp_of_game)
+        n_games = len(opp_of_game)
+        boards = np.zeros((n_games, 9), np.int8)
+        player = np.ones(n_games, np.int8)
+        learner_side = np.where(self._np_rng.random(n_games) < 0.5,
+                                1, -1).astype(np.int8)
+        active = np.ones(n_games, bool)
+        z = np.full(n_games, 0.5)
+        obs_l: List[np.ndarray] = []
+        mask_l: List[np.ndarray] = []
+        act_l: List[np.ndarray] = []
+        ret_game: List[np.ndarray] = []  # game index of each learner move
+        for _ply in range(9):
+            if not active.any():
+                break
+            turn_learner = np.flatnonzero(
+                active & (player == learner_side))
+            groups = [(learner_params, turn_learner, True)]
+            for i, (_n, opp_params, _c) in enumerate(matches):
+                idxs = np.flatnonzero(active & (player != learner_side)
+                                      & (opp_of_game == i))
+                if len(idxs):
+                    groups.append((opp_params, idxs, False))
+            for params, idxs, is_learner in groups:
+                if len(idxs) == 0:
+                    continue
+                acts = self._batch_sample(params, boards[idxs],
+                                          player[idxs])
+                if is_learner:
+                    obs_l.append(self._vec_obs(boards[idxs], player[idxs]))
+                    mask_l.append((boards[idxs] == 0).astype(np.float32))
+                    act_l.append(acts)
+                    ret_game.append(idxs)
+                self._timesteps_total += len(idxs)
+                self._apply_moves(boards, player, learner_side, active, z,
+                                  idxs, acts)
+        outcomes = [(matches[opp_of_game[g]][0], z[g])
+                    for g in range(n_games)]
+        obs = np.concatenate(obs_l) if obs_l else np.zeros((0, 18),
+                                                           np.float32)
+        masks = np.concatenate(mask_l) if mask_l else np.zeros(
+            (0, 9), np.float32)
+        acts = np.concatenate(act_l) if act_l else np.zeros(0, np.int64)
+        game_of_move = np.concatenate(ret_game) if ret_game else \
+            np.zeros(0, np.int64)
+        returns = 2.0 * z[game_of_move] - 1.0
+        return (obs, masks, acts), returns, outcomes
+
+    def _record(self, a: str, b: str, z: float) -> None:
+        wins, games = self.payoff.get((a, b), (0.0, 0))
+        self.payoff[(a, b)] = (wins + z, games + 1)
+        wins_b, games_b = self.payoff.get((b, a), (0.0, 0))
+        self.payoff[(b, a)] = (wins_b + (1.0 - z), games_b + 1)
+
+    # ---------------------------------------------------------------- train
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        jnp = self._jnp
+        info: Dict[str, Any] = {}
+        for name, learner in self.learners.items():
+            # sample an opponent per game, then group identical opponents
+            # so lockstep play needs one policy batch per distinct foe
+            draws: Dict[str, Tuple[Any, int]] = {}
+            for _ in range(cfg.games_per_iter):
+                opp_name, opp_params = self._pick_opponent(name)
+                params, count = draws.get(opp_name, (opp_params, 0))
+                draws[opp_name] = (params, count + 1)
+            matches = [(n, p, c) for n, (p, c) in draws.items()]
+            (obs, masks, acts), rets, outcomes = self._play_matches(
+                learner["params"], matches)
+            for opp_name, z in outcomes:
+                self._record(name, opp_name, z)
+            batch = (jnp.asarray(obs, jnp.float32),
+                     jnp.asarray(masks, jnp.float32),
+                     jnp.asarray(acts.astype(np.int32)),
+                     jnp.asarray(rets.astype(np.float32)))
+            learner["params"], learner["opt"], loss, ent = \
+                self._pg_update(learner["params"], learner["opt"], *batch)
+            info[f"{name}_win_rate"] = float(
+                np.mean([z for _, z in outcomes]))
+            info[f"{name}_loss"] = float(loss)
+        self.iteration += 1
+        # periodic league freeze: snapshot every learner; exploiters
+        # restart from a fresh init after snapshotting (the paper's reset)
+        if self.iteration % cfg.snapshot_interval == 0:
+            for name, learner in list(self.learners.items()):
+                snap = f"{name}@{self.iteration}"
+                self.league[snap] = self._jax.tree.map(
+                    np.asarray, learner["params"])
+                if "exploiter" in name:
+                    self._init_key, key = self._jax.random.split(
+                        self._init_key)
+                    params = self.net.init(
+                        key, jnp.zeros((1, self._obs_dim)))["params"]
+                    learner["params"] = params
+                    learner["opt"] = self.tx.init(params)
+            info["league_size"] = len(self.league)
+        return {"training_iteration": self.iteration,
+                "timesteps_total": self._timesteps_total,
+                "info": info}
+
+    # ------------------------------------------------------------ eval utils
+    def eval_vs_random(self, name: str = "main_0",
+                       n_games: int = 50) -> float:
+        """Win-rate (draws = 0.5) of a learner against a uniform-random
+        player — the standard sanity ladder rung.  Lockstep-batched."""
+        params = self.learners[name]["params"]
+        boards = np.zeros((n_games, 9), np.int8)
+        player = np.ones(n_games, np.int8)
+        side = np.where(self._np_rng.random(n_games) < 0.5,
+                        1, -1).astype(np.int8)
+        active = np.ones(n_games, bool)
+        z = np.full(n_games, 0.5)
+        for _ply in range(9):
+            if not active.any():
+                break
+            for is_learner in (True, False):
+                idxs = np.flatnonzero(
+                    active & ((player == side) == is_learner))
+                if len(idxs) == 0:
+                    continue
+                if is_learner:
+                    acts = self._batch_sample(params, boards[idxs],
+                                              player[idxs], greedy=True)
+                else:
+                    gumbel = self._np_rng.random((len(idxs), 9))
+                    acts = np.where(boards[idxs] == 0, gumbel,
+                                    -1.0).argmax(1)
+                self._apply_moves(boards, player, side, active, z,
+                                  idxs, acts)
+        return float(z.mean())
+
+    # ----------------------------------------------------------- checkpoint
+    def get_weights(self) -> Any:
+        return self._jax.tree.map(np.asarray,
+                                  self.learners["main_0"]["params"])
+
+    def set_weights(self, weights: Any) -> None:
+        self.learners["main_0"]["params"] = self._jax.tree.map(
+            self._jnp.asarray, weights)
+
+    def save(self) -> Checkpoint:
+        import cloudpickle
+        blob = cloudpickle.dumps({
+            "learners": self._jax.tree.map(
+                np.asarray, {n: l["params"]
+                             for n, l in self.learners.items()}),
+            "opts": self._jax.tree.map(
+                np.asarray, {n: l["opt"]
+                             for n, l in self.learners.items()}),
+            "league": self.league,
+            "payoff": self.payoff,
+        })
+        return Checkpoint.from_dict({"league_blob": blob,
+                                     "iteration": self.iteration})
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        import cloudpickle
+        d = checkpoint.to_dict()
+        state = cloudpickle.loads(d["league_blob"])
+        for n, p in state["learners"].items():
+            self.learners[n] = {
+                "params": self._jax.tree.map(self._jnp.asarray, p),
+                "opt": self._jax.tree.map(self._jnp.asarray,
+                                          state["opts"][n]),
+            }
+        self.league = state["league"]
+        self.payoff = state["payoff"]
+        self.iteration = d.get("iteration", 0)
+
+    def stop(self) -> None:
+        pass

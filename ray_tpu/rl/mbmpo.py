@@ -1,0 +1,312 @@
+"""MBMPO: model-based meta-policy optimization.
+
+Analog of /root/reference/rllib/algorithms/mbmpo/mbmpo.py (Clavera et al.
+2018): learn an ensemble of dynamics models from real transitions, treat
+each ensemble member as a "task", and meta-learn policy parameters with a
+MAML-style inner/outer loop over *imagined* rollouts so one inner gradient
+step adapts the policy to any member (and therefore robustly to the real
+dynamics, which the ensemble brackets).
+
+TPU-native shape (same design as rl/maml.py): the inner adaptation is
+differentiated through directly (grad-of-grad) and the ensemble dimension
+is vmapped, so one jitted meta-step computes every member's imagined
+rollouts, inner updates, and the second-order meta-gradient as a single
+XLA program. Model training is likewise one jitted step vmapped over the
+ensemble with bootstrap-resampled minibatches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rl.algorithm import AlgorithmConfig
+from ray_tpu.rl.env import Box, make_env
+
+
+class MBMPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MBMPO
+        self.ensemble_size = 5
+        self.model_hidden = (128, 128)
+        self.model_lr = 1e-3
+        self.model_train_steps = 200    # sgd steps per iteration
+        self.model_batch_size = 256
+        self.inner_lr = 0.05
+        self.meta_lr = 3e-4
+        self.horizon = 20               # imagined rollout length
+        self.n_imagined = 16            # rollouts per ensemble member
+        self.meta_updates_per_iter = 10
+        self.real_steps_per_iter = 1000
+        self.buffer_size = 50_000
+        self.hidden = (64, 64)          # policy net
+        self.exploration_noise = 0.2
+
+    def environment(self, env=None, **kwargs):
+        return super().environment(env or "Pendulum-v1", **kwargs)
+
+
+class MBMPO:
+    def __init__(self, config: MBMPOConfig):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        cfg = config
+
+        self.env = make_env(cfg.env_spec)
+        if not isinstance(self.env.action_space, Box):
+            raise ValueError("MBMPO requires a continuous action space")
+        obs_dim = int(np.prod(self.env.observation_space.shape))
+        act_dim = int(np.prod(self.env.action_space.shape))
+        self.obs_dim, self.act_dim = obs_dim, act_dim
+        low = np.asarray(self.env.action_space.low, np.float32).reshape(-1)
+        high = np.asarray(self.env.action_space.high, np.float32).reshape(-1)
+        self._scale = (high - low) / 2.0
+        self._shift = (high + low) / 2.0
+
+        class Policy(nn.Module):
+            @nn.compact
+            def __call__(self, s):
+                x = s
+                for h in cfg.hidden:
+                    x = nn.tanh(nn.Dense(h)(x))
+                mean = nn.Dense(act_dim)(x)
+                log_std = self.param("log_std", nn.initializers.constant(-0.5),
+                                     (act_dim,))
+                return mean, log_std
+
+        class Dynamics(nn.Module):
+            """delta_state + reward head; trained on real transitions."""
+
+            @nn.compact
+            def __call__(self, s, a):
+                x = jnp.concatenate([s, a], -1)
+                for h in cfg.model_hidden:
+                    x = nn.swish(nn.Dense(h)(x))
+                delta = nn.Dense(obs_dim)(x)
+                reward = nn.Dense(1)(x)[..., 0]
+                return delta, reward
+
+        self.policy = Policy()
+        self.dynamics = Dynamics()
+        rng = jax.random.PRNGKey(cfg.seed or 0)
+        r_pi, r_dyn = jax.random.split(rng)
+        pi_params = self.policy.init(r_pi, jnp.zeros((1, obs_dim)))["params"]
+        # independently initialized ensemble members, stacked on axis 0
+        dyn_params = jax.vmap(
+            lambda k: self.dynamics.init(k, jnp.zeros((1, obs_dim)),
+                                         jnp.zeros((1, act_dim)))["params"]
+        )(jax.random.split(r_dyn, cfg.ensemble_size))
+
+        self.pi_tx = optax.adam(cfg.meta_lr)
+        self.dyn_tx = optax.adam(cfg.model_lr)
+        self.state = {
+            "pi": pi_params,
+            "pi_opt": self.pi_tx.init(pi_params),
+            "dyn": dyn_params,
+            "dyn_opt": jax.vmap(self.dyn_tx.init)(dyn_params),
+        }
+
+        # ---------------------------------------------------- model training
+        def model_loss(dp, s, a, s2, r):
+            delta_hat, r_hat = self.dynamics.apply({"params": dp}, s, a)
+            return (jnp.square(delta_hat - (s2 - s)).sum(-1).mean()
+                    + jnp.square(r_hat - r).mean())
+
+        def model_step(dyn, dyn_opt, batch):
+            # batch arrays are [ensemble, B, ...] (bootstrap-resampled)
+            def one(dp, do, s, a, s2, r):
+                loss, grads = jax.value_and_grad(model_loss)(dp, s, a, s2, r)
+                updates, do = self.dyn_tx.update(grads, do, dp)
+                return optax.apply_updates(dp, updates), do, loss
+
+            dyn, dyn_opt, losses = jax.vmap(one)(
+                dyn, dyn_opt, batch["s"], batch["a"], batch["s2"],
+                batch["r"])
+            return dyn, dyn_opt, losses.mean()
+
+        self._model_step = jax.jit(model_step, donate_argnums=(0, 1))
+
+        # ------------------------------------------------ imagination + MAML
+        def logp(pp, s, a):
+            mean, log_std = self.policy.apply({"params": pp}, s)
+            var = jnp.exp(2 * log_std)
+            return (-0.5 * (jnp.square(a - mean) / var
+                            + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+
+        def imagine(pp, dp, s0, key):
+            """Roll the policy through one learned model; returns the
+            REINFORCE surrogate (differentiable wrt pp) and mean return."""
+            def step(carry, k):
+                s = carry
+                mean, log_std = self.policy.apply({"params": pp}, s)
+                u = mean + jnp.exp(log_std) * jax.random.normal(
+                    k, mean.shape)  # pre-squash sample
+                a = jnp.tanh(u)
+                delta, r = self.dynamics.apply(
+                    {"params": dp}, s, a * self._scale + self._shift)
+                return s + delta, (s, u, r)
+
+            keys = jax.random.split(key, cfg.horizon)
+            _, (ss, uu, rr) = jax.lax.scan(step, s0, keys)
+            # reward-to-go weighted log-probs (REINFORCE with baseline).
+            # The score is the Gaussian log-density at the PRE-squash
+            # sample u: the tanh change-of-variables Jacobian is constant
+            # wrt params once u is fixed, so it drops out of the gradient
+            # (evaluating at tanh(u) instead would bias the score).
+            rtg = jnp.cumsum(rr[::-1], 0)[::-1]              # [T, B]
+            rtg = rtg - rtg.mean(axis=1, keepdims=True)
+            lp = jax.vmap(lambda s, u: logp(pp, s, u))(
+                ss, jax.lax.stop_gradient(uu))
+            surrogate = (lp * jax.lax.stop_gradient(rtg)).sum(0).mean()
+            return surrogate, rr.sum(0).mean()
+
+        def member_meta_loss(pp, dp, s0, k_in, k_out):
+            # inner: one policy-gradient step inside this member's model
+            g = jax.grad(lambda p: -imagine(p, dp, s0, k_in)[0])(pp)
+            adapted = jax.tree.map(lambda p, gg: p - cfg.inner_lr * gg,
+                                   pp, g)
+            # outer: post-adaptation performance in the same model; the
+            # meta-gradient flows through the inner step (second order)
+            surrogate, ret = imagine(adapted, dp, s0, k_out)
+            return -surrogate, ret
+
+        def meta_step(pi, pi_opt, dyn, s0, key):
+            # s0: [ensemble, B, obs] real states; vmap members into one
+            # XLA program (the MAML-over-models core of MBMPO)
+            ks = jax.random.split(key, cfg.ensemble_size * 2)
+            k_in, k_out = ks[:cfg.ensemble_size], ks[cfg.ensemble_size:]
+
+            def loss(p):
+                losses, rets = jax.vmap(
+                    lambda dp, s, ki, ko: member_meta_loss(p, dp, s, ki, ko)
+                )(dyn, s0, k_in, k_out)
+                return losses.mean(), rets.mean()
+
+            (l, ret), grads = jax.value_and_grad(loss, has_aux=True)(pi)
+            updates, pi_opt = self.pi_tx.update(grads, pi_opt, pi)
+            return optax.apply_updates(pi, updates), pi_opt, l, ret
+
+        self._meta_step = jax.jit(meta_step, donate_argnums=(0, 1))
+        self._jax, self._jnp = jax, jnp
+        self._rng = jax.random.PRNGKey((cfg.seed or 0) + 77)
+        self._np_rng = np.random.default_rng(cfg.seed or 0)
+
+        self._buf_s: list = []
+        self._buf_a: list = []
+        self._buf_s2: list = []
+        self._buf_r: list = []
+        self._reward_window: list = []
+
+    # ------------------------------------------------------------- rollouts
+    def _act_real(self, pi_params, obs: np.ndarray) -> np.ndarray:
+        jnp = self._jnp
+        mean, log_std = self.policy.apply(
+            {"params": pi_params}, jnp.asarray(obs, jnp.float32)[None])
+        a = np.tanh(np.asarray(mean)[0]
+                    + np.exp(np.asarray(log_std))
+                    * self._np_rng.standard_normal(self.act_dim)
+                    * self.config.exploration_noise / 0.2 * 1.0)
+        return a.astype(np.float32)
+
+    def _collect_real(self, n_steps: int) -> None:
+        cfg = self.config
+        obs, _ = self.env.reset()
+        ep_rew = 0.0
+        for _ in range(n_steps):
+            a = self._act_real(self.state["pi"], np.asarray(obs, np.float32))
+            env_a = a * self._scale + self._shift
+            obs2, r, term, trunc, _ = self.env.step(env_a)
+            self._buf_s.append(np.asarray(obs, np.float32).reshape(-1))
+            self._buf_a.append(env_a.reshape(-1).astype(np.float32))
+            self._buf_s2.append(np.asarray(obs2, np.float32).reshape(-1))
+            self._buf_r.append(float(r))
+            ep_rew += float(r)
+            self._timesteps_total += 1
+            obs = obs2
+            if term or trunc:
+                self._reward_window.append(ep_rew)
+                ep_rew = 0.0
+                obs, _ = self.env.reset()
+        cap = cfg.buffer_size
+        for buf in (self._buf_s, self._buf_a, self._buf_s2, self._buf_r):
+            del buf[:-cap]
+        self._reward_window = self._reward_window[-50:]
+
+    # ---------------------------------------------------------------- train
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        jnp = self._jnp
+        self._collect_real(cfg.real_steps_per_iter)
+        s = np.stack(self._buf_s)
+        a = np.stack(self._buf_a)
+        s2 = np.stack(self._buf_s2)
+        r = np.asarray(self._buf_r, np.float32)
+        n = len(s)
+
+        model_loss = float("nan")
+        for _ in range(cfg.model_train_steps):
+            idx = self._np_rng.integers(
+                0, n, (cfg.ensemble_size, min(cfg.model_batch_size, n)))
+            batch = {"s": jnp.asarray(s[idx]), "a": jnp.asarray(a[idx]),
+                     "s2": jnp.asarray(s2[idx]), "r": jnp.asarray(r[idx])}
+            self.state["dyn"], self.state["dyn_opt"], loss = \
+                self._model_step(self.state["dyn"], self.state["dyn_opt"],
+                                 batch)
+            model_loss = float(loss)
+
+        meta_loss = imagined_return = float("nan")
+        for _ in range(cfg.meta_updates_per_iter):
+            idx = self._np_rng.integers(
+                0, n, (cfg.ensemble_size, cfg.n_imagined))
+            s0 = jnp.asarray(s[idx])
+            self._rng, key = self._jax.random.split(self._rng)
+            self.state["pi"], self.state["pi_opt"], ml, ret = \
+                self._meta_step(self.state["pi"], self.state["pi_opt"],
+                                self.state["dyn"], s0, key)
+            meta_loss, imagined_return = float(ml), float(ret)
+
+        self.iteration += 1
+        rews = self._reward_window
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "episode_reward_mean": float(np.mean(rews)) if rews
+            else float("nan"),
+            "info": {"model_loss": model_loss, "meta_loss": meta_loss,
+                     "imagined_return": imagined_return,
+                     "buffer_size": n},
+        }
+
+    # ----------------------------------------------------------- checkpoint
+    def get_weights(self) -> Any:
+        return self._jax.tree.map(np.asarray, self.state["pi"])
+
+    def set_weights(self, weights: Any) -> None:
+        self.state["pi"] = self._jax.tree.map(self._jnp.asarray, weights)
+
+    def save(self) -> Checkpoint:
+        from ray_tpu.rl.algorithm import full_training_state
+        return Checkpoint.from_dict({
+            "state": full_training_state(self),
+            "iteration": self.iteration})
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        from ray_tpu.rl.algorithm import apply_full_training_state
+        d = checkpoint.to_dict()
+        if d.get("state") is not None:
+            apply_full_training_state(self, d["state"])
+        else:
+            self.set_weights(d["weights"])
+        self.iteration = d.get("iteration", 0)
+
+    def stop(self) -> None:
+        self.env.close()

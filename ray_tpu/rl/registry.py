@@ -162,6 +162,16 @@ def _ars():
     return ARS, ARSConfig
 
 
+def _alpha_star():
+    from ray_tpu.rl.alpha_star import AlphaStar, AlphaStarConfig
+    return AlphaStar, AlphaStarConfig
+
+
+def _mbmpo():
+    from ray_tpu.rl.mbmpo import MBMPO, MBMPOConfig
+    return MBMPO, MBMPOConfig
+
+
 _REGISTRY = {
     "ppo": _ppo,
     "impala": _impala,
@@ -185,6 +195,8 @@ _REGISTRY = {
     "maml": _maml,
     "slateq": _slateq,
     "dreamer": _dreamer,
+    "mbmpo": _mbmpo,
+    "alphastar": _alpha_star,
     "apexdqn": _apex_dqn,
     "crr": _crr,
     "dt": _dt,

@@ -737,13 +737,17 @@ class CoreWorker:
                 # admission: multi-chunk pulls reserve their full buffer
                 # from the process-wide quota before allocating, so N
                 # concurrent gets of large objects queue instead of
-                # overcommitting memory
+                # overcommitting memory.  Drop the first chunk before
+                # queueing — a parked waiter must hold no payload bytes
+                # (re-fetching one chunk later is cheaper than cap-exempt
+                # memory per waiter); the idle TCP conn it keeps is fds,
+                # not memory
+                first = None
                 if not self._pull_budget.acquire(total, deadline):
                     return "error", None  # quota wait timed out: transient
                 try:
                     out = bytearray(total)
-                    out[:len(first["data"])] = first["data"]
-                    off = len(first["data"])
+                    off = 0
                     while off < total:
                         if deadline is not None and \
                                 time.monotonic() >= deadline:

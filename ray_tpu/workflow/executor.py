@@ -49,6 +49,10 @@ def _run_step_with_retries(storage, workflow_id, step_id, fn, args, kwargs,
     catch = bool(wf_opts.get("catch_exceptions", False))
     attempt = 0
     while True:
+        # cancel() must be able to stop a retry loop (especially the
+        # retry-forever case) — the pre-step check alone can't reach here
+        if storage.get_status(workflow_id) == st.STATUS_CANCELED:
+            raise WorkflowCancellationError(workflow_id)
         try:
             value = ray_tpu.get(fn.remote(*args, **kwargs))
             return (value, None) if catch else value
