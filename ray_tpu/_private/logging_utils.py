@@ -20,6 +20,26 @@ def get_logger(name: str) -> logging.Logger:
     return logging.getLogger(f"ray_tpu.{name}")
 
 
+def enable_stack_dumps(session_dir: str | None) -> None:
+    """SIGUSR1 -> dump every thread's Python stack to the session log dir
+    (py-spy/`ray stack` analog, cf. reference python/ray/scripts `ray
+    stack`): `ray-tpu stack` signals all session processes and collects
+    the files. The dump file is kept open for the process's lifetime —
+    faulthandler requires a stable fd."""
+    if not session_dir:
+        return
+    import faulthandler
+    import signal
+
+    try:
+        log_dir = os.path.join(session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        f = open(os.path.join(log_dir, f"stack_{os.getpid()}.txt"), "w")
+        faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
+    except (OSError, ValueError, AttributeError):
+        pass  # debugging aid only; never fail startup over it
+
+
 def setup_component_logging(component: str, session_dir: str | None = None,
                             level: int = logging.INFO) -> str | None:
     """Configure the root ray_tpu logger; returns the log file path if any."""

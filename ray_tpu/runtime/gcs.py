@@ -1107,8 +1107,10 @@ def main():  # pragma: no cover - spawned as a subprocess
     parser.add_argument("--session-dir", default=None)
     parser.add_argument("--address-file", default=None)
     args = parser.parse_args()
-    from ray_tpu._private.logging_utils import setup_component_logging
+    from ray_tpu._private.logging_utils import (enable_stack_dumps,
+                                                 setup_component_logging)
     setup_component_logging("gcs_server", args.session_dir)
+    enable_stack_dumps(args.session_dir)
     persist = (os.path.join(args.session_dir, "gcs_snapshot.pkl")
                if args.session_dir else None)
     server = GcsServer(args.host, args.port, persist_path=persist)

@@ -1089,8 +1089,10 @@ def main():  # pragma: no cover - subprocess entry
     parser.add_argument("--address-file", default=None)
     parser.add_argument("--labels", default="{}")
     args = parser.parse_args()
-    from ray_tpu._private.logging_utils import setup_component_logging
+    from ray_tpu._private.logging_utils import (enable_stack_dumps,
+                                                 setup_component_logging)
     setup_component_logging("raylet", args.session_dir)
+    enable_stack_dumps(args.session_dir)
     resources = json.loads(args.resources) or None
     raylet = Raylet((args.gcs_host, args.gcs_port), args.session_dir,
                     resources=resources,

@@ -501,6 +501,8 @@ def main():
     parser.add_argument("--node-id", required=True)
     args = parser.parse_args()
     setup_component_logging("worker", args.session_dir)
+    from ray_tpu._private.logging_utils import enable_stack_dumps
+    enable_stack_dumps(args.session_dir)
     worker = WorkerProcess(args)
     logger.info("worker %s serving at %s", args.worker_id[:8],
                 worker.core.address)

@@ -126,6 +126,16 @@ def _write_pids(session_dir: str, node) -> None:
         json.dump(pids, f)
 
 
+def _latest_session_dir() -> Optional[str]:
+    """Session dir advertised by the most recent local `init`/`start`."""
+    try:
+        with open(os.path.join("/tmp", "ray_tpu_sessions",
+                               "latest.json")) as f:
+            return json.load(f)["session_dir"]
+    except (OSError, ValueError, KeyError):
+        return None
+
+
 def cmd_stop(args) -> None:
     """Kill daemons of the latest session (plus their workers)."""
     import subprocess
@@ -136,11 +146,9 @@ def cmd_stop(args) -> None:
         sessions = [os.path.join(base, d) for d in os.listdir(base)
                     if d.startswith("session_")]
     else:
-        try:
-            with open(os.path.join(base, "latest.json")) as f:
-                sessions = [json.load(f)["session_dir"]]
-        except (OSError, ValueError, KeyError):
-            pass
+        latest = _latest_session_dir()
+        if latest:
+            sessions = [latest]
     for sess in sessions:
         pid_file = os.path.join(sess, "pids.json")
         try:
@@ -231,6 +239,62 @@ def cmd_debug(args) -> None:
         return
     for bid, addr in sessions:
         print(f"{bid}  {addr}   (attach: nc {addr.replace(':', ' ')})")
+
+
+def cmd_stack(args) -> None:
+    """Dump every session process's Python thread stacks (py-spy /
+    `ray stack` analog): SIGUSR1 each process whose cmdline references the
+    session dir, then print the faulthandler dumps they wrote."""
+    import glob
+
+    session_dir = getattr(args, "session_dir", None) or \
+        _latest_session_dir()
+    if not session_dir:
+        print("no session found; pass --session-dir")
+        return
+    session_dir = os.path.abspath(session_dir).rstrip("/")
+    # faulthandler APPENDS to each per-pid file: remember current sizes so
+    # only this run's dumps are printed (older runs' output and files of
+    # dead/recycled pids would otherwise masquerade as live stacks)
+    offsets = {}
+    for path in glob.glob(os.path.join(session_dir, "logs",
+                                       "stack_*.txt")):
+        try:
+            offsets[path] = os.path.getsize(path)
+        except OSError:
+            pass
+    signalled = []
+    for proc_dir in glob.glob("/proc/[0-9]*"):
+        try:
+            with open(os.path.join(proc_dir, "cmdline"), "rb") as f:
+                cmdline = f.read().decode(errors="replace")
+        except OSError:
+            continue
+        if session_dir in cmdline and "ray_tpu" in cmdline:
+            pid = int(os.path.basename(proc_dir))
+            if pid == os.getpid():
+                continue
+            try:
+                os.kill(pid, signal.SIGUSR1)
+                signalled.append(pid)
+            except OSError:
+                pass
+    if not signalled:
+        print(f"no ray_tpu processes found for session {session_dir}")
+        return
+    time.sleep(0.4)  # let faulthandler flush
+    print(f"signalled {len(signalled)} processes: {signalled}")
+    for pid in signalled:
+        path = os.path.join(session_dir, "logs", f"stack_{pid}.txt")
+        try:
+            with open(path) as f:
+                f.seek(offsets.get(path, 0))
+                content = f.read().strip()
+        except OSError:
+            continue
+        if content:
+            print(f"\n===== pid {pid} =====")
+            print(content)
 
 
 def cmd_microbenchmark(args) -> None:
@@ -354,6 +418,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("stack",
+                        help="dump all session processes' thread stacks")
+    sp.add_argument("--session-dir")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("microbenchmark",
                         help="core-runtime ops/s suite (ray_perf analog)")
