@@ -187,3 +187,24 @@ def test_async_actor_exception_propagates(ray_start_regular):
         ray_tpu.get(b.go.remote(), timeout=60)
     # the actor survives a failed call
     assert ray_tpu.get(b.ok.remote(), timeout=60) == 1
+
+
+def test_method_num_returns_declaration(ray_start_regular):
+    """@ray_tpu.method(num_returns=N) declared on the class takes effect
+    through the handle (harvested into method options at creation)."""
+    @ray_tpu.remote
+    class Splitter:
+        @ray_tpu.method(num_returns=2)
+        def split(self):
+            return "a", "b"
+
+        def one(self):
+            return "single"
+
+    s = Splitter.remote()
+    r1, r2 = s.split.remote()
+    assert ray_tpu.get([r1, r2], timeout=60) == ["a", "b"]
+    assert ray_tpu.get(s.one.remote(), timeout=60) == "single"
+    # per-call override still wins
+    ref = s.split.options(num_returns=1).remote()
+    assert ray_tpu.get(ref, timeout=60) == ("a", "b")
