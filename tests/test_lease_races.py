@@ -91,3 +91,31 @@ def test_concurrent_large_pulls_respect_admission_cap(ray_start_cluster):
         assert v is not None and float(v[0]) == float(i)
     assert w._pull_budget.used == 0  # fully drained after the pulls
     ray_tpu.shutdown()
+
+
+def test_pull_budget_fifo_and_oversize_unit():
+    """_PullBudget unit semantics: strict FIFO (a fitting small request
+    can't starve a queued large one), oversize requests clamp to the cap
+    and run alone, accounting drains to zero."""
+    from ray_tpu.runtime.core_worker import _PullBudget
+
+    b = _PullBudget(100)
+    assert b.acquire(60, None)
+
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(b.acquire(200, time.monotonic() + 10)))
+    t.start()
+    time.sleep(0.1)
+    assert got == []  # oversize waits for exclusivity (used > 0)
+    # a small request that WOULD fit must queue behind the large head
+    assert b.acquire(30, time.monotonic() + 0.3) is False
+    b.release(60)
+    t.join(timeout=10)
+    assert got == [True]  # clamped to cap, admitted alone
+    assert b.used == 100
+    b.release(200)  # symmetric clamp
+    assert b.used == 0
+    assert b.acquire(30, time.monotonic() + 1)
+    b.release(30)
+    assert b.used == 0
