@@ -106,7 +106,12 @@ def test_pull_budget_fifo_and_oversize_unit():
     t = threading.Thread(
         target=lambda: got.append(b.acquire(200, time.monotonic() + 10)))
     t.start()
-    time.sleep(0.1)
+    # deterministic: wait until the ticket is actually enqueued (a fixed
+    # sleep can't distinguish 'blocked waiting' from 'not yet started')
+    deadline = time.monotonic() + 10
+    while not b._waiters and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert b._waiters
     assert got == []  # oversize waits for exclusivity (used > 0)
     # a small request that WOULD fit must queue behind the large head
     assert b.acquire(30, time.monotonic() + 0.3) is False
