@@ -144,7 +144,7 @@ def set_global_worker(worker: Optional["CoreWorker"]) -> None:
 
 class _OwnedObject:
     __slots__ = ("state", "data", "error", "locations", "event", "refcount",
-                 "task_spec", "dynamic_children")
+                 "task_spec", "dynamic_children", "recovering")
 
     def __init__(self):
         self.state = "pending"       # pending | ready
@@ -157,6 +157,9 @@ class _OwnedObject:
         # sub-object ids of a num_returns="dynamic" task: freed with slot 0
         # unless a deserialized generator bound its own refs to them
         self.dynamic_children: Optional[list] = None
+        # a _recover_or_fail thread is resolving this entry: borrowers
+        # polling every 10 ms must not spawn redundant ones
+        self.recovering = False
 
 
 class _PullBudget:
@@ -866,19 +869,23 @@ class CoreWorker:
         """Owner-side recovery entry point for borrower-driven gets: either
         kick off reconstruction or resolve the entry to ObjectLostError so
         every waiter (local and remote) gets a clean failure."""
-        if self._try_reconstruct(oid, entry):
-            return
-        err = exc.ObjectLostError(
-            f"object {oid.hex()[:16]} lost: all copies are gone and it "
-            f"cannot be reconstructed")
-        head, views = ser.serialize(err, error_type=ser.ERROR_OBJECT_LOST)
-        data = ser.to_flat_bytes(head, views)
-        with self._owned_lock:
-            if entry.state == "ready" and entry.data is None \
-                    and not entry.locations:
-                entry.data = data
-                entry.error = ser.ERROR_OBJECT_LOST
-                entry.event.set()
+        try:
+            if self._try_reconstruct(oid, entry):
+                return
+            err = exc.ObjectLostError(
+                f"object {oid.hex()[:16]} lost: all copies are gone and it "
+                f"cannot be reconstructed")
+            head, views = ser.serialize(err, error_type=ser.ERROR_OBJECT_LOST)
+            data = ser.to_flat_bytes(head, views)
+            with self._owned_lock:
+                if entry.state == "ready" and entry.data is None \
+                        and not entry.locations:
+                    entry.data = data
+                    entry.error = ser.ERROR_OBJECT_LOST
+                    entry.event.set()
+        finally:
+            with self._owned_lock:
+                entry.recovering = False
 
     # ------------------------------------------------------------- wait
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
@@ -1020,6 +1027,11 @@ class CoreWorker:
         dropped), matching the reference's lineage eviction
         (task_manager lineage footprint accounting)."""
         budget = CONFIG.lineage_max_bytes
+        # small rotation cap: this runs under _owned_lock on every task
+        # submission, so the scan must stay O(1) per call — rotation makes
+        # successive calls examine different entries, so progress past a
+        # pending head accumulates across submissions instead
+        rotations = min(16, len(self._lineage_order))
         while self._lineage_bytes > budget and self._lineage_order:
             tb = self._lineage_order[0]
             meta = self._lineage_meta.get(tb)
@@ -1027,10 +1039,16 @@ class CoreWorker:
                 self._lineage_order.popleft()
                 continue
             # never evict lineage of a task whose outputs are still pending
-            # (its spec is also the retry path for worker death)
+            # (its spec is also the retry path for worker death) — but
+            # rotate past it rather than stopping, so one long-running head
+            # task can't pin every completed task behind it over budget
             if any(self._owned[o].state == "pending"
                    for o in meta["slots"] if o in self._owned):
-                break
+                if rotations <= 0:
+                    break
+                rotations -= 1
+                self._lineage_order.rotate(-1)
+                continue
             self._lineage_order.popleft()
             meta["evicted"] = True
             self._lineage_bytes -= meta["size"]
@@ -1715,9 +1733,19 @@ class CoreWorker:
         if not locations:
             # every copy died with its node: recover (or resolve the entry
             # to ObjectLostError) off the RPC thread; the borrower keeps
-            # polling and picks up the recomputed value / error
-            threading.Thread(target=self._recover_or_fail,
-                             args=(oid, entry), daemon=True).start()
+            # polling and picks up the recomputed value / error. One
+            # recovery thread per entry — concurrent borrower polls (every
+            # 10 ms each) must not fan out redundant ones.
+            with self._owned_lock:
+                spawn = not entry.recovering
+                entry.recovering = True
+            if spawn:
+                try:
+                    threading.Thread(target=self._recover_or_fail,
+                                     args=(oid, entry), daemon=True).start()
+                except RuntimeError:  # thread exhaustion: let a later
+                    with self._owned_lock:  # borrower poll retry the spawn
+                        entry.recovering = False
             return None
         return {"locations": list(locations)}
 

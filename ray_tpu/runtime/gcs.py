@@ -1060,6 +1060,18 @@ class GcsClient:
                 if self._closed or (deadline is not None
                                     and time.monotonic() >= deadline):
                     raise
+                # A send-side OSError can surface as ConnectionError with
+                # the conn not yet marked closed (the reader thread closes
+                # it asynchronously); close it ourselves so _reconnect
+                # actually reconnects instead of no-opping, and so we
+                # don't busy-spin on the broken socket. NOTE: retrying
+                # re-sends RPCs that may already have been applied
+                # server-side — every GCS mutating RPC must stay
+                # idempotent (they key on caller-chosen ids, not counters).
+                try:
+                    conn.close()
+                except Exception:
+                    pass
                 try:
                     self._reconnect()
                 except (ConnectionError, OSError, rpc.RpcError,

@@ -436,14 +436,19 @@ class Raylet:
 
     def _restore_one(self, oid, size: int, meta: int, path: str) -> bool:
         from ray_tpu.exceptions import ObjectStoreFullError
-        try:
-            with open(path, "rb") as f:
-                data = f.read()
-        except FileNotFoundError:
-            return False
+        # Mark restoring BEFORE reading the file: _rpc_free_objects checks
+        # _restoring under the same lock, so either it sees us and defers
+        # the free (retried until the copy stays gone) or it unlinks first
+        # and our read fails — no window where a freed object is re-sealed
+        # into shm untracked.
         with self._lock:
             self._restoring.add(oid.binary())
         try:
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except FileNotFoundError:
+                return False
             try:
                 buf = self.store.create(oid, size, meta=meta,
                                         allow_evict=False)
@@ -497,11 +502,18 @@ class Raylet:
             deleted = self.store.delete(oid)
             with self._lock:
                 rec = self._spilled.pop(oid.binary(), None)
+                restoring = oid.binary() in self._restoring
             if rec is not None:
                 try:
                     os.unlink(self._spill_path(oid))
                 except FileNotFoundError:
                     pass
+            if restoring:
+                # a concurrent _restore_one may re-seal this object into
+                # shm after our delete; defer so the retry loop deletes
+                # whatever copy the restore produces
+                with self._lock:
+                    self._deferred_frees.add(oid.binary())
             elif not deleted and self.store.contains(oid):
                 # pinned right now (a reader, or _spill_one mid-handoff):
                 # the single free RPC must still win eventually
@@ -523,8 +535,13 @@ class Raylet:
                     os.unlink(self._spill_path(oid))
                 except FileNotFoundError:
                     pass
-            if not self.store.contains(oid):
-                with self._lock:
+            with self._lock:
+                # keep the entry while a restore is in flight: contains()
+                # is momentarily False while _restore_one reads the spill
+                # file, and dropping the free here would let the restore
+                # seal a zero-refcount object into shm permanently
+                if not self.store.contains(oid) \
+                        and ob not in self._restoring:
                     self._deferred_frees.discard(ob)
 
     # --------------------------------------------------------- memory / OOM
