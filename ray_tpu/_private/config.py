@@ -62,6 +62,16 @@ _declare("object_spill_fault", str, "",
          "Fault-injection seam for spill IO: 'unstable' fails every other "
          "spill write, 'slow' adds latency per spill (reference unstable/"
          "slow external-storage fakes, external_storage.py:587/608).")
+_declare("object_spill_uri", str, "",
+         "Storage URI objects spill to (file:// dir, mock:// for tests); "
+         "empty means the local spill dir. Fallback-allocated primaries "
+         "always stay on local disk, as in reference plasma "
+         "(external_storage.py:72 ExternalStorage seam).")
+_declare("object_spill_failure_rate", float, 0.0,
+         "Deterministic fraction of spill writes that fail (storage-layer "
+         "FlakyStorage; spilling retries on the next scan).")
+_declare("object_spill_slow_ms", float, 0.0,
+         "Injected latency per spill-storage operation in milliseconds.")
 _declare("object_transfer_chunk_bytes", int, 8 * 1024 * 1024,
          "Inter-node object pushes move in chunks of this size (bounds "
          "per-message memory; cf. reference object_manager chunked Push).")

@@ -10,10 +10,51 @@ the node that read them.
 from __future__ import annotations
 
 import glob as _glob
+import io
 import os
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+
+from ray_tpu._private import storage as _storage
+
+
+def _is_remote(path: str) -> bool:
+    """URI handled by the storage seam rather than the local filesystem.
+
+    ``file://`` strips to a plain path (read tasks run on any node; a
+    file:// URI means a shared/local filesystem, same as the reference's
+    default pyarrow LocalFileSystem). ``mock://`` is per-process memory —
+    fine for driver-side tests, not shared with remote read workers.
+    """
+    return _storage.is_uri(path) and _storage.parse_uri(path)[0] != "file"
+
+
+def _localize(path: str) -> str:
+    if _storage.is_uri(path) and _storage.parse_uri(path)[0] == "file":
+        return _storage.parse_uri(path)[1]
+    return path
+
+
+def _open(path: str, mode: str = "rb"):
+    """File-like opener for both local paths and storage URIs (reference
+    read_api.py threads a pyarrow ``filesystem`` through every reader;
+    here the seam yields whole-object readers)."""
+    if _is_remote(path):
+        buf = io.BytesIO(_storage.read_bytes(path))
+        return io.TextIOWrapper(buf) if "b" not in mode else buf
+    return open(path, mode)
+
+
+def _out_target(path: str, filename: str):
+    """-> (local_path_or_None, uri_or_None) for one output file under
+    ``path``: local destinations stream straight to disk, remote URIs
+    buffer and go through the seam."""
+    if _is_remote(path):
+        return None, _storage.join_uri(path, filename)
+    path = _localize(path)
+    os.makedirs(path, exist_ok=True)
+    return os.path.join(path, filename), None
 
 
 class ReadTask:
@@ -33,8 +74,18 @@ class ReadTask:
 def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
     if isinstance(paths, str):
         paths = [paths]
-    out: List[str] = []
+    files: List[str] = []
     for p in paths:
+        if _is_remote(p):
+            if _storage.exists(p):
+                files.append(p)
+                continue
+            rels = _storage.list_prefix(p)
+            files.extend(_storage.join_uri(p, r) for r in sorted(rels)
+                         if suffix is None or r.endswith(suffix))
+            continue
+        p = _localize(p)
+        out: List[str] = []
         if os.path.isdir(p):
             pat = os.path.join(p, "**", f"*{suffix}" if suffix else "*")
             out.extend(sorted(_glob.glob(pat, recursive=True)))
@@ -42,7 +93,7 @@ def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
             out.extend(sorted(_glob.glob(p)))
         else:
             out.append(p)
-    files = [f for f in out if os.path.isfile(f)]
+        files.extend(f for f in out if os.path.isfile(f))
     if not files:
         raise FileNotFoundError(f"no input files for {paths!r}")
     return files
@@ -77,6 +128,8 @@ def parquet_tasks(paths, columns: Optional[List[str]] = None) -> List[ReadTask]:
 
     def read_one(path: str):
         import pyarrow.parquet as pq
+        if _is_remote(path):
+            return pq.read_table(_open(path), columns=columns)
         return pq.read_table(path, columns=columns)
 
     return [ReadTask(lambda p=f: read_one(p), input_files=[f])
@@ -88,7 +141,8 @@ def csv_tasks(paths, **pandas_kwargs) -> List[ReadTask]:
 
     def read_one(path: str):
         import pandas as pd
-        return pd.read_csv(path, **pandas_kwargs)
+        return pd.read_csv(_open(path, "r") if _is_remote(path) else path,
+                           **pandas_kwargs)
 
     return [ReadTask(lambda p=f: read_one(p), input_files=[f])
             for f in files]
@@ -100,7 +154,7 @@ def json_tasks(paths, lines: bool = True) -> List[ReadTask]:
     def read_one(path: str):
         import json
         rows = []
-        with open(path) as fh:
+        with _open(path, "r") as fh:
             if lines:
                 for line in fh:
                     line = line.strip()
@@ -117,15 +171,15 @@ def json_tasks(paths, lines: bool = True) -> List[ReadTask]:
 
 def numpy_tasks(paths) -> List[ReadTask]:
     files = _expand_paths(paths, ".npy")
-    return [ReadTask(lambda p=f: {"data": np.load(p)}, input_files=[f])
-            for f in files]
+    return [ReadTask(lambda p=f: {"data": np.load(_open(p))},
+                     input_files=[f]) for f in files]
 
 
 def text_tasks(paths) -> List[ReadTask]:
     files = _expand_paths(paths)
 
     def read_one(path: str):
-        with open(path) as fh:
+        with _open(path, "r") as fh:
             return [line.rstrip("\n") for line in fh]
 
     return [ReadTask(lambda p=f: read_one(p), input_files=[f])
@@ -136,7 +190,7 @@ def binary_tasks(paths) -> List[ReadTask]:
     files = _expand_paths(paths)
 
     def read_one(path: str):
-        with open(path, "rb") as fh:
+        with _open(path, "rb") as fh:
             return [{"path": path, "bytes": fh.read()}]
 
     return [ReadTask(lambda p=f: read_one(p), input_files=[f])
@@ -164,7 +218,7 @@ def image_tasks(paths, *, size=None, mode: Optional[str] = None
             from PIL import Image
             imgs, names = [], []
             for f in chunk:
-                im = Image.open(f)
+                im = Image.open(_open(f))
                 if mode:
                     im = im.convert(mode)
                 if size:
@@ -187,42 +241,56 @@ def image_tasks(paths, *, size=None, mode: Optional[str] = None
 def write_parquet_block(block, path: str, idx: int) -> str:
     from ray_tpu.data.block import BlockAccessor
     import pyarrow.parquet as pq
-    os.makedirs(path, exist_ok=True)
     table = BlockAccessor.for_block(block).to_arrow()
-    out = os.path.join(path, f"part-{idx:05d}.parquet")
-    pq.write_table(table, out)
-    return out
+    local, uri = _out_target(path, f"part-{idx:05d}.parquet")
+    if local is not None:
+        pq.write_table(table, local)
+        return local
+    buf = io.BytesIO()
+    pq.write_table(table, buf)
+    _storage.write_bytes(uri, buf.getvalue())
+    return uri
 
 
 def write_csv_block(block, path: str, idx: int) -> str:
     from ray_tpu.data.block import BlockAccessor
-    os.makedirs(path, exist_ok=True)
     df = BlockAccessor.for_block(block).to_pandas()
-    out = os.path.join(path, f"part-{idx:05d}.csv")
-    df.to_csv(out, index=False)
-    return out
+    local, uri = _out_target(path, f"part-{idx:05d}.csv")
+    if local is not None:
+        df.to_csv(local, index=False)
+        return local
+    _storage.write_bytes(uri, df.to_csv(index=False).encode())
+    return uri
 
 
 def write_json_block(block, path: str, idx: int) -> str:
     import json
 
     from ray_tpu.data.block import BlockAccessor
-    os.makedirs(path, exist_ok=True)
     acc = BlockAccessor.for_block(block)
-    out = os.path.join(path, f"part-{idx:05d}.json")
-    with open(out, "w") as fh:
-        for row in acc.iter_rows():
-            fh.write(json.dumps(_jsonable(row)) + "\n")
-    return out
+    local, uri = _out_target(path, f"part-{idx:05d}.json")
+    if local is not None:
+        with open(local, "w") as fh:
+            for row in acc.iter_rows():
+                fh.write(json.dumps(_jsonable(row)) + "\n")
+        return local
+    lines = "".join(json.dumps(_jsonable(row)) + "\n"
+                    for row in acc.iter_rows())
+    _storage.write_bytes(uri, lines.encode())
+    return uri
 
 
 def write_numpy_block(block, path: str, idx: int, column: str) -> str:
     from ray_tpu.data.block import BlockAccessor
-    os.makedirs(path, exist_ok=True)
     arrs = BlockAccessor.for_block(block).to_numpy()
-    out = os.path.join(path, f"part-{idx:05d}.npy")
-    np.save(out, arrs[column])
-    return out
+    local, uri = _out_target(path, f"part-{idx:05d}.npy")
+    if local is not None:
+        np.save(local, arrs[column])
+        return local
+    buf = io.BytesIO()
+    np.save(buf, arrs[column])
+    _storage.write_bytes(uri, buf.getvalue())
+    return uri
 
 
 def _jsonable(row: Any) -> Any:

@@ -133,6 +133,25 @@ class Raylet:
             CONFIG.object_store_fallback_dir or session_dir,
             f"spill_{self.node_id.hex()[:12]}")
         os.makedirs(self._spill_dir, exist_ok=True)
+        # spill proper goes through the pluggable storage seam (URI-keyed;
+        # mock:// + fault wrappers in tests); fallback-allocated primaries
+        # written by clients stay plain local files in _spill_dir
+        from ray_tpu._private import storage as _storage
+        base = CONFIG.object_spill_uri or self._spill_dir
+        self._spill_store, self._spill_key_base = _storage.get_storage(base)
+        # one fault seam: the legacy object_spill_fault presets and the
+        # numeric knobs both wrap the backend in the same FlakyStorage
+        fail_rate = CONFIG.object_spill_failure_rate
+        slow_ms = CONFIG.object_spill_slow_ms
+        if CONFIG.object_spill_fault == "unstable":
+            fail_rate = max(fail_rate, 0.5)  # fail every other write
+        elif CONFIG.object_spill_fault == "slow":
+            slow_ms = max(slow_ms, 500.0)
+        if fail_rate or slow_ms:
+            self._spill_store = _storage.FlakyStorage(
+                self._spill_store, failure_rate=fail_rate, slow_ms=slow_ms)
+        self._fs_store = _storage.FileStorage()
+        self._fallback_local: set = set()  # oids whose bytes are local files
         self._spilled: Dict[bytes, Tuple[int, int]] = {}  # oid -> (size, meta)
         # frees that couldn't complete yet (object pinned, e.g. mid-spill);
         # retried by the spill loop so a free racing a spill can't leak the
@@ -324,6 +343,14 @@ class Raylet:
     def _spill_path(self, oid) -> str:
         return os.path.join(self._spill_dir, oid.hex())
 
+    def _spill_loc(self, oid):
+        """-> (storage, key) holding this object's spilled bytes."""
+        with self._lock:
+            fb = oid.binary() in self._fallback_local
+        if fb:
+            return self._fs_store, self._spill_path(oid)
+        return self._spill_store, f"{self._spill_key_base}/{oid.hex()}"
+
     def _spill_bytes(self, needed: int) -> int:
         """Spill LRU-first until ``needed`` bytes left shm (or no victims)."""
         with self._spill_mutex:
@@ -345,22 +372,19 @@ class Raylet:
         if res is None:
             return False
         buf, meta = res
-        path = self._spill_path(oid)
+        sstore, skey = self._spill_loc(oid)
         try:
-            fault = CONFIG.object_spill_fault
-            if fault == "slow":
-                time.sleep(0.5)
-            elif fault == "unstable":
-                self._spill_fault_tick = \
-                    getattr(self, "_spill_fault_tick", 0) + 1
-                if self._spill_fault_tick % 2 == 1:
-                    logger.warning("spill fault injection: dropping write "
-                                   "of %s", oid.hex()[:12])
-                    return False  # retried by the next scan
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(buf)
-            os.replace(tmp, path)
+            # pass the shm memoryview straight through: FileStorage
+            # streams it to disk without a heap copy (spilling fires
+            # exactly when memory is tight)
+            try:
+                sstore.write_bytes(skey, buf)
+            except OSError as e:
+                # flaky/full spill target: keep the shm copy, the next
+                # scan retries (reference spill IO error path)
+                logger.warning("spill write of %s failed: %s",
+                               oid.hex()[:12], e)
+                return False
         finally:
             buf.release()
             self.store.release(oid)
@@ -372,10 +396,7 @@ class Raylet:
             # pinned between release and delete: keep it in shm
             with self._lock:
                 self._spilled.pop(oid.binary(), None)
-            try:
-                os.unlink(path)
-            except FileNotFoundError:
-                pass
+            sstore.delete(skey)
             return False
         logger.debug("spilled %s (%d bytes)", oid.hex()[:12], size)
         return True
@@ -384,7 +405,10 @@ class Raylet:
         """Serve a chunk of a spilled object, racing safely against a
         concurrent restore (which removes the file and re-creates the shm
         copy): a None return is authoritative 'absent' to owners, so every
-        transient mid-handoff window must be retried, never reported."""
+        transient mid-handoff window must be retried, never reported —
+        and an exhausted run of flaky storage reads raises (the owner
+        maps a transport error to 'transient', never to lost)."""
+        io_error = None
         for _ in range(3):
             with self._lock:
                 rec = self._spilled.get(oid.binary())
@@ -400,27 +424,33 @@ class Raylet:
                     return None
                 return self._chunk_from_shm(oid, res, p)
             size, meta = rec
-            path = self._spill_path(oid)
             # restore into shm when it fits under the spill threshold
             # (reference LocalObjectManager restore / plasma re-create
             # path) so subsequent local gets are zero-copy again
             st = self.store.stats()
             if st["bytes_in_use"] + size <= \
                     CONFIG.object_spill_threshold * st["capacity"]:
-                if self._restore_one(oid, size, meta, path):
+                if self._restore_one(oid, size, meta):
                     # blocking get: a concurrent restorer may not have
                     # sealed yet
                     res = self.store.get(oid, timeout=2.0)
                     if res is not None:
                         return self._chunk_from_shm(oid, res, p)
                     continue
+            sstore, skey = self._spill_loc(oid)
             try:
-                with open(path, "rb") as f:
-                    f.seek(int(p.get("offset", 0)))
-                    data = f.read(int(p.get("length", size)))
+                data = sstore.read_bytes(skey, int(p.get("offset", 0)),
+                                         int(p.get("length", size)))
                 return {"total": size, "meta": meta, "data": data}
             except FileNotFoundError:
                 continue  # restored (or freed) under us: re-resolve
+            except OSError as e:
+                io_error = e
+                continue  # flaky storage read: retry
+        if io_error is not None:
+            raise rpc.RpcError(
+                f"spill storage read failed for {oid.hex()[:12]}: "
+                f"{io_error}")
         return None
 
     def _chunk_from_shm(self, oid, res, p) -> dict:
@@ -434,7 +464,7 @@ class Raylet:
             buf.release()
             self.store.release(oid)
 
-    def _restore_one(self, oid, size: int, meta: int, path: str) -> bool:
+    def _restore_one(self, oid, size: int, meta: int) -> bool:
         from ray_tpu.exceptions import ObjectStoreFullError
         # Mark restoring BEFORE reading the file: _rpc_free_objects checks
         # _restoring under the same lock, so either it sees us and defers
@@ -444,11 +474,13 @@ class Raylet:
         with self._lock:
             self._restoring.add(oid.binary())
         try:
+            sstore, skey = self._spill_loc(oid)
             try:
-                with open(path, "rb") as f:
-                    data = f.read()
+                data = sstore.read_bytes(skey)
             except FileNotFoundError:
                 return False
+            except OSError:
+                return False  # flaky storage read: fetch path retries
             try:
                 buf = self.store.create(oid, size, meta=meta,
                                         allow_evict=False)
@@ -463,10 +495,8 @@ class Raylet:
             self.store.seal(oid)
             with self._lock:
                 self._spilled.pop(oid.binary(), None)
-            try:
-                os.unlink(path)
-            except FileNotFoundError:
-                pass
+                self._fallback_local.discard(oid.binary())
+            sstore.delete(skey)
             logger.debug("restored %s (%d bytes)", oid.hex()[:12], size)
             return True
         finally:
@@ -483,6 +513,7 @@ class Raylet:
         from ray_tpu._private.ids import ObjectID
         oid = ObjectID(p["object_id"])
         with self._lock:
+            self._fallback_local.add(oid.binary())
             self._spilled[oid.binary()] = (int(p["size"]),
                                            int(p.get("meta", 0)))
         return {"ok": True}
@@ -500,14 +531,13 @@ class Raylet:
         for ob in p.get("object_ids", ()):
             oid = ObjectID(ob)
             deleted = self.store.delete(oid)
+            sstore, skey = self._spill_loc(oid)
             with self._lock:
                 rec = self._spilled.pop(oid.binary(), None)
+                self._fallback_local.discard(oid.binary())
                 restoring = oid.binary() in self._restoring
             if rec is not None:
-                try:
-                    os.unlink(self._spill_path(oid))
-                except FileNotFoundError:
-                    pass
+                sstore.delete(skey)
             if restoring:
                 # a concurrent _restore_one may re-seal this object into
                 # shm after our delete; defer so the retry loop deletes
@@ -528,13 +558,12 @@ class Raylet:
         for ob in pending:
             oid = ObjectID(ob)
             self.store.delete(oid)
+            sstore, skey = self._spill_loc(oid)
             with self._lock:
                 rec = self._spilled.pop(ob, None)
+                self._fallback_local.discard(ob)
             if rec is not None:
-                try:
-                    os.unlink(self._spill_path(oid))
-                except FileNotFoundError:
-                    pass
+                sstore.delete(skey)
             with self._lock:
                 # keep the entry while a restore is in flight: contains()
                 # is momentarily False while _restore_one reads the spill

@@ -39,6 +39,12 @@ class Searcher:
                           error: bool = False) -> None:
         pass
 
+    def advance_restored(self, trial_id: str, live: bool) -> None:
+        """Experiment restore: advance a deterministic searcher past a
+        config that was already handed out before the restart (the stored
+        config is reused; the suggestion is discarded)."""
+        self.suggest(trial_id)
+
 
 class BasicVariantGenerator(Searcher):
     """Grid × random expansion of the param space, computed up front."""
@@ -116,6 +122,13 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id, result=None, error=False):
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result, error)
+
+    def advance_restored(self, trial_id, live):
+        # bypass the cap (restored trials already exist) but keep the
+        # in-flight ledger honest for the ones about to run again
+        self.searcher.advance_restored(trial_id, live)
+        if live:
+            self._live.add(trial_id)
 
 
 def _gridless(space: Dict[str, Any]) -> Dict[str, Any]:

@@ -18,8 +18,9 @@ ERROR = "ERROR"
 
 class Trial:
     def __init__(self, config: Dict[str, Any], experiment_dir: str,
-                 resources: Optional[Dict[str, float]] = None):
-        self.trial_id = uuid.uuid4().hex[:8]
+                 resources: Optional[Dict[str, float]] = None,
+                 trial_id: Optional[str] = None):
+        self.trial_id = trial_id or uuid.uuid4().hex[:8]
         self.config = config
         self.resources = dict(resources or {"CPU": 1.0})
         self.status = PENDING

@@ -93,15 +93,27 @@ class TrialRunner:
     """Drives all trials of one experiment to completion."""
 
     def __init__(self, trainable: Callable, param_space: Dict[str, Any],
-                 tune_config: TuneConfig, run_config: RunConfig):
+                 tune_config: TuneConfig, run_config: RunConfig,
+                 restore_path: Optional[str] = None,
+                 resume_errored: bool = False):
+        import tempfile
         import ray_tpu
+        from ray_tpu.tune.syncer import Syncer, resolve_storage
         self.trainable = trainable
         self.tune_config = tune_config
         self.run_config = run_config
-        self.experiment_dir = os.path.join(
-            run_config.storage_path,
-            run_config.name or f"tune_{time.strftime('%Y%m%d_%H%M%S')}")
+        self._resume_errored = resume_errored
+        staging = os.path.join(tempfile.gettempdir(), "ray_tpu_tune_staging")
+        if restore_path is not None:
+            self._init_restore(restore_path, staging)
+        else:
+            name = run_config.name \
+                or f"tune_{time.strftime('%Y%m%d_%H%M%S')}"
+            self.experiment_dir, self._sync_uri = resolve_storage(
+                run_config.storage_path, name, staging)
         os.makedirs(self.experiment_dir, exist_ok=True)
+        self._syncer = Syncer(self.experiment_dir, self._sync_uri) \
+            if self._sync_uri else None
 
         self.searcher = tune_config.search_alg or BasicVariantGenerator(
             param_space, num_samples=tune_config.num_samples,
@@ -133,6 +145,101 @@ class TrialRunner:
         self._csv_fields: Optional[List[str]] = None
         self.callbacks = list(run_config.callbacks or [])
         self._iteration = 0
+        if restore_path is not None:
+            self._apply_restore_state()
+
+    # -- experiment persistence / restore ---------------------------------
+    # (reference tune resume: experiment_state-*.json written by the
+    # TrialRunner checkpointer, trial_runner.py:962 checkpoint(); here one
+    # experiment_state.json + per-trial checkpoint.pkl, synced via Syncer)
+    def _init_restore(self, restore_path: str, staging: str) -> None:
+        from ray_tpu._private import storage as _storage
+        if _storage.is_uri(restore_path):
+            name = restore_path.rstrip("/").rsplit("/", 1)[-1]
+            self.experiment_dir = os.path.join(staging, name)
+            self._sync_uri = restore_path
+            _storage.download_dir(restore_path, self.experiment_dir)
+        else:
+            self.experiment_dir = restore_path
+            self._sync_uri = None
+        state_path = os.path.join(self.experiment_dir,
+                                  "experiment_state.json")
+        if not os.path.exists(state_path):
+            raise TuneError(f"no experiment_state.json under "
+                            f"{restore_path!r}; nothing to restore")
+        with open(state_path) as f:
+            self._restore_state = json.load(f)
+
+    def _apply_restore_state(self) -> None:
+        state = self._restore_state
+        # append to the prior run's progress.csv instead of truncating it
+        if os.path.exists(self._csv_path):
+            with open(self._csv_path) as f:
+                header = f.readline().strip()
+            if header:
+                self._csv_fields = header.split(",")
+        for ts in state.get("trials", []):
+            t = Trial(ts["config"], self.experiment_dir,
+                      resources=self.tune_config.trial_resources,
+                      trial_id=ts["trial_id"])
+            t.last_result = ts.get("last_result", {})
+            if t.last_result:
+                t.results.append(t.last_result)
+            t.num_failures = ts.get("num_failures", 0)
+            t.error = ts.get("error")
+            status = ts["status"]
+            # a trial that was mid-flight resumes from its checkpoint
+            terminal = (TERMINATED,) if self._resume_errored \
+                else (TERMINATED, ERROR)
+            t.status = status if status in terminal else PENDING
+            if status == ERROR and t.status == PENDING:
+                t.error = None
+                t.num_failures = 0
+            ckpt_path = os.path.join(t.logdir, "checkpoint.pkl")
+            if os.path.exists(ckpt_path):
+                with open(ckpt_path, "rb") as f:
+                    t.checkpoint = Checkpoint.from_bytes(f.read())
+            self.trials.append(t)
+            # deterministic searchers re-derive their sequence: advance
+            # them past configs already handed out before the restart
+            try:
+                self.searcher.advance_restored(t.trial_id,
+                                               t.status == PENDING)
+            except Exception:
+                pass
+            self.scheduler.on_trial_add(self, t)
+            if t.status in (TERMINATED, ERROR):
+                self.searcher.on_trial_complete(
+                    t.trial_id, t.last_result or None,
+                    error=t.status == ERROR)
+        self._iteration = state.get("iteration", 0)
+
+    def _save_experiment_state(self) -> None:
+        trials = []
+        for t in self.trials:
+            trials.append({
+                "trial_id": t.trial_id, "config": t.config,
+                "status": t.status, "last_result": t.last_result,
+                "num_failures": t.num_failures, "error": t.error,
+            })
+            if t.checkpoint is not None \
+                    and getattr(t, "_saved_ckpt", None) is not t.checkpoint:
+                try:
+                    blob = t.checkpoint.to_bytes()
+                except Exception:
+                    continue
+                tmp = os.path.join(t.logdir, ".checkpoint.tmp")
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, os.path.join(t.logdir, "checkpoint.pkl"))
+                t._saved_ckpt = t.checkpoint
+        state = {"name": os.path.basename(self.experiment_dir),
+                 "iteration": self._iteration, "trials": trials}
+        tmp = os.path.join(self.experiment_dir, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, default=str)
+        os.replace(tmp, os.path.join(self.experiment_dir,
+                                     "experiment_state.json"))
 
     # -- trial lifecycle ---------------------------------------------------
     def _make_trial(self) -> Optional[Trial]:
@@ -204,9 +311,17 @@ class TrialRunner:
         """One scheduling round; returns False when the experiment is done."""
         import ray_tpu
 
-        # launch new/paused trials up to the concurrency cap
+        # launch new/paused trials up to the concurrency cap; restored
+        # PENDING trials (restart-from-checkpoint) go first
         live = [t for t in self.trials if t.status == RUNNING]
         while len(live) < self.max_concurrent:
+            restored = next((t for t in self.trials
+                             if t.status == PENDING and t.actor is None),
+                            None)
+            if restored is not None:
+                self._start_trial(restored)
+                live.append(restored)
+                continue
             paused = self.scheduler.choose_trial_to_run(self)
             if paused is not None:
                 self._start_trial(paused)
@@ -322,6 +437,12 @@ class TrialRunner:
     def run(self) -> List[Result]:
         while self.step():
             self._iteration += 1
+            self._save_experiment_state()
+            if self._syncer is not None:
+                self._syncer.sync_up()
+        self._save_experiment_state()
+        if self._syncer is not None:
+            self._syncer.sync_up(force=True)
         for cb in self.callbacks:
             cb.on_experiment_end(self.trials)
         out = []
@@ -347,10 +468,30 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restore_path: Optional[str] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, *,
+                param_space: Optional[Dict[str, Any]] = None,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None,
+                resume_errored: bool = False) -> "Tuner":
+        """Resume an experiment from a local dir or storage URI (reference
+        tuner.py Tuner.restore): finished trials keep their results,
+        interrupted ones restart from their last synced checkpoint;
+        ``resume_errored`` also restarts trials that had failed."""
+        tuner = cls(trainable, param_space=param_space,
+                    tune_config=tune_config, run_config=run_config)
+        tuner._restore_path = path
+        tuner._resume_errored = resume_errored
+        return tuner
 
     def fit(self) -> ResultGrid:
         runner = TrialRunner(self.trainable, self.param_space,
-                             self.tune_config, self.run_config)
+                             self.tune_config, self.run_config,
+                             restore_path=self._restore_path,
+                             resume_errored=getattr(
+                                 self, "_resume_errored", False))
         results = runner.run()
         return ResultGrid(results, runner.trials,
                           self.tune_config.metric, self.tune_config.mode)

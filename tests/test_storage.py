@@ -1,0 +1,158 @@
+"""Pluggable storage seam tests (cf. reference test_object_spilling.py's
+unstable-storage cases and air/_internal remote_storage tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import storage
+
+
+@pytest.fixture(autouse=True)
+def _clean_mock():
+    storage.MemoryStorage.clear()
+    yield
+    storage.MemoryStorage.clear()
+
+
+def test_file_and_mock_roundtrip(tmp_path):
+    for base in (f"file://{tmp_path}/x", "mock://ns/x"):
+        uri = storage.join_uri(base, "a", "b.bin")
+        assert not storage.exists(uri)
+        storage.write_bytes(uri, b"hello world")
+        assert storage.exists(uri)
+        assert storage.read_bytes(uri) == b"hello world"
+        assert storage.read_bytes(uri, offset=6) == b"world"
+        assert storage.read_bytes(uri, offset=0, length=5) == b"hello"
+        assert storage.list_prefix(base) == ["a/b.bin"]
+        assert storage.delete_uri(uri)
+        assert not storage.exists(uri)
+        with pytest.raises(FileNotFoundError):
+            storage.read_bytes(uri)
+
+
+def test_upload_download_dir(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "top.txt").write_bytes(b"t")
+    (src / "sub" / "leaf.txt").write_bytes(b"l")
+    assert storage.upload_dir(str(src), "mock://exp/run1") == 2
+    assert storage.list_prefix("mock://exp/run1") == \
+        ["sub/leaf.txt", "top.txt"]
+    dest = tmp_path / "dest"
+    assert storage.download_dir("mock://exp/run1", str(dest)) == 2
+    assert (dest / "top.txt").read_bytes() == b"t"
+    assert (dest / "sub" / "leaf.txt").read_bytes() == b"l"
+
+
+def test_flaky_storage_is_deterministic():
+    flaky = storage.FlakyStorage(storage.MemoryStorage(), failure_rate=0.3)
+    outcomes = []
+    for i in range(100):
+        try:
+            flaky.write_bytes(f"k{i}", b"v")
+            outcomes.append(True)
+        except OSError:
+            outcomes.append(False)
+    assert outcomes.count(False) == 30  # exactly the configured rate
+    # reads unaffected unless fail_reads
+    assert flaky.read_bytes("k0") == b"v"
+
+
+def test_unknown_scheme_rejected():
+    with pytest.raises(ValueError, match="unsupported storage scheme"):
+        storage.read_bytes("s3://nope/x")
+
+
+def test_spill_through_mock_uri():
+    """Objects spill to mock:// storage inside the raylet and round-trip
+    (the spill consumer of the seam; reference external_storage.py:72)."""
+    ray_tpu.init(system_config={
+        "object_store_memory_bytes": 32 * 1024 * 1024,
+        "object_spill_uri": "mock://spill_test",
+    })
+    try:
+        refs = [ray_tpu.put(np.full((1 << 20,), i, dtype=np.uint8))
+                for i in range(80)]  # 80 MB >> 32 MB store
+        for i, r in enumerate(refs):
+            assert ray_tpu.get(r)[0] == i
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_spill_survives_flaky_backend():
+    """30% of spill writes fail; the scan retries and the working set
+    still round-trips (reference UnstableFileStorage chaos case)."""
+    ray_tpu.init(system_config={
+        "object_store_memory_bytes": 32 * 1024 * 1024,
+        "object_spill_failure_rate": 0.3,
+    })
+    try:
+        refs = [ray_tpu.put(np.full((1 << 20,), i, dtype=np.uint8))
+                for i in range(80)]
+        for i, r in enumerate(refs):
+            assert ray_tpu.get(r)[0] == i
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_tune_sync_to_mock_and_restore(ray_start_regular):
+    """A Tune run syncs its experiment to mock:// storage; after the local
+    staging dir is wiped, Tuner.restore resumes errored trials from the
+    synced checkpoint (the Tune consumer of the seam; reference
+    tune/syncer.py:185 + Tuner.restore)."""
+    import shutil
+    from ray_tpu.air import Checkpoint, RunConfig, session
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    def flaky(config):
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["i"] + 1 if ckpt else 0
+        for i in range(start, 4):
+            if i == 2 and start == 0:
+                raise RuntimeError("interrupted")
+            session.report({"i": i},
+                           checkpoint=Checkpoint.from_dict({"i": i}))
+
+    run_cfg = RunConfig(name="exp_sync", storage_path="mock://tune_exps")
+    grid = Tuner(flaky, param_space={},
+                 tune_config=TuneConfig(metric="i", mode="max"),
+                 run_config=run_cfg).fit()
+    assert len(grid.errors) == 1  # first run dies at i==2
+
+    # everything needed to resume lives under the URI
+    synced = storage.list_prefix("mock://tune_exps/exp_sync")
+    assert "experiment_state.json" in synced
+    assert any(s.endswith("checkpoint.pkl") for s in synced)
+
+    # wipe local staging: restore must come from the mock store alone
+    import tempfile
+    shutil.rmtree(os.path.join(tempfile.gettempdir(),
+                               "ray_tpu_tune_staging", "exp_sync"),
+                  ignore_errors=True)
+
+    grid2 = Tuner.restore("mock://tune_exps/exp_sync", flaky,
+                          tune_config=TuneConfig(metric="i", mode="max"),
+                          resume_errored=True).fit()
+    assert not grid2.errors
+    # resumed from the synced i=1 checkpoint (start=2), not from scratch
+    assert grid2.get_best_result().metrics["i"] == 3
+
+
+def test_data_read_write_uri(ray_start_regular, tmp_path):
+    """data.write_*/read_* against storage URIs (the Data consumer of the
+    seam; reference read_api.py:429 read_parquet(filesystem=...))."""
+    from ray_tpu import data
+
+    ds = data.range(100, parallelism=4)
+    out_uri = f"file://{tmp_path}/ds_out"
+    ds.write_parquet(out_uri)
+    back = data.read_parquet(out_uri)
+    assert back.count() == 100
+    assert sorted(r["id"] for r in back.take_all()) == list(range(100))
+
+    csv_uri = f"file://{tmp_path}/ds_csv"
+    ds.write_csv(csv_uri)
+    back_csv = data.read_csv(csv_uri)
+    assert back_csv.count() == 100
