@@ -8,8 +8,8 @@ attention, JaxTrainer, datasets, tuning, RL, and serving.
 from ray_tpu._private.config import CONFIG  # noqa: F401
 from ray_tpu.actor import get_actor, kill, method  # noqa: F401
 from ray_tpu.api import (available_resources, cluster_resources, context,  # noqa: F401
-                         get, init, is_initialized, nodes, put, remote,
-                         shutdown, wait)
+                         get, get_runtime_context, init, is_initialized,
+                         nodes, put, remote, shutdown, wait)
 from ray_tpu.runtime.core_worker import (ObjectRef,  # noqa: F401
                                          ObjectRefGenerator)
 
@@ -18,6 +18,6 @@ __version__ = "0.1.0"
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "get_actor", "kill", "nodes", "cluster_resources",
-    "available_resources", "context", "ObjectRef", "ObjectRefGenerator",
-    "CONFIG", "__version__",
+    "available_resources", "context", "get_runtime_context", "ObjectRef",
+    "ObjectRefGenerator", "CONFIG", "__version__",
 ]

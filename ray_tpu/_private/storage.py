@@ -218,16 +218,67 @@ class FlakyStorage(Storage):
 
 class _GcsStorage(Storage):
     """gs:// behind an optional import; the hermetic TPU image has no
-    cloud SDK, so this stays a clear-error seam until one is present."""
+    cloud SDK, so on most boxes construction raises a clear error. With
+    the SDK present, keys are ``bucket/path`` and ops map to blob
+    upload/download; SDK errors surface as OSError so consumers' retry
+    paths (spill scan, syncer) treat them as transient IO."""
 
     def __init__(self):
         try:
-            from google.cloud import storage as gcs  # noqa: F401
+            from google.cloud import storage as gcs
         except ImportError:
             raise ImportError(
                 "gs:// URIs need the google-cloud-storage package, which "
                 "is not in this image; use file:// or mock://, or install "
                 "it in your own environment") from None
+        self._client = gcs.Client()
+
+    def _blob(self, key: str):
+        bucket, _, path = key.partition("/")
+        return self._client.bucket(bucket).blob(path)
+
+    def write_bytes(self, key: str, data) -> None:
+        try:
+            self._blob(key).upload_from_string(bytes(data))
+        except Exception as e:
+            raise OSError(f"gs write failed for {key}: {e}") from e
+
+    def read_bytes(self, key: str, offset: int = 0,
+                   length: Optional[int] = None) -> bytes:
+        end = None if length is None else offset + length - 1
+        try:
+            return self._blob(key).download_as_bytes(
+                start=offset or None, end=end)
+        except Exception as e:
+            if getattr(e, "code", None) == 404:
+                raise FileNotFoundError(f"gs://{key}") from None
+            raise OSError(f"gs read failed for {key}: {e}") from e
+
+    def delete(self, key: str, missing_ok: bool = True) -> bool:
+        try:
+            self._blob(key).delete()
+            return True
+        except Exception as e:
+            if getattr(e, "code", None) == 404:
+                if not missing_ok:
+                    raise FileNotFoundError(f"gs://{key}") from None
+                return False
+            raise OSError(f"gs delete failed for {key}: {e}") from e
+
+    def exists(self, key: str) -> bool:
+        try:
+            return self._blob(key).exists()
+        except Exception as e:
+            raise OSError(f"gs exists failed for {key}: {e}") from e
+
+    def list_prefix(self, key: str) -> List[str]:
+        bucket, _, path = key.partition("/")
+        pre = path.rstrip("/") + "/"
+        try:
+            blobs = self._client.list_blobs(bucket, prefix=pre)
+            return sorted(b.name[len(pre):] for b in blobs)
+        except Exception as e:
+            raise OSError(f"gs list failed for {key}: {e}") from e
 
 
 _REGISTRY: Dict[str, Storage] = {}

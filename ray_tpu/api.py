@@ -180,6 +180,58 @@ def context() -> Dict[str, Any]:
     }
 
 
+class RuntimeContext:
+    """Where-am-I API for driver/task/actor code (reference
+    runtime_context.py:13 ``RuntimeContext``: get_node_id/get_job_id/
+    get_task_id/get_actor_id + the dict form via .get())."""
+
+    def __init__(self, worker):
+        self._worker = worker
+
+    def get(self) -> Dict[str, Any]:
+        out = {"node_id": self.node_id, "job_id": self.job_id}
+        if self.task_id is not None:
+            out["task_id"] = self.task_id
+        if self.actor_id is not None:
+            out["actor_id"] = self.actor_id
+        return out
+
+    @property
+    def node_id(self) -> str:
+        return self._worker.node_id
+
+    @property
+    def job_id(self) -> str:
+        return self._worker.job_id.hex()
+
+    @property
+    def task_id(self) -> Optional[str]:
+        tid = getattr(self._worker, "current_task_id", None)
+        return tid.hex() if tid is not None else None
+
+    @property
+    def actor_id(self) -> Optional[str]:
+        aid = getattr(self._worker, "current_actor_id", None)
+        return aid if isinstance(aid, (str, type(None))) else aid.hex()
+
+    # reference get_* accessors
+    def get_node_id(self) -> str:
+        return self.node_id
+
+    def get_job_id(self) -> str:
+        return self.job_id
+
+    def get_task_id(self) -> Optional[str]:
+        return self.task_id
+
+    def get_actor_id(self) -> Optional[str]:
+        return self.actor_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(cw.get_global_worker())
+
+
 def _client():
     """Active remote-driver context, if init was called with client://."""
     from ray_tpu.util import client as client_mod

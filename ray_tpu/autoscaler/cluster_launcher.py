@@ -1,0 +1,460 @@
+"""Cluster launcher: ``ray-tpu up/down/attach/exec/submit`` from a YAML.
+
+Analog of /root/reference/python/ray/scripts/scripts.py:1161 (``ray up``),
+autoscaler/_private/commands.py (create_or_update_cluster / teardown /
+exec / attach / rsync) and the ray-schema.json cluster YAML.  The
+operator story it completes: the TpuPodSliceProvider can create slices,
+this module installs and starts raylets on them.
+
+Layout of a cluster YAML (see examples/cluster.yaml):
+
+    cluster_name: demo
+    provider: {type: local|tpu|fake, zone: ..., project: ..., dry_run: ...}
+    auth: {ssh_user: ..., ssh_private_key: ...}
+    available_node_types:
+      head: {resources: {CPU: 4}, hosts_per_node: 1,
+             min_workers: 0, max_workers: 0}
+      v4_32: {node_config: {accelerator_type: v4-32},
+              resources: {CPU: 8, TPU: 4}, hosts_per_node: 4,
+              min_workers: 1, max_workers: 4}
+    head_node_type: head
+    file_mounts: {remote_path: local_path}
+    initialization_commands: [...]
+    setup_commands: [...]            # + head_/worker_ variants
+    head_start_ray_commands: ["... start --head --port={port}"]
+    worker_start_ray_commands: ["... start --address={head_address}"]
+
+Cross-invocation state (which nodes exist, the head address, per-node
+session dirs) persists in ``~/.ray_tpu/clusters/<name>.json`` (override
+dir via RAY_TPU_CLUSTER_STATE_DIR) so ``down``/``exec``/``submit`` work
+from a fresh process, the same way the reference keeps cluster state
+under ``~/.ray``.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import socket
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.autoscaler.command_runner import (CommandRunnerInterface,
+                                               LocalCommandRunner,
+                                               SSHCommandRunner,
+                                               TpuVmCommandRunner)
+from ray_tpu.autoscaler.node_provider import get_node_provider
+from ray_tpu.autoscaler.updater import NodeUpdater
+
+DEFAULT_HEAD_PORT = 6380
+
+
+class ClusterConfigError(ValueError):
+    pass
+
+
+# ----------------------------------------------------------------- config
+_TOP_DEFAULTS: Dict[str, Any] = {
+    "max_workers": 8,
+    "auth": {},
+    "file_mounts": {},
+    "initialization_commands": [],
+    "setup_commands": [],
+    "head_setup_commands": [],
+    "worker_setup_commands": [],
+    "head_start_ray_commands": [],
+    "worker_start_ray_commands": [],
+    "stop_ray_commands": [],
+    "env": {},     # exported into every launcher-run command on every node
+    "python": "python3",   # interpreter on REMOTE nodes (local uses sys.executable)
+}
+_NODE_TYPE_DEFAULTS: Dict[str, Any] = {
+    "node_config": {},
+    "resources": {},
+    "hosts_per_node": 1,
+    "min_workers": 0,
+    "max_workers": 1,
+}
+
+
+def load_cluster_config(path: str) -> Dict[str, Any]:
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    return validate_cluster_config(cfg)
+
+
+def validate_cluster_config(cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """Schema check + defaults (reference ray-schema.json / prepare_config).
+    Raises ClusterConfigError with a field-level message on problems."""
+    if not isinstance(cfg, dict):
+        raise ClusterConfigError("cluster config must be a mapping")
+    cfg = copy.deepcopy(cfg)
+    for field in ("cluster_name", "provider", "available_node_types",
+                  "head_node_type"):
+        if field not in cfg:
+            raise ClusterConfigError(f"missing required field {field!r}")
+    if not isinstance(cfg["provider"], dict) or "type" not in cfg["provider"]:
+        raise ClusterConfigError("provider must be a mapping with a 'type'")
+    for k, v in _TOP_DEFAULTS.items():
+        cfg.setdefault(k, copy.deepcopy(v))
+    types = cfg["available_node_types"]
+    if not isinstance(types, dict) or not types:
+        raise ClusterConfigError("available_node_types must be a non-empty "
+                                 "mapping")
+    for name, nt in types.items():
+        if not isinstance(nt, dict):
+            raise ClusterConfigError(f"node type {name!r} must be a mapping")
+        for k, v in _NODE_TYPE_DEFAULTS.items():
+            nt.setdefault(k, copy.deepcopy(v))
+        if nt["min_workers"] > nt["max_workers"]:
+            raise ClusterConfigError(
+                f"node type {name!r}: min_workers > max_workers")
+    head = cfg["head_node_type"]
+    if head not in types:
+        raise ClusterConfigError(
+            f"head_node_type {head!r} not in available_node_types "
+            f"({sorted(types)})")
+    unknown_cmds = [k for k in cfg if k.endswith("_commands")
+                    and k not in _TOP_DEFAULTS]
+    if unknown_cmds:
+        raise ClusterConfigError(f"unknown command sections: {unknown_cmds}")
+    return cfg
+
+
+# ------------------------------------------------------------ local state
+def _state_dir() -> str:
+    d = os.environ.get("RAY_TPU_CLUSTER_STATE_DIR") or \
+        os.path.expanduser("~/.ray_tpu/clusters")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _state_path(cluster_name: str) -> str:
+    return os.path.join(_state_dir(), f"{cluster_name}.json")
+
+
+def load_cluster_state(cluster_name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_state_path(cluster_name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _save_cluster_state(state: Dict[str, Any]) -> None:
+    path = _state_path(state["cluster_name"])
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _delete_cluster_state(cluster_name: str) -> None:
+    try:
+        os.unlink(_state_path(cluster_name))
+    except FileNotFoundError:
+        pass
+
+
+# ---------------------------------------------------------------- runners
+def _make_runner(cfg: Dict[str, Any], node: Dict[str, Any],
+                 worker_index: int = 0, *,
+                 dry_run: bool = False) -> CommandRunnerInterface:
+    """Runner for host ``worker_index`` of one launch unit."""
+    ptype = cfg["provider"]["type"]
+    dry = dry_run or bool(cfg["provider"].get("dry_run"))
+    if ptype in ("tpu", "gce-tpu"):
+        return TpuVmCommandRunner(
+            node["node_id"], worker_index,
+            zone=cfg["provider"].get("zone", "us-central2-b"),
+            project=cfg["provider"].get("project"), dry_run=dry)
+    ip = node.get("ip", "127.0.0.1")
+    if ip in ("127.0.0.1", "localhost"):
+        return LocalCommandRunner(dry_run=dry)
+    auth = cfg.get("auth", {})
+    return SSHCommandRunner(ip, ssh_user=auth.get("ssh_user", "ubuntu"),
+                            ssh_key=auth.get("ssh_private_key"),
+                            dry_run=dry)
+
+
+def _fill(commands: List[str], subs: Dict[str, str]) -> List[str]:
+    out = []
+    for c in commands:
+        for k, v in subs.items():
+            c = c.replace("{" + k + "}", str(v))
+        out.append(c)
+    return out
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# --------------------------------------------------------------------- up
+def create_or_update_cluster(config_path: str, *, dry_run: bool = False,
+                             no_start_workers: bool = False,
+                             _print=print) -> Dict[str, Any]:
+    """``ray-tpu up``: create the head launch unit, bootstrap it, then
+    bring up every node type's min_workers.  Returns the cluster state.
+
+    ``dry_run`` forces every provider call and command into record-only
+    mode and prints the plan instead of executing it."""
+    cfg = load_cluster_config(config_path)
+    name = cfg["cluster_name"]
+    provider_cfg = dict(cfg["provider"])
+    if dry_run:
+        provider_cfg["dry_run"] = True
+    provider = get_node_provider(provider_cfg, name)
+    types = cfg["available_node_types"]
+    head_type = cfg["head_node_type"]
+    ht = types[head_type]
+
+    state: Dict[str, Any] = {
+        "cluster_name": name, "config_path": os.path.abspath(config_path),
+        "provider": provider_cfg, "head": None, "workers": [],
+        "created_at": time.time(),
+    }
+
+    # -- head ---------------------------------------------------------------
+    _print(f"[{name}] launching head node ({head_type})...")
+    head_rec = provider.create_node(head_type, ht["node_config"],
+                                    ht["resources"], ht["hosts_per_node"],
+                                    {"ray-cluster-name": name,
+                                     "ray-node-kind": "head"})
+    head_ip = head_rec.tags.get("ip", head_rec.node_id)
+    if cfg["provider"].get("head_port"):
+        port = int(cfg["provider"]["head_port"])
+    elif head_ip in ("127.0.0.1", "localhost"):
+        port = _free_port()   # shared machine: avoid collisions
+    else:
+        port = DEFAULT_HEAD_PORT
+    head_address = f"{head_ip}:{port}"
+    subs = {"port": port, "head_address": head_address}
+
+    head_node = {"node_id": head_rec.node_id, "ip": head_ip,
+                 "node_type": head_type, "session_dirs": []}
+    runner = _make_runner(cfg, head_node, 0, dry_run=dry_run)
+    upd = NodeUpdater(
+        head_rec.node_id, runner,
+        file_mounts=cfg["file_mounts"],
+        initialization_commands=_fill(cfg["initialization_commands"], subs),
+        setup_commands=_fill(cfg["setup_commands"]
+                             + cfg["head_setup_commands"], subs),
+        start_commands=_fill(cfg["head_start_ray_commands"], subs),
+        env={**cfg["env"], "RAY_TPU_HEAD_ADDRESS": head_address})
+    state["head"] = head_node
+    state["head_address"] = head_address
+    if not dry_run:
+        # persist before bootstrapping: a failure anywhere below must
+        # leave `ray-tpu down` a teardown path to the created nodes
+        _save_cluster_state(state)
+    try:
+        upd.update()
+    except Exception:
+        if not dry_run:
+            _save_cluster_state(state)
+        raise
+    if upd.session_dir:
+        head_node["session_dirs"].append(upd.session_dir)
+    if not dry_run:
+        _save_cluster_state(state)
+    runners = [(head_rec.node_id, 0, runner)]
+
+    # -- workers ------------------------------------------------------------
+    updaters: List[NodeUpdater] = []
+    if not no_start_workers:
+        for tname, nt in types.items():
+            if tname == head_type:
+                continue
+            for _ in range(nt["min_workers"]):
+                rec = provider.create_node(
+                    tname, nt["node_config"], nt["resources"],
+                    nt["hosts_per_node"],
+                    {"ray-cluster-name": name, "ray-node-kind": "worker"})
+                wnode = {"node_id": rec.node_id,
+                         "ip": rec.tags.get("ip", rec.node_id),
+                         "node_type": tname,
+                         "hosts": nt["hosts_per_node"],
+                         "session_dirs": []}
+                for host_i in range(nt["hosts_per_node"]):
+                    wrunner = _make_runner(cfg, wnode, host_i,
+                                           dry_run=dry_run)
+                    wupd = NodeUpdater(
+                        f"{rec.node_id}#{host_i}", wrunner,
+                        file_mounts=cfg["file_mounts"],
+                        initialization_commands=_fill(
+                            cfg["initialization_commands"], subs),
+                        setup_commands=_fill(
+                            cfg["setup_commands"]
+                            + cfg["worker_setup_commands"], subs),
+                        start_commands=_fill(
+                            cfg["worker_start_ray_commands"], subs),
+                        env={**cfg["env"],
+                             "RAY_TPU_HEAD_ADDRESS": head_address})
+                    wupd.start()   # one thread per host, like the reference
+                    updaters.append(wupd)
+                    runners.append((rec.node_id, host_i, wrunner))
+                state["workers"].append(wnode)
+                if not dry_run:
+                    _save_cluster_state(state)  # nodes exist: make down work
+        failed = None
+        for wupd, wnode in zip(
+                updaters,
+                [w for w in state["workers"]
+                 for _ in range(w["hosts"])]):
+            wupd.join()
+            if wupd.status == "failed" and failed is None:
+                failed = f"worker bootstrap failed on {wupd.node_id}: " \
+                         f"{wupd.error}"
+            if wupd.session_dir:
+                wnode["session_dirs"].append(wupd.session_dir)
+        if not dry_run:
+            _save_cluster_state(state)  # record every session dir started
+        if failed is not None:
+            raise RuntimeError(
+                failed + f"\n(tear down with: ray-tpu down {config_path})")
+
+    if dry_run:
+        _print(f"[{name}] DRY RUN — planned operations:")
+        for call in getattr(provider, "calls", []):
+            _print("  provider: " + " ".join(call))
+        for nid, host_i, r in runners:
+            for call in getattr(r, "calls", []):
+                _print(f"  {nid}#{host_i}: {call}")
+        return state
+
+    _save_cluster_state(state)
+    _print(f"[{name}] head up at {head_address}; "
+           f"{len(state['workers'])} worker launch unit(s)")
+    _print(f"  attach:  ray-tpu attach {config_path}")
+    _print(f"  submit:  ray-tpu submit {config_path} your_script.py")
+    _print(f"  python:  ray_tpu.init(address=\"{head_address}\")")
+    return state
+
+
+# ------------------------------------------------------------------- down
+def teardown_cluster(config_path_or_name: str, *,
+                     _print=print) -> None:
+    """``ray-tpu down``: stop every node's session, terminate provider
+    nodes, drop the state file."""
+    if os.path.exists(config_path_or_name):
+        cfg = load_cluster_config(config_path_or_name)
+        name = cfg["cluster_name"]
+    else:
+        cfg = None
+        name = config_path_or_name
+    state = load_cluster_state(name)
+    if state is None:
+        _print(f"[{name}] no recorded cluster state; nothing to tear down")
+        return
+    if cfg is None and state.get("config_path") and \
+            os.path.exists(state["config_path"]):
+        cfg = load_cluster_config(state["config_path"])
+    if cfg is None:
+        raise ClusterConfigError(
+            f"cluster config for {name!r} not found; pass the YAML path")
+
+    stop_cmds = cfg.get("stop_ray_commands") or []
+    nodes = ([state["head"]] if state.get("head") else []) + \
+        state.get("workers", [])
+    for node in nodes:
+        hosts = node.get("hosts", 1)
+        for host_i in range(hosts):
+            runner = _make_runner(cfg, node, host_i)
+            cmds = list(stop_cmds)
+            # stop exactly the sessions this launch created (shared-host
+            # local provider: other clusters' sessions must survive)
+            for sess in node.get("session_dirs", []):
+                cmds.append(
+                    f"{_python_for(cfg, node)} -m ray_tpu.scripts stop "
+                    f"--session-dir {sess}")
+            for cmd in cmds:
+                rc, out = runner.run(cmd, timeout=60.0, env=cfg["env"])
+                if rc != 0:
+                    _print(f"  warning: stop on {node['node_id']}#{host_i} "
+                           f"rc={rc}")
+
+    provider = get_node_provider(dict(state["provider"]), name)
+    for node in nodes:
+        try:
+            provider.terminate_node(node["node_id"])
+        except Exception as e:
+            _print(f"  warning: terminate {node['node_id']}: {e}")
+    _delete_cluster_state(name)
+    _print(f"[{name}] torn down ({len(nodes)} launch unit(s))")
+
+
+# ----------------------------------------------------------- exec / attach
+def _python_for(cfg: Dict[str, Any], node: Dict[str, Any]) -> str:
+    """Interpreter to invoke on this node: the local runner shares our
+    environment (sys.executable); remote hosts use the YAML `python` key."""
+    import sys
+    if cfg["provider"]["type"] not in ("tpu", "gce-tpu") and             node.get("ip", "") in ("127.0.0.1", "localhost"):
+        return sys.executable
+    return cfg.get("python", "python3")
+
+
+def _head_runner(cfg: Dict[str, Any],
+                 state: Dict[str, Any]) -> CommandRunnerInterface:
+    return _make_runner(cfg, state["head"], 0)
+
+
+def _require_state(config_path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    cfg = load_cluster_config(config_path)
+    state = load_cluster_state(cfg["cluster_name"])
+    if state is None:
+        raise RuntimeError(
+            f"cluster {cfg['cluster_name']!r} is not up "
+            f"(no state file); run: ray-tpu up {config_path}")
+    return cfg, state
+
+
+def exec_cluster(config_path: str, command: str, *,
+                 _print=print) -> Tuple[int, str]:
+    """``ray-tpu exec``: run a shell command on the head node with
+    RAY_TPU_ADDRESS pointing at the cluster."""
+    cfg, state = _require_state(config_path)
+    runner = _head_runner(cfg, state)
+    rc, out = runner.run(
+        command, env={**cfg["env"],
+                      "RAY_TPU_ADDRESS": state["head_address"]})
+    if out:
+        _print(out.rstrip())
+    return rc, out
+
+
+def attach_cluster(config_path: str, *, _print=print) -> str:
+    """``ray-tpu attach``: interactive shell on the head node (prints the
+    command; execs it when stdin is a tty)."""
+    import sys
+    cfg, state = _require_state(config_path)
+    runner = _head_runner(cfg, state)
+    shell = runner.remote_shell_command()
+    _print(f"[{cfg['cluster_name']}] head shell: {shell}")
+    if sys.stdin.isatty() and not isinstance(runner, LocalCommandRunner):
+        os.execvp("sh", ["sh", "-c", shell])
+    return shell
+
+
+def submit_job(config_path: str, script: str,
+               script_args: Optional[List[str]] = None, *,
+               _print=print) -> Tuple[int, str]:
+    """``ray-tpu submit``: copy a driver script to the head node and run
+    it against the cluster (reference scripts.py submit)."""
+    cfg, state = _require_state(config_path)
+    runner = _head_runner(cfg, state)
+    remote_path = f"/tmp/ray_tpu_submit_{int(time.time()*1000)}_" \
+                  f"{os.path.basename(script)}"
+    runner.put_file(script, remote_path)
+    args = " ".join(script_args or [])
+    cmd = f"{_python_for(cfg, state['head'])} {remote_path} {args}".rstrip()
+    rc, out = runner.run(
+        cmd, timeout=3600.0,
+        env={**cfg["env"], "RAY_TPU_ADDRESS": state["head_address"]})
+    if out:
+        _print(out.rstrip())
+    return rc, out

@@ -1,0 +1,183 @@
+"""Command runners: how the cluster launcher executes commands on nodes.
+
+Analog of /root/reference/python/ray/autoscaler/command_runner.py:7
+(``CommandRunnerInterface``) and _private/command_runner.py:159
+(``SSHCommandRunner``).  TPU-native addition: ``TpuVmCommandRunner`` drives
+``gcloud compute tpus tpu-vm ssh --worker=N`` — a pod slice is N hosts
+behind one instance name, so one launch unit fans out to per-worker
+runners rather than per-IP SSH sessions.
+
+Every runner supports ``dry_run``: commands are recorded (and printed via
+``plan()``) instead of executed, which is both the zero-egress test seam
+and the ``ray-tpu up --dry-run`` plan printer.
+"""
+
+from __future__ import annotations
+
+import shlex
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Tuple
+
+
+class CommandRunnerInterface:
+    """Run shell commands / copy files on one cluster host."""
+
+    def run(self, cmd: str, *, timeout: float = 300.0,
+            env: Optional[Dict[str, str]] = None) -> Tuple[int, str]:
+        """-> (returncode, combined output)."""
+        raise NotImplementedError
+
+    def put_file(self, local_path: str, remote_path: str) -> None:
+        raise NotImplementedError
+
+    def remote_shell_command(self) -> str:
+        """The interactive shell invocation `ray-tpu attach` should exec."""
+        raise NotImplementedError
+
+
+class LocalCommandRunner(CommandRunnerInterface):
+    """Runs on this host (reference LocalProvider path); the e2e seam for
+    launcher tests — 'nodes' are sessions on the local machine."""
+
+    def __init__(self, *, dry_run: bool = False,
+                 log_prefix: str = ""):
+        self.dry_run = dry_run
+        self.log_prefix = log_prefix
+        self.calls: List[str] = []
+
+    def run(self, cmd: str, *, timeout: float = 300.0,
+            env: Optional[Dict[str, str]] = None) -> Tuple[int, str]:
+        self.calls.append(cmd)
+        if self.dry_run:
+            return 0, ""
+        import os
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        try:
+            proc = subprocess.run(
+                ["bash", "-lc", cmd], capture_output=True, text=True,
+                timeout=timeout, env=full_env)
+        except subprocess.TimeoutExpired as e:
+            return 124, (e.output or "") + f"\n[timeout after {timeout}s]"
+        return proc.returncode, (proc.stdout or "") + (proc.stderr or "")
+
+    def put_file(self, local_path: str, remote_path: str) -> None:
+        self.calls.append(f"cp {local_path} {remote_path}")
+        if self.dry_run:
+            return
+        import os
+        os.makedirs(os.path.dirname(remote_path) or ".", exist_ok=True)
+        shutil.copyfile(local_path, remote_path)
+
+    def remote_shell_command(self) -> str:
+        return "bash"
+
+
+class SSHCommandRunner(CommandRunnerInterface):
+    """Plain SSH to one IP (reference SSHCommandRunner,
+    _private/command_runner.py:159): StrictHostKeyChecking off,
+    ControlMaster reuse left to the user's ssh config."""
+
+    def __init__(self, node_ip: str, ssh_user: str = "ubuntu",
+                 ssh_key: Optional[str] = None, *, dry_run: bool = False):
+        self.node_ip = node_ip
+        self.ssh_user = ssh_user
+        self.ssh_key = ssh_key
+        self.dry_run = dry_run
+        self.calls: List[str] = []
+
+    def _base(self, interactive: bool = False) -> List[str]:
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+               "-o", "UserKnownHostsFile=/dev/null",
+               "-o", "LogLevel=ERROR"]
+        if interactive:
+            cmd.append("-tt")
+        if self.ssh_key:
+            cmd += ["-i", self.ssh_key]
+        cmd.append(f"{self.ssh_user}@{self.node_ip}")
+        return cmd
+
+    def run(self, cmd: str, *, timeout: float = 300.0,
+            env: Optional[Dict[str, str]] = None) -> Tuple[int, str]:
+        if env:
+            exports = " ".join(f"{k}={shlex.quote(v)}"
+                               for k, v in env.items())
+            cmd = f"export {exports}; {cmd}"
+        full = self._base() + [cmd]
+        self.calls.append(shlex.join(full))
+        if self.dry_run:
+            return 0, ""
+        try:
+            proc = subprocess.run(full, capture_output=True, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            return 124, (e.output or "") + f"\n[timeout after {timeout}s]"
+        return proc.returncode, (proc.stdout or "") + (proc.stderr or "")
+
+    def put_file(self, local_path: str, remote_path: str) -> None:
+        scp = ["scp", "-o", "StrictHostKeyChecking=no",
+               "-o", "UserKnownHostsFile=/dev/null", "-o", "LogLevel=ERROR"]
+        if self.ssh_key:
+            scp += ["-i", self.ssh_key]
+        scp += [local_path, f"{self.ssh_user}@{self.node_ip}:{remote_path}"]
+        self.calls.append(shlex.join(scp))
+        if self.dry_run:
+            return
+        subprocess.run(scp, check=True, capture_output=True)
+
+    def remote_shell_command(self) -> str:
+        return shlex.join(self._base(interactive=True))
+
+
+class TpuVmCommandRunner(CommandRunnerInterface):
+    """``gcloud compute tpus tpu-vm ssh <instance> --worker=N`` — the only
+    supported path onto TPU pod-slice hosts (no raw IPs; gcloud tunnels
+    IAP/OS-login).  One runner per (slice instance, worker index)."""
+
+    def __init__(self, instance: str, worker: int, *, zone: str,
+                 project: Optional[str] = None, dry_run: bool = True):
+        self.instance = instance
+        self.worker = worker
+        self.zone = zone
+        self.project = project
+        self.dry_run = dry_run
+        self.calls: List[str] = []
+
+    def _gcloud(self, verb: str, extra: List[str]) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", verb, self.instance,
+               f"--worker={self.worker}", f"--zone={self.zone}"]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        return cmd + extra
+
+    def run(self, cmd: str, *, timeout: float = 300.0,
+            env: Optional[Dict[str, str]] = None) -> Tuple[int, str]:
+        if env:
+            exports = " ".join(f"{k}={shlex.quote(v)}"
+                               for k, v in env.items())
+            cmd = f"export {exports}; {cmd}"
+        full = self._gcloud("ssh", [f"--command={cmd}"])
+        self.calls.append(shlex.join(full))
+        if self.dry_run:
+            return 0, ""
+        if shutil.which("gcloud") is None:
+            raise RuntimeError("gcloud CLI not available")
+        try:
+            proc = subprocess.run(full, capture_output=True, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            return 124, (e.output or "") + f"\n[timeout after {timeout}s]"
+        return proc.returncode, (proc.stdout or "") + (proc.stderr or "")
+
+    def put_file(self, local_path: str, remote_path: str) -> None:
+        full = self._gcloud("scp", [local_path,
+                                    f"{self.instance}:{remote_path}"])
+        self.calls.append(shlex.join(full))
+        if self.dry_run:
+            return
+        subprocess.run(full, check=True, capture_output=True)
+
+    def remote_shell_command(self) -> str:
+        return shlex.join(self._gcloud("ssh", []))

@@ -108,4 +108,20 @@ class InMemoryNodeProvider(NodeProvider):
             rec.raylet_ids = raylet_ids or []
 
 
+class LocalNodeProvider(InMemoryNodeProvider):
+    """Launch units are sessions on this machine (reference 'local'
+    provider, autoscaler/_private/local/node_provider.py): the cluster
+    launcher's LocalCommandRunner starts a real raylet per node via
+    ``ray-tpu start``, so a laptop hosts an honest multi-daemon cluster."""
+
+    def create_node(self, node_type, node_config, resources, hosts,
+                    labels) -> NodeRecord:
+        rec = super().create_node(node_type, node_config, resources,
+                                  hosts, labels)
+        rec.tags["ip"] = "127.0.0.1"
+        rec.state = "running"
+        return rec
+
+
 register_node_provider("mem", InMemoryNodeProvider)
+register_node_provider("local", LocalNodeProvider)

@@ -1,0 +1,116 @@
+"""NodeUpdater: bootstrap one cluster host from bare VM to running raylet.
+
+Analog of /root/reference/python/ray/autoscaler/_private/updater.py
+(``NodeUpdater.run`` → wait-ready → rsync file mounts → initialization /
+setup / start commands).  Differences by design: no rsync binary
+dependency (file mounts copy through the CommandRunner), and the start
+command may report the session dir back ("session: <path>") which the
+updater records so ``ray-tpu down`` can stop exactly that session on
+shared hosts (the local-provider e2e seam).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.command_runner import CommandRunnerInterface
+
+logger = logging.getLogger(__name__)
+
+
+class NodeUpdaterError(RuntimeError):
+    pass
+
+
+class NodeUpdater:
+    def __init__(self, node_id: str, runner: CommandRunnerInterface, *,
+                 file_mounts: Optional[Dict[str, str]] = None,
+                 initialization_commands: Optional[List[str]] = None,
+                 setup_commands: Optional[List[str]] = None,
+                 start_commands: Optional[List[str]] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 ready_command: Optional[str] = None,
+                 ready_timeout: float = 300.0):
+        self.node_id = node_id
+        self.runner = runner
+        self.file_mounts = dict(file_mounts or {})
+        self.initialization_commands = list(initialization_commands or [])
+        self.setup_commands = list(setup_commands or [])
+        self.start_commands = list(start_commands or [])
+        self.env = dict(env or {})
+        self.ready_command = ready_command
+        self.ready_timeout = ready_timeout
+        self.status = "pending"     # pending|waiting-ready|syncing|
+        #                             setting-up|starting|up-to-date|failed
+        self.error: Optional[str] = None
+        self.session_dir: Optional[str] = None   # parsed from start output
+        self.output: List[str] = []
+        self._thread: Optional[threading.Thread] = None
+
+    # -- phases ------------------------------------------------------------
+    def _wait_ready(self) -> None:
+        """Until the node answers a trivial command (VM boot / sshd up)."""
+        import time
+        self.status = "waiting-ready"
+        cmd = self.ready_command or "uptime"
+        deadline = time.monotonic() + self.ready_timeout
+        last = ""
+        while time.monotonic() < deadline:
+            rc, out = self.runner.run(cmd, timeout=30.0)
+            if rc == 0:
+                return
+            last = out
+            time.sleep(2.0)
+        raise NodeUpdaterError(
+            f"node {self.node_id} never became reachable: {last}")
+
+    def _sync_files(self) -> None:
+        self.status = "syncing"
+        for remote, local in self.file_mounts.items():
+            self.runner.put_file(local, remote)
+
+    def _run_commands(self, commands: List[str], phase: str) -> None:
+        self.status = phase
+        for cmd in commands:
+            rc, out = self.runner.run(cmd, env=self.env)
+            self.output.append(out)
+            if rc != 0:
+                raise NodeUpdaterError(
+                    f"node {self.node_id} {phase} command failed "
+                    f"(rc={rc}): {cmd}\n{out[-2000:]}")
+            m = re.search(r"session: (\S+)", out)
+            if m:
+                self.session_dir = m.group(1).rstrip(")")
+
+    def update(self) -> None:
+        try:
+            self._wait_ready()
+            self._sync_files()
+            self._run_commands(self.initialization_commands, "initializing")
+            self._run_commands(self.setup_commands, "setting-up")
+            self._run_commands(self.start_commands, "starting")
+            self.status = "up-to-date"
+        except Exception as e:
+            self.status = "failed"
+            self.error = str(e)
+            logger.error("updater for %s failed: %s", self.node_id, e)
+            raise
+
+    # -- threading (reference updaters run as one thread per node) ---------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._update_quiet,
+                                        daemon=True)
+        self._thread.start()
+
+    def _update_quiet(self) -> None:
+        try:
+            self.update()
+        except Exception:
+            pass  # status/error carry the outcome
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)

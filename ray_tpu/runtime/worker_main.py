@@ -274,6 +274,7 @@ class WorkerProcess:
         cls = self.core.load_function(creation["cls_key"])
         args, kwargs, _borrowed = self._resolve_args(creation["args"])
         self.actor_id = p["actor_id"]
+        self.core.current_actor_id = p["actor_id"]  # get_runtime_context()
         groups = {str(g): int(c)
                   for g, c in (creation.get("concurrency_groups")
                                or {}).items()}

@@ -137,18 +137,24 @@ def _latest_session_dir() -> Optional[str]:
 
 
 def cmd_stop(args) -> None:
-    """Kill daemons of the latest session (plus their workers)."""
+    """Kill daemons of the latest session (plus their workers).
+    ``--session-dir`` stops exactly one session — the cluster launcher's
+    teardown path on hosts shared by several nodes/clusters."""
     import subprocess
     killed = 0
     base = "/tmp/ray_tpu_sessions"
     sessions = []
-    if args.all and os.path.isdir(base):
+    one_session = getattr(args, "session_dir", None)
+    if one_session:
+        sessions = [one_session]
+    elif args.all and os.path.isdir(base):
         sessions = [os.path.join(base, d) for d in os.listdir(base)
                     if d.startswith("session_")]
     else:
         latest = _latest_session_dir()
         if latest:
             sessions = [latest]
+    all_pids = []
     for sess in sessions:
         pid_file = os.path.join(sess, "pids.json")
         try:
@@ -160,14 +166,73 @@ def cmd_stop(args) -> None:
             try:
                 os.kill(pid, signal.SIGTERM)
                 killed += 1
+                all_pids.append(pid)
             except ProcessLookupError:
                 pass
-    # workers/daemons not tracked by pid files (started via init())
-    subprocess.run(
-        ["pkill", "-f",
-         "ray_tpu.(runtime.(gcs|raylet|worker_main)|dashboard)"],
-        check=False)
+        # a session's daemons/workers carry its dir on their command line
+        # (match only runtime processes, not this CLI invocation itself)
+        subprocess.run(["pkill", "-f", f"ray_tpu.runtime.*{sess}"],
+                       check=False)
+    # grace period, then SIGKILL stragglers (reference `ray stop` waits for
+    # procs to exit and force-kills what remains)
+    def _alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True
+
+    deadline = time.monotonic() + 5.0
+    while all_pids and time.monotonic() < deadline:
+        all_pids = [p for p in all_pids if _alive(p)]
+        if all_pids:
+            time.sleep(0.2)
+    for pid in all_pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    if not one_session:
+        # workers/daemons not tracked by pid files (started via init())
+        subprocess.run(
+            ["pkill", "-f",
+             "ray_tpu.(runtime.(gcs|raylet|worker_main)|dashboard)"],
+            check=False)
     print(f"stopped {killed} tracked daemon(s)")
+
+
+# -------------------------------------------------- cluster launcher verbs
+# (reference scripts.py:1161 `ray up` + down/attach/exec/submit)
+def cmd_up(args) -> None:
+    from ray_tpu.autoscaler.cluster_launcher import create_or_update_cluster
+    create_or_update_cluster(args.config, dry_run=args.dry_run,
+                             no_start_workers=args.no_workers)
+
+
+def cmd_down(args) -> None:
+    from ray_tpu.autoscaler.cluster_launcher import teardown_cluster
+    teardown_cluster(args.config)
+
+
+def cmd_attach(args) -> None:
+    from ray_tpu.autoscaler.cluster_launcher import attach_cluster
+    attach_cluster(args.config)
+
+
+def cmd_exec(args) -> None:
+    import shlex
+    from ray_tpu.autoscaler.cluster_launcher import exec_cluster
+    # shlex.join preserves the user's quoting through the remote re-parse
+    rc, _ = exec_cluster(args.config, shlex.join(args.command))
+    sys.exit(rc)
+
+
+def cmd_submit(args) -> None:
+    from ray_tpu.autoscaler.cluster_launcher import submit_job
+    rc, _ = submit_job(args.config, args.script, args.script_args)
+    sys.exit(rc)
 
 
 # ----------------------------------------------------------------- status
@@ -393,7 +458,38 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("stop", help="stop local daemons")
     sp.add_argument("--all", action="store_true",
                     help="stop every session, not just the latest")
+    sp.add_argument("--session-dir",
+                    help="stop exactly this session (launcher teardown)")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("up", help="launch a cluster from a YAML config")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.add_argument("--dry-run", action="store_true",
+                    help="print the gcloud/SSH plan without executing")
+    sp.add_argument("--no-workers", action="store_true",
+                    help="bring up only the head node")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a launched cluster")
+    sp.add_argument("config", help="cluster YAML path (or cluster name)")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("attach", help="interactive shell on the head node")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.set_defaults(fn=cmd_attach)
+
+    sp = sub.add_parser("exec", help="run a shell command on the head node")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.add_argument("command", nargs=argparse.REMAINDER,
+                    help="command to run")
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("submit",
+                        help="run a driver script against the cluster")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.add_argument("script", help="local python script to run on the head")
+    sp.add_argument("script_args", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
 
     for name, fn in (("status", cmd_status), ("memory", cmd_memory),
                      ("debug", cmd_debug)):

@@ -155,9 +155,14 @@ class TrialRunner:
     def _init_restore(self, restore_path: str, staging: str) -> None:
         from ray_tpu._private import storage as _storage
         if _storage.is_uri(restore_path):
+            import shutil
             name = restore_path.rstrip("/").rsplit("/", 1)[-1]
             self.experiment_dir = os.path.join(staging, name)
             self._sync_uri = restore_path
+            # the mirror is the source of truth: stale staging files from
+            # a crashed run (written after its last sync) must not merge
+            # with the older synced state
+            shutil.rmtree(self.experiment_dir, ignore_errors=True)
             _storage.download_dir(restore_path, self.experiment_dir)
         else:
             self.experiment_dir = restore_path

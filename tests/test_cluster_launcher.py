@@ -1,0 +1,146 @@
+"""Cluster launcher e2e (model: reference test_autoscaler.py launcher
+cases + test_cli.py): `up` a multi-node cluster from YAML via the local
+provider + LocalCommandRunner, run a job on it, tear it down."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu.autoscaler.cluster_launcher import (ClusterConfigError,
+                                                 create_or_update_cluster,
+                                                 exec_cluster,
+                                                 load_cluster_state,
+                                                 submit_job,
+                                                 teardown_cluster,
+                                                 validate_cluster_config)
+
+PY = sys.executable
+
+
+def _local_yaml(tmp_path, workers=2):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = f"""
+cluster_name: launcher-e2e
+provider:
+  type: local
+env:
+  PYTHONPATH: {repo}
+available_node_types:
+  head:
+    resources: {{"CPU": 2}}
+    hosts_per_node: 1
+  cpu_worker:
+    resources: {{"CPU": 2}}
+    hosts_per_node: 1
+    min_workers: {workers}
+    max_workers: {workers}
+head_node_type: head
+head_start_ray_commands:
+  - {PY} -m ray_tpu.scripts start --head --port={{port}} --num-cpus 2
+worker_start_ray_commands:
+  - {PY} -m ray_tpu.scripts start --address={{head_address}} --num-cpus 2
+"""
+    path = tmp_path / "cluster.yaml"
+    path.write_text(cfg)
+    return str(path)
+
+
+@pytest.fixture
+def state_dir(tmp_path, monkeypatch):
+    d = tmp_path / "cluster_state"
+    monkeypatch.setenv("RAY_TPU_CLUSTER_STATE_DIR", str(d))
+    return d
+
+
+def test_validate_cluster_config_errors():
+    with pytest.raises(ClusterConfigError, match="cluster_name"):
+        validate_cluster_config({"provider": {"type": "local"},
+                                 "available_node_types": {"a": {}},
+                                 "head_node_type": "a"})
+    with pytest.raises(ClusterConfigError, match="head_node_type"):
+        validate_cluster_config({"cluster_name": "x",
+                                 "provider": {"type": "local"},
+                                 "available_node_types": {"a": {}},
+                                 "head_node_type": "nope"})
+    with pytest.raises(ClusterConfigError, match="min_workers"):
+        validate_cluster_config({"cluster_name": "x",
+                                 "provider": {"type": "local"},
+                                 "available_node_types": {
+                                     "a": {"min_workers": 3,
+                                           "max_workers": 1}},
+                                 "head_node_type": "a"})
+
+
+def test_tpu_yaml_dry_run_plan(capsys, state_dir):
+    """`ray-tpu up examples/cluster.yaml --dry-run` prints the gcloud/SSH
+    plan for a v4-32 slice without executing anything."""
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "cluster.yaml")
+    lines = []
+    create_or_update_cluster(path, dry_run=True, _print=lines.append)
+    plan = "\n".join(lines)
+    assert "DRY RUN" in plan
+    assert "gcloud compute tpus tpu-vm create" in plan
+    assert "--accelerator-type v4-32" in plan
+    # 4 hosts of the slice each get their start command over gcloud ssh
+    assert plan.count("--worker=") >= 5  # 1 head host + 4 slice hosts
+    assert "start --address=" in plan
+    # nothing was persisted: a dry run leaves no cluster state
+    assert load_cluster_state("tpu-demo") is None
+
+
+def test_launcher_up_job_down(tmp_path, state_dir):
+    """The full operator loop: up -> nodes registered -> exec + submit a
+    real driver -> down kills exactly this cluster's sessions."""
+    yaml_path = _local_yaml(tmp_path, workers=2)
+    state = create_or_update_cluster(yaml_path, _print=lambda *a: None)
+    try:
+        assert state["head_address"]
+        assert len(state["workers"]) == 2
+        # state survives to a fresh process (down/exec read it from disk)
+        assert load_cluster_state("launcher-e2e")["head_address"] == \
+            state["head_address"]
+
+        # the cluster is real: a driver sees head + 2 worker nodes
+        driver = tmp_path / "driver.py"
+        driver.write_text(textwrap.dedent("""
+            import os, ray_tpu
+            ray_tpu.init(address=os.environ["RAY_TPU_ADDRESS"])
+            import time
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                nodes = [n for n in ray_tpu.nodes() if n["alive"]]
+                if len(nodes) >= 3:
+                    break
+                time.sleep(0.5)
+            assert len(nodes) >= 3, nodes
+
+            @ray_tpu.remote
+            def whoami():
+                return ray_tpu.get_runtime_context().node_id
+            spots = set(ray_tpu.get([whoami.remote() for _ in range(12)]))
+            print("NODES-SEEN", len(nodes), "TASK-NODES", len(spots))
+            ray_tpu.shutdown()
+        """))
+        rc, out = submit_job(yaml_path, str(driver), _print=lambda *a: None)
+        assert rc == 0, out
+        assert "NODES-SEEN 3" in out
+
+        rc, out = exec_cluster(yaml_path, "echo cluster-says-hi",
+                               _print=lambda *a: None)
+        assert rc == 0 and "cluster-says-hi" in out
+    finally:
+        teardown_cluster(yaml_path, _print=lambda *a: None)
+
+    # every session this cluster started is dead; state file removed
+    assert load_cluster_state("launcher-e2e") is None
+    for node in [state["head"]] + state["workers"]:
+        for sess in node["session_dirs"]:
+            pids = json.load(open(os.path.join(sess, "pids.json")))
+            for pid in pids:
+                alive = subprocess.run(["kill", "-0", str(pid)],
+                                       capture_output=True).returncode == 0
+                assert not alive, f"pid {pid} of {sess} survived teardown"
