@@ -29,21 +29,48 @@ def bench_handle(handle, n_warm=100, n=1000, concurrency=32):
     ray_tpu.get([handle.remote(i) for i in range(n_warm)], timeout=120)
     lats = []
     t0 = time.monotonic()
-    inflight = {handle.remote(time.monotonic()): None
-                for _ in range(concurrency)}
     done = 0
+    # batched closed loop, like the reference serve microbenchmark's
+    # asyncio.gather batches (serve/benchmarks/microbenchmark.py)
     while done < n:
-        ready, _ = ray_tpu.wait(list(inflight), num_returns=1, timeout=60)
-        for r in ready:
+        batch = min(concurrency, n - done)
+        refs = [handle.remote(time.monotonic()) for _ in range(batch)]
+        for r in refs:
             sent = ray_tpu.get(r, timeout=60)
             lats.append(time.monotonic() - sent)
-            del inflight[r]
-            done += 1
-            if done + len(inflight) < n:
-                inflight[handle.remote(time.monotonic())] = None
+        done += batch
     elapsed = time.monotonic() - t0
     p50, p99 = _percentiles(lats)
     return n / elapsed, p50, p99
+
+
+def bench_overhead(handle, n_warm=50, n=300):
+    """Concurrency-1 latency: p50 of a serve handle round-trip minus the
+    p50 of a bare actor call — the serve stack's per-request overhead
+    (the reference's 1-2 ms bar, doc/source/serve/performance.md:19)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Bare:
+        def noop(self, x):
+            return x
+
+    bare = Bare.remote()
+    for _ in range(n_warm):
+        ray_tpu.get(bare.noop.remote(1))
+        ray_tpu.get(handle.remote(1), timeout=60)
+    bare_lats, serve_lats = [], []
+    for _ in range(n):
+        t0 = time.monotonic()
+        ray_tpu.get(bare.noop.remote(1))
+        bare_lats.append(time.monotonic() - t0)
+        t0 = time.monotonic()
+        ray_tpu.get(handle.remote(1), timeout=60)
+        serve_lats.append(time.monotonic() - t0)
+    ray_tpu.kill(bare)
+    bp50, _ = _percentiles(bare_lats)       # already milliseconds
+    sp50, sp99 = _percentiles(serve_lats)
+    return sp50 - bp50, bp50, sp50, sp99
 
 
 def bench_http(port, n_warm=50, n=500, concurrency=16):
@@ -95,6 +122,13 @@ def main():
     print(json.dumps({"metric": "serve_handle_qps", "value": round(qps, 1),
                       "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
                       "reference": "3-4k qps (8 replicas), 1-2ms overhead"}))
+    overhead, bare_p50, serve_p50, serve_p99 = bench_overhead(handle)
+    print(json.dumps({"metric": "serve_overhead_ms",
+                      "value": round(overhead, 2),
+                      "bare_actor_p50_ms": round(bare_p50, 2),
+                      "serve_p50_ms": round(serve_p50, 2),
+                      "serve_p99_ms": round(serve_p99, 2),
+                      "reference": "1-2 ms serve overhead"}))
     http_qps, hp50, hp99 = bench_http(18230)
     print(json.dumps({"metric": "serve_http_qps",
                       "value": round(http_qps, 1),

@@ -450,8 +450,10 @@ def submit_job(config_path: str, script: str,
     remote_path = f"/tmp/ray_tpu_submit_{int(time.time()*1000)}_" \
                   f"{os.path.basename(script)}"
     runner.put_file(script, remote_path)
-    args = " ".join(script_args or [])
-    cmd = f"{_python_for(cfg, state['head'])} {remote_path} {args}".rstrip()
+    import shlex
+    args = " ".join(shlex.quote(a) for a in (script_args or []))
+    cmd = (f"{_python_for(cfg, state['head'])} "
+           f"{shlex.quote(remote_path)} {args}").rstrip()
     rc, out = runner.run(
         cmd, timeout=3600.0,
         env={**cfg["env"], "RAY_TPU_ADDRESS": state["head_address"]})

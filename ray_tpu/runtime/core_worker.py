@@ -142,6 +142,49 @@ def set_global_worker(worker: Optional["CoreWorker"]) -> None:
     _global_worker = worker
 
 
+class _NotifyingEvent:
+    """threading.Event + ready callbacks, fired exactly once on set().
+    Library code (Serve handles, async bridges) registers callbacks
+    instead of polling wait() loops — the reference's task-completion
+    callback path in core_worker's TaskManager."""
+
+    __slots__ = ("_ev", "_cbs", "_lock")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._cbs: List = []
+        self._lock = threading.Lock()
+
+    def set(self) -> None:
+        with self._lock:
+            self._ev.set()
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                logger.exception("object ready callback failed")
+
+    def add_callback(self, cb) -> bool:
+        """Register cb to run on set(); returns False (not registered)
+        when already set — caller invokes it directly."""
+        with self._lock:
+            if self._ev.is_set():
+                return False
+            self._cbs.append(cb)
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ev.clear()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._ev.wait(timeout)
+
+    def is_set(self) -> bool:
+        return self._ev.is_set()
+
+
 class _OwnedObject:
     __slots__ = ("state", "data", "error", "locations", "event", "refcount",
                  "task_spec", "dynamic_children", "recovering")
@@ -151,9 +194,11 @@ class _OwnedObject:
         self.data: Optional[bytes] = None     # serialized inline payload
         self.error = 0
         self.locations: set = set()  # node_id hex with a shm copy
-        self.event = threading.Event()
+        self.event = _NotifyingEvent()
         self.refcount = 0
-        self.task_spec: Optional[bytes] = None  # lineage for reconstruction
+        # lineage for reconstruction: {"spec","resources","key",
+        # "retries_left","strategy","env"} shared across sibling slots
+        self.task_spec: Optional[dict] = None
         # sub-object ids of a num_returns="dynamic" task: freed with slot 0
         # unless a deserialized generator bound its own refs to them
         self.dynamic_children: Optional[list] = None
@@ -269,6 +314,8 @@ class CoreWorker:
         # max_retries; reference task_oom_retries)
         self._oom_retries: Dict[bytes, int] = {}
         self._fn_cache: Dict[str, Any] = {}
+        self._fn_key_by_id: Dict[int, str] = {}  # id(func) -> fn key
+        self._fn_id_pins: Dict[int, Any] = {}    # keeps those ids stable
         self._node_table: Dict[str, Dict] = {}
 
         # actor submission: per-actor ordered pipeline (a single sender
@@ -822,21 +869,15 @@ class CoreWorker:
         with self._owned_lock:
             if entry.state == "pending":
                 return True  # recovery already in flight
-            blob = entry.task_spec
-            if blob is None:
+            meta = entry.task_spec
+            if meta is None:
                 return False
-            meta = cloudpickle.loads(blob)
             if meta["retries_left"] <= 0:
                 return False
-            meta["retries_left"] -= 1
-            new_blob = cloudpickle.dumps(meta)
+            meta["retries_left"] -= 1  # shared dict: visible to all slots
             spec = meta["spec"]
             task_id = TaskID(spec["task_id"])
             lmeta = self._lineage_meta.get(task_id.binary())
-            if lmeta is not None and not lmeta["evicted"]:
-                # keep the byte ledger in sync with the re-pickled spec
-                self._lineage_bytes += len(new_blob) - lmeta["size"]
-                lmeta["size"] = len(new_blob)
             # reset every return slot of the task (the resubmission
             # regenerates them all), including adopted dynamic children
             slots = {ObjectID.for_task_return(task_id, i)
@@ -847,7 +888,7 @@ class CoreWorker:
                 sib = self._owned.get(sib_oid)
                 if sib is None:
                     continue
-                sib.task_spec = new_blob
+                sib.task_spec = meta
                 sib.state = "pending"
                 sib.data = None
                 sib.error = 0
@@ -886,6 +927,16 @@ class CoreWorker:
         finally:
             with self._owned_lock:
                 entry.recovering = False
+
+    def add_ready_callback(self, ref: ObjectRef, cb) -> None:
+        """Run ``cb()`` once the owned object is ready — immediately when
+        it already is (or when the ref isn't owned by this worker, where
+        readiness can't be observed locally; callers use this for refs
+        they own, e.g. Serve handles watching their replica calls)."""
+        with self._owned_lock:
+            entry = self._owned.get(ref.id)
+        if entry is None or not entry.event.add_callback(cb):
+            cb()
 
     # ------------------------------------------------------------- wait
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
@@ -931,12 +982,23 @@ class CoreWorker:
 
     # -------------------------------------------------- function registry
     def register_function(self, func) -> str:
+        # hot path: every task submission lands here, and cloudpickling the
+        # function just to recompute its content hash dominates small-task
+        # submit cost.  The id() cache pins each cached function object
+        # explicitly — without the pin, a duplicate-hash function could be
+        # collected and its id recycled by a different function, which
+        # would then silently run the wrong code remotely.
+        cached = self._fn_key_by_id.get(id(func))
+        if cached is not None:
+            return cached
         blob = cloudpickle.dumps(func)
         key = hashlib.sha1(blob).hexdigest()
         full = f"fn:{self.job_id.hex()}:{key}"
         if full not in self._fn_cache:
             self.gcs.kv_put(full, blob, overwrite=False)
             self._fn_cache[full] = func
+        self._fn_key_by_id[id(func)] = full
+        self._fn_id_pins[id(func)] = func
         return full
 
     def load_function(self, key: str):
@@ -998,23 +1060,28 @@ class CoreWorker:
             spec["trace_ctx"] = trace_ctx
         return_refs = []
         n_slots = num_return_slots(num_returns)
-        spec_blob = cloudpickle.dumps(
-            {"spec": spec, "resources": resources, "key": key,
-             "retries_left": max_retries,
-             "strategy": scheduling_strategy, "env": runtime_env})
+        # lineage stays an in-process dict (never crosses a wire); pickling
+        # it per submission doubled small-task submit cost for no benefit.
+        # The spec is never mutated after submission (workers get an RPC
+        # copy), so sharing one dict across sibling slots is safe; the
+        # byte ledger uses the dominant term (args) plus flat overhead.
+        lineage = {"spec": spec, "resources": resources, "key": key,
+                   "retries_left": max_retries,
+                   "strategy": scheduling_strategy, "env": runtime_env}
+        lineage_size = len(arg_blob) + 512
         with self._owned_lock:
             slots = set()
             for i in range(n_slots):
                 oid = ObjectID.for_task_return(task_id, i)
                 entry = _OwnedObject()
-                entry.task_spec = spec_blob
+                entry.task_spec = lineage
                 self._owned[oid] = entry
                 slots.add(oid)
                 return_refs.append(ObjectRef(oid, self.address, self))
             self._lineage_meta[task_id.binary()] = {
-                "size": len(spec_blob), "slots": slots, "evicted": False}
+                "size": lineage_size, "slots": slots, "evicted": False}
             self._lineage_order.append(task_id.binary())
-            self._lineage_bytes += len(spec_blob)
+            self._lineage_bytes += lineage_size
             self._evict_lineage_locked()
         self._enqueue_task(key, resources, spec, max_retries,
                            strategy=scheduling_strategy, env=runtime_env)
@@ -1333,50 +1400,51 @@ class CoreWorker:
         for spec, _ in items:
             self._store_task_error(spec, error)
 
+    # pushes in flight per lease connection: overlaps push RTT + spec
+    # serialization with worker execution (the worker drains its own FIFO
+    # serially, so this changes delivery, not execution concurrency) —
+    # reference push-queue pipelining, direct_task_transport.cc:174/213
+    _PUSH_WINDOW = 8
+
     def _lease_worker_loop(self, key: str, st, lease: _Lease) -> None:
-        """Pull tasks from the key's queue and push them to this worker."""
+        """Pull tasks from the key's queue and pipeline them to this
+        worker: up to _PUSH_WINDOW unacked pushes ride the connection."""
+        inflight: deque = deque()   # (spec, retries, future)
         while True:
-            with self._sched_lock:
-                if st["queue"] and not self._shutdown.is_set():
-                    spec, retries = st["queue"].popleft()
-                else:
+            while len(inflight) < self._PUSH_WINDOW:
+                with self._sched_lock:
+                    if st["queue"] and not self._shutdown.is_set():
+                        spec, retries = st["queue"].popleft()
+                    else:
+                        break
+                # send failures surface through the future (call_async
+                # catches them internally), landing in the dead-worker
+                # path below like any mid-task connection loss
+                inflight.append((spec, retries,
+                                 lease.conn.call_async("push_task", spec)))
+            if not inflight:
+                with self._sched_lock:
+                    # closing window: a task may have been enqueued after
+                    # our empty-queue read above
+                    if st["queue"] and not self._shutdown.is_set():
+                        continue
                     st["leases"].remove(lease)
-                    break
+                break
+            spec, retries, fut = inflight.popleft()
             try:
-                reply = lease.conn.call("push_task", spec, timeout=None)
+                reply = fut.result(None)
                 self._on_task_reply(spec, reply)
             except (ConnectionError, OSError, rpc.RemoteError) as e:
                 if isinstance(e, rpc.RemoteError):
                     self._store_task_error(spec, exc.RayTpuError(str(e)))
                     continue
-                # worker died mid-task.  An OOM kill draws from its own
-                # retry budget (task_oom_retries) and leaves max_retries
-                # untouched — the task didn't fail, the node ran dry
-                if self._lease_was_oom_killed(lease):
-                    left = self._oom_retries.get(spec["task_id"],
-                                                 CONFIG.task_oom_retries)
-                    if left > 0:
-                        self._oom_retries[spec["task_id"]] = left - 1
-                        logger.info(
-                            "task %s OOM-killed; retrying (%d OOM "
-                            "retries left)", spec["name"], left - 1)
-                        with self._sched_lock:
-                            st["queue"].appendleft((spec, retries))
-                    else:
-                        self._store_task_error(
-                            spec, exc.OutOfMemoryError(
-                                f"task {spec['name']} was OOM-killed "
-                                f"{CONFIG.task_oom_retries + 1} times "
-                                f"(host memory exhausted)"),
-                            error_code=ser.ERROR_OOM)
-                elif retries > 0:
-                    logger.info("task %s worker died; retrying (%d left)",
-                                spec["name"], retries)
-                    with self._sched_lock:
-                        st["queue"].appendleft((spec, retries - 1))
-                else:
-                    self._store_task_error(spec, exc.WorkerCrashedError(
-                        f"task {spec['name']} worker died: {e}"))
+                # worker died mid-task: apply per-task retry accounting to
+                # this task and every other unacked in-flight push
+                failed = [(spec, retries)] + [(s, r) for s, r, _ in inflight]
+                oom = self._lease_was_oom_killed(lease)
+                for fspec, fretries in reversed(failed):
+                    self._retry_or_fail_dead_worker(st, fspec, fretries,
+                                                    oom, e)
                 with self._sched_lock:
                     st["leases"].remove(lease)
                 try:
@@ -1387,6 +1455,37 @@ class CoreWorker:
                 return
         self._return_lease(lease)
         self._maybe_request_lease(key, st)
+
+    def _retry_or_fail_dead_worker(self, st, spec, retries: int,
+                                   oom: bool, e: BaseException) -> None:
+        """Retry accounting for one task whose worker died mid-flight.
+        An OOM kill draws from its own retry budget (task_oom_retries)
+        and leaves max_retries untouched — the task didn't fail, the
+        node ran dry."""
+        if oom:
+            left = self._oom_retries.get(spec["task_id"],
+                                         CONFIG.task_oom_retries)
+            if left > 0:
+                self._oom_retries[spec["task_id"]] = left - 1
+                logger.info("task %s OOM-killed; retrying (%d OOM "
+                            "retries left)", spec["name"], left - 1)
+                with self._sched_lock:
+                    st["queue"].appendleft((spec, retries))
+            else:
+                self._store_task_error(
+                    spec, exc.OutOfMemoryError(
+                        f"task {spec['name']} was OOM-killed "
+                        f"{CONFIG.task_oom_retries + 1} times "
+                        f"(host memory exhausted)"),
+                    error_code=ser.ERROR_OOM)
+        elif retries > 0:
+            logger.info("task %s worker died; retrying (%d left)",
+                        spec["name"], retries)
+            with self._sched_lock:
+                st["queue"].appendleft((spec, retries - 1))
+        else:
+            self._store_task_error(spec, exc.WorkerCrashedError(
+                f"task {spec['name']} worker died: {e}"))
 
     def _lease_was_oom_killed(self, lease: _Lease) -> bool:
         payload = {"worker_id": lease.worker_id}

@@ -656,6 +656,16 @@ class GcsServer:
                 }, timeout=CONFIG.actor_creation_timeout_s)
                 with self._lock:
                     entry.pop("retry_delay", None)
+                    killed_mid_flight = entry["state"] == DEAD
+                if killed_mid_flight:
+                    # kill_actor raced this dispatch: the kill push found
+                    # nothing on the node yet, so the worker+resources it
+                    # just acquired would leak without this reap
+                    try:
+                        node_conn.push("kill_actor_worker",
+                                       {"actor_id": aid})
+                    except ConnectionError:
+                        pass
                 return
             except (rpc.RemoteError, ConnectionError, TimeoutError) as e:
                 last_err = e
@@ -697,8 +707,21 @@ class GcsServer:
             entry = self._actors.get(p["actor_id"])
             if entry is None:
                 return {"ok": False}
-            entry["state"] = ALIVE
-            entry["address"] = tuple(p["address"])
+            dead = entry["state"] == DEAD
+            if dead:
+                # killed while __init__ ran: reap instead of resurrecting
+                node_conn = self._node_conns.get(entry.get("node_id") or "")
+            else:
+                entry["state"] = ALIVE
+                entry["address"] = tuple(p["address"])
+        if dead:
+            if node_conn is not None:
+                try:
+                    node_conn.push("kill_actor_worker",
+                                   {"actor_id": p["actor_id"]})
+                except ConnectionError:
+                    pass
+            return {"ok": False, "dead": True}
         self._publish("actor", {"actor_id": p["actor_id"], "state": ALIVE,
                                 "address": tuple(p["address"])})
         return {"ok": True}

@@ -5,6 +5,13 @@ Analog of /root/reference/python/ray/serve/handle.py (RayServeHandle :78)
 power-of-two-choices over handle-local in-flight counts, with
 max_concurrent_queries backpressure; routing tables refresh from the
 controller with a version stamp (short-poll analog of LongPollClient).
+
+Per-request hot path (the reference's 1-2 ms overhead bar,
+doc/source/serve/performance.md:19-20): no GCS lookups (replica actor
+handles are cached), no polling threads (in-flight counts decrement via
+owned-object ready callbacks the moment a reply lands), and the periodic
+routing-table refresh runs on a background thread so requests never wait
+on the controller.
 """
 
 from __future__ import annotations
@@ -35,12 +42,12 @@ class DeploymentHandle:
         self._lock = threading.Condition()
         self._version = -1
         self._replicas: List[str] = []
+        self._actors: Dict[str, Any] = {}      # replica name -> actor handle
         self._max_concurrent = 8
         self._inflight: Dict[str, int] = {}
-        self._outstanding: List[tuple] = []  # (ref, replica_name)
         self._last_refresh = 0.0
         self._controller = None
-        self._drain_thread: Optional[threading.Thread] = None
+        self._refreshing = False
 
     # ------------------------------------------------------------ plumbing
     def _get_controller(self):
@@ -48,6 +55,27 @@ class DeploymentHandle:
             self._controller = ray_tpu.get_actor(
                 CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
         return self._controller
+
+    def _maybe_refresh_bg(self):
+        """Kick a background refresh when the table is stale; requests
+        keep routing on the current table meanwhile."""
+        now = time.monotonic()
+        if now - self._last_refresh < _REFRESH_INTERVAL_S:
+            return
+        with self._lock:
+            if self._refreshing:
+                return
+            self._refreshing = True
+        threading.Thread(target=self._refresh_quiet, daemon=True).start()
+
+    def _refresh_quiet(self):
+        try:
+            self._refresh(force=True)
+        except Exception:
+            pass
+        finally:
+            with self._lock:
+                self._refreshing = False
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -60,6 +88,7 @@ class DeploymentHandle:
         if targets is None:
             with self._lock:
                 self._replicas = []
+                self._actors.clear()
             return
         if targets.get("unchanged"):
             return
@@ -67,58 +96,22 @@ class DeploymentHandle:
             self._version = targets["version"]
             self._replicas = targets["replicas"]
             self._max_concurrent = targets["max_concurrent_queries"]
+            live = set(self._replicas)
             for r in self._replicas:
                 self._inflight.setdefault(r, 0)
+            for gone in [r for r in self._actors if r not in live]:
+                del self._actors[gone]
             self._lock.notify_all()
 
-    def _ensure_drainer(self):
-        with self._lock:
-            if (self._drain_thread is None
-                    or not self._drain_thread.is_alive()):
-                self._drain_thread = threading.Thread(
-                    target=self._drain_loop, daemon=True)
-                self._drain_thread.start()
-
-    def _drain_loop(self):
-        """Decrement in-flight counts as replica calls complete. Exits when
-        no requests are outstanding (restarted on demand by _route) so idle
-        handles pin no thread."""
-        idle_since = None
-        while True:
+    def _actor_for(self, replica: str):
+        """Cached replica actor handle: one GCS lookup per replica per
+        table version, not one per request."""
+        actor = self._actors.get(replica)
+        if actor is None:
+            actor = ray_tpu.get_actor(replica, namespace=SERVE_NAMESPACE)
             with self._lock:
-                outstanding = list(self._outstanding)
-            if not outstanding:
-                if idle_since is None:
-                    idle_since = time.monotonic()
-                elif time.monotonic() - idle_since > 1.0:
-                    with self._lock:
-                        if not self._outstanding:
-                            self._drain_thread = None
-                            return
-                time.sleep(0.02)
-                continue
-            idle_since = None
-            refs = [r for r, _ in outstanding]
-            try:
-                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.2,
-                                       fetch_local=False)
-            except Exception:
-                # transient wait failure: errored calls still complete their
-                # refs, so just retry rather than zeroing in-flight counts
-                time.sleep(0.1)
-                continue
-            if done:
-                done_ids = {d.id for d in done}
-                with self._lock:
-                    still = []
-                    for ref, replica in self._outstanding:
-                        if ref.id in done_ids:
-                            self._inflight[replica] = max(
-                                0, self._inflight.get(replica, 1) - 1)
-                        else:
-                            still.append((ref, replica))
-                    self._outstanding = still
-                    self._lock.notify_all()
+                self._actors[replica] = actor
+        return actor
 
     # ------------------------------------------------------------- routing
     def _pick_replica(self) -> Optional[str]:
@@ -132,8 +125,17 @@ class DeploymentHandle:
         a, b = random.sample(candidates, 2)
         return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
 
+    def _release(self, replica: str) -> None:
+        with self._lock:
+            self._inflight[replica] = max(
+                0, self._inflight.get(replica, 1) - 1)
+            self._lock.notify_all()
+
     def _route(self, method: str, args: tuple, kwargs: dict):
-        self._refresh()
+        if self._replicas:
+            self._maybe_refresh_bg()
+        else:
+            self._refresh()      # cold start: need a table before routing
         deadline = time.monotonic() + 60.0
         while True:
             with self._lock:
@@ -151,8 +153,7 @@ class DeploymentHandle:
                 self._refresh(force=not self._replicas)
                 continue
             try:
-                actor = ray_tpu.get_actor(replica,
-                                          namespace=SERVE_NAMESPACE)
+                actor = self._actor_for(replica)
                 ref = actor.handle_request.remote(method, args, kwargs)
             except Exception:
                 # replica vanished (scale-down/crash): drop it locally,
@@ -162,14 +163,17 @@ class DeploymentHandle:
                         0, self._inflight.get(replica, 1) - 1)
                     if replica in self._replicas:
                         self._replicas.remove(replica)
+                    self._actors.pop(replica, None)
                 if time.monotonic() > deadline:
                     raise
                 self._refresh(force=True)
                 time.sleep(0.05)
                 continue
-            with self._lock:
-                self._outstanding.append((ref, replica))
-            self._ensure_drainer()
+            # in-flight count drops the instant the reply lands — no
+            # polling drainer between a reply and the next admission
+            from ray_tpu.runtime.core_worker import get_global_worker
+            get_global_worker().add_ready_callback(
+                ref, lambda r=replica: self._release(r))
             return ref
 
     # ------------------------------------------------------------ user API

@@ -52,12 +52,32 @@ class HTTPProxyActor:
                 payload = dict(request.query)
             loop = asyncio.get_running_loop()
 
-            def call():
-                h = self._get_handle(deployment)
-                return ray_tpu.get(h.remote(payload), timeout=60)
+            # Submission runs in the executor (it can momentarily block on
+            # backpressure), but the thread is released immediately: the
+            # reply is awaited via an owned-object ready callback, so no
+            # thread is parked for the request's full duration (the
+            # reference's fully-async proxy→replica path).
+            def submit():
+                return self._get_handle(deployment).remote(payload)
 
             try:
-                result = await loop.run_in_executor(None, call)
+                ref = await loop.run_in_executor(None, submit)
+                fut = loop.create_future()
+
+                def _on_ready():
+                    def _resolve():
+                        if not fut.done():
+                            fut.set_result(None)
+                    loop.call_soon_threadsafe(_resolve)
+
+                from ray_tpu.runtime.core_worker import get_global_worker
+                get_global_worker().add_ready_callback(ref, _on_ready)
+                await asyncio.wait_for(fut, timeout=60)
+                # ready means resolved, not necessarily local: a large
+                # result may still need a cross-node fetch, which must not
+                # run on the event loop
+                result = await loop.run_in_executor(
+                    None, lambda: ray_tpu.get(ref, timeout=60))
             except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500
                 return web.json_response(
                     {"error": type(e).__name__, "message": str(e)},

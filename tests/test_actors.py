@@ -98,3 +98,25 @@ def test_actor_no_restart_dies_for_good(ray_start_regular):
     f.die.remote()
     with pytest.raises((ActorDiedError, ActorUnavailableError)):
         ray_tpu.get(f.get.remote(), timeout=90)
+
+
+def test_kill_racing_creation_releases_resources(ray_start_regular):
+    """kill() before/while an actor's creation dispatch is in flight must
+    not leak the worker or its resource slots (reference
+    GcsActorManager::DestroyActor on PENDING_CREATION actors)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    for _ in range(3):
+        a = A.remote()
+        ray_tpu.kill(a)  # racing creation: never awaited, never called
+    # every CPU slot must be reusable: the fixture starts 4 CPUs
+    gang = [A.remote() for _ in range(4)]
+    assert ray_tpu.get([g.ping.remote() for g in gang], timeout=120) == \
+        [1, 1, 1, 1]
+    for g in gang:
+        ray_tpu.kill(g)
