@@ -95,7 +95,7 @@ class DashboardHead:
                            "/api/tasks", "/api/placement_groups",
                            "/api/cluster_status", "/api/jobs",
                            "/api/serve/applications", "/metrics"]})
-        from ray_tpu.dashboard.static_page import INDEX_HTML
+        from ray_tpu.dashboard.web_app import INDEX_HTML
         return web.Response(text=INDEX_HTML, content_type="text/html")
 
     async def _version(self, request) -> web.Response:
@@ -166,9 +166,21 @@ class DashboardHead:
         return web.json_response(json.loads(raw))
 
     async def _job_logs(self, request) -> web.Response:
+        """Full text by default; ``?offset=N`` returns the delta past N as
+        JSON so the live page can tail without refetching (reference
+        job_head.py tail_job_logs streaming)."""
         sid = request.match_info["submission_id"]
         raw = await self._call(self.gcs.kv_get, "job_logs:" + sid)
-        return web.Response(text=(raw or b"").decode("utf-8", "replace"))
+        text = (raw or b"").decode("utf-8", "replace")
+        if "offset" in request.query:
+            try:
+                off = max(0, int(request.query["offset"]))
+            except ValueError:
+                raise web.HTTPBadRequest(
+                    text="offset must be an integer") from None
+            return web.json_response(
+                {"text": text[off:], "offset": len(text)})
+        return web.Response(text=text)
 
     async def _job_stop(self, request) -> web.Response:
         sid = request.match_info["submission_id"]

@@ -1,0 +1,267 @@
+"""Live dashboard frontend: one self-contained HTML+JS page.
+
+Replaces the reference's React SPA (dashboard/client/src/App.tsx — pages
+for overview/nodes/actors/jobs/logs/serve) with a no-build-toolchain
+single file served by ``DashboardHead``: vanilla JS polls the existing
+REST API every 2 s, so every view updates without reload; the Jobs view
+tails a job's logs live through the offset-based log endpoint.
+
+Design notes: status is never color-alone (dot + text label), duration
+bars use a single muted hue (magnitude = one-hue sequential), all text
+stays in ink tokens.
+"""
+
+INDEX_HTML = r"""<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  :root {
+    --bg: #f7f7f5; --surface: #ffffff; --ink: #1a1a1a; --ink2: #5c5c57;
+    --muted: #8a8a84; --line: #e4e4df; --accent: #4c6a92;
+    --ok: #2e7d48; --warn: #a66a00; --bad: #b3382e;
+  }
+  * { box-sizing: border-box; }
+  body { margin: 0; font: 14px/1.45 system-ui, sans-serif;
+         background: var(--bg); color: var(--ink); }
+  header { display: flex; align-items: baseline; gap: 16px;
+           padding: 14px 20px; background: var(--surface);
+           border-bottom: 1px solid var(--line); }
+  header h1 { font-size: 16px; margin: 0; }
+  header .sub { color: var(--muted); font-size: 12px; }
+  nav { display: flex; gap: 2px; padding: 0 20px;
+        background: var(--surface); border-bottom: 1px solid var(--line); }
+  nav button { border: 0; background: none; padding: 10px 14px;
+               font: inherit; color: var(--ink2); cursor: pointer;
+               border-bottom: 2px solid transparent; }
+  nav button.active { color: var(--ink);
+                      border-bottom-color: var(--accent); }
+  main { padding: 18px 20px; max-width: 1200px; }
+  .tiles { display: flex; gap: 12px; flex-wrap: wrap; margin-bottom: 16px; }
+  .tile { background: var(--surface); border: 1px solid var(--line);
+          border-radius: 8px; padding: 12px 16px; min-width: 150px; }
+  .tile .v { font-size: 22px; font-weight: 600; }
+  .tile .k { color: var(--muted); font-size: 12px; }
+  table { border-collapse: collapse; width: 100%;
+          background: var(--surface); border: 1px solid var(--line);
+          border-radius: 8px; overflow: hidden; }
+  th, td { text-align: left; padding: 7px 12px;
+           border-bottom: 1px solid var(--line); font-size: 13px; }
+  th { color: var(--ink2); font-weight: 600; background: var(--bg); }
+  tr:last-child td { border-bottom: 0; }
+  .dot { display: inline-block; width: 8px; height: 8px;
+         border-radius: 50%; margin-right: 6px; vertical-align: middle; }
+  .s-ok .dot { background: var(--ok); }   .s-ok { color: var(--ok); }
+  .s-warn .dot { background: var(--warn); } .s-warn { color: var(--warn); }
+  .s-bad .dot { background: var(--bad); }  .s-bad { color: var(--bad); }
+  .s-mut .dot { background: var(--muted); } .s-mut { color: var(--ink2); }
+  .bar { background: var(--line); border-radius: 4px; height: 8px;
+         width: 160px; display: inline-block; vertical-align: middle; }
+  .bar i { display: block; height: 100%; background: var(--accent);
+           border-radius: 4px; }
+  .mono { font-family: ui-monospace, monospace; font-size: 12px; }
+  #log { background: #16211c; color: #d7e0da; padding: 12px;
+         border-radius: 8px; font-family: ui-monospace, monospace;
+         font-size: 12px; white-space: pre-wrap; max-height: 420px;
+         overflow-y: auto; margin-top: 12px; }
+  .hint { color: var(--muted); font-size: 12px; margin: 8px 0; }
+  a.joblink { color: var(--accent); cursor: pointer;
+              text-decoration: underline; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span class="sub" id="meta">connecting…</span>
+  <span class="sub" id="tick"></span>
+</header>
+<nav id="nav"></nav>
+<main id="main">loading…</main>
+<script>
+const TABS = ["Overview", "Nodes", "Actors", "Tasks", "Jobs", "Serve",
+              "Placement Groups"];
+let tab = location.hash ? decodeURIComponent(location.hash.slice(1))
+                        : "Overview";
+let followJob = null, logOffset = 0, timer = null;
+
+const $ = (id) => document.getElementById(id);
+const esc = (s) => String(s ?? "").replace(/[&<>"'`]/g,
+  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;",
+           "'":"&#39;","`":"&#96;"}[c]));
+const J = async (url) => (await fetch(url)).json();
+
+function statusCls(s) {
+  s = String(s || "").toUpperCase();
+  if (["ALIVE", "RUNNING", "SUCCEEDED", "CREATED", "HEALTHY", "FINISHED",
+       "true", "TRUE"].includes(s)) return "s-ok";
+  if (["PENDING", "PENDING_CREATION", "RESTARTING", "UPDATING",
+       "SUBMITTED"].includes(s)) return "s-warn";
+  if (["DEAD", "FAILED", "ERROR", "STOPPED", "false",
+       "FALSE"].includes(s)) return "s-bad";
+  return "s-mut";
+}
+const badge = (s) => `<span class="${statusCls(s)}"><span class="dot">` +
+  `</span>${esc(s)}</span>`;
+function table(cols, rows) {
+  return `<table><tr>${cols.map(c => `<th>${esc(c)}</th>`).join("")}</tr>` +
+    (rows.length ? rows.map(r =>
+       `<tr>${r.map(c => `<td>${c}</td>`).join("")}</tr>`).join("")
+     : `<tr><td colspan="${cols.length}" class="hint">nothing yet</td></tr>`)
+    + `</table>`;
+}
+
+async function renderOverview() {
+  const s = await J("/api/cluster_status");
+  const res = s.total_resources || {}, av = s.available_resources || {};
+  const tiles = [
+    ["alive nodes", s.alive_nodes], ["dead nodes", s.dead_nodes],
+    ...Object.keys(res).sort().map(r =>
+      [r, `${(av[r] ?? 0).toFixed(1)} / ${res[r].toFixed(1)} free`]),
+  ];
+  return `<div class="tiles">` + tiles.map(([k, v]) =>
+    `<div class="tile"><div class="v">${esc(v)}</div>` +
+    `<div class="k">${esc(k)}</div></div>`).join("") + `</div>` +
+    `<div class="hint">auto-refreshing every 2 s — API under /api/*, ` +
+    `Prometheus at /metrics</div>`;
+}
+
+async function renderNodes() {
+  const d = await J("/api/nodes");
+  return table(["node", "state", "address", "CPU free", "TPU free",
+                "labels"],
+    d.nodes.map(n => [
+      `<span class="mono">${esc(n.node_id.slice(0, 12))}</span>`,
+      badge(n.alive ? "ALIVE" : "DEAD"),
+      `<span class="mono">${esc((n.address || []).join(":"))}</span>`,
+      `${(n.available?.CPU ?? 0)} / ${(n.resources?.CPU ?? 0)}`,
+      `${(n.available?.TPU ?? 0)} / ${(n.resources?.TPU ?? 0)}`,
+      esc(JSON.stringify(n.labels || {}))]));
+}
+
+async function renderActors() {
+  const d = await J("/api/actors");
+  return table(["actor", "name", "state", "node", "restarts"],
+    d.actors.map(a => [
+      `<span class="mono">${esc(a.actor_id.slice(0, 12))}</span>`,
+      esc(a.name || ""), badge(a.state),
+      `<span class="mono">${esc((a.node_id || "").slice(0, 12))}</span>`,
+      `${a.restarts ?? 0}/${a.max_restarts ?? 0}`]));
+}
+
+async function renderTasks() {
+  // /api/tasks rows: {task_id, name, state, events: [{state, ts}, ...]}
+  const d = await J("/api/tasks?limit=300");
+  const items = d.tasks.slice(-120).reverse().map(t => {
+    const ts = (t.events || []).map(e => e.ts);
+    const dur = ts.length ? Math.max(...ts) - Math.min(...ts) : 0;
+    return {id: t.task_id, name: t.name, state: t.state, dur};
+  });
+  const maxDur = Math.max(0.001, ...items.map(r => r.dur));
+  return `<div class="hint">most recent tasks — bar = wall time ` +
+    `(longest ${maxDur.toFixed(2)} s)</div>` +
+    table(["task", "name", "state", "duration", ""],
+      items.map(r => [
+        `<span class="mono">${esc(r.id.slice(0, 12))}</span>`,
+        esc(r.name), badge(r.state), `${r.dur.toFixed(3)} s`,
+        `<span class="bar"><i style="width:${
+           Math.max(2, 100 * r.dur / maxDur)}%"></i></span>`]));
+}
+
+async function renderJobs() {
+  const d = await J("/api/jobs");
+  let html = table(["job", "status", "entrypoint", "logs"],
+    d.jobs.map(j => [
+      `<span class="mono">${esc(j.submission_id)}</span>`,
+      badge(j.status), `<span class="mono">${esc(j.entrypoint)}</span>`,
+      // data attribute + delegated listener: a user-chosen submission_id
+      // must never be spliced into inline JS (XSS sink)
+      `<a class="joblink" data-sid="${esc(j.submission_id)}">tail</a>`]));
+  if (followJob) {
+    html += `<div class="hint">tailing logs of <b>${esc(followJob)}</b> ` +
+      `(streams as the job writes) — ` +
+      `<a class="joblink" data-sid="">stop</a></div>` +
+      `<div id="log"></div>`;
+  }
+  return html;
+}
+
+async function renderServe() {
+  const d = await J("/api/serve/applications");
+  const deps = Object.entries(d.deployments || {});
+  return table(["deployment", "status", "replicas", "route"],
+    deps.map(([name, s]) => [
+      esc(name), badge(s.status || s.state || "?"),
+      `${s.running_replicas ?? s.replicas ?? "?"} / ` +
+      `${s.target_replicas ?? s.num_replicas ?? "?"}`,
+      `<span class="mono">/${esc(name)}</span>`]));
+}
+
+async function renderPGs() {
+  // API shape: {"placement_groups": {pg_id: {state, strategy, bundles}}}
+  const d = await J("/api/placement_groups");
+  return table(["placement group", "state", "strategy", "bundles"],
+    Object.values(d.placement_groups || {}).map(pg => [
+      `<span class="mono">${esc((pg.pg_id || "").slice(0, 12))}</span>`,
+      badge(pg.state), esc(pg.strategy || ""),
+      esc(JSON.stringify(pg.bundles || []))]));
+}
+
+window.tailJob = (sid) => { followJob = sid || null; logOffset = 0;
+                            refresh(); };
+document.addEventListener("click", (e) => {
+  const a = e.target.closest("a.joblink[data-sid]");
+  if (a) tailJob(a.dataset.sid);
+});
+
+const RENDER = {"Overview": renderOverview, "Nodes": renderNodes,
+  "Actors": renderActors, "Tasks": renderTasks, "Jobs": renderJobs,
+  "Serve": renderServe, "Placement Groups": renderPGs};
+
+async function pollLog() {
+  if (tab !== "Jobs" || !followJob) return;
+  const d = await J(`/api/jobs/${encodeURIComponent(followJob)}` +
+                    `/logs?offset=${logOffset}`);
+  const el = $("log");
+  if (el && d.text) {
+    el.textContent += d.text;
+    el.scrollTop = el.scrollHeight;
+  }
+  logOffset = d.offset ?? logOffset;
+}
+
+async function refresh() {
+  try {
+    const html = await RENDER[tab]();
+    const logEl = $("log");
+    const keep = logEl ? logEl.textContent : "";
+    $("main").innerHTML = html;
+    if ($("log") && keep) { $("log").textContent = keep;
+                            $("log").scrollTop = $("log").scrollHeight; }
+    await pollLog();
+    $("tick").textContent =
+      "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    $("tick").textContent = "refresh failed: " + e;
+  }
+}
+
+function setTab(t) {
+  tab = t; location.hash = t;
+  document.querySelectorAll("nav button").forEach(b =>
+    b.classList.toggle("active", b.textContent === t));
+  $("main").innerHTML = "loading…";
+  refresh();
+}
+
+$("nav").innerHTML = TABS.map(t => `<button>${t}</button>`).join("");
+document.querySelectorAll("nav button").forEach(b =>
+  b.addEventListener("click", () => setTab(b.textContent)));
+J("/api/version").then(v =>
+  $("meta").textContent = `v${v.version}`).catch(() => {});
+setTab(TABS.includes(tab) ? tab : "Overview");
+timer = setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -142,11 +142,42 @@ def set_global_worker(worker: Optional["CoreWorker"]) -> None:
     _global_worker = worker
 
 
+_cb_queue: "SimpleQueue" = None
+_cb_lock = threading.Lock()
+
+
+def _dispatch_callback(cb) -> None:
+    """Ready callbacks run on one dedicated dispatcher thread, never on
+    the thread that called set(): reply-processing paths hold _owned_lock
+    when entries become ready, and a callback that blocked (or re-entered
+    a CoreWorker API) there would stall every get/put/submit."""
+    global _cb_queue
+    if _cb_queue is None:
+        with _cb_lock:
+            if _cb_queue is None:
+                from queue import SimpleQueue
+                q = SimpleQueue()
+
+                def loop():
+                    while True:
+                        f = q.get()
+                        try:
+                            f()
+                        except Exception:
+                            logger.exception("object ready callback failed")
+
+                threading.Thread(target=loop, daemon=True,
+                                 name="ready-callbacks").start()
+                _cb_queue = q
+    _cb_queue.put(cb)
+
+
 class _NotifyingEvent:
     """threading.Event + ready callbacks, fired exactly once on set().
     Library code (Serve handles, async bridges) registers callbacks
     instead of polling wait() loops — the reference's task-completion
-    callback path in core_worker's TaskManager."""
+    callback path in core_worker's TaskManager. Callbacks are invoked on
+    a shared dispatcher thread, not the setter's thread."""
 
     __slots__ = ("_ev", "_cbs", "_lock")
 
@@ -160,10 +191,7 @@ class _NotifyingEvent:
             self._ev.set()
             cbs, self._cbs = self._cbs, []
         for cb in cbs:
-            try:
-                cb()
-            except Exception:
-                logger.exception("object ready callback failed")
+            _dispatch_callback(cb)
 
     def add_callback(self, cb) -> bool:
         """Register cb to run on set(); returns False (not registered)
@@ -997,6 +1025,12 @@ class CoreWorker:
         if full not in self._fn_cache:
             self.gcs.kv_put(full, blob, overwrite=False)
             self._fn_cache[full] = func
+        # bound the id cache: drivers that build a fresh closure per
+        # submission would otherwise pin every one (and whatever arrays it
+        # captured) forever.  Dropping the maps just loses cache hits.
+        if len(self._fn_key_by_id) >= 4096:
+            self._fn_key_by_id.clear()
+            self._fn_id_pins.clear()
         self._fn_key_by_id[id(func)] = full
         self._fn_id_pins[id(func)] = func
         return full
@@ -1438,13 +1472,15 @@ class CoreWorker:
                 if isinstance(e, rpc.RemoteError):
                     self._store_task_error(spec, exc.RayTpuError(str(e)))
                     continue
-                # worker died mid-task: apply per-task retry accounting to
-                # this task and every other unacked in-flight push
-                failed = [(spec, retries)] + [(s, r) for s, r, _ in inflight]
+                # Worker died mid-task. The worker drains its FIFO
+                # serially, so only this oldest unacked push can have been
+                # executing — it alone is charged retry/OOM budget; the
+                # younger in-flight pushes never ran and requeue for free.
                 oom = self._lease_was_oom_killed(lease)
-                for fspec, fretries in reversed(failed):
-                    self._retry_or_fail_dead_worker(st, fspec, fretries,
-                                                    oom, e)
+                with self._sched_lock:
+                    for s, r, _ in reversed(inflight):
+                        st["queue"].appendleft((s, r))
+                self._retry_or_fail_dead_worker(st, spec, retries, oom, e)
                 with self._sched_lock:
                     st["leases"].remove(lease)
                 try:

@@ -61,6 +61,8 @@ class HTTPProxyActor:
                 return self._get_handle(deployment).remote(payload)
 
             try:
+                import time as _time
+                deadline = _time.monotonic() + 60.0
                 ref = await loop.run_in_executor(None, submit)
                 fut = loop.create_future()
 
@@ -72,12 +74,14 @@ class HTTPProxyActor:
 
                 from ray_tpu.runtime.core_worker import get_global_worker
                 get_global_worker().add_ready_callback(ref, _on_ready)
-                await asyncio.wait_for(fut, timeout=60)
-                # ready means resolved, not necessarily local: a large
-                # result may still need a cross-node fetch, which must not
-                # run on the event loop
+                # one 60 s budget end to end: readiness wait + the fetch
+                # (a large result may still need a cross-node pull, which
+                # must not run on the event loop)
+                await asyncio.wait_for(
+                    fut, timeout=max(0.1, deadline - _time.monotonic()))
+                remaining = max(0.1, deadline - _time.monotonic())
                 result = await loop.run_in_executor(
-                    None, lambda: ray_tpu.get(ref, timeout=60))
+                    None, lambda: ray_tpu.get(ref, timeout=remaining))
             except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500
                 return web.json_response(
                     {"error": type(e).__name__, "message": str(e)},

@@ -56,15 +56,62 @@ def test_dashboard_endpoints(ray_start_regular):
         assert "dash_test_counter" in text
         assert "ray_tpu_cluster_nodes 1" in text
 
-        # "/" serves the HTML UI to browsers, JSON to API clients
+        # "/" serves the live HTML UI to browsers, JSON to API clients
         with urllib.request.urlopen(base + "/", timeout=10) as r:
             html = r.read().decode()
-        assert "<!doctype html>" in html and "ray_tpu dashboard" in html
+        assert "<!doctype html>" in html.lower()
+        assert "ray_tpu dashboard" in html
+        # the page is live: it polls every view without reload and can
+        # tail job logs (reference SPA pages list, dashboard/client/src)
+        assert "setInterval(refresh" in html
+        for tab_name in ("Nodes", "Actors", "Tasks", "Jobs", "Serve"):
+            assert f'"{tab_name}"' in html
+        assert "tailJob" in html
         req = urllib.request.Request(base + "/",
                                      headers={"Accept": "application/json"})
         with urllib.request.urlopen(req, timeout=10) as r:
             import json as _json
             assert "routes" in _json.loads(r.read())
+    finally:
+        head.stop()
+
+
+def test_dashboard_job_log_tail(ray_start_regular):
+    """The offset-based log endpoint returns only the delta, so the live
+    page can stream a running job's logs."""
+    import json as _json
+
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    host, port = ray_tpu.context()["gcs_address"].rsplit(":", 1)
+    head = start_dashboard((host, int(port)), port=0)
+    try:
+        base = f"http://{head.host}:{head.port}"
+        client = JobSubmissionClient()
+        sid = client.submit_job(
+            entrypoint="python -c \"import time\n"
+                       "for i in range(6):\n"
+                       "    print('tick', i, flush=True)\n"
+                       "    time.sleep(0.5)\"")
+        deadline = time.monotonic() + 60
+        got, offset = "", 0
+        while time.monotonic() < deadline and "tick 5" not in got:
+            with urllib.request.urlopen(
+                    f"{base}/api/jobs/{sid}/logs?offset={offset}",
+                    timeout=10) as r:
+                d = _json.loads(r.read())
+            assert offset == 0 or "tick 0" not in d["text"], \
+                "offset fetch must return only the delta"
+            got += d["text"]
+            offset = d["offset"]
+            time.sleep(0.4)
+        assert all(f"tick {i}" in got for i in range(6)), got
+        # plain fetch still returns the whole text
+        with urllib.request.urlopen(f"{base}/api/jobs/{sid}/logs",
+                                    timeout=10) as r:
+            assert "tick 0" in r.read().decode()
     finally:
         head.stop()
 
