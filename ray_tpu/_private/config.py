@@ -72,6 +72,13 @@ _declare("object_spill_failure_rate", float, 0.0,
          "FlakyStorage; spilling retries on the next scan).")
 _declare("object_spill_slow_ms", float, 0.0,
          "Injected latency per spill-storage operation in milliseconds.")
+_declare("local_fs_capacity_threshold", float, 0.95,
+         "Disk-usage fraction above which spill/fallback writes fail "
+         "gracefully instead of filling the disk (reference "
+         "local_fs_capacity_threshold, file_system_monitor.h).")
+_declare("fs_monitor_test_usage_path", str, "",
+         "Fault-injection seam: path of a file holding a float disk-usage "
+         "fraction the filesystem monitor reads instead of statvfs.")
 _declare("object_transfer_chunk_bytes", int, 8 * 1024 * 1024,
          "Inter-node object pushes move in chunks of this size (bounds "
          "per-message memory; cf. reference object_manager chunked Push).")

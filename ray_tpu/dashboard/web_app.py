@@ -94,11 +94,11 @@ const J = async (url) => (await fetch(url)).json();
 function statusCls(s) {
   s = String(s || "").toUpperCase();
   if (["ALIVE", "RUNNING", "SUCCEEDED", "CREATED", "HEALTHY", "FINISHED",
-       "true", "TRUE"].includes(s)) return "s-ok";
+       "TRUE"].includes(s)) return "s-ok";
   if (["PENDING", "PENDING_CREATION", "RESTARTING", "UPDATING",
        "SUBMITTED"].includes(s)) return "s-warn";
-  if (["DEAD", "FAILED", "ERROR", "STOPPED", "false",
-       "FALSE"].includes(s)) return "s-bad";
+  if (["DEAD", "FAILED", "ERROR", "STOPPED", "FALSE"].includes(s))
+    return "s-bad";
   return "s-mut";
 }
 const badge = (s) => `<span class="${statusCls(s)}"><span class="dot">` +
@@ -218,10 +218,11 @@ const RENDER = {"Overview": renderOverview, "Nodes": renderNodes,
   "Actors": renderActors, "Tasks": renderTasks, "Jobs": renderJobs,
   "Serve": renderServe, "Placement Groups": renderPGs};
 
-async function pollLog() {
+async function pollLog(g) {
   if (tab !== "Jobs" || !followJob) return;
   const d = await J(`/api/jobs/${encodeURIComponent(followJob)}` +
                     `/logs?offset=${logOffset}`);
+  if (g !== gen) return;   // a newer refresh owns the log pane now
   const el = $("log");
   if (el && d.text) {
     el.textContent += d.text;
@@ -230,19 +231,22 @@ async function pollLog() {
   logOffset = d.offset ?? logOffset;
 }
 
+let gen = 0;   // invalidates in-flight refreshes on tab switch / re-entry
 async function refresh() {
+  const g = ++gen;
   try {
     const html = await RENDER[tab]();
+    if (g !== gen) return;   // superseded: don't overwrite newer content
     const logEl = $("log");
     const keep = logEl ? logEl.textContent : "";
     $("main").innerHTML = html;
     if ($("log") && keep) { $("log").textContent = keep;
                             $("log").scrollTop = $("log").scrollHeight; }
-    await pollLog();
+    await pollLog(g);
     $("tick").textContent =
       "updated " + new Date().toLocaleTimeString();
   } catch (e) {
-    $("tick").textContent = "refresh failed: " + e;
+    if (g === gen) $("tick").textContent = "refresh failed: " + e;
   }
 }
 

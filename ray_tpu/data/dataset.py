@@ -66,12 +66,23 @@ class _AllToAll:
 
 
 def _apply_block_fn(fn, block):
-    return fn(block)
+    """-> (block, meta): block tasks return their output plus measured
+    per-block stats as a second return slot (ds.stats() plumbing)."""
+    import time as _time
+    from ray_tpu.data import _stats
+    w0, c0 = _time.perf_counter(), _time.process_time()
+    out = fn(block)
+    return out, _stats.block_meta(out, w0, c0)
 
 
 def _read_and_apply(task: ReadTask, fn):
+    import time as _time
+    from ray_tpu.data import _stats
+    w0, c0 = _time.perf_counter(), _time.process_time()
     block = task()
-    return fn(block) if fn is not None else block
+    if fn is not None:
+        block = fn(block)
+    return block, _stats.block_meta(block, w0, c0)
 
 
 class _BlockWorker:
@@ -81,32 +92,37 @@ class _BlockWorker:
         self._fn = fn
 
     def apply(self, block):
-        return self._fn(block)
+        return _apply_block_fn(self._fn, block)
 
 
 class ExecutionPlan:
     def __init__(self, read_tasks: Optional[List[ReadTask]] = None,
-                 block_refs: Optional[List[Any]] = None):
+                 block_refs: Optional[List[Any]] = None,
+                 stats_parent=None):
         assert (read_tasks is None) != (block_refs is None)
+        from ray_tpu.data._stats import DatasetStats
         self._read_tasks = read_tasks
         self._input_refs = block_refs
         self._stages: List[Any] = []
         self._cache: Optional[List[Any]] = None
+        self.stats = DatasetStats(parent=stats_parent)
 
     def with_stage(self, stage) -> "ExecutionPlan":
-        p = ExecutionPlan(self._read_tasks,
-                          self._input_refs) if self._cache is None \
-            else ExecutionPlan(read_tasks=None, block_refs=self._cache)
         if self._cache is None:
+            p = ExecutionPlan(self._read_tasks, self._input_refs)
             p._stages = list(self._stages)
+        else:
+            # derived dataset continues from this one's materialized
+            # blocks; carry the stats so ds.stats() shows the full chain
+            p = ExecutionPlan(read_tasks=None, block_refs=self._cache,
+                              stats_parent=self.stats)
         p._stages.append(stage)
         return p
 
     def execute(self) -> List[Any]:
         if self._cache is not None:
             return self._cache
-        import ray_tpu
-        from ray_tpu.data import _stats
+        import time as _time
 
         # fuse consecutive one-to-one stages
         fused: List[Any] = []
@@ -122,36 +138,58 @@ class ExecutionPlan:
         idx = 0
         if self._read_tasks is not None:
             # fuse the first run of one-to-one stages into the read tasks
+            # — but never an actor-pool stage (a model must instantiate
+            # once per actor, not once per block) or one with a bigger
+            # resource request than the read's num_cpus=1
             first_fn = None
-            if fused and isinstance(fused[0], _OneToOne):
+            if fused and isinstance(fused[0], _OneToOne) \
+                    and not isinstance(fused[0].compute,
+                                       ActorPoolStrategy) \
+                    and fused[0].num_cpus <= 1:
                 first_fn = fused[0].fn
                 idx = 1
             name = "read" if first_fn is None else f"read->{fused[0].name}"
-            with _stats.timed(name):
-                remote_read = ray_tpu.remote(num_cpus=1)(_read_and_apply)
-                refs = [remote_read.remote(t, first_fn)
-                        for t in self._read_tasks]
+            import ray_tpu
+            t0 = _time.perf_counter()
+            remote_read = ray_tpu.remote(num_cpus=1,
+                                         num_returns=2)(_read_and_apply)
+            pairs = [remote_read.remote(t, first_fn)
+                     for t in self._read_tasks]
+            refs = [p[0] for p in pairs]
+            self.stats.record_stage(name, _time.perf_counter() - t0,
+                                    meta_refs=[p[1] for p in pairs])
         else:
             refs = list(self._input_refs)
 
         for st in fused[idx:]:
-            with _stats.timed(st.name):
-                if isinstance(st, _OneToOne):
-                    refs = self._run_one_to_one(st, refs)
-                else:
-                    refs = st.fn(refs)
+            t0 = _time.perf_counter()
+            if isinstance(st, _OneToOne):
+                refs, metas = self._run_one_to_one(st, refs)
+                self.stats.record_stage(st.name,
+                                        _time.perf_counter() - t0,
+                                        meta_refs=metas)
+            else:
+                refs = st.fn(refs)
+                self.stats.record_stage(st.name,
+                                        _time.perf_counter() - t0,
+                                        block_count=len(refs))
         self._cache = refs
         return refs
 
-    def _run_one_to_one(self, st: _OneToOne, refs: List[Any]) -> List[Any]:
+    def _run_one_to_one(self, st: _OneToOne, refs: List[Any]):
+        """-> (block refs, meta refs): every block task yields its stats
+        in a second return slot."""
         import ray_tpu
         if isinstance(st.compute, ActorPoolStrategy):
             pool_size = min(st.compute.max_size, max(len(refs), 1))
             actor_cls = ray_tpu.remote(num_cpus=st.num_cpus)(_BlockWorker)
             actors = [actor_cls.remote(st.fn) for _ in range(pool_size)]
-            out = []
+            out, metas = [], []
             for i, ref in enumerate(refs):
-                out.append(actors[i % pool_size].apply.remote(ref))
+                b, m = actors[i % pool_size].apply \
+                    .options(num_returns=2).remote(ref)
+                out.append(b)
+                metas.append(m)
             # keep actor handles alive until results land
             ray_tpu.wait(out, num_returns=len(out))
             for a in actors:
@@ -159,9 +197,11 @@ class ExecutionPlan:
                     ray_tpu.kill(a)
                 except Exception:
                     pass
-            return out
-        remote_fn = ray_tpu.remote(num_cpus=st.num_cpus)(_apply_block_fn)
-        return [remote_fn.remote(st.fn, ref) for ref in refs]
+            return out, metas
+        remote_fn = ray_tpu.remote(num_cpus=st.num_cpus,
+                                   num_returns=2)(_apply_block_fn)
+        pairs = [remote_fn.remote(st.fn, ref) for ref in refs]
+        return [p[0] for p in pairs], [p[1] for p in pairs]
 
     def num_blocks_hint(self) -> int:
         if self._cache is not None:
@@ -592,8 +632,11 @@ class Dataset:
         return DatasetPipeline.from_dataset_repeated(self, times)
 
     def stats(self) -> str:
-        from ray_tpu.data import _stats
-        return _stats.summary()
+        """Per-stage execution report: blocks, driver wall time, remote
+        wall/CPU time, output rows and bytes (reference ds.stats(),
+        data/_internal/stats.py:161). Executes the plan if needed."""
+        self._plan.execute()
+        return self._plan.stats.summary()
 
     def __repr__(self):
         return f"Dataset(num_blocks={self.num_blocks()})"

@@ -55,6 +55,11 @@ class ObjectStoreFullError(RayTpuError):
     """The shared-memory store could not admit the object."""
 
 
+class OutOfDiskError(RayTpuError):
+    """Local disk crossed local_fs_capacity_threshold: spilling and
+    fallback allocation refuse to write (reference OutOfDiskError)."""
+
+
 class OutOfMemoryError(RayTpuError):
     """A worker was killed by the memory monitor (cf. OutOfMemoryError)."""
 

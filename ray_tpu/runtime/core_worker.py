@@ -598,8 +598,15 @@ class CoreWorker:
         (same host, shared filesystem) and register it; fetches stream or
         restore it like any spilled object."""
         import os
-        spill_dir = self._raylet.call("spill_dir", {},
-                                      timeout=CONFIG.raylet_rpc_timeout_s)
+        try:
+            spill_dir = self._raylet.call(
+                "spill_dir", {}, timeout=CONFIG.raylet_rpc_timeout_s)
+        except rpc.RemoteError as e:
+            if "out of disk" in str(e):
+                # shm full AND disk full: degrade with a clear error
+                # instead of a hang (reference OutOfDiskError)
+                raise exc.OutOfDiskError(str(e)) from None
+            raise
         path = os.path.join(spill_dir, oid.hex())
         tmp = f"{path}.tmp{os.getpid()}"
         total = ser.serialized_size(head, views)
@@ -1025,12 +1032,16 @@ class CoreWorker:
         if full not in self._fn_cache:
             self.gcs.kv_put(full, blob, overwrite=False)
             self._fn_cache[full] = func
-        # bound the id cache: drivers that build a fresh closure per
+        # bound the local caches: drivers that build a fresh closure per
         # submission would otherwise pin every one (and whatever arrays it
-        # captured) forever.  Dropping the maps just loses cache hits.
+        # captured) forever.  Dropping them just costs cache hits — the
+        # blobs stay exported in GCS KV for the job's lifetime, like the
+        # reference's per-job function table.
         if len(self._fn_key_by_id) >= 4096:
             self._fn_key_by_id.clear()
             self._fn_id_pins.clear()
+        if len(self._fn_cache) >= 4096:
+            self._fn_cache.clear()
         self._fn_key_by_id[id(func)] = full
         self._fn_id_pins[id(func)] = func
         return full

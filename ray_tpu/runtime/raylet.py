@@ -152,6 +152,10 @@ class Raylet:
                 self._spill_store, failure_rate=fail_rate, slow_ms=slow_ms)
         self._fs_store = _storage.FileStorage()
         self._fallback_local: set = set()  # oids whose bytes are local files
+        # disk-full protection for spill/fallback writes (reference
+        # FileSystemMonitor, src/ray/common/file_system_monitor.h)
+        from ray_tpu._private.file_system_monitor import FileSystemMonitor
+        self._fs_monitor = FileSystemMonitor(self._spill_dir)
         self._spilled: Dict[bytes, Tuple[int, int]] = {}  # oid -> (size, meta)
         # frees that couldn't complete yet (object pinned, e.g. mid-spill);
         # retried by the spill loop so a free racing a spill can't leak the
@@ -365,6 +369,8 @@ class Raylet:
             return freed
 
     def _spill_one(self, oid, size: int) -> bool:
+        if not CONFIG.object_spill_uri and self._fs_monitor.over_capacity():
+            return False  # disk full: keep the shm copy, fail gracefully
         with self._lock:
             if oid.binary() in self._deferred_frees:
                 return False  # being freed: spilling it would leak the file
@@ -505,6 +511,11 @@ class Raylet:
 
     def _rpc_spill_dir(self, conn, p):
         """Clients writing fallback-allocated primaries need the dir."""
+        if self._fs_monitor.over_capacity():
+            raise rpc.RpcError(
+                "out of disk: local filesystem is above "
+                f"{CONFIG.local_fs_capacity_threshold:.0%} capacity; "
+                "fallback allocation refused")
         return self._spill_dir
 
     def _rpc_register_spilled(self, conn, p):

@@ -301,3 +301,21 @@ def test_map_can_change_row_schema(ray_start_regular):
     rows = out.take(3)
     assert set(rows[0]) == {"x", "y"}
     assert out.count() == 30
+
+
+def test_dataset_stats_per_stage(ray_start_regular):
+    """ds.stats() reports per-stage blocks, driver/remote wall, CPU,
+    rows, and bytes (reference DatasetStats, data/_internal/stats.py)."""
+    from ray_tpu import data
+
+    ds = data.range(400, parallelism=4).map(lambda r: {"id": r["id"] + 1})
+    report = ds.stats()
+    assert "Stage read->map" in report
+    assert "remote wall time" in report
+    assert "remote cpu time" in report
+    assert "total=400" in report          # output rows across blocks
+    assert "output size (bytes)" in report
+    # a derived dataset keeps the whole chain in its report
+    ds2 = ds.filter(lambda r: r["id"] % 2 == 0)
+    report2 = ds2.stats()
+    assert "Stage read->map" in report2 and "filter" in report2

@@ -156,3 +156,34 @@ def test_data_read_write_uri(ray_start_regular, tmp_path):
     ds.write_csv(csv_uri)
     back_csv = data.read_csv(csv_uri)
     assert back_csv.count() == 100
+
+
+def test_disk_full_fails_spills_gracefully(tmp_path):
+    """With the filesystem monitor reporting a full disk, spilling stops
+    (objects stay in shm) and a put that needs fallback allocation
+    raises OutOfDiskError instead of hanging (reference
+    file_system_monitor.h + OutOfDiskError)."""
+    usage_file = tmp_path / "usage"
+    usage_file.write_text("0.99")   # injected: disk is 99% full
+    ray_tpu.init(system_config={
+        "object_store_memory_bytes": 24 * 1024 * 1024,
+        "fs_monitor_test_usage_path": str(usage_file),
+    })
+    try:
+        from ray_tpu.exceptions import OutOfDiskError
+        refs = []
+        with pytest.raises(OutOfDiskError, match="out of disk"):
+            for i in range(40):   # 40 MB >> 24 MB store, spilling refused
+                refs.append(ray_tpu.put(
+                    np.full((1 << 20,), i, dtype=np.uint8)))
+        # what made it into shm is still readable
+        assert ray_tpu.get(refs[0])[0] == 0
+        # freeing space re-enables spilling: the same overflow now works
+        usage_file.write_text("0.2")
+        import time
+        time.sleep(1.2)  # monitor check interval
+        more = [ray_tpu.put(np.full((1 << 20,), 7, dtype=np.uint8))
+                for _ in range(30)]
+        assert all(ray_tpu.get(m)[0] == 7 for m in more)
+    finally:
+        ray_tpu.shutdown()
