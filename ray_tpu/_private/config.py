@@ -79,6 +79,13 @@ _declare("local_fs_capacity_threshold", float, 0.95,
 _declare("fs_monitor_test_usage_path", str, "",
          "Fault-injection seam: path of a file holding a float disk-usage "
          "fraction the filesystem monitor reads instead of statvfs.")
+_declare("runtime_env_cache_dir", str, "",
+         "Directory caching per-requirement-set pip venvs; empty means "
+         "/tmp/ray_tpu_runtime_envs (reference URI cache, uri_cache.py).")
+_declare("runtime_env_pip_find_links", str, "",
+         "Local wheelhouse for pip runtime envs: installs run with "
+         "--no-index --find-links here (zero-egress seam; unset uses the "
+         "normal package index).")
 _declare("object_transfer_chunk_bytes", int, 8 * 1024 * 1024,
          "Inter-node object pushes move in chunks of this size (bounds "
          "per-message memory; cf. reference object_manager chunked Push).")

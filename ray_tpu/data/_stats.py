@@ -49,11 +49,17 @@ class _StageStats:
     def _metas(self) -> List[Dict]:
         if self._resolved is None:
             import ray_tpu
-            try:
-                self._resolved = [m for m in ray_tpu.get(
-                    list(self.meta_refs), timeout=60) if m]
-            except Exception:
-                self._resolved = []
+            out = []
+            for ref in self.meta_refs:
+                # per-ref: one lost block's meta (node death mid-chaos)
+                # must not discard every other block's measurements
+                try:
+                    m = ray_tpu.get(ref, timeout=30)
+                except Exception:
+                    continue
+                if m:
+                    out.append(m)
+            self._resolved = out
         return self._resolved
 
     def report(self) -> str:

@@ -649,15 +649,21 @@ class Raylet:
             return {"oom": p.get("worker_id") in self._oom_kills}
 
     def _reap_loop(self) -> None:
-        """Detect dead worker processes (cf. WorkerPool child monitoring)."""
+        """Detect dead worker processes (cf. WorkerPool child monitoring).
+        The loop must survive anything dispatch raises downstream — a
+        dead reaper means dead workers are never detected again."""
         while not self._stopped.wait(0.1):
-            with self._lock:
-                handles = list(self._workers.values())
-            for h in handles:
-                if h.proc.poll() is not None:
-                    self._on_worker_dead(h.worker_id.hex(),
-                                         f"exit code {h.proc.returncode}")
-            self._trim_idle_workers()
+            try:
+                with self._lock:
+                    handles = list(self._workers.values())
+                for h in handles:
+                    if h.proc.poll() is not None:
+                        self._on_worker_dead(
+                            h.worker_id.hex(),
+                            f"exit code {h.proc.returncode}")
+                self._trim_idle_workers()
+            except Exception:
+                logger.exception("worker reap pass failed")
 
     def _trim_idle_workers(self) -> None:
         max_idle = CONFIG.worker_pool_max_idle
@@ -692,10 +698,26 @@ class Raylet:
         env["RAY_TPU_SYSTEM_CONFIG"] = CONFIG.overrides_env_blob()
         env["PYTHONPATH"] = package_pythonpath() + (
             os.pathsep + user_pp if user_pp else "")
+        # a pip runtime env swaps the interpreter for its venv's python
+        # (reference PipProcessor + exec hook): isolation is real — the
+        # worker process itself runs inside the env, and the venv's
+        # site-packages goes FIRST on PYTHONPATH so pinned versions beat
+        # any same-named packages living next to ray_tpu
+        python = sys.executable
+        renv_json = (env_overrides or {}).get("RAY_TPU_RUNTIME_ENV")
+        if renv_json:
+            import json as _json
+            pip_reqs = _json.loads(renv_json).get("pip")
+            if pip_reqs:
+                from ray_tpu.runtime_env.pip import (ensure_pip_env,
+                                                     venv_site_packages)
+                python = ensure_pip_env(pip_reqs)
+                env["PYTHONPATH"] = venv_site_packages(python) + \
+                    os.pathsep + env["PYTHONPATH"]
         log_prefix = os.path.join(self.session_dir, "logs",
                                   f"worker-{worker_id.hex()[:12]}")
         os.makedirs(os.path.dirname(log_prefix), exist_ok=True)
-        cmd = [sys.executable, "-m", "ray_tpu.runtime.worker_main",
+        cmd = [python, "-m", "ray_tpu.runtime.worker_main",
                "--raylet-host", self.address[0],
                "--raylet-port", str(self.address[1]),
                "--worker-id", worker_id.hex(),
@@ -861,6 +883,16 @@ class Raylet:
         need.setdefault("CPU", 1.0)
         bundle = p.get("bundle")  # [pg_id_hex, index] -> lease from the pool
         pool_key = f"{bundle[0]}:{int(bundle[1])}" if bundle else None
+        # cold pip-env builds run here, on the requester's own RPC thread
+        # (its lease call is what's waiting) — never inside
+        # _dispatch_pending, which register/reap paths also drive
+        renv = p.get("env")
+        if renv and renv.get("pip"):
+            from ray_tpu.runtime_env.pip import ensure_pip_env
+            try:
+                ensure_pip_env(renv["pip"])
+            except Exception as e:
+                raise rpc.RpcError(f"runtime env setup failed: {e}")
         if pool_key is not None:
             with self._res_lock:
                 if pool_key not in self._bundle_pools:
@@ -956,9 +988,19 @@ class Raylet:
                     if handle is not None:
                         break
             if handle is None:
-                handle = self._spawn_worker(
-                    req["job_id"],
-                    self._merged_env(need, req.get("env")))
+                try:
+                    handle = self._spawn_worker(
+                        req["job_id"],
+                        self._merged_env(need, req.get("env")))
+                except Exception as e:
+                    # e.g. pip runtime-env build failure: the lease's
+                    # resources must return and the requester must hear
+                    # a clean error, not a stall
+                    logger.error("worker spawn failed: %s", e)
+                    self._give_back(need, pool_key)
+                    req["out"]["error"] = f"worker spawn failed: {e}"
+                    req["event"].set()
+                    continue
                 if not self._wait_worker_ready(handle):
                     self._give_back(need, pool_key)
                     req["out"]["error"] = "worker failed to start"
@@ -1032,10 +1074,21 @@ class Raylet:
         need.setdefault("CPU", 1.0)
         bundle = p.get("bundle")
         pool_key = f"{bundle[0]}:{int(bundle[1])}" if bundle else None
+        renv = p.get("runtime_env")
+        if renv and renv.get("pip"):
+            from ray_tpu.runtime_env.pip import ensure_pip_env
+            try:
+                ensure_pip_env(renv["pip"])   # cold build before resources
+            except Exception as e:
+                raise rpc.RpcError(f"runtime env setup failed: {e}")
         if not self._try_acquire(need, pool_key):
             raise rpc.RpcError("resources unavailable for actor")
-        handle = self._spawn_worker(
-            None, self._merged_env(need, p.get("runtime_env")))
+        try:
+            handle = self._spawn_worker(
+                None, self._merged_env(need, p.get("runtime_env")))
+        except Exception as e:
+            self._give_back(need, pool_key)
+            raise rpc.RpcError(f"actor worker spawn failed: {e}")
         if not self._wait_worker_ready(handle):
             self._give_back(need, pool_key)
             raise rpc.RpcError("actor worker failed to start")

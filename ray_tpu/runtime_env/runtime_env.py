@@ -2,10 +2,11 @@
 
 Analog of /root/reference/python/ray/runtime_env/runtime_env.py (RuntimeEnv
 class) + _private/runtime_env/ plugins. TPU-native scope: env_vars,
-working_dir, and py_modules ship code/config through the GCS KV; `pip` /
-`conda` are validated but rejected — TPU pods run hermetic images with no
-package egress, so dependencies must be baked into the image (the
-container-image analog of the reference's `container` plugin).
+working_dir, and py_modules ship code/config through the GCS KV; ``pip``
+gives CPU-side workers per-env dependency isolation via cached local
+venvs (runtime_env/pip.py — TPU-pod images should still bake heavy deps);
+``conda`` stays rejected (no conda in hermetic images; the reference's
+container plugin is the analog there).
 """
 
 from __future__ import annotations
@@ -49,11 +50,14 @@ class RuntimeEnv(dict):
                 if not os.path.exists(m):
                     raise ValueError(f"py_module {m!r} not found")
             self["py_modules"] = list(py_modules)
-        if pip or conda:
+        if pip:
+            from ray_tpu.runtime_env.pip import normalize_pip_field
+            self["pip"] = normalize_pip_field(pip)
+        if conda:
             raise ValueError(
-                "pip/conda runtime envs are not supported on TPU pods: "
-                "images are hermetic (no package egress). Bake Python "
-                "dependencies into the container image instead.")
+                "conda runtime envs are not supported: images are "
+                "hermetic (no conda). Use pip (isolated local venv) or "
+                "bake dependencies into the container image.")
         if config:
             self["config"] = dict(config)
 
@@ -85,6 +89,8 @@ def prepare_runtime_env(raw: Optional[Dict[str, Any]], gcs
     if env.get("py_modules"):
         desc["py_modules"] = [packaging.upload_package(gcs, m)
                               for m in env["py_modules"]]
+    if env.get("pip"):
+        desc["pip"] = list(env["pip"])
     if env.get("config"):
         desc["config"] = dict(env["config"])
     if not desc:

@@ -72,3 +72,65 @@ def test_actor_survives_chaos_with_restarts(ray_start_cluster):
                 raise
             time.sleep(0.5)
     ray_tpu.shutdown()
+
+
+def test_shuffle_survives_node_kills_mid_transfer(ray_start_cluster,
+                                                  monkeypatch):
+    """A multi-node random_shuffle completes correctly while the NodeKiller
+    fires every few seconds: blocks are mid-chunked-transfer when their
+    nodes die (tiny transfer chunks force multi-chunk pulls), so recovery
+    exercises _restore_one/_try_reconstruct under real racing (reference
+    chaos shuffle runs, test_utils.py:1301 NodeKillerActor)."""
+    # 128 KiB chunks: a 1 MiB block moves in 8 chunks per pull
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES", "131072")
+    cluster = ray_start_cluster
+    head_id = cluster.head_node.node_id
+    for _ in range(2):
+        cluster.add_node(resources={"CPU": 2})
+    cluster.wait_for_nodes(3)
+    ray_tpu.init(num_cpus=1, address=cluster.address)
+    from ray_tpu import data
+
+    n = 1 << 18   # 256k rows -> 8 blocks x ~256 KiB
+    killer = NodeKiller(cluster.gcs_address, protected_node_ids=[head_id],
+                        interval_s=4.0, max_kills=2, seed=11).start()
+    try:
+        shuffled = data.range(n, parallelism=8).random_shuffle(seed=5)
+        # replacement capacity joins while the killer is live
+        time.sleep(2.0)
+        cluster.add_node(resources={"CPU": 2})
+        total = shuffled.count()
+        # correctness, not just liveness: every row exactly once
+        parts = shuffled.map_batches(
+            lambda b: {"s": np.asarray([b["id"].sum()], dtype=np.int64)})
+        checksum = sum(int(r["s"]) for r in parts.take_all())
+    finally:
+        killer.stop()
+    assert len(killer.kills) >= 1, "chaos never fired"
+    assert total == n
+    assert checksum == n * (n - 1) // 2
+    ray_tpu.shutdown()
+
+
+def test_shuffle_with_unstable_slow_spill_storage(monkeypatch):
+    """A shuffle whose working set overflows the store completes with 30%
+    of spill writes failing and injected spill latency underneath
+    (reference UnstableFileStorage/SlowFileStorage chaos cases,
+    external_storage.py:587/608)."""
+    import ray_tpu as rt
+    rt.init(num_cpus=4, system_config={
+        "object_store_memory_bytes": 24 * 1024 * 1024,
+        "object_spill_failure_rate": 0.3,
+        "object_spill_slow_ms": 20.0,
+    })
+    try:
+        from ray_tpu import data
+        n = 1 << 19   # ~4 MiB x 12 blocks round-tripping through spill
+        ds = data.range(n, parallelism=12).random_shuffle(seed=3)
+        assert ds.count() == n
+        parts = ds.map_batches(
+            lambda b: {"s": np.asarray([b["id"].sum()], dtype=np.int64)})
+        checksum = sum(int(r["s"]) for r in parts.take_all())
+        assert checksum == n * (n - 1) // 2
+    finally:
+        rt.shutdown()

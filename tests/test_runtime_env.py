@@ -14,8 +14,11 @@ def test_runtime_env_validation(tmp_path):
         RuntimeEnv(env_vars={"A": 1})
     with pytest.raises(ValueError):
         RuntimeEnv(working_dir=str(tmp_path / "nope"))
+    with pytest.raises(TypeError):
+        RuntimeEnv(pip="not-a-list")
     with pytest.raises(ValueError):
-        RuntimeEnv(pip=["requests"])
+        RuntimeEnv(conda={"dependencies": ["x"]})
+    assert RuntimeEnv(pip=["b", "a"])["pip"] == ["a", "b"]
     with pytest.raises(ValueError):
         RuntimeEnv.from_dict({"bogus_field": 1})
     env = RuntimeEnv(env_vars={"A": "1"}, working_dir=str(tmp_path))
@@ -70,3 +73,87 @@ def test_env_vars_in_actor(ray_start_regular):
 
     a = Probe.remote()
     assert ray_tpu.get(a.read.remote()) == "yes"
+
+
+def _make_wheel(wheel_dir, name, version):
+    """Minimal hand-built wheel (no build backend needed: zero egress)."""
+    import os
+    import zipfile
+    os.makedirs(wheel_dir, exist_ok=True)
+    whl = os.path.join(wheel_dir, f"{name}-{version}-py3-none-any.whl")
+    dist = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}/__init__.py": f'__version__ = "{version}"\n',
+        f"{dist}/METADATA": (f"Metadata-Version: 2.1\nName: {name}\n"
+                             f"Version: {version}\n"),
+        f"{dist}/WHEEL": ("Wheel-Version: 1.0\nGenerator: test\n"
+                          "Root-Is-Purelib: true\nTag: py3-none-any\n"),
+    }
+    record = "".join(f"{p},,\n" for p in files) + f"{dist}/RECORD,,\n"
+    files[f"{dist}/RECORD"] = record
+    with zipfile.ZipFile(whl, "w") as z:
+        for path, content in files.items():
+            z.writestr(path, content)
+    return whl
+
+
+def test_pip_runtime_env_conflicting_versions(tmp_path):
+    """Two tasks pin conflicting versions of the same package and run
+    concurrently, each inside its own cached venv (reference
+    PipProcessor, _private/runtime_env/pip.py:75; local wheelhouse keeps
+    the install zero-egress)."""
+    import ray_tpu
+
+    wheelhouse = str(tmp_path / "wheels")
+    _make_wheel(wheelhouse, "conflictpkg", "1.0.0")
+    _make_wheel(wheelhouse, "conflictpkg", "2.0.0")
+    ray_tpu.init(num_cpus=2, system_config={
+        "runtime_env_pip_find_links": wheelhouse,
+        "runtime_env_cache_dir": str(tmp_path / "env_cache"),
+    })
+    try:
+        @ray_tpu.remote
+        def which_version():
+            import conflictpkg
+            return conflictpkg.__version__
+
+        r1 = which_version.options(
+            runtime_env={"pip": ["conflictpkg==1.0.0"]}).remote()
+        r2 = which_version.options(
+            runtime_env={"pip": ["conflictpkg==2.0.0"]}).remote()
+        assert sorted(ray_tpu.get([r1, r2], timeout=240)) == \
+            ["1.0.0", "2.0.0"]
+
+        # the venvs are cached: a second round reuses them (fast path)
+        import time
+        t0 = time.monotonic()
+        r3 = which_version.options(
+            runtime_env={"pip": ["conflictpkg==1.0.0"]}).remote()
+        assert ray_tpu.get(r3, timeout=60) == "1.0.0"
+        assert time.monotonic() - t0 < 30
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_pip_runtime_env_bad_package_fails_cleanly(tmp_path):
+    """An unresolvable pip requirement surfaces as a task error, not a
+    hang (reference RuntimeEnvSetupError path)."""
+    import pytest as _pytest
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=1, system_config={
+        "runtime_env_pip_find_links": str(tmp_path / "empty_wheels"),
+        "runtime_env_cache_dir": str(tmp_path / "env_cache2"),
+    })
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ref = f.options(
+            runtime_env={"pip": ["no-such-package==9.9.9"]}).remote()
+        with _pytest.raises(ray_tpu.exceptions.RayTpuError):
+            ray_tpu.get(ref, timeout=120)
+    finally:
+        ray_tpu.shutdown()
