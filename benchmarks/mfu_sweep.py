@@ -1,0 +1,62 @@
+"""One-variant MFU measurement for the gpt-large remat/chunk sweep.
+
+Run one configuration per process (fresh HBM + compile cache):
+  python benchmarks/mfu_sweep.py --policy block_outs --batch 8 --chunk 256
+Prints one JSON line; the sweep results are recorded in bench.py's
+comments and BENCH notes.
+"""
+
+import argparse
+import functools
+import json
+
+import jax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="gpt-large")
+    ap.add_argument("--policy", default="nothing")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    import bench
+    from ray_tpu.models import get_config
+    from ray_tpu.train.step import OptimizerConfig, lm_loss_chunked_fn
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "")
+    peak = next((v for k, v in bench.PEAK_FLOPS.items() if k in kind),
+                197e12)
+
+    # _bench_one re-imports lm_loss_chunked_fn at call time, so patching
+    # the module attribute injects our chunk size
+    loss_fn = functools.partial(lm_loss_chunked_fn, chunk_size=args.chunk)
+    try:
+        cfg = get_config(args.config, max_seq_len=1024, remat=True,
+                         remat_policy=args.policy, attention_impl="flash")
+        import ray_tpu.train.step as step_mod
+        orig = step_mod.lm_loss_chunked_fn
+        step_mod.lm_loss_chunked_fn = loss_fn
+        try:
+            res = bench._bench_one(
+                cfg, args.batch, 1024, steps=args.steps, warmup=3,
+                peak=peak,
+                optimizer=OptimizerConfig(warmup_steps=10, decay_steps=1000,
+                                          optimizer="adafactor"),
+                chunked=True)
+        finally:
+            step_mod.lm_loss_chunked_fn = orig
+        res.update({"policy": args.policy, "batch": args.batch,
+                    "chunk": args.chunk, "ok": True})
+    except Exception as e:
+        res = {"policy": args.policy, "batch": args.batch,
+               "chunk": args.chunk, "ok": False,
+               "error": f"{type(e).__name__}: {str(e)[:200]}"}
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Single-node scalability envelope — the stretch dimensions.
+
+Counterpart of the reference's single-node benchmark rows
+(release/benchmarks/README.md:27-31: many-args, many-returns, queued
+1M tasks, large objects on one node).  Sized for this box but the same
+structures: the owner table and per-key lease queue at 1M submissions
+(laddered — the reference drains the same way), argument staging at
+10k refs into one task, 1k return slots from one task, and a multi-GiB
+single object through the shm store.
+
+Writes the ``envelope`` section of MICROBENCH.json:
+    python benchmarks/scale_envelope.py [-o MICROBENCH.json]
+"""
+
+import argparse
+import json
+import os
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_1m_queued_tasks(n=1_000_000, wave=25_000):
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+
+    @ray_tpu.remote(num_cpus=1)
+    def echo(i):
+        return i
+
+    t0 = time.monotonic()
+    done = 0
+    ok = True
+    while done < n:
+        k = min(wave, n - done)
+        refs = [echo.remote(done + j) for j in range(k)]
+        vals = ray_tpu.get(refs, timeout=1800)
+        ok = ok and vals == list(range(done, done + k))
+        done += k
+        print(f"  [1m-tasks] {done}/{n}", flush=True)
+    dt = time.monotonic() - t0
+    ray_tpu.shutdown()
+    return {"name": "queued_tasks_1m", "count": n,
+            "seconds": round(dt, 1), "tasks_per_s": round(n / dt, 1),
+            "pass": ok, "reference": "1M queued tasks on one node "
+            "(release/benchmarks/README.md:31)"}
+
+
+def bench_10k_args():
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024 * 1024)
+
+    @ray_tpu.remote
+    def consume(*args):
+        return len(args), sum(args)
+
+    n = 10_000
+    t0 = time.monotonic()
+    refs = [ray_tpu.put(i) for i in range(n)]
+    count, total = ray_tpu.get(consume.remote(*refs), timeout=1800)
+    dt = time.monotonic() - t0
+    ray_tpu.shutdown()
+    return {"name": "args_10k_single_task", "count": n,
+            "seconds": round(dt, 1),
+            "pass": count == n and total == n * (n - 1) // 2,
+            "reference": "10k object args to a single task "
+            "(release/benchmarks/README.md:27)"}
+
+
+def bench_1k_returns():
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024 * 1024)
+
+    n = 1_000
+
+    @ray_tpu.remote(num_returns=n)
+    def produce():
+        return tuple(range(n))
+
+    t0 = time.monotonic()
+    refs = produce.remote()
+    vals = ray_tpu.get(list(refs), timeout=1800)
+    dt = time.monotonic() - t0
+    ray_tpu.shutdown()
+    return {"name": "returns_1k_single_task", "count": n,
+            "seconds": round(dt, 1), "pass": vals == list(range(n)),
+            "reference": "1k+ returns from a single task "
+            "(release/benchmarks/README.md:28, 3k on 64-core)"}
+
+
+def bench_multi_gib_object(gib=2):
+    import numpy as np
+
+    import ray_tpu
+    size = gib * (1 << 30)
+    ray_tpu.init(num_cpus=2,
+                 object_store_memory=size + (1 << 30))
+    t0 = time.monotonic()
+    arr = np.arange(size // 8, dtype=np.int64)
+    ref = ray_tpu.put(arr)
+    put_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    back = ray_tpu.get(ref, timeout=600)
+    get_s = time.monotonic() - t0
+    ok = back.shape == arr.shape and back[0] == 0 \
+        and int(back[-1]) == size // 8 - 1 \
+        and int(back[size // 16]) == size // 16
+    del arr, back, ref
+    ray_tpu.shutdown()
+    return {"name": "single_object_gib", "gib": gib,
+            "put_s": round(put_s, 2), "get_s": round(get_s, 2),
+            "pass": bool(ok),
+            "reference": "100 GiB objects on a 576 GB-RAM node "
+            "(release/benchmarks/README.md:30); scaled to this box"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output",
+                    default=os.path.join(REPO, "MICROBENCH.json"))
+    ap.add_argument("--tasks", type=int, default=1_000_000)
+    args = ap.parse_args()
+
+    rows = []
+    for fn in (lambda: bench_1m_queued_tasks(args.tasks),
+               bench_10k_args, bench_1k_returns, bench_multi_gib_object):
+        print(f"[envelope] {fn}", flush=True)
+        rows.append(fn())
+        print(json.dumps(rows[-1]), flush=True)
+
+    try:
+        with open(args.output) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    env = doc.setdefault("envelope", {})
+    env["stretch"] = rows
+    env["source"] = ("tests/test_scale_envelope.py (CI counts) + "
+                     "benchmarks/scale_envelope.py (stretch)")
+    with open(args.output, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"[envelope] wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

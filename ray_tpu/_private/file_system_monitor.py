@@ -24,12 +24,14 @@ logger = logging.getLogger(__name__)
 class FileSystemMonitor:
     def __init__(self, path: str,
                  capacity_threshold: float = None,
-                 check_interval_s: float = 1.0):
+                 check_interval_s: float = 1.0,
+                 on_over=None):
         self.path = path
         self.capacity_threshold = (
             CONFIG.local_fs_capacity_threshold
             if capacity_threshold is None else capacity_threshold)
         self.check_interval_s = check_interval_s
+        self.on_over = on_over   # fired once on each not-full->full edge
         self._last_check = 0.0
         self._last_usage = 0.0
         self._warned = False
@@ -64,6 +66,11 @@ class FileSystemMonitor:
                 "(threshold %.0f%%): object spilling and fallback "
                 "allocation are disabled until space frees up",
                 self.path, usage * 100, self.capacity_threshold * 100)
+            if self.on_over is not None:
+                try:
+                    self.on_over(usage)
+                except Exception:
+                    pass
         elif not over:
             self._warned = False
         return over

@@ -1,0 +1,68 @@
+"""In-process sampling profiler: folded stacks over a time window.
+
+Analog of the reference's on-demand py-spy CPU profiling of any worker
+(/root/reference/python/ray/dashboard/modules/reporter/reporter_agent.py:253
+``CpuProfilingManager``) without the external binary: every daemon and
+worker answers a ``profile`` RPC by sampling ``sys._current_frames()``
+for the requested window and returning flamegraph-ready folded stacks
+(``a;b;c count`` lines, collapse format), so ``ray-tpu profile`` can
+flame any live process in the cluster.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict
+
+
+def sample_folded(duration_s: float = 2.0,
+                  interval_s: float = 0.01,
+                  max_depth: int = 60) -> Dict[str, int]:
+    """Sample every thread's stack for ``duration_s``; returns
+    {folded_stack: samples}. Runs inside the target process (the RPC
+    thread doing the sampling excludes itself)."""
+    me = sys._getframe()  # marker: skip the sampler's own thread
+    counts: Dict[str, int] = {}
+    end = time.monotonic() + max(0.05, duration_s)
+    interval_s = max(0.001, interval_s)
+    while time.monotonic() < end:
+        for tid, frame in sys._current_frames().items():
+            f = frame
+            stack = []
+            skip = False
+            while f is not None and len(stack) < max_depth:
+                if f is me:
+                    skip = True
+                    break
+                code = f.f_code
+                fname = code.co_filename.rsplit("/", 1)[-1]
+                stack.append(f"{code.co_name} ({fname}:{f.f_lineno})")
+                f = f.f_back
+            if skip or not stack:
+                continue
+            key = ";".join(reversed(stack))
+            counts[key] = counts.get(key, 0) + 1
+        time.sleep(interval_s)
+    return counts
+
+
+def folded_text(counts: Dict[str, int]) -> str:
+    """Flamegraph collapse format, hottest first."""
+    return "\n".join(
+        f"{stack} {n}" for stack, n in
+        sorted(counts.items(), key=lambda kv: -kv[1]))
+
+
+def top_summary(counts: Dict[str, int], limit: int = 20) -> str:
+    """Human-readable leaf-frame ranking for terminal output."""
+    leaves: Dict[str, int] = {}
+    total = 0
+    for stack, n in counts.items():
+        leaf = stack.rsplit(";", 1)[-1]
+        leaves[leaf] = leaves.get(leaf, 0) + n
+        total += n
+    lines = [f"{total} samples"]
+    for leaf, n in sorted(leaves.items(), key=lambda kv: -kv[1])[:limit]:
+        lines.append(f"  {100 * n / max(1, total):5.1f}%  {leaf}")
+    return "\n".join(lines)

@@ -67,6 +67,8 @@ class DashboardHead:
             web.get("/api/jobs/{submission_id}/logs", self._job_logs),
             web.post("/api/jobs/{submission_id}/stop", self._job_stop),
             web.get("/api/serve/applications", self._serve_status),
+            web.get("/api/events", self._events),
+            web.get("/api/profile", self._profile),
             web.get("/metrics", self._metrics),
             web.get("/", self._index),
         ])
@@ -211,6 +213,69 @@ class DashboardHead:
 
         sid = await self._call(_submit)
         return web.json_response({"submission_id": sid})
+
+    # --------------------------------------------------------------- events
+    async def _events(self, request) -> web.Response:
+        """Structured component events (reference dashboard event view
+        over event.cc / event_logger.py emissions)."""
+        try:
+            limit = int(request.query.get("limit", 200))
+        except ValueError:
+            raise web.HTTPBadRequest(text="limit must be an integer") \
+                from None
+        sev = request.query.get("severity")
+        events = await self._call(
+            lambda: self.gcs.call("list_events",
+                                  {"limit": limit, "severity": sev}))
+        return web.json_response({"events": events})
+
+    # -------------------------------------------------------------- profile
+    async def _profile(self, request) -> web.Response:
+        """On-demand flame sampling of any cluster process (reference
+        reporter_agent CPU profiling): ?node_id=...[&worker_id=...]
+        [&duration=2][&format=folded|top]."""
+        node_prefix = request.query.get("node_id")
+        try:
+            # clamp: an unbounded duration would pin an executor thread
+            # and the target's sampler for its whole span
+            duration = min(60.0,
+                           float(request.query.get("duration", 2.0)))
+        except ValueError:
+            raise web.HTTPBadRequest(text="duration must be a number") \
+                from None
+        fmt = request.query.get("format", "folded")
+
+        def run():
+            from ray_tpu._private import rpc as _rpc
+            from ray_tpu._private.profiler import folded_text, top_summary
+            if node_prefix:
+                nodes = self.gcs.call("list_nodes")
+                node = next((n for n in nodes
+                             if n["node_id"].startswith(node_prefix)
+                             and n.get("alive")), None)
+                if node is None:
+                    raise ValueError(f"no alive node matching "
+                                     f"{node_prefix!r}")
+                conn = _rpc.connect(tuple(node["address"]), timeout=5.0)
+                try:
+                    counts = conn.call(
+                        "profile",
+                        {"duration": duration,
+                         "worker_id": request.query.get("worker_id")},
+                        timeout=duration + 40)
+                finally:
+                    conn.close()
+            else:
+                counts = self.gcs.call("profile", {"duration": duration},
+                                       timeout=duration + 40)
+            return top_summary(counts) if fmt == "top" \
+                else folded_text(counts)
+
+        try:
+            text = await self._call(run)
+        except Exception as e:  # noqa: BLE001 - surfaced as HTTP 400
+            raise web.HTTPBadRequest(text=str(e))
+        return web.Response(text=text)
 
     # -------------------------------------------------------------- metrics
     async def _metrics(self, request) -> web.Response:

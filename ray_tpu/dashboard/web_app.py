@@ -80,7 +80,7 @@ INDEX_HTML = r"""<!DOCTYPE html>
 <main id="main">loading…</main>
 <script>
 const TABS = ["Overview", "Nodes", "Actors", "Tasks", "Jobs", "Serve",
-              "Placement Groups"];
+              "Placement Groups", "Events"];
 let tab = location.hash ? decodeURIComponent(location.hash.slice(1))
                         : "Overview";
 let followJob = null, logOffset = 0, timer = null;
@@ -96,7 +96,7 @@ function statusCls(s) {
   if (["ALIVE", "RUNNING", "SUCCEEDED", "CREATED", "HEALTHY", "FINISHED",
        "TRUE"].includes(s)) return "s-ok";
   if (["PENDING", "PENDING_CREATION", "RESTARTING", "UPDATING",
-       "SUBMITTED"].includes(s)) return "s-warn";
+       "SUBMITTED", "WARNING"].includes(s)) return "s-warn";
   if (["DEAD", "FAILED", "ERROR", "STOPPED", "FALSE"].includes(s))
     return "s-bad";
   return "s-mut";
@@ -207,6 +207,14 @@ async function renderPGs() {
       esc(JSON.stringify(pg.bundles || []))]));
 }
 
+async function renderEvents() {
+  const d = await J("/api/events?limit=200");
+  return table(["time", "severity", "source", "label", "message"],
+    d.events.slice().reverse().map(e => [
+      new Date(e.ts * 1000).toLocaleTimeString(),
+      badge(e.severity), esc(e.source), esc(e.label), esc(e.message)]));
+}
+
 window.tailJob = (sid) => { followJob = sid || null; logOffset = 0;
                             refresh(); };
 document.addEventListener("click", (e) => {
@@ -216,7 +224,8 @@ document.addEventListener("click", (e) => {
 
 const RENDER = {"Overview": renderOverview, "Nodes": renderNodes,
   "Actors": renderActors, "Tasks": renderTasks, "Jobs": renderJobs,
-  "Serve": renderServe, "Placement Groups": renderPGs};
+  "Serve": renderServe, "Placement Groups": renderPGs,
+  "Events": renderEvents};
 
 async function pollLog(g) {
   if (tab !== "Jobs" || !followJob) return;

@@ -18,6 +18,7 @@ from typing import Optional
 
 import flax.linen as nn
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
@@ -83,8 +84,27 @@ def stack_layers(block_cls, cfg: TransformerConfig, ctor_kwargs, x,
     if remat is None:
         remat = cfg.remat
     if remat:
-        policy = (None if cfg.remat_policy == "nothing"
-                  else jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        # the remat ladder, least to most memory (scaling-book recipe:
+        # pick the most-saving policy that still fits HBM):
+        #   nothing    — full recompute (fits 1B on one 16 GiB chip)
+        #   block_outs — save each block's attn/mlp outputs (named
+        #                checkpoints below): residual stream reconstructs
+        #                without re-running attention, ~1.5 GiB at 1B/b8
+        #   dots       — save only no-batch-dim dot outputs (tiny)
+        #   dots_all   — save every dot output (max memory, min recompute)
+        policies = {
+            "nothing": None,
+            "block_outs": jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "mlp_out"),
+            "dots": jax.checkpoint_policies
+            .dots_with_no_batch_dims_saveable,
+            "dots_all": jax.checkpoint_policies.dots_saveable,
+        }
+        if cfg.remat_policy not in policies:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}; "
+                f"choose one of {sorted(policies)}")
+        policy = policies[cfg.remat_policy]
         block_cls = nn.remat(block_cls, prevent_cse=False, policy=policy)
     if cfg.scan_layers:
         variable_axes = {"params": 0, "intermediates": 0}
@@ -191,6 +211,7 @@ class Block(nn.Module):
         y = RMSNorm(cfg.norm_eps, name="attn_norm")(x)
         y = Attention(cfg, self.mesh, self.rules, self.decode, name="attn")(
             y, cos, sin, positions)
+        y = jax.ad_checkpoint.checkpoint_name(y, "attn_out")
         x = x + y
         y = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
         if cfg.moe_experts > 0:
@@ -202,6 +223,7 @@ class Block(nn.Module):
                        name="moe")(y)
         else:
             y = MLP(cfg, name="mlp")(y)
+        y = jax.ad_checkpoint.checkpoint_name(y, "mlp_out")
         x = x + y
         if self.mesh is not None and not self.decode:
             x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),

@@ -63,6 +63,10 @@ class GcsServer:
         self._kv: Dict[str, bytes] = {}
         from ray_tpu._private.task_events import GcsTaskTable
         self._task_table = GcsTaskTable()
+        # structured component events (reference src/ray/util/event.cc +
+        # event_logger.py): bounded ring consumed by the dashboard
+        from collections import deque as _deque
+        self._events = _deque(maxlen=1000)
         self._placement_groups: Dict[str, Dict[str, Any]] = {}
         # channel -> list of (conn, subscriber key)
         self._subs: Dict[str, List[rpc.Connection]] = {}
@@ -174,6 +178,41 @@ class GcsServer:
         "register_node", "register_job", "finish_job", "kv_put", "kv_del",
         "register_actor", "actor_ready", "actor_failed", "kill_actor",
         "create_placement_group", "remove_placement_group"})
+
+    def _rpc_profile(self, conn, p):
+        """Flame-sample the GCS process itself (reporter_agent analog)."""
+        from ray_tpu._private.profiler import sample_folded
+        return sample_folded(float((p or {}).get("duration", 2.0)))
+
+    # ------------------------------------------------------ component events
+    def _rpc_report_event(self, conn, p):
+        """Machine-readable component event (reference event.cc schema:
+        severity/label/message/source + custom fields)."""
+        ev = {"ts": p.get("ts") or time.time(),
+              "severity": p.get("severity", "INFO"),
+              "source": p.get("source", "unknown"),
+              "label": p.get("label", ""),
+              "message": p.get("message", ""),
+              "fields": p.get("fields") or {}}
+        with self._lock:   # appends race list_events on RPC threads
+            self._events.append(ev)
+        self._publish("events", ev)
+        return {"ok": True}
+
+    def record_event(self, severity: str, source: str, label: str,
+                     message: str, **fields) -> None:
+        """In-process emission for the GCS's own transitions."""
+        self._rpc_report_event(None, {
+            "severity": severity, "source": source, "label": label,
+            "message": message, "fields": fields})
+
+    def _rpc_list_events(self, conn, p):
+        limit = int(p.get("limit", 200)) if p else 200
+        sev = (p or {}).get("severity")
+        with self._lock:
+            snapshot = list(self._events)
+        out = [e for e in snapshot if sev is None or e["severity"] == sev]
+        return out[-limit:]
 
     # ------------------------------------------------------------------ rpc
     def _handle(self, conn: rpc.Connection, method: str, p: Any) -> Any:
@@ -344,6 +383,11 @@ class GcsServer:
                        node_id[:8], len(affected))
         self._mark_dirty()
         self._publish("node", {"node_id": node_id, "state": "DEAD"})
+        self.record_event("ERROR", "gcs", "NODE_DEAD",
+                          f"node {node_id[:8]} missed "
+                          f"{CONFIG.health_check_failure_threshold} "
+                          "heartbeats", node_id=node_id,
+                          actors_affected=len(affected))
         for aid in affected:
             self._on_actor_failure(aid, f"node {node_id[:8]} died")
         # placement groups with a bundle on the dead node go back to PENDING
@@ -753,6 +797,9 @@ class GcsServer:
         self._publish("actor", {"actor_id": aid,
                                 "state": RESTARTING if restart else DEAD,
                                 "reason": reason})
+        self.record_event("WARNING" if restart else "ERROR", "gcs",
+                          "ACTOR_RESTARTING" if restart else "ACTOR_DEAD",
+                          f"actor {aid[:8]}: {reason}", actor_id=aid)
         if restart:
             logger.info("restarting actor %s (%s)", aid[:8], reason)
             self._schedule_actor(aid)

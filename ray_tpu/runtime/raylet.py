@@ -155,7 +155,12 @@ class Raylet:
         # disk-full protection for spill/fallback writes (reference
         # FileSystemMonitor, src/ray/common/file_system_monitor.h)
         from ray_tpu._private.file_system_monitor import FileSystemMonitor
-        self._fs_monitor = FileSystemMonitor(self._spill_dir)
+        self._fs_monitor = FileSystemMonitor(
+            self._spill_dir,
+            on_over=lambda usage: self._report_event(
+                "ERROR", "OUT_OF_DISK",
+                f"filesystem {usage:.0%} full: spilling disabled",
+                usage=round(usage, 3)))
         self._spilled: Dict[bytes, Tuple[int, int]] = {}  # oid -> (size, meta)
         # frees that couldn't complete yet (object pinned, e.g. mid-spill);
         # retried by the spill loop so a free racing a spill can't leak the
@@ -193,6 +198,26 @@ class Raylet:
             self._log_monitor.start()
         else:
             self._log_monitor = None
+
+    def _report_event(self, severity: str, label: str, message: str,
+                      **fields) -> None:
+        """Best-effort structured component event to the GCS (reference
+        event.cc + event_logger.py; dashboard Events view consumes).
+        Fire-and-forget on its own thread: emission sites sit on
+        memory-critical paths (OOM kill, spill under _spill_mutex) that
+        must never wait on a GCS round trip."""
+        fields.setdefault("node_id", self.node_id.hex())
+
+        def send():
+            try:
+                self.gcs.call("report_event", {
+                    "severity": severity, "source": "raylet",
+                    "label": label, "message": message,
+                    "fields": fields}, timeout=5)
+            except Exception:
+                pass
+
+        threading.Thread(target=send, daemon=True).start()
 
     # --------------------------------------------------------------- serving
     def _handle(self, conn: rpc.Connection, method: str, p: Any) -> Any:
@@ -390,6 +415,8 @@ class Raylet:
                 # scan retries (reference spill IO error path)
                 logger.warning("spill write of %s failed: %s",
                                oid.hex()[:12], e)
+                self._report_event("WARNING", "SPILL_WRITE_FAILED",
+                                   f"spill of {oid.hex()[:12]} failed: {e}")
                 return False
         finally:
             buf.release()
@@ -509,6 +536,25 @@ class Raylet:
             with self._lock:
                 self._restoring.discard(oid.binary())
 
+    def _rpc_profile(self, conn, p):
+        """Flame-sample this raylet, or forward to one of its workers
+        (reference reporter_agent on-demand CPU profiling)."""
+        wid = p.get("worker_id")
+        duration = float(p.get("duration", 2.0))
+        if wid:
+            with self._lock:
+                h = None
+                for w, handle in self._workers.items():
+                    if w.startswith(wid):
+                        h = handle
+                        break
+            if h is None or h.conn is None:
+                raise rpc.RpcError(f"no live worker matching {wid!r}")
+            return h.conn.call("profile", {"duration": duration},
+                               timeout=duration + 30)
+        from ray_tpu._private.profiler import sample_folded
+        return sample_folded(duration)
+
     def _rpc_spill_dir(self, conn, p):
         """Clients writing fallback-allocated primaries need the dir."""
         if self._fs_monitor.over_capacity():
@@ -626,6 +672,10 @@ class Raylet:
         logger.warning("memory usage %.2f >= %.2f: OOM-killing worker %s "
                        "(retriable-LIFO policy)", usage,
                        self._memory_monitor.threshold, victim[:8])
+        self._report_event("ERROR", "OOM_KILL",
+                           f"host memory {usage:.0%}: killed worker "
+                           f"{victim[:8]}", worker_id=victim,
+                           usage=round(usage, 3))
         self._kill_worker(victim, f"OOM-killed (host memory {usage:.0%})",
                           force=True)
 

@@ -86,7 +86,8 @@ class WorkerProcess:
         core_handle = self.core._handle_rpc
 
         def dispatch(conn, method, payload):
-            if method in ("push_task", "actor_task", "create_actor", "kill"):
+            if method in ("push_task", "actor_task", "create_actor", "kill",
+                          "profile"):
                 return self._handle(conn, method, payload)
             return core_handle(conn, method, payload)
 
@@ -122,6 +123,11 @@ class WorkerProcess:
         if method == "kill":
             import os
             os._exit(1)
+        if method == "profile":
+            # on-demand flame sampling of this worker (reference
+            # reporter_agent CPU profiling, reporter_agent.py:253)
+            from ray_tpu._private.profiler import sample_folded
+            return sample_folded(float((p or {}).get("duration", 2.0)))
         raise rpc.RpcError(f"worker: unknown method {method}")
 
     # --------------------------------------------------------- normal tasks

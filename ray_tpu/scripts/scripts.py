@@ -306,6 +306,48 @@ def cmd_debug(args) -> None:
         print(f"{bid}  {addr}   (attach: nc {addr.replace(':', ' ')})")
 
 
+def cmd_profile(args) -> None:
+    """Flame-sample a live cluster process (reference `ray stack`/py-spy
+    reporter path): GCS by default, a raylet with --node, one of its
+    workers with --worker. Prints folded stacks (-o writes a .folded
+    file for flamegraph tooling) or a top-N leaf summary."""
+    from ray_tpu._private import rpc
+    from ray_tpu._private.profiler import folded_text, top_summary
+    from ray_tpu.runtime.gcs import GcsClient
+
+    if args.worker and not args.node:
+        sys.exit("--worker requires --node (the worker's raylet)")
+    addr = _resolve_address(args)
+    host, port = addr.rsplit(":", 1)
+    gcs = GcsClient((host, int(port)))
+    try:
+        if args.node:
+            node = next((n for n in gcs.call("list_nodes")
+                         if n["node_id"].startswith(args.node)
+                         and n.get("alive")), None)
+            if node is None:
+                sys.exit(f"no alive node matching {args.node!r}")
+            conn = rpc.connect(tuple(node["address"]), timeout=5.0)
+            try:
+                counts = conn.call("profile",
+                                   {"duration": args.duration,
+                                    "worker_id": args.worker},
+                                   timeout=args.duration + 40)
+            finally:
+                conn.close()
+        else:
+            counts = gcs.call("profile", {"duration": args.duration},
+                              timeout=args.duration + 40)
+    finally:
+        gcs.close()
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(folded_text(counts) + "\n")
+        print(f"wrote {sum(counts.values())} samples to {args.output}")
+    else:
+        print(top_summary(counts))
+
+
 def cmd_stack(args) -> None:
     """Dump every session process's Python thread stacks (py-spy /
     `ray stack` analog): SIGUSR1 each process whose cmdline references the
@@ -519,6 +561,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="dump all session processes' thread stacks")
     sp.add_argument("--session-dir")
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser("profile",
+                        help="flame-sample a live cluster process")
+    sp.add_argument("--address")
+    sp.add_argument("--node", help="node id prefix (default: the GCS)")
+    sp.add_argument("--worker", help="worker id prefix on that node")
+    sp.add_argument("--duration", type=float, default=2.0)
+    sp.add_argument("-o", "--output", help="write folded stacks here")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("microbenchmark",
                         help="core-runtime ops/s suite (ray_perf analog)")

@@ -257,3 +257,67 @@ def test_cli_stack_dumps_all_processes(ray_start_regular):
     assert "signalled" in out.stdout, out.stdout[:500] + out.stderr[:500]
     assert "Thread" in out.stdout  # faulthandler stack frames present
     assert "_recv_exact" in out.stdout or "threading.py" in out.stdout
+
+
+def test_component_events_and_profiling(ray_start_regular):
+    """Structured events flow to the GCS ring + dashboard endpoint, and
+    every process kind answers on-demand flame sampling (reference
+    event_logger.py + reporter_agent.py:253)."""
+    import json as _json
+
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.runtime.core_worker import get_global_worker
+
+    worker = get_global_worker()
+    gcs = worker.gcs
+    gcs.call("report_event", {"severity": "WARNING", "source": "test",
+                              "label": "UNIT", "message": "hello events",
+                              "fields": {"k": 1}})
+    events = gcs.call("list_events", {"limit": 10})
+    assert any(e["label"] == "UNIT" and e["fields"]["k"] == 1
+               for e in events)
+    only_err = gcs.call("list_events", {"severity": "ERROR", "limit": 10})
+    assert all(e["severity"] == "ERROR" for e in only_err)
+
+    # profile the GCS process
+    counts = gcs.call("profile", {"duration": 0.3}, timeout=40)
+    assert counts and all(isinstance(v, int) for v in counts.values())
+
+    # profile a worker through its raylet (spin one up with a task)
+    @ray_tpu.remote
+    def spin():
+        import time
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 4:
+            sum(range(1000))
+        return 1
+
+    ref = spin.remote()
+    import time
+    time.sleep(1.0)
+    nodes = gcs.call("list_nodes")
+    from ray_tpu._private import rpc
+    conn = rpc.connect(tuple(nodes[0]["address"]), timeout=5.0)
+    try:
+        wcounts = conn.call("profile", {"duration": 0.5, "worker_id": ""},
+                            timeout=40)  # the raylet itself
+        assert wcounts
+    finally:
+        conn.close()
+    assert ray_tpu.get(ref, timeout=60) == 1
+
+    # the dashboard exposes both
+    host, port = ray_tpu.context()["gcs_address"].rsplit(":", 1)
+    head = start_dashboard((host, int(port)), port=0)
+    try:
+        base = f"http://{head.host}:{head.port}"
+        with urllib.request.urlopen(base + "/api/events", timeout=10) as r:
+            evs = _json.loads(r.read())["events"]
+        assert any(e["label"] == "UNIT" for e in evs)
+        with urllib.request.urlopen(
+                base + "/api/profile?duration=0.3&format=top",
+                timeout=60) as r:
+            assert "samples" in r.read().decode()
+    finally:
+        head.stop()
