@@ -81,15 +81,21 @@ def main():
     if on_tpu:
         # measured sweep on v5e (16 GiB): batch 16 + remat beats batch 8
         # no-remat (47.7% vs 45.1% MFU); batch 32 needs the chunked head
-        # and lands lower (44.3%) — the fp32 logits path at 16 wins
+        # and lands lower (44.3%) — the fp32 logits path at 16 wins.
+        # Round-3 kernel sweep: flash block_q/block_k 1024/1024 beats the
+        # old 256/256 by ~25% on attention fwd+bwd at these shapes
+        # (gpt-small 49.1% -> 54.4% MFU, gpt-large 44.3% -> 48.6%).
         small = _bench_one(
             get_config("gpt-small", max_seq_len=1024, remat=True,
                        attention_impl="flash"),
             16 * n_dev, 1024, steps=20, warmup=3, peak=peak)
         # memory-lean path at 1B scale (north-star stepping stone): full
         # per-block remat + chunked CE head + adafactor fits 1.07B params
-        # on one 16 GiB chip at batch 8 (sweep: b8 44.2% / b16 44.4% MFU;
-        # AdamW fp32 OOMs by 26 MB even at b2 with bf16 first moment)
+        # on one 16 GiB chip at batch 8.  Round-3 sweep held the rest of
+        # the config: remat block_outs/dots_all/dots all measured equal
+        # or worse (or fail to compile at b8); CE chunk 512 worse; seq
+        # 2048 @ b4 worse; xla attention 37.5%; jax splash kernel 23.6%
+        # at head_dim 64 — the in-tree flash kernel with 1024-blocks wins.
         large = _bench_one(
             get_config("gpt-large", max_seq_len=1024, remat=True,
                        remat_policy="nothing", attention_impl="flash"),

@@ -19,7 +19,9 @@ def main():
     ap.add_argument("--policy", default="nothing")
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--chunk", type=int, default=256)
+    ap.add_argument("--attn", default="flash")
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--seq", type=int, default=1024)
     args = ap.parse_args()
 
     import bench
@@ -35,14 +37,15 @@ def main():
     # the module attribute injects our chunk size
     loss_fn = functools.partial(lm_loss_chunked_fn, chunk_size=args.chunk)
     try:
-        cfg = get_config(args.config, max_seq_len=1024, remat=True,
-                         remat_policy=args.policy, attention_impl="flash")
+        cfg = get_config(args.config, max_seq_len=args.seq, remat=True,
+                         remat_policy=args.policy,
+                         attention_impl=args.attn)
         import ray_tpu.train.step as step_mod
         orig = step_mod.lm_loss_chunked_fn
         step_mod.lm_loss_chunked_fn = loss_fn
         try:
             res = bench._bench_one(
-                cfg, args.batch, 1024, steps=args.steps, warmup=3,
+                cfg, args.batch, args.seq, steps=args.steps, warmup=3,
                 peak=peak,
                 optimizer=OptimizerConfig(warmup_steps=10, decay_steps=1000,
                                           optimizer="adafactor"),
@@ -50,10 +53,12 @@ def main():
         finally:
             step_mod.lm_loss_chunked_fn = orig
         res.update({"policy": args.policy, "batch": args.batch,
-                    "chunk": args.chunk, "ok": True})
+                    "chunk": args.chunk, "attn": args.attn,
+                    "seq": args.seq, "ok": True})
     except Exception as e:
         res = {"policy": args.policy, "batch": args.batch,
-               "chunk": args.chunk, "ok": False,
+               "chunk": args.chunk, "attn": args.attn, "seq": args.seq,
+               "ok": False,
                "error": f"{type(e).__name__}: {str(e)[:200]}"}
     print(json.dumps(res))
 

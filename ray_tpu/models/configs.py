@@ -26,7 +26,7 @@ class TransformerConfig:
     norm_eps: float = 1e-6
     dtype: jnp.dtype = jnp.bfloat16        # activation dtype
     param_dtype: jnp.dtype = jnp.float32
-    attention_impl: str = "auto"           # auto | xla | flash | ring | ulysses
+    attention_impl: str = "auto"           # auto | xla | flash | splash | ring | ulysses
     remat: bool = True                     # checkpoint each block (HBM <-> FLOPs)
     remat_policy: str = "dots"             # "dots": save no-batch-dim dots
     # (cheap recompute, more HBM); "nothing": full per-block recompute —

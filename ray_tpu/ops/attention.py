@@ -4,6 +4,8 @@
   - ``"xla"``    — einsum attention; runs everywhere, materializes [Sq, Sk].
   - ``"flash"``  — Pallas TPU flash kernel (ray_tpu/ops/flash_attention.py);
                    O(S) memory, fused online softmax on the MXU.
+  - ``"splash"`` — JAX's public tuned TPU kernel (comparison impl; the
+    in-tree flash kernel measured faster at head_dim 64).
   - ``"auto"``   — flash on TPU backends, xla elsewhere.
 
 Layout convention throughout the framework: ``q``: [batch, q_len, heads,
@@ -88,15 +90,21 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Public fused attention entry point (see module docstring)."""
     if impl == "auto":
         impl = "flash" if _on_tpu() else "xla"
-    if impl == "flash" and mask is not None:
-        impl = "xla"       # the Pallas kernel has no padding-mask path
-    if impl == "flash":
-        from ray_tpu.ops.flash_attention import flash_attention
+    if impl in ("flash", "splash") and mask is not None:
+        impl = "xla"       # the Pallas kernels have no padding-mask path
+    if impl in ("flash", "splash"):
         heads, kv_heads = q.shape[-2], k.shape[-2]
         if kv_heads != heads:
             k = repeat_kv(k, heads // kv_heads)
             v = repeat_kv(v, heads // kv_heads)
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        if impl == "flash":
+            from ray_tpu.ops.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal,
+                                   sm_scale=sm_scale)
+        # JAX's tuned public TPU kernel, kept as a comparison impl (the
+        # in-tree flash kernel measured faster at head_dim 64 — bench.py)
+        from ray_tpu.ops.splash import splash_attention
+        return splash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                              mask=mask)

@@ -272,7 +272,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, sm_scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 256,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Flash attention on [B, S, H, D] / [B, Sk, H, D] inputs (heads equal;
     GQA expansion happens in ops.attention)."""
