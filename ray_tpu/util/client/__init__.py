@@ -128,14 +128,65 @@ class ClientActorClass:
 
 
 class ClientContext:
-    """One connection to a ClientServer; the client-mode API surface."""
+    """One connection to a ClientServer; the client-mode API surface.
 
-    def __init__(self, address: Tuple[str, int]):
-        self._conn = rpc.connect(address)
+    Survives connection drops: the context holds a session id, the
+    server keeps the session's refs for a reconnect grace window, and
+    ``_call`` transparently reconnects and retries — each RPC carries a
+    request id the server dedups, so retries are exactly-once
+    (reference client reconnect + reply caching, dataclient.py)."""
+
+    def __init__(self, address: Tuple[str, int],
+                 reconnect_grace_s: float = 30.0):
+        import uuid as _uuid
         self.address = address
+        self.session_id = _uuid.uuid4().hex
+        self.reconnect_grace_s = reconnect_grace_s
+        self._conn_lock = threading.Lock()
+        self._conn = self._connect()
+
+    def _connect(self) -> rpc.Connection:
+        conn = rpc.connect(self.address)
+        conn.call("hello", {"session_id": self.session_id}, timeout=10)
+        return conn
 
     def _call(self, method: str, payload: dict) -> Any:
-        return self._conn.call(method, payload)
+        import time as _time
+        import uuid as _uuid
+        payload = dict(payload, _req=_uuid.uuid4().hex)
+        deadline = _time.monotonic() + self.reconnect_grace_s
+        while True:
+            conn = self._conn
+            try:
+                if conn.closed:
+                    raise ConnectionError("client connection closed")
+                return conn.call(method, payload)
+            except (ConnectionError, OSError):
+                if _time.monotonic() >= deadline:
+                    raise
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                with self._conn_lock:
+                    stale = self._conn is conn or self._conn.closed
+                if not stale:
+                    continue   # another thread already reconnected
+                # dial OUTSIDE the lock: other threads' calls must not
+                # queue behind this thread's connect timeout
+                try:
+                    fresh = self._connect()
+                except (ConnectionError, OSError):
+                    _time.sleep(0.5)
+                    continue
+                with self._conn_lock:
+                    if self._conn is conn or self._conn.closed:
+                        self._conn = fresh
+                    else:
+                        try:
+                            fresh.close()
+                        except Exception:
+                            pass
 
     @staticmethod
     def _dumps(value: Any) -> bytes:
@@ -188,6 +239,12 @@ class ClientContext:
         return self._call("cluster_info", {})
 
     def disconnect(self) -> None:
+        try:
+            # clean goodbye: the server releases our refs immediately
+            # instead of waiting out the reconnect grace window
+            self._conn.call("bye", {}, timeout=5)
+        except Exception:
+            pass
         self._conn.close()
 
 

@@ -5,8 +5,12 @@ client/server/, proxier.py; wire protocol ray_client.proto:324
 ``RayletDriver``): a thin process inside the cluster that executes
 put/get/wait/task/actor calls on behalf of drivers connecting from outside
 (laptops, notebooks).  One shared embedded driver serves every client
-connection; per-connection registries pin ObjectRefs/actor handles so a
-client disconnect releases everything it created.
+connection; per-SESSION registries pin ObjectRefs/actor handles.  A clean
+``bye`` releases everything immediately; an abrupt connection loss keeps
+the session alive for ``reconnect_grace_s`` so the client can reconnect
+and keep its refs (reference client reconnect, test_client_reconnect.py),
+and a per-session request-id reply cache makes retried RPCs exactly-once
+across the reconnect.
 
 Run standalone:  ``python -m ray_tpu.util.client.server --port 10001``
 (connects to the latest local session, or pass ``--address host:port``).
@@ -18,6 +22,7 @@ import cloudpickle
 import pickle
 import threading
 import uuid
+from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu._private import rpc
@@ -59,22 +64,43 @@ class ClientServer:
     """Serves client drivers; embeds (or joins) a cluster as their proxy."""
 
     def __init__(self, address: Optional[str] = None, host: str = "0.0.0.0",
-                 port: int = 10001, **init_kwargs):
+                 port: int = 10001, reconnect_grace_s: float = 30.0,
+                 **init_kwargs):
         import ray_tpu
         if not ray_tpu.is_initialized():
             ray_tpu.init(address=address, **init_kwargs)
         self._lock = threading.Lock()
-        # per-connection state: refs and actor handles created by the client
-        self._refs: Dict[rpc.Connection, Dict[str, Any]] = {}
-        self._actors: Dict[rpc.Connection, Dict[str, Any]] = {}
+        self.reconnect_grace_s = reconnect_grace_s
+        # session_id -> {refs, actors, replies, reply_order, conn, timer}
+        self._sessions: Dict[str, Dict[str, Any]] = {}
+        self._conn_session: Dict[rpc.Connection, str] = {}
         self._server = rpc.Server(self._handle, host=host, port=port,
                                   on_disconnect=self._disconnected)
         self.address: Tuple[str, int] = self._server.address
 
-    # ------------------------------------------------------------- plumbing
-    def _conn_refs(self, conn) -> Dict[str, Any]:
+    # ------------------------------------------------------------- sessions
+    def _session(self, conn) -> Dict[str, Any]:
         with self._lock:
-            return self._refs.setdefault(conn, {})
+            sid = self._conn_session.get(conn)
+            if sid is None:
+                # pre-hello caller (or a legacy client): anonymous
+                # session fate-shared with this one connection
+                sid = f"anon-{id(conn):x}"
+                self._conn_session[conn] = sid
+            return self._ensure_session(sid, conn)
+
+    def _ensure_session(self, sid: str, conn) -> Dict[str, Any]:
+        # _lock held
+        sess = self._sessions.get(sid)
+        if sess is None:
+            sess = {"refs": {}, "actors": {}, "replies": {},
+                    "reply_order": deque(),
+                    "conn": conn, "timer": None}
+            self._sessions[sid] = sess
+        return sess
+
+    def _conn_refs(self, conn) -> Dict[str, Any]:
+        return self._session(conn)["refs"]
 
     def _register(self, conn, ref) -> str:
         rid = uuid.uuid4().hex
@@ -93,14 +119,116 @@ class ClientServer:
                 raise rpc.RpcError(f"unknown ref {tag.ref_id[:8]}")
         return _map_structure(value, one)
 
-    def _disconnected(self, conn) -> None:
+    def _drop_session(self, sid: str) -> None:
         with self._lock:
-            self._refs.pop(conn, None)
-            self._actors.pop(conn, None)
+            sess = self._sessions.get(sid)
+            if sess is None or sess["conn"] is not None:
+                return  # reconnected during the grace window
+            del self._sessions[sid]
+            self._forget_conns(sid)
+
+    def _forget_conns(self, sid: str) -> None:
+        # _lock held: drop dead conn->sid bindings of this session
+        for c in [c for c, s in self._conn_session.items() if s == sid]:
+            del self._conn_session[c]
+
+    def _disconnected(self, conn) -> None:
+        # NOTE: the conn->sid binding is kept — a handler still running
+        # on this connection must keep resolving to the right session
+        # (registering into a fresh anonymous one would strand the refs
+        # its cached reply hands back). Bindings drop with the session.
+        with self._lock:
+            sid = self._conn_session.get(conn)
+            sess = self._sessions.get(sid) if sid else None
+            if sess is None or sess["conn"] is not conn:
+                return
+            sess["conn"] = None
+            if sid.startswith("anon-"):
+                # legacy connection-scoped session: no reconnect identity
+                del self._sessions[sid]
+                self._forget_conns(sid)
+                return
+            # keep refs/actors for the grace window so a reconnecting
+            # client finds them again
+            t = threading.Timer(self.reconnect_grace_s,
+                                self._drop_session, args=(sid,))
+            t.daemon = True
+            sess["timer"] = t
+            t.start()
 
     # ------------------------------------------------------------- handlers
+    _REPLY_CACHE_MAX_BYTES = 256 * 1024
+
+    @staticmethod
+    def _reply_size(out: Any) -> int:
+        if isinstance(out, dict):
+            return sum(len(v) for v in out.values()
+                       if isinstance(v, (bytes, bytearray)))
+        return 0
+
     def _handle(self, conn, method: str, p: Any) -> Any:
-        return getattr(self, f"_rpc_{method}")(conn, p or {})
+        p = p or {}
+        req = p.get("_req")
+        if req is None:
+            return getattr(self, f"_rpc_{method}")(conn, p)
+        sess = self._session(conn)
+        with self._lock:
+            prior = sess["replies"].get(req)
+            if prior is None:
+                # mark in flight so a retry racing this execution waits
+                # instead of re-executing (exactly-once, not at-least-once)
+                inflight = threading.Event()
+                sess["replies"][req] = inflight
+        if prior is not None:
+            if isinstance(prior, threading.Event):
+                prior.wait(timeout=120)
+                with self._lock:
+                    done = sess["replies"].get(req)
+                if not isinstance(done, threading.Event):
+                    return done
+                raise rpc.RpcError("retried request still executing")
+            return prior
+        try:
+            out = getattr(self, f"_rpc_{method}")(conn, p)
+        except BaseException:
+            with self._lock:
+                sess["replies"].pop(req, None)
+            inflight.set()
+            raise
+        with self._lock:
+            # huge replies (multi-MB gets) are not worth pinning; the
+            # only RPC with big replies is the idempotent get
+            if self._reply_size(out) <= self._REPLY_CACHE_MAX_BYTES:
+                sess["replies"][req] = out
+                sess["reply_order"].append(req)
+                while len(sess["reply_order"]) > 512:
+                    sess["replies"].pop(sess["reply_order"].popleft(),
+                                        None)
+            else:
+                sess["replies"].pop(req, None)
+        inflight.set()
+        return out
+
+    def _rpc_hello(self, conn, p):
+        """Bind this connection to a client session (new or resumed)."""
+        sid = p["session_id"]
+        with self._lock:
+            self._conn_session[conn] = sid
+            sess = self._ensure_session(sid, conn)
+            sess["conn"] = conn
+            if sess["timer"] is not None:
+                sess["timer"].cancel()
+                sess["timer"] = None
+        return {"ok": True}
+
+    def _rpc_bye(self, conn, p):
+        """Clean disconnect: release the session's refs immediately."""
+        with self._lock:
+            sid = self._conn_session.get(conn)
+            if sid:
+                self._sessions.pop(sid, None)
+                self._forget_conns(sid)
+        return {"ok": True}
 
     def _rpc_put(self, conn, p):
         import ray_tpu
@@ -155,13 +283,11 @@ class ClientServer:
             actor_cls = actor_cls.options(**p["options"])
         handle = actor_cls.remote(*args, **kwargs)
         aid = uuid.uuid4().hex
-        with self._lock:
-            self._actors.setdefault(conn, {})[aid] = handle
+        self._session(conn)["actors"][aid] = handle
         return {"actor_id": aid}
 
     def _actor(self, conn, aid):
-        with self._lock:
-            handle = self._actors.get(conn, {}).get(aid)
+        handle = self._session(conn)["actors"].get(aid)
         if handle is None:
             raise rpc.RpcError(f"unknown actor {aid[:8]}")
         return handle
@@ -176,8 +302,7 @@ class ClientServer:
     def _rpc_kill_actor(self, conn, p):
         import ray_tpu
         ray_tpu.kill(self._actor(conn, p["actor_id"]))
-        with self._lock:
-            self._actors.get(conn, {}).pop(p["actor_id"], None)
+        self._session(conn)["actors"].pop(p["actor_id"], None)
         return {}
 
     def _rpc_nodes(self, conn, p):
