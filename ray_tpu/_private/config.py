@@ -107,6 +107,19 @@ _declare("heartbeat_period_ms", int, 250,
 _declare("health_check_failure_threshold", int, 8,
          "Missed heartbeats before the GCS marks a node dead.")
 _declare("gcs_rpc_timeout_s", float, 30.0, "Client->GCS RPC timeout.")
+_declare("gcs_snapshot_interval_s", float, 0.2,
+         "Period of the GCS full-snapshot compaction tick (the WAL makes "
+         "each mutation durable immediately; the snapshot only bounds "
+         "replay length).")
+_declare("gcs_wal_enabled", bool, True,
+         "Per-mutation write-ahead journal next to the GCS snapshot "
+         "(reference writes through to the Redis store client per "
+         "mutation, store_client/redis_store_client.h:28).")
+_declare("gcs_wal_fsync", bool, False,
+         "fsync the GCS WAL after every record: survives host power loss "
+         "at control-plane-latency cost; off, records survive process "
+         "death but not kernel crash (matches Redis appendfsync "
+         "everysec-style tradeoff).")
 _declare("raylet_rpc_timeout_s", float, 30.0, "Client->node-daemon RPC timeout.")
 _declare("actor_creation_timeout_s", float, 60.0, "Actor __init__ readiness timeout.")
 _declare("memory_monitor_refresh_ms", int, 250,

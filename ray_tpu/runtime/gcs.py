@@ -39,12 +39,28 @@ DEAD = "DEAD"
 class GcsServer:
     """All control state for one cluster; serves the RPC surface.
 
-    With ``persist_path`` set, every table mutation marks the state dirty
-    and a snapshot thread writes an atomic pickle (tmp+rename) of
-    nodes/actors/jobs/KV/placement-groups; a restarted GCS replays it
-    (reference: GcsInitData load at gcs_server.cc:121-181 over the
-    Redis/file store_client) and raylets re-attach via their next
-    heartbeat."""
+    With ``persist_path`` set, durability is two-tier (reference: every
+    table mutation writes through to the store client,
+    store_client/redis_store_client.h:28; GcsInitData replays it at
+    gcs_server.cc:121-181):
+
+    * a **write-ahead journal** (``<persist_path>.wal``) gets one
+      length-prefixed record per mutation, synchronously, before the
+      mutating RPC returns — so a SIGKILL directly after an
+      acknowledged mutation loses nothing (fsync is opt-in via
+      ``gcs_wal_fsync``; without it, records survive process death but
+      not host power loss);
+    * a **snapshot thread** compacts the full tables into an atomic
+      pickle (tmp+rename) every ``gcs_snapshot_interval_s`` while dirty,
+      rotating the journal so replay length stays bounded.
+
+    Recovery loads the snapshot (if any), then replays journal records
+    with a sequence number newer than the snapshot's.  Records carry
+    absolute values (table, key, value-or-tombstone), so re-applying an
+    already-compacted record is idempotent.  Task events and the
+    component-event ring are deliberately ephemeral."""
+
+    _TOMBSTONE = "__gcs_wal_tombstone__"
 
     SNAPSHOT_TABLES = ("_nodes", "_actors", "_named_actors", "_jobs",
                       "_kv", "_placement_groups")
@@ -78,8 +94,13 @@ class GcsServer:
         from ray_tpu._core.scheduler import make_scheduler
         self._cluster_scheduler = make_scheduler(
             spill_threshold=CONFIG.scheduler_spill_threshold)
-        if persist_path and os.path.exists(persist_path):
-            self._load_snapshot(persist_path)
+        self._wal_lock = threading.Lock()
+        self._wal_seq = 0
+        self._wal_fh = None
+        if persist_path:
+            self._recover(persist_path)
+            if CONFIG.gcs_wal_enabled:
+                self._wal_fh = open(persist_path + ".wal", "ab")
         self._health_thread = threading.Thread(target=self._health_loop,
                                                daemon=True)
         self._health_thread.start()
@@ -89,12 +110,55 @@ class GcsServer:
             self._snap_thread.start()
 
     # ------------------------------------------------------------ persistence
-    def _mark_dirty(self) -> None:
-        if self._persist_path:
-            self._dirty.set()
+    def _mark_dirty(self, *hints) -> None:
+        """Mark the snapshot dirty and journal the named entries.
+
+        ``hints`` are ``(table_attr, key)`` pairs identifying what the
+        caller just mutated; each becomes one synchronous WAL record of
+        the entry's **current** value (``key=None`` journals the whole
+        table — used where one RPC fans out over many entries, e.g. a
+        job finish killing its actors).  Callers that can't name what
+        changed pass nothing and fall back to snapshot-tick durability."""
+        if not self._persist_path:
+            return
+        self._dirty.set()
+        if self._wal_fh is None or not hints:
+            return
+        import pickle
+        import struct
+        try:
+            # self._lock before _wal_lock everywhere: the value read and
+            # its sequence number must agree, or replay could finish on a
+            # stale value for a key mutated concurrently.  The disk write
+            # happens OUTSIDE self._lock so fsync latency never stalls
+            # unrelated RPCs; replay sorts records by seq, so two threads
+            # landing frames out of file order is harmless.
+            with self._lock:
+                with self._wal_lock:
+                    frames = []
+                    for table, key in hints:
+                        tbl = getattr(self, table)
+                        if key is None:
+                            value = dict(tbl)
+                        else:
+                            value = tbl.get(key, self._TOMBSTONE)
+                        self._wal_seq += 1
+                        rec = pickle.dumps(
+                            (self._wal_seq, table, key, value))
+                        frames.append(struct.pack(">I", len(rec)) + rec)
+            with self._wal_lock:
+                if self._wal_fh is None:
+                    return
+                self._wal_fh.write(b"".join(frames))
+                self._wal_fh.flush()
+                if CONFIG.gcs_wal_fsync:
+                    os.fsync(self._wal_fh.fileno())
+        except Exception:
+            logger.exception("GCS WAL append failed (snapshot tick still "
+                             "covers this mutation)")
 
     def _snapshot_loop(self) -> None:
-        while not self._stopped.wait(0.2):
+        while not self._stopped.wait(CONFIG.gcs_snapshot_interval_s):
             if not self._dirty.is_set():
                 continue
             self._dirty.clear()
@@ -109,24 +173,136 @@ class GcsServer:
             except Exception:
                 pass
 
+    def _wal_old_files(self) -> list:
+        """Rotated journal segments on disk, oldest first (the rotation
+        seq is embedded in the name)."""
+        import glob
+        out = []
+        for p in glob.glob(self._persist_path + ".wal.old.*"):
+            try:
+                out.append((int(p.rsplit(".", 1)[1]), p))
+            except ValueError:
+                continue
+        legacy = self._persist_path + ".wal.old"  # pre-unique-name builds
+        if os.path.exists(legacy):
+            out.append((-1, legacy))
+        return [p for _, p in sorted(out)]
+
     def _write_snapshot(self) -> None:
         import pickle
         with self._lock:
-            blob = pickle.dumps({t: getattr(self, t)
-                                 for t in self.SNAPSHOT_TABLES})
+            with self._wal_lock:
+                blob = pickle.dumps(
+                    {"__v": 2, "wal_seq": self._wal_seq,
+                     "tables": {t: getattr(self, t)
+                                for t in self.SNAPSHOT_TABLES}})
+                # rotate the journal inside the locks: records after the
+                # pickle point land in the fresh file and survive the
+                # compaction; records before it are covered by the pickle.
+                # Rotation uses a UNIQUE name per compaction — if the
+                # snapshot write below fails (disk full), earlier rotated
+                # segments must survive untouched or their acked records
+                # would have no on-disk copy; replay seq-filters overlaps
+                if self._wal_fh is not None:
+                    self._wal_fh.close()
+                    os.replace(self._persist_path + ".wal",
+                               f"{self._persist_path}.wal.old."
+                               f"{self._wal_seq}")
+                    self._wal_fh = open(self._persist_path + ".wal", "ab")
         tmp = f"{self._persist_path}.tmp{os.getpid()}"
         with open(tmp, "wb") as f:
             f.write(blob)
         os.replace(tmp, self._persist_path)
+        # only now are all rotated segments (records <= pickled wal_seq)
+        # fully covered by a durable snapshot
+        for p in self._wal_old_files():
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
 
-    def _load_snapshot(self, path: str) -> None:
+    @classmethod
+    def _read_wal_records(cls, path: str) -> list:
+        """Records from one journal file, tolerating a torn final write."""
         import pickle
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+        import struct
+        out = []
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return out
+        off = 0
+        while off + 4 <= len(data):
+            (n,) = struct.unpack_from(">I", data, off)
+            if off + 4 + n > len(data):
+                break  # torn tail: the append died mid-record
+            try:
+                out.append(pickle.loads(data[off + 4:off + 4 + n]))
+            except Exception:
+                break
+            off += 4 + n
+        return out
+
+    def _recover(self, path: str) -> None:
+        """Snapshot + journal replay (GcsInitData analog).  Runs during
+        construction, before the address file is published — no client
+        can reach the server yet, so replay is effectively single-
+        threaded."""
+        import pickle
+        base_seq = 0
+        loaded = False
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                state = pickle.load(f)
+            if "__v" in state:
+                tables, base_seq = state["tables"], state["wal_seq"]
+            else:  # v1 flat-dict snapshot from before the WAL existed
+                tables = state
+            with self._lock:
+                for t in self.SNAPSHOT_TABLES:
+                    getattr(self, t).update(tables.get(t, {}))
+            loaded = True
+        # journals are ALWAYS replayed, even with gcs_wal_enabled=False —
+        # the flag governs writing; records a previous (WAL-on) incarnation
+        # acked must never be dropped just because the operator toggled it.
+        # Records apply in seq order (concurrent appenders may land frames
+        # out of file order), filtered against the snapshot's seq.
+        records = []
+        for wal in self._wal_old_files() + [path + ".wal"]:
+            records.extend(self._read_wal_records(wal))
+        records.sort(key=lambda r: r[0])
+        replayed = 0
+        for seq, table, key, value in records:
+            self._wal_seq = max(self._wal_seq, seq)
+            if seq <= base_seq or table not in self.SNAPSHOT_TABLES:
+                continue
+            tbl = getattr(self, table)
+            if key is None:
+                tbl.clear()
+                tbl.update(value)
+            elif value == self._TOMBSTONE:
+                tbl.pop(key, None)
+            else:
+                tbl[key] = value
+            replayed += 1
+        self._wal_seq = max(self._wal_seq, base_seq)
+        if not CONFIG.gcs_wal_enabled and replayed:
+            # WAL now off: nothing will rotate these files again, and a
+            # future WAL-on incarnation would replay them over a NEWER
+            # snapshot, resurrecting later-deleted state.  Fold them into
+            # a snapshot right now, then drop them.
+            self._write_snapshot()
+            try:
+                os.remove(path + ".wal")
+            except FileNotFoundError:
+                pass
+        if loaded or replayed:
+            self._post_recover(path, replayed)
+
+    def _post_recover(self, path: str, replayed: int) -> None:
         now = time.monotonic()
         with self._lock:
-            for t in self.SNAPSHOT_TABLES:
-                getattr(self, t).update(state.get(t, {}))
             for node in self._nodes.values():
                 # give restored nodes a fresh grace period to heartbeat in;
                 # monotonic timestamps from the old process are meaningless
@@ -142,9 +318,10 @@ class GcsServer:
                 if a.get("state") in (PENDING_CREATION, RESTARTING):
                     a["dispatched"] = False
                     a.pop("retry_delay", None)
-        logger.info("GCS state restored from %s: %d nodes, %d actors, "
-                    "%d jobs, %d kv keys, %d pgs", path, len(self._nodes),
-                    len(self._actors), len(self._jobs), len(self._kv),
+        logger.info("GCS state restored from %s (+%d WAL records): "
+                    "%d nodes, %d actors, %d jobs, %d kv keys, %d pgs",
+                    path, replayed, len(self._nodes), len(self._actors),
+                    len(self._jobs), len(self._kv),
                     len(self._placement_groups))
         threading.Thread(target=self._retry_after_reattach,
                          daemon=True).start()
@@ -171,13 +348,37 @@ class GcsServer:
     def stop(self) -> None:
         self._stopped.set()
         self._server.stop()
+        snap = getattr(self, "_snap_thread", None)
+        if snap is not None:
+            snap.join(timeout=5)  # let the final compaction finish
+        with self._wal_lock:
+            if self._wal_fh is not None:
+                self._wal_fh.close()
+                self._wal_fh = None
 
-    # RPCs that change persisted tables; _handle marks the snapshot dirty
-    # after any of them (internal transitions call _mark_dirty directly)
-    _MUTATING_RPCS = frozenset({
-        "register_node", "register_job", "finish_job", "kv_put", "kv_del",
-        "register_actor", "actor_ready", "actor_failed", "kill_actor",
-        "create_placement_group", "remove_placement_group"})
+    # RPCs that change persisted tables → the WAL hints for what they
+    # touch; _handle journals + marks dirty after any of them.  Handlers
+    # whose fan-out the payload can't name (finish_job kills the job's
+    # actors, actor_failed drives the restart FSM) journal from inside
+    # the transition instead and are mapped to no hints here.
+    _MUTATING_RPCS: Dict[str, Any] = {
+        "register_node": lambda p: (("_nodes", p["node_id"]),),
+        "register_job": lambda p: (("_jobs", p["job_id"]),),
+        "finish_job": lambda p: (),
+        "kv_put": lambda p: (("_kv", p["key"]),),
+        "kv_del": lambda p: (("_kv", p["key"]),),
+        "register_actor": lambda p: (("_actors", p["actor_id"]),
+                                     ("_named_actors", None)),
+        "actor_ready": lambda p: (("_actors", p["actor_id"]),),
+        "actor_failed": lambda p: (),
+        "kill_actor": lambda p: (("_actors", p["actor_id"]),
+                                 ("_named_actors", None)),
+        "create_placement_group": lambda p: (
+            ("_placement_groups", p["pg_id"]),),
+        "remove_placement_group": lambda p: (
+            ("_placement_groups", p["pg_id"]), ("_actors", None),
+            ("_named_actors", None)),
+    }
 
     def _rpc_profile(self, conn, p):
         """Flame-sample the GCS process itself (reporter_agent analog)."""
@@ -220,8 +421,9 @@ class GcsServer:
         if fn is None:
             raise rpc.RpcError(f"GCS: unknown method {method}")
         out = fn(conn, p or {})
-        if method in self._MUTATING_RPCS:
-            self._mark_dirty()
+        hints = self._MUTATING_RPCS.get(method)
+        if hints is not None:
+            self._mark_dirty(*hints(p or {}))
         return out
 
     def _on_disconnect(self, conn: rpc.Connection) -> None:
@@ -381,7 +583,7 @@ class GcsServer:
                           node_id in pg["placement"]]
         logger.warning("node %s marked dead (actors affected: %d)",
                        node_id[:8], len(affected))
-        self._mark_dirty()
+        self._mark_dirty(("_nodes", node_id))
         self._publish("node", {"node_id": node_id, "state": "DEAD"})
         self.record_event("ERROR", "gcs", "NODE_DEAD",
                           f"node {node_id[:8]} missed "
@@ -467,7 +669,8 @@ class GcsServer:
                     except ConnectionError:
                         pass
             self._publish("job", {"job_id": job_id, "state": "FINISHED"})
-            self._mark_dirty()
+            self._mark_dirty(("_jobs", job_id), ("_actors", None),
+                             ("_named_actors", None))
 
     def _rpc_list_jobs(self, conn, p):
         with self._lock:
@@ -793,7 +996,7 @@ class GcsServer:
                 restart = False
         # dirty AFTER the state transition: marking first lets the snapshot
         # tick clear the flag and persist the pre-transition tables
-        self._mark_dirty()
+        self._mark_dirty(("_actors", aid))
         self._publish("actor", {"actor_id": aid,
                                 "state": RESTARTING if restart else DEAD,
                                 "reason": reason})
@@ -892,7 +1095,7 @@ class GcsServer:
             with self._lock:
                 pg["placing"] = False
             # after the transition so the snapshot can't persist pre-state
-            self._mark_dirty()
+            self._mark_dirty(("_placement_groups", pg["pg_id"]))
 
     def _reserve_pg_bundles(self, pg, placement, conns) -> bool:
         pgid = pg["pg_id"]

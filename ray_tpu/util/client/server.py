@@ -172,22 +172,30 @@ class ClientServer:
         if req is None:
             return getattr(self, f"_rpc_{method}")(conn, p)
         sess = self._session(conn)
-        with self._lock:
-            prior = sess["replies"].get(req)
-            if prior is None:
-                # mark in flight so a retry racing this execution waits
-                # instead of re-executing (exactly-once, not at-least-once)
-                inflight = threading.Event()
-                sess["replies"][req] = inflight
-        if prior is not None:
-            if isinstance(prior, threading.Event):
-                prior.wait(timeout=120)
-                with self._lock:
-                    done = sess["replies"].get(req)
-                if not isinstance(done, threading.Event):
-                    return done
-                raise rpc.RpcError("retried request still executing")
-            return prior
+        while True:
+            with self._lock:
+                prior = sess["replies"].get(req)
+                if prior is None:
+                    # mark in flight so a retry racing this execution waits
+                    # instead of re-executing (exactly-once when the
+                    # original completes; see absent-entry case below)
+                    inflight = threading.Event()
+                    sess["replies"][req] = inflight
+                    break
+            if not isinstance(prior, threading.Event):
+                return prior
+            prior.wait(timeout=120)
+            with self._lock:
+                done = sess["replies"].get(req)
+            if done is None:
+                # entry vanished: the original raised (its error went to a
+                # connection that is gone) or its reply was too big to pin
+                # (only the idempotent get) — re-execute rather than hand
+                # the client a bogus None reply
+                continue
+            if not isinstance(done, threading.Event):
+                return done
+            raise rpc.RpcError("retried request still executing")
         try:
             out = getattr(self, f"_rpc_{method}")(conn, p)
         except BaseException:
@@ -213,6 +221,11 @@ class ClientServer:
         """Bind this connection to a client session (new or resumed)."""
         sid = p["session_id"]
         with self._lock:
+            # a reconnecting session's previous conns are dead: drop their
+            # bindings now (not at session end) or each reconnect leaks one
+            for c in [c for c, s in self._conn_session.items()
+                      if s == sid and c is not conn and c.closed]:
+                del self._conn_session[c]
             self._conn_session[conn] = sid
             sess = self._ensure_session(sid, conn)
             sess["conn"] = conn

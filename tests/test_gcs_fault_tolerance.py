@@ -53,6 +53,60 @@ def test_gcs_restart_preserves_state(ray_start_cluster):
     ray_tpu.shutdown()
 
 
+def test_wal_survives_immediate_gcs_kill():
+    """A mutation acknowledged an instant before SIGKILL is replayed from
+    the write-ahead journal — the snapshot tick is disabled (1h interval)
+    so only the per-mutation WAL can provide durability (reference writes
+    through to the store client per mutation,
+    store_client/redis_store_client.h:28)."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.cluster_utils import Cluster
+    saved = CONFIG.copy_overrides()
+    CONFIG.set("gcs_snapshot_interval_s", 3600.0)
+    cluster = None
+    try:
+        cluster = Cluster()
+        cluster.wait_for_nodes(1)
+        ray_tpu.init(num_cpus=2, address=cluster.address)
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(name="wal-actor", lifetime="detached").remote()
+        assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+
+        from ray_tpu.runtime.core_worker import get_global_worker
+        w = get_global_worker()
+        w.gcs.kv_put("wal:marker", b"acked-then-killed")
+        # no sleep: the kv_put reply means the WAL record is on disk;
+        # restart_gcs SIGKILLs right away, so a snapshot can never run
+        cluster.restart_gcs()
+
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                assert w.gcs.kv_get("wal:marker") == b"acked-then-killed"
+                h = ray_tpu.get_actor("wal-actor")
+                assert ray_tpu.get(h.inc.remote(), timeout=60) == 2
+                break
+            except (ray_tpu.exceptions.RayTpuError, ValueError,
+                    ConnectionError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+        ray_tpu.shutdown()
+    finally:
+        CONFIG.set_overrides(saved)
+        if cluster is not None:
+            cluster.shutdown()
+
+
 def test_tasks_keep_working_after_gcs_restart(ray_start_cluster):
     """Task submission rides through a GCS restart: the driver's client
     reconnects and raylets keep serving leases."""
