@@ -1,0 +1,162 @@
+// C++ driver implementation — see cpp_api.h.
+#include "cpp_api.h"
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "rpcnet.h"
+
+namespace ray_tpu_cpp {
+
+using pycodec::PyVal;
+
+namespace {
+
+std::string random_bytes(size_t n) {
+  std::string out(n, '\0');
+  int fd = ::open("/dev/urandom", O_RDONLY);
+  if (fd >= 0) {
+    ssize_t got = ::read(fd, &out[0], n);
+    ::close(fd);
+    if ((size_t)got == n) return out;
+  }
+  for (size_t j = 0; j < n; ++j) out[j] = (char)(rand() & 0xff);
+  return out;
+}
+
+std::string to_hex(const std::string& b) {
+  static const char* digits = "0123456789abcdef";
+  std::string out;
+  out.reserve(b.size() * 2);
+  for (unsigned char c : b) {
+    out += digits[c >> 4];
+    out += digits[c & 0xf];
+  }
+  return out;
+}
+
+}  // namespace
+
+struct Driver::Impl {
+  std::unique_ptr<rpcnet::Conn> gcs;
+  std::unique_ptr<rpcnet::Conn> raylet;
+  // after a spillback redirect, the raylet that actually granted the
+  // lease — return_worker must go THERE or the remote worker leaks
+  std::unique_ptr<rpcnet::Conn> granting;
+  std::unique_ptr<rpcnet::Conn> worker;
+  std::string job_id_hex;
+  std::string sched_key;
+  std::string lease_id, worker_id;
+
+  rpcnet::Conn* lease_home() {
+    return granting ? granting.get() : raylet.get();
+  }
+};
+
+Driver::Driver(const std::string& raylet_host, int raylet_port,
+               const std::string& gcs_host, int gcs_port)
+    : impl_(new Impl) {
+  impl_->job_id_hex = to_hex(random_bytes(16));
+  job_id_ = impl_->job_id_hex;
+  impl_->sched_key = impl_->job_id_hex.substr(0, 8) + "|CPU=1|lang=cpp";
+
+  impl_->gcs.reset(rpcnet::Conn::connect(gcs_host, gcs_port));
+  PyVal reg = PyVal::dict();
+  reg.set("job_id", PyVal::str(impl_->job_id_hex));
+  reg.set("entrypoint", PyVal::str("cpp-driver"));
+  impl_->gcs->call("register_job", reg, 30.0);
+
+  impl_->raylet.reset(rpcnet::Conn::connect(raylet_host, raylet_port));
+
+  // lease one cpp worker, following spillback redirects like the Python
+  // submitter (core_worker._lease_with_spillback, max 3 hops)
+  PyVal payload = PyVal::dict();
+  payload.set("key", PyVal::str(impl_->sched_key));
+  PyVal res = PyVal::dict();
+  res.set("CPU", PyVal::integer(1));
+  payload.set("resources", std::move(res));
+  payload.set("job_id", PyVal::str(impl_->job_id_hex));
+  payload.set("env", PyVal::none());
+  payload.set("language", PyVal::str("cpp"));
+
+  PyVal grant;
+  for (int hop = 0; hop < 3; ++hop) {
+    PyVal p = payload;
+    p.set("spillback", PyVal::integer(hop));
+    grant = impl_->lease_home()->call("lease_worker", p, 60.0);
+    const PyVal* retry = grant.get("retry_at");
+    if (!retry) break;
+    if (retry->items.size() != 2)
+      throw TaskFailure("bad retry_at in lease grant");
+    impl_->granting.reset(rpcnet::Conn::connect(retry->items[0].s,
+                                                (int)retry->items[1].i));
+  }
+  const PyVal* lease = grant.get("lease_id");
+  const PyVal* wid = grant.get("worker_id");
+  const PyVal* addr = grant.get("address");
+  if (!lease || !wid || !addr || addr->items.size() != 2)
+    throw TaskFailure("bad lease grant: " + grant.repr());
+  impl_->lease_id = lease->s;
+  impl_->worker_id = wid->s;
+  impl_->worker.reset(rpcnet::Conn::connect(addr->items[0].s,
+                                            (int)addr->items[1].i));
+}
+
+Driver::~Driver() {
+  if (!impl_) return;
+  // return the lease so the worker goes back to the idle pool, then
+  // finish the job (GCS reaps any leftover per-job state)
+  try {
+    if (impl_->lease_home() && !impl_->lease_id.empty()) {
+      PyVal p = PyVal::dict();
+      p.set("lease_id", PyVal::str(impl_->lease_id));
+      p.set("worker_id", PyVal::str(impl_->worker_id));
+      p.set("key", PyVal::str(impl_->sched_key));
+      impl_->lease_home()->call("return_worker", p, 10.0);
+    }
+  } catch (...) {
+  }
+  try {
+    if (impl_->gcs) {
+      PyVal p = PyVal::dict();
+      p.set("job_id", PyVal::str(impl_->job_id_hex));
+      impl_->gcs->call("finish_job", p, 10.0);
+    }
+  } catch (...) {
+  }
+}
+
+PyVal Driver::call(const std::string& fn_name,
+                   const std::vector<PyVal>& args, double timeout_s) {
+  // args blob shape = (args_tuple, kwargs_dict), core_worker._serialize_args
+  PyVal packed = PyVal::tuple(
+      {PyVal::tuple(std::vector<PyVal>(args.begin(), args.end())),
+       PyVal::dict()});
+  PyVal spec = PyVal::dict();
+  spec.set("task_id", PyVal::bytes(random_bytes(16)));
+  spec.set("fn_key", PyVal::str("cpp:" + fn_name));
+  spec.set("args", PyVal::bytes(pycodec::pickle_dumps(packed)));
+  spec.set("num_returns", PyVal::integer(1));
+  PyVal owner = PyVal::list();
+  owner.items.push_back(PyVal::str("127.0.0.1"));
+  owner.items.push_back(PyVal::integer(0));
+  spec.set("owner_addr", std::move(owner));
+  spec.set("name", PyVal::str("cpp:" + fn_name));
+
+  PyVal reply = impl_->worker->call("push_task", spec, timeout_s);
+  const PyVal* results = reply.get("results");
+  if (!results || results->items.empty())
+    throw TaskFailure("empty task reply");
+  const PyVal& one = results->items[0];
+  const PyVal* data = one.get("data");
+  if (!data || data->kind != PyVal::BYTES)
+    throw TaskFailure("non-inline task result");
+  int64_t err = 0;
+  PyVal value = pycodec::flat_deserialize(data->s, &err);
+  if (err) throw TaskFailure("task failed: " + value.repr());
+  return value;
+}
+
+}  // namespace ray_tpu_cpp

@@ -1,0 +1,50 @@
+// The C++ user API: a native driver for the cluster.
+//
+// Analog of the reference's C++ API (/root/reference/cpp/include/ray/api.h
+// ray::Init/Task(...).Remote(...).Get()): connect to a running cluster's
+// raylet + GCS, lease C++ workers through the same lease protocol Python
+// drivers use (core_worker._lease_with_spillback), push tasks, and read
+// inline results.  pycodec::PyVal is the value currency on both sides.
+//
+//   ray_tpu_cpp::Driver d("127.0.0.1", raylet_port, "127.0.0.1", gcs_port);
+//   PyVal out = d.call("Add", {PyVal::integer(1), PyVal::integer(2)});
+//
+// v1 scope matches the cpp worker: primitive by-value args/results,
+// inline replies, no actors.  The driver keeps one leased worker per
+// Driver object (serial dispatch) and returns it on destruction — the
+// fan-out story belongs to the Python driver; this API is the
+// "C++ program participates in the cluster" surface.
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "pycodec.h"
+
+namespace ray_tpu_cpp {
+
+struct TaskFailure : std::runtime_error {
+  explicit TaskFailure(const std::string& m) : std::runtime_error(m) {}
+};
+
+class Driver {
+ public:
+  Driver(const std::string& raylet_host, int raylet_port,
+         const std::string& gcs_host, int gcs_port);
+  ~Driver();
+
+  // submit fn_name(args) to a leased cpp worker and wait for the result
+  pycodec::PyVal call(const std::string& fn_name,
+                      const std::vector<pycodec::PyVal>& args,
+                      double timeout_s = 60.0);
+
+  const std::string& job_id() const { return job_id_; }
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+  std::string job_id_;
+};
+
+}  // namespace ray_tpu_cpp

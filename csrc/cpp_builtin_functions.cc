@@ -1,0 +1,96 @@
+// Built-in cpp task functions shipped in the stock worker binary —
+// the e2e test surface for the C++ task runtime (and a usage example
+// for RAY_TPU_CPP_FUNCTION).
+#include <unistd.h>
+
+#include <numeric>
+#include <stdexcept>
+
+#include "cpp_functions.h"
+
+namespace ray_tpu_cpp {
+
+using pycodec::PyVal;
+
+namespace {
+
+PyVal add(const std::vector<PyVal>& args) {
+  double acc = 0;
+  bool any_float = false;
+  int64_t iacc = 0;
+  for (const auto& a : args) {
+    if (a.kind == PyVal::INT) {
+      iacc += a.i;
+      acc += (double)a.i;
+    } else if (a.kind == PyVal::FLOAT) {
+      any_float = true;
+      acc += a.f;
+    } else {
+      throw std::runtime_error("Add: numeric args only");
+    }
+  }
+  return any_float ? PyVal::real(acc) : PyVal::integer(iacc);
+}
+
+PyVal concat(const std::vector<PyVal>& args) {
+  std::string out;
+  for (const auto& a : args) {
+    if (a.kind != PyVal::STR) throw std::runtime_error("Concat: str args");
+    out += a.s;
+  }
+  return PyVal::str(out);
+}
+
+PyVal fib(const std::vector<PyVal>& args) {
+  if (args.size() != 1 || args[0].kind != PyVal::INT)
+    throw std::runtime_error("Fib: one int arg");
+  int64_t a = 0, b = 1;
+  for (int64_t j = 0; j < args[0].i; ++j) {
+    int64_t t = a + b;
+    a = b;
+    b = t;
+  }
+  return PyVal::integer(a);
+}
+
+PyVal echo(const std::vector<PyVal>& args) {
+  PyVal out = PyVal::list(std::vector<PyVal>(args.begin(), args.end()));
+  return out;
+}
+
+PyVal fail(const std::vector<PyVal>& args) {
+  std::string msg = "cpp task failed deliberately";
+  if (!args.empty() && args[0].kind == PyVal::STR) msg = args[0].s;
+  throw std::runtime_error(msg);
+}
+
+PyVal pid(const std::vector<PyVal>&) {
+  // lets tests assert which PROCESS ran a task (language-pool isolation)
+  return PyVal::integer((int64_t)::getpid());
+}
+
+PyVal minmax(const std::vector<PyVal>& args) {
+  // two returns: exercise num_returns=2 from a cpp task
+  if (args.empty()) throw std::runtime_error("MinMax: need args");
+  int64_t lo = args[0].i, hi = args[0].i;
+  for (const auto& a : args) {
+    if (a.kind != PyVal::INT) throw std::runtime_error("MinMax: int args");
+    if (a.i < lo) lo = a.i;
+    if (a.i > hi) hi = a.i;
+  }
+  return PyVal::tuple({PyVal::integer(lo), PyVal::integer(hi)});
+}
+
+}  // namespace
+
+void register_builtin_functions() {
+  register_function("Add", add);
+  register_function("Concat", concat);
+  register_function("Fib", fib);
+  register_function("Echo", echo);
+  register_function("Fail", fail);
+  register_function("Pid", pid);
+  register_function("MinMax", minmax);
+}
+
+}  // namespace ray_tpu_cpp

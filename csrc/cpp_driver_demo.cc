@@ -1,0 +1,59 @@
+// Demo/e2e check for the C++ user API (cpp_api.h): joins a running
+// cluster as a native driver, runs a handful of cpp tasks, verifies
+// results, exits 0 on success.  Driven by tests/test_cpp_api.py.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "cpp_api.h"
+
+using pycodec::PyVal;
+
+static const char* arg_value(int argc, char** argv, const char* flag) {
+  for (int j = 1; j + 1 < argc; ++j)
+    if (strcmp(argv[j], flag) == 0) return argv[j + 1];
+  return nullptr;
+}
+
+int main(int argc, char** argv) {
+  const char* rh = arg_value(argc, argv, "--raylet-host");
+  const char* rp = arg_value(argc, argv, "--raylet-port");
+  const char* gh = arg_value(argc, argv, "--gcs-host");
+  const char* gp = arg_value(argc, argv, "--gcs-port");
+  if (!rh || !rp || !gh || !gp) {
+    fprintf(stderr, "usage: cpp_driver_demo --raylet-host H --raylet-port P"
+                    " --gcs-host H --gcs-port P\n");
+    return 2;
+  }
+  try {
+    ray_tpu_cpp::Driver d(rh, atoi(rp), gh, atoi(gp));
+    printf("joined cluster as job %s\n", d.job_id().c_str());
+
+    PyVal sum = d.call("Add", {PyVal::integer(40), PyVal::integer(2)});
+    printf("Add(40,2) = %s\n", sum.repr().c_str());
+    if (sum.kind != PyVal::INT || sum.i != 42) return 1;
+
+    PyVal fib = d.call("Fib", {PyVal::integer(30)});
+    printf("Fib(30) = %s\n", fib.repr().c_str());
+    if (fib.kind != PyVal::INT || fib.i != 832040) return 1;
+
+    PyVal cat = d.call("Concat", {PyVal::str("c++ "), PyVal::str("driver")});
+    printf("Concat = %s\n", cat.repr().c_str());
+    if (cat.kind != PyVal::STR || cat.s != "c++ driver") return 1;
+
+    bool raised = false;
+    try {
+      d.call("Fail", {PyVal::str("from-cpp-driver")});
+    } catch (const ray_tpu_cpp::TaskFailure& e) {
+      raised = strstr(e.what(), "from-cpp-driver") != nullptr;
+      printf("failure surfaced: %s\n", e.what());
+    }
+    if (!raised) return 1;
+
+    printf("CPP_DRIVER_OK\n");
+    return 0;
+  } catch (const std::exception& e) {
+    fprintf(stderr, "cpp driver failed: %s\n", e.what());
+    return 1;
+  }
+}

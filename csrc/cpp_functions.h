@@ -1,0 +1,38 @@
+// The C++ task SDK surface: what user code includes to write cpp tasks.
+//
+// Analog of the reference's task registration macros
+// (/root/reference/cpp/include/ray/api.h RAY_REMOTE): a function takes
+// decoded PyVal args and returns a PyVal; RAY_TPU_CPP_FUNCTION registers
+// it under a name callable from Python
+// (cross_language.cpp_function("Name")) and from the C++ driver API.
+// Users build their own worker binary by linking cpp_worker.cc with
+// translation units that use this macro.
+#pragma once
+
+#include <functional>
+#include <string>
+#include <vector>
+
+#include "pycodec.h"
+
+namespace ray_tpu_cpp {
+
+using TaskFn =
+    std::function<pycodec::PyVal(const std::vector<pycodec::PyVal>&)>;
+
+void register_function(const std::string& name, TaskFn fn);
+
+// Built-in demo/test functions compiled into the stock cpp_worker
+// (tests/test_cpp_api.py drives them end-to-end).
+void register_builtin_functions();
+
+struct Registrar {
+  Registrar(const std::string& name, TaskFn fn) {
+    register_function(name, std::move(fn));
+  }
+};
+
+}  // namespace ray_tpu_cpp
+
+#define RAY_TPU_CPP_FUNCTION(name, fn) \
+  static ::ray_tpu_cpp::Registrar _ray_tpu_reg_##name(#name, fn)

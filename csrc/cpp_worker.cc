@@ -1,0 +1,250 @@
+// cpp_worker: the C++ task-execution runtime.
+//
+// Analog of the reference's C++ worker half (/root/reference/cpp/ —
+// api.h TaskExecutor + worker main): a worker process the raylet spawns
+// for leases whose scheduling key carries language=cpp.  It speaks the
+// same worker protocol as ray_tpu/runtime/worker_main.py — register with
+// the raylet over a duplex RPC connection (fate-sharing on disconnect),
+// serve push_task from owners, execute a registered C++ function, and
+// reply with inline results in the serialization.py flat format.
+//
+// Functions are registered in a static registry by name; drivers invoke
+// them via ray_tpu.cross_language.cpp_function("Name").remote(...)
+// (the reference's cross_language.py:15 java_function analog) or from
+// C++ via the user API in cpp_api.h.  v1 scope: by-value primitive
+// args/results (no ObjectRef args, no actors, no dynamic returns).
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <string>
+
+#include "cpp_functions.h"
+#include "pycodec.h"
+#include "rpcnet.h"
+
+using pycodec::PyVal;
+
+namespace {
+
+std::map<std::string, ray_tpu_cpp::TaskFn>& registry() {
+  static std::map<std::string, ray_tpu_cpp::TaskFn> r;
+  return r;
+}
+
+// serialized-format helpers -------------------------------------------------
+
+std::string make_error_payload(const std::string& task_name,
+                               const std::string& message) {
+  // a real ray_tpu.exceptions.TaskError(function_name, cause, tb) the
+  // Python owner deserializes and raises unchanged
+  PyVal cause;
+  cause.kind = PyVal::OPAQUE;
+  cause.s = "builtins.RuntimeError";
+  cause.items.push_back(PyVal::str(message));
+  PyVal err;
+  err.kind = PyVal::OPAQUE;
+  err.s = "ray_tpu.exceptions.TaskError";
+  err.items.push_back(PyVal::str(task_name));
+  err.items.push_back(std::move(cause));
+  err.items.push_back(PyVal::str("(cpp worker)"));
+  return pycodec::flat_serialize(err, /*error_type=ERROR_TASK*/ 1);
+}
+
+PyVal error_reply(const PyVal& spec, const std::string& message) {
+  const PyVal* name = spec.get("name");
+  const PyVal* nret = spec.get("num_returns");
+  int64_t slots = 1;
+  if (nret && nret->kind == PyVal::INT && nret->i > 1) slots = nret->i;
+  std::string payload = make_error_payload(
+      name && name->kind == PyVal::STR ? name->s : "cpp-task", message);
+  PyVal results = PyVal::list();
+  for (int64_t j = 0; j < slots; ++j) {
+    PyVal one = PyVal::dict();
+    one.set("data", PyVal::bytes(payload));
+    one.set("error", PyVal::integer(1));
+    results.items.push_back(std::move(one));
+  }
+  PyVal reply = PyVal::dict();
+  reply.set("results", std::move(results));
+  return reply;
+}
+
+PyVal execute_task(const PyVal& spec) {
+  const PyVal* fn_key = spec.get("fn_key");
+  if (!fn_key || fn_key->kind != PyVal::STR ||
+      fn_key->s.rfind("cpp:", 0) != 0)
+    return error_reply(spec, "cpp worker received a non-cpp fn_key");
+  std::string name = fn_key->s.substr(4);
+  auto it = registry().find(name);
+  if (it == registry().end())
+    return error_reply(spec, "no cpp function registered as '" + name +
+                                 "' in this worker binary");
+  const PyVal* blob = spec.get("args");
+  if (!blob || blob->kind != PyVal::BYTES)
+    return error_reply(spec, "missing args blob");
+  PyVal packed;
+  try {
+    packed = pycodec::pickle_loads(blob->s);
+  } catch (const std::exception& e) {
+    return error_reply(spec, std::string("args not decodable C++-side "
+                                         "(ObjectRef/numpy args are not "
+                                         "supported by cpp tasks): ") +
+                                 e.what());
+  }
+  // args blob = (args_tuple, kwargs_dict) — core_worker._serialize_args
+  if (packed.kind != PyVal::TUPLE || packed.items.size() != 2)
+    return error_reply(spec, "bad args blob shape");
+  if (!packed.items[1].map.empty())
+    return error_reply(spec, "cpp tasks take positional args only");
+  std::vector<PyVal> args = std::move(packed.items[0].items);
+
+  PyVal value;
+  try {
+    value = it->second(args);
+  } catch (const std::exception& e) {
+    return error_reply(spec, e.what());
+  }
+
+  const PyVal* nret = spec.get("num_returns");
+  int64_t n = nret && nret->kind == PyVal::INT ? nret->i : 1;
+  if (nret && nret->kind == PyVal::STR)
+    return error_reply(spec, "num_returns='dynamic' unsupported for cpp");
+  std::vector<PyVal> values;
+  if (n == 1) {
+    values.push_back(std::move(value));
+  } else if (n == 0) {
+    // nothing
+  } else {
+    if (value.kind != PyVal::TUPLE && value.kind != PyVal::LIST)
+      return error_reply(spec, "task declared multiple returns but the "
+                               "cpp function returned a scalar");
+    if ((int64_t)value.items.size() != n)
+      return error_reply(spec, "return count mismatch");
+    values = std::move(value.items);
+  }
+  PyVal results = PyVal::list();
+  for (auto& v : values) {
+    PyVal one = PyVal::dict();
+    try {
+      one.set("data", PyVal::bytes(pycodec::flat_serialize(v)));
+    } catch (const std::exception& e) {
+      return error_reply(spec, std::string("unserializable result: ") +
+                                   e.what());
+    }
+    results.items.push_back(std::move(one));
+  }
+  PyVal reply = PyVal::dict();
+  reply.set("results", std::move(results));
+  return reply;
+}
+
+// serial executor: the owner's retry accounting assumes this worker
+// drains its FIFO one task at a time (core_worker._lease_worker_loop)
+struct Executor {
+  std::mutex m;
+  std::condition_variable cv;
+  std::deque<std::tuple<PyVal, PyVal*, std::condition_variable*, bool*>> q;
+
+  PyVal run(const PyVal& spec) {
+    PyVal out;
+    std::condition_variable done_cv;
+    bool done = false;
+    {
+      std::lock_guard<std::mutex> g(m);
+      q.emplace_back(spec, &out, &done_cv, &done);
+      cv.notify_one();
+    }
+    std::unique_lock<std::mutex> lk(m);
+    done_cv.wait(lk, [&] { return done; });
+    return out;
+  }
+
+  void loop() {
+    for (;;) {
+      std::tuple<PyVal, PyVal*, std::condition_variable*, bool*> item;
+      {
+        std::unique_lock<std::mutex> lk(m);
+        cv.wait(lk, [&] { return !q.empty(); });
+        item = std::move(q.front());
+        q.pop_front();
+      }
+      PyVal out = execute_task(std::get<0>(item));
+      {
+        std::lock_guard<std::mutex> g(m);
+        *std::get<1>(item) = std::move(out);
+        *std::get<3>(item) = true;
+        std::get<2>(item)->notify_all();
+      }
+    }
+  }
+};
+
+Executor g_exec;
+
+PyVal dispatch(const std::string& method, const PyVal& payload) {
+  if (method == "push_task") return g_exec.run(payload);
+  if (method == "kill") _exit(1);
+  if (method == "ping") return PyVal::dict();
+  if (method == "profile") {
+    PyVal out = PyVal::dict();
+    out.set("folded", PyVal::str("cpp_worker;native 1"));
+    return out;
+  }
+  throw rpcnet::RpcError("cpp worker: unsupported method " + method);
+}
+
+const char* arg_value(int argc, char** argv, const char* flag) {
+  for (int j = 1; j + 1 < argc; ++j)
+    if (strcmp(argv[j], flag) == 0) return argv[j + 1];
+  return nullptr;
+}
+
+}  // namespace
+
+namespace ray_tpu_cpp {
+void register_function(const std::string& name, TaskFn fn) {
+  registry()[name] = std::move(fn);
+}
+}  // namespace ray_tpu_cpp
+
+int main(int argc, char** argv) {
+  const char* raylet_host = arg_value(argc, argv, "--raylet-host");
+  const char* raylet_port = arg_value(argc, argv, "--raylet-port");
+  const char* worker_id = arg_value(argc, argv, "--worker-id");
+  if (!raylet_host || !raylet_port || !worker_id) {
+    fprintf(stderr, "usage: cpp_worker --raylet-host H --raylet-port P "
+                    "--worker-id ID [ignored worker_main flags]\n");
+    return 2;
+  }
+  ray_tpu_cpp::register_builtin_functions();
+
+  std::thread exec([&] { g_exec.loop(); });
+  exec.detach();
+
+  rpcnet::Server server(dispatch);
+
+  // fate-share with the raylet exactly like worker_main.py:_raylet_gone
+  rpcnet::Conn* raylet = rpcnet::Conn::connect(
+      raylet_host, atoi(raylet_port), dispatch, [] {
+        fprintf(stderr, "raylet connection lost; cpp worker exiting\n");
+        _exit(1);
+      });
+
+  PyVal reg = PyVal::dict();
+  reg.set("worker_id", PyVal::str(worker_id));
+  PyVal addr = PyVal::list();
+  addr.items.push_back(PyVal::str("127.0.0.1"));
+  addr.items.push_back(PyVal::integer(server.port()));
+  reg.set("address", std::move(addr));
+  try {
+    raylet->call("register_worker", reg, 30.0);
+  } catch (const std::exception& e) {
+    fprintf(stderr, "register_worker failed: %s\n", e.what());
+    return 1;
+  }
+  fprintf(stderr, "cpp worker %s serving on port %d\n", worker_id,
+          server.port());
+  for (;;) pause();
+}

@@ -1,0 +1,638 @@
+// pycodec implementation — see pycodec.h for scope.
+#include "pycodec.h"
+
+#include <cstring>
+
+namespace pycodec {
+
+namespace {
+
+// ---------------------------------------------------------------- repr
+void repr_into(const PyVal& v, std::string* out) {
+  char buf[64];
+  switch (v.kind) {
+    case PyVal::NONE: *out += "None"; break;
+    case PyVal::BOOL: *out += v.b ? "True" : "False"; break;
+    case PyVal::INT:
+      snprintf(buf, sizeof buf, "%lld", (long long)v.i);
+      *out += buf;
+      break;
+    case PyVal::FLOAT:
+      snprintf(buf, sizeof buf, "%g", v.f);
+      *out += buf;
+      break;
+    case PyVal::STR:
+      *out += '\'';
+      *out += v.s;
+      *out += '\'';
+      break;
+    case PyVal::BYTES:
+      *out += "b'";
+      for (unsigned char c : v.s) {
+        if (c >= 0x20 && c < 0x7f && c != '\'') {
+          *out += (char)c;
+        } else {
+          snprintf(buf, sizeof buf, "\\x%02x", c);
+          *out += buf;
+        }
+      }
+      *out += '\'';
+      break;
+    case PyVal::LIST:
+    case PyVal::TUPLE: {
+      *out += v.kind == PyVal::LIST ? '[' : '(';
+      for (size_t j = 0; j < v.items.size(); ++j) {
+        if (j) *out += ", ";
+        repr_into(v.items[j], out);
+      }
+      if (v.kind == PyVal::TUPLE && v.items.size() == 1) *out += ',';
+      *out += v.kind == PyVal::LIST ? ']' : ')';
+      break;
+    }
+    case PyVal::DICT: {
+      *out += '{';
+      for (size_t j = 0; j < v.map.size(); ++j) {
+        if (j) *out += ", ";
+        repr_into(v.map[j].first, out);
+        *out += ": ";
+        repr_into(v.map[j].second, out);
+      }
+      *out += '}';
+      break;
+    }
+    case PyVal::OPAQUE: {
+      *out += '<';
+      *out += v.s;
+      if (!v.items.empty()) {
+        *out += '(';
+        for (size_t j = 0; j < v.items.size(); ++j) {
+          if (j) *out += ", ";
+          repr_into(v.items[j], out);
+        }
+        *out += ')';
+      }
+      *out += '>';
+      break;
+    }
+  }
+}
+
+// ------------------------------------------------------------- decoder
+struct Reader {
+  const unsigned char* p;
+  const unsigned char* end;
+  explicit Reader(const std::string& d)
+      : p((const unsigned char*)d.data()),
+        end((const unsigned char*)d.data() + d.size()) {}
+  unsigned char u8() {
+    if (p >= end) throw CodecError("pickle: truncated");
+    return *p++;
+  }
+  const unsigned char* take(size_t n) {
+    if ((size_t)(end - p) < n) throw CodecError("pickle: truncated");
+    const unsigned char* q = p;
+    p += n;
+    return q;
+  }
+  uint16_t u16le() {
+    const unsigned char* q = take(2);
+    return (uint16_t)(q[0] | q[1] << 8);
+  }
+  uint32_t u32le() {
+    const unsigned char* q = take(4);
+    return (uint32_t)q[0] | (uint32_t)q[1] << 8 | (uint32_t)q[2] << 16 |
+           (uint32_t)q[3] << 24;
+  }
+  uint64_t u64le() {
+    uint64_t lo = u32le();
+    uint64_t hi = u32le();
+    return lo | hi << 32;
+  }
+};
+
+constexpr int kMark = -1;  // sentinel index on the meta stack
+
+struct Unpickler {
+  Reader r;
+  std::vector<PyVal> stack;
+  std::vector<size_t> marks;
+  std::vector<PyVal> memo;
+
+  explicit Unpickler(const std::string& d) : r(d) {}
+
+  PyVal pop() {
+    if (stack.empty()) throw CodecError("pickle: stack underflow");
+    PyVal v = std::move(stack.back());
+    stack.pop_back();
+    return v;
+  }
+  std::vector<PyVal> pop_to_mark() {
+    if (marks.empty()) throw CodecError("pickle: no mark");
+    size_t m = marks.back();
+    marks.pop_back();
+    std::vector<PyVal> out(std::make_move_iterator(stack.begin() + m),
+                           std::make_move_iterator(stack.end()));
+    stack.resize(m);
+    return out;
+  }
+  void memo_put(size_t idx) {
+    if (stack.empty()) throw CodecError("pickle: memoize on empty stack");
+    if (memo.size() <= idx) memo.resize(idx + 1);
+    memo[idx] = stack.back();
+  }
+
+  PyVal run() {
+    for (;;) {
+      unsigned char op = r.u8();
+      switch (op) {
+        case 0x80: /* PROTO */ r.u8(); break;
+        case 0x95: /* FRAME */ r.u64le(); break;
+        case '.': /* STOP */
+          if (stack.size() != 1)
+            throw CodecError("pickle: bad final stack");
+          return std::move(stack.back());
+        case 'N': stack.push_back(PyVal::none()); break;
+        case 0x88: stack.push_back(PyVal::boolean(true)); break;
+        case 0x89: stack.push_back(PyVal::boolean(false)); break;
+        case 'J': /* BININT, signed */
+          stack.push_back(PyVal::integer((int32_t)r.u32le()));
+          break;
+        case 'K': stack.push_back(PyVal::integer(r.u8())); break;
+        case 'M': stack.push_back(PyVal::integer(r.u16le())); break;
+        case 0x8a: { /* LONG1 */
+          size_t n = r.u8();
+          if (n > 8) throw CodecError("pickle: LONG1 too wide for int64");
+          const unsigned char* q = r.take(n);
+          uint64_t raw = 0;
+          for (size_t j = 0; j < n; ++j) raw |= (uint64_t)q[j] << (8 * j);
+          // sign-extend little-endian two's complement
+          if (n > 0 && n < 8 && (q[n - 1] & 0x80))
+            raw |= ~uint64_t(0) << (8 * n);
+          stack.push_back(PyVal::integer((int64_t)raw));
+          break;
+        }
+        case 'G': { /* BINFLOAT, big-endian double */
+          const unsigned char* q = r.take(8);
+          uint64_t raw = 0;
+          for (int j = 0; j < 8; ++j) raw = raw << 8 | q[j];
+          double d;
+          memcpy(&d, &raw, 8);
+          stack.push_back(PyVal::real(d));
+          break;
+        }
+        case 0x8c: { /* SHORT_BINUNICODE */
+          size_t n = r.u8();
+          const unsigned char* q = r.take(n);
+          stack.push_back(PyVal::str(std::string((const char*)q, n)));
+          break;
+        }
+        case 'X': { /* BINUNICODE */
+          size_t n = r.u32le();
+          const unsigned char* q = r.take(n);
+          stack.push_back(PyVal::str(std::string((const char*)q, n)));
+          break;
+        }
+        case 0x8d: { /* BINUNICODE8 */
+          size_t n = (size_t)r.u64le();
+          const unsigned char* q = r.take(n);
+          stack.push_back(PyVal::str(std::string((const char*)q, n)));
+          break;
+        }
+        case 'C': { /* SHORT_BINBYTES */
+          size_t n = r.u8();
+          const unsigned char* q = r.take(n);
+          stack.push_back(PyVal::bytes(std::string((const char*)q, n)));
+          break;
+        }
+        case 'B': { /* BINBYTES */
+          size_t n = r.u32le();
+          const unsigned char* q = r.take(n);
+          stack.push_back(PyVal::bytes(std::string((const char*)q, n)));
+          break;
+        }
+        case 0x8e: { /* BINBYTES8 */
+          size_t n = (size_t)r.u64le();
+          const unsigned char* q = r.take(n);
+          stack.push_back(PyVal::bytes(std::string((const char*)q, n)));
+          break;
+        }
+        case ']': stack.push_back(PyVal::list()); break;
+        case ')': stack.push_back(PyVal::tuple()); break;
+        case '}': stack.push_back(PyVal::dict()); break;
+        case '(': marks.push_back(stack.size()); break;
+        case 'a': { /* APPEND */
+          PyVal v = pop();
+          if (stack.empty() || stack.back().kind != PyVal::LIST)
+            throw CodecError("pickle: APPEND to non-list");
+          stack.back().items.push_back(std::move(v));
+          break;
+        }
+        case 'e': { /* APPENDS */
+          std::vector<PyVal> vs = pop_to_mark();
+          if (stack.empty() || stack.back().kind != PyVal::LIST)
+            throw CodecError("pickle: APPENDS to non-list");
+          for (auto& v : vs) stack.back().items.push_back(std::move(v));
+          break;
+        }
+        case 't': { /* TUPLE */
+          std::vector<PyVal> vs = pop_to_mark();
+          stack.push_back(PyVal::tuple(std::move(vs)));
+          break;
+        }
+        case 0x85: { /* TUPLE1 */
+          PyVal a = pop();
+          stack.push_back(PyVal::tuple({std::move(a)}));
+          break;
+        }
+        case 0x86: { /* TUPLE2 */
+          PyVal b2 = pop(), a = pop();
+          stack.push_back(PyVal::tuple({std::move(a), std::move(b2)}));
+          break;
+        }
+        case 0x87: { /* TUPLE3 */
+          PyVal c = pop(), b2 = pop(), a = pop();
+          stack.push_back(
+              PyVal::tuple({std::move(a), std::move(b2), std::move(c)}));
+          break;
+        }
+        case 's': { /* SETITEM */
+          PyVal v = pop(), k = pop();
+          if (stack.empty() || stack.back().kind != PyVal::DICT)
+            throw CodecError("pickle: SETITEM on non-dict");
+          stack.back().map.emplace_back(std::move(k), std::move(v));
+          break;
+        }
+        case 'u': { /* SETITEMS */
+          std::vector<PyVal> vs = pop_to_mark();
+          if (vs.size() % 2)
+            throw CodecError("pickle: SETITEMS odd count");
+          if (stack.empty() || stack.back().kind != PyVal::DICT)
+            throw CodecError("pickle: SETITEMS on non-dict");
+          for (size_t j = 0; j < vs.size(); j += 2)
+            stack.back().map.emplace_back(std::move(vs[j]),
+                                          std::move(vs[j + 1]));
+          break;
+        }
+        case 0x94: /* MEMOIZE */ memo_put(memo.size()); break;
+        case 'q': /* BINPUT */ memo_put(r.u8()); break;
+        case 'r': /* LONG_BINPUT */ memo_put(r.u32le()); break;
+        case 'h': { /* BINGET */
+          size_t idx = r.u8();
+          if (idx >= memo.size()) throw CodecError("pickle: bad memo get");
+          stack.push_back(memo[idx]);
+          break;
+        }
+        case 'j': { /* LONG_BINGET */
+          size_t idx = r.u32le();
+          if (idx >= memo.size()) throw CodecError("pickle: bad memo get");
+          stack.push_back(memo[idx]);
+          break;
+        }
+        case 'c': { /* GLOBAL: two newline-terminated strings */
+          std::string mod, name;
+          for (unsigned char ch; (ch = r.u8()) != '\n';) mod += (char)ch;
+          for (unsigned char ch; (ch = r.u8()) != '\n';) name += (char)ch;
+          PyVal o;
+          o.kind = PyVal::OPAQUE;
+          o.s = mod + "." + name;
+          stack.push_back(std::move(o));
+          break;
+        }
+        case 0x93: { /* STACK_GLOBAL */
+          PyVal name = pop(), mod = pop();
+          PyVal o;
+          o.kind = PyVal::OPAQUE;
+          o.s = (mod.kind == PyVal::STR ? mod.s : "?") + "." +
+                (name.kind == PyVal::STR ? name.s : "?");
+          stack.push_back(std::move(o));
+          break;
+        }
+        case 'R':      /* REDUCE: callable(args) -> opaque keeping both */
+        case 0x81: { /* NEWOBJ: cls.__new__(cls, *args) */
+          PyVal args = pop(), callable = pop();
+          // protocol-2 bytes: _codecs.encode(latin1_str, 'latin1') — map
+          // the utf-8-carried code points (< 256 by construction) back
+          if (callable.kind == PyVal::OPAQUE &&
+              callable.s == "_codecs.encode" &&
+              args.kind == PyVal::TUPLE && args.items.size() == 2 &&
+              args.items[0].kind == PyVal::STR &&
+              args.items[1].kind == PyVal::STR &&
+              args.items[1].s == "latin1") {
+            const std::string& u = args.items[0].s;
+            std::string raw;
+            raw.reserve(u.size());
+            for (size_t j = 0; j < u.size();) {
+              unsigned char c0 = u[j];
+              if (c0 < 0x80) {
+                raw += (char)c0;
+                j += 1;
+              } else {  // 2-byte utf-8 sequence for U+0080..U+00FF
+                if (j + 1 >= u.size())
+                  throw CodecError("pickle: bad latin1 payload");
+                raw += (char)(((c0 & 0x1f) << 6) | (u[j + 1] & 0x3f));
+                j += 2;
+              }
+            }
+            stack.push_back(PyVal::bytes(std::move(raw)));
+            break;
+          }
+          // protocol-2 empty bytes: __builtin__.bytes() / builtins.bytes()
+          if (callable.kind == PyVal::OPAQUE &&
+              (callable.s == "__builtin__.bytes" ||
+               callable.s == "builtins.bytes") &&
+              args.kind == PyVal::TUPLE && args.items.empty()) {
+            stack.push_back(PyVal::bytes(""));
+            break;
+          }
+          PyVal o;
+          o.kind = PyVal::OPAQUE;
+          o.s = callable.kind == PyVal::OPAQUE ? callable.s : "?";
+          if (args.kind == PyVal::TUPLE) o.items = std::move(args.items);
+          else o.items.push_back(std::move(args));
+          stack.push_back(std::move(o));
+          break;
+        }
+        case 'b': { /* BUILD: obj.__setstate__(state) — keep the state */
+          PyVal state = pop();
+          if (stack.empty()) throw CodecError("pickle: BUILD underflow");
+          if (stack.back().kind == PyVal::OPAQUE)
+            stack.back().items.push_back(std::move(state));
+          break;
+        }
+        case 0x8f: /* EMPTY_SET -> treat as list */
+          stack.push_back(PyVal::list());
+          break;
+        case 0x90: { /* ADDITEMS (set) */
+          std::vector<PyVal> vs = pop_to_mark();
+          if (stack.empty() || stack.back().kind != PyVal::LIST)
+            throw CodecError("pickle: ADDITEMS on non-set");
+          for (auto& v : vs) stack.back().items.push_back(std::move(v));
+          break;
+        }
+        default: {
+          char msg[64];
+          snprintf(msg, sizeof msg, "pickle: unsupported opcode 0x%02x", op);
+          throw CodecError(msg);
+        }
+      }
+    }
+  }
+};
+
+// ------------------------------------------------------------- encoder
+void dump_val(const PyVal& v, std::string* out) {
+  char buf[16];
+  switch (v.kind) {
+    case PyVal::NONE: *out += 'N'; break;
+    case PyVal::BOOL: *out += (char)(v.b ? 0x88 : 0x89); break;
+    case PyVal::INT: {
+      if (v.i >= 0 && v.i < 256) {
+        *out += 'K';
+        *out += (char)v.i;
+      } else if (v.i >= INT32_MIN && v.i <= INT32_MAX) {
+        *out += 'J';
+        uint32_t u = (uint32_t)(int32_t)v.i;
+        for (int j = 0; j < 4; ++j) *out += (char)(u >> (8 * j));
+      } else { /* LONG1, 8-byte two's complement + sign pad rules */
+        uint64_t u = (uint64_t)v.i;
+        unsigned char le[9];
+        size_t n = 0;
+        for (; n < 8; ++n) le[n] = (unsigned char)(u >> (8 * n));
+        // trim redundant sign bytes
+        while (n > 1) {
+          unsigned char top = le[n - 1], next = le[n - 2];
+          if ((top == 0x00 && !(next & 0x80)) ||
+              (top == 0xff && (next & 0x80)))
+            --n;
+          else
+            break;
+        }
+        *out += (char)0x8a;
+        *out += (char)n;
+        out->append((const char*)le, n);
+      }
+      break;
+    }
+    case PyVal::FLOAT: {
+      *out += 'G';
+      uint64_t raw;
+      memcpy(&raw, &v.f, 8);
+      for (int j = 7; j >= 0; --j) *out += (char)(raw >> (8 * j));
+      break;
+    }
+    case PyVal::STR: {
+      *out += 'X';
+      uint32_t n = (uint32_t)v.s.size();
+      for (int j = 0; j < 4; ++j) *out += (char)(n >> (8 * j));
+      *out += v.s;
+      break;
+    }
+    case PyVal::BYTES: {
+      *out += 'B';
+      uint32_t n = (uint32_t)v.s.size();
+      for (int j = 0; j < 4; ++j) *out += (char)(n >> (8 * j));
+      *out += v.s;
+      break;
+    }
+    case PyVal::LIST: {
+      *out += ']';
+      if (!v.items.empty()) {
+        *out += '(';
+        for (const auto& it : v.items) dump_val(it, out);
+        *out += 'e';
+      }
+      break;
+    }
+    case PyVal::TUPLE: {
+      if (v.items.empty()) {
+        *out += ')';
+      } else if (v.items.size() <= 3) {
+        for (const auto& it : v.items) dump_val(it, out);
+        *out += (char)(0x85 + v.items.size() - 1);
+      } else {
+        *out += '(';
+        for (const auto& it : v.items) dump_val(it, out);
+        *out += 't';
+      }
+      break;
+    }
+    case PyVal::DICT: {
+      *out += '}';
+      if (!v.map.empty()) {
+        *out += '(';
+        for (const auto& kv : v.map) {
+          dump_val(kv.first, out);
+          dump_val(kv.second, out);
+        }
+        *out += 'u';
+      }
+      break;
+    }
+    case PyVal::OPAQUE: {
+      // GLOBAL(module, name) + args tuple + REDUCE: lets C++ construct
+      // Python objects by qualified name — used for real exception
+      // payloads (e.g. ray_tpu.exceptions.TaskError) in task replies
+      size_t dot = v.s.rfind('.');
+      if (dot == std::string::npos)
+        throw CodecError("pickle: opaque value needs module.name: " + v.s);
+      *out += 'c';
+      *out += v.s.substr(0, dot);
+      *out += '\n';
+      *out += v.s.substr(dot + 1);
+      *out += '\n';
+      PyVal args = PyVal::tuple(v.items);
+      dump_val(args, out);
+      *out += 'R';
+      break;
+    }
+  }
+  (void)buf;
+}
+
+// ----------------------------------------------------- msgpack (tiny)
+void mp_uint(uint64_t n, std::string* out) {
+  if (n < 128) {
+    *out += (char)n;
+  } else if (n <= 0xffffffffu) {
+    *out += (char)0xce;
+    for (int j = 3; j >= 0; --j) *out += (char)(n >> (8 * j));
+  } else {
+    *out += (char)0xcf;
+    for (int j = 7; j >= 0; --j) *out += (char)(n >> (8 * j));
+  }
+}
+void mp_str(const std::string& s, std::string* out) {
+  if (s.size() < 32) {
+    *out += (char)(0xa0 | s.size());
+  } else {
+    *out += (char)0xd9;
+    *out += (char)s.size();
+  }
+  *out += s;
+}
+
+}  // namespace
+
+std::string PyVal::repr() const {
+  std::string out;
+  repr_into(*this, &out);
+  return out;
+}
+
+PyVal pickle_loads(const std::string& data) {
+  Unpickler u(data);
+  (void)kMark;
+  return u.run();
+}
+
+std::string pickle_dumps(const PyVal& v) {
+  std::string out;
+  out += (char)0x80;  // PROTO
+  out += (char)3;     // bytes needs >= 3
+  dump_val(v, &out);
+  out += '.';
+  return out;
+}
+
+std::string flat_serialize(const PyVal& v, int64_t error_type) {
+  std::string payload = pickle_dumps(v);
+  // msgpack {"n":0, "lens":[], "plen":N, "err":E}
+  std::string meta;
+  meta += (char)0x84;  // fixmap(4)
+  mp_str("n", &meta);
+  meta += (char)0x00;
+  mp_str("lens", &meta);
+  meta += (char)0x90;  // fixarray(0)
+  mp_str("plen", &meta);
+  mp_uint(payload.size(), &meta);
+  mp_str("err", &meta);
+  mp_uint((uint64_t)error_type, &meta);
+  std::string out;
+  uint32_t mlen = (uint32_t)meta.size();
+  for (int j = 0; j < 4; ++j) out += (char)(mlen >> (8 * j));
+  out += meta;
+  out += payload;
+  return out;
+}
+
+namespace {
+// minimal msgpack reader for the meta dict written by serialization.py
+struct MpReader {
+  Reader r;
+  explicit MpReader(const unsigned char* p, const unsigned char* end)
+      : r("") {
+    r.p = p;
+    r.end = end;
+  }
+  uint64_t read_uint() {
+    unsigned char t = r.u8();
+    if (t < 0x80) return t;
+    if (t == 0xcc) return r.u8();
+    if (t == 0xcd) {
+      const unsigned char* q = r.take(2);
+      return (uint64_t)q[0] << 8 | q[1];
+    }
+    if (t == 0xce) {
+      const unsigned char* q = r.take(4);
+      return (uint64_t)q[0] << 24 | (uint64_t)q[1] << 16 |
+             (uint64_t)q[2] << 8 | q[3];
+    }
+    if (t == 0xcf) {
+      const unsigned char* q = r.take(8);
+      uint64_t n = 0;
+      for (int j = 0; j < 8; ++j) n = n << 8 | q[j];
+      return n;
+    }
+    throw CodecError("msgpack: expected uint");
+  }
+  std::string read_str() {
+    unsigned char t = r.u8();
+    size_t n;
+    if ((t & 0xe0) == 0xa0) n = t & 0x1f;
+    else if (t == 0xd9) n = r.u8();
+    else throw CodecError("msgpack: expected str");
+    const unsigned char* q = r.take(n);
+    return std::string((const char*)q, n);
+  }
+};
+}  // namespace
+
+PyVal flat_deserialize(const std::string& data, int64_t* error_type) {
+  if (data.size() < 4) throw CodecError("flat: truncated header");
+  uint32_t mlen = (uint32_t)(unsigned char)data[0] |
+                  (uint32_t)(unsigned char)data[1] << 8 |
+                  (uint32_t)(unsigned char)data[2] << 16 |
+                  (uint32_t)(unsigned char)data[3] << 24;
+  if (data.size() < 4 + mlen) throw CodecError("flat: truncated meta");
+  MpReader mp((const unsigned char*)data.data() + 4,
+              (const unsigned char*)data.data() + 4 + mlen);
+  unsigned char t = mp.r.u8();
+  if ((t & 0xf0) != 0x80) throw CodecError("flat: meta not a map");
+  size_t pairs = t & 0x0f;
+  uint64_t nbuf = 0, plen = 0, err = 0;
+  for (size_t j = 0; j < pairs; ++j) {
+    std::string key = mp.read_str();
+    if (key == "lens") {
+      unsigned char at = mp.r.u8();
+      size_t n;
+      if ((at & 0xf0) == 0x90) n = at & 0x0f;
+      else if (at == 0xdc) { const unsigned char* q = mp.r.take(2);
+                             n = (size_t)q[0] << 8 | q[1]; }
+      else throw CodecError("flat: lens not array");
+      for (size_t k = 0; k < n; ++k) mp.read_uint();
+    } else {
+      uint64_t val = mp.read_uint();
+      if (key == "n") nbuf = val;
+      else if (key == "plen") plen = val;
+      else if (key == "err") err = val;
+    }
+  }
+  if (nbuf != 0)
+    throw CodecError("flat: payload has out-of-band buffers (numpy?) — "
+                     "not representable C++-side");
+  if (error_type) *error_type = (int64_t)err;
+  if (data.size() < 4 + mlen + plen) throw CodecError("flat: truncated");
+  return pickle_loads(data.substr(4 + mlen, plen));
+}
+
+}  // namespace pycodec

@@ -1,0 +1,99 @@
+// pycodec: a pickle/msgpack codec for the control-plane wire format.
+//
+// The framework's RPC layer frames length-prefixed pickled tuples
+// (ray_tpu/_private/rpc.py), and object payloads use
+// [u32 meta_len][msgpack meta][pickle payload] (_private/serialization.py).
+// C++ components (the cpp worker runtime and the C++ user API — the analog
+// of the reference's cpp/ tree, /root/reference/cpp/include/ray/api.h) need
+// to speak both.  This codec covers the closed value set the control plane
+// actually uses: None/bool/int/float/str/bytes/list/tuple/dict, plus an
+// OPAQUE node for anything else (class refs, reduces) so error payloads can
+// still be surfaced without a Python interpreter.
+//
+// Not a general unpickler by design: no framework object reconstruction,
+// no extension registry, no cycles (the control plane never sends them).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace pycodec {
+
+struct PyVal;
+using PyValPtr = std::shared_ptr<PyVal>;
+
+struct PyVal {
+  enum Kind { NONE, BOOL, INT, FLOAT, STR, BYTES, LIST, TUPLE, DICT, OPAQUE };
+  Kind kind = NONE;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;  // STR (utf-8) / BYTES; OPAQUE: "module.qualname"
+  std::vector<PyVal> items;                      // LIST/TUPLE; OPAQUE: args
+  std::vector<std::pair<PyVal, PyVal>> map;      // DICT
+
+  static PyVal none() { return PyVal{}; }
+  static PyVal boolean(bool v) { PyVal x; x.kind = BOOL; x.b = v; return x; }
+  static PyVal integer(int64_t v) { PyVal x; x.kind = INT; x.i = v; return x; }
+  static PyVal real(double v) { PyVal x; x.kind = FLOAT; x.f = v; return x; }
+  static PyVal str(std::string v) {
+    PyVal x; x.kind = STR; x.s = std::move(v); return x;
+  }
+  static PyVal bytes(std::string v) {
+    PyVal x; x.kind = BYTES; x.s = std::move(v); return x;
+  }
+  static PyVal list(std::vector<PyVal> v = {}) {
+    PyVal x; x.kind = LIST; x.items = std::move(v); return x;
+  }
+  static PyVal tuple(std::vector<PyVal> v = {}) {
+    PyVal x; x.kind = TUPLE; x.items = std::move(v); return x;
+  }
+  static PyVal dict() { PyVal x; x.kind = DICT; return x; }
+
+  void set(const std::string& key, PyVal value) {
+    map.emplace_back(PyVal::str(key), std::move(value));
+  }
+  // dict lookup by string key; nullptr when absent
+  const PyVal* get(const std::string& key) const {
+    for (const auto& kv : map)
+      if (kv.first.kind == STR && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+  bool truthy() const {
+    switch (kind) {
+      case NONE: return false;
+      case BOOL: return b;
+      case INT: return i != 0;
+      case FLOAT: return f != 0.0;
+      case STR: case BYTES: return !s.empty();
+      case LIST: case TUPLE: return !items.empty();
+      case DICT: return !map.empty();
+      default: return true;
+    }
+  }
+  // Pythonic repr for diagnostics/tests
+  std::string repr() const;
+};
+
+struct CodecError : std::runtime_error {
+  explicit CodecError(const std::string& m) : std::runtime_error(m) {}
+};
+
+// pickle.loads: accepts protocol 2..5 streams over the supported value set.
+PyVal pickle_loads(const std::string& data);
+// pickle.dumps(protocol=3): loadable by any Python 3.
+std::string pickle_dumps(const PyVal& v);
+
+// Object-payload flat format (serialization.py serialize/to_flat_bytes)
+// with zero out-of-band buffers: [u32 meta_len][msgpack meta][payload].
+std::string flat_serialize(const PyVal& v, int64_t error_type = 0);
+// Inverse for inline results; throws CodecError if the payload carries
+// out-of-band buffers (numpy et al. — not a C++-side value).
+PyVal flat_deserialize(const std::string& data, int64_t* error_type);
+
+}  // namespace pycodec

@@ -1,0 +1,46 @@
+// Round-trip test harness for pycodec (driven by tests/test_cpp_api.py):
+// stdin:  [u32 len][pickled value] ...
+// stdout: per value, [u32 len][re-encoded pickle][u32 len][repr utf-8]
+#include <cstdio>
+#include <string>
+
+#include "pycodec.h"
+
+static bool read_exact(char* buf, size_t n) {
+  return fread(buf, 1, n, stdin) == n;
+}
+static void write_block(const std::string& s) {
+  uint32_t n = (uint32_t)s.size();
+  char hdr[4] = {(char)n, (char)(n >> 8), (char)(n >> 16), (char)(n >> 24)};
+  fwrite(hdr, 1, 4, stdout);
+  fwrite(s.data(), 1, s.size(), stdout);
+}
+
+int main() {
+  char hdr[4];
+  while (read_exact(hdr, 4)) {
+    uint32_t n = (uint32_t)(unsigned char)hdr[0] |
+                 (uint32_t)(unsigned char)hdr[1] << 8 |
+                 (uint32_t)(unsigned char)hdr[2] << 16 |
+                 (uint32_t)(unsigned char)hdr[3] << 24;
+    std::string data(n, '\0');
+    if (!read_exact(&data[0], n)) return 1;
+    try {
+      pycodec::PyVal v = pycodec::pickle_loads(data);
+      std::string enc;
+      try {
+        enc = pycodec::pickle_dumps(v);
+      } catch (const std::exception&) {
+        // opaque values (class refs etc.) decode for inspection but
+        // cannot be re-encoded — report the repr alone
+      }
+      write_block(enc);
+      write_block(v.repr());
+    } catch (const std::exception& e) {
+      write_block("");
+      write_block(std::string("ERROR: ") + e.what());
+    }
+    fflush(stdout);
+  }
+  return 0;
+}

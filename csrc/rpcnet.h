@@ -1,0 +1,317 @@
+// rpcnet: C++ side of the control-plane RPC protocol.
+//
+// Wire-compatible with ray_tpu/_private/rpc.py — length-prefixed pickled
+// 4-tuples (kind, msg_id, a, b) over TCP, full duplex: either side can
+// issue requests; responses are matched by msg_id.  Used by the C++
+// worker runtime (cpp_worker.cc) and the C++ user API (the analog of the
+// reference's cpp/ tree), with pycodec doing the pickling.
+//
+// Concurrency model mirrors the Python layer: one reader thread per
+// connection, each inbound request handled on its own thread (an owner
+// pipelines task pushes on one connection; handling inline would
+// head-of-line-block them), writes serialized by a mutex.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <memory>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+#include "pycodec.h"
+
+namespace rpcnet {
+
+using pycodec::PyVal;
+
+struct RpcError : std::runtime_error {
+  explicit RpcError(const std::string& m) : std::runtime_error(m) {}
+};
+struct RemoteError : RpcError {
+  explicit RemoteError(const std::string& m) : RpcError(m) {}
+};
+
+namespace detail {
+inline void send_all(int fd, const char* p, size_t n, std::mutex& wlock) {
+  std::lock_guard<std::mutex> g(wlock);
+  while (n) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) throw RpcError("send failed");
+    p += k;
+    n -= (size_t)k;
+  }
+}
+inline bool recv_all(int fd, char* p, size_t n) {
+  while (n) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= (size_t)k;
+  }
+  return true;
+}
+}  // namespace detail
+
+class Conn {
+ public:
+  // handler(method, payload) -> reply value; throw to send an error reply
+  using Handler = std::function<PyVal(const std::string&, const PyVal&)>;
+  using CloseFn = std::function<void()>;
+
+  Conn(int fd, Handler handler = nullptr, CloseFn on_close = nullptr)
+      : fd_(fd), handler_(std::move(handler)),
+        on_close_(std::move(on_close)) {
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    reader_ = std::thread([this] { read_loop(); });
+  }
+
+  static Conn* connect(const std::string& host, int port,
+                       Handler handler = nullptr,
+                       CloseFn on_close = nullptr) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) throw RpcError("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      ::close(fd);
+      throw RpcError("bad address " + host);
+    }
+    if (::connect(fd, (sockaddr*)&addr, sizeof addr) != 0) {
+      ::close(fd);
+      throw RpcError("connect to " + host + " failed");
+    }
+    return new Conn(fd, std::move(handler), std::move(on_close));
+  }
+
+  ~Conn() {
+    close();
+    if (reader_.joinable()) reader_.join();
+  }
+
+  PyVal call(const std::string& method, const PyVal& payload,
+             double timeout_s = 60.0) {
+    int64_t id = next_id_++;
+    auto slot = std::make_shared<Slot>();
+    {
+      std::lock_guard<std::mutex> g(inflight_lock_);
+      if (closed_) throw RpcError("connection closed");
+      inflight_[id] = slot;
+    }
+    PyVal frame = PyVal::tuple(
+        {PyVal::integer(0), PyVal::integer(id), PyVal::str(method),
+         payload});
+    send_frame(frame);
+    std::unique_lock<std::mutex> lk(slot->m);
+    if (!slot->cv.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                           [&] { return slot->done; })) {
+      std::lock_guard<std::mutex> g(inflight_lock_);
+      inflight_.erase(id);
+      throw RpcError("rpc timeout: " + method);
+    }
+    if (!slot->ok) throw RemoteError(slot->err);
+    return std::move(slot->value);
+  }
+
+  void push(const std::string& method, const PyVal& payload) {
+    send_frame(PyVal::tuple({PyVal::integer(2), PyVal::integer(0),
+                             PyVal::str(method), payload}));
+  }
+
+  void close() {
+    bool was = closed_.exchange(true);
+    if (!was) {
+      ::shutdown(fd_, SHUT_RDWR);
+      ::close(fd_);
+      fail_inflight("connection closed");
+    }
+  }
+  bool closed() const { return closed_; }
+
+ private:
+  struct Slot {
+    std::mutex m;
+    std::condition_variable cv;
+    bool done = false, ok = false;
+    PyVal value;
+    std::string err;
+  };
+
+  void send_frame(const PyVal& frame) {
+    std::string data = pycodec::pickle_dumps(frame);
+    char hdr[4];
+    uint32_t n = (uint32_t)data.size();
+    for (int j = 0; j < 4; ++j) hdr[j] = (char)(n >> (8 * j));
+    std::string buf(hdr, 4);
+    buf += data;
+    try {
+      detail::send_all(fd_, buf.data(), buf.size(), wlock_);
+    } catch (...) {
+      close();
+      throw;
+    }
+  }
+
+  void read_loop() {
+    for (;;) {
+      char hdr[4];
+      if (!detail::recv_all(fd_, hdr, 4)) break;
+      uint32_t n = (uint32_t)(unsigned char)hdr[0] |
+                   (uint32_t)(unsigned char)hdr[1] << 8 |
+                   (uint32_t)(unsigned char)hdr[2] << 16 |
+                   (uint32_t)(unsigned char)hdr[3] << 24;
+      std::string data(n, '\0');
+      if (!detail::recv_all(fd_, &data[0], n)) break;
+      PyVal frame;
+      try {
+        frame = pycodec::pickle_loads(data);
+      } catch (const std::exception&) {
+        break;  // protocol garbage: drop the connection
+      }
+      if (frame.kind != PyVal::TUPLE || frame.items.size() != 4) break;
+      int64_t kind = frame.items[0].i;
+      int64_t id = frame.items[1].i;
+      if (kind == 0) {  // REQUEST
+        std::string method =
+            frame.items[2].kind == PyVal::STR ? frame.items[2].s : "";
+        PyVal payload = std::move(frame.items[3]);
+        std::thread([this, id, method, payload]() {
+          handle_request(id, method, payload);
+        }).detach();
+      } else if (kind == 1) {  // RESPONSE
+        std::shared_ptr<Slot> slot;
+        {
+          std::lock_guard<std::mutex> g(inflight_lock_);
+          auto it = inflight_.find(id);
+          if (it != inflight_.end()) {
+            slot = it->second;
+            inflight_.erase(it);
+          }
+        }
+        if (slot) {
+          std::lock_guard<std::mutex> lk(slot->m);
+          slot->ok = frame.items[2].truthy();
+          if (slot->ok)
+            slot->value = std::move(frame.items[3]);
+          else
+            slot->err = frame.items[3].repr();
+          slot->done = true;
+          slot->cv.notify_all();
+        }
+      }
+      // kind == 2 (PUSH): fire-and-forget notifications are not consumed
+      // by C++ components yet; drop them
+    }
+    closed_ = true;
+    fail_inflight("connection lost");
+    if (on_close_) on_close_();
+  }
+
+  void handle_request(int64_t id, const std::string& method,
+                      const PyVal& payload) {
+    PyVal ok = PyVal::boolean(true);
+    PyVal out;
+    try {
+      if (!handler_) throw RpcError("no handler");
+      out = handler_(method, payload);
+    } catch (const std::exception& e) {
+      ok = PyVal::boolean(false);
+      // the Python side pickles exception objects; we can only send a
+      // string — rpc.RemoteError(repr(cause)) renders it faithfully
+      out = PyVal::str(std::string(e.what()));
+    }
+    try {
+      send_frame(PyVal::tuple(
+          {PyVal::integer(1), PyVal::integer(id), ok, out}));
+    } catch (...) {
+      // peer gone; reader loop will notice
+    }
+  }
+
+  void fail_inflight(const std::string& why) {
+    std::unordered_map<int64_t, std::shared_ptr<Slot>> victims;
+    {
+      std::lock_guard<std::mutex> g(inflight_lock_);
+      victims.swap(inflight_);
+    }
+    for (auto& kv : victims) {
+      std::lock_guard<std::mutex> lk(kv.second->m);
+      kv.second->ok = false;
+      kv.second->err = why;
+      kv.second->done = true;
+      kv.second->cv.notify_all();
+    }
+  }
+
+  int fd_;
+  Handler handler_;
+  CloseFn on_close_;
+  std::mutex wlock_;
+  std::atomic<int64_t> next_id_{1};
+  std::mutex inflight_lock_;
+  std::unordered_map<int64_t, std::shared_ptr<Slot>> inflight_;
+  std::atomic<bool> closed_{false};
+  std::thread reader_;
+};
+
+// Minimal listening server: accept loop, one Conn per client.
+class Server {
+ public:
+  explicit Server(Conn::Handler handler, int port = 0)
+      : handler_(std::move(handler)) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw RpcError("socket() failed");
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons((uint16_t)port);
+    if (::bind(fd_, (sockaddr*)&addr, sizeof addr) != 0 ||
+        ::listen(fd_, 128) != 0)
+      throw RpcError("bind/listen failed");
+    socklen_t len = sizeof addr;
+    getsockname(fd_, (sockaddr*)&addr, &len);
+    port_ = ntohs(addr.sin_port);
+    acceptor_ = std::thread([this] { accept_loop(); });
+  }
+
+  int port() const { return port_; }
+
+  ~Server() {
+    ::shutdown(fd_, SHUT_RDWR);
+    ::close(fd_);
+    if (acceptor_.joinable()) acceptor_.join();
+  }
+
+ private:
+  void accept_loop() {
+    for (;;) {
+      int cfd = ::accept(fd_, nullptr, nullptr);
+      if (cfd < 0) return;
+      // conns live until process exit (workers are short-lived processes;
+      // a real teardown story belongs to the embedding runtime)
+      new Conn(cfd, handler_);
+    }
+  }
+
+  Conn::Handler handler_;
+  int fd_ = -1;
+  int port_ = 0;
+  std::thread acceptor_;
+};
+
+}  // namespace rpcnet

@@ -121,6 +121,11 @@ _declare("gcs_wal_fsync", bool, False,
          "death but not kernel crash (matches Redis appendfsync "
          "everysec-style tradeoff).")
 _declare("raylet_rpc_timeout_s", float, 30.0, "Client->node-daemon RPC timeout.")
+_declare("cpp_worker_binary", str, "",
+         "Path of the C++ worker binary spawned for language=cpp leases; "
+         "empty means the stock build at ray_tpu/_core/cpp_worker. Point "
+         "it at your own binary (csrc/cpp_functions.h "
+         "RAY_TPU_CPP_FUNCTION) to expose custom C++ tasks.")
 _declare("actor_creation_timeout_s", float, 60.0, "Actor __init__ readiness timeout.")
 _declare("memory_monitor_refresh_ms", int, 250,
          "Period of the per-node host-memory monitor; 0 disables it.")

@@ -1,0 +1,100 @@
+"""Cross-language task invocation.
+
+Analog of /root/reference/python/ray/cross_language.py (java_function :15,
+java_actor_class :50), retargeted at this framework's native language:
+``cpp_function("Name")`` returns a handle whose ``.remote(...)`` submits a
+task with fn_key ``cpp:Name`` and ``language="cpp"`` — the raylet leases a
+C++ worker (csrc/cpp_worker.cc) whose static registry resolves the name
+(csrc/cpp_functions.h RAY_TPU_CPP_FUNCTION).
+
+v1 scope, enforced at submit time where possible: positional by-value
+primitive args (no ObjectRefs into cpp tasks), primitive results, fixed
+num_returns (no "dynamic"), no cpp actors yet.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+_PRIMITIVES = (type(None), bool, int, float, str, bytes)
+
+
+def _check_arg(a: Any) -> None:
+    if isinstance(a, (list, tuple)):
+        for x in a:
+            _check_arg(x)
+        return
+    if isinstance(a, dict):
+        for k, v in a.items():
+            _check_arg(k)
+            _check_arg(v)
+        return
+    if not isinstance(a, _PRIMITIVES):
+        raise TypeError(
+            f"cpp tasks take primitive by-value args; got {type(a).__name__}"
+            " (ObjectRefs/arrays are not representable C++-side)")
+
+
+class CppFunction:
+    """Handle on a C++ function registered in the worker binary."""
+
+    def __init__(self, name: str, *, num_returns: int = 1,
+                 resources: Optional[Dict[str, float]] = None,
+                 max_retries: int = 3):
+        if not name or ":" in name:
+            raise ValueError(f"bad cpp function name {name!r}")
+        self._name = name
+        self._num_returns = num_returns
+        self._resources = dict(resources or {})
+        self._max_retries = max_retries
+
+    def options(self, *, num_returns: Optional[int] = None,
+                resources: Optional[Dict[str, float]] = None,
+                max_retries: Optional[int] = None) -> "CppFunction":
+        return CppFunction(
+            self._name,
+            num_returns=self._num_returns if num_returns is None
+            else num_returns,
+            resources=self._resources if resources is None else resources,
+            max_retries=self._max_retries if max_retries is None
+            else max_retries)
+
+    def remote(self, *args):
+        import pickle
+
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.runtime.core_worker import get_global_worker
+        for a in args:
+            _check_arg(a)
+            # any arg whose pickle exceeds the inline threshold would be
+            # promoted to a store ObjectRef by _serialize_args — which a
+            # cpp worker cannot resolve; reject at the submit site with
+            # the real reason instead of a far-from-cause worker error
+            if len(pickle.dumps(a, protocol=5)) > \
+                    CONFIG.max_direct_call_args_bytes:
+                raise ValueError(
+                    "cpp task arg exceeds max_direct_call_args_bytes "
+                    f"({CONFIG.max_direct_call_args_bytes}); it would be "
+                    "promoted to a store object, which cpp tasks cannot "
+                    "resolve yet")
+        if not isinstance(self._num_returns, int):
+            raise ValueError("cpp tasks need a fixed integer num_returns")
+        worker = get_global_worker()
+        refs = worker.submit_task(
+            None, args, {},
+            num_returns=self._num_returns,
+            resources=self._resources,
+            max_retries=self._max_retries,
+            name=f"cpp:{self._name}",
+            fn_key=f"cpp:{self._name}",
+            language="cpp")
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+
+def cpp_function(name: str, **options) -> CppFunction:
+    """Handle on the C++ task ``name`` (RAY_TPU_CPP_FUNCTION-registered
+    in the worker binary — stock functions live in
+    csrc/cpp_builtin_functions.cc)."""
+    return CppFunction(name, **options)

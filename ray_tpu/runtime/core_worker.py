@@ -1064,9 +1064,15 @@ class CoreWorker:
                     name: str = "",
                     scheduling_key: Optional[str] = None,
                     scheduling_strategy: Optional[dict] = None,
-                    runtime_env: Optional[dict] = None
+                    runtime_env: Optional[dict] = None,
+                    fn_key: Optional[str] = None,
+                    language: Optional[str] = None
                     ) -> List[ObjectRef]:
-        fn_key = self.register_function(func)
+        # cross-language tasks carry a pre-resolved key ("cpp:Name") the
+        # target-language worker resolves in its own registry (reference
+        # cross_language.py — no function export through the GCS)
+        if fn_key is None:
+            fn_key = self.register_function(func)
         task_id = TaskID.from_random()
         resources = dict(resources or {})
         runtime_env = runtime_env or self.job_runtime_env
@@ -1086,6 +1092,10 @@ class CoreWorker:
             # reuse another env's idle workers (reference SchedulingKey
             # includes the serialized runtime env)
             key += "|env=" + runtime_env["hash"]
+        if language:
+            # a cpp lease must never reuse (or be reused by) python
+            # workers from the same resource-shaped pool
+            key += f"|lang={language}"
         arg_blob, live_refs = self._serialize_args(args, kwargs)
         if live_refs:
             self._arg_refs[task_id.binary()] = live_refs
@@ -1129,7 +1139,8 @@ class CoreWorker:
             self._lineage_bytes += lineage_size
             self._evict_lineage_locked()
         self._enqueue_task(key, resources, spec, max_retries,
-                           strategy=scheduling_strategy, env=runtime_env)
+                           strategy=scheduling_strategy, env=runtime_env,
+                           language=language)
         self.events.record(task_id.hex(), "SUBMITTED", name=spec["name"])
         return return_refs
 
@@ -1224,20 +1235,22 @@ class CoreWorker:
     # ----- per-key scheduling queue: leased workers pull pending specs -----
     def _sched_state(self, key: str, resources,
                      strategy: Optional[dict] = None,
-                     env: Optional[dict] = None) -> Dict[str, Any]:
+                     env: Optional[dict] = None,
+                     language: Optional[str] = None) -> Dict[str, Any]:
         with self._sched_lock:
             st = self._sched.get(key)
             if st is None:
                 st = {"queue": deque(), "leases": [], "requesting": False,
                       "resources": dict(resources), "strategy": strategy,
-                      "env": env}
+                      "env": env, "language": language}
                 self._sched[key] = st
             return st
 
     def _enqueue_task(self, key, resources, spec, retries: int,
                       strategy: Optional[dict] = None,
-                      env: Optional[dict] = None) -> None:
-        st = self._sched_state(key, resources, strategy, env)
+                      env: Optional[dict] = None,
+                      language: Optional[str] = None) -> None:
+        st = self._sched_state(key, resources, strategy, env, language)
         with self._sched_lock:
             st["queue"].append((spec, retries))
         self._maybe_request_lease(key, st)
@@ -1310,7 +1323,8 @@ class CoreWorker:
                 return grant
             # soft affinity fall-through: default path below
         payload = {"key": key, "resources": st["resources"],
-                   "job_id": self.job_id.hex(), "env": st.get("env")}
+                   "job_id": self.job_id.hex(), "env": st.get("env"),
+                   "language": st.get("language")}
         target_addr = None  # None -> local raylet
         for hop in range(3):
             if target_addr is None:
@@ -1358,7 +1372,7 @@ class CoreWorker:
         returning None); spread -> least-loaded feasible node."""
         base = {"key": key, "resources": st["resources"],
                 "job_id": self.job_id.hex(), "spillback": 2,
-                "env": st.get("env")}
+                "env": st.get("env"), "language": st.get("language")}
         kind = strategy.get("type")
         if kind == "placement_group":
             pg_id = strategy["pg_id"]

@@ -375,9 +375,10 @@ class GcsServer:
                                  ("_named_actors", None)),
         "create_placement_group": lambda p: (
             ("_placement_groups", p["pg_id"]),),
+        # actor deaths from PG removal journal individually via
+        # _on_actor_failure's own ("_actors", aid) hint
         "remove_placement_group": lambda p: (
-            ("_placement_groups", p["pg_id"]), ("_actors", None),
-            ("_named_actors", None)),
+            ("_placement_groups", p["pg_id"]), ("_named_actors", None)),
     }
 
     def _rpc_profile(self, conn, p):
@@ -669,7 +670,11 @@ class GcsServer:
                     except ConnectionError:
                         pass
             self._publish("job", {"job_id": job_id, "state": "FINISHED"})
-            self._mark_dirty(("_jobs", job_id), ("_actors", None),
+            # per-actor hints keep the WAL record O(affected), not a
+            # whole-table pickle under the global lock (_named_actors is
+            # a handful of entries — whole-table is fine there)
+            self._mark_dirty(("_jobs", job_id),
+                             *((("_actors", aid) for aid, _ in doomed)),
                              ("_named_actors", None))
 
     def _rpc_list_jobs(self, conn, p):

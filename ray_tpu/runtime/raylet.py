@@ -735,9 +735,13 @@ class Raylet:
 
     # ------------------------------------------------------------ worker pool
     def _spawn_worker(self, job_id: Optional[str],
-                      env_overrides: Optional[Dict[str, str]] = None
-                      ) -> WorkerHandle:
+                      env_overrides: Optional[Dict[str, str]] = None,
+                      language: Optional[str] = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
+        if language == "cpp":
+            return self._spawn_cpp_worker(worker_id, job_id, env_overrides)
+        if language not in (None, "", "python"):
+            raise ValueError(f"unsupported worker language {language!r}")
         from ray_tpu.runtime.node import package_pythonpath
         env = dict(os.environ)
         env.update(env_overrides or {})
@@ -783,6 +787,46 @@ class Raylet:
                                     cwd=os.getcwd())
         finally:
             out_f.close()  # the child holds its own dups
+            err_f.close()
+        handle = WorkerHandle(worker_id, proc)
+        handle.job_id = job_id
+        with self._lock:
+            self._workers[worker_id.hex()] = handle
+        return handle
+
+    def _spawn_cpp_worker(self, worker_id, job_id: Optional[str],
+                          env_overrides: Optional[Dict[str, str]]
+                          ) -> WorkerHandle:
+        """Spawn the native C++ worker runtime (csrc/cpp_worker.cc, the
+        reference's cpp/ worker analog) for language=cpp leases.  It
+        speaks the same worker protocol, so everything downstream (ready
+        wait, lease grant, reaping, kill) is language-blind.  The binary
+        is the stock one unless cpp_worker_binary points at a user build
+        with more registered functions."""
+        binary = CONFIG.cpp_worker_binary
+        if not binary:
+            binary = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "_core", "cpp_worker")
+        if not os.path.exists(binary):
+            raise RuntimeError(
+                f"cpp worker binary not found at {binary} — build it with "
+                "`make -C csrc` or set cpp_worker_binary")
+        env = dict(os.environ)
+        env.update(env_overrides or {})
+        log_prefix = os.path.join(self.session_dir, "logs",
+                                  f"cppworker-{worker_id.hex()[:12]}")
+        os.makedirs(os.path.dirname(log_prefix), exist_ok=True)
+        cmd = [binary,
+               "--raylet-host", self.address[0],
+               "--raylet-port", str(self.address[1]),
+               "--worker-id", worker_id.hex()]
+        out_f = open(log_prefix + ".out", "ab")
+        err_f = open(log_prefix + ".err", "ab")
+        try:
+            proc = subprocess.Popen(cmd, env=env, stdout=out_f,
+                                    stderr=err_f, cwd=os.getcwd())
+        finally:
+            out_f.close()
             err_f.close()
         handle = WorkerHandle(worker_id, proc)
         handle.job_id = job_id
@@ -961,6 +1005,7 @@ class Raylet:
         event = threading.Event()
         req = {"key": p.get("key", ""), "resources": p.get("resources", {}),
                "job_id": p.get("job_id"), "env": p.get("env") or {},
+               "language": p.get("language"),
                "pool": pool_key, "spillback": spillback,
                "t_queued": time.monotonic(),
                "event": event, "out": fut_holder}
@@ -1041,7 +1086,8 @@ class Raylet:
                 try:
                     handle = self._spawn_worker(
                         req["job_id"],
-                        self._merged_env(need, req.get("env")))
+                        self._merged_env(need, req.get("env")),
+                        language=req.get("language"))
                 except Exception as e:
                     # e.g. pip runtime-env build failure: the lease's
                     # resources must return and the requester must hear

@@ -20,6 +20,7 @@ import fcntl
 import hashlib
 import logging
 import os
+import re
 import subprocess
 import sys
 from typing import List
@@ -111,6 +112,53 @@ def ensure_pip_env(requirements: List[str],
             return py
         finally:
             fcntl.flock(lock_f, fcntl.LOCK_UN)
+
+
+def normalize_conda_field(conda) -> List[str]:
+    """Translate a conda runtime env into pip requirements for the venv
+    machinery (reference conda plugin: _private/runtime_env/conda.py).
+
+    Hermetic TPU images ship no conda binary, so instead of a solver we
+    honor the *declarative content* of the common shapes:
+
+    * dict (environment.yml content): ``dependencies`` entries become
+      pip requirements — ``name=ver`` → ``name==ver``, the nested
+      ``{"pip": [...]}`` block passes through; ``python=...``/``pip``
+      entries are skipped (the interpreter is the image's own).
+    * path to an ``environment.yml``: parsed the same way.
+    * named conda env (bare string): rejected — there is no conda
+      installation to look it up in.
+    """
+    if isinstance(conda, str):
+        if conda.endswith((".yml", ".yaml")):
+            import yaml
+            with open(conda) as f:
+                conda = yaml.safe_load(f) or {}
+        else:
+            raise ValueError(
+                f"conda env by name ({conda!r}) is not supported: images "
+                "are hermetic (no conda installation to resolve it). "
+                "Pass the environment.yml content (dict or path) — its "
+                "dependencies run in an isolated venv — or use 'pip'.")
+    if not isinstance(conda, dict):
+        raise TypeError("runtime_env 'conda' must be an environment.yml "
+                        "dict, a path to one, or a named env")
+    reqs: List[str] = []
+    for dep in conda.get("dependencies", []):
+        if isinstance(dep, dict):
+            reqs.extend(dep.get("pip", []))
+            continue
+        if not isinstance(dep, str):
+            raise TypeError(f"bad conda dependency: {dep!r}")
+        name = re.split(r"[=<>!~ ]", dep.strip(), maxsplit=1)[0]
+        if name in ("python", "pip"):
+            continue  # interpreter/installer come from the image
+        # conda pinning ("name=1.2", "name==1.2", "name>=1.2") → pip
+        if "=" in dep and not any(op in dep for op in ("==", ">=", "<=",
+                                                       ">", "<", "!=")):
+            dep = dep.replace("=", "==", 1)
+        reqs.append(dep)
+    return sorted(reqs)
 
 
 def normalize_pip_field(pip) -> List[str]:

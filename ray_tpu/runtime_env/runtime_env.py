@@ -5,8 +5,9 @@ class) + _private/runtime_env/ plugins. TPU-native scope: env_vars,
 working_dir, and py_modules ship code/config through the GCS KV; ``pip``
 gives CPU-side workers per-env dependency isolation via cached local
 venvs (runtime_env/pip.py — TPU-pod images should still bake heavy deps);
-``conda`` stays rejected (no conda in hermetic images; the reference's
-container plugin is the analog there).
+``conda`` environment.yml specs fold into the same venv path (their
+dependencies become pip requirements; no conda binary in hermetic
+images, so named envs are rejected).
 """
 
 from __future__ import annotations
@@ -54,10 +55,14 @@ class RuntimeEnv(dict):
             from ray_tpu.runtime_env.pip import normalize_pip_field
             self["pip"] = normalize_pip_field(pip)
         if conda:
-            raise ValueError(
-                "conda runtime envs are not supported: images are "
-                "hermetic (no conda). Use pip (isolated local venv) or "
-                "bake dependencies into the container image.")
+            # conda specs fold into the same venv isolation path as pip:
+            # the environment.yml's dependencies become requirements (the
+            # image's interpreter replaces conda's python solver)
+            from ray_tpu.runtime_env.pip import normalize_conda_field
+            merged = sorted(set(self.get("pip", []))
+                            | set(normalize_conda_field(conda)))
+            if merged:
+                self["pip"] = merged
         if config:
             self["config"] = dict(config)
 

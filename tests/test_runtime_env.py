@@ -16,13 +16,41 @@ def test_runtime_env_validation(tmp_path):
         RuntimeEnv(working_dir=str(tmp_path / "nope"))
     with pytest.raises(TypeError):
         RuntimeEnv(pip="not-a-list")
-    with pytest.raises(ValueError):
-        RuntimeEnv(conda={"dependencies": ["x"]})
     assert RuntimeEnv(pip=["b", "a"])["pip"] == ["a", "b"]
     with pytest.raises(ValueError):
         RuntimeEnv.from_dict({"bogus_field": 1})
     env = RuntimeEnv(env_vars={"A": "1"}, working_dir=str(tmp_path))
     assert env.to_dict()["env_vars"] == {"A": "1"}
+
+
+def test_conda_spec_folds_into_pip(tmp_path):
+    """conda environment.yml content routes through the venv isolation
+    path: dependencies become pip requirements (reference conda plugin,
+    _private/runtime_env/conda.py); named envs are rejected — no conda
+    binary in hermetic images."""
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    env = RuntimeEnv(conda={"dependencies": [
+        "python=3.10", "pip", "left-pad=1.0", {"pip": ["right-pad==2.0"]}]})
+    assert env["pip"] == ["left-pad==1.0", "right-pad==2.0"]
+    assert "conda" not in env  # wire format stays pip-only
+
+    # conda + pip merge, deduped
+    env = RuntimeEnv(pip=["right-pad==2.0"],
+                     conda={"dependencies": [{"pip": ["a==1"]}]})
+    assert env["pip"] == ["a==1", "right-pad==2.0"]
+
+    # environment.yml file path parses the same way
+    yml = tmp_path / "environment.yml"
+    yml.write_text("name: t\ndependencies:\n  - python=3.10\n"
+                   "  - numpy>=1.20\n  - pip:\n    - req==1.0\n")
+    env = RuntimeEnv(conda=str(yml))
+    assert env["pip"] == ["numpy>=1.20", "req==1.0"]
+
+    with pytest.raises(ValueError):
+        RuntimeEnv(conda="some-named-env")
+    with pytest.raises(TypeError):
+        RuntimeEnv(conda=[1, 2])
 
 
 def test_env_vars_in_task(ray_start_regular):

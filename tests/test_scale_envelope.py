@@ -66,6 +66,42 @@ def test_many_actors_register_and_respond():
 
 
 @pytest.mark.slow
+def test_thousand_object_args_one_task():
+    """1k ObjectRef args into a single task: argument staging resolves
+    them all and pins them for the task's lifetime (reference 10k+ args,
+    release/benchmarks/README.md:27; benchmarks/scale_envelope.py runs
+    the full 10k)."""
+    n = int(1_000 * SCALE)
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+
+    @ray_tpu.remote
+    def consume(*args):
+        return len(args), sum(args)
+
+    refs = [ray_tpu.put(i) for i in range(n)]
+    count, total = ray_tpu.get(consume.remote(*refs), timeout=1800)
+    assert count == n and total == n * (n - 1) // 2
+    ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_256_returns_one_task():
+    """Hundreds of return slots from one task: per-slot ownership entries
+    and the multi-return seal path (reference 3k+ returns,
+    release/benchmarks/README.md:28; the bench script runs 1k)."""
+    n = int(256 * SCALE)
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+
+    @ray_tpu.remote(num_returns=n)
+    def produce():
+        return tuple(range(n))
+
+    assert ray_tpu.get(list(produce.remote()),
+                       timeout=1800) == list(range(n))
+    ray_tpu.shutdown()
+
+
+@pytest.mark.slow
 def test_hundred_placement_groups():
     """100+ simultaneous placement groups: 2-phase reservation, bundle
     pools, and clean removal at the reference's envelope dimension."""
