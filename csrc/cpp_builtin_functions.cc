@@ -81,9 +81,58 @@ PyVal minmax(const std::vector<PyVal>& args) {
   return PyVal::tuple({PyVal::integer(lo), PyVal::integer(hi)});
 }
 
+// ---------------------------------------------------------------- actors
+
+struct CounterActor : CppActor {
+  int64_t n = 0;
+  explicit CounterActor(int64_t start) : n(start) {}
+  PyVal call(const std::string& method,
+             const std::vector<PyVal>& args) override {
+    if (method == "inc") {
+      n += args.empty() ? 1 : args[0].i;
+      return PyVal::integer(n);
+    }
+    if (method == "total") return PyVal::integer(n);
+    if (method == "boom") throw std::runtime_error("counter exploded");
+    throw std::runtime_error("CounterActor has no method '" + method + "'");
+  }
+};
+
+struct KvActor : CppActor {
+  std::vector<std::pair<std::string, PyVal>> entries;
+  PyVal call(const std::string& method,
+             const std::vector<PyVal>& args) override {
+    if (method == "put") {
+      if (args.size() != 2 || args[0].kind != PyVal::STR)
+        throw std::runtime_error("put(key: str, value)");
+      for (auto& kv : entries)
+        if (kv.first == args[0].s) {
+          kv.second = args[1];
+          return PyVal::none();
+        }
+      entries.emplace_back(args[0].s, args[1]);
+      return PyVal::none();
+    }
+    if (method == "get") {
+      for (auto& kv : entries)
+        if (kv.first == args.at(0).s) return kv.second;
+      return PyVal::none();
+    }
+    if (method == "size") return PyVal::integer((int64_t)entries.size());
+    throw std::runtime_error("KvActor has no method '" + method + "'");
+  }
+};
+
 }  // namespace
 
 void register_builtin_functions() {
+  register_actor_class("Counter", [](const std::vector<PyVal>& args) {
+    return std::unique_ptr<CppActor>(
+        new CounterActor(args.empty() ? 0 : args[0].i));
+  });
+  register_actor_class("Kv", [](const std::vector<PyVal>&) {
+    return std::unique_ptr<CppActor>(new KvActor());
+  });
   register_function("Add", add);
   register_function("Concat", concat);
   register_function("Fib", fib);

@@ -15,6 +15,8 @@
 
 #include "pycodec.h"
 
+#include <memory>
+
 namespace ray_tpu_cpp {
 
 using TaskFn =
@@ -22,8 +24,22 @@ using TaskFn =
 
 void register_function(const std::string& name, TaskFn fn);
 
-// Built-in demo/test functions compiled into the stock cpp_worker
-// (tests/test_cpp_api.py drives them end-to-end).
+// A C++ actor: constructed once by its factory, then receives method
+// calls in strict per-caller submission order (the actor queue
+// guarantee).  Throwing from call() fails that task only, not the actor.
+struct CppActor {
+  virtual ~CppActor() = default;
+  virtual pycodec::PyVal call(const std::string& method,
+                              const std::vector<pycodec::PyVal>& args) = 0;
+};
+
+using ActorFactory = std::function<std::unique_ptr<CppActor>(
+    const std::vector<pycodec::PyVal>&)>;
+
+void register_actor_class(const std::string& name, ActorFactory factory);
+
+// Built-in demo/test functions + actor classes compiled into the stock
+// cpp_worker (tests/test_cpp_api.py drives them end-to-end).
 void register_builtin_functions();
 
 struct Registrar {
@@ -31,8 +47,15 @@ struct Registrar {
     register_function(name, std::move(fn));
   }
 };
+struct ActorRegistrar {
+  ActorRegistrar(const std::string& name, ActorFactory f) {
+    register_actor_class(name, std::move(f));
+  }
+};
 
 }  // namespace ray_tpu_cpp
 
 #define RAY_TPU_CPP_FUNCTION(name, fn) \
   static ::ray_tpu_cpp::Registrar _ray_tpu_reg_##name(#name, fn)
+#define RAY_TPU_CPP_ACTOR(name, factory) \
+  static ::ray_tpu_cpp::ActorRegistrar _ray_tpu_areg_##name(#name, factory)

@@ -33,6 +33,17 @@ std::map<std::string, ray_tpu_cpp::TaskFn>& registry() {
   return r;
 }
 
+std::map<std::string, ray_tpu_cpp::ActorFactory>& actor_registry() {
+  static std::map<std::string, ray_tpu_cpp::ActorFactory> r;
+  return r;
+}
+
+// actor state of this (dedicated) worker process
+std::unique_ptr<ray_tpu_cpp::CppActor> g_actor;
+std::string g_actor_id;
+std::string g_gcs_host;
+int g_gcs_port = 0;
+
 // serialized-format helpers -------------------------------------------------
 
 std::string make_error_payload(const std::string& task_name,
@@ -140,6 +151,134 @@ PyVal execute_task(const PyVal& spec) {
   return reply;
 }
 
+PyVal execute_actor_task(const PyVal& spec) {
+  const PyVal* method = spec.get("method");
+  if (!method || method->kind != PyVal::STR)
+    return error_reply(spec, "actor task without method");
+  if (method->s == "__ray_terminate__") _exit(0);
+  if (!g_actor)
+    return error_reply(spec, "no actor constructed in this worker");
+  const PyVal* blob = spec.get("args");
+  PyVal packed;
+  try {
+    packed = pycodec::pickle_loads(blob ? blob->s : std::string());
+  } catch (const std::exception& e) {
+    return error_reply(spec, std::string("actor args not decodable "
+                                         "C++-side: ") + e.what());
+  }
+  if (packed.kind != PyVal::TUPLE || packed.items.size() != 2 ||
+      !packed.items[1].map.empty())
+    return error_reply(spec, "cpp actors take positional args only");
+  PyVal value;
+  try {
+    value = g_actor->call(method->s, packed.items[0].items);
+  } catch (const std::exception& e) {
+    return error_reply(spec, e.what());
+  }
+  PyVal one = PyVal::dict();
+  try {
+    one.set("data", PyVal::bytes(pycodec::flat_serialize(value)));
+  } catch (const std::exception& e) {
+    return error_reply(spec, std::string("unserializable result: ") +
+                                 e.what());
+  }
+  PyVal results = PyVal::list();
+  results.items.push_back(std::move(one));
+  PyVal reply = PyVal::dict();
+  reply.set("results", std::move(results));
+  return reply;
+}
+
+PyVal create_actor(const PyVal& p) {
+  const PyVal* aid = p.get("actor_id");
+  const PyVal* spec_blob = p.get("spec");
+  if (!aid || !spec_blob || spec_blob->kind != PyVal::BYTES)
+    throw rpcnet::RpcError("bad create_actor payload");
+  PyVal creation = pycodec::pickle_loads(spec_blob->s);
+  const PyVal* cls_key = creation.get("cls_key");
+  if (!cls_key || cls_key->kind != PyVal::STR ||
+      cls_key->s.rfind("cpp:", 0) != 0)
+    throw rpcnet::RpcError("cpp worker got a non-cpp actor class");
+  std::string name = cls_key->s.substr(4);
+  auto it = actor_registry().find(name);
+  if (it == actor_registry().end())
+    throw rpcnet::RpcError("no cpp actor class registered as '" + name +
+                           "' in this worker binary");
+  const PyVal* blob = creation.get("args");
+  PyVal packed = pycodec::pickle_loads(
+      blob && blob->kind == PyVal::BYTES ? blob->s : std::string());
+  if (packed.kind != PyVal::TUPLE || packed.items.size() != 2)
+    throw rpcnet::RpcError("bad actor creation args");
+  g_actor = it->second(packed.items[0].items);
+  g_actor_id = aid->s;
+  return PyVal::dict();  // actor_ready is sent by the caller (main flow)
+}
+
+// Actor calls carry (stream, seq) and MUST execute in seq order per
+// stream (worker_main._actor_streams analog): the handler thread parks
+// its work in the stream buffer and the executor pops in-order.
+struct ActorStreams {
+  struct Stream {
+    int64_t next = 0;
+    std::map<int64_t, std::tuple<PyVal, PyVal*, bool*>> buf;
+  };
+  std::mutex m;
+  std::condition_variable cv;       // executor wakeups
+  std::condition_variable done_cv;  // handler-thread completions
+  std::map<std::string, Stream> streams;
+
+  PyVal run(const PyVal& spec) {
+    const PyVal* seq = spec.get("seq");
+    const PyVal* stream_id = spec.get("stream");
+    PyVal out;
+    bool done = false;
+    {
+      std::lock_guard<std::mutex> g(m);
+      auto& st = streams[stream_id && stream_id->kind == PyVal::STR
+                             ? stream_id->s
+                             : std::string()];
+      st.buf[seq ? seq->i : 0] = {spec, &out, &done};
+      cv.notify_all();
+    }
+    std::unique_lock<std::mutex> lk(m);
+    done_cv.wait(lk, [&] { return done; });
+    return out;
+  }
+
+  void loop() {
+    for (;;) {
+      std::tuple<PyVal, PyVal*, bool*> work;
+      bool got = false;
+      {
+        std::unique_lock<std::mutex> lk(m);
+        cv.wait(lk, [&] {
+          for (auto& kv : streams) {
+            auto it = kv.second.buf.find(kv.second.next);
+            if (it != kv.second.buf.end()) {
+              work = std::move(it->second);
+              kv.second.buf.erase(it);
+              kv.second.next++;
+              got = true;
+              return true;
+            }
+          }
+          return false;
+        });
+      }
+      if (!got) continue;
+      PyVal out = execute_actor_task(std::get<0>(work));
+      {
+        std::lock_guard<std::mutex> g(m);
+        *std::get<1>(work) = std::move(out);
+        *std::get<2>(work) = true;
+        done_cv.notify_all();
+      }
+    }
+  }
+};
+
+ActorStreams g_actor_streams;
+
 // serial executor: the owner's retry accounting assumes this worker
 // drains its FIFO one task at a time (core_worker._lease_worker_loop)
 struct Executor {
@@ -182,9 +321,31 @@ struct Executor {
 };
 
 Executor g_exec;
+int g_server_port = 0;
+
+void notify_actor_ready() {
+  // dedicated conn; one-shot (the GCS also learns liveness via the
+  // raylet's heartbeats — this just flips the FSM to ALIVE with our
+  // address, like worker_main._create_actor's actor_ready call)
+  std::unique_ptr<rpcnet::Conn> gcs(
+      rpcnet::Conn::connect(g_gcs_host, g_gcs_port));
+  PyVal p = PyVal::dict();
+  p.set("actor_id", PyVal::str(g_actor_id));
+  PyVal addr = PyVal::list();
+  addr.items.push_back(PyVal::str("127.0.0.1"));
+  addr.items.push_back(PyVal::integer(g_server_port));
+  p.set("address", std::move(addr));
+  gcs->call("actor_ready", p, 30.0);
+}
 
 PyVal dispatch(const std::string& method, const PyVal& payload) {
   if (method == "push_task") return g_exec.run(payload);
+  if (method == "actor_task") return g_actor_streams.run(payload);
+  if (method == "create_actor") {
+    PyVal out = create_actor(payload);
+    notify_actor_ready();
+    return out;
+  }
   if (method == "kill") _exit(1);
   if (method == "ping") return PyVal::dict();
   if (method == "profile") {
@@ -207,23 +368,33 @@ namespace ray_tpu_cpp {
 void register_function(const std::string& name, TaskFn fn) {
   registry()[name] = std::move(fn);
 }
+void register_actor_class(const std::string& name, ActorFactory f) {
+  actor_registry()[name] = std::move(f);
+}
 }  // namespace ray_tpu_cpp
 
 int main(int argc, char** argv) {
   const char* raylet_host = arg_value(argc, argv, "--raylet-host");
   const char* raylet_port = arg_value(argc, argv, "--raylet-port");
   const char* worker_id = arg_value(argc, argv, "--worker-id");
+  const char* gcs_host = arg_value(argc, argv, "--gcs-host");
+  const char* gcs_port = arg_value(argc, argv, "--gcs-port");
   if (!raylet_host || !raylet_port || !worker_id) {
     fprintf(stderr, "usage: cpp_worker --raylet-host H --raylet-port P "
-                    "--worker-id ID [ignored worker_main flags]\n");
+                    "--worker-id ID [--gcs-host H --gcs-port P]\n");
     return 2;
   }
+  if (gcs_host) g_gcs_host = gcs_host;
+  if (gcs_port) g_gcs_port = atoi(gcs_port);
   ray_tpu_cpp::register_builtin_functions();
 
   std::thread exec([&] { g_exec.loop(); });
   exec.detach();
+  std::thread actor_exec([&] { g_actor_streams.loop(); });
+  actor_exec.detach();
 
   rpcnet::Server server(dispatch);
+  g_server_port = server.port();
 
   // fate-share with the raylet exactly like worker_main.py:_raylet_gone
   rpcnet::Conn* raylet = rpcnet::Conn::connect(

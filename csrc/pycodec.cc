@@ -113,32 +113,46 @@ struct Reader {
 constexpr int kMark = -1;  // sentinel index on the meta stack
 
 struct Unpickler {
+  // The stack and memo hold shared_ptrs to the SAME object: CPython
+  // memoizes a container BEFORE populating it (EMPTY_LIST; MEMOIZE;
+  // MARK ... APPENDS), so a BINGET alias must observe the later
+  // population or shared references (e.g. Echo(x, x)) decode as empty
+  // containers.  Embedding ops copy the (by then fully built) child by
+  // value — correct for all acyclic data; cycles are out of scope (the
+  // control plane never sends them) and surface as wrong-but-terminating
+  // copies rather than infinite loops.
+  using Ref = std::shared_ptr<PyVal>;
   Reader r;
-  std::vector<PyVal> stack;
+  std::vector<Ref> stack;
   std::vector<size_t> marks;
-  std::vector<PyVal> memo;
+  std::vector<Ref> memo;
 
   explicit Unpickler(const std::string& d) : r(d) {}
 
-  PyVal pop() {
+  void push(PyVal v) { stack.push_back(std::make_shared<PyVal>(std::move(v))); }
+  Ref pop() {
     if (stack.empty()) throw CodecError("pickle: stack underflow");
-    PyVal v = std::move(stack.back());
+    Ref v = std::move(stack.back());
     stack.pop_back();
     return v;
   }
-  std::vector<PyVal> pop_to_mark() {
+  PyVal& top() {
+    if (stack.empty()) throw CodecError("pickle: empty stack");
+    return *stack.back();
+  }
+  std::vector<Ref> pop_to_mark() {
     if (marks.empty()) throw CodecError("pickle: no mark");
     size_t m = marks.back();
     marks.pop_back();
-    std::vector<PyVal> out(std::make_move_iterator(stack.begin() + m),
-                           std::make_move_iterator(stack.end()));
+    std::vector<Ref> out(std::make_move_iterator(stack.begin() + m),
+                         std::make_move_iterator(stack.end()));
     stack.resize(m);
     return out;
   }
   void memo_put(size_t idx) {
     if (stack.empty()) throw CodecError("pickle: memoize on empty stack");
     if (memo.size() <= idx) memo.resize(idx + 1);
-    memo[idx] = stack.back();
+    memo[idx] = stack.back();  // alias, not copy
   }
 
   PyVal run() {
@@ -150,15 +164,15 @@ struct Unpickler {
         case '.': /* STOP */
           if (stack.size() != 1)
             throw CodecError("pickle: bad final stack");
-          return std::move(stack.back());
-        case 'N': stack.push_back(PyVal::none()); break;
-        case 0x88: stack.push_back(PyVal::boolean(true)); break;
-        case 0x89: stack.push_back(PyVal::boolean(false)); break;
+          return *stack.back();
+        case 'N': push(PyVal::none()); break;
+        case 0x88: push(PyVal::boolean(true)); break;
+        case 0x89: push(PyVal::boolean(false)); break;
         case 'J': /* BININT, signed */
-          stack.push_back(PyVal::integer((int32_t)r.u32le()));
+          push(PyVal::integer((int32_t)r.u32le()));
           break;
-        case 'K': stack.push_back(PyVal::integer(r.u8())); break;
-        case 'M': stack.push_back(PyVal::integer(r.u16le())); break;
+        case 'K': push(PyVal::integer(r.u8())); break;
+        case 'M': push(PyVal::integer(r.u16le())); break;
         case 0x8a: { /* LONG1 */
           size_t n = r.u8();
           if (n > 8) throw CodecError("pickle: LONG1 too wide for int64");
@@ -168,7 +182,7 @@ struct Unpickler {
           // sign-extend little-endian two's complement
           if (n > 0 && n < 8 && (q[n - 1] & 0x80))
             raw |= ~uint64_t(0) << (8 * n);
-          stack.push_back(PyVal::integer((int64_t)raw));
+          push(PyVal::integer((int64_t)raw));
           break;
         }
         case 'G': { /* BINFLOAT, big-endian double */
@@ -177,100 +191,101 @@ struct Unpickler {
           for (int j = 0; j < 8; ++j) raw = raw << 8 | q[j];
           double d;
           memcpy(&d, &raw, 8);
-          stack.push_back(PyVal::real(d));
+          push(PyVal::real(d));
           break;
         }
         case 0x8c: { /* SHORT_BINUNICODE */
           size_t n = r.u8();
           const unsigned char* q = r.take(n);
-          stack.push_back(PyVal::str(std::string((const char*)q, n)));
+          push(PyVal::str(std::string((const char*)q, n)));
           break;
         }
         case 'X': { /* BINUNICODE */
           size_t n = r.u32le();
           const unsigned char* q = r.take(n);
-          stack.push_back(PyVal::str(std::string((const char*)q, n)));
+          push(PyVal::str(std::string((const char*)q, n)));
           break;
         }
         case 0x8d: { /* BINUNICODE8 */
           size_t n = (size_t)r.u64le();
           const unsigned char* q = r.take(n);
-          stack.push_back(PyVal::str(std::string((const char*)q, n)));
+          push(PyVal::str(std::string((const char*)q, n)));
           break;
         }
         case 'C': { /* SHORT_BINBYTES */
           size_t n = r.u8();
           const unsigned char* q = r.take(n);
-          stack.push_back(PyVal::bytes(std::string((const char*)q, n)));
+          push(PyVal::bytes(std::string((const char*)q, n)));
           break;
         }
         case 'B': { /* BINBYTES */
           size_t n = r.u32le();
           const unsigned char* q = r.take(n);
-          stack.push_back(PyVal::bytes(std::string((const char*)q, n)));
+          push(PyVal::bytes(std::string((const char*)q, n)));
           break;
         }
         case 0x8e: { /* BINBYTES8 */
           size_t n = (size_t)r.u64le();
           const unsigned char* q = r.take(n);
-          stack.push_back(PyVal::bytes(std::string((const char*)q, n)));
+          push(PyVal::bytes(std::string((const char*)q, n)));
           break;
         }
-        case ']': stack.push_back(PyVal::list()); break;
-        case ')': stack.push_back(PyVal::tuple()); break;
-        case '}': stack.push_back(PyVal::dict()); break;
+        case ']': push(PyVal::list()); break;
+        case ')': push(PyVal::tuple()); break;
+        case '}': push(PyVal::dict()); break;
         case '(': marks.push_back(stack.size()); break;
         case 'a': { /* APPEND */
-          PyVal v = pop();
-          if (stack.empty() || stack.back().kind != PyVal::LIST)
+          Ref v = pop();
+          if (top().kind != PyVal::LIST)
             throw CodecError("pickle: APPEND to non-list");
-          stack.back().items.push_back(std::move(v));
+          top().items.push_back(*v);
           break;
         }
         case 'e': { /* APPENDS */
-          std::vector<PyVal> vs = pop_to_mark();
-          if (stack.empty() || stack.back().kind != PyVal::LIST)
+          std::vector<Ref> vs = pop_to_mark();
+          if (top().kind != PyVal::LIST)
             throw CodecError("pickle: APPENDS to non-list");
-          for (auto& v : vs) stack.back().items.push_back(std::move(v));
+          for (auto& v : vs) top().items.push_back(*v);
           break;
         }
         case 't': { /* TUPLE */
-          std::vector<PyVal> vs = pop_to_mark();
-          stack.push_back(PyVal::tuple(std::move(vs)));
+          std::vector<Ref> vs = pop_to_mark();
+          std::vector<PyVal> items;
+          items.reserve(vs.size());
+          for (auto& v : vs) items.push_back(*v);
+          push(PyVal::tuple(std::move(items)));
           break;
         }
         case 0x85: { /* TUPLE1 */
-          PyVal a = pop();
-          stack.push_back(PyVal::tuple({std::move(a)}));
+          Ref a = pop();
+          push(PyVal::tuple({*a}));
           break;
         }
         case 0x86: { /* TUPLE2 */
-          PyVal b2 = pop(), a = pop();
-          stack.push_back(PyVal::tuple({std::move(a), std::move(b2)}));
+          Ref b2 = pop(), a = pop();
+          push(PyVal::tuple({*a, *b2}));
           break;
         }
         case 0x87: { /* TUPLE3 */
-          PyVal c = pop(), b2 = pop(), a = pop();
-          stack.push_back(
-              PyVal::tuple({std::move(a), std::move(b2), std::move(c)}));
+          Ref c = pop(), b2 = pop(), a = pop();
+          push(PyVal::tuple({*a, *b2, *c}));
           break;
         }
         case 's': { /* SETITEM */
-          PyVal v = pop(), k = pop();
-          if (stack.empty() || stack.back().kind != PyVal::DICT)
+          Ref v = pop(), k = pop();
+          if (top().kind != PyVal::DICT)
             throw CodecError("pickle: SETITEM on non-dict");
-          stack.back().map.emplace_back(std::move(k), std::move(v));
+          top().map.emplace_back(*k, *v);
           break;
         }
         case 'u': { /* SETITEMS */
-          std::vector<PyVal> vs = pop_to_mark();
+          std::vector<Ref> vs = pop_to_mark();
           if (vs.size() % 2)
             throw CodecError("pickle: SETITEMS odd count");
-          if (stack.empty() || stack.back().kind != PyVal::DICT)
+          if (top().kind != PyVal::DICT)
             throw CodecError("pickle: SETITEMS on non-dict");
           for (size_t j = 0; j < vs.size(); j += 2)
-            stack.back().map.emplace_back(std::move(vs[j]),
-                                          std::move(vs[j + 1]));
+            top().map.emplace_back(*vs[j], *vs[j + 1]);
           break;
         }
         case 0x94: /* MEMOIZE */ memo_put(memo.size()); break;
@@ -295,21 +310,21 @@ struct Unpickler {
           PyVal o;
           o.kind = PyVal::OPAQUE;
           o.s = mod + "." + name;
-          stack.push_back(std::move(o));
+          push(std::move(o));
           break;
         }
         case 0x93: { /* STACK_GLOBAL */
-          PyVal name = pop(), mod = pop();
+          Ref name = pop(), mod = pop();
           PyVal o;
           o.kind = PyVal::OPAQUE;
-          o.s = (mod.kind == PyVal::STR ? mod.s : "?") + "." +
-                (name.kind == PyVal::STR ? name.s : "?");
-          stack.push_back(std::move(o));
+          o.s = (mod->kind == PyVal::STR ? mod->s : "?") + "." +
+                (name->kind == PyVal::STR ? name->s : "?");
+          push(std::move(o));
           break;
         }
         case 'R':      /* REDUCE: callable(args) -> opaque keeping both */
         case 0x81: { /* NEWOBJ: cls.__new__(cls, *args) */
-          PyVal args = pop(), callable = pop();
+          PyVal args = *pop(), callable = *pop();
           // protocol-2 bytes: _codecs.encode(latin1_str, 'latin1') — map
           // the utf-8-carried code points (< 256 by construction) back
           if (callable.kind == PyVal::OPAQUE &&
@@ -333,7 +348,7 @@ struct Unpickler {
                 j += 2;
               }
             }
-            stack.push_back(PyVal::bytes(std::move(raw)));
+            push(PyVal::bytes(std::move(raw)));
             break;
           }
           // protocol-2 empty bytes: __builtin__.bytes() / builtins.bytes()
@@ -341,7 +356,7 @@ struct Unpickler {
               (callable.s == "__builtin__.bytes" ||
                callable.s == "builtins.bytes") &&
               args.kind == PyVal::TUPLE && args.items.empty()) {
-            stack.push_back(PyVal::bytes(""));
+            push(PyVal::bytes(""));
             break;
           }
           PyVal o;
@@ -349,24 +364,23 @@ struct Unpickler {
           o.s = callable.kind == PyVal::OPAQUE ? callable.s : "?";
           if (args.kind == PyVal::TUPLE) o.items = std::move(args.items);
           else o.items.push_back(std::move(args));
-          stack.push_back(std::move(o));
+          push(std::move(o));
           break;
         }
         case 'b': { /* BUILD: obj.__setstate__(state) — keep the state */
-          PyVal state = pop();
-          if (stack.empty()) throw CodecError("pickle: BUILD underflow");
-          if (stack.back().kind == PyVal::OPAQUE)
-            stack.back().items.push_back(std::move(state));
+          Ref state = pop();
+          if (top().kind == PyVal::OPAQUE)
+            top().items.push_back(*state);
           break;
         }
         case 0x8f: /* EMPTY_SET -> treat as list */
-          stack.push_back(PyVal::list());
+          push(PyVal::list());
           break;
         case 0x90: { /* ADDITEMS (set) */
-          std::vector<PyVal> vs = pop_to_mark();
-          if (stack.empty() || stack.back().kind != PyVal::LIST)
+          std::vector<Ref> vs = pop_to_mark();
+          if (top().kind != PyVal::LIST)
             throw CodecError("pickle: ADDITEMS on non-set");
-          for (auto& v : vs) stack.back().items.push_back(std::move(v));
+          for (auto& v : vs) top().items.push_back(*v);
           break;
         }
         default: {

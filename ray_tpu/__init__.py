@@ -10,7 +10,8 @@ from ray_tpu.actor import get_actor, kill, method  # noqa: F401
 from ray_tpu.api import (available_resources, cluster_resources, context,  # noqa: F401
                          get, get_runtime_context, init, is_initialized,
                          nodes, put, remote, shutdown, wait)
-from ray_tpu.cross_language import cpp_function  # noqa: F401
+from ray_tpu.cross_language import (cpp_actor_class,  # noqa: F401
+                                    cpp_function)
 from ray_tpu.runtime.core_worker import (ObjectRef,  # noqa: F401
                                          ObjectRefGenerator)
 
@@ -20,5 +21,6 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "get_actor", "kill", "nodes", "cluster_resources",
     "available_resources", "context", "get_runtime_context", "ObjectRef",
-    "ObjectRefGenerator", "CONFIG", "cpp_function", "__version__",
+    "ObjectRefGenerator", "CONFIG", "cpp_function", "cpp_actor_class",
+    "__version__",
 ]

@@ -35,6 +35,28 @@ def _check_arg(a: Any) -> None:
             " (ObjectRefs/arrays are not representable C++-side)")
 
 
+def _guard_args(args) -> None:
+    """Reject anything the C++ side cannot receive: non-primitives, and
+    args _serialize_args would promote to store ObjectRefs.  Mirrors the
+    exact promotion predicate (core_worker._maybe_big pre-filter + pickle
+    size > max_direct_call_args_bytes) so nothing inline-shippable is
+    spuriously rejected and nothing promotable slips through to become a
+    far-from-cause worker error."""
+    import pickle
+
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.runtime.core_worker import _maybe_big
+    for a in args:
+        _check_arg(a)
+        if _maybe_big(a) and len(pickle.dumps(a, protocol=5)) > \
+                CONFIG.max_direct_call_args_bytes:
+            raise ValueError(
+                "cpp task/actor arg exceeds max_direct_call_args_bytes "
+                f"({CONFIG.max_direct_call_args_bytes}); it would be "
+                "promoted to a store object, which the C++ side cannot "
+                "resolve yet")
+
+
 class CppFunction:
     """Handle on a C++ function registered in the worker binary."""
 
@@ -60,23 +82,8 @@ class CppFunction:
             else max_retries)
 
     def remote(self, *args):
-        import pickle
-
-        from ray_tpu._private.config import CONFIG
         from ray_tpu.runtime.core_worker import get_global_worker
-        for a in args:
-            _check_arg(a)
-            # any arg whose pickle exceeds the inline threshold would be
-            # promoted to a store ObjectRef by _serialize_args — which a
-            # cpp worker cannot resolve; reject at the submit site with
-            # the real reason instead of a far-from-cause worker error
-            if len(pickle.dumps(a, protocol=5)) > \
-                    CONFIG.max_direct_call_args_bytes:
-                raise ValueError(
-                    "cpp task arg exceeds max_direct_call_args_bytes "
-                    f"({CONFIG.max_direct_call_args_bytes}); it would be "
-                    "promoted to a store object, which cpp tasks cannot "
-                    "resolve yet")
+        _guard_args(args)
         if not isinstance(self._num_returns, int):
             raise ValueError("cpp tasks need a fixed integer num_returns")
         worker = get_global_worker()
@@ -98,3 +105,83 @@ def cpp_function(name: str, **options) -> CppFunction:
     in the worker binary — stock functions live in
     csrc/cpp_builtin_functions.cc)."""
     return CppFunction(name, **options)
+
+
+class _CppMethod:
+    def __init__(self, handle: "CppActorHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args):
+        from ray_tpu.runtime.core_worker import get_global_worker
+        _guard_args(args)
+        refs = get_global_worker().submit_actor_task(
+            self._handle._actor_id, self._method, args, {}, num_returns=1)
+        return refs[0]
+
+
+class CppActorHandle:
+    """Handle on a live C++ actor; ``handle.method.remote(...)`` submits
+    through the same ordered per-actor pipeline Python actors use (the
+    worker executes in seq order).  Works with ``ray_tpu.kill``."""
+
+    def __init__(self, actor_id):
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> _CppMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _CppMethod(self, name)
+
+    def __repr__(self):
+        return f"CppActorHandle({self._actor_id.hex()[:12]})"
+
+
+class CppActorClass:
+    """Class-side handle for a C++ actor registered with
+    RAY_TPU_CPP_ACTOR in the worker binary."""
+
+    def __init__(self, name: str, *,
+                 resources: Optional[Dict[str, float]] = None,
+                 max_restarts: int = 0,
+                 actor_name: Optional[str] = None,
+                 lifetime: Optional[str] = None):
+        if not name or ":" in name:
+            raise ValueError(f"bad cpp actor class name {name!r}")
+        self._cls = name
+        self._resources = dict(resources or {})
+        self._max_restarts = max_restarts
+        self._actor_name = actor_name
+        self._lifetime = lifetime
+
+    def options(self, *, resources: Optional[Dict[str, float]] = None,
+                max_restarts: Optional[int] = None,
+                name: Optional[str] = None,
+                lifetime: Optional[str] = None) -> "CppActorClass":
+        return CppActorClass(
+            self._cls,
+            resources=self._resources if resources is None else resources,
+            max_restarts=self._max_restarts if max_restarts is None
+            else max_restarts,
+            actor_name=self._actor_name if name is None else name,
+            lifetime=self._lifetime if lifetime is None else lifetime)
+
+    def remote(self, *args) -> CppActorHandle:
+        from ray_tpu.runtime.core_worker import get_global_worker
+        _guard_args(args)
+        actor_id = get_global_worker().create_actor(
+            None, args, {},
+            name=self._actor_name,
+            detached=self._lifetime == "detached",
+            max_restarts=self._max_restarts,
+            resources=self._resources,
+            cls_key=f"cpp:{self._cls}",
+            language="cpp")
+        return CppActorHandle(actor_id)
+
+
+def cpp_actor_class(name: str, **options) -> CppActorClass:
+    """Handle on the C++ actor class ``name`` (reference
+    cross_language.py:50 java_actor_class analog; stock classes in
+    csrc/cpp_builtin_functions.cc: Counter, Kv)."""
+    return CppActorClass(name, **options)

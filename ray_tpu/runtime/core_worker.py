@@ -1690,7 +1690,9 @@ class CoreWorker:
                      concurrency_groups: Optional[Dict[str, int]] = None,
                      resources: Optional[Dict[str, float]] = None,
                      scheduling_strategy: Optional[dict] = None,
-                     runtime_env: Optional[dict] = None) -> "ActorID":
+                     runtime_env: Optional[dict] = None,
+                     cls_key: Optional[str] = None,
+                     language: Optional[str] = None) -> "ActorID":
         actor_id = ActorID.from_random()
         bundle = None
         strategy = None
@@ -1701,7 +1703,10 @@ class CoreWorker:
             else:
                 # node_affinity / spread: enforced by the GCS scheduler
                 strategy = dict(scheduling_strategy)
-        cls_key = self.register_function(cls)
+        # cross-language actors carry a pre-resolved class key the target
+        # language's worker resolves in its own registry
+        if cls_key is None:
+            cls_key = self.register_function(cls)
         creation_spec = cloudpickle.dumps({
             "actor_id": actor_id.binary(),
             "cls_key": cls_key,
@@ -1724,6 +1729,7 @@ class CoreWorker:
             "bundle": bundle,
             "strategy": strategy,
             "runtime_env": runtime_env or self.job_runtime_env,
+            "language": language,
         }, timeout=CONFIG.actor_creation_timeout_s)
         return actor_id
 

@@ -766,6 +766,7 @@ class GcsServer:
                 "death_cause": None,
                 "bundle": p.get("bundle"),  # [pg_id_hex, index] or None
                 "strategy": p.get("strategy"),  # node_affinity/spread dict
+                "language": p.get("language"),  # None/python, or "cpp"
                 "runtime_env": p.get("runtime_env"),
             }
             self._actors[aid] = entry
@@ -905,6 +906,7 @@ class GcsServer:
                     "resources": entry["resources"],
                     "bundle": cand_bundle,
                     "runtime_env": entry.get("runtime_env"),
+                    "language": entry.get("language"),
                 }, timeout=CONFIG.actor_creation_timeout_s)
                 with self._lock:
                     entry.pop("retry_delay", None)

@@ -819,7 +819,9 @@ class Raylet:
         cmd = [binary,
                "--raylet-host", self.address[0],
                "--raylet-port", str(self.address[1]),
-               "--worker-id", worker_id.hex()]
+               "--worker-id", worker_id.hex(),
+               "--gcs-host", self.gcs_address[0],
+               "--gcs-port", str(self.gcs_address[1])]
         out_f = open(log_prefix + ".out", "ab")
         err_f = open(log_prefix + ".err", "ab")
         try:
@@ -1181,7 +1183,8 @@ class Raylet:
             raise rpc.RpcError("resources unavailable for actor")
         try:
             handle = self._spawn_worker(
-                None, self._merged_env(need, p.get("runtime_env")))
+                None, self._merged_env(need, p.get("runtime_env")),
+                language=p.get("language"))
         except Exception as e:
             self._give_back(need, pool_key)
             raise rpc.RpcError(f"actor worker spawn failed: {e}")

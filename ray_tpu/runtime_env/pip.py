@@ -150,13 +150,17 @@ def normalize_conda_field(conda) -> List[str]:
             continue
         if not isinstance(dep, str):
             raise TypeError(f"bad conda dependency: {dep!r}")
-        name = re.split(r"[=<>!~ ]", dep.strip(), maxsplit=1)[0]
+        dep = dep.strip()
+        name = re.split(r"[=<>!~ ]", dep, maxsplit=1)[0]
         if name in ("python", "pip"):
             continue  # interpreter/installer come from the image
-        # conda pinning ("name=1.2", "name==1.2", "name>=1.2") → pip
-        if "=" in dep and not any(op in dep for op in ("==", ">=", "<=",
-                                                       ">", "<", "!=")):
-            dep = dep.replace("=", "==", 1)
+        # conda-only pin forms → pip:
+        #   name=1.2           -> name==1.2
+        #   name=1.2=py39h...  -> name==1.2  (conda env export emits
+        #                         build strings pip cannot parse)
+        m = re.fullmatch(r"([A-Za-z0-9_.\-]+)=([^=<>!~]+)(=[^=]+)?", dep)
+        if m:
+            dep = f"{m.group(1)}=={m.group(2)}"
         reqs.append(dep)
     return sorted(reqs)
 

@@ -40,6 +40,8 @@ def test_pycodec_roundtrip_all_protocols():
          "num_returns": 1, "owner_addr": ["127.0.0.1", 1234]},
         ["dup", "dup", {"dup": "dup"}],  # exercises memo opcodes
     ]
+    shared = [1, 2]  # memoized-before-populated container, referenced twice
+    cases.append((shared, shared, {"k": shared}))
     blobs = b""
     for proto in (2, 3, 4, 5):
         for c in cases:
@@ -139,6 +141,38 @@ def test_cpp_and_python_pools_are_disjoint(ray_start_regular):
     for pid in py_pids:
         exe = os.readlink(f"/proc/{pid}/exe")
         assert "python" in os.path.basename(exe), exe
+
+
+def test_cpp_actor_lifecycle(ray_start_regular):
+    """cpp_actor_class: construct with args, stateful ordered method
+    calls, per-call errors that don't kill the actor, ray_tpu.kill."""
+    _tool("cpp_worker")
+    c = ray_tpu.cpp_actor_class("Counter").remote(100)
+    assert ray_tpu.get(c.inc.remote(), timeout=120) == 101
+    assert ray_tpu.get(c.inc.remote(5), timeout=120) == 106
+    # pipelined burst executes in submission order (seq-ordered streams)
+    vals = ray_tpu.get([c.inc.remote() for _ in range(20)], timeout=120)
+    assert vals == list(range(107, 127))
+    with pytest.raises(ray_tpu.exceptions.TaskError,
+                       match="counter exploded"):
+        ray_tpu.get(c.boom.remote(), timeout=120)
+    assert ray_tpu.get(c.total.remote(), timeout=120) == 126  # still alive
+    ray_tpu.kill(c)
+
+
+def test_cpp_actor_state_isolated(ray_start_regular):
+    """Two cpp actors of different classes hold independent native state;
+    values of any primitive shape round-trip."""
+    _tool("cpp_worker")
+    kv = ray_tpu.cpp_actor_class("Kv").remote()
+    ray_tpu.get(kv.put.remote("a", [1, 2, 3]), timeout=120)
+    ray_tpu.get(kv.put.remote("b", {"x": b"bytes"}), timeout=120)
+    assert ray_tpu.get(kv.get.remote("a"), timeout=120) == [1, 2, 3]
+    assert ray_tpu.get(kv.get.remote("b"), timeout=120) == {"x": b"bytes"}
+    assert ray_tpu.get(kv.size.remote(), timeout=120) == 2
+    c = ray_tpu.cpp_actor_class("Counter").remote(0)
+    assert ray_tpu.get(c.inc.remote(), timeout=120) == 1
+    assert ray_tpu.get(kv.size.remote(), timeout=120) == 2
 
 
 def test_cpp_native_driver(ray_start_cluster):
