@@ -4,6 +4,7 @@
 #include <fcntl.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cstring>
 
 #include "rpcnet.h"
@@ -39,6 +40,14 @@ std::string to_hex(const std::string& b) {
 
 }  // namespace
 
+// shared per-actor-handle state: all copies of an ActorClient draw seqs
+// from the same counter on the same stream
+struct ActorState {
+  std::unique_ptr<rpcnet::Conn> conn;
+  std::string stream;
+  std::atomic<int64_t> next_seq{0};
+};
+
 struct Driver::Impl {
   std::unique_ptr<rpcnet::Conn> gcs;
   std::unique_ptr<rpcnet::Conn> raylet;
@@ -60,7 +69,9 @@ Driver::Driver(const std::string& raylet_host, int raylet_port,
     : impl_(new Impl) {
   impl_->job_id_hex = to_hex(random_bytes(16));
   job_id_ = impl_->job_id_hex;
-  impl_->sched_key = impl_->job_id_hex.substr(0, 8) + "|CPU=1|lang=cpp";
+  // fractional lease: the driver pins one worker for its whole lifetime,
+  // and a full-CPU hold would starve actor placement on a 1-CPU node
+  impl_->sched_key = impl_->job_id_hex.substr(0, 8) + "|CPU=0.5|lang=cpp";
 
   impl_->gcs.reset(rpcnet::Conn::connect(gcs_host, gcs_port));
   PyVal reg = PyVal::dict();
@@ -75,7 +86,7 @@ Driver::Driver(const std::string& raylet_host, int raylet_port,
   PyVal payload = PyVal::dict();
   payload.set("key", PyVal::str(impl_->sched_key));
   PyVal res = PyVal::dict();
-  res.set("CPU", PyVal::integer(1));
+  res.set("CPU", PyVal::real(0.5));
   payload.set("resources", std::move(res));
   payload.set("job_id", PyVal::str(impl_->job_id_hex));
   payload.set("env", PyVal::none());
@@ -126,6 +137,106 @@ Driver::~Driver() {
     }
   } catch (...) {
   }
+}
+
+ActorClient Driver::actor(const std::string& cls_name,
+                          const std::vector<PyVal>& args,
+                          const PyVal& resources, double timeout_s) {
+  std::string actor_id_hex = to_hex(random_bytes(16));
+  // creation spec: the dict worker_main/cpp_worker expect inside
+  // register_actor's spec bytes (core_worker.create_actor layout)
+  PyVal args_blob = PyVal::tuple(
+      {PyVal::tuple(std::vector<PyVal>(args.begin(), args.end())),
+       PyVal::dict()});
+  PyVal creation = PyVal::dict();
+  creation.set("actor_id", PyVal::bytes(random_bytes(16)));
+  creation.set("cls_key", PyVal::str("cpp:" + cls_name));
+  creation.set("args", PyVal::bytes(pycodec::pickle_dumps(args_blob)));
+  PyVal owner = PyVal::list();
+  owner.items.push_back(PyVal::str("127.0.0.1"));
+  owner.items.push_back(PyVal::integer(0));
+  creation.set("owner_addr", std::move(owner));
+  creation.set("max_concurrency", PyVal::none());
+  creation.set("concurrency_groups", PyVal::dict());
+
+  PyVal reg = PyVal::dict();
+  reg.set("actor_id", PyVal::str(actor_id_hex));
+  reg.set("job_id", PyVal::str(impl_->job_id_hex));
+  reg.set("spec", PyVal::bytes(pycodec::pickle_dumps(creation)));
+  reg.set("resources", resources);
+  reg.set("max_restarts", PyVal::integer(0));
+  reg.set("language", PyVal::str("cpp"));
+  impl_->gcs->call("register_actor", reg, timeout_s);
+
+  // poll the FSM until ALIVE (core_worker._resolve_actor analog)
+  for (int tick = 0; tick < (int)(timeout_s / 0.1); ++tick) {
+    PyVal q = PyVal::dict();
+    q.set("actor_id", PyVal::str(actor_id_hex));
+    PyVal info = impl_->gcs->call("get_actor", q, timeout_s);
+    const PyVal* state = info.get("state");
+    if (state && state->kind == PyVal::STR) {
+      if (state->s == "DEAD") {
+        const PyVal* cause = info.get("death_cause");
+        throw TaskFailure("actor creation failed: " +
+                          (cause ? cause->repr() : std::string("?")));
+      }
+      if (state->s == "ALIVE") {
+        const PyVal* addr = info.get("address");
+        if (addr && addr->items.size() == 2) {
+          auto st = std::make_shared<ActorState>();
+          st->conn.reset(rpcnet::Conn::connect(addr->items[0].s,
+                                               (int)addr->items[1].i));
+          st->stream = to_hex(random_bytes(8));
+          ActorClient a;
+          a.state_ = st;
+          a.actor_id_ = actor_id_hex;
+          return a;
+        }
+      }
+    }
+    usleep(100000);
+  }
+  throw TaskFailure("actor not ALIVE within timeout");
+}
+
+void Driver::kill_actor(const ActorClient& a) {
+  PyVal p = PyVal::dict();
+  p.set("actor_id", PyVal::str(a.actor_id()));
+  impl_->gcs->call("kill_actor", p, 10.0);
+}
+
+PyVal ActorClient::call(const std::string& method,
+                        const std::vector<PyVal>& args, double timeout_s) {
+  auto* st = (ActorState*)state_.get();
+  if (!st) throw TaskFailure("uninitialized ActorClient");
+  PyVal packed = PyVal::tuple(
+      {PyVal::tuple(std::vector<PyVal>(args.begin(), args.end())),
+       PyVal::dict()});
+  PyVal spec = PyVal::dict();
+  spec.set("task_id", PyVal::bytes(random_bytes(16)));
+  spec.set("actor_id", PyVal::str(actor_id_));
+  spec.set("method", PyVal::str(method));
+  spec.set("args", PyVal::bytes(pycodec::pickle_dumps(packed)));
+  spec.set("num_returns", PyVal::integer(1));
+  PyVal owner = PyVal::list();
+  owner.items.push_back(PyVal::str("127.0.0.1"));
+  owner.items.push_back(PyVal::integer(0));
+  spec.set("owner_addr", std::move(owner));
+  spec.set("name", PyVal::str(method));
+  spec.set("seq", PyVal::integer(st->next_seq++));
+  spec.set("stream", PyVal::str(st->stream));
+
+  PyVal reply = st->conn->call("actor_task", spec, timeout_s);
+  const PyVal* results = reply.get("results");
+  if (!results || results->items.empty())
+    throw TaskFailure("empty actor reply");
+  const PyVal* data = results->items[0].get("data");
+  if (!data || data->kind != PyVal::BYTES)
+    throw TaskFailure("non-inline actor result");
+  int64_t err = 0;
+  PyVal value = pycodec::flat_deserialize(data->s, &err);
+  if (err) throw TaskFailure("actor call failed: " + value.repr());
+  return value;
 }
 
 PyVal Driver::call(const std::string& fn_name,

@@ -28,6 +28,28 @@ struct TaskFailure : std::runtime_error {
   explicit TaskFailure(const std::string& m) : std::runtime_error(m) {}
 };
 
+class Driver;
+
+// Client handle on a C++ actor created by this driver.  Calls execute in
+// submission order (the worker's seq-ordered actor queue).  Destroying
+// the handle does NOT kill the actor; use Driver::kill_actor.
+class ActorClient {
+ public:
+  pycodec::PyVal call(const std::string& method,
+                      const std::vector<pycodec::PyVal>& args,
+                      double timeout_s = 60.0);
+  const std::string& actor_id() const { return actor_id_; }
+
+ private:
+  friend class Driver;
+  ActorClient() = default;
+  // conn + stream + seq live in ONE shared state so copies of a handle
+  // keep drawing from the same sequence (colliding seqs would wedge the
+  // worker's in-order queue); type-erased to keep rpcnet out of the header
+  std::shared_ptr<void> state_;
+  std::string actor_id_;
+};
+
 class Driver {
  public:
   Driver(const std::string& raylet_host, int raylet_port,
@@ -38,6 +60,16 @@ class Driver {
   pycodec::PyVal call(const std::string& fn_name,
                       const std::vector<pycodec::PyVal>& args,
                       double timeout_s = 60.0);
+
+  // create a C++ actor (RAY_TPU_CPP_ACTOR-registered class) and wait
+  // until it is ALIVE; the GCS schedules it like any Python-created
+  // actor.  resources defaults to {"CPU": 1} raylet-side; pass
+  // fractional CPU to co-locate with held task leases on small nodes
+  ActorClient actor(const std::string& cls_name,
+                    const std::vector<pycodec::PyVal>& args,
+                    const pycodec::PyVal& resources = pycodec::PyVal::dict(),
+                    double timeout_s = 60.0);
+  void kill_actor(const ActorClient& a);
 
   const std::string& job_id() const { return job_id_; }
 

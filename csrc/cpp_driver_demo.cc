@@ -50,6 +50,27 @@ int main(int argc, char** argv) {
     }
     if (!raised) return 1;
 
+    // actors from the native driver: stateful, ordered.  Fractional CPU
+    // so the actor coexists with our held task lease on a 1-CPU node
+    PyVal res = PyVal::dict();
+    res.set("CPU", PyVal::real(0.25));
+    ray_tpu_cpp::ActorClient counter =
+        d.actor("Counter", {PyVal::integer(10)}, res);
+    for (int j = 0; j < 3; ++j) {
+      PyVal n = counter.call("inc", {});
+      printf("counter.inc() = %s\n", n.repr().c_str());
+      if (n.kind != PyVal::INT || n.i != 11 + j) return 1;
+    }
+    bool actor_err = false;
+    try {
+      counter.call("boom", {});
+    } catch (const ray_tpu_cpp::TaskFailure& e) {
+      actor_err = strstr(e.what(), "counter exploded") != nullptr;
+    }
+    PyVal total = counter.call("total", {});
+    if (!actor_err || total.i != 13) return 1;  // error didn't kill it
+    d.kill_actor(counter);
+
     printf("CPP_DRIVER_OK\n");
     return 0;
   } catch (const std::exception& e) {

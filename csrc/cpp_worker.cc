@@ -43,6 +43,10 @@ std::unique_ptr<ray_tpu_cpp::CppActor> g_actor;
 std::string g_actor_id;
 std::string g_gcs_host;
 int g_gcs_port = 0;
+// this node's cluster-visible host: the raylet we registered with lives
+// on this machine, so its advertised host is ours too (worker_main's
+// core.address analog — never loopback, or cross-node owners can't push)
+std::string g_self_host = "127.0.0.1";
 
 // serialized-format helpers -------------------------------------------------
 
@@ -332,7 +336,7 @@ void notify_actor_ready() {
   PyVal p = PyVal::dict();
   p.set("actor_id", PyVal::str(g_actor_id));
   PyVal addr = PyVal::list();
-  addr.items.push_back(PyVal::str("127.0.0.1"));
+  addr.items.push_back(PyVal::str(g_self_host));
   addr.items.push_back(PyVal::integer(g_server_port));
   p.set("address", std::move(addr));
   gcs->call("actor_ready", p, 30.0);
@@ -386,6 +390,7 @@ int main(int argc, char** argv) {
   }
   if (gcs_host) g_gcs_host = gcs_host;
   if (gcs_port) g_gcs_port = atoi(gcs_port);
+  g_self_host = raylet_host;
   ray_tpu_cpp::register_builtin_functions();
 
   std::thread exec([&] { g_exec.loop(); });
@@ -406,7 +411,7 @@ int main(int argc, char** argv) {
   PyVal reg = PyVal::dict();
   reg.set("worker_id", PyVal::str(worker_id));
   PyVal addr = PyVal::list();
-  addr.items.push_back(PyVal::str("127.0.0.1"));
+  addr.items.push_back(PyVal::str(g_self_host));
   addr.items.push_back(PyVal::integer(server.port()));
   reg.set("address", std::move(addr));
   try {

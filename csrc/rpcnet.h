@@ -278,7 +278,9 @@ class Server {
     setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    // all interfaces: remote owners push tasks straight to workers, so a
+    // loopback-only bind would strand cross-node actors/leases
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
     addr.sin_port = htons((uint16_t)port);
     if (::bind(fd_, (sockaddr*)&addr, sizeof addr) != 0 ||
         ::listen(fd_, 128) != 0)

@@ -1,0 +1,43 @@
+"""C++ tasks and actors from a Python driver (cross-language calls).
+
+The functions/classes live in the native worker binary
+(csrc/cpp_builtin_functions.cc, registered with RAY_TPU_CPP_FUNCTION /
+RAY_TPU_CPP_ACTOR); build with `make -C csrc`.  Point the
+`cpp_worker_binary` config flag at your own build to expose your own.
+A C++ program can also join the cluster directly — see
+csrc/cpp_driver_demo.cc for the native-driver (cpp_api.h) version of
+this script.
+"""
+
+import ray_tpu
+
+# two dedicated actor workers + task workers need more CPU slots than a
+# tiny CI box advertises; resources are logical
+ray_tpu.init(num_cpus=4)
+
+# --- cpp tasks: leases route to native workers (language=cpp pools) ----
+add = ray_tpu.cpp_function("Add")
+print("Add(1, 2, 3)        =", ray_tpu.get(add.remote(1, 2, 3)))
+print("Fib(80)             =",
+      ray_tpu.get(ray_tpu.cpp_function("Fib").remote(80)))
+lo, hi = ray_tpu.get(list(
+    ray_tpu.cpp_function("MinMax", num_returns=2).remote(7, 3, 9, 1)))
+print("MinMax(7,3,9,1)     =", (lo, hi))
+
+# --- cpp actors: native state, ordered method pipeline -----------------
+counter = ray_tpu.cpp_actor_class("Counter").remote(100)
+for _ in range(3):
+    print("counter.inc()       =", ray_tpu.get(counter.inc.remote()))
+
+kv = ray_tpu.cpp_actor_class("Kv").remote()
+ray_tpu.get(kv.put.remote("config", {"lr": 0.001, "layers": [256, 256]}))
+print("kv.get('config')    =", ray_tpu.get(kv.get.remote("config")))
+
+# --- errors cross the language boundary as TaskError -------------------
+try:
+    ray_tpu.get(ray_tpu.cpp_function("Fail").remote("native explosion"))
+except ray_tpu.exceptions.TaskError as e:
+    print("cpp error surfaced  =", str(e).splitlines()[0])
+
+ray_tpu.kill(counter)
+ray_tpu.shutdown()
