@@ -15,9 +15,12 @@ Writes the ``envelope`` section of MICROBENCH.json:
 import argparse
 import json
 import os
+import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 
 def bench_1m_queued_tasks(n=1_000_000, wave=25_000):

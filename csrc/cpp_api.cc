@@ -142,14 +142,16 @@ Driver::~Driver() {
 ActorClient Driver::actor(const std::string& cls_name,
                           const std::vector<PyVal>& args,
                           const PyVal& resources, double timeout_s) {
-  std::string actor_id_hex = to_hex(random_bytes(16));
+  std::string aid_bytes = random_bytes(16);
+  std::string actor_id_hex = to_hex(aid_bytes);
   // creation spec: the dict worker_main/cpp_worker expect inside
-  // register_actor's spec bytes (core_worker.create_actor layout)
+  // register_actor's spec bytes (core_worker.create_actor layout — the
+  // spec's actor_id must be the same identity the GCS registers)
   PyVal args_blob = PyVal::tuple(
       {PyVal::tuple(std::vector<PyVal>(args.begin(), args.end())),
        PyVal::dict()});
   PyVal creation = PyVal::dict();
-  creation.set("actor_id", PyVal::bytes(random_bytes(16)));
+  creation.set("actor_id", PyVal::bytes(aid_bytes));
   creation.set("cls_key", PyVal::str("cpp:" + cls_name));
   creation.set("args", PyVal::bytes(pycodec::pickle_dumps(args_blob)));
   PyVal owner = PyVal::list();

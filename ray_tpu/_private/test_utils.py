@@ -1,0 +1,129 @@
+"""Reusable test doubles and helpers.
+
+Analog of /root/reference/python/ray/_private/test_utils.py — the
+reference's most-reused testing pattern (SURVEY.md §4): actor-based
+synchronization primitives tasks can rendezvous on (SignalActor :704,
+Semaphore :725), condition polling (wait_for_condition :461), and
+driver-script isolation (run_string_as_driver :329).  The chaos
+NodeKiller analog lives in ``_private/chaos.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Callable, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=0)
+class SignalActor:
+    """A distributed Event: tasks block on ``wait`` until ``send``.
+
+    >>> sig = SignalActor.remote()
+    >>> ray_tpu.get(sig.wait.remote())   # blocks until somebody sends
+    """
+
+    def __init__(self):
+        import asyncio
+        self._event = asyncio.Event()
+        self._num_waiters = 0
+
+    async def send(self, clear: bool = False):
+        self._event.set()
+        if clear:
+            self._event.clear()
+
+    async def wait(self, should_wait: bool = True):
+        if should_wait:
+            self._num_waiters += 1
+            try:
+                await self._event.wait()
+            finally:
+                self._num_waiters -= 1
+
+    async def cur_num_waiters(self) -> int:
+        return self._num_waiters
+
+
+@ray_tpu.remote(num_cpus=0)
+class Semaphore:
+    """A distributed semaphore for throttling/rendezvous in tests."""
+
+    def __init__(self, value: int = 1):
+        import asyncio
+        self._sema = asyncio.Semaphore(value=value)
+
+    async def acquire(self):
+        await self._sema.acquire()
+
+    async def release(self):
+        self._sema.release()
+
+    async def locked(self) -> bool:
+        return self._sema.locked()
+
+
+def wait_for_condition(condition_predictor: Callable[..., bool],
+                       timeout: float = 10.0,
+                       retry_interval_ms: int = 100,
+                       raise_exceptions: bool = False,
+                       **kwargs: Any) -> None:
+    """Poll until the predicate is True or raise RuntimeError with the
+    last exception seen (reference wait_for_condition semantics)."""
+    start = time.monotonic()
+    last_ex: Optional[BaseException] = None
+    while time.monotonic() - start <= timeout:
+        try:
+            if condition_predictor(**kwargs):
+                return
+        except Exception as e:  # noqa: BLE001 - surfaced on timeout
+            if raise_exceptions:
+                raise
+            last_ex = e
+        time.sleep(retry_interval_ms / 1000.0)
+    message = "The condition wasn't met before the timeout expired."
+    if last_ex is not None:
+        message += f" Last exception: {last_ex!r}"
+    raise RuntimeError(message)
+
+
+def run_string_as_driver(script: str,
+                         env: Optional[dict] = None,
+                         timeout: float = 120.0) -> str:
+    """Run a script as a separate driver process; returns its stdout,
+    raising on non-zero exit with stderr in the message."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    child_env = dict(os.environ)
+    child_env["PYTHONPATH"] = repo + os.pathsep + \
+        child_env.get("PYTHONPATH", "")
+    if env:
+        child_env.update(env)
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True,
+                          env=child_env, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"driver script failed (rc={proc.returncode}):\n"
+            f"{proc.stderr[-4000:]}")
+    return proc.stdout
+
+
+def run_string_as_driver_nonblocking(script: str,
+                                     env: Optional[dict] = None
+                                     ) -> subprocess.Popen:
+    """Start a driver script without waiting (reference :362)."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    child_env = dict(os.environ)
+    child_env["PYTHONPATH"] = repo + os.pathsep + \
+        child_env.get("PYTHONPATH", "")
+    if env:
+        child_env.update(env)
+    return subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, env=child_env)

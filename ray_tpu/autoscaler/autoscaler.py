@@ -49,6 +49,20 @@ class StandardAutoscaler:
     def update(self, lm: LoadMetrics) -> dict:
         records = self.provider.non_terminated_nodes()
 
+        # operator-reconciled providers (batching/kuberay style) expose
+        # safe_to_scale=False while a submitted delete is still being
+        # applied: deciding against half-applied state double-counts the
+        # lame-duck nodes (reference kuberay autoscaler gate)
+        if not getattr(self.provider, "safe_to_scale", True):
+            self.last_status = {
+                "nodes": {rec.node_id: rec.node_type for rec in records},
+                "launched": [], "terminated": [],
+                "pending_demand": len(lm.pending_demand),
+                "usage": lm.summary(),
+                "waiting": "provider reconciling previous scale request",
+            }
+            return self.last_status
+
         # 1. idle termination: every host of a launch unit must be idle past
         #    the timeout (slice-atomic: one busy host keeps the slice)
         idle_by_unit: Dict[str, List[float]] = {}

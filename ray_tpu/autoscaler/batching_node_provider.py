@@ -1,0 +1,220 @@
+"""Batching node provider: operator-reconciled scaling (kuberay analog).
+
+Analog of /root/reference/python/ray/autoscaler/batching_node_provider.py
+(``BatchingNodeProvider``, ``ScaleRequest``) — the integration style the
+reference uses for kuberay, where the autoscaler cannot create VMs
+directly but instead patches one declarative *scale request* (a CRD in
+k8s) that an external operator reconciles:
+
+* reads of cluster state batch into one ``get_node_data()`` snapshot per
+  autoscaler cycle;
+* mutations (create_node/terminate_node) only edit an in-memory
+  ``ScaleRequest``; the next cycle submits it as ONE
+  ``submit_scale_request`` patch — never N API calls for N nodes.
+
+No k8s client exists in hermetic TPU images, so the concrete backend here
+is ``InProcessOperator``: a reconcile loop over the submitted spec that
+stands in for the kuberay operator (and doubles as the test seam, like
+the reference's fake_multinode provider does for the VM providers).  A
+real k8s backend only needs get_node_data/submit_scale_request over the
+RayCluster CRD.  Launch units stay slice-atomic: one worker of a TPU
+pod-slice type means one whole slice.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Set
+
+from ray_tpu.autoscaler.node_provider import NodeProvider, NodeRecord
+
+
+@dataclass
+class ScaleRequest:
+    """One declarative scaling patch (reference ScaleRequest,
+    batching_node_provider.py:26): desired worker count per type plus the
+    specific workers to delete when scaling down."""
+    desired_num_workers: Dict[str, int] = field(default_factory=dict)
+    workers_to_delete: Set[str] = field(default_factory=set)
+
+
+class BatchingNodeProvider(NodeProvider):
+    """Base class batching all reads/mutations per autoscaler cycle.
+
+    Subclasses implement ``get_node_data`` and ``submit_scale_request``.
+    """
+
+    def __init__(self, provider_config: Dict[str, Any], cluster_name: str):
+        super().__init__(provider_config, cluster_name)
+        self._lock = threading.Lock()
+        self.scale_request = ScaleRequest()
+        self._scale_change_needed = False
+        self._node_data: Dict[str, NodeRecord] = {}
+
+    # ------------------------------------------------------------- backend
+    def get_node_data(self) -> Dict[str, NodeRecord]:
+        raise NotImplementedError
+
+    def submit_scale_request(self, scale_request: ScaleRequest) -> None:
+        raise NotImplementedError
+
+    # ------------------------------------------- NodeProvider surface
+    def non_terminated_nodes(self) -> List[NodeRecord]:
+        with self._lock:
+            if self._scale_change_needed:
+                # one batched patch for everything the previous cycle
+                # decided, however many nodes it touched
+                self.submit_scale_request(self.scale_request)
+                self._scale_change_needed = False
+            self._node_data = self.get_node_data()
+            # rebase the request on observed state (reference semantics,
+            # batching_node_provider.py:119) — but deletes the operator
+            # has NOT applied yet must survive the rebase, and their
+            # lame-duck nodes must not count toward desired capacity, or
+            # new demand during the reconciliation window double-counts
+            # them (phantom nodes -> scale thrash)
+            still_deleting = {w for w in self.scale_request.workers_to_delete
+                              if w in self._node_data}
+            counts: Dict[str, int] = {}
+            for node_id, rec in self._node_data.items():
+                if node_id in still_deleting:
+                    continue
+                counts[rec.node_type] = counts.get(rec.node_type, 0) + 1
+            self.scale_request = ScaleRequest(
+                desired_num_workers=counts,
+                workers_to_delete=still_deleting)
+            return list(self._node_data.values())
+
+    def create_node(self, node_type: str, node_config: Dict[str, Any],
+                    resources: Dict[str, float], hosts: int,
+                    labels: Dict[str, str]) -> NodeRecord:
+        with self._lock:
+            cur = self.scale_request.desired_num_workers.get(node_type, 0)
+            self.scale_request.desired_num_workers[node_type] = cur + 1
+            self._scale_change_needed = True
+            # a placeholder record: the operator materializes the real
+            # node asynchronously; the autoscaler sees it via the next
+            # cycle's node data
+            return NodeRecord(node_id=f"pending-{node_type}-{cur}",
+                              node_type=node_type, state="pending",
+                              tags=dict(labels))
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            rec = self._node_data.get(node_id)
+            if rec is None:
+                return
+            n = self.scale_request.desired_num_workers.get(rec.node_type, 0)
+            self.scale_request.desired_num_workers[rec.node_type] = \
+                max(0, n - 1)
+            self.scale_request.workers_to_delete.add(node_id)
+            self._scale_change_needed = True
+
+    @property
+    def safe_to_scale(self) -> bool:
+        """False while a previous delete is still being reconciled —
+        scaling decisions against half-applied state double-delete
+        (reference safe_to_scale, batching_node_provider.py)."""
+        with self._lock:
+            return not any(w in self._node_data
+                           for w in self.scale_request.workers_to_delete)
+
+
+class InProcessOperator:
+    """Stand-in for the kuberay operator: holds the last submitted spec
+    and reconciles actual nodes toward it on a background thread."""
+
+    def __init__(self, spawn_host, reconcile_interval_s: float = 0.05):
+        # spawn_host(node_type) -> NodeRecord with live raylet(s);
+        # in tests this is cluster_utils.Cluster.add_node glue
+        self._spawn_host = spawn_host
+        self._lock = threading.Lock()
+        self._spec: Dict[str, int] = {}
+        self._deletes: Set[str] = set()
+        self._nodes: Dict[str, NodeRecord] = {}
+        self._patches = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, args=(reconcile_interval_s,),
+            daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------ operator API
+    def patch(self, scale_request: ScaleRequest) -> None:
+        with self._lock:
+            self._patches += 1
+            self._spec = dict(scale_request.desired_num_workers)
+            self._deletes |= set(scale_request.workers_to_delete)
+
+    def nodes(self) -> Dict[str, NodeRecord]:
+        with self._lock:
+            return dict(self._nodes)
+
+    @property
+    def patch_count(self) -> int:
+        with self._lock:
+            return self._patches
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    # --------------------------------------------------------- reconcile
+    def _reconcile_loop(self, interval: float) -> None:
+        seq = 0
+        while not self._stop.wait(interval):
+            with self._lock:
+                deletes = [d for d in self._deletes if d in self._nodes]
+                spec = dict(self._spec)
+            for node_id in deletes:
+                with self._lock:
+                    rec = self._nodes.pop(node_id, None)
+                    self._deletes.discard(node_id)
+                if rec is not None and rec.tags.get("_terminate"):
+                    rec.tags["_terminate"]()  # test-glue teardown hook
+            with self._lock:
+                counts: Dict[str, int] = {}
+                for rec in self._nodes.values():
+                    counts[rec.node_type] = \
+                        counts.get(rec.node_type, 0) + 1
+            for node_type, want in spec.items():
+                have = counts.get(node_type, 0)
+                for _ in range(want - have):
+                    try:
+                        rec = self._spawn_host(node_type)
+                    except Exception:
+                        break  # next tick retries
+                    rec.node_id = rec.node_id or f"op-{node_type}-{seq}"
+                    seq += 1
+                    rec.state = "running"
+                    with self._lock:
+                        self._nodes[rec.node_id] = rec
+
+
+class KubeRayStyleProvider(BatchingNodeProvider):
+    """BatchingNodeProvider over an InProcessOperator — the complete
+    kuberay integration shape minus the k8s transport."""
+
+    def __init__(self, provider_config: Dict[str, Any], cluster_name: str):
+        super().__init__(provider_config, cluster_name)
+        self.operator: InProcessOperator = provider_config["operator"]
+
+    def get_node_data(self) -> Dict[str, NodeRecord]:
+        return self.operator.nodes()
+
+    def submit_scale_request(self, scale_request: ScaleRequest) -> None:
+        self.operator.patch(scale_request)
+
+    def shutdown(self) -> None:
+        self.operator.stop()
+
+
+def _register() -> None:
+    from ray_tpu.autoscaler.node_provider import register_node_provider
+    register_node_provider(
+        "kuberay", lambda cfg, name, **kw: KubeRayStyleProvider(cfg, name))
+
+
+_register()

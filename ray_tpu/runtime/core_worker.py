@@ -21,6 +21,7 @@ worker embeds one.  It owns
 from __future__ import annotations
 
 import hashlib
+import itertools
 import threading
 import time
 import traceback
@@ -313,6 +314,17 @@ class CoreWorker:
         self._owned: Dict[ObjectID, _OwnedObject] = {}
         self._owned_lock = threading.RLock()  # ObjectRef ctor re-enters
         self._memory_cache: Dict[ObjectID, Any] = {}   # deserialized values
+        # insertion order of BORROWED cache entries only — the trim's
+        # working set.  Owned entries leave via refcounting, so scanning
+        # the whole cache for borrowed victims on every get was O(cache)
+        # per call (quadratic across a big wave of gets) for zero
+        # evictions.  Entries carry an insertion token matched against
+        # _borrowed_tokens at trim time: a removal path (release_borrowed
+        # etc.) drops the token, so a stale FIFO entry can never evict a
+        # LIVE re-fetched value and release its active pins.
+        self._borrowed_cache_order: deque = deque()   # (oid, token)
+        self._borrowed_tokens: Dict[ObjectID, int] = {}
+        self._borrowed_seq = itertools.count()
         self._pins: Dict[ObjectID, int] = {}   # local shm pins we hold
         self._pins_lock = threading.Lock()
         # strong refs to task-argument ObjectRefs, held until the task using
@@ -538,7 +550,7 @@ class CoreWorker:
             with self._owned_lock:
                 if oid in self._owned:
                     continue  # owned objects are managed by refcounting
-                self._memory_cache.pop(oid, None)
+                self._drop_cached(oid)
             self._release_pins(oid)
 
     # ------------------------------------------------------------- put/get
@@ -639,22 +651,34 @@ class CoreWorker:
             raise exc.GetTimeoutError(f"get timed out on {ref}")
         value = ser.deserialize(data)   # raises stored task errors
         self._memory_cache[oid] = value
-        self._maybe_trim_cache()
+        with self._owned_lock:
+            borrowed = oid not in self._owned
+        if borrowed:
+            tok = next(self._borrowed_seq)
+            self._borrowed_tokens[oid] = tok
+            self._borrowed_cache_order.append((oid, tok))
+            self._maybe_trim_cache()
         return value
 
+    def _drop_cached(self, oid: ObjectID) -> None:
+        """Remove a cached value AND its borrowed-FIFO claim; every path
+        that pops _memory_cache for a possibly-borrowed oid must come
+        through here or the FIFO entry goes stale."""
+        self._memory_cache.pop(oid, None)
+        self._borrowed_tokens.pop(oid, None)
+
     def _maybe_trim_cache(self, cap: int = 4096) -> None:
-        """Bound the borrowed portion of the value cache (owned entries are
-        evicted by refcounting; borrowed ones would otherwise accumulate in
-        long-lived pooled workers)."""
-        if len(self._memory_cache) <= cap:
-            return
-        with self._owned_lock:
-            victims = [oid for oid in self._memory_cache
-                       if oid not in self._owned][:len(self._memory_cache) - cap]
-            for oid in victims:
-                self._memory_cache.pop(oid, None)
-        for oid in victims:
-            self._release_pins(oid)
+        """Bound the borrowed portion of the value cache (owned entries
+        are evicted by refcounting; borrowed ones would otherwise
+        accumulate in long-lived pooled workers).  O(1) amortized: only
+        the borrowed-insertion FIFO is walked, never the whole cache."""
+        while len(self._borrowed_cache_order) > cap:
+            oid, tok = self._borrowed_cache_order.popleft()
+            if self._borrowed_tokens.get(oid) != tok:
+                continue  # superseded or released: not ours to evict
+            self._borrowed_tokens.pop(oid, None)
+            if self._memory_cache.pop(oid, None) is not None:
+                self._release_pins(oid)
 
     def _fetch_serialized(self, ref: ObjectRef,
                           deadline: Optional[float]) -> Optional[memoryview]:

@@ -147,6 +147,71 @@ def test_autoscaler_launches_for_pending_demand():
     assert status["launched"] == []
 
 
+def test_batching_provider_one_patch_per_cycle():
+    """kuberay-style integration: N scaling decisions in a cycle become
+    ONE declarative patch an operator reconciles (reference
+    batching_node_provider.py semantics)."""
+    from ray_tpu.autoscaler.batching_node_provider import (
+        InProcessOperator, KubeRayStyleProvider)
+    from ray_tpu.autoscaler.node_provider import NodeRecord
+
+    seq = [0]
+
+    def spawn_host(node_type):
+        seq[0] += 1
+        return NodeRecord(node_id=f"w{seq[0]}", node_type=node_type,
+                          state="running")
+
+    op = InProcessOperator(spawn_host)
+    provider = KubeRayStyleProvider({"type": "kuberay", "operator": op},
+                                    "t")
+    try:
+        cfg = make_config(types={
+            "cpu4": {"resources": {"CPU": 4}, "max_workers": 8}},
+            upscaling_speed=99)  # let one tick stage all 3 launches
+        auto = StandardAutoscaler(cfg, provider)
+        # demand worth 3 nodes -> 3 create_node decisions, zero patches yet
+        lm = LoadMetrics(nodes=[view("head", {"CPU": 1},
+                                     available={"CPU": 0})],
+                         pending_demand=[{"CPU": 4}] * 3)
+        status = auto.update(lm)
+        assert len(status["launched"]) == 3
+        assert op.patch_count == 0  # mutations only staged so far
+        # next cycle submits exactly one batched patch; operator
+        # reconciles all 3 workers from it
+        auto.update(LoadMetrics(nodes=[view("head", {"CPU": 1})]))
+        assert op.patch_count == 1
+
+        def all_up():
+            return len(op.nodes()) == 3
+        deadline = time.monotonic() + 10
+        while not all_up() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert all_up()
+
+        # scale down: idle workers leave via workers_to_delete, again one
+        # patch for the whole decision set
+        recs = provider.non_terminated_nodes()
+        assert len(recs) == 3
+        lm_idle = LoadMetrics(nodes=[
+            view(f"r{r.node_id}", {"CPU": 4}, idle_s=10_000,
+                 labels={"autoscaler-node-id": r.node_id}) for r in recs])
+        patches_before = op.patch_count
+        status = auto.update(lm_idle)
+        assert len(status["terminated"]) == 3
+        assert not provider.safe_to_scale  # deletes not reconciled yet
+        provider.non_terminated_nodes()   # next cycle: submit
+        assert op.patch_count == patches_before + 1
+        deadline = time.monotonic() + 10
+        while op.nodes() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert op.nodes() == {}
+        provider.non_terminated_nodes()
+        assert provider.safe_to_scale
+    finally:
+        op.stop()
+
+
 def test_tpu_provider_dry_run_records_gcloud_calls():
     p = TpuPodSliceProvider({"type": "tpu", "project": "proj",
                              "zone": "us-central2-b", "dry_run": True})

@@ -1,0 +1,81 @@
+"""The test-double layer itself (reference _private/test_utils.py —
+SignalActor :704, Semaphore :725, wait_for_condition :461,
+run_string_as_driver :329)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.test_utils import (Semaphore, SignalActor,
+                                         run_string_as_driver,
+                                         wait_for_condition)
+
+
+def test_signal_actor_rendezvous(ray_start_regular):
+    sig = SignalActor.remote()
+
+    @ray_tpu.remote
+    def blocked(s):
+        ray_tpu.get(s.wait.remote())
+        return "released"
+
+    ref = blocked.remote(sig)
+    # the task is parked on the signal, not finished
+    ready, pending = ray_tpu.wait([ref], timeout=1)
+    assert pending == [ref]
+    wait_for_condition(
+        lambda: ray_tpu.get(sig.cur_num_waiters.remote(), timeout=30) == 1,
+        timeout=60)
+    ray_tpu.get(sig.send.remote(), timeout=30)
+    assert ray_tpu.get(ref, timeout=60) == "released"
+
+
+def test_semaphore_throttles(ray_start_regular):
+    sem = Semaphore.remote(value=1)
+    ray_tpu.get(sem.acquire.remote(), timeout=30)
+    assert ray_tpu.get(sem.locked.remote(), timeout=30)
+    ray_tpu.get(sem.release.remote(), timeout=30)
+    assert not ray_tpu.get(sem.locked.remote(), timeout=30)
+
+
+def test_wait_for_condition_surfaces_last_exception():
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise ValueError("not yet")
+        return True
+
+    wait_for_condition(flaky, timeout=10, retry_interval_ms=10)
+
+    with pytest.raises(RuntimeError, match="always-broken"):
+        def broken():
+            raise ValueError("always-broken")
+        wait_for_condition(broken, timeout=0.3, retry_interval_ms=50)
+
+
+def test_run_string_as_driver_isolated(ray_start_regular):
+    """A second driver process joins the same cluster and leaves again
+    without disturbing this one."""
+    from ray_tpu.runtime.core_worker import get_global_worker
+    addr = get_global_worker().gcs._address
+    out = run_string_as_driver(f"""
+import ray_tpu
+ray_tpu.init(address="{addr[0]}:{addr[1]}")
+
+@ray_tpu.remote
+def f():
+    return "from-second-driver"
+
+print(ray_tpu.get(f.remote(), timeout=60))
+ray_tpu.shutdown()
+""")
+    assert "from-second-driver" in out
+
+    @ray_tpu.remote
+    def g():
+        return 1
+
+    assert ray_tpu.get(g.remote(), timeout=60) == 1
