@@ -86,6 +86,7 @@ PyVal minmax(const std::vector<PyVal>& args) {
 struct CounterActor : CppActor {
   int64_t n = 0;
   explicit CounterActor(int64_t start) : n(start) {}
+  // pid lets tests target THIS actor's process exactly (restart tests)
   PyVal call(const std::string& method,
              const std::vector<PyVal>& args) override {
     if (method == "inc") {
@@ -93,6 +94,7 @@ struct CounterActor : CppActor {
       return PyVal::integer(n);
     }
     if (method == "total") return PyVal::integer(n);
+    if (method == "pid") return PyVal::integer((int64_t)::getpid());
     if (method == "boom") throw std::runtime_error("counter exploded");
     throw std::runtime_error("CounterActor has no method '" + method + "'");
   }

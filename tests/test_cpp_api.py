@@ -175,6 +175,36 @@ def test_cpp_actor_state_isolated(ray_start_regular):
     assert ray_tpu.get(kv.size.remote(), timeout=120) == 2
 
 
+def test_cpp_actor_restart_after_worker_death(ray_start_regular):
+    """The GCS restart FSM treats cpp actors like Python ones: killing
+    the native worker process restarts the actor (fresh state, same
+    handle) while max_restarts lasts."""
+    import time
+    _tool("cpp_worker")
+    c = ray_tpu.cpp_actor_class("Counter", max_restarts=2).remote(0)
+    assert ray_tpu.get(c.inc.remote(), timeout=120) == 1
+    # the actor names its OWN process — no /proc guessing that could hit
+    # another session's worker
+    pid = ray_tpu.get(c.pid.remote(), timeout=120)
+    assert os.readlink(f"/proc/{pid}/exe").endswith("cpp_worker")
+    os.kill(pid, 9)
+
+    deadline = time.monotonic() + 120
+    while True:
+        try:
+            # idempotent probe: a timed-out-but-executed attempt can't
+            # skew the asserted state the way a retried inc() would
+            total = ray_tpu.get(c.total.remote(), timeout=30)
+            break
+        except (ray_tpu.exceptions.RayTpuError, ConnectionError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.5)
+    assert total == 0  # fresh instance from the factory args
+    assert ray_tpu.get(c.pid.remote(), timeout=120) != pid
+    assert ray_tpu.get(c.inc.remote(), timeout=120) == 1
+
+
 def test_cpp_native_driver(ray_start_cluster):
     """The C++ user API binary joins the cluster as a driver: registers a
     job, leases a cpp worker via the standard lease protocol, runs tasks,
