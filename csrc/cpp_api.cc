@@ -46,7 +46,41 @@ struct ActorState {
   std::unique_ptr<rpcnet::Conn> conn;
   std::string stream;
   std::atomic<int64_t> next_seq{0};
+  // for fetching store-located (non-inline) results via the raylet;
+  // lazily connected, independent of the Driver's lifetime
+  std::string raylet_host;
+  int raylet_port = 0;
+  std::unique_ptr<rpcnet::Conn> fetch_conn;
+  std::mutex fetch_lock;
 };
+
+namespace {
+
+// resolve one reply slot to the serialized flat bytes: inline "data",
+// or a {"location": ...} store object fetched whole via the raylet's
+// fetch_object RPC (raylet.py _rpc_fetch_object)
+std::string resolve_slot(const PyVal& slot, const std::string& task_id,
+                         rpcnet::Conn* raylet, double timeout_s) {
+  const PyVal* data = slot.get("data");
+  if (data && data->kind == PyVal::BYTES) return data->s;
+  const PyVal* loc = slot.get("location");
+  if (loc && raylet) {
+    std::string oid = task_id;  // ObjectID: task id + BE u32 index 0
+    oid.push_back('\0');
+    oid.push_back('\0');
+    oid.push_back('\0');
+    oid.push_back('\0');
+    PyVal q = PyVal::dict();
+    q.set("object_id", PyVal::bytes(oid));
+    PyVal out = raylet->call("fetch_object", q, timeout_s);
+    const PyVal* d = out.get("data");
+    if (d && d->kind == PyVal::BYTES) return d->s;
+    throw TaskFailure("store fetch returned no data");
+  }
+  throw TaskFailure("unresolvable task result slot: " + slot.repr());
+}
+
+}  // namespace
 
 struct Driver::Impl {
   std::unique_ptr<rpcnet::Conn> gcs;
@@ -58,6 +92,8 @@ struct Driver::Impl {
   std::string job_id_hex;
   std::string sched_key;
   std::string lease_id, worker_id;
+  std::string raylet_host;
+  int raylet_port = 0;
 
   rpcnet::Conn* lease_home() {
     return granting ? granting.get() : raylet.get();
@@ -80,6 +116,8 @@ Driver::Driver(const std::string& raylet_host, int raylet_port,
   impl_->gcs->call("register_job", reg, 30.0);
 
   impl_->raylet.reset(rpcnet::Conn::connect(raylet_host, raylet_port));
+  impl_->raylet_host = raylet_host;
+  impl_->raylet_port = raylet_port;
 
   // lease one cpp worker, following spillback redirects like the Python
   // submitter (core_worker._lease_with_spillback, max 3 hops)
@@ -189,6 +227,8 @@ ActorClient Driver::actor(const std::string& cls_name,
           st->conn.reset(rpcnet::Conn::connect(addr->items[0].s,
                                                (int)addr->items[1].i));
           st->stream = to_hex(random_bytes(8));
+          st->raylet_host = impl_->raylet_host;
+          st->raylet_port = impl_->raylet_port;
           ActorClient a;
           a.state_ = st;
           a.actor_id_ = actor_id_hex;
@@ -228,15 +268,23 @@ PyVal ActorClient::call(const std::string& method,
   spec.set("seq", PyVal::integer(st->next_seq++));
   spec.set("stream", PyVal::str(st->stream));
 
+  std::string task_id = spec.get("task_id")->s;
   PyVal reply = st->conn->call("actor_task", spec, timeout_s);
   const PyVal* results = reply.get("results");
   if (!results || results->items.empty())
     throw TaskFailure("empty actor reply");
-  const PyVal* data = results->items[0].get("data");
-  if (!data || data->kind != PyVal::BYTES)
-    throw TaskFailure("non-inline actor result");
+  rpcnet::Conn* fetcher = nullptr;
+  {
+    std::lock_guard<std::mutex> g(st->fetch_lock);
+    if (!st->fetch_conn && st->raylet_port)
+      st->fetch_conn.reset(
+          rpcnet::Conn::connect(st->raylet_host, st->raylet_port));
+    fetcher = st->fetch_conn.get();
+  }
+  std::string flat =
+      resolve_slot(results->items[0], task_id, fetcher, timeout_s);
   int64_t err = 0;
-  PyVal value = pycodec::flat_deserialize(data->s, &err);
+  PyVal value = pycodec::flat_deserialize(flat, &err);
   if (err) throw TaskFailure("actor call failed: " + value.repr());
   return value;
 }
@@ -258,16 +306,15 @@ PyVal Driver::call(const std::string& fn_name,
   spec.set("owner_addr", std::move(owner));
   spec.set("name", PyVal::str("cpp:" + fn_name));
 
+  std::string task_id = spec.get("task_id")->s;
   PyVal reply = impl_->worker->call("push_task", spec, timeout_s);
   const PyVal* results = reply.get("results");
   if (!results || results->items.empty())
     throw TaskFailure("empty task reply");
-  const PyVal& one = results->items[0];
-  const PyVal* data = one.get("data");
-  if (!data || data->kind != PyVal::BYTES)
-    throw TaskFailure("non-inline task result");
+  std::string flat = resolve_slot(results->items[0], task_id,
+                                  impl_->raylet.get(), timeout_s);
   int64_t err = 0;
-  PyVal value = pycodec::flat_deserialize(data->s, &err);
+  PyVal value = pycodec::flat_deserialize(flat, &err);
   if (err) throw TaskFailure("task failed: " + value.repr());
   return value;
 }

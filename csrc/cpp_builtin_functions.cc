@@ -64,6 +64,15 @@ PyVal fail(const std::vector<PyVal>& args) {
   throw std::runtime_error(msg);
 }
 
+PyVal blob(const std::vector<PyVal>& args) {
+  // n bytes of fill — exercises the above-inline-threshold result path
+  // (sealed into the shm store, {"location": ...} reply)
+  if (args.empty() || args[0].kind != PyVal::INT)
+    throw std::runtime_error("Blob: (n [, fill-str]) args");
+  char fill = args.size() > 1 && !args[1].s.empty() ? args[1].s[0] : 'x';
+  return PyVal::bytes(std::string((size_t)args[0].i, fill));
+}
+
 PyVal pid(const std::vector<PyVal>&) {
   // lets tests assert which PROCESS ran a task (language-pool isolation)
   return PyVal::integer((int64_t)::getpid());
@@ -95,6 +104,8 @@ struct CounterActor : CppActor {
     }
     if (method == "total") return PyVal::integer(n);
     if (method == "pid") return PyVal::integer((int64_t)::getpid());
+    if (method == "payload")  // big actor result -> store-object reply
+      return PyVal::bytes(std::string((size_t)args.at(0).i, 'y'));
     if (method == "boom") throw std::runtime_error("counter exploded");
     throw std::runtime_error("CounterActor has no method '" + method + "'");
   }
@@ -140,6 +151,7 @@ void register_builtin_functions() {
   register_function("Fib", fib);
   register_function("Echo", echo);
   register_function("Fail", fail);
+  register_function("Blob", blob);
   register_function("Pid", pid);
   register_function("MinMax", minmax);
 }

@@ -69,6 +69,17 @@ int main(int argc, char** argv) {
     }
     PyVal total = counter.call("total", {});
     if (!actor_err || total.i != 13) return 1;  // error didn't kill it
+
+    // store-located results: task + actor payloads above the inline
+    // threshold come back via the raylet fetch path
+    PyVal big = d.call("Blob", {PyVal::integer(500000), PyVal::str("q")});
+    printf("Blob(500000) -> %zu bytes\n", big.s.size());
+    if (big.kind != PyVal::BYTES || big.s.size() != 500000 ||
+        big.s[0] != 'q')
+      return 1;
+    PyVal apay = counter.call("payload", {PyVal::integer(300000)});
+    printf("actor payload -> %zu bytes\n", apay.s.size());
+    if (apay.kind != PyVal::BYTES || apay.s.size() != 300000) return 1;
     d.kill_actor(counter);
 
     printf("CPP_DRIVER_OK\n");

@@ -1,0 +1,72 @@
+// cpp_store: the C++ worker's client for the node's shared-memory store.
+//
+// Attaches the same mmap segment csrc/shmstore.cc manages (the raylet
+// creates it; Python workers attach via ctypes in
+// ray_tpu/runtime/object_store.py) and writes sealed primary copies the
+// same way store_put does (core_worker.py:577): create with
+// allow_evict=0 — primaries are never LRU-evicted — copy the serialized
+// flat bytes, seal.  Lets cpp tasks return results above the inline
+// threshold as store objects instead of multi-MB RPC replies.
+#pragma once
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+extern "C" {
+long long store_create(void* base, const uint8_t* id, uint64_t size,
+                       uint64_t meta, int allow_evict);
+int store_seal(void* base, const uint8_t* id);
+}
+
+namespace ray_tpu_cpp {
+
+class ShmStoreClient {
+ public:
+  // attach an existing segment; false if absent/unreadable
+  bool attach(const std::string& path) {
+    int fd = ::open(path.c_str(), O_RDWR);
+    if (fd < 0) return false;
+    struct stat st{};
+    if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+      ::close(fd);
+      return false;
+    }
+    len_ = (size_t)st.st_size;
+    base_ = ::mmap(nullptr, len_, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (base_ == MAP_FAILED) {
+      base_ = nullptr;
+      return false;
+    }
+    return true;
+  }
+
+  bool attached() const { return base_ != nullptr; }
+
+  // sealed primary copy of `data` under the 20-byte object id; false on
+  // store-full (the caller degrades to an inline reply — the Python
+  // worker's spill-request/fallback dance is not replicated here)
+  bool put(const uint8_t id[20], const std::string& data) {
+    if (!base_) return false;
+    long long off = store_create(base_, id, data.size(), /*meta=*/0,
+                                 /*allow_evict=*/0);
+    if (off == -1) return true;  // already exists: a lost-reply retry
+    // re-produced the same (task_id, index) — success like the Python
+    // worker's FileExistsError path (core_worker.py store_put)
+    if (off <= 0) return false;
+    memcpy((char*)base_ + off, data.data(), data.size());
+    return store_seal(base_, id) == 0;
+  }
+
+ private:
+  void* base_ = nullptr;
+  size_t len_ = 0;
+};
+
+}  // namespace ray_tpu_cpp

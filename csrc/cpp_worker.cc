@@ -21,6 +21,7 @@
 #include <string>
 
 #include "cpp_functions.h"
+#include "cpp_store.h"
 #include "pycodec.h"
 #include "rpcnet.h"
 
@@ -47,6 +48,45 @@ int g_gcs_port = 0;
 // on this machine, so its advertised host is ours too (worker_main's
 // core.address analog — never loopback, or cross-node owners can't push)
 std::string g_self_host = "127.0.0.1";
+// local shm store for large results ({"location": node_id} replies)
+ray_tpu_cpp::ShmStoreClient g_store;
+std::string g_node_id_hex;
+
+int64_t inline_max_bytes() {
+  // default matches CONFIG.inline_object_max_bytes; the env var is the
+  // standard flag-override channel (RAY_TPU_<NAME>)
+  static int64_t v = [] {
+    const char* e = getenv("RAY_TPU_INLINE_OBJECT_MAX_BYTES");
+    return e ? atoll(e) : 100 * 1024;
+  }();
+  return v;
+}
+
+// one result slot: inline payload, or a sealed store object when the
+// payload is big and the store is reachable (worker_main
+// _package_results semantics)
+PyVal package_slot(const std::string& task_id, int64_t index,
+                   std::string payload) {  // by value: moved when inline
+  PyVal one = PyVal::dict();
+  if ((int64_t)payload.size() > inline_max_bytes() &&
+      g_store.attached() && !g_node_id_hex.empty() &&
+      task_id.size() == 16) {
+    // ObjectID.for_task_return: 16-byte task id + big-endian u32 index
+    uint8_t oid[20];
+    memcpy(oid, task_id.data(), 16);
+    oid[16] = (uint8_t)(index >> 24);
+    oid[17] = (uint8_t)(index >> 16);
+    oid[18] = (uint8_t)(index >> 8);
+    oid[19] = (uint8_t)index;
+    if (g_store.put(oid, payload)) {
+      one.set("location", PyVal::str(g_node_id_hex));
+      return one;
+    }
+    // store full: inline degradation is always correct, just bigger
+  }
+  one.set("data", PyVal::bytes(std::move(payload)));
+  return one;
+}
 
 // serialized-format helpers -------------------------------------------------
 
@@ -139,16 +179,20 @@ PyVal execute_task(const PyVal& spec) {
       return error_reply(spec, "return count mismatch");
     values = std::move(value.items);
   }
+  const PyVal* tid = spec.get("task_id");
+  std::string task_id =
+      tid && tid->kind == PyVal::BYTES ? tid->s : std::string();
   PyVal results = PyVal::list();
-  for (auto& v : values) {
-    PyVal one = PyVal::dict();
+  for (size_t i = 0; i < values.size(); ++i) {
+    std::string payload;
     try {
-      one.set("data", PyVal::bytes(pycodec::flat_serialize(v)));
+      payload = pycodec::flat_serialize(values[i]);
     } catch (const std::exception& e) {
       return error_reply(spec, std::string("unserializable result: ") +
                                    e.what());
     }
-    results.items.push_back(std::move(one));
+    results.items.push_back(
+        package_slot(task_id, (int64_t)i, std::move(payload)));
   }
   PyVal reply = PyVal::dict();
   reply.set("results", std::move(results));
@@ -179,15 +223,18 @@ PyVal execute_actor_task(const PyVal& spec) {
   } catch (const std::exception& e) {
     return error_reply(spec, e.what());
   }
-  PyVal one = PyVal::dict();
+  std::string payload;
   try {
-    one.set("data", PyVal::bytes(pycodec::flat_serialize(value)));
+    payload = pycodec::flat_serialize(value);
   } catch (const std::exception& e) {
     return error_reply(spec, std::string("unserializable result: ") +
                                  e.what());
   }
+  const PyVal* tid = spec.get("task_id");
   PyVal results = PyVal::list();
-  results.items.push_back(std::move(one));
+  results.items.push_back(package_slot(
+      tid && tid->kind == PyVal::BYTES ? tid->s : std::string(), 0,
+      std::move(payload)));
   PyVal reply = PyVal::dict();
   reply.set("results", std::move(results));
   return reply;
@@ -391,6 +438,12 @@ int main(int argc, char** argv) {
   if (gcs_host) g_gcs_host = gcs_host;
   if (gcs_port) g_gcs_port = atoi(gcs_port);
   g_self_host = raylet_host;
+  const char* store_path = arg_value(argc, argv, "--store-path");
+  const char* node_id = arg_value(argc, argv, "--node-id");
+  if (node_id) g_node_id_hex = node_id;
+  if (store_path && !g_store.attach(store_path))
+    fprintf(stderr, "shm store attach failed (%s): large results will "
+                    "ship inline\n", store_path);
   ray_tpu_cpp::register_builtin_functions();
 
   std::thread exec([&] { g_exec.loop(); });

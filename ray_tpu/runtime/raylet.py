@@ -821,7 +821,16 @@ class Raylet:
                "--raylet-port", str(self.address[1]),
                "--worker-id", worker_id.hex(),
                "--gcs-host", self.gcs_address[0],
-               "--gcs-port", str(self.gcs_address[1])]
+               "--gcs-port", str(self.gcs_address[1]),
+               "--store-path", self.store_path,
+               "--node-id", self.node_id.hex()]
+        # flag-override channel the binary understands (cf. Config env
+        # resolution): keep the inline threshold consistent across
+        # languages when tests/system_config change it — but an explicit
+        # per-env user override (env_vars) outranks it, like it would for
+        # a Python worker
+        env.setdefault("RAY_TPU_INLINE_OBJECT_MAX_BYTES",
+                       str(CONFIG.inline_object_max_bytes))
         out_f = open(log_prefix + ".out", "ab")
         err_f = open(log_prefix + ".err", "ab")
         try:

@@ -175,6 +175,23 @@ def test_cpp_actor_state_isolated(ray_start_regular):
     assert ray_tpu.get(kv.size.remote(), timeout=120) == 2
 
 
+def test_cpp_large_results_ride_the_store(ray_start_regular):
+    """Results above the inline threshold are sealed into the shm store
+    by the native worker (cpp_store.h) and fetched like any store
+    object; small results stay inline."""
+    _tool("cpp_worker")
+    blob = ray_tpu.get(ray_tpu.cpp_function("Blob").remote(4_000_000, "z"),
+                       timeout=180)
+    assert len(blob) == 4_000_000 and blob[:1] == b"z" and blob[-1:] == b"z"
+    assert ray_tpu.get(ray_tpu.cpp_function("Blob").remote(10, "a"),
+                       timeout=180) == b"a" * 10
+    # big actor result through the same path; actor state unaffected
+    c = ray_tpu.cpp_actor_class("Counter").remote(0)
+    p = ray_tpu.get(c.payload.remote(1_500_000), timeout=180)
+    assert len(p) == 1_500_000 and p[:1] == b"y"
+    assert ray_tpu.get(c.inc.remote(), timeout=180) == 1
+
+
 def test_cpp_actor_restart_after_worker_death(ray_start_regular):
     """The GCS restart FSM treats cpp actors like Python ones: killing
     the native worker process restarts the actor (fresh state, same
