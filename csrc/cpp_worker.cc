@@ -62,6 +62,174 @@ int64_t inline_max_bytes() {
   return v;
 }
 
+// ------------------------------------------------- borrowed-arg fetch
+// ObjectRef args pickle as _rebuild_ref(id_bytes, (host, port)); the
+// cpp worker resolves them through the same borrower protocol Python
+// workers use: poll the owner's get_object (inline data or locations),
+// then fetch located copies whole from that node's raylet
+// (fetch_object).  Connections are cached per peer.
+std::mutex g_peer_lock;
+std::map<std::pair<std::string, int>, std::shared_ptr<rpcnet::Conn>>
+    g_peer_conns;
+std::map<std::string, std::pair<std::string, int>> g_node_addr_cache;
+std::mutex g_gcs_lock;
+std::unique_ptr<rpcnet::Conn> g_gcs_conn;
+
+// Returned shared_ptr keeps the Conn alive for the caller even if a
+// concurrent thread replaces the cache entry after a disconnect — the
+// old object dies only when its last user finishes (throwing connects
+// are never inserted, so a cached entry is never null).
+std::shared_ptr<rpcnet::Conn> peer_conn(const std::string& host,
+                                        int port) {
+  auto key = std::make_pair(host, port);
+  {
+    std::lock_guard<std::mutex> g(g_peer_lock);
+    auto it = g_peer_conns.find(key);
+    if (it != g_peer_conns.end() && !it->second->closed())
+      return it->second;
+  }
+  std::shared_ptr<rpcnet::Conn> fresh(
+      rpcnet::Conn::connect(host, port));  // throws: nothing cached
+  std::lock_guard<std::mutex> g(g_peer_lock);
+  g_peer_conns[key] = fresh;
+  return fresh;
+}
+
+// node_id hex -> (host, port); the table is cached like the Python
+// borrower's node cache — one list_nodes per UNKNOWN node, never under
+// the peer-connection lock
+bool node_address(const std::string& node_id, std::string* host,
+                  int* port) {
+  {
+    std::lock_guard<std::mutex> g(g_gcs_lock);
+    auto it = g_node_addr_cache.find(node_id);
+    if (it != g_node_addr_cache.end()) {
+      *host = it->second.first;
+      *port = it->second.second;
+      return true;
+    }
+  }
+  std::lock_guard<std::mutex> g(g_gcs_lock);
+  if (!g_gcs_conn || g_gcs_conn->closed()) {
+    if (g_gcs_host.empty()) return false;
+    g_gcs_conn.reset(rpcnet::Conn::connect(g_gcs_host, g_gcs_port));
+  }
+  PyVal nodes = g_gcs_conn->call("list_nodes", PyVal::dict(), 10.0);
+  bool found = false;
+  for (const auto& n : nodes.items) {
+    const PyVal* nid = n.get("node_id");
+    const PyVal* addr = n.get("address");
+    if (nid && nid->kind == PyVal::STR && addr &&
+        addr->items.size() == 2) {
+      g_node_addr_cache[nid->s] = {addr->items[0].s,
+                                   (int)addr->items[1].i};
+      if (nid->s == node_id) {
+        *host = addr->items[0].s;
+        *port = (int)addr->items[1].i;
+        found = true;
+      }
+    }
+  }
+  return found;
+}
+
+// chunked whole-object read (fetch_object_chunk): a multi-GB promoted
+// arg never occupies a multi-GB RPC frame (raylet chunk protocol)
+constexpr int64_t kFetchChunk = 8 * 1024 * 1024;
+
+std::string fetch_located(const std::string& id_bytes,
+                          const std::string& host, int port,
+                          double timeout_s) {
+  auto conn = peer_conn(host, port);
+  std::string out;
+  int64_t total = -1;
+  for (int64_t off = 0; total < 0 || off < total; off += kFetchChunk) {
+    PyVal q = PyVal::dict();
+    q.set("object_id", PyVal::bytes(id_bytes));
+    q.set("offset", PyVal::integer(off));
+    q.set("length", PyVal::integer(kFetchChunk));
+    PyVal r = conn->call("fetch_object_chunk", q, timeout_s);
+    const PyVal* d = r.get("data");
+    const PyVal* t = r.get("total");
+    if (!d || d->kind != PyVal::BYTES || !t || t->kind != PyVal::INT)
+      throw std::runtime_error("arg fetch returned no data");
+    total = t->i;
+    out += d->s;
+  }
+  return out;
+}
+
+PyVal resolve_ref_arg(const std::string& id_bytes,
+                      const std::string& owner_host, int owner_port,
+                      double timeout_s = 60.0) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  PyVal q = PyVal::dict();
+  q.set("object_id", PyVal::bytes(id_bytes));
+  q.set("timeout", PyVal::real(1.0));
+  while (std::chrono::steady_clock::now() < deadline) {
+    PyVal r = peer_conn(owner_host, owner_port)->call("get_object", q,
+                                                      timeout_s);
+    if (r.kind == PyVal::NONE) {
+      usleep(10000);  // the owner is recovering/producing it: poll
+      continue;
+    }
+    const PyVal* data = r.get("data");
+    if (data && data->kind == PyVal::BYTES) {
+      int64_t err = 0;
+      PyVal v = pycodec::flat_deserialize(data->s, &err);
+      if (err)
+        throw std::runtime_error("dependency failed: " + v.repr());
+      return v;
+    }
+    const PyVal* locs = r.get("locations");
+    if (locs && !locs->items.empty()) {
+      // try every reported location; a stale/evicted copy or a dead
+      // node re-polls the owner instead of failing the task (the
+      // Python borrower's retry semantics)
+      for (const auto& loc : locs->items) {
+        std::string host;
+        int port = 0;
+        if (loc.kind != PyVal::STR ||
+            !node_address(loc.s, &host, &port))
+          continue;
+        try {
+          std::string flat =
+              fetch_located(id_bytes, host, port, timeout_s);
+          int64_t err = 0;
+          PyVal v = pycodec::flat_deserialize(flat, &err);
+          if (err)
+            throw std::runtime_error("dependency failed: " + v.repr());
+          return v;
+        } catch (const rpcnet::RpcError&) {
+          continue;  // that copy is gone; try the next / re-poll
+        }
+      }
+    }
+    usleep(10000);
+  }
+  throw std::runtime_error("timed out resolving ObjectRef arg");
+}
+
+// an unpickled ObjectRef marker: OPAQUE _rebuild_ref(id, (host, port))
+bool is_ref_marker(const PyVal& v) {
+  return v.kind == PyVal::OPAQUE &&
+         v.s.size() >= 12 &&
+         v.s.compare(v.s.size() - 12, 12, "_rebuild_ref") == 0 &&
+         v.items.size() == 2 && v.items[0].kind == PyVal::BYTES &&
+         v.items[1].kind == PyVal::TUPLE &&
+         v.items[1].items.size() == 2;
+}
+
+void resolve_ref_args(std::vector<PyVal>* args) {
+  for (auto& a : *args) {
+    if (is_ref_marker(a)) {
+      a = resolve_ref_arg(a.items[0].s, a.items[1].items[0].s,
+                          (int)a.items[1].items[1].i);
+    }
+  }
+}
+
 // one result slot: inline payload, or a sealed store object when the
 // payload is big and the store is reachable (worker_main
 // _package_results semantics)
@@ -154,6 +322,11 @@ PyVal execute_task(const PyVal& spec) {
   if (!packed.items[1].map.empty())
     return error_reply(spec, "cpp tasks take positional args only");
   std::vector<PyVal> args = std::move(packed.items[0].items);
+  try {
+    resolve_ref_args(&args);
+  } catch (const std::exception& e) {
+    return error_reply(spec, e.what());
+  }
 
   PyVal value;
   try {
@@ -219,6 +392,7 @@ PyVal execute_actor_task(const PyVal& spec) {
     return error_reply(spec, "cpp actors take positional args only");
   PyVal value;
   try {
+    resolve_ref_args(&packed.items[0].items);
     value = g_actor->call(method->s, packed.items[0].items);
   } catch (const std::exception& e) {
     return error_reply(spec, e.what());

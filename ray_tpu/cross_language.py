@@ -19,42 +19,41 @@ from typing import Any, Dict, Optional
 _PRIMITIVES = (type(None), bool, int, float, str, bytes)
 
 
-def _check_arg(a: Any) -> None:
+def _check_arg(a: Any, depth: int = 0) -> None:
+    from ray_tpu.runtime.core_worker import ObjectRef
+    if isinstance(a, ObjectRef):
+        if depth == 0:
+            return  # resolved worker-side via the borrower protocol
+        # nested refs pass through Python workers as live handles, but a
+        # cpp worker would see an opaque marker it cannot resolve —
+        # reject at the call site instead of corrupting silently
+        raise TypeError(
+            "ObjectRef args to cpp tasks must be top-level positional "
+            "args (nested inside containers they are not resolvable "
+            "C++-side)")
     if isinstance(a, (list, tuple)):
         for x in a:
-            _check_arg(x)
+            _check_arg(x, depth + 1)
         return
     if isinstance(a, dict):
         for k, v in a.items():
-            _check_arg(k)
-            _check_arg(v)
+            _check_arg(k, depth + 1)
+            _check_arg(v, depth + 1)
         return
     if not isinstance(a, _PRIMITIVES):
         raise TypeError(
             f"cpp tasks take primitive by-value args; got {type(a).__name__}"
-            " (ObjectRefs/arrays are not representable C++-side)")
+            " (arrays and arbitrary objects are not representable "
+            "C++-side; top-level ObjectRefs to primitive values are)")
 
 
 def _guard_args(args) -> None:
-    """Reject anything the C++ side cannot receive: non-primitives, and
-    args _serialize_args would promote to store ObjectRefs.  Mirrors the
-    exact promotion predicate (core_worker._maybe_big pre-filter + pickle
-    size > max_direct_call_args_bytes) so nothing inline-shippable is
-    spuriously rejected and nothing promotable slips through to become a
-    far-from-cause worker error."""
-    import pickle
-
-    from ray_tpu._private.config import CONFIG
-    from ray_tpu.runtime.core_worker import _maybe_big
+    """Reject what the C++ side cannot receive: non-primitive values.
+    ObjectRef args (explicit or from large-arg store promotion) are fine
+    — the cpp worker fetches them through the owner/raylet like any
+    borrower, provided the referenced VALUE is itself primitive."""
     for a in args:
         _check_arg(a)
-        if _maybe_big(a) and len(pickle.dumps(a, protocol=5)) > \
-                CONFIG.max_direct_call_args_bytes:
-            raise ValueError(
-                "cpp task/actor arg exceeds max_direct_call_args_bytes "
-                f"({CONFIG.max_direct_call_args_bytes}); it would be "
-                "promoted to a store object, which the C++ side cannot "
-                "resolve yet")
 
 
 class CppFunction:

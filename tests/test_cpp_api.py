@@ -175,6 +175,39 @@ def test_cpp_actor_state_isolated(ray_start_regular):
     assert ray_tpu.get(kv.size.remote(), timeout=120) == 2
 
 
+def test_cpp_ref_args_resolve_via_borrower_protocol(ray_start_regular):
+    """ObjectRef args into cpp tasks/actors: the native worker polls the
+    owner (get_object) and fetches located copies from raylets — same
+    borrower protocol as Python workers.  Covers explicit refs, cpp->cpp
+    chaining, python->cpp handoff, auto-promoted large args, and failed
+    upstream dependencies."""
+    _tool("cpp_worker")
+    add = ray_tpu.cpp_function("Add")
+    assert ray_tpu.get(add.remote(ray_tpu.put(40), ray_tpu.put(2)),
+                       timeout=180) == 42
+    mid = add.remote(1, 2)
+    assert ray_tpu.get(add.remote(mid, 10), timeout=180) == 13
+
+    @ray_tpu.remote
+    def produce():
+        return 5
+
+    assert ray_tpu.get(add.remote(produce.remote(), 1), timeout=180) == 6
+    # > max_direct_call_args_bytes: promoted to a store object client-side
+    big = "a" * 500_000
+    got = ray_tpu.get(ray_tpu.cpp_function("Concat").remote(big, "!"),
+                      timeout=180)
+    assert len(got) == 500_001 and got.endswith("!")
+    # refs into actor methods
+    kv = ray_tpu.cpp_actor_class("Kv").remote()
+    ray_tpu.get(kv.put.remote("k", ray_tpu.put([1, 2, 3])), timeout=180)
+    assert ray_tpu.get(kv.get.remote("k"), timeout=180) == [1, 2, 3]
+    # failed upstream surfaces, doesn't hang
+    bad = ray_tpu.cpp_function("Fail").remote("upstream-dead")
+    with pytest.raises(ray_tpu.exceptions.TaskError):
+        ray_tpu.get(add.remote(bad, 1), timeout=180)
+
+
 def test_cpp_large_results_ride_the_store(ray_start_regular):
     """Results above the inline threshold are sealed into the shm store
     by the native worker (cpp_store.h) and fetched like any store
