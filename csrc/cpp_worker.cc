@@ -137,13 +137,19 @@ bool node_address(const std::string& node_id, std::string* host,
 // arg never occupies a multi-GB RPC frame (raylet chunk protocol)
 constexpr int64_t kFetchChunk = 8 * 1024 * 1024;
 
-std::string fetch_located(const std::string& id_bytes,
-                          const std::string& host, int port,
-                          double timeout_s) {
+// Returns false when the copy is absent at this location (evicted, or in
+// the transient spill-restore window raylet documents as must-retry) —
+// the caller then re-polls the owner, matching core_worker's
+// absent->retry semantics (core_worker.py:871). Advances by the bytes
+// actually received, never by the request size, and treats an empty
+// chunk as absent so a short read can't yield a corrupt payload.
+bool fetch_located(const std::string& id_bytes, const std::string& host,
+                   int port, double timeout_s, std::string* out) {
   auto conn = peer_conn(host, port);
-  std::string out;
+  out->clear();
   int64_t total = -1;
-  for (int64_t off = 0; total < 0 || off < total; off += kFetchChunk) {
+  int64_t off = 0;
+  while (total < 0 || off < total) {
     PyVal q = PyVal::dict();
     q.set("object_id", PyVal::bytes(id_bytes));
     q.set("offset", PyVal::integer(off));
@@ -152,11 +158,13 @@ std::string fetch_located(const std::string& id_bytes,
     const PyVal* d = r.get("data");
     const PyVal* t = r.get("total");
     if (!d || d->kind != PyVal::BYTES || !t || t->kind != PyVal::INT)
-      throw std::runtime_error("arg fetch returned no data");
+      return false;  // copy gone at this node
+    if (d->s.empty()) return false;  // empty chunk == absent
     total = t->i;
-    out += d->s;
+    out->append(d->s);
+    off += (int64_t)d->s.size();
   }
-  return out;
+  return (int64_t)out->size() == total;
 }
 
 PyVal resolve_ref_arg(const std::string& id_bytes,
@@ -186,24 +194,27 @@ PyVal resolve_ref_arg(const std::string& id_bytes,
     if (locs && !locs->items.empty()) {
       // try every reported location; a stale/evicted copy or a dead
       // node re-polls the owner instead of failing the task (the
-      // Python borrower's retry semantics)
+      // Python borrower's retry semantics). Only a decoded
+      // dependency-failure propagates out of this loop.
       for (const auto& loc : locs->items) {
         std::string host;
         int port = 0;
         if (loc.kind != PyVal::STR ||
             !node_address(loc.s, &host, &port))
           continue;
+        std::string flat;
+        bool have = false;
         try {
-          std::string flat =
-              fetch_located(id_bytes, host, port, timeout_s);
-          int64_t err = 0;
-          PyVal v = pycodec::flat_deserialize(flat, &err);
-          if (err)
-            throw std::runtime_error("dependency failed: " + v.repr());
-          return v;
+          have = fetch_located(id_bytes, host, port, timeout_s, &flat);
         } catch (const rpcnet::RpcError&) {
-          continue;  // that copy is gone; try the next / re-poll
+          have = false;  // node unreachable: try the next / re-poll
         }
+        if (!have) continue;
+        int64_t err = 0;
+        PyVal v = pycodec::flat_deserialize(flat, &err);
+        if (err)
+          throw std::runtime_error("dependency failed: " + v.repr());
+        return v;
       }
     }
     usleep(10000);
@@ -262,14 +273,17 @@ std::string make_error_payload(const std::string& task_name,
                                const std::string& message) {
   // a real ray_tpu.exceptions.TaskError(function_name, cause, tb) the
   // Python owner deserializes and raises unchanged
+  // sanitize: encoding a str raises CodecError on invalid UTF-8, and a
+  // throw from the error path would escape the executor loop and kill
+  // the worker (user e.what() may embed raw input bytes)
   PyVal cause;
   cause.kind = PyVal::OPAQUE;
   cause.s = "builtins.RuntimeError";
-  cause.items.push_back(PyVal::str(message));
+  cause.items.push_back(PyVal::str(pycodec::sanitize_utf8(message)));
   PyVal err;
   err.kind = PyVal::OPAQUE;
   err.s = "ray_tpu.exceptions.TaskError";
-  err.items.push_back(PyVal::str(task_name));
+  err.items.push_back(PyVal::str(pycodec::sanitize_utf8(task_name)));
   err.items.push_back(std::move(cause));
   err.items.push_back(PyVal::str("(cpp worker)"));
   return pycodec::flat_serialize(err, /*error_type=ERROR_TASK*/ 1);
@@ -434,6 +448,10 @@ PyVal create_actor(const PyVal& p) {
       blob && blob->kind == PyVal::BYTES ? blob->s : std::string());
   if (packed.kind != PyVal::TUPLE || packed.items.size() != 2)
     throw rpcnet::RpcError("bad actor creation args");
+  // Constructor args may be top-level ObjectRefs (cross_language's
+  // _guard_args allows them), exactly like plain task / actor-method
+  // args: resolve the markers before the factory sees them.
+  resolve_ref_args(&packed.items[0].items);
   g_actor = it->second(packed.items[0].items);
   g_actor_id = aid->s;
   return PyVal::dict();  // actor_ready is sent by the caller (main flow)

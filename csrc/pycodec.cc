@@ -394,6 +394,46 @@ struct Unpickler {
 };
 
 // ------------------------------------------------------------- encoder
+// RFC 3629: length of the valid UTF-8 sequence at p[i] (rejects
+// overlongs, surrogates, and > U+10FFFF), or 0 when invalid.
+size_t utf8_seq_len(const unsigned char* p, size_t n, size_t i) {
+  unsigned char c = p[i];
+  if (c < 0x80) return 1;
+  if ((c & 0xe0) == 0xc0) {
+    if (i + 1 >= n || (p[i + 1] & 0xc0) != 0x80 || c < 0xc2) return 0;
+    return 2;
+  }
+  if ((c & 0xf0) == 0xe0) {
+    if (i + 2 >= n || (p[i + 1] & 0xc0) != 0x80 ||
+        (p[i + 2] & 0xc0) != 0x80)
+      return 0;
+    if (c == 0xe0 && p[i + 1] < 0xa0) return 0;   // overlong
+    if (c == 0xed && p[i + 1] >= 0xa0) return 0;  // surrogate
+    return 3;
+  }
+  if ((c & 0xf8) == 0xf0) {
+    if (i + 3 >= n || (p[i + 1] & 0xc0) != 0x80 ||
+        (p[i + 2] & 0xc0) != 0x80 || (p[i + 3] & 0xc0) != 0x80)
+      return 0;
+    if (c == 0xf0 && p[i + 1] < 0x90) return 0;  // overlong
+    if (c > 0xf4 || (c == 0xf4 && p[i + 1] >= 0x90))
+      return 0;  // > U+10FFFF
+    return 4;
+  }
+  return 0;
+}
+
+bool is_valid_utf8(const std::string& s) {
+  const unsigned char* p = (const unsigned char*)s.data();
+  size_t n = s.size();
+  for (size_t i = 0; i < n;) {
+    size_t len = utf8_seq_len(p, n, i);
+    if (!len) return false;
+    i += len;
+  }
+  return true;
+}
+
 void dump_val(const PyVal& v, std::string* out) {
   char buf[16];
   switch (v.kind) {
@@ -435,6 +475,12 @@ void dump_val(const PyVal& v, std::string* out) {
       break;
     }
     case PyVal::STR: {
+      // BINUNICODE carries raw UTF-8; an invalid sequence would only
+      // surface as an opaque UnicodeDecodeError at the Python owner's
+      // get(), far from the producing function. Fail here instead.
+      if (!is_valid_utf8(v.s))
+        throw CodecError(
+            "non-UTF-8 str result: return bytes instead of str");
       *out += 'X';
       uint32_t n = (uint32_t)v.s.size();
       for (int j = 0; j < 4; ++j) *out += (char)(n >> (8 * j));
@@ -537,6 +583,25 @@ PyVal pickle_loads(const std::string& data) {
   Unpickler u(data);
   (void)kMark;
   return u.run();
+}
+
+std::string sanitize_utf8(const std::string& s) {
+  if (is_valid_utf8(s)) return s;
+  const unsigned char* p = (const unsigned char*)s.data();
+  size_t n = s.size();
+  std::string out;
+  out.reserve(n);
+  for (size_t i = 0; i < n;) {
+    size_t len = utf8_seq_len(p, n, i);
+    if (len) {
+      out.append(s, i, len);
+      i += len;
+    } else {
+      out += "\xef\xbf\xbd";  // U+FFFD replacement character
+      ++i;
+    }
+  }
+  return out;
 }
 
 std::string pickle_dumps(const PyVal& v) {

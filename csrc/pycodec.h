@@ -92,6 +92,12 @@ std::string pickle_dumps(const PyVal& v);
 // Object-payload flat format (serialization.py serialize/to_flat_bytes)
 // with zero out-of-band buffers: [u32 meta_len][msgpack meta][payload].
 std::string flat_serialize(const PyVal& v, int64_t error_type = 0);
+
+// Replace invalid UTF-8 byte sequences with U+FFFD so the result always
+// encodes as a pickle str.  Error paths MUST route messages through this
+// (encoding a str raises CodecError on invalid UTF-8; an error path that
+// itself throws would escape the executor and kill the worker).
+std::string sanitize_utf8(const std::string& s);
 // Inverse for inline results; throws CodecError if the payload carries
 // out-of-band buffers (numpy et al. — not a C++-side value).
 PyVal flat_deserialize(const std::string& data, int64_t* error_type);

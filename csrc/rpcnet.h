@@ -230,8 +230,10 @@ class Conn {
     } catch (const std::exception& e) {
       ok = PyVal::boolean(false);
       // the Python side pickles exception objects; we can only send a
-      // string — rpc.RemoteError(repr(cause)) renders it faithfully
-      out = PyVal::str(std::string(e.what()));
+      // string — rpc.RemoteError(repr(cause)) renders it faithfully.
+      // Sanitized: a non-UTF-8 what() would make send_frame throw and
+      // the reply would be silently dropped (caller hangs to timeout).
+      out = PyVal::str(pycodec::sanitize_utf8(std::string(e.what())));
     }
     try {
       send_frame(PyVal::tuple(

@@ -805,6 +805,10 @@ class Raylet:
         with more registered functions."""
         binary = CONFIG.cpp_worker_binary
         if not binary:
+            # stock build: verify the committed artifact still matches
+            # csrc/ sources (rebuilds on mismatch) before spawning it
+            from ray_tpu._core import buildcheck
+            buildcheck.ensure_fresh(logger=logger)
             binary = os.path.join(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))), "_core", "cpp_worker")
         if not os.path.exists(binary):

@@ -206,6 +206,10 @@ def test_cpp_ref_args_resolve_via_borrower_protocol(ray_start_regular):
     bad = ray_tpu.cpp_function("Fail").remote("upstream-dead")
     with pytest.raises(ray_tpu.exceptions.TaskError):
         ray_tpu.get(add.remote(bad, 1), timeout=180)
+    # refs into the CONSTRUCTOR resolve too (create_actor resolves the
+    # markers before the factory runs) — not just method args
+    c2 = ray_tpu.cpp_actor_class("Counter").remote(ray_tpu.put(100))
+    assert ray_tpu.get(c2.inc.remote(), timeout=180) == 101
 
 
 def test_cpp_large_results_ride_the_store(ray_start_regular):
