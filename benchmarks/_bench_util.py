@@ -1,0 +1,11 @@
+"""Helpers shared by the benchmark drivers."""
+
+
+def percentiles(lat_s):
+    """(p50_ms, p99_ms) of a list of latencies in seconds."""
+    lat = sorted(lat_s)
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p / 100 * len(lat)))] * 1000.0
+
+    return pct(50), pct(99)

@@ -72,6 +72,12 @@ def main():
     out["data"] = _run_json_lines(
         [sys.executable, os.path.join(REPO, "benchmarks", "data_ingest.py")])
 
+    print("[collect] LLM serving (continuous batching, real chip)...",
+          flush=True)
+    out["serve_llm"] = _run_json_lines(
+        [sys.executable, os.path.join(REPO, "benchmarks", "serve_llm.py"),
+         "--slots", "32", "--requests", "128"], timeout=2400)
+
     # scale envelope: written by tests/test_scale_envelope.py when it runs;
     # keep the previous numbers if present
     try:

@@ -1,0 +1,200 @@
+"""LLM serving benchmark: continuous batching on the real chip.
+
+The north-star serving row (BASELINE.md: "Serve llama-3-8b, TPU
+replicas ... qps, p50/p99").  The reference's serve numbers are no-op
+handlers (doc/source/serve/performance.md); this drives REAL token
+generation through one TPU-resident engine replica and reports
+tokens/s/chip, request qps, latency percentiles, and batch occupancy —
+the numbers a model-serving user actually plans capacity with.
+
+Run directly (defaults to gpt-small shapes, random weights):
+  python benchmarks/serve_llm.py [--preset gpt-small] [--slots 8]
+        [--requests 64] [--prompt-len 64] [--new-tokens 64] [--engine-only]
+
+Prints one JSON line per scenario (collect_microbench.py ingests these).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+# repo-root import without PYTHONPATH (which would leak into the axon
+# tunnel subprocess and break its own imports)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+try:
+    from benchmarks._bench_util import percentiles as _percentiles
+except ImportError:          # run as a script from benchmarks/
+    from _bench_util import percentiles as _percentiles
+
+
+def build_engine(preset: str, slots: int, seed: int = 0,
+                 max_seq_len=None, block_size=16):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.configs import get_config
+    from ray_tpu.models.gpt import GPT
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    cfg = get_config(preset)
+    model = GPT(cfg, decode=True)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 1), jnp.int32))["params"]
+    return LLMEngine(cfg, params, num_slots=slots,
+                     max_seq_len=max_seq_len,
+                     block_size=block_size), cfg
+
+
+def bench_engine(preset="gpt-small", slots=8, requests=64, prompt_len=64,
+                 new_tokens=64, stagger_s=0.0):
+    """Drive the engine directly (no serve actor hop): the chip-side
+    ceiling for one replica."""
+    # KV allocation sized to the workload (prompt + generation + slack):
+    # decode reads the whole cache row every step
+    eng, cfg = build_engine(preset, slots,
+                            max_seq_len=2 * (prompt_len + new_tokens))
+    vocab = cfg.vocab_size
+
+    # compile every jit path at the bench shapes before timing
+    eng.warmup(prompt_lens=[prompt_len])
+    eng.submit([7] * prompt_len, max_new_tokens=4, temperature=0.8)
+
+    results = [None] * requests
+    lats = []
+    ttfts = []
+    lock = threading.Lock()
+
+    def go(i):
+        prompt = [(i * 37 + j) % (vocab - 1) + 1 for j in range(prompt_len)]
+        r = eng.submit(prompt, max_new_tokens=new_tokens, temperature=0.8)
+        with lock:
+            results[i] = r
+            lats.append(r.latency_s)
+            ttfts.append(r.time_to_first_token_s)
+
+    t0 = time.monotonic()
+    threads = []
+    for i in range(requests):
+        th = threading.Thread(target=go, args=(i,))
+        th.start()
+        threads.append(th)
+        if stagger_s:
+            time.sleep(stagger_s)
+    for th in threads:
+        th.join()
+    wall = time.monotonic() - t0
+
+    tokens = sum(len(r.tokens) for r in results if r is not None)
+    st = eng.stats.snapshot(eng.num_slots)
+    p50, p99 = _percentiles(lats)
+    t50, t99 = _percentiles(ttfts)
+    eng.close()
+    return {
+        "metric": "serve_llm_engine",
+        "preset": preset,
+        "num_slots": slots,
+        "requests": requests,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "tokens_per_s": round(tokens / wall, 1),
+        "qps": round(requests / wall, 2),
+        "p50_ms": round(p50, 1),
+        "p99_ms": round(p99, 1),
+        "ttft_p50_ms": round(t50, 1),
+        "ttft_p99_ms": round(t99, 1),
+        "batch_occupancy": st["batch_occupancy"],
+        "wall_s": round(wall, 2),
+    }
+
+
+def bench_serve(preset="gpt-small", slots=8, requests=64, prompt_len=64,
+                new_tokens=64, concurrency=32):
+    """Same load through a Serve replica handle: measures what a client
+    of the deployment sees (adds router + actor-call overhead)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    # replica __init__ compiles every engine specialization (warmup):
+    # give actor creation room beyond the 60 s default
+    ray_tpu.init(num_cpus=4,
+                 system_config={"actor_creation_timeout_s": 900.0})
+    serve.start()
+    app = serve.llm.build_app(preset=preset, num_slots=slots,
+                              max_concurrent_queries=concurrency * 2,
+                              max_seq_len=2 * (prompt_len + new_tokens),
+                              warmup_prompt_lens=[prompt_len])
+    handle = serve.run(app, name="llm-bench")
+    try:
+        # warm the replica's jit paths
+        ray_tpu.get(handle.remote({"prompt": [7] * prompt_len,
+                                   "max_new_tokens": 4}), timeout=600)
+        lats = []
+        t0 = time.monotonic()
+        done = 0
+        pending = {}
+        i = 0
+        while done < requests:
+            while len(pending) < concurrency and i < requests:
+                prompt = [(i * 37 + j) % 1000 + 1
+                          for j in range(prompt_len)]
+                ref = handle.remote({"prompt": prompt,
+                                     "max_new_tokens": new_tokens,
+                                     "temperature": 0.8})
+                pending[ref] = time.monotonic()
+                i += 1
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                    timeout=600)
+            for r in ready:
+                out = ray_tpu.get(r)
+                assert len(out["tokens"]) == new_tokens
+                lats.append(time.monotonic() - pending.pop(r))
+                done += 1
+        wall = time.monotonic() - t0
+        p50, p99 = _percentiles(lats)
+        return {
+            "metric": "serve_llm_handle",
+            "preset": preset,
+            "num_slots": slots,
+            "requests": requests,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "tokens_per_s": round(requests * new_tokens / wall, 1),
+            "qps": round(requests / wall, 2),
+            "p50_ms": round(p50, 1),
+            "p99_ms": round(p99, 1),
+            "wall_s": round(wall, 2),
+        }
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="gpt-small")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--engine-only", action="store_true")
+    args = ap.parse_args()
+
+    row = bench_engine(args.preset, args.slots, args.requests,
+                       args.prompt_len, args.new_tokens)
+    print(json.dumps(row))
+    sys.stdout.flush()
+    if not args.engine_only:
+        row = bench_serve(args.preset, args.slots, args.requests,
+                          args.prompt_len, args.new_tokens)
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

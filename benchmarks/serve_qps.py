@@ -12,14 +12,10 @@ Prints one JSON line per scenario:
 import json
 import time
 
-
-def _percentiles(lat_s):
-    lat = sorted(lat_s)
-
-    def pct(p):
-        return lat[min(len(lat) - 1, int(p / 100 * len(lat)))] * 1000.0
-
-    return pct(50), pct(99)
+try:
+    from benchmarks._bench_util import percentiles as _percentiles
+except ImportError:          # run as a script from benchmarks/
+    from _bench_util import percentiles as _percentiles
 
 
 def bench_handle(handle, n_warm=100, n=1000, concurrency=32):

@@ -20,13 +20,7 @@ from ray_tpu.models.configs import TransformerConfig
 from ray_tpu.models.gpt import GPT
 
 
-def sample_logits(rng: jax.Array, logits: jax.Array, *,
-                  temperature: float = 1.0, top_k: int = 0,
-                  top_p: float = 1.0) -> jax.Array:
-    """Sample token ids from [B, V] logits (greedy when temperature == 0)."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
-    logits = logits / temperature
+def _trim_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -38,7 +32,40 @@ def sample_logits(rng: jax.Array, logits: jax.Array, *,
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits, axis=-1)
+    return logits
+
+
+def sample_logits(rng: jax.Array, logits: jax.Array, *,
+                  temperature=1.0, top_k: int = 0,
+                  top_p: float = 1.0) -> jax.Array:
+    """Sample token ids from [B, V] logits (greedy when temperature == 0).
+
+    ``temperature`` may be a scalar or a per-row [B] array — the
+    continuous-batching engine mixes greedy and sampled requests in one
+    batch, so greedy rows (temperature 0) select argmax under the same
+    trace."""
+    if isinstance(temperature, (int, float)):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(
+            rng, _trim_logits(logits / temperature, top_k, top_p), axis=-1)
+    temps = jnp.asarray(temperature)
+    greedy = jnp.argmax(logits, axis=-1)
+    safe_t = jnp.where(temps > 0, temps, 1.0)
+    trimmed = _trim_logits(logits / safe_t[:, None], top_k, top_p)
+    sampled = jax.random.categorical(rng, trimmed, axis=-1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+def init_decode_cache(model, batch_size: int):
+    """Zeroed KV cache for a decode-mode model, built from shapes alone
+    (eval_shape — no second copy of the parameters is materialized).
+    Shared by Generator and the serving engine (serve/llm_engine.py)."""
+    tokens = jnp.zeros((batch_size, 1), jnp.int32)
+    abstract = jax.eval_shape(
+        lambda t: model.init(jax.random.PRNGKey(0), t), tokens)
+    return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype),
+                        abstract["cache"])
 
 
 class Generator:
@@ -69,13 +96,7 @@ class Generator:
         self._step = jax.jit(step, donate_argnums=(1,))
 
     def init_cache(self, batch_size: int):
-        """Zeroed KV cache built from shapes alone (eval_shape — no second
-        copy of the parameters is ever materialized)."""
-        tokens = jnp.zeros((batch_size, 1), jnp.int32)
-        abstract = jax.eval_shape(
-            lambda t: self.model.init(jax.random.PRNGKey(0), t), tokens)
-        return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype),
-                            abstract["cache"])
+        return init_decode_cache(self.model, batch_size)
 
     def generate(self, prompt_tokens, *, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_k: int = 0,

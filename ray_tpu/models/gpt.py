@@ -144,7 +144,7 @@ class Attention(nn.Module):
         k = apply_rope(k, cos, sin, positions)
 
         if self.decode:
-            out = self._decode_attend(q, k, v)
+            out = self._decode_attend(q, k, v, positions)
         else:
             out = self._train_attend(q, k, v)
         out = out.reshape(*out.shape[:2], h * hd)
@@ -175,8 +175,18 @@ class Attention(nn.Module):
         from ray_tpu.ops.attention import attention
         return attention(q, k, v, causal=True, impl=impl)
 
-    def _decode_attend(self, q, k, v):
-        """Append to the KV cache and attend (cache collection vars)."""
+    def _decode_attend(self, q, k, v, positions):
+        """Write K/V into the cache at per-row positions and attend under
+        a position mask.
+
+        ``positions`` [B, T] are the absolute positions of the q tokens;
+        each row's T positions must be contiguous starting at
+        ``positions[:, 0]`` but ROWS MAY SIT AT DIFFERENT OFFSETS — the
+        property continuous batching needs (serve/llm_engine.py: each
+        batch row is an independent request mid-decode).  The uniform
+        case (Generator.generate) is positions = full(pos); when
+        positions is None the scalar cache index drives a uniform step,
+        the pre-slot behavior."""
         cfg = self.cfg
         b = q.shape[0]
         ck = self.variable("cache", "k", jnp.zeros,
@@ -190,13 +200,24 @@ class Attention(nn.Module):
             # shape-only pass: leave the cache untouched (flax convention —
             # a cache write here would leave index advanced before decoding)
             return xla_attention(q, k, v, causal=True)
-        cur = idx.value
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k.astype(cfg.dtype),
-                                                (0, cur, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v.astype(cfg.dtype),
-                                                (0, cur, 0, 0))
-        idx.value = cur + q.shape[1]
-        return xla_attention(q, ck.value, cv.value, causal=True, q_offset=cur)
+        if positions is None:
+            positions = idx.value + jnp.broadcast_to(
+                jnp.arange(q.shape[1]), (b, q.shape[1]))
+
+        def _row_write(cache_row, new_row, p):
+            return jax.lax.dynamic_update_slice(cache_row, new_row, (p, 0, 0))
+
+        write_pos = positions[:, 0]
+        ck.value = jax.vmap(_row_write)(ck.value, k.astype(cfg.dtype),
+                                        write_pos)
+        cv.value = jax.vmap(_row_write)(cv.value, v.astype(cfg.dtype),
+                                        write_pos)
+        idx.value = jnp.max(positions) + 1
+        # key j is visible to the query at absolute position p iff j <= p
+        # (equivalent to the old q_offset causal mask when rows align)
+        k_idx = jnp.arange(cfg.max_seq_len)
+        mask = k_idx[None, None, None, :] <= positions[:, None, :, None]
+        return xla_attention(q, ck.value, cv.value, causal=False, mask=mask)
 
 
 class Block(nn.Module):

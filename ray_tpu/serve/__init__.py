@@ -19,6 +19,15 @@ from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle
 
+
+def __getattr__(name):
+    # serve.llm pulls jax (the engine); load it only when asked for so
+    # plain serve users keep the fast no-jax import
+    if name == "llm":
+        import importlib
+        return importlib.import_module("ray_tpu.serve.llm")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "start", "run", "shutdown", "delete", "status", "deployment",
     "Deployment", "Application", "DeploymentHandle", "batch",

@@ -1,0 +1,585 @@
+"""Continuous-batching LLM inference engine (the TPU serving core).
+
+The reference serves LLMs by scaling replicas and batching whole requests
+(`python/ray/serve/batching.py`); its Serve LLM benchmark surface is
+llama-3-8b qps/p50/p99 (BASELINE.md north-star row).  On TPU the win is
+*iteration-level* scheduling (Orca-style): one jitted decode step over a
+fixed slot grid, with requests admitted into free KV-cache slots and
+evicted the step they finish — no compile-shape churn, no head-of-line
+blocking behind a long generation.
+
+Design (shaped by one hard constraint: on a remote-chip transport every
+device->host fetch costs a full round-trip that outweighs a decode step
+~12x, so the engine does exactly ONE fetch per scheduling quantum):
+
+  - The KV cache is one global [num_slots+1, max_seq, ...] buffer per
+    layer (gpt.py ``_decode_attend`` slot mode: per-row write positions
+    + a position mask, so every row sits at a different offset).  Row
+    ``num_slots`` is a scratch slot that absorbs padded admission
+    writes; it is never scheduled.
+  - Prefill runs per admission WAVE: prompts sharing a power-of-two
+    length bucket run as one batched forward (one compile per
+    (bucket, wave-size) pair), each first token is sampled inside the
+    same jit, and the prompt K/V blocks are scattered into their slots
+    in one call.  Right-pad garbage beyond a real prompt length is
+    always overwritten by a decode write before the position mask makes
+    it visible, so padding needs no extra masking.
+  - One jitted ``block step`` advances ALL slots ``block_size`` tokens
+    via lax.scan: [N] tokens in, [N, K] tokens out, donated cache.
+    Newly admitted slots get their first token scattered in on-device
+    (the host never sees it before dispatch), and the block output and
+    the admission first-tokens come back in a single combined fetch.
+  - No eos logic on device: rows that finish mid-block keep generating
+    junk the host truncates; a freed slot keeps stepping junk until
+    it is reused (the grid is fixed — those steps are free).
+  - Per-request temperature rides as an [N] array (greedy rows select
+    argmax under the same jit); top_k/top_p are engine-static.
+
+The host loop owns admission/eviction and runs on a plain thread;
+``submit`` is loop-aware like serve's ``_BatchQueue.submit`` (awaitable
+from an async replica, blocking from a plain thread).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.configs import TransformerConfig
+from ray_tpu.models.gpt import GPT
+
+# admission waves are padded to the next of these sizes (bounded jit
+# specializations per prompt bucket)
+_WAVE_SIZES = (1, 2, 4, 8)
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    tokens: List[int]
+    finish_reason: str                    # "eos" | "length"
+    prompt_len: int
+    time_to_first_token_s: float
+    latency_s: float
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float
+    eos_id: Optional[int]
+    deliver: Callable[[bool, Any], None]
+    on_token: Optional[Callable[[int], None]]
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    delivered: bool = False
+
+
+class _Slot:
+    __slots__ = ("request", "pos", "out", "last_token", "first_token_at")
+
+    def __init__(self, request: _Request, prompt_len: int, first_token: int):
+        self.request = request
+        self.pos = prompt_len            # next write position
+        self.out = [first_token]
+        self.last_token = first_token
+        self.first_token_at = time.monotonic()
+
+
+class EngineStats:
+    """Occupancy / throughput counters, read by benchmarks and /stats."""
+
+    def __init__(self):
+        self.steps = 0                   # decode steps executed (N-wide)
+        self.step_tokens = 0             # tokens delivered from steps
+        self.tokens_generated = 0        # + prefill first tokens
+        self.prefills = 0
+        self.requests_completed = 0
+
+    def occupancy(self, num_slots: int) -> float:
+        """Fraction of step-slots that produced a delivered token (junk
+        decoded past eos / on freed slots counts against it)."""
+        return (self.step_tokens / (self.steps * num_slots)
+                if self.steps else 0.0)
+
+    def snapshot(self, num_slots: int) -> dict:
+        return {
+            "steps": self.steps,
+            "tokens_generated": self.tokens_generated,
+            "prefills": self.prefills,
+            "requests_completed": self.requests_completed,
+            "batch_occupancy": round(self.occupancy(num_slots), 4),
+        }
+
+
+class LLMEngine:
+    """Slot-scheduled KV-cache decoder around a GPT-family checkpoint."""
+
+    def __init__(self, cfg: TransformerConfig, params, *,
+                 num_slots: int = 8, max_prompt_len: Optional[int] = None,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 min_prefill_bucket: int = 16, block_size: int = 32,
+                 max_seq_len: Optional[int] = None):
+        # Inference engine owns its own copies of the knobs a server
+        # tunes independently of training:
+        #  - max_seq_len: the KV allocation AND the per-step attention
+        #    read span.  Decode attends over the whole cache row every
+        #    step, so serving 128-token chats with a 8192-long cache
+        #    reads 64x more HBM than needed — size it to the workload.
+        #  - dtype: params are cast to the activation dtype once here;
+        #    serving never needs f32 master weights, and keeping them
+        #    would re-cast (and re-read) the full parameter set every
+        #    decode step.
+        if max_seq_len is not None:
+            cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
+        self.cfg = cfg
+        self.params = jax.tree.map(
+            lambda p: p.astype(cfg.dtype) if hasattr(p, "astype") else p,
+            params)
+        self.num_slots = num_slots
+        self.top_k = top_k
+        self.top_p = top_p
+        self.max_prompt_len = max_prompt_len or cfg.max_seq_len // 2
+        self._min_bucket = min_prefill_bucket
+        self.block_size = block_size
+        self.model = GPT(cfg, decode=True)
+        self.stats = EngineStats()
+
+        self._rng = jax.random.PRNGKey(seed)
+        self._lock = threading.Condition()
+        self._pending: collections.deque = collections.deque()
+        self._slots: List[Optional[_Slot]] = [None] * num_slots
+        self._free: List[int] = list(range(num_slots))[::-1]
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+        # +1 scratch row absorbing padded admission writes
+        self._rows = num_slots + 1
+        self._cache = self._init_cache(self._rows)
+        # decode state lives ON DEVICE between quanta (tokens, positions,
+        # temps, rng): the host uploads only the small admit arrays, and
+        # only when something was admitted
+        self._state = self._init_state(seed)
+        # packed admit metadata [3, num_slots]: slots row, positions row,
+        # temps*1e6 row — one upload per quantum, cached when empty
+        no_meta = np.zeros((3, num_slots), np.int32)
+        no_meta[0, :] = num_slots                           # -> scratch
+        self._no_admit = (jnp.asarray(no_meta),
+                          jnp.zeros((num_slots,), jnp.int32))
+        self._prefill_jit: dict = {}      # (bucket, wave) -> jitted fn
+        self._insert_jit: dict = {}       # (bucket, wave) -> jitted fn
+        self._block_jit = jax.jit(self._block_fn,
+                                  donate_argnums=(1, 2))
+
+    # ------------------------------------------------------------ jit fns
+
+    def _init_cache(self, batch):
+        from ray_tpu.models.generate import init_decode_cache
+        return init_decode_cache(self.model, batch)
+
+    def _init_state(self, seed: int):
+        return (jnp.zeros((self._rows,), jnp.int32),      # tokens
+                jnp.zeros((self._rows,), jnp.int32),      # positions
+                jnp.zeros((self._rows,), jnp.float32),    # temps
+                jax.random.PRNGKey(seed))                 # device rng
+
+    def _sample_fn(self, rng, logits, temps):
+        """[B, V] logits + per-row temperature -> [B] token ids
+        (models/generate.py sample_logits, array-temperature form)."""
+        from ray_tpu.models.generate import sample_logits
+        return sample_logits(rng, logits, temperature=temps,
+                             top_k=self.top_k, top_p=self.top_p)
+
+    def _get_prefill(self, bucket: int, wave: int):
+        fn = self._prefill_jit.get((bucket, wave))
+        if fn is None:
+            def prefill(params, packed, rng):
+                # packed [wave, bucket+3]: right-padded prompt tokens,
+                # then s_real, slot, temp*1e6 (single upload).  Per-row
+                # last REAL logit selected by s_real; first tokens
+                # sampled here so admission needs no host round-trip.
+                tokens = packed[:, :bucket]
+                s_reals = packed[:, bucket]
+                slots = packed[:, bucket + 1]
+                temps = packed[:, bucket + 2].astype(jnp.float32) / 1e6
+                b, s = tokens.shape
+                positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+                cache = self._init_cache(b)
+                logits, mut = self.model.apply(
+                    {"params": params, "cache": cache}, tokens, positions,
+                    mutable=["cache"])
+                last = jnp.take_along_axis(
+                    logits, (s_reals - 1)[:, None, None], axis=1)[:, 0]
+                first = self._sample_fn(rng, last, temps)
+                return first, mut["cache"], slots
+            fn = self._prefill_jit[(bucket, wave)] = jax.jit(prefill)
+        return fn
+
+    def _get_insert(self, bucket: int, wave: int):
+        fn = self._insert_jit.get((bucket, wave))
+        if fn is None:
+            def insert(cache, pre, slots):
+                # scatter each prefilled row's first `bucket` positions
+                # into its slot; padded rows carry slot == num_slots
+                # (the scratch row)
+                def leaf(g, p):
+                    # K/V leaves are [..., batch, seq, kv_heads, head_dim]
+                    # (a leading layer axis under scan_layers): the batch
+                    # axis sits at ndim-4 BY LAYOUT, never inferred from
+                    # shapes — wave can equal the global row count.
+                    # Lower-rank leaves (per-layer scalar "index") are
+                    # engine-unused in slot mode: skip.
+                    if g.ndim < 4:
+                        return g
+                    ax = g.ndim - 4
+                    for r in range(wave):
+                        row = jax.lax.slice_in_dim(p, r, r + 1, axis=ax)
+                        row = jax.lax.slice_in_dim(row, 0, bucket,
+                                                   axis=ax + 1)
+                        start = [jnp.int32(0)] * g.ndim
+                        start[ax] = slots[r]
+                        g = jax.lax.dynamic_update_slice(g, row, start)
+                    return g
+                return jax.tree.map(leaf, cache, pre)
+            fn = self._insert_jit[(bucket, wave)] = jax.jit(
+                insert, donate_argnums=(0,))
+        return fn
+
+    def _block_fn(self, params, cache, state, admit_meta, a_firsts):
+        """lax.scan of block_size decode steps: one dispatch, ONE
+        combined [rows*K + num_slots] fetch of (token block, admission
+        first tokens), and all decode state chained on device.  Newly
+        admitted rows' tokens/positions/temps are scattered in here;
+        admit_meta is one packed [3, num_slots] i32 upload (slots,
+        positions, temps*1e6), padded so every quantum reuses one
+        compiled program (pad slots point at the scratch row)."""
+        tokens, positions, temps, rng = state
+        a_slots = admit_meta[0]
+        tokens = tokens.at[a_slots].set(a_firsts)
+        positions = positions.at[a_slots].set(admit_meta[1])
+        temps = temps.at[a_slots].set(
+            admit_meta[2].astype(jnp.float32) / 1e6)
+        rng, sub = jax.random.split(rng)
+        keys = jax.random.split(sub, self.block_size)
+
+        def one(carry, key):
+            tokens, positions, cache = carry
+            logits, mut = self.model.apply(
+                {"params": params, "cache": cache}, tokens[:, None],
+                positions[:, None], mutable=["cache"])
+            nxt = self._sample_fn(key, logits[:, -1], temps)
+            positions = jnp.minimum(positions + 1,
+                                    self.cfg.max_seq_len - 1)
+            return (nxt, positions, mut["cache"]), nxt
+
+        (tokens, positions, cache), block = jax.lax.scan(
+            one, (tokens, positions, cache), keys)
+        combined = jnp.concatenate([block.T.reshape(-1), a_firsts])
+        return combined, (tokens, positions, temps, rng), cache
+
+    # ------------------------------------------------------------- public
+
+    def warmup(self, prompt_lens=(64,)) -> None:
+        """Compile every jit specialization the given prompt lengths can
+        hit (all admission wave sizes per bucket + the block program) so
+        no request pays compile latency.  Serve replicas call this at
+        init; benchmarks call it before timing."""
+        buckets = sorted({self._bucket(n) for n in prompt_lens})
+        rng = jax.random.PRNGKey(0)
+        for bucket in buckets:
+            for wave in _WAVE_SIZES:
+                packed = np.zeros((wave, bucket + 3), np.int32)
+                packed[:, bucket] = 1
+                packed[:, bucket + 1] = self.num_slots      # scratch
+                firsts, pre, slots = self._get_prefill(bucket, wave)(
+                    self.params, jnp.asarray(packed), rng)
+                self._cache = self._get_insert(bucket, wave)(
+                    self._cache, pre, slots)
+        combined, self._state, self._cache = self._block_jit(
+            self.params, self._cache, self._state, *self._no_admit)
+        np.asarray(combined)   # force completion (and the compile)
+
+    def submit(self, prompt: List[int], *, max_new_tokens: int = 32,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               on_token: Optional[Callable[[int], None]] = None):
+        """Enqueue one generation request.
+
+        From inside a running event loop returns an awaitable resolving
+        to a GenerationResult (async serve replicas); from a plain
+        thread blocks and returns the result (drivers, benchmarks)."""
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.max_prompt_len:
+            raise ValueError(f"prompt len {len(prompt)} > max_prompt_len "
+                             f"{self.max_prompt_len}")
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            fut = loop.create_future()
+
+            def deliver(ok, value, _loop=loop, _fut=fut):
+                def _set():
+                    if _fut.done():
+                        return
+                    (_fut.set_result if ok else _fut.set_exception)(value)
+                _loop.call_soon_threadsafe(_set)
+
+            self._enqueue(_Request(list(prompt), max_new_tokens,
+                                   temperature, eos_id, deliver, on_token))
+            return fut
+        ev = threading.Event()
+        out: dict = {}
+
+        def deliver(ok, value):
+            out["ok" if ok else "err"] = value
+            ev.set()
+
+        self._enqueue(_Request(list(prompt), max_new_tokens, temperature,
+                               eos_id, deliver, on_token))
+        ev.wait()
+        if "err" in out:
+            raise out["err"]
+        return out["ok"]
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    # -------------------------------------------------------- engine loop
+
+    def _enqueue(self, req: _Request):
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("engine closed")
+            self._pending.append(req)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+            self._lock.notify()
+
+    def _bucket(self, n: int) -> int:
+        b = self._min_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.cfg.max_seq_len)
+
+    def _next_key(self):
+        self._rng, key = jax.random.split(self._rng)
+        return key
+
+    def _dispatch_admission_wave(self, group: list, bucket: int):
+        """One batched prefill + one batched cache insert for admits
+        sharing a prompt-length bucket.  Returns the DEVICE array of
+        their first tokens — nothing is fetched here, and everything
+        rides ONE packed upload (each host->device transfer is a
+        round-trip on a remote-chip transport)."""
+        wave = next(w for w in _WAVE_SIZES if w >= len(group))
+        # packed layout per row: [prompt(bucket) | s_real | slot | temp*1e6]
+        packed = np.zeros((wave, bucket + 3), np.int32)
+        packed[:, bucket] = 1
+        packed[:, bucket + 1] = self.num_slots        # pad rows: scratch
+        for r, (req, slot) in enumerate(group):
+            packed[r, :len(req.prompt)] = req.prompt
+            packed[r, bucket] = len(req.prompt)
+            packed[r, bucket + 1] = slot
+            packed[r, bucket + 2] = int(req.temperature * 1e6)
+        firsts, pre_cache, slots = self._get_prefill(bucket, wave)(
+            self.params, jnp.asarray(packed), self._next_key())
+        self._cache = self._get_insert(bucket, wave)(
+            self._cache, pre_cache, slots)
+        self.stats.prefills += len(group)
+        return firsts[:len(group)]
+
+    def _finish_admit(self, req: _Request, slot: int, first: int):
+        self.stats.tokens_generated += 1
+        sl = _Slot(req, len(req.prompt), first)
+        self._slots[slot] = sl
+        if req.on_token is not None:
+            self._safe_on_token(req, first)
+        # a 1-token request (or instant eos) finishes without stepping
+        self._maybe_finish(slot)
+
+    def _safe_on_token(self, req: _Request, token: int):
+        try:
+            req.on_token(token)
+        except Exception:       # user callback; never kills the loop
+            pass
+
+    @staticmethod
+    def _safe_deliver(req: _Request, ok: bool, value) -> None:
+        """Exactly-once, exception-proof completion: a client whose
+        event loop already closed (or a fatal-path retry of an already
+        completed request) must never poison the engine loop or steal
+        other submitters' deliveries."""
+        if req.delivered:
+            return
+        req.delivered = True
+        try:
+            req.deliver(ok, value)
+        except Exception:
+            pass
+
+    def _maybe_finish(self, i: int) -> bool:
+        sl = self._slots[i]
+        req = sl.request
+        reason = None
+        if req.eos_id is not None and sl.last_token == req.eos_id:
+            reason = "eos"
+        elif len(sl.out) >= req.max_new_tokens:
+            reason = "length"
+        elif sl.pos + 1 >= self.cfg.max_seq_len:
+            reason = "length"
+        if reason is None:
+            return False
+        now = time.monotonic()
+        result = GenerationResult(
+            tokens=sl.out, finish_reason=reason,
+            prompt_len=sl.pos - len(sl.out) + 1,
+            time_to_first_token_s=sl.first_token_at - req.submitted_at,
+            latency_s=now - req.submitted_at)
+        self._slots[i] = None
+        self._free.append(i)
+        self.stats.requests_completed += 1
+        self._safe_deliver(req, True, result)
+        return True
+
+    def _loop(self):
+        # Software-pipelined: quantum k+1 is DISPATCHED before quantum
+        # k's results are fetched and processed, so the device never
+        # idles on the host's fetch round-trip or bookkeeping.  The
+        # price is a one-block admission/eviction lag, which the
+        # request-identity checks in _process_quantum make safe.
+        inflight = None
+        while True:
+            with self._lock:
+                while (not self._closed and not self._pending
+                       and all(s is None for s in self._slots)
+                       and inflight is None):
+                    self._lock.wait()
+                if self._closed:
+                    victims = ([s.request for s in self._slots
+                                if s is not None]
+                               + ([r for r, _ in inflight[1]]
+                                  if inflight else [])
+                               + list(self._pending))
+                    self._pending.clear()
+                    for req in victims:
+                        self._safe_deliver(
+                            req, False,
+                            RuntimeError("engine closed"))
+                    return
+                admits = []
+                while self._pending and self._free:
+                    admits.append((self._pending.popleft(),
+                                   self._free.pop()))
+            try:
+                nxt = self._dispatch_quantum(admits, inflight)
+                if inflight is not None:
+                    self._process_quantum(inflight)
+                inflight = nxt
+            except Exception as e:   # engine-fatal (OOM, compile error)
+                with self._lock:
+                    victims = ([s.request for s in self._slots
+                                if s is not None]
+                               + [a[0] for a in admits]
+                               + ([r for r, _ in inflight[1]]
+                                  if inflight else [])
+                               + list(self._pending))
+                    self._pending.clear()
+                    self._slots = [None] * self.num_slots
+                    self._free = list(range(self.num_slots))[::-1]
+                inflight = None
+                # the block/insert calls donate the cache and device
+                # state: after a failed call the old buffers may be
+                # deleted — rebuild before continuing
+                self._cache = self._init_cache(self._rows)
+                self._state = self._init_state(0)
+                for req in victims:
+                    self._safe_deliver(req, False, e)
+
+    def _dispatch_quantum(self, admits: list, inflight):
+        """Prefill + enqueue one decode block; returns (combined_device,
+        admitted, rows) or None when there is nothing to run.  ``rows``
+        snapshots (slot_index, request) pairs whose tokens this block
+        carries — including the PREVIOUS quantum's admissions, which are
+        decoding on device but not yet placed in _slots."""
+        admitted = []                      # (req, slot) in firsts order
+        firsts_parts = []
+        by_bucket: dict = {}
+        for req, slot in admits:
+            by_bucket.setdefault(self._bucket(len(req.prompt)),
+                                 []).append((req, slot))
+        for bucket, group in by_bucket.items():
+            for start in range(0, len(group), _WAVE_SIZES[-1]):
+                chunk = group[start:start + _WAVE_SIZES[-1]]
+                firsts_parts.append(
+                    self._dispatch_admission_wave(chunk, bucket))
+                admitted.extend(chunk)
+
+        rows = [(i, s.request) for i, s in enumerate(self._slots)
+                if s is not None]
+        if inflight is not None:
+            rows += [(slot, req) for req, slot in inflight[1]]
+        rows += [(slot, req) for req, slot in admitted]
+        if not rows:
+            return None
+        # decode state (tokens/positions/temps/rng) is device-chained;
+        # the host uploads one packed admit array, cached when empty
+        n_admit = len(admitted)
+        if n_admit:
+            A = self.num_slots
+            meta = np.zeros((3, A), np.int32)
+            meta[0, :] = self.num_slots
+            for r, (req, slot) in enumerate(admitted):
+                meta[0, r] = slot
+                meta[1, r] = len(req.prompt)
+                meta[2, r] = int(req.temperature * 1e6)
+            pad = jnp.zeros((A - n_admit,), jnp.int32)
+            admit_meta = jnp.asarray(meta)
+            admit_firsts = jnp.concatenate(firsts_parts + [pad])
+        else:
+            admit_meta, admit_firsts = self._no_admit
+        combined, self._state, self._cache = self._block_jit(
+            self.params, self._cache, self._state, admit_meta,
+            admit_firsts)
+        return (combined, admitted, rows)
+
+    def _process_quantum(self, quantum):
+        combined, admitted, rows = quantum
+        host = np.asarray(combined)        # the ONE fetch this quantum
+        K = self.block_size
+        block = host[:self._rows * K].reshape(self._rows, K)
+        self.stats.steps += K
+
+        # --- admissions complete (their first tokens are now known) ---
+        for (req, slot), first in zip(admitted, host[self._rows * K:]):
+            self._finish_admit(req, slot, int(first))
+        # --- block processing: truncate junk past each row's finish ---
+        for i, req in rows:
+            sl = self._slots[i]
+            if sl is None or sl.request is not req:
+                continue      # evicted earlier (or reused): junk row
+            for k in range(K):
+                tok = int(block[i, k])
+                sl.out.append(tok)
+                sl.last_token = tok
+                sl.pos += 1
+                self.stats.step_tokens += 1
+                self.stats.tokens_generated += 1
+                if sl.request.on_token is not None:
+                    self._safe_on_token(sl.request, tok)
+                if self._maybe_finish(i):
+                    break     # rest of the row is junk past eos
