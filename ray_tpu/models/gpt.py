@@ -270,12 +270,29 @@ class GPT(nn.Module):
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
             (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
-        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        lookup = embed
+        if self.mesh is not None and not self.decode:
+            # explicit all-gather of the sharded table before the lookup:
+            # left to itself the partitioner reshards the gather result
+            # via an involuntary full rematerialization (replicate, then
+            # repartition — a full-tensor broadcast on the step's hot
+            # path).  Constraining the operand makes the same transfer
+            # ONE clean all-gather and the gather itself local.
+            lookup = with_sharding(self.mesh, embed, (None, None),
+                                   self.rules)
+        x = jnp.take(lookup, tokens, axis=0).astype(cfg.dtype)
         if self.mesh is not None and not self.decode:
             x = with_sharding(self.mesh, x, ("batch", "seq", "act_embed"),
                               self.rules)
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                     cfg.rope_theta)
+        if self.mesh is not None and not self.decode:
+            # the rope tables are tiny closure constants: pin them
+            # replicated so the partitioner never invents a sharding for
+            # them (they otherwise surface as involuntarily
+            # rematerialized fake_parameters)
+            cos = with_sharding(self.mesh, cos, (None, None), self.rules)
+            sin = with_sharding(self.mesh, sin, (None, None), self.rules)
 
         x = stack_layers(
             Block, cfg,

@@ -30,13 +30,21 @@ class JaxConfig(BackendConfig):
     the rendezvous path.
     """
 
-    def __init__(self, init_distributed: bool = True):
+    def __init__(self, init_distributed: bool = True,
+                 platform: Optional[str] = None):
         self.init_distributed = init_distributed
+        # force a backend on the workers (e.g. "cpu" to rendezvous a
+        # multi-process gloo mesh in tests / on chipless hosts); None
+        # keeps whatever the worker environment selects (libtpu on pods)
+        self.platform = platform
 
     def on_start(self, worker_group: WorkerGroup,
                  scaling: ScalingConfig) -> None:
         if not self.init_distributed or scaling.num_workers <= 1:
             return
+        if self.platform:
+            worker_group.execute("set_env",
+                                 {"JAX_PLATFORMS": self.platform})
         ip = worker_group.execute_single(0, "get_node_ip")
         port = worker_group.execute_single(0, "find_free_port")
         coordinator = f"{ip}:{port}"

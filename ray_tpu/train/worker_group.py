@@ -70,6 +70,17 @@ class TrainWorker:
         TPU-native replacement for the reference's torch.distributed TCP
         rendezvous (train/torch/config.py:29). Returns local device count."""
         import jax
+        # re-pin the platform: set_env may have changed JAX_PLATFORMS
+        # after __init__ ran (plugin discovery overrides the plain env
+        # var, so the pin must go through jax.config)
+        plat = os.environ.get("JAX_PLATFORMS", "")
+        if plat:
+            jax.config.update("jax_platforms", plat)
+        if plat.split(",")[0] == "cpu":
+            # cross-process collectives on the CPU backend need an
+            # explicit implementation; harmless when single-process
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
         if self.world_size > 1:
             jax.distributed.initialize(
                 coordinator_address=coordinator,
