@@ -10,7 +10,13 @@ Prints one JSON line per scenario:
 """
 
 import json
+import os
+import sys
 import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 try:
     from benchmarks._bench_util import percentiles as _percentiles
@@ -69,6 +75,39 @@ def bench_overhead(handle, n_warm=50, n=300):
     return sp50 - bp50, bp50, sp50, sp99
 
 
+def bench_http_floor(port, n=400, concurrency=16):
+    """Same client load against the proxy's /-/healthz (no serve hop):
+    what the aiohttp client+server pair alone costs on this box — the
+    denominator for judging the serve overhead in bench_http."""
+    import asyncio
+
+    import aiohttp
+
+    async def run():
+        url = f"http://127.0.0.1:{port}/-/healthz"
+        lats = []
+        async with aiohttp.ClientSession() as sess:
+            async def one():
+                t0 = time.monotonic()
+                async with sess.get(url) as resp:
+                    await resp.read()
+                lats.append(time.monotonic() - t0)
+
+            sem = asyncio.Semaphore(concurrency)
+
+            async def bounded():
+                async with sem:
+                    await one()
+
+            t0 = time.monotonic()
+            await asyncio.gather(*[bounded() for _ in range(n)])
+            elapsed = time.monotonic() - t0
+        p50, p99 = _percentiles(lats)
+        return n / elapsed, p50, p99
+
+    return asyncio.run(run())
+
+
 def bench_http(port, n_warm=50, n=500, concurrency=16):
     """aiohttp client closed-loop against the proxy."""
     import asyncio
@@ -125,11 +164,19 @@ def main():
                       "serve_p50_ms": round(serve_p50, 2),
                       "serve_p99_ms": round(serve_p99, 2),
                       "reference": "1-2 ms serve overhead"}))
+    floor_qps, fp50, fp99 = bench_http_floor(18230)
+    print(json.dumps({"metric": "serve_http_floor_qps",
+                      "value": round(floor_qps, 1),
+                      "p50_ms": round(fp50, 2), "p99_ms": round(fp99, 2),
+                      "note": "aiohttp client+server alone (healthz), "
+                              "same box/concurrency — the transport "
+                              "ceiling the serve rows sit under"}))
     http_qps, hp50, hp99 = bench_http(18230)
     print(json.dumps({"metric": "serve_http_qps",
                       "value": round(http_qps, 1),
                       "p50_ms": round(hp50, 2), "p99_ms": round(hp99, 2),
-                      "reference": "~1.9k req/s microbenchmark"}))
+                      "reference": "~1.9k req/s microbenchmark (multi-core"
+                                   " box); single core here"}))
     serve.shutdown()
     ray_tpu.shutdown()
 

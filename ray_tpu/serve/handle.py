@@ -48,6 +48,7 @@ class DeploymentHandle:
         self._last_refresh = 0.0
         self._controller = None
         self._refreshing = False
+        self._worker_cache = None
 
     # ------------------------------------------------------------ plumbing
     def _get_controller(self):
@@ -179,6 +180,38 @@ class DeploymentHandle:
     # ------------------------------------------------------------ user API
     def remote(self, *args, **kwargs):
         return self._route("__call__", args, kwargs)
+
+    def try_remote(self, *args, **kwargs):
+        """One-shot non-blocking route: submit to a replica with spare
+        capacity, or return None (cold table, backpressure, vanished
+        replica).  Event-loop callers (the HTTP proxy) use this as the
+        fast path and fall back to the blocking ``remote`` in an
+        executor — so the common case never leaves the loop and the
+        congested case never stalls it."""
+        if not self._replicas:
+            return None
+        self._maybe_refresh_bg()
+        with self._lock:
+            replica = self._pick_replica()
+            if replica is None:
+                return None
+            self._inflight[replica] = self._inflight.get(replica, 0) + 1
+        try:
+            actor = self._actor_for(replica)
+            ref = actor.handle_request.remote("__call__", args, kwargs)
+        except Exception:
+            self._release(replica)
+            return None
+        self._worker().add_ready_callback(
+            ref, lambda r=replica: self._release(r))
+        return ref
+
+    def _worker(self):
+        w = self._worker_cache
+        if w is None:
+            from ray_tpu.runtime.core_worker import get_global_worker
+            w = self._worker_cache = get_global_worker()
+        return w
 
     def __getattr__(self, name: str) -> _SubHandle:
         if name.startswith("_"):

@@ -39,7 +39,11 @@ class HTTPProxyActor:
         return self._handles[deployment]
 
     def _serve(self):
+        import time as _time
+
         from aiohttp import web
+
+        from ray_tpu.runtime.core_worker import get_global_worker
 
         async def handle(request: web.Request) -> web.Response:
             deployment = request.match_info["deployment"]
@@ -52,18 +56,20 @@ class HTTPProxyActor:
                 payload = dict(request.query)
             loop = asyncio.get_running_loop()
 
-            # Submission runs in the executor (it can momentarily block on
-            # backpressure), but the thread is released immediately: the
-            # reply is awaited via an owned-object ready callback, so no
-            # thread is parked for the request's full duration (the
-            # reference's fully-async proxy→replica path).
-            def submit():
+            # Fast path stays ON the event loop end to end: non-blocking
+            # submit (try_remote), readiness via an owned-object ready
+            # callback, and an immediate local get once ready.  Executor
+            # hops happen only under backpressure (blocking admission)
+            # or when a large result needs a cross-node pull — the two
+            # cases that would otherwise stall every other request.
+            def submit_blocking():
                 return self._get_handle(deployment).remote(payload)
 
             try:
-                import time as _time
                 deadline = _time.monotonic() + 60.0
-                ref = await loop.run_in_executor(None, submit)
+                ref = self._get_handle(deployment).try_remote(payload)
+                if ref is None:        # cold table / backpressure
+                    ref = await loop.run_in_executor(None, submit_blocking)
                 fut = loop.create_future()
 
                 def _on_ready():
@@ -72,16 +78,18 @@ class HTTPProxyActor:
                             fut.set_result(None)
                     loop.call_soon_threadsafe(_resolve)
 
-                from ray_tpu.runtime.core_worker import get_global_worker
                 get_global_worker().add_ready_callback(ref, _on_ready)
                 # one 60 s budget end to end: readiness wait + the fetch
-                # (a large result may still need a cross-node pull, which
-                # must not run on the event loop)
                 await asyncio.wait_for(
                     fut, timeout=max(0.1, deadline - _time.monotonic()))
-                remaining = max(0.1, deadline - _time.monotonic())
-                result = await loop.run_in_executor(
-                    None, lambda: ray_tpu.get(ref, timeout=remaining))
+                try:
+                    # ready + inline/local result: returns without waiting
+                    result = ray_tpu.get(ref, timeout=0.05)
+                except ray_tpu.exceptions.GetTimeoutError:
+                    # store-resident result needing a pull: off the loop
+                    remaining = max(0.1, deadline - _time.monotonic())
+                    result = await loop.run_in_executor(
+                        None, lambda: ray_tpu.get(ref, timeout=remaining))
             except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500
                 return web.json_response(
                     {"error": type(e).__name__, "message": str(e)},
