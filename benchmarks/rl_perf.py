@@ -1,0 +1,92 @@
+"""RL north-star benchmark: PPO CartPole to reward 150.
+
+Counterpart of the reference's tuned example
+(rllib/tuned_examples/ppo/cartpole-ppo.yaml: episode_reward_mean >= 150
+within 100k env steps) — the second BASELINE.md north-star row.  Reports
+wall time to the target, env steps consumed, and learner throughput.
+
+  python benchmarks/rl_perf.py [--target 150] [--max-steps 100000]
+
+Prints one JSON line.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def run(target=150.0, max_steps=100_000, seed=0):
+    import ray_tpu
+    from ray_tpu.rl import PPOConfig
+
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=125)
+            .training(train_batch_size=1000, sgd_minibatch_size=250,
+                      num_sgd_iter=8, lr=3e-4, entropy_coeff=0.01,
+                      gamma=0.99)
+            .debugging(seed=seed)
+            .build())
+    t0 = time.monotonic()
+    reached_at_s = None
+    reached_at_steps = None
+    iters = 0
+    best = float("-inf")
+    sgd_steps = 0
+    try:
+        while True:
+            result = algo.train()
+            iters += 1
+            reward = result["episode_reward_mean"]
+            best = max(best, reward)
+            steps = result["timesteps_total"]
+            sgd_steps = result.get("num_sgd_steps_total", 0) or \
+                iters * 8 * (1000 // 250)
+            if reward >= target and reached_at_s is None:
+                reached_at_s = time.monotonic() - t0
+                reached_at_steps = steps
+                break
+            if steps >= max_steps:
+                break
+            print(f"  [rl] iter {iters}: reward {reward:.1f} "
+                  f"steps {steps}", flush=True)
+        wall = time.monotonic() - t0
+        return {
+            "metric": "rl_ppo_cartpole",
+            "target_reward": target,
+            "reached": reached_at_s is not None,
+            "time_to_target_s": (round(reached_at_s, 1)
+                                 if reached_at_s else None),
+            "env_steps_to_target": reached_at_steps,
+            "best_reward": round(best, 1),
+            "train_iters": iters,
+            "env_steps_total": steps,
+            "env_steps_per_s": round(steps / wall, 1),
+            "sgd_steps_per_s": round(sgd_steps / wall, 1),
+            "reference": "rllib tuned cartpole-ppo.yaml: reward 150 "
+                         "within 100k env steps",
+        }
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--target", type=float, default=150.0)
+    ap.add_argument("--max-steps", type=int, default=100_000)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    print(json.dumps(run(args.target, args.max_steps, args.seed)))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+# the envelope saturates this box for minutes at a time: give every
+# daemon scaled liveness patience (see config.timeout_scale) so the GCS
+# doesn't declare the loaded node dead mid-bench
+os.environ.setdefault("RAY_TPU_TIMEOUT_SCALE", "8.0")
+
 
 def bench_1m_queued_tasks(n=1_000_000, wave=25_000):
     import ray_tpu
@@ -91,30 +96,167 @@ def bench_1k_returns():
             "(release/benchmarks/README.md:28, 3k on 64-core)"}
 
 
-def bench_multi_gib_object(gib=2):
+def bench_multi_gib_object(gib=20):
+    """Cold AND warm put of one multi-GiB numpy object.
+
+    The two rows separate what they measure: cold is first-touch page
+    faults on a fresh tmpfs segment (paging-bound on VMs with on-demand
+    memory — nothing userspace can speed up, which is why the raylet
+    prefaults segments in the background); warm reuses the freed extent
+    — the steady-state throughput a long-lived cluster sees, and the
+    number comparable to the reference's plasma memcpy path."""
+    import gc
+
     import numpy as np
 
     import ray_tpu
+
+    # fit the box: segment + source array + headroom, all resident.
+    # Unknown free memory -> conservative, not maximal.
+    try:
+        free = os.sysconf("SC_AVPHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError):
+        free = 0
+    if not free:
+        gib = min(gib, 2)
+    while gib > 2 and (2 * gib + 6) * (1 << 30) > free:
+        gib //= 2
     size = gib * (1 << 30)
-    ray_tpu.init(num_cpus=2,
-                 object_store_memory=size + (1 << 30))
+    # prefault off: the cold row must measure a genuinely cold segment.
+    # spill threshold 1.0: a 95%-full store is the POINT here, not a
+    # pressure signal to spill the primary to disk mid-measurement.
+    ray_tpu.init(num_cpus=2, object_store_memory=size + (1 << 30),
+                 system_config={"object_store_prefault": False,
+                                "object_spill_threshold": 1.0})
+    from ray_tpu.runtime.core_worker import get_global_worker
+    raylet = get_global_worker()._raylet
+    n_elem = size // 8
+    arr = np.arange(n_elem, dtype=np.int64)   # position-sensitive payload
     t0 = time.monotonic()
-    arr = np.arange(size // 8, dtype=np.int64)
     ref = ray_tpu.put(arr)
-    put_s = time.monotonic() - t0
+    cold_put_s = time.monotonic() - t0
     t0 = time.monotonic()
     back = ray_tpu.get(ref, timeout=600)
     get_s = time.monotonic() - t0
-    ok = back.shape == arr.shape and back[0] == 0 \
-        and int(back[-1]) == size // 8 - 1 \
-        and int(back[size // 16]) == size // 16
-    del arr, back, ref
+    ok = back.shape == arr.shape and int(back[0]) == 0 \
+        and int(back[-1]) == n_elem - 1 \
+        and int(back[n_elem // 2]) == n_elem // 2
+    del back, ref
+    gc.collect()
+    # wait for the free so the warm put reuses the (now faulted) extent
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        st = raylet.call("store_stats", {}, timeout=10)
+        if st["bytes_in_use"] < (1 << 26):
+            break
+        time.sleep(0.2)
+    t0 = time.monotonic()
+    ref2 = ray_tpu.put(arr)
+    warm_put_s = time.monotonic() - t0
+    back2 = ray_tpu.get(ref2, timeout=600)
+    ok = ok and int(back2[-1]) == n_elem - 1 \
+        and int(back2[n_elem // 3]) == n_elem // 3
+    del arr, back2, ref2
     ray_tpu.shutdown()
     return {"name": "single_object_gib", "gib": gib,
-            "put_s": round(put_s, 2), "get_s": round(get_s, 2),
+            "cold_put_s": round(cold_put_s, 2),
+            "warm_put_s": round(warm_put_s, 2),
+            "warm_gib_per_s": round(gib / warm_put_s, 2),
+            "get_s": round(get_s, 2),
             "pass": bool(ok),
             "reference": "100 GiB objects on a 576 GB-RAM node "
-            "(release/benchmarks/README.md:30); scaled to this box"}
+            "(release/benchmarks/README.md:30); scaled to this box. "
+            "cold = VM first-touch paging floor; warm = steady state"}
+
+
+def bench_10k_object_batched_get(n=10_000, payload=1024):
+    """10k store objects fetched in ONE ray_tpu.get call."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, object_store_memory=512 * 1024 * 1024)
+    blob = b"x" * payload
+    t0 = time.monotonic()
+    refs = [ray_tpu.put((i, blob)) for i in range(n)]
+    put_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    vals = ray_tpu.get(refs, timeout=1800)
+    get_s = time.monotonic() - t0
+    ok = all(v[0] == i and len(v[1]) == payload
+             for i, v in enumerate(vals))
+    ray_tpu.shutdown()
+    return {"name": "batched_get_10k_objects", "count": n,
+            "payload_bytes": payload,
+            "put_s": round(put_s, 2), "get_s": round(get_s, 2),
+            "gets_per_s": round(n / get_s, 1), "pass": bool(ok),
+            "reference": "many-object get on one node "
+            "(release/benchmarks/README.md:27-29)"}
+
+
+def bench_1k_actors(n=1_000):
+    """1k live actors: create all, one round-trip call to each, kill."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                 system_config={"worker_start_timeout_s": 300.0,
+                                "actor_creation_timeout_s": 300.0})
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    t0 = time.monotonic()
+    actors = []
+    wave = 50
+    for s in range(0, n, wave):
+        batch = [A.remote(i) for i in range(s, min(s + wave, n))]
+        # laddered like the reference's many_actors release test: ready-
+        # wait each wave so spawn bursts don't trip start timeouts
+        ray_tpu.get([a.who.remote() for a in batch], timeout=1800)
+        actors += batch
+        print(f"  [1k-actors] {len(actors)}/{n}", flush=True)
+    create_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    vals = ray_tpu.get([a.who.remote() for a in actors], timeout=1800)
+    call_s = time.monotonic() - t0
+    ok = vals == list(range(n))
+    t0 = time.monotonic()
+    for a in actors:
+        ray_tpu.kill(a)
+    kill_s = time.monotonic() - t0
+    ray_tpu.shutdown()
+    return {"name": "actors_1k_registered_responding", "count": n,
+            "create_s": round(create_s, 1), "call_all_s": round(call_s, 1),
+            "kill_s": round(kill_s, 1), "pass": bool(ok),
+            "reference": "10k actors across 64 nodes "
+            "(release/benchmarks/README.md:10); one node here"}
+
+
+def bench_500_pgs(n=500):
+    """500 placement groups created, ready-waited, and removed."""
+    import ray_tpu
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    t0 = time.monotonic()
+    pgs = []
+    ok = True
+    for i in range(n):
+        pg = placement_group([{"CPU": 0.001}])
+        ok = ok and pg.wait(timeout_seconds=60)
+        pgs.append(pg)
+    create_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    for pg in pgs:
+        remove_placement_group(pg)
+    remove_s = time.monotonic() - t0
+    ray_tpu.shutdown()
+    return {"name": "placement_groups_500", "count": n,
+            "create_ready_s": round(create_s, 1),
+            "remove_s": round(remove_s, 1), "pass": bool(ok),
+            "reference": "1k placement groups across 64 nodes "
+            "(release/benchmarks/README.md:12); one node here"}
 
 
 def main():
@@ -126,7 +268,9 @@ def main():
 
     rows = []
     for fn in (lambda: bench_1m_queued_tasks(args.tasks),
-               bench_10k_args, bench_1k_returns, bench_multi_gib_object):
+               bench_10k_args, bench_1k_returns, bench_multi_gib_object,
+               bench_10k_object_batched_get, bench_1k_actors,
+               bench_500_pgs):
         print(f"[envelope] {fn}", flush=True)
         rows.append(fn())
         print(json.dumps(rows[-1]), flush=True)

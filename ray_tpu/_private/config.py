@@ -56,6 +56,15 @@ _declare("object_store_memory_bytes", int, 2 * 1024**3,
 _declare("object_store_fallback_dir", str, "",
          "Directory holding spilled-object files; empty means a spill_<node> "
          "dir inside the session dir (removed at raylet shutdown).")
+_declare("object_store_dir", str, "/dev/shm",
+         "Directory holding the shared-memory store segment (plasma "
+         "convention: tmpfs, so big writes never hit disk writeback). "
+         "Falls back to the session dir when missing or too small.")
+_declare("object_store_prefault", bool, True,
+         "Pre-touch the store segment's pages from a background thread at "
+         "raylet start.  First-touch faults can be an order of magnitude "
+         "slower than warm writes (VM memory ballooning / on-demand "
+         "paging); prefaulting moves that cost off the put critical path.")
 _declare("object_spill_threshold", float, 0.8,
          "Fraction of store capacity above which primary copies spill to disk.")
 _declare("object_spill_fault", str, "",
@@ -98,6 +107,10 @@ _declare("worker_pool_prestart", int, 0,
 _declare("worker_pool_max_idle", int, 8,
          "Max idle workers kept alive per node for lease reuse.")
 _declare("worker_start_timeout_s", float, 30.0, "Worker process start timeout.")
+_declare("worker_prefork", bool, True,
+         "Fork python workers from a warm zygote process (one heavy "
+         "interpreter+jax import per raylet instead of per worker). "
+         "Venv-interpreter and cpp workers always exec.")
 _declare("worker_lease_timeout_s", float, 30.0, "Worker lease RPC timeout.")
 _declare("task_retry_delay_ms", int, 100, "Delay before resubmitting a failed task.")
 _declare("max_direct_call_args_bytes", int, 100 * 1024,
@@ -106,6 +119,12 @@ _declare("heartbeat_period_ms", int, 250,
          "Node daemon -> GCS resource/liveness report period.")
 _declare("health_check_failure_threshold", int, 8,
          "Missed heartbeats before the GCS marks a node dead.")
+_declare("timeout_scale", float, 1.0,
+         "Multiplier applied to liveness/startup timeouts at resolution "
+         "time (the _SCALED flags below).  Loaded hosts — CI sharing one "
+         "core with the cluster under test — starve heartbeat threads "
+         "for seconds at a time; scaling the patience beats tuning each "
+         "timeout per box.  Set RAY_TPU_TIMEOUT_SCALE=4 in test envs.")
 _declare("gcs_rpc_timeout_s", float, 30.0, "Client->GCS RPC timeout.")
 _declare("gcs_snapshot_interval_s", float, 0.2,
          "Period of the GCS full-snapshot compaction tick (the WAL makes "
@@ -217,18 +236,28 @@ class Config:
         flag = _FLAG_TABLE.get(name)
         if flag is None:
             raise AttributeError(f"unknown ray_tpu config flag: {name!r}")
+        value = None
+        found = False
         with self._lock:
             if name in self._overrides:
                 value = self._overrides[name]
-                return copy.deepcopy(value) if isinstance(value, (dict, list)) else value
-        raw = os.environ.get(_ENV_PREFIX + name.upper())
-        if raw is not None:
-            try:
-                return flag.parse(raw)
-            except (ValueError, TypeError):
-                pass
-        default = flag.default
-        return copy.deepcopy(default) if isinstance(default, (dict, list)) else default
+                found = True
+        if not found:
+            raw = os.environ.get(_ENV_PREFIX + name.upper())
+            if raw is not None:
+                try:
+                    value = flag.parse(raw)
+                    found = True
+                except (ValueError, TypeError):
+                    pass
+        if not found:
+            value = flag.default
+        if name in _SCALED_FLAGS:
+            scale = self.timeout_scale
+            if scale != 1.0:
+                value = flag.type(value * scale)
+        return copy.deepcopy(value) if isinstance(value, (dict, list)) \
+            else value
 
     def set(self, name: str, value: Any) -> None:
         if name not in _FLAG_TABLE:
@@ -256,6 +285,20 @@ class Config:
     def snapshot(self) -> Dict[str, Any]:
         return {name: getattr(self, name) for name in _FLAG_TABLE}
 
+
+# liveness/startup patience flags timeout_scale multiplies (NOT data-
+# plane semantics like spill thresholds or batch waits)
+_SCALED_FLAGS = frozenset({
+    "health_check_failure_threshold",
+    "worker_start_timeout_s",
+    "worker_lease_timeout_s",
+    "actor_creation_timeout_s",
+    "gcs_rpc_timeout_s",
+    "raylet_rpc_timeout_s",
+    "fetch_fail_timeout_s",
+    "collective_rendezvous_timeout_s",
+    "collective_op_timeout_s",
+})
 
 CONFIG = Config()
 

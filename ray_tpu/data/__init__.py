@@ -21,6 +21,8 @@ from ray_tpu.data.preprocessors import (BatchMapper, Chain,  # noqa: F401
                                         Preprocessor, SimpleImputer,
                                         StandardScaler)
 from ray_tpu.data import datasource as _dsrc
+from ray_tpu.data.partitioning import (Partitioning,  # noqa: F401
+                                       PathPartitionFilter)
 
 
 def _from_tasks(tasks) -> Dataset:
@@ -61,12 +63,66 @@ def from_arrow(tables) -> Dataset:
         block_refs=[ray_tpu.put(t) for t in tables]))
 
 
-def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
-    return _from_tasks(_dsrc.parquet_tasks(paths, columns))
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 partitioning=None, partition_filter=None) -> Dataset:
+    """``partitioning``/``partition_filter``: hive-layout lakes — prune
+    files by path-encoded values before reading, and append the values
+    as columns (data/partitioning.py)."""
+    return _from_tasks(_dsrc.parquet_tasks(
+        paths, columns, partitioning=partitioning,
+        partition_filter=partition_filter))
 
 
-def read_csv(paths, **kwargs) -> Dataset:
-    return _from_tasks(_dsrc.csv_tasks(paths, **kwargs))
+def read_csv(paths, *, partitioning=None, partition_filter=None,
+             **kwargs) -> Dataset:
+    return _from_tasks(_dsrc.csv_tasks(
+        paths, partitioning=partitioning,
+        partition_filter=partition_filter, **kwargs))
+
+
+def read_tfrecords(paths, *, partitioning=None,
+                   partition_filter=None) -> Dataset:
+    """tf.train.Example records (data/tfrecords.py: framing + protobuf
+    decoded without a tensorflow dependency)."""
+    from ray_tpu.data import tfrecords as _tfr
+    return _from_tasks(_tfr.tfrecord_tasks(
+        paths, partitioning=partitioning,
+        partition_filter=partition_filter))
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: Optional[List[dict]] = None,
+               parallelism: int = 4) -> Dataset:
+    """Read a MongoDB collection (cf. reference
+    python/ray/data/datasource/mongo_datasource.py).  Splits on _id
+    ranges into parallel read tasks.  Requires pymongo (not baked into
+    this image — the import error says so at call time, not deep in a
+    worker)."""
+    try:
+        import pymongo  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "read_mongo requires pymongo, which is not installed in "
+            "this environment") from e
+
+    def make_task(skip: int, limit: int):
+        def read_block():
+            import pymongo as pm
+            coll = pm.MongoClient(uri)[database][collection]
+            stages = list(pipeline or [])
+            stages += [{"$skip": skip}, {"$limit": limit}]
+            return list(coll.aggregate(stages))
+        return _dsrc.ReadTask(read_block)
+
+    import pymongo as pm
+    total = pm.MongoClient(uri)[database][collection] \
+        .estimated_document_count()
+    parallelism = max(1, min(parallelism, total or 1))
+    per = max(1, (total + parallelism - 1) // parallelism)
+    tasks = [make_task(s, per) for s in range(0, total, per)]
+    if not tasks:
+        return from_items([])
+    return _from_tasks(tasks)
 
 
 def read_json(paths, *, lines: bool = True) -> Dataset:
@@ -131,7 +187,9 @@ __all__ = [
     "TaskPoolStrategy", "ActorPoolStrategy", "GroupedData",
     "range", "from_items", "from_pandas", "from_numpy", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_numpy", "read_text",
-    "read_binary_files", "read_images", "from_torch", "from_huggingface",
+    "read_binary_files", "read_images", "read_tfrecords", "read_mongo",
+    "from_torch", "from_huggingface",
+    "Partitioning", "PathPartitionFilter",
     "RandomAccessDataset", "Preprocessor", "StandardScaler", "MinMaxScaler", "LabelEncoder",
     "OneHotEncoder", "SimpleImputer", "Concatenator", "BatchMapper", "Chain",
 ]

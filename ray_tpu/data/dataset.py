@@ -597,6 +597,14 @@ class Dataset:
     def write_json(self, path: str) -> List[str]:
         return self._write(path, "json")
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        import ray_tpu
+        from ray_tpu.data.tfrecords import write_tfrecords_block
+        refs = self._plan.execute()
+        remote_write = ray_tpu.remote(num_cpus=1)(write_tfrecords_block)
+        return ray_tpu.get([remote_write.remote(r, path, i)
+                            for i, r in enumerate(refs)])
+
     def write_numpy(self, path: str, *, column: str = "data") -> List[str]:
         import ray_tpu
         from ray_tpu.data import datasource as dsrc

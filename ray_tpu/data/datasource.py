@@ -123,29 +123,47 @@ def items_tasks(items: List[Any], parallelism: int) -> List[ReadTask]:
     return tasks
 
 
-def parquet_tasks(paths, columns: Optional[List[str]] = None) -> List[ReadTask]:
+def parquet_tasks(paths, columns: Optional[List[str]] = None,
+                  partitioning=None,
+                  partition_filter=None) -> List[ReadTask]:
+    from ray_tpu.data.partitioning import (add_partition_columns,
+                                           apply_partitioning)
     files = _expand_paths(paths, ".parquet")
+    files, values = apply_partitioning(files, partitioning,
+                                       partition_filter)
 
-    def read_one(path: str):
+    def read_one(path: str, vals):
         import pyarrow.parquet as pq
         if _is_remote(path):
-            return pq.read_table(_open(path), columns=columns)
-        return pq.read_table(path, columns=columns)
+            table = pq.read_table(_open(path), columns=columns)
+        else:
+            table = pq.read_table(path, columns=columns)
+        return add_partition_columns(table, vals) if vals else table
 
-    return [ReadTask(lambda p=f: read_one(p), input_files=[f])
-            for f in files]
+    return [ReadTask(lambda p=f, v=(values[i] if values else None):
+                     read_one(p, v), input_files=[f])
+            for i, f in enumerate(files)]
 
 
-def csv_tasks(paths, **pandas_kwargs) -> List[ReadTask]:
+def csv_tasks(paths, partitioning=None, partition_filter=None,
+              **pandas_kwargs) -> List[ReadTask]:
+    from ray_tpu.data.partitioning import (add_partition_columns,
+                                           apply_partitioning)
     files = _expand_paths(paths, ".csv")
+    files, part_values = apply_partitioning(files, partitioning,
+                                            partition_filter)
 
-    def read_one(path: str):
+    def read_one(path: str, vals):
         import pandas as pd
-        return pd.read_csv(_open(path, "r") if _is_remote(path) else path,
-                           **pandas_kwargs)
+        frame = pd.read_csv(
+            _open(path, "r") if _is_remote(path) else path,
+            **pandas_kwargs)
+        return add_partition_columns(frame, vals) if vals else frame
 
-    return [ReadTask(lambda p=f: read_one(p), input_files=[f])
-            for f in files]
+    return [ReadTask(lambda p=f, v=(part_values[i] if part_values
+                                    else None): read_one(p, v),
+                     input_files=[f])
+            for i, f in enumerate(files)]
 
 
 def json_tasks(paths, lines: bool = True) -> List[ReadTask]:

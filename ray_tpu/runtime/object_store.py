@@ -13,6 +13,7 @@ from __future__ import annotations
 import ctypes
 import mmap
 import os
+import threading
 import time
 from typing import Optional, Tuple
 
@@ -84,6 +85,43 @@ class SharedMemoryStore:
         self._base = ctypes.addressof(ctypes.c_char.from_buffer(mm))
         self._lib = get_lib()
         self._created = created
+        self._prefault_stop = threading.Event()
+        self._prefault_thread: Optional[threading.Thread] = None
+
+    def prefault_async(self, chunk_bytes: int = 64 * 1024 * 1024) -> None:
+        """Touch every segment page from a background thread.
+
+        On VMs with on-demand memory paging (this box: ~28 us per 4 KiB
+        first-touch fault, ~0.15 GiB/s) a cold multi-GiB put is fault-
+        bound, not memcpy-bound (warm writes run at ~4.5 GiB/s).  The
+        kernel can't populate faster either (MADV_POPULATE_WRITE measures
+        the same), so the only win is moving the faults OFF the put
+        critical path — done here in chunks with small yields so the
+        store host stays responsive on small boxes."""
+        if self._prefault_thread is not None:
+            return
+
+        def run():
+            try:
+                libc = ctypes.CDLL("libc.so.6", use_errno=True)
+            except OSError:
+                return
+            MADV_POPULATE_WRITE = 23
+            total = len(self._mm)
+            off = 0
+            while off < total and not self._prefault_stop.is_set():
+                n = min(chunk_bytes, total - off)
+                rc = libc.madvise(ctypes.c_void_p(self._base + off),
+                                  ctypes.c_size_t(n),
+                                  MADV_POPULATE_WRITE)
+                if rc != 0:      # old kernel / unsupported mapping: stop
+                    return
+                off += n
+                time.sleep(0.002)
+
+        self._prefault_thread = threading.Thread(
+            target=run, name="store-prefault", daemon=True)
+        self._prefault_thread.start()
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -128,6 +166,9 @@ class SharedMemoryStore:
             time.sleep(0.02)
 
     def close(self) -> None:
+        self._prefault_stop.set()
+        if self._prefault_thread is not None:
+            self._prefault_thread.join(timeout=5)
         self._buf.release()
         try:
             self._mm.close()

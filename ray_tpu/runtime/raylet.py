@@ -19,6 +19,7 @@ chips unless ``tpu_chips_per_host`` subdivides visible devices.
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -54,6 +55,90 @@ def detect_resources() -> Dict[str, float]:
     return resources
 
 
+# env vars consumed at interpreter start / first import: a zygote fork
+# applies env AFTER those were read, so such overrides must exec
+_IMPORT_SENSITIVE_ENV = ("JAX_", "XLA_", "LD_", "PYTHON", "TPU_",
+                         "PALLAS_", "MALLOC_")
+
+
+def _env_needs_exec(env_overrides) -> bool:
+    return any(k.startswith(_IMPORT_SENSITIVE_ENV)
+               for k in (env_overrides or {}))
+
+
+class ForkedProc:
+    """Popen-shaped handle over a zygote-forked worker pid.
+
+    The worker is reparented to init (double fork) and reaped there, so
+    there is no exit status to collect — returncode is -1 once the
+    process is gone, which is all the pool logic reads.  Liveness and
+    signaling go through a pidfd when available: a bare pid can be
+    recycled by an unrelated process (init reaps these workers
+    immediately), which would make kill(pid, 0) report a dead worker as
+    alive forever."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self._pidfd: Optional[int] = None
+        try:
+            self._pidfd = os.pidfd_open(pid)
+        except (OSError, AttributeError):
+            pass        # process already gone, or pre-5.3 kernel
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        if self._pidfd is not None:
+            import select
+            r, _, _ = select.select([self._pidfd], [], [], 0)
+            if not r:
+                return None
+            os.close(self._pidfd)
+            self._pidfd = None
+            self.returncode = -1
+            return self.returncode
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except ProcessLookupError:
+            self.returncode = -1
+            return self.returncode
+        except PermissionError:     # pid recycled by another user: dead
+            self.returncode = -1
+            return self.returncode
+
+    def _signal(self, sig: int) -> None:
+        try:
+            if self._pidfd is not None:
+                signal.pidfd_send_signal(self._pidfd, sig)
+            else:
+                os.kill(self.pid, sig)
+        except (ProcessLookupError, OSError):
+            self.returncode = self.returncode or -1
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+    def __del__(self):
+        if self._pidfd is not None:
+            try:
+                os.close(self._pidfd)
+            except OSError:
+                pass
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired("zygote-worker", timeout)
+            time.sleep(0.02)
+        return self.returncode
+
+
 class WorkerHandle:
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen):
         self.worker_id = worker_id
@@ -85,9 +170,28 @@ class Raylet:
 
         store_mem = object_store_memory or CONFIG.object_store_memory_bytes
         self.store_path = os.path.join(
-            session_dir, f"store_{self.node_id.hex()[:12]}")
+            self._pick_store_dir(store_mem),
+            f"ray_tpu_store_{os.getpid()}_{self.node_id.hex()[:12]}")
         self.store = SharedMemoryStore.create_segment(self.store_path,
                                                       store_mem)
+        if CONFIG.object_store_prefault and store_mem >= (1 << 30):
+            # big segments: move first-touch fault cost off the put path
+            self.store.prefault_async()
+
+        # prefork zygote: launched eagerly so its heavy import (the
+        # sitecustomize-mandated jax, ~8 s on this host class) overlaps
+        # cluster startup; first worker spawn connects to it
+        self._zygote_proc: Optional[subprocess.Popen] = None
+        self._zygote_conn: Optional[Any] = None
+        self._zygote_lock = threading.Lock()
+        self._zygote_sock_path = os.path.join(
+            session_dir, f"zygote_{self.node_id.hex()[:12]}.sock")
+        if CONFIG.worker_prefork:
+            try:
+                self._start_zygote()
+            except Exception as e:
+                logger.warning("zygote start failed (%s); workers will "
+                               "exec instead", e)
 
         self._workers: Dict[str, WorkerHandle] = {}       # worker_id hex ->
         self._idle: Dict[str, deque] = {}                 # sched key -> ids
@@ -780,19 +884,144 @@ class Raylet:
                "--gcs-host", self.gcs_address[0],
                "--gcs-port", str(self.gcs_address[1]),
                "--node-id", self.node_id.hex()]
-        out_f = open(log_prefix + ".out", "ab")
-        err_f = open(log_prefix + ".err", "ab")
-        try:
-            proc = subprocess.Popen(cmd, env=env, stdout=out_f, stderr=err_f,
-                                    cwd=os.getcwd())
-        finally:
-            out_f.close()  # the child holds its own dups
-            err_f.close()
+        proc = None
+        if CONFIG.worker_prefork and python == sys.executable and \
+                not _env_needs_exec(env_overrides):
+            # stock interpreter, no import-time-sensitive env overrides:
+            # fork off the warm zygote (ms) instead of exec+reimport
+            # (~8 s under the jax sitecustomize).  Venv workers need
+            # their own interpreter -> exec path below.
+            try:
+                proc = self._zygote_spawn(
+                    ["worker_main"] + cmd[3:], env,
+                    log_prefix + ".out", log_prefix + ".err")
+            except Exception as e:
+                logger.warning("zygote spawn failed (%s); exec fallback",
+                               e)
+                # ambiguous outcome: the zygote may still complete the
+                # fork after our timeout.  A fresh worker id keeps that
+                # orphan from colliding with the exec'd worker (its
+                # registration for the old id is simply rejected).
+                worker_id = WorkerID.from_random()
+                cmd[cmd.index("--worker-id") + 1] = worker_id.hex()
+        if proc is None:
+            out_f = open(log_prefix + ".out", "ab")
+            err_f = open(log_prefix + ".err", "ab")
+            try:
+                proc = subprocess.Popen(cmd, env=env, stdout=out_f,
+                                        stderr=err_f, cwd=os.getcwd())
+            finally:
+                out_f.close()  # the child holds its own dups
+                err_f.close()
         handle = WorkerHandle(worker_id, proc)
         handle.job_id = job_id
         with self._lock:
             self._workers[worker_id.hex()] = handle
         return handle
+
+    # ---------------------------------------------------------- zygote
+    def _start_zygote(self) -> None:
+        from ray_tpu.runtime.node import package_pythonpath
+        env = dict(os.environ)
+        env["RAY_TPU_SYSTEM_CONFIG"] = CONFIG.overrides_env_blob()
+        env["PYTHONPATH"] = package_pythonpath()
+        log_prefix = os.path.join(self.session_dir, "logs",
+                                  f"zygote-{self.node_id.hex()[:12]}")
+        os.makedirs(os.path.dirname(log_prefix), exist_ok=True)
+        out_f = open(log_prefix + ".out", "ab")
+        err_f = open(log_prefix + ".err", "ab")
+        try:
+            self._zygote_proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.runtime.worker_zygote",
+                 "--socket", self._zygote_sock_path],
+                env=env, stdout=out_f, stderr=err_f, cwd=os.getcwd())
+        finally:
+            out_f.close()
+            err_f.close()
+
+    def _zygote_spawn(self, argv, env, out_path, err_path) -> ForkedProc:
+        """Fork a worker off the warm zygote; raises on any failure (the
+        caller execs instead)."""
+        import socket as socketlib
+
+        from ray_tpu.runtime import worker_zygote as wz
+        with self._zygote_lock:
+            if self._zygote_proc is None or \
+                    self._zygote_proc.poll() is not None:
+                self._zygote_conn = None
+                self._start_zygote()
+            if self._zygote_conn is None:
+                deadline = time.monotonic() + \
+                    CONFIG.worker_start_timeout_s * 2
+                while True:
+                    try:
+                        s = socketlib.socket(socketlib.AF_UNIX,
+                                             socketlib.SOCK_STREAM)
+                        s.connect(self._zygote_sock_path)
+                        self._zygote_conn = s
+                        break
+                    except OSError:
+                        s.close()
+                        if self._zygote_proc.poll() is not None:
+                            raise RuntimeError("zygote exited "
+                                               f"{self._zygote_proc.returncode}")
+                        if time.monotonic() > deadline:
+                            raise TimeoutError("zygote not ready")
+                        time.sleep(0.1)
+            conn = self._zygote_conn
+            try:
+                wz.send_msg(conn, {"argv": argv, "env": env,
+                                   "stdout": out_path, "stderr": err_path,
+                                   "cwd": os.getcwd()})
+                conn.settimeout(CONFIG.worker_start_timeout_s)
+                reply = wz.recv_msg(conn)
+                conn.settimeout(None)
+            except OSError as e:
+                try:
+                    conn.close()
+                finally:
+                    self._zygote_conn = None
+                raise RuntimeError(f"zygote connection failed: {e}")
+            if not reply or "pid" not in reply:
+                self._zygote_conn = None
+                raise RuntimeError("zygote gave no pid")
+            return ForkedProc(reply["pid"])
+
+    def _pick_store_dir(self, store_mem: int) -> str:
+        """tmpfs home for the shm segment (plasma convention): big writes
+        never generate disk writeback.  Falls back to the session dir
+        when the configured dir is missing or can't fit the segment.
+        Also sweeps segments leaked by crashed raylets (name embeds the
+        creating pid; tmpfs leaks are RAM leaks)."""
+        d = CONFIG.object_store_dir
+        # sweep leaked segments FIRST: a crashed raylet's multi-GiB
+        # segment is the most likely reason the free-space check would
+        # fail, and reclaiming it is the point of the sweep
+        try:
+            for name in os.listdir(d):
+                if not name.startswith("ray_tpu_store_"):
+                    continue
+                try:
+                    pid = int(name.split("_")[3])
+                    os.kill(pid, 0)
+                except (IndexError, ValueError):
+                    continue
+                except ProcessLookupError:
+                    try:
+                        os.unlink(os.path.join(d, name))
+                    except OSError:
+                        pass
+                except PermissionError:
+                    pass     # pid alive under another user
+        except OSError:
+            pass
+        try:
+            st = os.statvfs(d)
+            if st.f_bavail * st.f_frsize < store_mem:
+                return self.session_dir
+        except OSError:
+            return self.session_dir
+        return d
 
     def _spawn_cpp_worker(self, worker_id, job_id: Optional[str],
                           env_overrides: Optional[Dict[str, str]]
@@ -1288,6 +1517,17 @@ class Raylet:
                 h.proc.wait(timeout=2)
             except subprocess.TimeoutExpired:
                 h.proc.kill()
+        if self._zygote_conn is not None:
+            try:
+                self._zygote_conn.close()
+            except OSError:
+                pass
+        if self._zygote_proc is not None:
+            self._zygote_proc.terminate()
+            try:
+                self._zygote_proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                self._zygote_proc.kill()
         self._server.stop()
         try:
             self.gcs.close()

@@ -1,0 +1,148 @@
+"""Prefork worker zygote: fork warm worker processes in milliseconds.
+
+On this class of host, interpreter startup is dominated by
+environment-mandated imports (a TPU PJRT plugin sitecustomize pulls jax
+into EVERY python process: ~8 s each).  The reference amortizes worker
+startup with a prestarted pool (worker_pool.cc); the zygote goes
+further: ONE process per raylet pays the import cost, then every python
+worker is an ``os.fork()`` away (~10 ms), giving this box reference-like
+actor/task worker density.
+
+Mechanics:
+  - The raylet launches ``python -m ray_tpu.runtime.worker_zygote
+    --socket <path>`` once (eagerly, so it warms while the cluster
+    boots) and sends framed spawn requests over the unix socket.
+  - Each request double-forks: the intermediate child forks the real
+    worker (reparented to init — the zygote never reaps), writes the
+    worker pid back on the socket, and exits.  The zygote stays
+    single-threaded, so forks are async-signal clean.
+  - The worker child starts a new session, points stdio at its log
+    files, swaps env/argv/config, closes inherited sockets, and calls
+    ``worker_main.main()`` exactly as an exec'd worker would.
+
+Workers that need a different interpreter (pip runtime envs) or
+language (cpp) keep the exec path — the raylet falls back automatically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import socket
+import struct
+import sys
+
+_FRAME = struct.Struct("<I")
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(_FRAME.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket):
+    head = _recv_exact(sock, _FRAME.size)
+    if head is None:
+        return None
+    (n,) = _FRAME.unpack(head)
+    body = _recv_exact(sock, n)
+    return None if body is None else pickle.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _become_worker(req: dict) -> None:
+    """Runs in the grandchild: turn this fork into a real worker."""
+    os.setsid()
+    devnull = os.open(os.devnull, os.O_RDONLY)
+    out = os.open(req["stdout"], os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                  0o644)
+    err = os.open(req["stderr"], os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                  0o644)
+    os.dup2(devnull, 0)
+    os.dup2(out, 1)
+    os.dup2(err, 2)
+    for fd in (devnull, out, err):
+        if fd > 2:
+            os.close(fd)
+    os.chdir(req["cwd"])
+    os.environ.clear()
+    os.environ.update(req["env"])
+    # the zygote's CONFIG was resolved from ITS env; re-resolve from the
+    # worker's blob (same raylet -> normally identical, but exact is free)
+    from ray_tpu._private.config import CONFIG
+    blob = req["env"].get("RAY_TPU_SYSTEM_CONFIG", "")
+    try:
+        CONFIG.set_overrides(json.loads(blob) if blob else {})
+    except (ValueError, TypeError):
+        pass
+    sys.argv = req["argv"]
+    from ray_tpu.runtime import worker_main
+    try:
+        worker_main.main()
+    finally:
+        os._exit(0)
+
+
+def _handle_conn(conn: socket.socket, listener: socket.socket) -> None:
+    while True:
+        req = recv_msg(conn)
+        if req is None:
+            return
+        sys.stdout.flush()
+        sys.stderr.flush()
+        pid1 = os.fork()
+        if pid1 == 0:
+            listener.close()
+            pid2 = os.fork()
+            if pid2 == 0:
+                conn.close()
+                _become_worker(req)     # never returns
+                os._exit(1)
+            # intermediate: report the worker pid, then die so the
+            # worker reparents to init (no zombie bookkeeping here)
+            try:
+                send_msg(conn, {"pid": pid2})
+            finally:
+                os._exit(0)
+        os.waitpid(pid1, 0)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--socket", required=True)
+    args = ap.parse_args()
+
+    # the expensive part, paid exactly once per raylet: the runtime (and
+    # whatever sitecustomize insists every process imports)
+    from ray_tpu.runtime import worker_main       # noqa: F401
+
+    try:
+        os.unlink(args.socket)
+    except FileNotFoundError:
+        pass
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(args.socket)
+    listener.listen(8)
+    while True:
+        conn, _ = listener.accept()
+        try:
+            _handle_conn(conn, listener)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Load calibration: this box runs the whole cluster under test on one
+# core, so heartbeat/startup threads starve for seconds under a full
+# suite.  The scale multiplies the liveness-patience flags
+# (config._SCALED_FLAGS) in every daemon (env-inherited) AND the
+# explicit get/wait timeouts tests pass (shim below).
+os.environ.setdefault("RAY_TPU_TIMEOUT_SCALE", "4.0")
+_TIMEOUT_SCALE = float(os.environ["RAY_TPU_TIMEOUT_SCALE"])
+
 import jax  # noqa: E402
 
 # The environment's sitecustomize force-registers an `axon` TPU backend and
@@ -21,6 +29,30 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _scale_test_timeouts():
+    """Multiply explicit ray_tpu.get/wait timeouts by the load scale —
+    test constants are written for an idle box."""
+    import ray_tpu
+    real_get, real_wait = ray_tpu.get, ray_tpu.wait
+
+    def get(refs, *, timeout=None, **kw):
+        if timeout is not None:
+            timeout = timeout * _TIMEOUT_SCALE
+        return real_get(refs, timeout=timeout, **kw)
+
+    def wait(refs, **kw):
+        if kw.get("timeout") is not None:
+            kw["timeout"] = kw["timeout"] * _TIMEOUT_SCALE
+        return real_wait(refs, **kw)
+
+    ray_tpu.get = get
+    ray_tpu.wait = wait
+    yield
+    ray_tpu.get = real_get
+    ray_tpu.wait = real_wait
 
 
 @pytest.fixture

@@ -102,28 +102,32 @@ def test_rollout_worker_local():
     assert isinstance(metrics, list)
 
 
-def test_ppo_cartpole_learns(ray_start_regular):
-    """PPO improves CartPole reward within a few iterations (tuned target
-    in the reference: 150 within 100k steps; we check clear learning
-    progress in a short budget)."""
+def test_ppo_cartpole_reaches_tuned_target(ray_start_regular):
+    """PPO reaches the reference's TUNED bar: episode_reward_mean >= 150
+    within 100k env steps (rllib/tuned_examples/ppo/cartpole-ppo.yaml:4-7).
+    The benchmarks/rl_perf.py config hits it in ~18k steps uncontended;
+    the full 100k budget absorbs shared-box nondeterminism."""
     from ray_tpu.rl import PPOConfig
     algo = (PPOConfig()
             .environment("CartPole-v1")
-            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
-                      rollout_fragment_length=100)
-            .training(train_batch_size=400, sgd_minibatch_size=128,
-                      num_sgd_iter=6, lr=3e-4, entropy_coeff=0.01)
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=125)
+            .training(train_batch_size=1000, sgd_minibatch_size=250,
+                      num_sgd_iter=8, lr=3e-4, entropy_coeff=0.01,
+                      gamma=0.99)
             .debugging(seed=0)
             .build())
     try:
-        first = algo.train()
         best = -np.inf
-        for _ in range(7):
+        result = {"timesteps_total": 0}
+        while result["timesteps_total"] < 100_000:
             result = algo.train()
             best = max(best, result["episode_reward_mean"])
-        assert result["timesteps_total"] >= 3200
-        assert best > first["episode_reward_mean"] + 10, \
-            f"no learning: first={first['episode_reward_mean']} best={best}"
+            if best >= 150:
+                break
+        assert best >= 150, \
+            f"tuned target missed: best={best} " \
+            f"steps={result['timesteps_total']}"
         ckpt = algo.save()
         algo.restore(ckpt)
     finally:
