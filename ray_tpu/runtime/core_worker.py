@@ -1864,6 +1864,10 @@ class CoreWorker:
             return self._rpc_get_object(p or {})
         if method == "core_worker_stats":
             return self._rpc_core_worker_stats(p or {})
+        if method == "profile":
+            # drivers flame-sample like any worker (`ray-tpu profile`)
+            from ray_tpu._private.profiler import sample_folded
+            return sample_folded(float((p or {}).get("duration", 2.0)))
         raise rpc.RpcError(f"core_worker: unknown method {method}")
 
     def _rpc_core_worker_stats(self, p) -> dict:

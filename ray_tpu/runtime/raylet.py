@@ -56,9 +56,11 @@ def detect_resources() -> Dict[str, float]:
 
 
 # env vars consumed at interpreter start / first import: a zygote fork
-# applies env AFTER those were read, so such overrides must exec
-_IMPORT_SENSITIVE_ENV = ("JAX_", "XLA_", "LD_", "PYTHON", "TPU_",
-                         "PALLAS_", "MALLOC_")
+# applies env AFTER those were read, so such overrides must exec.
+# JAX_PLATFORMS / XLA_FLAGS are NOT here: they are read at first
+# backend init, which the zygote never performs — the forked child
+# re-pins the platform explicitly (worker_zygote._become_worker).
+_IMPORT_SENSITIVE_ENV = ("LD_", "PYTHON", "TPU_", "PALLAS_", "MALLOC_")
 
 
 def _env_needs_exec(env_overrides) -> bool:

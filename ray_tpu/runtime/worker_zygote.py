@@ -86,6 +86,17 @@ def _become_worker(req: dict) -> None:
         CONFIG.set_overrides(json.loads(blob) if blob else {})
     except (ValueError, TypeError):
         pass
+    # the zygote imported jax but never initialized a backend; the env
+    # update above covers XLA_FLAGS (read at first backend use), and the
+    # platform choice must be re-pinned through jax.config because
+    # plugin discovery overrides the plain env var
+    plat = req["env"].get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
     sys.argv = req["argv"]
     from ray_tpu.runtime import worker_main
     try:
@@ -122,6 +133,20 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--socket", required=True)
     args = ap.parse_args()
+
+    # die with the raylet: a SIGKILLed raylet must not orphan a warm
+    # jax-loaded process forever (PR_SET_PDEATHSIG is cleared on fork,
+    # so spawned workers don't inherit the tie)
+    try:
+        import ctypes
+        import signal as _signal
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, _signal.SIGKILL)
+        if os.getppid() == 1:          # raylet already gone
+            return
+    except OSError:
+        pass
 
     # the expensive part, paid exactly once per raylet: the runtime (and
     # whatever sitecustomize insists every process imports)
