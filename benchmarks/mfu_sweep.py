@@ -9,8 +9,14 @@ comments and BENCH notes.
 import argparse
 import functools
 import json
+import os
+import sys
 
 import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 
 def main():
@@ -22,6 +28,7 @@ def main():
     ap.add_argument("--attn", default="flash")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--remat-layers", type=int, default=None)
     args = ap.parse_args()
 
     import bench
@@ -39,6 +46,7 @@ def main():
     try:
         cfg = get_config(args.config, max_seq_len=args.seq, remat=True,
                          remat_policy=args.policy,
+                         remat_layers=args.remat_layers,
                          attention_impl=args.attn)
         import ray_tpu.train.step as step_mod
         orig = step_mod.lm_loss_chunked_fn

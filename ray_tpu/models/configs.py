@@ -28,6 +28,10 @@ class TransformerConfig:
     param_dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"           # auto | xla | flash | splash | ring | ulysses
     remat: bool = True                     # checkpoint each block (HBM <-> FLOPs)
+    remat_layers: Optional[int] = None     # None -> all; K -> only the first
+    # K layers rematerialize, the rest store activations (partial remat:
+    # spends HBM headroom to cut the backward recompute, the knob between
+    # "nothing" and no-remat that per-policy selection can't reach)
     remat_policy: str = "dots"             # "dots": save no-batch-dim dots
     # (cheap recompute, more HBM); "nothing": full per-block recompute —
     # the memory-lean setting that fits ~1B params on one 16 GiB chip

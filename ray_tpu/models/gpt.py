@@ -75,12 +75,20 @@ class MLP(nn.Module):
 
 def stack_layers(block_cls, cfg: TransformerConfig, ctor_kwargs, x,
                  call_args, *, remat: Optional[bool] = None,
-                 cache: bool = False, name: str = "blocks"):
-    """Apply cfg.n_layers blocks under the repo's standard stacking: remat
-    per cfg.remat (HBM<->FLOPs), one ``lax.scan``'d block when
-    cfg.scan_layers (O(1) compile time in depth). Must be called from a
-    parent's ``@nn.compact`` __call__. Blocks are invoked ``mdl(x, *call_args)``.
+                 cache: bool = False, name: str = "blocks",
+                 n_layers: Optional[int] = None):
+    """Apply ``n_layers`` (default cfg.n_layers) blocks under the repo's
+    standard stacking: remat per cfg.remat (HBM<->FLOPs), one
+    ``lax.scan``'d block when cfg.scan_layers (O(1) compile time in
+    depth). Must be called from a parent's ``@nn.compact`` __call__.
+    Blocks are invoked ``mdl(x, *call_args)``.
+
+    cfg.remat_layers splits the stack at the CALLER (two stack_layers
+    calls, one rematted, one plain) — partial remat for configs with
+    HBM headroom between "recompute everything" and "store everything".
     """
+    if n_layers is None:
+        n_layers = cfg.n_layers
     if remat is None:
         remat = cfg.remat
     if remat:
@@ -114,11 +122,11 @@ def stack_layers(block_cls, cfg: TransformerConfig, ctor_kwargs, x,
             lambda mdl, carry, _: (mdl(carry, *call_args), None),
             variable_axes=variable_axes,
             split_rngs={"params": True},
-            length=cfg.n_layers,
+            length=n_layers,
             metadata_params={nn.PARTITION_NAME: None},
         )(block_cls(cfg, **ctor_kwargs, name=name), x, None)
     else:
-        for i in range(cfg.n_layers):
+        for i in range(n_layers):
             x = block_cls(cfg, **ctor_kwargs,
                           name=f"{name[:-1]}_{i}")(x, *call_args)
     return x
@@ -294,11 +302,26 @@ class GPT(nn.Module):
             cos = with_sharding(self.mesh, cos, (None, None), self.rules)
             sin = with_sharding(self.mesh, sin, (None, None), self.rules)
 
-        x = stack_layers(
-            Block, cfg,
-            dict(mesh=self.mesh, rules=self.rules, decode=self.decode),
-            x, (cos, sin, positions),
-            remat=cfg.remat and not self.decode, cache=True)
+        do_remat = cfg.remat and not self.decode
+        n_remat = (cfg.n_layers if cfg.remat_layers is None
+                   else max(0, min(cfg.remat_layers, cfg.n_layers)))
+        block_kwargs = dict(mesh=self.mesh, rules=self.rules,
+                            decode=self.decode)
+        if do_remat and 0 < n_remat < cfg.n_layers:
+            # partial remat: the first n_remat layers recompute in the
+            # backward pass, the tail stores activations (uses the HBM
+            # headroom "policy" selection can't reach)
+            x = stack_layers(Block, cfg, block_kwargs, x,
+                             (cos, sin, positions), remat=True,
+                             cache=True, n_layers=n_remat)
+            x = stack_layers(Block, cfg, block_kwargs, x,
+                             (cos, sin, positions), remat=False,
+                             cache=True, name="blocks_tail",
+                             n_layers=cfg.n_layers - n_remat)
+        else:
+            x = stack_layers(Block, cfg, block_kwargs, x,
+                             (cos, sin, positions), remat=do_remat,
+                             cache=True)
 
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if return_hidden:

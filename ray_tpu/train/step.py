@@ -120,9 +120,35 @@ def _lm_loss_body(batch: Dict[str, jax.Array],
     return loss, metrics
 
 
+def cast_params_once(params: Any, dtype=jnp.bfloat16) -> Any:
+    """Cast f32 matrix/embedding params to the activation dtype OUTSIDE
+    the rematted blocks.
+
+    flax promotes param dtype inside each Dense call — under full remat
+    that cast sits inside the checkpointed region and re-reads the f32
+    master weights on every backward recompute (~6.5 GB of extra HBM
+    traffic per recompute at 1B params).  Hoisting it here makes the
+    bf16 copy a saved residual: one cast per step, measured +1.4pp MFU
+    on gpt-large with remat_policy="nothing" (benchmarks/mfu_sweep.py).
+    1-D leaves (norm scales) stay f32 — their kernels want f32 anyway.
+    Gradients are unchanged: autodiff through the cast accumulates f32.
+    """
+    return jax.tree.map(
+        lambda p: p.astype(dtype)
+        if (hasattr(p, "dtype") and p.dtype == jnp.float32
+            and getattr(p, "ndim", 0) >= 2) else p, params)
+
+
 def lm_loss_fn(apply_fn: Callable, params: Any, batch: Dict[str, jax.Array],
-               z_loss: float = 0.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Next-token LM loss. batch: {"tokens": [B, S+1] or [B, S], "mask"?}."""
+               z_loss: float = 0.0,
+               param_cast=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token LM loss. batch: {"tokens": [B, S+1] or [B, S], "mask"?}.
+
+    ``param_cast``: optional dtype for :func:`cast_params_once` (models
+    computing in bf16 with f32 masters under remat)."""
+    if param_cast is not None:
+        params = cast_params_once(params, param_cast)
+
     def head(inputs, mask, targets):
         logits, mutated = apply_fn({"params": params}, inputs,
                                    mutable=["intermediates"])
@@ -136,7 +162,8 @@ def lm_loss_chunked_fn(apply_fn: Callable, params: Any,
                        batch: Dict[str, jax.Array],
                        z_loss: float = 0.0,
                        chunk_size: int = 256,
-                       head_weight: Optional[Callable] = None
+                       head_weight: Optional[Callable] = None,
+                       param_cast=None
                        ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token LM loss with the chunked projection head
     (ops/losses.py chunked_lm_loss): the logits tensor's peak HBM drops
@@ -149,7 +176,12 @@ def lm_loss_chunked_fn(apply_fn: Callable, params: Any,
     ``lm_head`` Dense, else the tied ``embed`` table — and raises for
     models that match neither; pass an explicit selector (e.g. via
     functools.partial) for other architectures.
+
+    ``param_cast``: optional dtype for :func:`cast_params_once`.
     """
+    if param_cast is not None:
+        params = cast_params_once(params, param_cast)
+
     def head(inputs, mask, targets):
         hidden, mutated = apply_fn({"params": params}, inputs,
                                    mutable=["intermediates"],

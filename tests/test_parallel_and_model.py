@@ -192,3 +192,61 @@ def test_generation_samplers_and_eos():
                       top_k=1)
     np.testing.assert_array_equal(np.asarray(a),
                                   np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_partial_remat_split_stack():
+    """cfg.remat_layers splits the stack into a rematted head and a
+    plain tail (two scan scopes); the forward math is unchanged vs the
+    single-stack model and a train step runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import GPT, get_config
+    from ray_tpu.train.step import OptimizerConfig, make_sharded_train
+    from ray_tpu.parallel import MeshConfig, build_mesh
+
+    cfg = get_config("tiny", max_seq_len=64, remat=True,
+                     remat_policy="nothing", remat_layers=1)
+    model = GPT(cfg)
+    tokens = jnp.arange(2 * 32, dtype=jnp.int32).reshape(2, 32) % 256
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    assert "blocks_tail" in variables["params"], \
+        "partial remat must create the plain tail scope"
+    logits = model.apply(variables, tokens)
+    assert jnp.isfinite(logits).all()
+
+    mesh = build_mesh(MeshConfig(data=-1))
+    m_model = GPT(cfg, mesh=mesh)
+    n_dev = len(jax.devices())
+    batch = {"tokens": jnp.arange(n_dev * 33, dtype=jnp.int32
+                                  ).reshape(n_dev, 33) % 256}
+    init_fn, step_fn, _, _ = make_sharded_train(
+        m_model, mesh, OptimizerConfig(warmup_steps=1, decay_steps=10),
+        example_batch=batch)
+    state = init_fn(jax.random.PRNGKey(0), batch)
+    state, metrics = step_fn(state, batch)
+    assert bool(jnp.isfinite(metrics["loss"]))
+
+
+def test_cast_params_once_identical_loss():
+    """The hoisted f32->bf16 cast changes scheduling, not numerics: the
+    loss equals the uncast path bit-for-bit (flax promotes to the same
+    bf16 values inside each Dense)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import GPT, get_config
+    from ray_tpu.train.step import lm_loss_fn
+
+    cfg = get_config("tiny", max_seq_len=64, dtype=jnp.bfloat16)
+    model = GPT(cfg)
+    tokens = (jnp.arange(2 * 33, dtype=jnp.int32).reshape(2, 33) * 7) % 256
+    params = model.init(jax.random.PRNGKey(0),
+                        tokens[:, :-1])["params"]
+    batch = {"tokens": tokens}
+    base, _ = lm_loss_fn(model.apply, params, batch)
+    cast, _ = lm_loss_fn(model.apply, params, batch,
+                         param_cast=jnp.bfloat16)
+    assert float(base) == float(cast)
