@@ -79,8 +79,8 @@ INDEX_HTML = r"""<!DOCTYPE html>
 <nav id="nav"></nav>
 <main id="main">loading…</main>
 <script>
-const TABS = ["Overview", "Nodes", "Actors", "Tasks", "Jobs", "Serve",
-              "Placement Groups", "Events"];
+const TABS = ["Overview", "Metrics", "Nodes", "Actors", "Tasks",
+              "Timeline", "Jobs", "Serve", "Placement Groups", "Events"];
 let tab = location.hash ? decodeURIComponent(location.hash.slice(1))
                         : "Overview";
 let followJob = null, logOffset = 0, timer = null;
@@ -124,6 +124,118 @@ async function renderOverview() {
     `<div class="k">${esc(k)}</div></div>`).join("") + `</div>` +
     `<div class="hint">auto-refreshing every 2 s — API under /api/*, ` +
     `Prometheus at /metrics</div>`;
+}
+
+// ---- Metrics: rolling client-side history, sampled even while other
+// tabs are open so the charts have depth the moment you switch here
+// (the reference embeds Grafana; one SVG line chart needs no toolchain)
+const HIST = {t: [], cpu: [], tpu: [], actors: [], running: []};
+async function sampleMetrics() {
+  try {
+    const [s, a, t] = await Promise.all([
+      J("/api/cluster_status"), J("/api/actors"),
+      J("/api/tasks?limit=2000")]);
+    const res = s.total_resources || {}, av = s.available_resources || {};
+    const used = (r) => (res[r] ?? 0) - (av[r] ?? 0);
+    HIST.t.push(Date.now() / 1000);
+    HIST.cpu.push(used("CPU"));
+    HIST.tpu.push(used("TPU"));
+    HIST.actors.push(a.actors.filter(x => x.state === "ALIVE").length);
+    HIST.running.push(t.tasks.filter(x => x.state === "RUNNING").length);
+    for (const k in HIST) if (HIST[k].length > 240) HIST[k].shift();
+  } catch (e) {}
+}
+setInterval(sampleMetrics, 5000);
+sampleMetrics();
+
+function lineChart(title, xs, ys, color) {
+  const W = 540, H = 120, P = 28;
+  if (ys.length < 2)
+    return `<div class="tile" style="width:${W}px"><div class="k">` +
+      `${esc(title)}</div><div class="hint">gathering…</div></div>`;
+  const ymax = Math.max(1e-9, ...ys), ymin = Math.min(0, ...ys);
+  const x0 = xs[0], x1 = xs[xs.length - 1] || x0 + 1;
+  const px = (x) => P + (W - P - 8) * (x - x0) / Math.max(1e-9, x1 - x0);
+  const py = (y) => H - 18 - (H - 30) * (y - ymin) /
+    Math.max(1e-9, ymax - ymin);
+  const pts = xs.map((x, i) => `${px(x).toFixed(1)},${py(ys[i]).toFixed(1)}`)
+    .join(" ");
+  const last = ys[ys.length - 1];
+  const span = Math.round(x1 - x0);
+  return `<div class="tile" style="width:${W}px">` +
+    `<div class="k">${esc(title)} <span style="float:right">now ` +
+    `<b>${esc(last)}</b> · peak ${esc(ymax)} · last ${span}s</span></div>` +
+    `<svg width="${W - 24}" height="${H}" role="img">` +
+    `<line x1="${P}" y1="${py(ymin)}" x2="${W - 8}" y2="${py(ymin)}" ` +
+    `stroke="var(--line)"/>` +
+    `<line x1="${P}" y1="${py(ymax)}" x2="${W - 8}" y2="${py(ymax)}" ` +
+    `stroke="var(--line)" stroke-dasharray="3 3"/>` +
+    `<text x="2" y="${py(ymax) + 4}" font-size="10" ` +
+    `fill="var(--muted)">${esc(ymax)}</text>` +
+    `<text x="2" y="${py(ymin) + 4}" font-size="10" ` +
+    `fill="var(--muted)">${esc(ymin)}</text>` +
+    `<polyline points="${pts}" fill="none" stroke="${color}" ` +
+    `stroke-width="1.5"/></svg></div>`;
+}
+
+async function renderMetrics() {
+  return `<div class="hint">sampled every 5 s in-page (Prometheus ` +
+    `scrape endpoint: /metrics)</div><div class="tiles">` +
+    lineChart("CPUs in use", HIST.t, HIST.cpu, "var(--accent)") +
+    lineChart("TPUs in use", HIST.t, HIST.tpu, "var(--warn)") +
+    lineChart("live actors", HIST.t, HIST.actors, "var(--ok)") +
+    lineChart("running tasks", HIST.t, HIST.running, "var(--accent)") +
+    `</div>`;
+}
+
+// ---- Timeline: task swimlanes per worker from the GCS task table
+// (same data `ray-tpu timeline` exports as a chrome trace)
+async function renderTimeline() {
+  const d = await J("/api/tasks?limit=2000");
+  const done = d.tasks.filter(t => t.start_time);
+  if (!done.length)
+    return `<div class="hint">no task events yet</div>`;
+  const now = Date.now() / 1000;
+  const t1 = Math.max(...done.map(t => t.end_time || now));
+  const t0 = Math.max(Math.min(...done.map(t => t.start_time)), t1 - 120);
+  const lanes = new Map();
+  for (const t of done) {
+    if ((t.end_time || now) < t0) continue;
+    const w = (t.worker_id || "?").slice(0, 12);
+    if (!lanes.has(w)) lanes.set(w, []);
+    lanes.get(w).push(t);
+  }
+  const laneIds = [...lanes.keys()].slice(0, 16);
+  const W = 1100, LH = 20, LX = 110;
+  const px = (x) => LX + (W - LX - 8) * (x - t0) / Math.max(1e-9, t1 - t0);
+  let rows = "";
+  laneIds.forEach((w, i) => {
+    const y = i * LH;
+    rows += `<text x="2" y="${y + 14}" font-size="11" class="mono" ` +
+      `fill="var(--ink2)">${esc(w)}</text>`;
+    for (const t of lanes.get(w)) {
+      const s = Math.max(t.start_time, t0), e = t.end_time || now;
+      const wid = Math.max(2, px(e) - px(s));
+      const color = t.state === "FAILED" ? "var(--bad)"
+        : (t.state === "RUNNING" ? "var(--warn)" : "var(--accent)");
+      rows += `<rect x="${px(s).toFixed(1)}" y="${y + 3}" ` +
+        `width="${wid.toFixed(1)}" height="${LH - 7}" rx="2" ` +
+        `fill="${color}" fill-opacity="0.75">` +
+        `<title>${esc(t.name)} (${esc(t.state)}) ` +
+        `${((e - s)).toFixed(3)}s</title></rect>`;
+    }
+  });
+  const H = laneIds.length * LH + 24;
+  return `<div class="hint">last ${(t1 - t0).toFixed(0)} s of task ` +
+    `execution, one lane per worker (hover for name/duration; full ` +
+    `chrome trace: <span class="mono">ray-tpu timeline</span>)</div>` +
+    `<div class="tile" style="width:${W + 24}px"><svg width="${W}" ` +
+    `height="${H}">${rows}` +
+    `<text x="${LX}" y="${H - 4}" font-size="10" fill="var(--muted)">` +
+    `${new Date(t0 * 1000).toLocaleTimeString()}</text>` +
+    `<text x="${W - 70}" y="${H - 4}" font-size="10" ` +
+    `fill="var(--muted)">` +
+    `${new Date(t1 * 1000).toLocaleTimeString()}</text></svg></div>`;
 }
 
 async function renderNodes() {
@@ -222,8 +334,9 @@ document.addEventListener("click", (e) => {
   if (a) tailJob(a.dataset.sid);
 });
 
-const RENDER = {"Overview": renderOverview, "Nodes": renderNodes,
-  "Actors": renderActors, "Tasks": renderTasks, "Jobs": renderJobs,
+const RENDER = {"Overview": renderOverview, "Metrics": renderMetrics,
+  "Nodes": renderNodes, "Actors": renderActors, "Tasks": renderTasks,
+  "Timeline": renderTimeline, "Jobs": renderJobs,
   "Serve": renderServe, "Placement Groups": renderPGs,
   "Events": renderEvents};
 

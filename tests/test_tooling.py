@@ -64,6 +64,25 @@ def test_dashboard_endpoints(ray_start_regular):
         # the page is live: it polls every view without reload and can
         # tail job logs (reference SPA pages list, dashboard/client/src)
         assert "setInterval(refresh" in html
+        # Metrics charts + Timeline swimlanes (reference embeds Grafana
+        # / chrome-trace externally; here they're in-page SVG).  The
+        # timeline renderer consumes start_time/end_time/worker_id off
+        # the task rows — pin that contract on real data.
+        assert "renderMetrics" in html and "renderTimeline" in html
+        assert "sampleMetrics" in html
+        # start_time rides the executing worker's RUNNING event, which
+        # flushes on its own clock: wait for a row that has it
+        deadline = time.monotonic() + 10
+        row = None
+        while time.monotonic() < deadline:
+            rows = _get_json(base + "/api/tasks?limit=50")["tasks"]
+            row = next((t for t in rows if t.get("start_time")), None)
+            if row is not None:
+                break
+            time.sleep(0.3)
+        assert row is not None, "no task row gained start_time"
+        for key in ("end_time", "worker_id", "state"):
+            assert key in row, (key, sorted(row))
         for tab_name in ("Nodes", "Actors", "Tasks", "Jobs", "Serve"):
             assert f'"{tab_name}"' in html
         assert "tailJob" in html
