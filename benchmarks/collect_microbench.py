@@ -21,9 +21,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_json_lines(cmd, timeout=900):
-    env = dict(os.environ, PYTHONPATH=REPO)
+    # no PYTHONPATH: it leaks into the TPU tunnel's helper subprocesses
+    # and breaks the axon backend; every benchmark script self-inserts
+    # the repo root into sys.path instead
     proc = subprocess.run(cmd, capture_output=True, text=True,
-                          timeout=timeout, env=env, cwd=REPO)
+                          timeout=timeout, env=dict(os.environ), cwd=REPO)
     rows = []
     for line in proc.stdout.splitlines():
         line = line.strip()

@@ -11,9 +11,15 @@ Prints one JSON line: {"metric": "data_ingest_gib_per_s", ...}.
 """
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 
 def main(total_gib: float = 2.0, block_mib: int = 128):

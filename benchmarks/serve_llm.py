@@ -122,13 +122,16 @@ def bench_serve(preset="gpt-small", slots=8, requests=64, prompt_len=64,
     from ray_tpu import serve
 
     # replica __init__ compiles every engine specialization (warmup):
-    # give actor creation room beyond the 60 s default
-    ray_tpu.init(num_cpus=4,
+    # give actor creation room beyond the 60 s default.  num_tpus=1 on
+    # both the cluster and the deployment: a replica without a TPU
+    # lease is pinned to the CPU backend (see build_app docstring).
+    ray_tpu.init(num_cpus=4, num_tpus=1,
                  system_config={"actor_creation_timeout_s": 900.0})
     serve.start()
     app = serve.llm.build_app(preset=preset, num_slots=slots,
                               max_concurrent_queries=concurrency * 2,
                               max_seq_len=2 * (prompt_len + new_tokens),
+                              num_tpus=1,
                               warmup_prompt_lens=[prompt_len])
     handle = serve.run(app, name="llm-bench")
     try:

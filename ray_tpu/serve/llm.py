@@ -97,9 +97,17 @@ class LLMServer:
 
 
 def build_app(preset: str = "tiny", *, num_replicas: int = 1,
-              max_concurrent_queries: int = 64, **server_kwargs):
-    """Deployment-bound application for serve.run()."""
+              max_concurrent_queries: int = 64, num_tpus: float = 0,
+              **server_kwargs):
+    """Deployment-bound application for serve.run().
+
+    ``num_tpus``: chips each replica leases.  MUST be > 0 to serve on
+    TPU — a replica with no TPU lease is pinned to the CPU backend by
+    the raylet (worker_main must not grab libtpu from under a training
+    job; raylet._tpu_env), and a gpt-scale engine on one CPU core
+    serves ~100x slower.  CI tests on CPU-only clusters keep 0."""
     dep = deployment(
         LLMServer, name=f"llm-{preset}", num_replicas=num_replicas,
-        max_concurrent_queries=max_concurrent_queries)
+        max_concurrent_queries=max_concurrent_queries,
+        ray_actor_options={"num_tpus": num_tpus} if num_tpus else None)
     return dep.bind(preset, **server_kwargs)
