@@ -64,6 +64,14 @@ def _recv_exact(sock: socket.socket, n: int):
 def _become_worker(req: dict) -> None:
     """Runs in the grandchild: turn this fork into a real worker."""
     os.setsid()
+    try:
+        # forked children keep the zygote's cmdline in ps; at least fix
+        # the comm name so `ps -C`/top distinguish workers from the
+        # zygote (15-char kernel limit)
+        with open("/proc/self/comm", "w") as f:
+            f.write("ray_tpu_worker")
+    except OSError:
+        pass
     devnull = os.open(os.devnull, os.O_RDONLY)
     out = os.open(req["stdout"], os.O_WRONLY | os.O_CREAT | os.O_APPEND,
                   0o644)
