@@ -119,6 +119,11 @@ _declare("heartbeat_period_ms", int, 250,
          "Node daemon -> GCS resource/liveness report period.")
 _declare("health_check_failure_threshold", int, 8,
          "Missed heartbeats before the GCS marks a node dead.")
+_declare("rpc_fuzz_ms", float, 0.0,
+         "Schedule fuzzing: jitter every RPC dispatch by up to this many "
+         "milliseconds (uniform).  Race tooling — perturbs message "
+         "interleavings the way TSAN-style schedule stressing does for "
+         "threads; see tests/test_sched_fuzz.py.  0 disables.")
 _declare("timeout_scale", float, 1.0,
          "Multiplier applied to liveness/startup timeouts at resolution "
          "time (the _SCALED flags below).  Loaded hosts — CI sharing one "

@@ -26,6 +26,23 @@ from ray_tpu._private.logging_utils import get_logger
 
 logger = get_logger("rpc")
 
+
+def _maybe_fuzz() -> None:
+    """Schedule fuzzing: jitter every RPC dispatch by up to
+    ``rpc_fuzz_ms`` (config; default 0 = off).
+
+    The single-language analog of the reference's TSAN/schedule-stress
+    race tooling: a handler that only works because replies "usually
+    arrive in order" fails under fuzz.  The race-sensitive suites
+    (lease races, chaos, GCS fault tolerance) run under it in
+    tests/test_sched_fuzz.py."""
+    from ray_tpu._private.config import CONFIG
+    ms = CONFIG.rpc_fuzz_ms
+    if ms > 0:
+        import random
+        import time as _time
+        _time.sleep(random.uniform(0.0, ms / 1000.0))
+
 _LEN = struct.Struct("<I")
 _REQUEST, _RESPONSE, _PUSH = 0, 1, 2
 
@@ -183,6 +200,7 @@ class Connection:
         try:
             if self._handler is None:
                 raise RpcError(f"no handler for {method}")
+            _maybe_fuzz()
             result = self._handler(self, method, payload)
             reply = (_RESPONSE, msg_id, True, result)
         except BaseException as e:  # noqa: BLE001 - errors cross the wire

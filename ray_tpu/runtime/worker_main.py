@@ -133,11 +133,24 @@ class WorkerProcess:
     # --------------------------------------------------------- normal tasks
     def _run_queued(self, spec) -> dict:
         """Enqueue and wait for completion on the executor thread, keeping
-        per-worker execution strictly serial."""
+        per-worker execution strictly serial.
+
+        ObjectRef args resolve HERE, on the push's own handler thread,
+        BEFORE the FIFO: pipelined pushes ride independent dispatch
+        threads, so push N+1 can reach the queue before push N.  If a
+        task could enter the executor with unresolved deps, a reordered
+        dependent (task2 queued ahead of the task1 it waits on) would
+        block the single executor forever — a head-of-line deadlock
+        found by the schedule fuzzer (tests/test_sched_fuzz.py)."""
+        resolved = None
+        try:
+            resolved = self._resolve_args(spec["args"])
+        except Exception as e:      # dep failed: report as task error
+            return self._package_error(spec, e)
         done = threading.Event()
         out: dict = {}
         with self._queue_cv:
-            self._queue.append((spec, done, out))
+            self._queue.append(((spec, resolved), done, out))
             self._queue_cv.notify()
         done.wait()
         if "raise" in out:
@@ -149,9 +162,10 @@ class WorkerProcess:
             with self._queue_cv:
                 while not self._queue:
                     self._queue_cv.wait()
-                spec, done, out = self._queue.pop(0)
+                work, done, out = self._queue.pop(0)
+            spec, resolved = work
             try:
-                out["reply"] = self._execute(spec)
+                out["reply"] = self._execute(spec, resolved)
             except BaseException as e:  # noqa: BLE001
                 out["raise"] = e
             done.set()
@@ -178,7 +192,7 @@ class WorkerProcess:
                 rkw[k] = v
         return tuple(resolved), rkw, borrowed
 
-    def _execute(self, spec) -> dict:
+    def _execute(self, spec, resolved=None) -> dict:
         from ray_tpu.util.tracing.tracing_helper import \
             propagate_trace_context
         fn = self.core.load_function(spec["fn_key"])
@@ -193,7 +207,8 @@ class WorkerProcess:
         propagate_trace_context(trace_ctx)
         borrowed = []
         try:
-            args, kwargs, borrowed = self._resolve_args(spec["args"])
+            args, kwargs, borrowed = (resolved if resolved is not None
+                                      else self._resolve_args(spec["args"]))
             result = fn(*args, **kwargs)
             return self._package_results(spec, result)
         except Exception as e:  # noqa: BLE001 - user errors cross the wire

@@ -1,0 +1,101 @@
+"""Schedule fuzzing: the runtime survives perturbed RPC interleavings.
+
+The reference stresses races with TSAN builds and schedule-fuzzing CI
+jobs (SURVEY.md §5 race detection).  The single-language analog here:
+``rpc_fuzz_ms`` jitters every RPC dispatch (rpc.py _maybe_fuzz), so
+orderings that "usually" hold — replies before pushes, lease grants
+before worker deaths, seal-before-fetch — get shuffled.  Any handler
+that silently depended on timing fails loudly under this suite.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def fuzzed_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024,
+                 system_config={"rpc_fuzz_ms": 8.0})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_tasks_actors_objects_under_fuzz(fuzzed_cluster):
+    """Core invariants hold when every RPC is jittered: task results
+    are exact, actor call order per caller is preserved, concurrent
+    waves complete, and store objects round-trip."""
+
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    assert ray_tpu.get([sq.remote(i) for i in range(40)],
+                       timeout=120) == [i * i for i in range(40)]
+
+    @ray_tpu.remote(num_cpus=0)
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def add(self, i):
+            self.log.append(i)
+            return len(self.log)
+
+        def all(self):
+            return self.log
+
+    s = Seq.remote()
+    refs = [s.add.remote(i) for i in range(30)]
+    counts = ray_tpu.get(refs, timeout=120)
+    # per-caller actor ordering survives the jitter: calls applied in
+    # submission order despite shuffled transport timing
+    assert counts == list(range(1, 31))
+    assert ray_tpu.get(s.all.remote(), timeout=60) == list(range(30))
+
+    big = ray_tpu.put(b"z" * 600_000)          # store path (not inline)
+    assert len(ray_tpu.get(big, timeout=60)) == 600_000
+
+
+def test_dependency_chains_under_fuzz(fuzzed_cluster):
+    """Ref-arg staging and chained lineage under jittered grants."""
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(15):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref, timeout=120) == 16
+
+
+def test_worker_death_under_fuzz(fuzzed_cluster):
+    """Actor restart FSM with jittered death notifications."""
+    @ray_tpu.remote(num_cpus=0, max_restarts=2)
+    class C:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            import os
+            os._exit(1)
+
+    c = C.remote()
+    assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
+    c.die.remote()
+    deadline = time.monotonic() + 90
+    val = None
+    while time.monotonic() < deadline:
+        try:
+            val = ray_tpu.get(c.bump.remote(), timeout=30)
+            break
+        except ray_tpu.exceptions.RayTpuError:
+            time.sleep(0.5)
+    assert val == 1, "actor did not restart under fuzz"
