@@ -864,10 +864,13 @@ class Raylet:
         # site-packages goes FIRST on PYTHONPATH so pinned versions beat
         # any same-named packages living next to ray_tpu
         python = sys.executable
+        container = None
         renv_json = (env_overrides or {}).get("RAY_TPU_RUNTIME_ENV")
         if renv_json:
             import json as _json
-            pip_reqs = _json.loads(renv_json).get("pip")
+            renv = _json.loads(renv_json)
+            container = renv.get("container")
+            pip_reqs = renv.get("pip")
             if pip_reqs:
                 from ray_tpu.runtime_env.pip import (ensure_pip_env,
                                                      venv_site_packages)
@@ -886,8 +889,18 @@ class Raylet:
                "--gcs-host", self.gcs_address[0],
                "--gcs-port", str(self.gcs_address[1]),
                "--node-id", self.node_id.hex()]
+        if container:
+            # containerized workers exec inside the image (cannot fork
+            # off the host zygote); the builder raises a clean error
+            # when no container runtime exists on this host
+            from ray_tpu.runtime_env.container import wrap_worker_command
+            cmd = wrap_worker_command(container, cmd,
+                                      session_dir=self.session_dir,
+                                      store_path=self.store_path,
+                                      env=env)
         proc = None
-        if CONFIG.worker_prefork and python == sys.executable and \
+        if CONFIG.worker_prefork and container is None and \
+                python == sys.executable and \
                 not _env_needs_exec(env_overrides):
             # stock interpreter, no import-time-sensitive env overrides:
             # fork off the warm zygote (ms) instead of exec+reimport

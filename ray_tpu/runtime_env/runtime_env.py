@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.runtime_env import packaging
 
 _ALLOWED = {"env_vars", "working_dir", "py_modules", "pip", "conda",
-            "config"}
+            "container", "config"}
 
 
 class RuntimeEnv(dict):
@@ -35,6 +35,7 @@ class RuntimeEnv(dict):
                  working_dir: Optional[str] = None,
                  py_modules: Optional[List[str]] = None,
                  pip: Any = None, conda: Any = None,
+                 container: Optional[Dict[str, Any]] = None,
                  config: Optional[Dict[str, Any]] = None):
         super().__init__()
         if env_vars:
@@ -54,6 +55,9 @@ class RuntimeEnv(dict):
         if pip:
             from ray_tpu.runtime_env.pip import normalize_pip_field
             self["pip"] = normalize_pip_field(pip)
+        if container:
+            from ray_tpu.runtime_env.container import validate
+            self["container"] = validate(container)
         if conda:
             # conda specs fold into the same venv isolation path as pip:
             # the environment.yml's dependencies become requirements (the
@@ -96,6 +100,8 @@ def prepare_runtime_env(raw: Optional[Dict[str, Any]], gcs
                               for m in env["py_modules"]]
     if env.get("pip"):
         desc["pip"] = list(env["pip"])
+    if env.get("container"):
+        desc["container"] = dict(env["container"])
     if env.get("config"):
         desc["config"] = dict(env["config"])
     if not desc:

@@ -185,3 +185,94 @@ def test_pip_runtime_env_bad_package_fails_cleanly(tmp_path):
             ray_tpu.get(ref, timeout=120)
     finally:
         ray_tpu.shutdown()
+
+
+def test_container_command_construction(tmp_path):
+    """wrap_worker_command builds the reference-shaped podman/docker
+    invocation: session + store mounts, host namespaces, critical env as
+    explicit --env, run_options, --entrypoint python, image, worker
+    args.  Pure construction — no container runtime needed."""
+    import pytest
+
+    from ray_tpu.runtime_env.container import (ContainerError, validate,
+                                               wrap_worker_command)
+
+    with pytest.raises(ContainerError):
+        validate({})                       # no image
+    with pytest.raises(ContainerError):
+        validate({"image": "img", "run_options": "not-a-list"})
+
+    fake = tmp_path / "fakedriver"
+    fake.write_text("#!/bin/sh\n")
+    fake.chmod(0o755)
+    cmd = wrap_worker_command(
+        {"image": "myimg:1", "driver": str(fake),
+         "run_options": ["--memory=1g"]},
+        ["/usr/bin/python3", "-m", "ray_tpu.runtime.worker_main",
+         "--worker-id", "abc"],
+        session_dir="/tmp/sess", store_path="/dev/shm/ray_tpu_store_x",
+        env={"PYTHONPATH": "/repo", "RAY_TPU_SYSTEM_CONFIG": "{}",
+             "IGNORED_KEY": "x"})
+    assert cmd[0] == str(fake) and cmd[1] == "run"
+    assert "-v" in cmd and "/tmp/sess:/tmp/sess" in cmd
+    assert "/dev/shm:/dev/shm" in cmd
+    for ns in ("--network=host", "--pid=host", "--ipc=host"):
+        assert ns in cmd
+    assert "PYTHONPATH=/repo" in cmd
+    assert not any(c.startswith("IGNORED_KEY") for c in cmd)
+    assert "--memory=1g" in cmd
+    i = cmd.index("--entrypoint")
+    assert cmd[i + 1] == "python" and cmd[i + 2] == "myimg:1"
+    # host interpreter path is dropped; worker args survive
+    assert "/usr/bin/python3" not in cmd
+    assert cmd[-3:] == ["ray_tpu.runtime.worker_main",
+                        "--worker-id", "abc"][-3:]
+
+    with pytest.raises(ContainerError, match="not found"):
+        wrap_worker_command({"image": "img", "driver": "no-such-runtime"},
+                            ["python", "-m", "x"], session_dir="/t",
+                            store_path="/dev/shm/s", env={})
+
+
+def test_container_runtime_env_end_to_end(ray_start_regular, tmp_path):
+    """A task with runtime_env={"container": ...} executes through the
+    container driver: a recording fake driver proves the raylet wrapped
+    the worker spawn (and passes execution through, standing in for a
+    real podman on hosts that have one)."""
+    import os
+
+    import ray_tpu
+
+    record = tmp_path / "invocations.log"
+    fake = tmp_path / "fakepodman"
+    # records its argv, then strips the container wrapping and execs the
+    # worker with the image's entrypoint (host python stands in)
+    fake.write_text(f"""#!/bin/bash
+echo "$@" >> {record}
+args=()
+entry=python
+seen_image=0
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    run|--rm|--network=host|--pid=host|--ipc=host) shift ;;
+    -v|--env) shift 2 ;;
+    --entrypoint) entry="$2"; shift 2 ;;
+    testimg:*) seen_image=1; shift ;;
+    *) if [[ $seen_image == 1 ]]; then args+=("$1"); fi; shift ;;
+  esac
+done
+exec "$entry" "${{args[@]}}"
+""")
+    fake.chmod(0o755)
+
+    @ray_tpu.remote(runtime_env={"container": {"image": "testimg:9",
+                                               "driver": str(fake)}})
+    def inside():
+        return os.getpid()
+
+    pid = ray_tpu.get(inside.remote(), timeout=300)
+    assert isinstance(pid, int)
+    logged = record.read_text()
+    assert "testimg:9" in logged
+    assert "--network=host" in logged
+    assert "worker_main" in logged
