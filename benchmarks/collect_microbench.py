@@ -1,12 +1,16 @@
 """Assemble MICROBENCH.json from the individual benchmark programs.
 
 Counterpart of the reference's release/benchmarks result collection:
-runs the core ops/s suite (ray_perf), the Serve qps/latency/overhead
-benchmark, and the Data bulk-ingest benchmark — each in its own process
-so daemons can't leak between sections — and merges their JSON output
-with the scale-envelope numbers recorded by tests/test_scale_envelope.py.
+each registered section (core ops/s, serve qps, data ingest, LLM
+serving, RL, vision) runs in its own process so daemons can't leak
+between sections, and their JSON outputs are merged into one file.
+Sections that a run does NOT regenerate — because `--only` skipped
+them, their script produced no rows, or they were written by another
+program (the scale envelope from tests/test_scale_envelope.py) — are
+preserved verbatim from the existing output file.
 
 Usage:  python benchmarks/collect_microbench.py [-o MICROBENCH.json]
+                                                [--only SECTION ...]
 """
 
 import argparse
@@ -39,11 +43,60 @@ def _run_json_lines(cmd, timeout=900):
     return rows
 
 
+# Every benchmark program the collector owns, in run order.  Adding a
+# section here is the ONLY step needed for it to survive refreshes: any
+# section present in the existing output file that the current run does
+# not regenerate is carried over verbatim (merge-preserve), so a partial
+# `--only` refresh can never silently drop another program's numbers.
+SECTIONS = {
+    "core": dict(cmd=[sys.executable, "-m", "ray_tpu._private.ray_perf"],
+                 timeout=900, last_list=True),
+    "serve": dict(cmd=[sys.executable,
+                       os.path.join(REPO, "benchmarks", "serve_qps.py")],
+                  timeout=900),
+    "data": dict(cmd=[sys.executable,
+                      os.path.join(REPO, "benchmarks", "data_ingest.py")],
+                 timeout=900),
+    "serve_llm": dict(cmd=[sys.executable,
+                           os.path.join(REPO, "benchmarks", "serve_llm.py"),
+                           "--slots", "32", "--requests", "128"],
+                      timeout=2400),
+    "rl": dict(cmd=[sys.executable,
+                    os.path.join(REPO, "benchmarks", "rl_perf.py")],
+               timeout=1800),
+    "vision": dict(cmd=[sys.executable,
+                        os.path.join(REPO, "benchmarks", "vision_perf.py")],
+                   timeout=1800),
+}
+
+
+def merge_preserve(out, prev, regenerated):
+    """Carry over every section of `prev` that this run didn't regenerate.
+
+    This is the fix for the round-4 data loss where a refresh that only
+    ran {core,serve,data,serve_llm} rewrote the whole file and dropped
+    the `rl` section: unknown or un-regenerated keys now survive.
+    """
+    meta = {"generated", "host", "note"}
+    for key, val in prev.items():
+        if key in meta or key in regenerated:
+            continue
+        out[key] = val
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("-o", "--output",
                     default=os.path.join(REPO, "MICROBENCH.json"))
+    ap.add_argument("--only", nargs="*", default=None,
+                    help="run only these sections (others are preserved "
+                         "from the existing output file)")
     args = ap.parse_args()
+    selected = list(SECTIONS) if args.only is None else args.only
+    unknown = [s for s in selected if s not in SECTIONS]
+    if unknown:
+        ap.error(f"unknown sections {unknown}; known: {list(SECTIONS)}")
 
     try:
         import psutil
@@ -61,34 +114,41 @@ def main():
                 "box has 1 physical core — per-core comparisons only",
     }
 
-    print("[collect] core ops/s suite (ray_perf)...", flush=True)
-    core = _run_json_lines(
-        [sys.executable, "-m", "ray_tpu._private.ray_perf"])
-    out["core"] = core[-1] if core and isinstance(core[-1], list) else core
+    regenerated = set()
+    for name in selected:
+        spec = SECTIONS[name]
+        script = next((a for a in spec["cmd"] if a.endswith(".py")), None)
+        if script and not os.path.exists(script):
+            # tolerable on a default all-sections sweep (a section can be
+            # registered ahead of its script landing), but an explicit
+            # --only request for it is a user error
+            if args.only is not None:
+                ap.error(f"--only {name}: {script} does not exist")
+            print(f"[collect] {name}: {script} missing, skipping "
+                  "(existing numbers preserved)", flush=True)
+            continue
+        print(f"[collect] {name}: {' '.join(spec['cmd'][1:])}", flush=True)
+        rows = _run_json_lines(spec["cmd"], timeout=spec["timeout"])
+        if spec.get("last_list") and rows and isinstance(rows[-1], list):
+            rows = rows[-1]
+        if not rows:
+            # rc=0 but no JSON output: treat as not regenerated so the
+            # previous good numbers survive instead of being wiped by []
+            print(f"[collect] {name}: no JSON rows produced, "
+                  "keeping previous numbers", flush=True)
+            continue
+        out[name] = rows
+        regenerated.add(name)
 
-    print("[collect] serve qps/latency/overhead...", flush=True)
-    out["serve"] = _run_json_lines(
-        [sys.executable, os.path.join(REPO, "benchmarks", "serve_qps.py")])
-
-    print("[collect] data bulk ingest...", flush=True)
-    out["data"] = _run_json_lines(
-        [sys.executable, os.path.join(REPO, "benchmarks", "data_ingest.py")])
-
-    print("[collect] LLM serving (continuous batching, real chip)...",
-          flush=True)
-    out["serve_llm"] = _run_json_lines(
-        [sys.executable, os.path.join(REPO, "benchmarks", "serve_llm.py"),
-         "--slots", "32", "--requests", "128"], timeout=2400)
-
-    # scale envelope: written by tests/test_scale_envelope.py when it runs;
-    # keep the previous numbers if present
+    # merge-preserve: sections this run didn't regenerate (including the
+    # envelope written by tests/test_scale_envelope.py, and any section a
+    # future program adds) survive the refresh
     try:
         with open(args.output) as f:
             prev = json.load(f)
-        if "envelope" in prev:
-            out["envelope"] = prev["envelope"]
     except (OSError, ValueError):
-        pass
+        prev = {}
+    merge_preserve(out, prev, regenerated)
 
     with open(args.output, "w") as f:
         json.dump(out, f, indent=1)

@@ -1,0 +1,111 @@
+"""Unit tests for the MICROBENCH collector's merge-preserve contract.
+
+Counterpart of the discipline in the reference's
+release/microbenchmark/run_microbenchmark.py: every benchmark program is
+a first-class section, and a refresh that regenerates only some sections
+must never drop the others.  (Round-4 regression: a refresh that didn't
+run rl_perf.py rewrote MICROBENCH.json and silently lost the `rl`
+section.)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+from collect_microbench import SECTIONS, merge_preserve  # noqa: E402
+
+
+def test_rl_is_a_first_class_section():
+    assert "rl" in SECTIONS
+    assert any("rl_perf.py" in a for a in SECTIONS["rl"]["cmd"])
+
+
+def test_unknown_sections_survive_a_refresh():
+    prev = {
+        "generated": "old",
+        "host": {"cpus": 1},
+        "rl": [{"metric": "rl_ppo_cartpole", "env_steps_per_s": 363.1}],
+        "envelope": {"tasks_1m": {"per_s": 2185}},
+        "some_future_section": {"x": 1},
+    }
+    out = {"generated": "new", "host": {"cpus": 1},
+           "core": [{"metric": "tasks_per_s"}]}
+    merge_preserve(out, prev, regenerated={"core"})
+    # un-regenerated sections carried over verbatim
+    assert out["rl"] == prev["rl"]
+    assert out["envelope"] == prev["envelope"]
+    assert out["some_future_section"] == {"x": 1}
+    # regenerated + metadata keys are NOT clobbered by the old file
+    assert out["generated"] == "new"
+    assert out["core"] == [{"metric": "tasks_per_s"}]
+
+
+def test_regenerated_section_replaces_old_value():
+    prev = {"rl": [{"env_steps_per_s": 1.0}]}
+    out = {"rl": [{"env_steps_per_s": 2.0}]}
+    merge_preserve(out, prev, regenerated={"rl"})
+    assert out["rl"] == [{"env_steps_per_s": 2.0}]
+
+
+def test_empty_rows_do_not_clobber_previous_numbers():
+    """A section that exits 0 but prints no JSON must not be treated as
+    regenerated — that would wipe good numbers with []."""
+    prev = {"rl": [{"env_steps_per_s": 363.1}]}
+    out = {}  # collector skipped adding 'rl' because rows was empty
+    merge_preserve(out, prev, regenerated=set())
+    assert out["rl"] == prev["rl"]
+
+
+def test_only_flag_rejects_missing_script(tmp_path):
+    """Explicitly requesting a section whose script doesn't exist is an
+    error, not a silent no-op."""
+    def script_of(spec):
+        return next((a for a in spec["cmd"] if a.endswith(".py")), None)
+    missing = [n for n, s in SECTIONS.items()
+               if script_of(s) and not os.path.exists(script_of(s))]
+    if not missing:
+        return  # all scripts exist now; the guard is covered by review
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "collect_microbench.py"),
+         "-o", str(tmp_path / "mb.json"), "--only", missing[0]],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "does not exist" in proc.stderr
+
+
+def test_only_flag_rejects_unknown_section(tmp_path):
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "collect_microbench.py"),
+         "-o", str(tmp_path / "mb.json"), "--only", "nonexistent"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "unknown sections" in proc.stderr
+
+
+def test_only_refresh_preserves_other_sections_end_to_end(tmp_path):
+    """Drive the real CLI with --only over a missing-script section: the
+    run regenerates nothing, so every pre-existing section must survive."""
+    out_path = tmp_path / "mb.json"
+    seed = {"generated": "old", "rl": [{"env_steps_per_s": 363.1}],
+            "envelope": {"ok": True}}
+    out_path.write_text(json.dumps(seed))
+    # 'vision' resolves to benchmarks/vision_perf.py; run from a cwd where
+    # the script path exists or not — the collector skips missing scripts
+    # and must preserve.  Use --only with an empty list: regenerates
+    # nothing at all.
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "collect_microbench.py"),
+         "-o", str(out_path), "--only"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    data = json.loads(out_path.read_text())
+    assert data["rl"] == seed["rl"]
+    assert data["envelope"] == seed["envelope"]
+    assert data["generated"] != "old"
