@@ -40,7 +40,7 @@ def _run_json_lines(cmd, timeout=900):
                 pass
     if proc.returncode != 0 and not rows:
         raise RuntimeError(f"{cmd}: rc={proc.returncode}\n{proc.stderr[-2000:]}")
-    return rows
+    return rows, proc.returncode
 
 
 # Every benchmark program the collector owns, in run order.  Adding a
@@ -128,14 +128,23 @@ def main():
                   "(existing numbers preserved)", flush=True)
             continue
         print(f"[collect] {name}: {' '.join(spec['cmd'][1:])}", flush=True)
-        rows = _run_json_lines(spec["cmd"], timeout=spec["timeout"])
+        try:
+            rows, rc = _run_json_lines(spec["cmd"], timeout=spec["timeout"])
+        except (RuntimeError, subprocess.TimeoutExpired) as e:
+            # one failing section must not abort the sweep or discard the
+            # sections that already completed
+            print(f"[collect] {name}: FAILED ({e}); "
+                  "keeping previous numbers", flush=True)
+            continue
         if spec.get("last_list") and rows and isinstance(rows[-1], list):
             rows = rows[-1]
-        if not rows:
-            # rc=0 but no JSON output: treat as not regenerated so the
-            # previous good numbers survive instead of being wiped by []
-            print(f"[collect] {name}: no JSON rows produced, "
-                  "keeping previous numbers", flush=True)
+        if not rows or rc != 0:
+            # no JSON output, or a crash after partial output: either way
+            # the previous good numbers survive — a truncated row set
+            # must never replace a complete one
+            print(f"[collect] {name}: "
+                  f"{'no JSON rows' if not rows else f'rc={rc} (partial)'}"
+                  ", keeping previous numbers", flush=True)
             continue
         out[name] = rows
         regenerated.add(name)

@@ -94,10 +94,14 @@ def read_mongo(uri: str, database: str, collection: str, *,
                pipeline: Optional[List[dict]] = None,
                parallelism: int = 4) -> Dataset:
     """Read a MongoDB collection (cf. reference
-    python/ray/data/datasource/mongo_datasource.py).  Splits on _id
-    ranges into parallel read tasks.  Requires pymongo (not baked into
-    this image — the import error says so at call time, not deep in a
-    worker)."""
+    python/ray/data/datasource/mongo_datasource.py).  Paginates with
+    $skip/$limit into parallel read tasks — simpler than the
+    reference's _id-range splitting, with the standard caveats: each
+    task re-scans O(skip) documents server-side, and concurrent writes
+    during the read can duplicate or miss documents.  Use a quiesced
+    collection (or a pipeline filter pinning a snapshot) for exact
+    results.  Requires pymongo (not baked into this image — the import
+    error says so at call time, not deep in a worker)."""
     try:
         import pymongo  # noqa: F401
     except ImportError as e:

@@ -52,8 +52,12 @@ class PathPartitionParser:
         base = self._p.base_dir.rstrip("/")
         if base:
             # tolerate absolute/relative mismatches: split on the base
-            # dir's last occurrence so URIs work too
-            idx = rel.rfind(base)
+            # dir's last occurrence, anchored at path-component
+            # boundaries so base "data" can't match inside "/mydata/"
+            marker = base + "/"
+            idx = rel.rfind(marker)
+            while idx > 0 and rel[idx - 1] != "/":
+                idx = rel.rfind(marker, 0, idx)
             if idx >= 0:
                 rel = rel[idx + len(base):]
         parts = [c for c in rel.split("/") if c][:-1]   # drop filename

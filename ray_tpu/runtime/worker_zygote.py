@@ -107,10 +107,25 @@ def _become_worker(req: dict) -> None:
             pass
     sys.argv = req["argv"]
     from ray_tpu.runtime import worker_main
+    # os._exit (not sys.exit) everywhere: a forked worker must never run
+    # the zygote's atexit/teardown.  But a crash has to be visible —
+    # traceback to the redirected stderr (.err log) and a nonzero status.
     try:
         worker_main.main()
-    finally:
-        os._exit(0)
+    except SystemExit as e:
+        code = e.code if isinstance(e.code, int) else (0 if e.code is None
+                                                       else 1)
+        if code != 0:
+            import traceback
+            traceback.print_exc()
+            sys.stderr.flush()
+        os._exit(code)
+    except BaseException:
+        import traceback
+        traceback.print_exc()
+        sys.stderr.flush()
+        os._exit(1)
+    os._exit(0)
 
 
 def _handle_conn(conn: socket.socket, listener: socket.socket) -> None:

@@ -19,6 +19,7 @@ exec (a fork off the warm zygote cannot enter an image).
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 from typing import Dict, List, Optional
@@ -62,7 +63,8 @@ def wrap_worker_command(container: dict, cmd: List[str], *,
     store segment's directory (the worker mmaps the segment by path).
     Critical env rides as explicit --env so it works across drivers
     (podman's --env-host would leak the whole host env; the reference
-    uses it, we pass the allowlist the worker actually needs)."""
+    uses it, we pass the system allowlist plus the user's runtime_env
+    env_vars keys)."""
     c = validate(container)
     drv = driver_path(c)
     if drv is None:
@@ -74,10 +76,27 @@ def wrap_worker_command(container: dict, cmd: List[str], *,
            "-v", f"{session_dir}:{session_dir}",
            "-v", f"{store_dir}:{store_dir}",
            "--network=host", "--pid=host", "--ipc=host"]
-    for key in ("PYTHONPATH", "RAY_TPU_SYSTEM_CONFIG",
-                "RAY_TPU_RUNTIME_ENV", "RAY_TPU_INLINE_OBJECT_MAX_BYTES",
-                "JAX_PLATFORMS", "XLA_FLAGS"):
-        if env.get(key):
+    forward = ["PYTHONPATH", "RAY_TPU_SYSTEM_CONFIG",
+               "RAY_TPU_RUNTIME_ENV", "RAY_TPU_INLINE_OBJECT_MAX_BYTES",
+               "JAX_PLATFORMS", "XLA_FLAGS"]
+    # user env_vars from the runtime_env descriptor ride along too —
+    # the raylet merged them into `env`, and the descriptor JSON names
+    # which keys are the user's (the reference forwards the entire host
+    # env via --env-host; we forward system allowlist + user keys)
+    user_keys: set = set()
+    renv_json = env.get("RAY_TPU_RUNTIME_ENV")
+    if renv_json:
+        try:
+            user_keys = set(json.loads(renv_json).get("env_vars") or {})
+            forward += [k for k in user_keys if k not in forward]
+        except ValueError:
+            pass
+    for key in forward:
+        # user keys forward even when empty (blanking an image-baked
+        # var is a legitimate override); system keys only when set
+        if key in user_keys and key in env:
+            out += ["--env", f"{key}={env[key]}"]
+        elif key not in user_keys and env.get(key):
             out += ["--env", f"{key}={env[key]}"]
     out += list(c["run_options"])
     out += ["--entrypoint", "python", c["image"]]

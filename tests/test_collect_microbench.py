@@ -51,6 +51,35 @@ def test_regenerated_section_replaces_old_value():
     assert out["rl"] == [{"env_steps_per_s": 2.0}]
 
 
+def test_partial_output_from_crashed_section_is_not_regenerated(tmp_path):
+    """A benchmark that prints some rows then dies nonzero must not
+    replace the previous complete numbers with a truncated set, and must
+    not abort the rest of the sweep."""
+    import collect_microbench as cm
+    crash = tmp_path / "crash_bench.py"
+    crash.write_text("print('{\"metric\": \"partial\"}')\n"
+                     "raise SystemExit(1)\n")
+    ok = tmp_path / "ok_bench.py"
+    ok.write_text("print('{\"metric\": \"fresh\"}')\n")
+    out_path = tmp_path / "mb.json"
+    out_path.write_text(json.dumps(
+        {"crashy": [{"metric": "complete"}], "other": 1}))
+    old_sections = dict(SECTIONS)
+    SECTIONS.clear()
+    SECTIONS["crashy"] = dict(cmd=[sys.executable, str(crash)], timeout=30)
+    SECTIONS["fine"] = dict(cmd=[sys.executable, str(ok)], timeout=30)
+    try:
+        sys.argv = ["collect_microbench.py", "-o", str(out_path)]
+        cm.main()
+    finally:
+        SECTIONS.clear()
+        SECTIONS.update(old_sections)
+    data = json.loads(out_path.read_text())
+    assert data["crashy"] == [{"metric": "complete"}]   # preserved
+    assert data["fine"] == [{"metric": "fresh"}]        # sweep continued
+    assert data["other"] == 1
+
+
 def test_empty_rows_do_not_clobber_previous_numbers():
     """A section that exits 0 but prints no JSON must not be treated as
     regenerated — that would wipe good numbers with []."""

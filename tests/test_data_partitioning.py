@@ -47,6 +47,20 @@ def test_parser_hive_and_dir():
         Partitioning("zebra")
 
 
+def test_parser_base_dir_anchored_at_component_boundary():
+    # base "data" must not match inside "/mydata/": only a whole path
+    # component splits, so the hive pairs under the real "data" dir win
+    p = PathPartitionParser(Partitioning("hive", base_dir="data"))
+    assert p("/mydata/data/year=2024/f.parquet") == {"year": "2024"}
+    # no component-anchored occurrence at all -> no split, fall through
+    # to scanning the whole path for hive pairs
+    assert p("/mydata/year=2024/f.parquet") == {"year": "2024"}
+    # dir style: a substring match would shift every positional field
+    d = PathPartitionParser(Partitioning("dir", base_dir="lake",
+                                         field_names=["year"]))
+    assert d("/datalake/lake/2024/f.csv") == {"year": "2024"}
+
+
 def test_read_parquet_hive_pruning(ray_start_regular, tmp_path):
     base = _hive_tree(tmp_path)
     # a poison file inside the pruned partition: opening it would raise,

@@ -233,6 +233,30 @@ def test_container_command_construction(tmp_path):
                             ["python", "-m", "x"], session_dir="/t",
                             store_path="/dev/shm/s", env={})
 
+    # user runtime_env env_vars ride into the container as --env too:
+    # the raylet merges them into the spawn env, and the descriptor JSON
+    # (RAY_TPU_RUNTIME_ENV) names which keys are the user's
+    import json
+    renv = json.dumps({"env_vars": {"MY_FLAG": "7", "OTHER": "y"}})
+    cmd = wrap_worker_command(
+        {"image": "myimg:1", "driver": str(fake)},
+        ["/usr/bin/python3", "-m", "ray_tpu.runtime.worker_main"],
+        session_dir="/tmp/sess", store_path="/dev/shm/ray_tpu_store_x",
+        env={"RAY_TPU_RUNTIME_ENV": renv, "MY_FLAG": "7", "OTHER": "y",
+             "HOST_SECRET": "nope"})
+    assert "MY_FLAG=7" in cmd and "OTHER=y" in cmd
+    assert not any(c.startswith("HOST_SECRET") for c in cmd)
+
+    # blanking a var is a legitimate override of an image-baked value:
+    # user env_vars forward even when empty
+    renv = json.dumps({"env_vars": {"BLANKED": ""}})
+    cmd = wrap_worker_command(
+        {"image": "myimg:1", "driver": str(fake)},
+        ["/usr/bin/python3", "-m", "ray_tpu.runtime.worker_main"],
+        session_dir="/tmp/sess", store_path="/dev/shm/ray_tpu_store_x",
+        env={"RAY_TPU_RUNTIME_ENV": renv, "BLANKED": ""})
+    assert "BLANKED=" in cmd
+
 
 def test_container_runtime_env_end_to_end(ray_start_regular, tmp_path):
     """A task with runtime_env={"container": ...} executes through the
