@@ -59,8 +59,8 @@ SECTIONS = {
                  timeout=900),
     "serve_llm": dict(cmd=[sys.executable,
                            os.path.join(REPO, "benchmarks", "serve_llm.py"),
-                           "--slots", "32", "--requests", "128"],
-                      timeout=2400),
+                           "--suite", "--slots", "32", "--requests", "128"],
+                      timeout=5400),
     "rl": dict(cmd=[sys.executable,
                     os.path.join(REPO, "benchmarks", "rl_perf.py")],
                timeout=1800),

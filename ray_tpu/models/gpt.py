@@ -137,9 +137,14 @@ class Attention(nn.Module):
     mesh: Optional[Mesh] = None
     rules: ShardingRules = LOGICAL_RULES
     decode: bool = False
+    # paged decode (serve/llm_engine.py paged mode): KV lives in a shared
+    # page pool instead of dense per-row [max_seq] strips.  paged_pages=0
+    # keeps the dense layout.  See ops/paged_attention.py.
+    paged_pages: int = 0
+    page_size: int = 64
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None):
+    def __call__(self, x, cos, sin, positions=None, block_tables=None):
         cfg = self.cfg
         h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         q = _dense((h, hd), ("embed", "heads", "head_dim"), "wq",
@@ -151,7 +156,10 @@ class Attention(nn.Module):
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 
-        if self.decode:
+        if self.decode and self.paged_pages:
+            out = self._decode_attend_paged(q, k, v, positions,
+                                            block_tables)
+        elif self.decode:
             out = self._decode_attend(q, k, v, positions)
         else:
             out = self._train_attend(q, k, v)
@@ -227,19 +235,59 @@ class Attention(nn.Module):
         mask = k_idx[None, None, None, :] <= positions[:, None, :, None]
         return xla_attention(q, ck.value, cv.value, causal=False, mask=mask)
 
+    def _decode_attend_paged(self, q, k, v, positions, block_tables):
+        """Paged-pool decode: scatter this call's K/V into the rows' pages,
+        then attend over only the occupied pages (ops/paged_attention.py).
+
+        ``positions`` [B, T] as in ``_decode_attend``; ``block_tables``
+        [B, max_pages] maps each row's logical page (position // page_size)
+        to a physical page in the shared pool.  Prompt prefill is the
+        T > 1 case: the window is causal over itself (a prompt attends
+        only to its own prefix), so no pool read is needed — the scatter
+        below is the whole cache interaction, and right-pad garbage past
+        a real prompt is overwritten by decode writes before any length
+        mask makes it visible (same invariant as dense slot mode).
+        """
+        cfg = self.cfg
+        ps = self.page_size
+        # one fused pool, K in [..., :hd], V in [..., hd:]; layout
+        # dictated by TPU tiling (ops/paged_attention.py layout note)
+        pool = (self.paged_pages, cfg.n_kv_heads, ps, 2 * cfg.head_dim)
+        ckv = self.variable("cache", "kv_pages", jnp.zeros, pool,
+                            cfg.dtype)
+        if self.is_initializing():
+            return xla_attention(q, k, v, causal=True)
+        if positions is None or block_tables is None:
+            raise ValueError("paged decode requires positions and "
+                             "block_tables")
+        pages = jnp.take_along_axis(block_tables, positions // ps, axis=1)
+        offs = positions % ps
+        kv = jnp.concatenate([k, v], axis=-1).astype(cfg.dtype)
+        # advanced indices at dims 0 and 2 -> value layout [B, T, kvh, 2hd]
+        ckv.value = ckv.value.at[pages, :, offs].set(kv)
+        if q.shape[1] > 1:
+            return xla_attention(q, k, v, causal=True)
+        from ray_tpu.ops.paged_attention import paged_attention
+        out = paged_attention(q[:, 0], ckv.value, block_tables,
+                              positions[:, 0] + 1)
+        return out[:, None]
+
 
 class Block(nn.Module):
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
     rules: ShardingRules = LOGICAL_RULES
     decode: bool = False
+    paged_pages: int = 0
+    page_size: int = 64
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None):
+    def __call__(self, x, cos, sin, positions=None, block_tables=None):
         cfg = self.cfg
         y = RMSNorm(cfg.norm_eps, name="attn_norm")(x)
-        y = Attention(cfg, self.mesh, self.rules, self.decode, name="attn")(
-            y, cos, sin, positions)
+        y = Attention(cfg, self.mesh, self.rules, self.decode,
+                      self.paged_pages, self.page_size, name="attn")(
+            y, cos, sin, positions, block_tables)
         y = jax.ad_checkpoint.checkpoint_name(y, "attn_out")
         x = x + y
         y = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
@@ -269,9 +317,12 @@ class GPT(nn.Module):
     mesh: Optional[Mesh] = None
     rules: ShardingRules = LOGICAL_RULES
     decode: bool = False
+    paged_pages: int = 0                   # >0: paged KV decode (see Attention)
+    page_size: int = 64
 
     @nn.compact
-    def __call__(self, tokens, positions=None, return_hidden: bool = False):
+    def __call__(self, tokens, positions=None, return_hidden: bool = False,
+                 block_tables=None):
         cfg = self.cfg
         embed = self.param(
             "embed",
@@ -306,21 +357,24 @@ class GPT(nn.Module):
         n_remat = (cfg.n_layers if cfg.remat_layers is None
                    else max(0, min(cfg.remat_layers, cfg.n_layers)))
         block_kwargs = dict(mesh=self.mesh, rules=self.rules,
-                            decode=self.decode)
+                            decode=self.decode,
+                            paged_pages=self.paged_pages,
+                            page_size=self.page_size)
+        call_args = (cos, sin, positions, block_tables)
         if do_remat and 0 < n_remat < cfg.n_layers:
             # partial remat: the first n_remat layers recompute in the
             # backward pass, the tail stores activations (uses the HBM
             # headroom "policy" selection can't reach)
             x = stack_layers(Block, cfg, block_kwargs, x,
-                             (cos, sin, positions), remat=True,
+                             call_args, remat=True,
                              cache=True, n_layers=n_remat)
             x = stack_layers(Block, cfg, block_kwargs, x,
-                             (cos, sin, positions), remat=False,
+                             call_args, remat=False,
                              cache=True, name="blocks_tail",
                              n_layers=cfg.n_layers - n_remat)
         else:
             x = stack_layers(Block, cfg, block_kwargs, x,
-                             (cos, sin, positions), remat=do_remat,
+                             call_args, remat=do_remat,
                              cache=True)
 
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)

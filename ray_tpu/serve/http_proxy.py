@@ -3,8 +3,11 @@
 Analog of /root/reference/python/ray/serve/_private/http_proxy.py
 (HTTPProxyActor :387, HTTPProxy :218, uvicorn/starlette there; aiohttp
 here — starlette isn't baked in). Routes ``/{deployment}`` with a JSON
-body to ``handle.remote(body)``; replica calls run in an executor so the
-event loop stays free.
+body to ``handle.remote(body)``.  The request path stays ON the event
+loop (``DeploymentHandle.try_remote`` + owned-object readiness
+callbacks); the blocking executor is a fallback for backpressured
+submits and cross-node result pulls only (round-4 redesign — the old
+executor-per-request path throttled the proxy at the thread pool).
 """
 
 from __future__ import annotations

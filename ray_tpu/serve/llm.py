@@ -45,6 +45,8 @@ class LLMServer:
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                  block_size: int = 32, max_seq_len: Optional[int] = None,
                  warmup_prompt_lens: Optional[list] = None,
+                 paged: bool = False, page_size: int = 64,
+                 kv_pool_pages: Optional[int] = None,
                  config_overrides: Optional[Dict[str, Any]] = None):
         from ray_tpu.models.configs import get_config
         from ray_tpu.serve.llm_engine import LLMEngine
@@ -55,7 +57,9 @@ class LLMServer:
                                 max_prompt_len=max_prompt_len,
                                 top_k=top_k, top_p=top_p, seed=seed,
                                 block_size=block_size,
-                                max_seq_len=max_seq_len)
+                                max_seq_len=max_seq_len, paged=paged,
+                                page_size=page_size,
+                                kv_pool_pages=kv_pool_pages)
         if warmup_prompt_lens:
             # pay all compiles at replica start, none at request time
             self.engine.warmup(prompt_lens=warmup_prompt_lens)

@@ -38,6 +38,27 @@ device->host fetch costs a full round-trip that outweighs a decode step
 The host loop owns admission/eviction and runs on a plain thread;
 ``submit`` is loop-aware like serve's ``_BatchQueue.submit`` (awaitable
 from an async replica, blocking from a plain thread).
+
+PAGED MODE (``paged=True``) replaces the dense per-slot ``[max_seq]``
+cache rows with a shared page pool + per-row block tables
+(ops/paged_attention.py):
+
+  - HBM: decode attention reads only the pages a row occupies (the
+    Pallas kernel's fori_loop bound is the row's page count), so long
+    ``max_seq_len`` stops costing bandwidth per step, and KV capacity
+    is pooled instead of reserved per slot.
+  - TTFT: prefill becomes SLOTLESS — a queued request's prompt K/V is
+    written straight into freshly allocated pages and its first token
+    sampled *before* any decode slot frees (prefill-ahead).  Requests
+    then wait in a ready queue holding their first token; a freeing
+    slot "installs" one by uploading its (token, position, table) row
+    into the block step's device state.  Time-to-first-token is bounded
+    by prefill throughput and pool capacity, not by slot turnover —
+    the saturation-TTFT fix the dense engine could not express.
+  - Safety: a freed slot keeps stepping junk until its redirect row
+    (table -> scratch page 0) rides the next block dispatch; pages are
+    recycled only through dispatches ordered after the last junk write
+    (device stream order), so reuse can never corrupt a live request.
 """
 
 from __future__ import annotations
@@ -83,14 +104,28 @@ class _Request:
 
 
 class _Slot:
-    __slots__ = ("request", "pos", "out", "last_token", "first_token_at")
+    __slots__ = ("request", "pos", "out", "last_token", "first_token_at",
+                 "pages")
 
-    def __init__(self, request: _Request, prompt_len: int, first_token: int):
+    def __init__(self, request: _Request, prompt_len: int, first_token: int,
+                 pages: Optional[List[int]] = None):
         self.request = request
         self.pos = prompt_len            # next write position
         self.out = [first_token]
         self.last_token = first_token
         self.first_token_at = time.monotonic()
+        self.pages = pages or []         # paged mode: physical pages owned
+
+
+class _Prefilled:
+    """Paged mode: a request whose prompt K/V already sits in pool pages
+    and whose first token is known, waiting for a decode slot."""
+
+    __slots__ = ("slot_state", "table")
+
+    def __init__(self, slot_state: _Slot, table):
+        self.slot_state = slot_state     # reused verbatim at install
+        self.table = table               # np.int32 [max_pages]
 
 
 class EngineStats:
@@ -126,7 +161,9 @@ class LLMEngine:
                  num_slots: int = 8, max_prompt_len: Optional[int] = None,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                  min_prefill_bucket: int = 16, block_size: int = 32,
-                 max_seq_len: Optional[int] = None):
+                 max_seq_len: Optional[int] = None,
+                 paged: bool = False, page_size: int = 64,
+                 kv_pool_pages: Optional[int] = None):
         # Inference engine owns its own copies of the knobs a server
         # tunes independently of training:
         #  - max_seq_len: the KV allocation AND the per-step attention
@@ -149,7 +186,20 @@ class LLMEngine:
         self.max_prompt_len = max_prompt_len or cfg.max_seq_len // 2
         self._min_bucket = min_prefill_bucket
         self.block_size = block_size
-        self.model = GPT(cfg, decode=True)
+        self.paged = paged
+        if paged:
+            self.page_size = page_size
+            self.max_pages = -(-cfg.max_seq_len // page_size)
+            # page 0 is the scratch page (zeroed tables point there);
+            # default pool: 4x the slots' worst case, so the ready queue
+            # can prefill well ahead of slot turnover
+            self.kv_pool_pages = (kv_pool_pages if kv_pool_pages
+                                  else 1 + 4 * num_slots * self.max_pages)
+            self.model = GPT(cfg, decode=True,
+                             paged_pages=self.kv_pool_pages,
+                             page_size=page_size)
+        else:
+            self.model = GPT(cfg, decode=True)
         self.stats = EngineStats()
 
         self._rng = jax.random.PRNGKey(seed)
@@ -171,12 +221,24 @@ class LLMEngine:
         # temps*1e6 row — one upload per quantum, cached when empty
         no_meta = np.zeros((3, num_slots), np.int32)
         no_meta[0, :] = num_slots                           # -> scratch
-        self._no_admit = (jnp.asarray(no_meta),
-                          jnp.zeros((num_slots,), jnp.int32))
         self._prefill_jit: dict = {}      # (bucket, wave) -> jitted fn
         self._insert_jit: dict = {}       # (bucket, wave) -> jitted fn
-        self._block_jit = jax.jit(self._block_fn,
-                                  donate_argnums=(1, 2))
+        if paged:
+            self._no_admit = (jnp.asarray(no_meta),
+                              jnp.zeros((num_slots,), jnp.int32),
+                              jnp.zeros((num_slots, self.max_pages),
+                                        jnp.int32))
+            self._free_pages: List[int] = list(
+                range(1, self.kv_pool_pages))[::-1]
+            self._ready: collections.deque = collections.deque()
+            self._stale_slots: set = set()   # evicted, redirect pending
+            self._block_jit = jax.jit(self._block_fn_paged,
+                                      donate_argnums=(1, 2))
+        else:
+            self._no_admit = (jnp.asarray(no_meta),
+                              jnp.zeros((num_slots,), jnp.int32))
+            self._block_jit = jax.jit(self._block_fn,
+                                      donate_argnums=(1, 2))
 
     # ------------------------------------------------------------ jit fns
 
@@ -185,10 +247,15 @@ class LLMEngine:
         return init_decode_cache(self.model, batch)
 
     def _init_state(self, seed: int):
-        return (jnp.zeros((self._rows,), jnp.int32),      # tokens
-                jnp.zeros((self._rows,), jnp.int32),      # positions
-                jnp.zeros((self._rows,), jnp.float32),    # temps
-                jax.random.PRNGKey(seed))                 # device rng
+        state = (jnp.zeros((self._rows,), jnp.int32),     # tokens
+                 jnp.zeros((self._rows,), jnp.int32),     # positions
+                 jnp.zeros((self._rows,), jnp.float32),   # temps
+                 jax.random.PRNGKey(seed))                # device rng
+        if self.paged:
+            # + per-row block tables (zeros -> every page is scratch)
+            state = state[:3] + (jnp.zeros(
+                (self._rows, self.max_pages), jnp.int32),) + state[3:]
+        return state
 
     def _sample_fn(self, rng, logits, temps):
         """[B, V] logits + per-row temperature -> [B] token ids
@@ -284,6 +351,66 @@ class LLMEngine:
         combined = jnp.concatenate([block.T.reshape(-1), a_firsts])
         return combined, (tokens, positions, temps, rng), cache
 
+    # ------------------------------------------------ paged-mode jit fns
+
+    def _get_prefill_paged(self, bucket: int, wave: int):
+        """Slotless prefill: prompts write straight into pool pages via
+        the model's paged path (the T>1 case of _decode_attend_paged);
+        the per-row last REAL logit samples the first token in-jit.
+        Donates the pool cache (it chains through every engine call)."""
+        fn = self._prefill_jit.get((bucket, wave))
+        if fn is None:
+            def prefill(params, cache, packed, tables, rng):
+                # packed [wave, bucket+2]: prompt tokens | s_real | temp*1e6
+                tokens = packed[:, :bucket]
+                s_reals = packed[:, bucket]
+                temps = packed[:, bucket + 1].astype(jnp.float32) / 1e6
+                b, s = tokens.shape
+                positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+                logits, mut = self.model.apply(
+                    {"params": params, "cache": cache}, tokens, positions,
+                    block_tables=tables, mutable=["cache"])
+                last = jnp.take_along_axis(
+                    logits, (s_reals - 1)[:, None, None], axis=1)[:, 0]
+                first = self._sample_fn(rng, last, temps)
+                return first, mut["cache"]
+            fn = self._prefill_jit[(bucket, wave)] = jax.jit(
+                prefill, donate_argnums=(1,))
+        return fn
+
+    def _block_fn_paged(self, params, cache, state, admit_meta,
+                        admit_lasts, admit_tables):
+        """Paged block step.  Differences from _block_fn: per-row block
+        tables ride the device state; installs upload their CURRENT last
+        token (known to the host since the request's prefill quantum) so
+        nothing extra is fetched; redirect rows (evicted slots) are just
+        installs of (token 0, position 0, zero table -> scratch page)."""
+        tokens, positions, temps, tables, rng = state
+        a_slots = admit_meta[0]
+        tokens = tokens.at[a_slots].set(admit_lasts)
+        positions = positions.at[a_slots].set(admit_meta[1])
+        temps = temps.at[a_slots].set(
+            admit_meta[2].astype(jnp.float32) / 1e6)
+        tables = tables.at[a_slots].set(admit_tables)
+        rng, sub = jax.random.split(rng)
+        keys = jax.random.split(sub, self.block_size)
+
+        def one(carry, key):
+            tokens, positions, cache = carry
+            logits, mut = self.model.apply(
+                {"params": params, "cache": cache}, tokens[:, None],
+                positions[:, None], block_tables=tables,
+                mutable=["cache"])
+            nxt = self._sample_fn(key, logits[:, -1], temps)
+            positions = jnp.minimum(positions + 1,
+                                    self.cfg.max_seq_len - 1)
+            return (nxt, positions, mut["cache"]), nxt
+
+        (tokens, positions, cache), block = jax.lax.scan(
+            one, (tokens, positions, cache), keys)
+        return (block.T.reshape(-1),
+                (tokens, positions, temps, tables, rng), cache)
+
     # ------------------------------------------------------------- public
 
     def warmup(self, prompt_lens=(64,)) -> None:
@@ -295,6 +422,14 @@ class LLMEngine:
         rng = jax.random.PRNGKey(0)
         for bucket in buckets:
             for wave in _WAVE_SIZES:
+                if self.paged:
+                    packed = np.zeros((wave, bucket + 2), np.int32)
+                    packed[:, bucket] = 1
+                    tables = jnp.zeros((wave, self.max_pages), jnp.int32)
+                    _, self._cache = self._get_prefill_paged(
+                        bucket, wave)(self.params, self._cache,
+                                      jnp.asarray(packed), tables, rng)
+                    continue
                 packed = np.zeros((wave, bucket + 3), np.int32)
                 packed[:, bucket] = 1
                 packed[:, bucket + 1] = self.num_slots      # scratch
@@ -376,17 +511,31 @@ class LLMEngine:
             b *= 2
         return min(b, self.cfg.max_seq_len)
 
+    def _wave_chunks(self, items: list):
+        """Group (req, payload) pairs by prompt-length bucket and yield
+        (bucket, chunk, wave_size) batches — the one admission-batching
+        policy both the dense and the paged prefill paths follow."""
+        by_bucket: dict = {}
+        for item in items:
+            by_bucket.setdefault(self._bucket(len(item[0].prompt)),
+                                 []).append(item)
+        for bucket, group in by_bucket.items():
+            for start in range(0, len(group), _WAVE_SIZES[-1]):
+                chunk = group[start:start + _WAVE_SIZES[-1]]
+                wave = next(w for w in _WAVE_SIZES if w >= len(chunk))
+                yield bucket, chunk, wave
+
     def _next_key(self):
         self._rng, key = jax.random.split(self._rng)
         return key
 
-    def _dispatch_admission_wave(self, group: list, bucket: int):
+    def _dispatch_admission_wave(self, group: list, bucket: int,
+                                 wave: int):
         """One batched prefill + one batched cache insert for admits
         sharing a prompt-length bucket.  Returns the DEVICE array of
         their first tokens — nothing is fetched here, and everything
         rides ONE packed upload (each host->device transfer is a
         round-trip on a remote-chip transport)."""
-        wave = next(w for w in _WAVE_SIZES if w >= len(group))
         # packed layout per row: [prompt(bucket) | s_real | slot | temp*1e6]
         packed = np.zeros((wave, bucket + 3), np.int32)
         packed[:, bucket] = 1
@@ -432,31 +581,49 @@ class LLMEngine:
         except Exception:
             pass
 
-    def _maybe_finish(self, i: int) -> bool:
-        sl = self._slots[i]
+    @staticmethod
+    def _finish_reason(sl: _Slot, max_seq_len: int) -> Optional[str]:
         req = sl.request
-        reason = None
         if req.eos_id is not None and sl.last_token == req.eos_id:
-            reason = "eos"
-        elif len(sl.out) >= req.max_new_tokens:
-            reason = "length"
-        elif sl.pos + 1 >= self.cfg.max_seq_len:
-            reason = "length"
-        if reason is None:
-            return False
+            return "eos"
+        if len(sl.out) >= req.max_new_tokens:
+            return "length"
+        if sl.pos + 1 >= max_seq_len:
+            return "length"
+        return None
+
+    def _deliver_result(self, sl: _Slot, reason: str) -> None:
+        req = sl.request
         now = time.monotonic()
         result = GenerationResult(
             tokens=sl.out, finish_reason=reason,
             prompt_len=sl.pos - len(sl.out) + 1,
             time_to_first_token_s=sl.first_token_at - req.submitted_at,
             latency_s=now - req.submitted_at)
-        self._slots[i] = None
-        self._free.append(i)
         self.stats.requests_completed += 1
         self._safe_deliver(req, True, result)
+
+    def _maybe_finish(self, i: int) -> bool:
+        sl = self._slots[i]
+        reason = self._finish_reason(sl, self.cfg.max_seq_len)
+        if reason is None:
+            return False
+        self._slots[i] = None
+        self._free.append(i)
+        if self.paged:
+            # the freed slot junk-steps its old table until its redirect
+            # row rides a block dispatch; pages recycle only through
+            # later dispatches, so immediate free is stream-safe (see
+            # module docstring)
+            self._stale_slots.add(i)
+            self._free_pages.extend(sl.pages)
+            sl.pages = []
+        self._deliver_result(sl, reason)
         return True
 
     def _loop(self):
+        if self.paged:
+            return self._loop_paged()
         # Software-pipelined: quantum k+1 is DISPATCHED before quantum
         # k's results are fetched and processed, so the device never
         # idles on the host's fetch round-trip or bookkeeping.  The
@@ -518,16 +685,10 @@ class LLMEngine:
         decoding on device but not yet placed in _slots."""
         admitted = []                      # (req, slot) in firsts order
         firsts_parts = []
-        by_bucket: dict = {}
-        for req, slot in admits:
-            by_bucket.setdefault(self._bucket(len(req.prompt)),
-                                 []).append((req, slot))
-        for bucket, group in by_bucket.items():
-            for start in range(0, len(group), _WAVE_SIZES[-1]):
-                chunk = group[start:start + _WAVE_SIZES[-1]]
-                firsts_parts.append(
-                    self._dispatch_admission_wave(chunk, bucket))
-                admitted.extend(chunk)
+        for bucket, chunk, wave in self._wave_chunks(admits):
+            firsts_parts.append(
+                self._dispatch_admission_wave(chunk, bucket, wave))
+            admitted.extend(chunk)
 
         rows = [(i, s.request) for i, s in enumerate(self._slots)
                 if s is not None]
@@ -568,6 +729,194 @@ class LLMEngine:
         for (req, slot), first in zip(admitted, host[self._rows * K:]):
             self._finish_admit(req, slot, int(first))
         # --- block processing: truncate junk past each row's finish ---
+        for i, req in rows:
+            sl = self._slots[i]
+            if sl is None or sl.request is not req:
+                continue      # evicted earlier (or reused): junk row
+            for k in range(K):
+                tok = int(block[i, k])
+                sl.out.append(tok)
+                sl.last_token = tok
+                sl.pos += 1
+                self.stats.step_tokens += 1
+                self.stats.tokens_generated += 1
+                if sl.request.on_token is not None:
+                    self._safe_on_token(sl.request, tok)
+                if self._maybe_finish(i):
+                    break     # rest of the row is junk past eos
+
+    # ---------------------------------------------------- paged engine loop
+
+    def _pages_needed(self, req: _Request) -> int:
+        span = min(len(req.prompt) + req.max_new_tokens,
+                   self.cfg.max_seq_len)
+        return -(-span // self.page_size)
+
+    def _loop_paged(self):
+        """Pipelined like _loop, with a slotless prefill stage ahead of
+        the block: each iteration (1) prefills as many queued prompts as
+        the pool allows, (2) installs ready requests into free slots and
+        dispatches the next block, (3) processes the PREVIOUS block's
+        fetch, (4) fetches this iteration's prefill first-tokens (the
+        device finished them before the just-dispatched block).  TTFT is
+        therefore one prefill round-trip, independent of slot turnover.
+        """
+        inflight = None       # (combined_dev, rows)
+        while True:
+            with self._lock:
+                while (not self._closed and not self._pending
+                       and not self._ready
+                       and all(s is None for s in self._slots)
+                       and inflight is None):
+                    self._lock.wait()
+                if self._closed:
+                    victims = (
+                        [s.request for s in self._slots if s is not None]
+                        + [pf.slot_state.request for pf in self._ready]
+                        + list(self._pending))
+                    self._pending.clear()
+                    self._ready.clear()
+                    for req in victims:
+                        self._safe_deliver(
+                            req, False, RuntimeError("engine closed"))
+                    return
+                todo = []
+                oversized = []
+                while self._pending:
+                    need = self._pages_needed(self._pending[0])
+                    if need > self.kv_pool_pages - 1:
+                        # can never fit: fail it rather than spin forever
+                        oversized.append(self._pending.popleft())
+                        continue
+                    if need > len(self._free_pages):
+                        break          # FIFO: no bypass, no starvation
+                    req = self._pending.popleft()
+                    pages = [self._free_pages.pop() for _ in range(need)]
+                    todo.append((req, pages))
+                installs = []
+                while self._free and self._ready:
+                    installs.append((self._ready.popleft(),
+                                     self._free.pop()))
+            for req in oversized:
+                self._safe_deliver(req, False, ValueError(
+                    f"request needs {self._pages_needed(req)} KV pages; "
+                    f"pool holds {self.kv_pool_pages - 1}"))
+            try:
+                new_prefills = self._dispatch_prefill_waves(todo)
+                nxt = self._dispatch_block_paged(installs)
+                if inflight is not None:
+                    self._process_block_paged(inflight)
+                for fw in new_prefills:
+                    self._process_prefill_wave(fw)
+                inflight = nxt
+            except Exception as e:   # engine-fatal (OOM, compile error)
+                with self._lock:
+                    victims = (
+                        [s.request for s in self._slots if s is not None]
+                        + [pf.slot_state.request for pf in self._ready]
+                        + [r for r, _ in todo]
+                        + ([r for _, r in inflight[1]] if inflight else [])
+                        + list(self._pending))
+                    self._pending.clear()
+                    self._ready.clear()
+                    self._slots = [None] * self.num_slots
+                    self._free = list(range(self.num_slots))[::-1]
+                    self._free_pages = list(
+                        range(1, self.kv_pool_pages))[::-1]
+                    self._stale_slots.clear()
+                inflight = None
+                self._cache = self._init_cache(self._rows)
+                self._state = self._init_state(0)
+                for req in victims:
+                    self._safe_deliver(req, False, e)
+
+    def _dispatch_prefill_waves(self, todo: list) -> list:
+        """Batch queued prompts into (bucket, wave) prefill calls that
+        write straight into their reserved pages.  Device dispatch only —
+        first tokens are fetched later in the iteration."""
+        out = []
+        for bucket, chunk, wave in self._wave_chunks(todo):
+            packed = np.zeros((wave, bucket + 2), np.int32)
+            packed[:, bucket] = 1
+            tables = np.zeros((wave, self.max_pages), np.int32)
+            metas = []
+            for r, (req, pages) in enumerate(chunk):
+                packed[r, :len(req.prompt)] = req.prompt
+                packed[r, bucket] = len(req.prompt)
+                packed[r, bucket + 1] = int(req.temperature * 1e6)
+                tables[r, :len(pages)] = pages
+                metas.append((req, pages, tables[r].copy()))
+            firsts, self._cache = self._get_prefill_paged(
+                bucket, wave)(self.params, self._cache,
+                              jnp.asarray(packed),
+                              jnp.asarray(tables), self._next_key())
+            self.stats.prefills += len(chunk)
+            out.append((firsts, metas))
+        return out
+
+    def _process_prefill_wave(self, fw) -> None:
+        """Fetch a prefill wave's first tokens; requests finish here if
+        one token was all they wanted, otherwise join the ready queue."""
+        firsts, metas = fw
+        host = np.asarray(firsts)
+        for (req, pages, table), first in zip(metas, host):
+            self.stats.tokens_generated += 1
+            sl = _Slot(req, len(req.prompt), int(first), pages)
+            if req.on_token is not None:
+                self._safe_on_token(req, int(first))
+            reason = self._finish_reason(sl, self.cfg.max_seq_len)
+            if reason is not None:
+                # never installed -> nothing junk-steps these pages:
+                # free immediately, no redirect needed
+                self._free_pages.extend(sl.pages)
+                sl.pages = []
+                self._deliver_result(sl, reason)
+            else:
+                with self._lock:
+                    self._ready.append(_Prefilled(sl, table))
+
+    def _dispatch_block_paged(self, installs: list):
+        """Install ready requests into free slots (their last token and
+        position are host-known — nothing is fetched), attach redirect
+        rows for stale slots, and dispatch one decode block.  Returns
+        (combined_device, rows) or None when no slot is active."""
+        A = self.num_slots
+        meta = np.zeros((3, A), np.int32)
+        meta[0, :] = A                                  # pad -> scratch
+        lasts = np.zeros((A,), np.int32)
+        tables = np.zeros((A, self.max_pages), np.int32)
+        n = 0
+        for pf, slot in installs:
+            sl = pf.slot_state
+            self._slots[slot] = sl
+            self._stale_slots.discard(slot)   # reuse doubles as redirect
+            meta[0, n] = slot
+            meta[1, n] = sl.pos
+            meta[2, n] = int(sl.request.temperature * 1e6)
+            lasts[n] = sl.last_token
+            tables[n] = pf.table
+            n += 1
+        if all(s is None for s in self._slots):
+            return None        # nothing to decode; redirects can wait
+        for slot in sorted(self._stale_slots):
+            if self._slots[slot] is None and n < A:
+                meta[0, n] = slot   # zero token/pos/table -> scratch page
+                n += 1
+                self._stale_slots.discard(slot)
+        admit = ((jnp.asarray(meta), jnp.asarray(lasts),
+                  jnp.asarray(tables)) if n else self._no_admit)
+        combined, self._state, self._cache = self._block_jit(
+            self.params, self._cache, self._state, *admit)
+        rows = [(i, s.request) for i, s in enumerate(self._slots)
+                if s is not None]
+        return (combined, rows)
+
+    def _process_block_paged(self, quantum) -> None:
+        combined, rows = quantum
+        host = np.asarray(combined)        # the ONE fetch this quantum
+        K = self.block_size
+        block = host.reshape(self._rows, K)
+        self.stats.steps += K
         for i, req in rows:
             sl = self._slots[i]
             if sl is None or sl.request is not req:
