@@ -63,7 +63,7 @@ SECTIONS = {
                       timeout=5400),
     "rl": dict(cmd=[sys.executable,
                     os.path.join(REPO, "benchmarks", "rl_perf.py")],
-               timeout=1800),
+               timeout=3600),   # PPO-to-150 + 2 IMPALA rows on 1 core
     "vision": dict(cmd=[sys.executable,
                         os.path.join(REPO, "benchmarks", "vision_perf.py")],
                    timeout=1800),

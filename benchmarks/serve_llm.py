@@ -18,7 +18,6 @@ import argparse
 import json
 import os
 import sys
-import threading
 import time
 
 # repo-root import without PYTHONPATH (which would leak into the axon
@@ -82,36 +81,35 @@ def bench_engine(preset="gpt-small", slots=8, requests=64, prompt_len=64,
 
 def _drive_engine(eng, cfg, preset, slots, requests, prompt_len,
                   new_tokens, stagger_s, paged):
+    import asyncio
+
     vocab = cfg.vocab_size
 
-    # compile every jit path at the bench shapes before timing
-    eng.warmup(prompt_lens=[prompt_len])
+    # compile every jit path at the bench shapes before timing, incl.
+    # the saturation-burst decomposition in paged mode
+    eng.warmup(prompt_lens=[prompt_len],
+               burst=requests if paged else 0)
     eng.submit([7] * prompt_len, max_new_tokens=4, temperature=0.8)
 
-    results = [None] * requests
-    lats = []
-    ttfts = []
-    lock = threading.Lock()
-
-    def go(i):
-        prompt = [(i * 37 + j) % (vocab - 1) + 1 for j in range(prompt_len)]
-        r = eng.submit(prompt, max_new_tokens=new_tokens, temperature=0.8)
-        with lock:
-            results[i] = r
-            lats.append(r.latency_s)
-            ttfts.append(r.time_to_first_token_s)
+    # single-threaded async submission: all requests enqueue at t~0 from
+    # one event loop (a thread per request on this 1-core box measures
+    # Python thread scheduling, not the engine)
+    async def drive():
+        futs = []
+        for i in range(requests):
+            prompt = [(i * 37 + j) % (vocab - 1) + 1
+                      for j in range(prompt_len)]
+            futs.append(eng.submit(prompt, max_new_tokens=new_tokens,
+                                   temperature=0.8))
+            if stagger_s:
+                await asyncio.sleep(stagger_s)
+        return await asyncio.gather(*futs)
 
     t0 = time.monotonic()
-    threads = []
-    for i in range(requests):
-        th = threading.Thread(target=go, args=(i,))
-        th.start()
-        threads.append(th)
-        if stagger_s:
-            time.sleep(stagger_s)
-    for th in threads:
-        th.join()
+    results = asyncio.run(drive())
     wall = time.monotonic() - t0
+    lats = [r.latency_s for r in results]
+    ttfts = [r.time_to_first_token_s for r in results]
 
     tokens = sum(len(r.tokens) for r in results if r is not None)
     st = eng.stats.snapshot(eng.num_slots)
@@ -156,17 +154,19 @@ def bench_serve(preset="gpt-small", slots=8, requests=64, prompt_len=64,
     # give actor creation room beyond the 60 s default.  num_tpus=1 on
     # both the cluster and the deployment: a replica without a TPU
     # lease is pinned to the CPU backend (see build_app docstring).
+    # Setup sits INSIDE the try: a failed serve.run must still tear the
+    # cluster down, or its daemons poison the rest of the --suite run.
     ray_tpu.init(num_cpus=4, num_tpus=1,
                  system_config={"actor_creation_timeout_s": 900.0})
-    serve.start()
-    app = serve.llm.build_app(preset=preset, num_slots=slots,
-                              max_concurrent_queries=2 * requests,
-                              max_seq_len=2 * (prompt_len + new_tokens),
-                              num_tpus=1, paged=paged,
-                              page_size=page_size, kv_pool_pages=pool,
-                              warmup_prompt_lens=[prompt_len])
-    handle = serve.run(app, name="llm-bench")
     try:
+        serve.start()
+        app = serve.llm.build_app(preset=preset, num_slots=slots,
+                                  max_concurrent_queries=2 * requests,
+                                  max_seq_len=2 * (prompt_len + new_tokens),
+                                  num_tpus=1, paged=paged,
+                                  page_size=page_size, kv_pool_pages=pool,
+                                  warmup_prompt_lens=[prompt_len])
+        handle = serve.run(app, name="llm-bench")
         # warm the replica's jit paths
         ray_tpu.get(handle.remote({"prompt": [7] * prompt_len,
                                    "max_new_tokens": 4}), timeout=600)
@@ -208,7 +208,10 @@ def bench_serve(preset="gpt-small", slots=8, requests=64, prompt_len=64,
             "wall_s": round(wall, 2),
         }
     finally:
-        serve.shutdown()
+        try:
+            serve.shutdown()
+        except Exception:
+            pass          # serve may not have started; cluster must die
         ray_tpu.shutdown()
 
 

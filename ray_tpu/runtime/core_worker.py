@@ -1761,6 +1761,11 @@ class CoreWorker:
                        timeout: Optional[float] = None) -> Tuple[str, int]:
         deadline = time.monotonic() + (timeout or
                                        CONFIG.actor_creation_timeout_s)
+        # adaptive poll: tight at first (creation is ~100 ms on an idle
+        # node; a fixed 20 ms tick added a quantization stall on every
+        # first call), backing off so 1k pending resolvers don't melt
+        # the GCS during mass creation
+        delay = 0.003
         while True:
             info = self.gcs.call("get_actor", {"actor_id": actor_id_hex})
             if info is None:
@@ -1774,7 +1779,8 @@ class CoreWorker:
                 raise exc.ActorUnavailableError(
                     f"actor {actor_id_hex[:8]} not ready "
                     f"(state={info['state']})")
-            time.sleep(0.02)
+            time.sleep(delay)
+            delay = min(delay * 1.6, 0.05)
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict, *,

@@ -71,13 +71,14 @@ def _env_needs_exec(env_overrides) -> bool:
 class ForkedProc:
     """Popen-shaped handle over a zygote-forked worker pid.
 
-    The worker is reparented to init (double fork) and reaped there, so
-    there is no exit status to collect — returncode is -1 once the
-    process is gone, which is all the pool logic reads.  Liveness and
-    signaling go through a pidfd when available: a bare pid can be
-    recycled by an unrelated process (init reaps these workers
-    immediately), which would make kill(pid, 0) report a dead worker as
-    alive forever."""
+    The worker is a direct child of the zygote, which runs with SIGCHLD
+    ignored so exits auto-reap (single-fork protocol, worker_zygote.py) —
+    there is no exit status for the raylet to collect; returncode is -1
+    once the process is gone, which is all the pool logic reads.
+    Liveness and signaling go through a pidfd when available: a bare pid
+    can be recycled by an unrelated process as soon as the kernel reaps
+    it, which would make kill(pid, 0) report a dead worker as alive
+    forever."""
 
     def __init__(self, pid: int):
         self.pid = pid
@@ -1425,6 +1426,7 @@ class Raylet:
     # ---------------------------------------------------------------- actors
     def _rpc_create_actor(self, conn, p):
         """GCS asks us to host an actor: dedicated worker + creation task."""
+        t0 = time.monotonic()
         need = dict(p.get("resources", {}))
         need.setdefault("CPU", 1.0)
         bundle = p.get("bundle")
@@ -1445,9 +1447,11 @@ class Raylet:
         except Exception as e:
             self._give_back(need, pool_key)
             raise rpc.RpcError(f"actor worker spawn failed: {e}")
+        t_spawn = time.monotonic()
         if not self._wait_worker_ready(handle):
             self._give_back(need, pool_key)
             raise rpc.RpcError("actor worker failed to start")
+        t_ready = time.monotonic()
         lease_id = "actor-" + p["actor_id"]
         with self._lock:
             self._leases[lease_id] = {"need": need, "pool": pool_key}
@@ -1461,6 +1465,11 @@ class Raylet:
         except (rpc.RemoteError, ConnectionError, TimeoutError) as e:
             self._kill_worker(handle.worker_id.hex(), f"actor init failed: {e}")
             raise rpc.RpcError(f"actor init failed: {e}")
+        logger.info(
+            "actor %s hosted: spawn %.0fms ready %.0fms init %.0fms",
+            p["actor_id"][:8], (t_spawn - t0) * 1e3,
+            (t_ready - t_spawn) * 1e3,
+            (time.monotonic() - t_ready) * 1e3)
         return {"ok": True, "address": list(handle.address)}
 
     # ---------------------------------------------------------------- objects

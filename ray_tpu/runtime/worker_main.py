@@ -525,7 +525,19 @@ def main():
     setup_component_logging("worker", args.session_dir)
     from ray_tpu._private.logging_utils import enable_stack_dumps
     enable_stack_dumps(args.session_dir)
-    worker = WorkerProcess(args)
+    if os.environ.get("RAY_TPU_PROFILE_STARTUP"):
+        import cProfile
+        import pstats
+        prof = cProfile.Profile()
+        prof.enable()
+        worker = WorkerProcess(args)
+        prof.disable()
+        path = os.path.join(args.session_dir, "logs",
+                            f"startup-{args.worker_id[:8]}.prof")
+        pstats.Stats(prof).dump_stats(path)
+        logger.info("startup profile: %s", path)
+    else:
+        worker = WorkerProcess(args)
     logger.info("worker %s serving at %s", args.worker_id[:8],
                 worker.core.address)
     threading.Event().wait()  # serve forever; raylet kills us

@@ -12,10 +12,14 @@ Mechanics:
   - The raylet launches ``python -m ray_tpu.runtime.worker_zygote
     --socket <path>`` once (eagerly, so it warms while the cluster
     boots) and sends framed spawn requests over the unix socket.
-  - Each request double-forks: the intermediate child forks the real
-    worker (reparented to init — the zygote never reaps), writes the
-    worker pid back on the socket, and exits.  The zygote stays
-    single-threaded, so forks are async-signal clean.
+  - Each request is ONE fork: the parent replies with the child pid
+    immediately (it knows it from fork()), and SIGCHLD is set to
+    SIG_IGN so exited workers auto-reap — no zombies, no waitpid, no
+    intermediate process.  (The first design double-forked so workers
+    reparented to init; that cost two page-table copies of a jax-laden
+    process plus a blocking waitpid PER SPAWN, serializing mass actor
+    creation at ~80 ms/fork.  The worker resets SIGCHLD to SIG_DFL so
+    user subprocess code sees normal child semantics.)
   - The worker child starts a new session, points stdio at its log
     files, swaps env/argv/config, closes inherited sockets, and calls
     ``worker_main.main()`` exactly as an exec'd worker would.
@@ -62,7 +66,9 @@ def _recv_exact(sock: socket.socket, n: int):
 
 
 def _become_worker(req: dict) -> None:
-    """Runs in the grandchild: turn this fork into a real worker."""
+    """Runs in the forked child: turn this fork into a real worker."""
+    import signal
+    signal.signal(signal.SIGCHLD, signal.SIG_DFL)
     os.setsid()
     try:
         # forked children keep the zygote's cmdline in ps; at least fix
@@ -135,21 +141,13 @@ def _handle_conn(conn: socket.socket, listener: socket.socket) -> None:
             return
         sys.stdout.flush()
         sys.stderr.flush()
-        pid1 = os.fork()
-        if pid1 == 0:
+        pid = os.fork()
+        if pid == 0:
             listener.close()
-            pid2 = os.fork()
-            if pid2 == 0:
-                conn.close()
-                _become_worker(req)     # never returns
-                os._exit(1)
-            # intermediate: report the worker pid, then die so the
-            # worker reparents to init (no zombie bookkeeping here)
-            try:
-                send_msg(conn, {"pid": pid2})
-            finally:
-                os._exit(0)
-        os.waitpid(pid1, 0)
+            conn.close()
+            _become_worker(req)         # never returns
+            os._exit(1)
+        send_msg(conn, {"pid": pid})
 
 
 def main() -> None:
@@ -157,12 +155,16 @@ def main() -> None:
     ap.add_argument("--socket", required=True)
     args = ap.parse_args()
 
+    import signal as _signal
+
+    # exited workers auto-reap (children of the zygote under the
+    # single-fork protocol); _become_worker resets SIG_DFL in workers
+    _signal.signal(_signal.SIGCHLD, _signal.SIG_IGN)
     # die with the raylet: a SIGKILLed raylet must not orphan a warm
     # jax-loaded process forever (PR_SET_PDEATHSIG is cleared on fork,
     # so spawned workers don't inherit the tie)
     try:
         import ctypes
-        import signal as _signal
         libc = ctypes.CDLL("libc.so.6", use_errno=True)
         PR_SET_PDEATHSIG = 1
         libc.prctl(PR_SET_PDEATHSIG, _signal.SIGKILL)

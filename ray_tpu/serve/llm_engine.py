@@ -78,8 +78,11 @@ from ray_tpu.models.configs import TransformerConfig
 from ray_tpu.models.gpt import GPT
 
 # admission waves are padded to the next of these sizes (bounded jit
-# specializations per prompt bucket)
-_WAVE_SIZES = (1, 2, 4, 8)
+# specializations per prompt bucket); the top size bounds how many
+# prompts one prefill dispatch carries — on a remote-chip transport the
+# per-dispatch round-trip dwarfs the prefill compute, so saturation
+# bursts (prefill-ahead admitting a whole queue) want wide waves
+_WAVE_SIZES = (1, 2, 4, 8, 16, 32)
 
 
 @dataclasses.dataclass
@@ -413,15 +416,26 @@ class LLMEngine:
 
     # ------------------------------------------------------------- public
 
-    def warmup(self, prompt_lens=(64,)) -> None:
+    def warmup(self, prompt_lens=(64,), burst: int = 0) -> None:
         """Compile every jit specialization the given prompt lengths can
         hit (all admission wave sizes per bucket + the block program) so
         no request pays compile latency.  Serve replicas call this at
-        init; benchmarks call it before timing."""
+        init; benchmarks call it before timing.
+
+        ``burst`` (paged mode): additionally push that many 1-token
+        dummy requests through the live loop at once, compiling the
+        saturation-burst paths the per-function loops can't reach (the
+        combined multi-wave fetch concat; its shape depends on the burst
+        decomposition)."""
         buckets = sorted({self._bucket(n) for n in prompt_lens})
         rng = jax.random.PRNGKey(0)
+        # dense admission is bounded by free slots, so waves beyond
+        # num_slots are dead shapes — don't pay their compiles (paged
+        # prefill is slotless: any wave size can occur)
+        sizes = [w for w in _WAVE_SIZES
+                 if self.paged or w == 1 or w // 2 < self.num_slots]
         for bucket in buckets:
-            for wave in _WAVE_SIZES:
+            for wave in sizes:
                 if self.paged:
                     packed = np.zeros((wave, bucket + 2), np.int32)
                     packed[:, bucket] = 1
@@ -440,6 +454,17 @@ class LLMEngine:
         combined, self._state, self._cache = self._block_jit(
             self.params, self._cache, self._state, *self._no_admit)
         np.asarray(combined)   # force completion (and the compile)
+        if burst and self.paged:
+            import asyncio
+
+            plen = max(prompt_lens)
+
+            async def _burst():
+                futs = [self.submit([7] * plen, max_new_tokens=1)
+                        for _ in range(burst)]
+                await asyncio.gather(*futs)
+
+            asyncio.run(_burst())
 
     def submit(self, prompt: List[int], *, max_new_tokens: int = 32,
                temperature: float = 0.0, eos_id: Optional[int] = None,
@@ -806,8 +831,7 @@ class LLMEngine:
                 nxt = self._dispatch_block_paged(installs)
                 if inflight is not None:
                     self._process_block_paged(inflight)
-                for fw in new_prefills:
-                    self._process_prefill_wave(fw)
+                self._process_prefill_waves(new_prefills)
                 inflight = nxt
             except Exception as e:   # engine-fatal (OOM, compile error)
                 with self._lock:
@@ -854,11 +878,26 @@ class LLMEngine:
             out.append((firsts, metas))
         return out
 
-    def _process_prefill_wave(self, fw) -> None:
-        """Fetch a prefill wave's first tokens; requests finish here if
-        one token was all they wanted, otherwise join the ready queue."""
-        firsts, metas = fw
-        host = np.asarray(firsts)
+    def _process_prefill_waves(self, waves: list) -> None:
+        """Fetch this iteration's prefill first-tokens with ONE combined
+        device->host transfer (each fetch is a full round-trip on a
+        remote-chip transport; a saturation burst dispatches many waves
+        per iteration) and complete/queue each request."""
+        if not waves:
+            return
+        if len(waves) == 1:
+            host = np.asarray(waves[0][0])
+        else:
+            host = np.asarray(jnp.concatenate([f for f, _ in waves]))
+        off = 0
+        for firsts, metas in waves:
+            n = firsts.shape[0]
+            self._complete_prefills(metas, host[off:off + n])
+            off += n
+
+    def _complete_prefills(self, metas, host) -> None:
+        """Requests finish here if one token was all they wanted,
+        otherwise they join the ready queue holding their first token."""
         for (req, pages, table), first in zip(metas, host):
             self.stats.tokens_generated += 1
             sl = _Slot(req, len(req.prompt), int(first), pages)
