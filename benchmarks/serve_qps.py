@@ -75,21 +75,25 @@ def bench_overhead(handle, n_warm=50, n=300):
     return sp50 - bp50, bp50, sp50, sp99
 
 
-def bench_http_floor(port, n=400, concurrency=16):
-    """Same client load against the proxy's /-/healthz (no serve hop):
-    what the aiohttp client+server pair alone costs on this box — the
-    denominator for judging the serve overhead in bench_http."""
+def bench_http_floor(port, n=400, concurrency=16, path="/-/healthz"):
+    """Same client load against a no-serve-hop proxy endpoint: what the
+    aiohttp client+server pair alone costs on this box — the denominator
+    for judging the serve overhead in bench_http.  ``/-/echo`` (POST +
+    JSON both ways) is the apples-to-apples floor for the serve row."""
     import asyncio
 
     import aiohttp
 
     async def run():
-        url = f"http://127.0.0.1:{port}/-/healthz"
+        url = f"http://127.0.0.1:{port}{path}"
         lats = []
+        post = path.endswith("echo")
         async with aiohttp.ClientSession() as sess:
             async def one():
                 t0 = time.monotonic()
-                async with sess.get(url) as resp:
+                req = (sess.post(url, json=1) if post
+                       else sess.get(url))
+                async with req as resp:
                     await resp.read()
                 lats.append(time.monotonic() - t0)
 
@@ -171,12 +175,27 @@ def main():
                       "note": "aiohttp client+server alone (healthz), "
                               "same box/concurrency — the transport "
                               "ceiling the serve rows sit under"}))
+    echo_qps, ep50, _ = bench_http_floor(18230, path="/-/echo")
+    print(json.dumps({"metric": "serve_http_echo_floor_qps",
+                      "value": round(echo_qps, 1),
+                      "p50_ms": round(ep50, 2),
+                      "note": "POST + JSON both ways, no serve hop: the "
+                              "apples-to-apples transport floor for "
+                              "serve_http_qps"}))
     http_qps, hp50, hp99 = bench_http(18230)
+    # single-core composition ceiling: every HTTP request serially costs
+    # this one core the aiohttp POST+JSON transport work (1/echo_qps)
+    # plus the full handle path (1/handle_qps) — the measured qps is
+    # read against that ceiling, not against the multi-core reference bar
+    ceiling = 1.0 / (1.0 / max(echo_qps, 1) + 1.0 / max(qps, 1))
     print(json.dumps({"metric": "serve_http_qps",
                       "value": round(http_qps, 1),
                       "p50_ms": round(hp50, 2), "p99_ms": round(hp99, 2),
+                      "single_core_composition_ceiling_qps": round(ceiling, 1),
+                      "pct_of_ceiling": round(100 * http_qps / ceiling, 1),
                       "reference": "~1.9k req/s microbenchmark (multi-core"
-                                   " box); single core here"}))
+                                   " box); single core here — proxy, "
+                                   "replica, client and daemons share it"}))
     serve.shutdown()
     ray_tpu.shutdown()
 

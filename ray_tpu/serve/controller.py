@@ -88,7 +88,7 @@ class ServeController:
             return {
                 "version": state["routing_version"],
                 "replicas": [i["name"] for i in state["replicas"].values()
-                             if i["healthy"]
+                             if i["healthy"] and not i.get("draining")
                              and i["version"] == state["version"]],
                 "max_concurrent_queries":
                     state["config"].get("max_concurrent_queries", 8),
@@ -107,7 +107,11 @@ class ServeController:
                     "target_replicas": s["target_replicas"],
                     "running_replicas": sum(
                         1 for i in s["replicas"].values()
-                        if i["healthy"] and i["version"] == s["version"]),
+                        if i["healthy"] and not i.get("draining")
+                        and i["version"] == s["version"]),
+                    "replicas": [tag for tag, i in s["replicas"].items()
+                                 if i["healthy"] and not i.get("draining")
+                                 and i["version"] == s["version"]],
                 }
                 for name, s in self._deployments.items()
             }
@@ -177,6 +181,7 @@ class ServeController:
             # freshly created replicas get a startup grace period)
             healthy_current = []
             total_ongoing = 0.0
+            metrics_partial = False
             for tag, info in list(replicas.items()):
                 try:
                     handle = ray_tpu.get_actor(info["name"],
@@ -186,8 +191,11 @@ class ServeController:
                                               "health_check_period_s", 2.0))
                     info["healthy"] = True
                     info["fails"] = 0
+                    info["last_ongoing"] = metrics["num_ongoing"]
                     total_ongoing += metrics["num_ongoing"]
                 except Exception:
+                    metrics_partial = True
+                    info.pop("last_ongoing", None)
                     info["fails"] = info.get("fails", 0) + 1
                     grace_s = config.get("health_check_grace_period_s", 120.0)
                     grace = (time.monotonic() - info.get("created_at", 0.0)
@@ -197,14 +205,31 @@ class ServeController:
                 if info["healthy"] and info["version"] == version:
                     healthy_current.append(tag)
 
-            # autoscaling decision
+            # autoscaling decision — when any replica's metrics read
+            # failed this pass, the partial total_ongoing is a LOWER
+            # bound on demand: upscaling on it is safe (e.g. a new
+            # replica still compiling must not freeze a burst response),
+            # but a phantom downscale would kill real work — suppressed.
             auto = config.get("autoscaling_config")
             if auto and healthy_current:
-                target = self._autoscale(name, auto, total_ongoing,
-                                         len(healthy_current), target)
+                new_target = self._autoscale(name, auto, total_ongoing,
+                                             len(healthy_current), target)
+                if new_target > target or not metrics_partial:
+                    target = new_target
+
+            # a rising target revives draining replicas before spawning
+            # new ones (their engine/caches are warm)
+            active = [t for t in healthy_current
+                      if not replicas[t].get("draining")]
+            for tag in healthy_current:
+                if len(active) >= target:
+                    break
+                if replicas[tag].get("draining"):
+                    replicas[tag].pop("draining", None)
+                    active.append(tag)
 
             # scale up: start missing replicas at the current version
-            missing = target - len(healthy_current)
+            missing = target - len(active)
             for _ in range(max(0, missing)):
                 tag = f"{name}#{uuid.uuid4().hex[:8]}"
                 actor_name = REPLICA_PREFIX + tag
@@ -228,15 +253,39 @@ class ServeController:
                     import traceback
                     traceback.print_exc()
 
-            # scale down / retire old-version or unhealthy replicas
+            # scale down / retire old-version or unhealthy replicas.
+            # Healthy excess replicas DRAIN instead of dying mid-request:
+            # a draining replica leaves the routing table immediately
+            # (get_targets filters on "draining") but is killed only once
+            # its ongoing count hits zero or the drain grace expires —
+            # cf. reference deployment_state graceful_shutdown_wait_loop_s.
             to_kill = []
-            excess = len(healthy_current) - target
+            excess = len(active) - target
+            drain_grace = config.get("graceful_shutdown_timeout_s", 30.0)
+            now = time.monotonic()
             for tag, info in list(replicas.items()):
                 if info["version"] != version or not info["healthy"]:
-                    to_kill.append(tag)
-                elif excess > 0:
-                    to_kill.append(tag)
+                    to_kill.append(tag)       # broken: no point draining
+                elif excess > 0 and not info.get("draining"):
+                    info["draining"] = now
                     excess -= 1
+            # handles refresh their routing table at most every
+            # _REFRESH_INTERVAL_S (1.0 s): a drained-empty replica must
+            # outlive that window or a stale-table handle can land a
+            # request in the instant between the idle check and the kill
+            min_drain_s = 2.0
+            for tag, info in list(replicas.items()):
+                if tag in to_kill or not info.get("draining"):
+                    continue
+                # last_ongoing was fetched by the health loop THIS pass;
+                # a failed read means unreachable != idle — keep
+                # draining until the grace expires rather than shooting
+                # a busy replica mid-request
+                ongoing = info.get("last_ongoing")
+                age = now - info["draining"]
+                if (ongoing == 0 and age > min_drain_s) \
+                        or age > drain_grace:
+                    to_kill.append(tag)
             for tag in to_kill:
                 info = replicas.pop(tag)
                 self._kill_replica(info["name"])
@@ -256,15 +305,18 @@ class ServeController:
                 else:
                     orphans = []
                     if (set(replicas) != set(cur["replicas"])
-                            or any(replicas[t]["healthy"]
-                                   != cur["replicas"][t]["healthy"]
+                            or any((replicas[t]["healthy"],
+                                    bool(replicas[t].get("draining")))
+                                   != (cur["replicas"][t]["healthy"],
+                                       bool(cur["replicas"][t]
+                                            .get("draining")))
                                    for t in replicas
                                    if t in cur["replicas"])):
                         cur["routing_version"] += 1
                     cur["replicas"] = replicas
                     cur["target_replicas"] = target
                     running = sum(1 for i in replicas.values()
-                                  if i["healthy"]
+                                  if i["healthy"] and not i.get("draining")
                                   and i["version"] == version)
                     cur["status"] = ("HEALTHY" if running >= target
                                      else "UPDATING")

@@ -48,6 +48,16 @@ class HTTPProxyActor:
 
         from ray_tpu.runtime.core_worker import get_global_worker
 
+        # per-request closures touch only locals: worker/handle lookups,
+        # monotonic, and the json codec are bound once (the proxy's whole
+        # budget on this box is fractions of a millisecond per request)
+        worker = get_global_worker()
+        get_handle = self._get_handle
+        monotonic = _time.monotonic
+        add_ready = worker.add_ready_callback
+        ray_get = ray_tpu.get
+        GetTimeout = ray_tpu.exceptions.GetTimeoutError
+
         async def handle(request: web.Request) -> web.Response:
             deployment = request.match_info["deployment"]
             if request.can_read_body:
@@ -65,34 +75,38 @@ class HTTPProxyActor:
             # hops happen only under backpressure (blocking admission)
             # or when a large result needs a cross-node pull — the two
             # cases that would otherwise stall every other request.
-            def submit_blocking():
-                return self._get_handle(deployment).remote(payload)
-
             try:
-                deadline = _time.monotonic() + 60.0
-                ref = self._get_handle(deployment).try_remote(payload)
+                deadline = monotonic() + 60.0
+                h = get_handle(deployment)
+                ref = h.try_remote(payload)
                 if ref is None:        # cold table / backpressure
-                    ref = await loop.run_in_executor(None, submit_blocking)
+                    ref = await loop.run_in_executor(
+                        None, h.remote, payload)
                 fut = loop.create_future()
 
                 def _on_ready():
-                    def _resolve():
-                        if not fut.done():
-                            fut.set_result(None)
-                    loop.call_soon_threadsafe(_resolve)
+                    loop.call_soon_threadsafe(_set_ready, fut)
 
-                get_global_worker().add_ready_callback(ref, _on_ready)
-                # one 60 s budget end to end: readiness wait + the fetch
-                await asyncio.wait_for(
-                    fut, timeout=max(0.1, deadline - _time.monotonic()))
+                add_ready(ref, _on_ready)
+                # manual timeout (call_later + cancel) instead of
+                # asyncio.wait_for: wait_for wraps the await in a Task —
+                # measurable per-request overhead at these rates.  The
+                # timer spends the REMAINING request budget (a blocked
+                # executor submit already consumed part of the 60 s)
+                timer = loop.call_later(
+                    max(0.1, deadline - monotonic()), _fail_timeout, fut)
+                try:
+                    await fut
+                finally:
+                    timer.cancel()
                 try:
                     # ready + inline/local result: returns without waiting
-                    result = ray_tpu.get(ref, timeout=0.05)
-                except ray_tpu.exceptions.GetTimeoutError:
+                    result = ray_get(ref, timeout=0.05)
+                except GetTimeout:
                     # store-resident result needing a pull: off the loop
-                    remaining = max(0.1, deadline - _time.monotonic())
+                    remaining = max(0.1, deadline - monotonic())
                     result = await loop.run_in_executor(
-                        None, lambda: ray_tpu.get(ref, timeout=remaining))
+                        None, lambda: ray_get(ref, timeout=remaining))
             except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500
                 return web.json_response(
                     {"error": type(e).__name__, "message": str(e)},
@@ -102,12 +116,31 @@ class HTTPProxyActor:
             except TypeError:
                 return web.Response(text=str(result))
 
+        def _set_ready(fut):
+            if not fut.done():
+                fut.set_result(None)
+
+        def _fail_timeout(fut):
+            if not fut.done():
+                fut.set_exception(TimeoutError("request timed out"))
+
         async def healthz(_request):
             return web.Response(text="ok")
+
+        async def echo(request):
+            """Transport+JSON floor probe: everything the proxy does per
+            request EXCEPT the serve hop (benchmarks/serve_qps.py reads
+            the serve_http row against this ceiling)."""
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                payload = None
+            return web.json_response(payload)
 
         async def main():
             app = web.Application()
             app.router.add_get("/-/healthz", healthz)
+            app.router.add_post("/-/echo", echo)
             app.router.add_route("*", "/{deployment}", handle)
             app.router.add_route("*", "/{deployment}/{tail:.*}", handle)
             runner = web.AppRunner(app)

@@ -102,6 +102,7 @@ class LLMServer:
 
 def build_app(preset: str = "tiny", *, num_replicas: int = 1,
               max_concurrent_queries: int = 64, num_tpus: float = 0,
+              autoscaling_config: Optional[Dict[str, Any]] = None,
               **server_kwargs):
     """Deployment-bound application for serve.run().
 
@@ -109,9 +110,17 @@ def build_app(preset: str = "tiny", *, num_replicas: int = 1,
     TPU — a replica with no TPU lease is pinned to the CPU backend by
     the raylet (worker_main must not grab libtpu from under a training
     job; raylet._tpu_env), and a gpt-scale engine on one CPU core
-    serves ~100x slower.  CI tests on CPU-only clusters keep 0."""
+    serves ~100x slower.  CI tests on CPU-only clusters keep 0.
+
+    ``autoscaling_config``: queue-depth replica autoscaling (min/max
+    replicas, target_num_ongoing_requests_per_replica, up/downscale
+    delays — serve/config.py AutoscalingConfig).  Each LLM replica owns
+    a full engine, so scaling 1->2 doubles both KV pool and chip
+    demand; the BASELINE.md north-star pairs this with pod-slice
+    autoscaling at the cluster layer."""
     dep = deployment(
         LLMServer, name=f"llm-{preset}", num_replicas=num_replicas,
         max_concurrent_queries=max_concurrent_queries,
+        autoscaling_config=autoscaling_config,
         ray_actor_options={"num_tpus": num_tpus} if num_tpus else None)
     return dep.bind(preset, **server_kwargs)
