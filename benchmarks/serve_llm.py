@@ -165,7 +165,8 @@ def bench_serve(preset="gpt-small", slots=8, requests=64, prompt_len=64,
                                   max_seq_len=2 * (prompt_len + new_tokens),
                                   num_tpus=1, paged=paged,
                                   page_size=page_size, kv_pool_pages=pool,
-                                  warmup_prompt_lens=[prompt_len])
+                                  warmup_prompt_lens=[prompt_len],
+                                  warmup_burst=requests if paged else 0)
         handle = serve.run(app, name="llm-bench")
         # warm the replica's jit paths
         ray_tpu.get(handle.remote({"prompt": [7] * prompt_len,

@@ -45,6 +45,7 @@ class LLMServer:
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                  block_size: int = 32, max_seq_len: Optional[int] = None,
                  warmup_prompt_lens: Optional[list] = None,
+                 warmup_burst: int = 0,
                  paged: bool = False, page_size: int = 64,
                  kv_pool_pages: Optional[int] = None,
                  config_overrides: Optional[Dict[str, Any]] = None):
@@ -62,7 +63,10 @@ class LLMServer:
                                 kv_pool_pages=kv_pool_pages)
         if warmup_prompt_lens:
             # pay all compiles at replica start, none at request time
-            self.engine.warmup(prompt_lens=warmup_prompt_lens)
+            # (warmup_burst additionally compiles the paged engine's
+            # saturation-burst fetch shapes — see LLMEngine.warmup)
+            self.engine.warmup(prompt_lens=warmup_prompt_lens,
+                               burst=warmup_burst)
 
     @staticmethod
     def _load_params(cfg, checkpoint: Optional[str], seed: int):
