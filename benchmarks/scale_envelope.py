@@ -264,14 +264,26 @@ def main():
     ap.add_argument("-o", "--output",
                     default=os.path.join(REPO, "MICROBENCH.json"))
     ap.add_argument("--tasks", type=int, default=1_000_000)
+    ap.add_argument("--only", nargs="+", default=None,
+                    help="run only benches whose row name contains one of "
+                         "these substrings; other stretch rows survive")
     args = ap.parse_args()
 
+    benches = {
+        "queued_tasks_1m": lambda: bench_1m_queued_tasks(args.tasks),
+        "args_10k_single_task": bench_10k_args,
+        "returns_1k_single_task": bench_1k_returns,
+        "single_object_gib": bench_multi_gib_object,
+        "batched_get_10k_objects": bench_10k_object_batched_get,
+        "actors_1k_registered_responding": bench_1k_actors,
+        "placement_groups_500": bench_500_pgs,
+    }
     rows = []
-    for fn in (lambda: bench_1m_queued_tasks(args.tasks),
-               bench_10k_args, bench_1k_returns, bench_multi_gib_object,
-               bench_10k_object_batched_get, bench_1k_actors,
-               bench_500_pgs):
-        print(f"[envelope] {fn}", flush=True)
+    for name, fn in benches.items():
+        if args.only is not None and not any(s in name
+                                             for s in args.only):
+            continue
+        print(f"[envelope] {name}", flush=True)
         rows.append(fn())
         print(json.dumps(rows[-1]), flush=True)
 
@@ -281,7 +293,11 @@ def main():
     except (OSError, ValueError):
         doc = {}
     env = doc.setdefault("envelope", {})
-    env["stretch"] = rows
+    # merge by row name: a partial refresh must not drop sibling rows
+    merged = {r.get("name"): r for r in env.get("stretch", [])}
+    for r in rows:
+        merged[r.get("name")] = r
+    env["stretch"] = list(merged.values())
     env["source"] = ("tests/test_scale_envelope.py (CI counts) + "
                      "benchmarks/scale_envelope.py (stretch)")
     with open(args.output, "w") as f:

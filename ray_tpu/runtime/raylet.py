@@ -142,10 +142,38 @@ class ForkedProc:
         return self.returncode
 
 
+class _PendingProc:
+    """Placeholder while the real process is being spawned: alive to
+    poll(), inert to signals — a health sweep racing the spawn must
+    neither reap nor signal a worker that doesn't exist yet.  Signals
+    received during the window are REMEMBERED so the spawner can apply
+    them to the real process the moment it exists (a kill during the
+    pending window must not leak a live worker)."""
+
+    pid = -1
+    returncode = None
+
+    def __init__(self):
+        self.terminated = False
+
+    def poll(self):
+        return None
+
+    def terminate(self):
+        self.terminated = True
+
+    def kill(self):
+        self.terminated = True
+
+    def wait(self, timeout=None):
+        return None
+
+
 class WorkerHandle:
-    def __init__(self, worker_id: WorkerID, proc: subprocess.Popen):
+    def __init__(self, worker_id: WorkerID,
+                 proc: Optional[subprocess.Popen]):
         self.worker_id = worker_id
-        self.proc = proc
+        self.proc = proc if proc is not None else _PendingProc()
         self.address: Optional[Tuple[str, int]] = None
         self.conn: Optional[rpc.Connection] = None
         self.ready = threading.Event()
@@ -899,6 +927,16 @@ class Raylet:
                                       session_dir=self.session_dir,
                                       store_path=self.store_path,
                                       env=env)
+        # the handle is registered BEFORE the process exists: a zygote-
+        # forked child starts running instantly and can win the race to
+        # register_worker against this (possibly starved) thread — a
+        # missing handle there rejects the registration and the newborn
+        # worker dies (observed at the 1k-actor burst: one lost worker
+        # per ~50-wave wedged its whole create wave)
+        handle = WorkerHandle(worker_id, None)
+        handle.job_id = job_id
+        with self._lock:
+            self._workers[worker_id.hex()] = handle
         proc = None
         if CONFIG.worker_prefork and container is None and \
                 python == sys.executable and \
@@ -918,21 +956,40 @@ class Raylet:
                 # fork after our timeout.  A fresh worker id keeps that
                 # orphan from colliding with the exec'd worker (its
                 # registration for the old id is simply rejected).
+                with self._lock:
+                    self._workers.pop(worker_id.hex(), None)
                 worker_id = WorkerID.from_random()
                 cmd[cmd.index("--worker-id") + 1] = worker_id.hex()
+                handle = WorkerHandle(worker_id, None)
+                handle.job_id = job_id
+                with self._lock:
+                    self._workers[worker_id.hex()] = handle
         if proc is None:
-            out_f = open(log_prefix + ".out", "ab")
-            err_f = open(log_prefix + ".err", "ab")
+            out_f = err_f = None
             try:
+                out_f = open(log_prefix + ".out", "ab")
+                err_f = open(log_prefix + ".err", "ab")
                 proc = subprocess.Popen(cmd, env=env, stdout=out_f,
                                         stderr=err_f, cwd=os.getcwd())
+            except Exception:
+                # any failure (incl. EMFILE on the opens) must unregister
+                # the pending handle or it ghosts in _workers forever
+                with self._lock:
+                    self._workers.pop(worker_id.hex(), None)
+                raise
             finally:
-                out_f.close()  # the child holds its own dups
-                err_f.close()
-        handle = WorkerHandle(worker_id, proc)
-        handle.job_id = job_id
-        with self._lock:
-            self._workers[worker_id.hex()] = handle
+                for f in (out_f, err_f):   # the child holds its own dups
+                    if f is not None:
+                        f.close()
+        pending = handle.proc
+        handle.proc = proc
+        if getattr(pending, "terminated", False):
+            # a kill landed while the process was still being spawned:
+            # apply it now instead of leaking a live worker
+            try:
+                proc.terminate()
+            except OSError:
+                pass
         return handle
 
     # ---------------------------------------------------------- zygote
@@ -989,9 +1046,34 @@ class Raylet:
                 wz.send_msg(conn, {"argv": argv, "env": env,
                                    "stdout": out_path, "stderr": err_path,
                                    "cwd": os.getcwd()})
-                conn.settimeout(CONFIG.worker_start_timeout_s)
-                reply = wz.recv_msg(conn)
-                conn.settimeout(None)
+                # A slow reply is NOT a dead zygote: under a mass-create
+                # burst on a starved core the single-threaded zygote can
+                # queue spawns for a long time, and a premature timeout
+                # here cascades badly — the exec fallback pays a full
+                # interpreter+jax import AND the orphaned fork later
+                # registers under the superseded id.  So wait on
+                # readability in ticks, timing out only on zygote DEATH
+                # or a hard deadline far beyond the start timeout.
+                import select
+                deadline = time.monotonic() + \
+                    CONFIG.worker_start_timeout_s * 4
+                while True:
+                    r, _, _ = select.select([conn], [], [], 1.0)
+                    if r:
+                        # readable: the reply frame is tiny, but a torn
+                        # write from a dying zygote must not block this
+                        # thread (it holds _zygote_lock) forever
+                        conn.settimeout(CONFIG.worker_start_timeout_s)
+                        try:
+                            reply = wz.recv_msg(conn)
+                        finally:
+                            conn.settimeout(None)
+                        break
+                    if self._zygote_proc.poll() is not None:
+                        raise OSError("zygote died "
+                                      f"{self._zygote_proc.returncode}")
+                    if time.monotonic() > deadline:
+                        raise OSError("zygote reply deadline exceeded")
             except OSError as e:
                 try:
                     conn.close()
