@@ -76,6 +76,48 @@ SECTIONS = {
 }
 
 
+# Control-plane rows whose regressions the RPC fast path must keep
+# visible (docs/rpc_fastpath.md): fresh core numbers are compared against
+# the COMMITTED MICROBENCH.json (git HEAD), not the working copy, so a
+# refresh that regressed the task path can't silently rebase its own
+# baseline before the diff is reviewed.
+_CONTROL_PLANE_ROWS = {
+    "single client tasks sync": "tasks_sync_ops_s",
+    "1:1 actor calls sync": "actor_sync_ops_s",
+}
+
+
+def _committed_baseline(path):
+    """Core rows of the committed MICROBENCH.json (None outside git)."""
+    try:
+        rel = os.path.relpath(path, REPO)
+        blob = subprocess.run(
+            ["git", "-C", REPO, "show", f"HEAD:{rel}"],
+            capture_output=True, text=True, timeout=30)
+        if blob.returncode != 0:
+            return None
+        return json.loads(blob.stdout)
+    except (OSError, ValueError, subprocess.TimeoutExpired):
+        return None
+
+
+def control_plane_deltas(core_rows, committed):
+    """{metric: {committed, current, ratio}} for the RPC-path rows."""
+    if not committed:
+        return {}
+    base = {r["name"]: r.get("ops_per_s")
+            for r in committed.get("core", []) if isinstance(r, dict)}
+    out = {}
+    for row in core_rows:
+        key = _CONTROL_PLANE_ROWS.get(row.get("name"))
+        if key is None or not base.get(row["name"]):
+            continue
+        prev, cur = base[row["name"]], row["ops_per_s"]
+        out[key] = {"committed_ops_s": prev, "current_ops_s": cur,
+                    "ratio": round(cur / prev, 3)}
+    return out
+
+
 def merge_preserve(out, prev, regenerated):
     """Carry over every section of `prev` that this run didn't regenerate.
 
@@ -117,7 +159,12 @@ def main():
         "host": {"cpus": os.cpu_count(), "physical_cpus": cpus,
                  "memory_gb": mem_gb, "platform": platform.platform()},
         "note": "reference microbenchmark runs on 16+ core machines; this "
-                "box has 1 physical core — per-core comparisons only",
+                "box is a 1-2 core heavily throttled VM whose absolute "
+                "throughput drifts hour to hour — per-core comparisons "
+                "only, and control-plane code comparisons should use "
+                "interleaved same-box A/B ratios "
+                "(control_plane_same_box_vs_seed), not cross-refresh "
+                "absolute deltas",
     }
 
     regenerated = set()
@@ -164,6 +211,17 @@ def main():
     except (OSError, ValueError):
         prev = {}
     merge_preserve(out, prev, regenerated)
+
+    if "core" in regenerated:
+        deltas = control_plane_deltas(out["core"],
+                                      _committed_baseline(args.output))
+        if deltas:
+            out["control_plane_deltas"] = deltas
+            for key, d in deltas.items():
+                tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
+                print(f"[collect] {key}: {d['committed_ops_s']:,.0f} -> "
+                      f"{d['current_ops_s']:,.0f} ops/s "
+                      f"(x{d['ratio']}) [{tag}]", flush=True)
 
     with open(args.output, "w") as f:
         json.dump(out, f, indent=1)

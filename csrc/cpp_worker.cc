@@ -581,7 +581,34 @@ void notify_actor_ready() {
   gcs->call("actor_ready", p, 30.0);
 }
 
+// Batched submission (core_worker._lease_worker_loop push_tasks frames):
+// execute each spec in frame order on the serial executor and ack once
+// with per-spec results.  A spec failure becomes a per-spec "err" entry
+// so one bad task can't poison its frame-mates (the python worker's
+// _run_queued_batch contract); no task_done streaming from C++ — the
+// frame ack resolves everything.
+PyVal run_task_batch(const PyVal& payload) {
+  const PyVal* specs = payload.get("specs");
+  if (!specs || (specs->kind != PyVal::LIST && specs->kind != PyVal::TUPLE))
+    throw rpcnet::RpcError("push_tasks: missing specs");
+  PyVal results = PyVal::list();
+  for (const PyVal& spec : specs->items) {
+    PyVal entry = PyVal::dict();
+    try {
+      entry.set("ok", g_exec.run(spec));
+    } catch (const std::exception& e) {
+      entry.set("err", PyVal::str(pycodec::sanitize_utf8(
+          std::string(e.what()))));
+    }
+    results.items.push_back(std::move(entry));
+  }
+  PyVal out = PyVal::dict();
+  out.set("results", std::move(results));
+  return out;
+}
+
 PyVal dispatch(const std::string& method, const PyVal& payload) {
+  if (method == "push_tasks") return run_task_batch(payload);
   if (method == "push_task") return g_exec.run(payload);
   if (method == "actor_task") return g_actor_streams.run(payload);
   if (method == "create_actor") {

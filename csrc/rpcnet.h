@@ -1,10 +1,16 @@
 // rpcnet: C++ side of the control-plane RPC protocol.
 //
-// Wire-compatible with ray_tpu/_private/rpc.py — length-prefixed pickled
-// 4-tuples (kind, msg_id, a, b) over TCP, full duplex: either side can
-// issue requests; responses are matched by msg_id.  Used by the C++
-// worker runtime (cpp_worker.cc) and the C++ user API (the analog of the
-// reference's cpp/ tree), with pycodec doing the pickling.
+// Wire-compatible with ray_tpu/_private/rpc.py — framed pickled 4-tuples
+// (kind, msg_id, a, b) over TCP, full duplex: either side can issue
+// requests; responses are matched by msg_id.  Frame layout (see
+// docs/rpc_fastpath.md):
+//   u32 pickle_len | u32 nbufs | nbufs * u64 buf_len | pickle | bufs
+// The C++ side always sends nbufs == 0 (pycodec pickles everything in
+// band); inbound out-of-band buffers (protocol-5 numpy payloads) are not
+// representable in pycodec, so such frames drop the connection — they
+// never occur on cpp-bound traffic (task specs carry plain bytes).
+// Used by the C++ worker runtime (cpp_worker.cc) and the C++ user API
+// (the analog of the reference's cpp/ tree), with pycodec pickling.
 //
 // Concurrency model mirrors the Python layer: one reader thread per
 // connection, each inbound request handled on its own thread (an owner
@@ -152,10 +158,11 @@ class Conn {
 
   void send_frame(const PyVal& frame) {
     std::string data = pycodec::pickle_dumps(frame);
-    char hdr[4];
+    char hdr[8];
     uint32_t n = (uint32_t)data.size();
     for (int j = 0; j < 4; ++j) hdr[j] = (char)(n >> (8 * j));
-    std::string buf(hdr, 4);
+    for (int j = 4; j < 8; ++j) hdr[j] = 0;  // nbufs == 0: all in band
+    std::string buf(hdr, 8);
     buf += data;
     try {
       detail::send_all(fd_, buf.data(), buf.size(), wlock_);
@@ -165,14 +172,25 @@ class Conn {
     }
   }
 
+  static uint32_t le32(const char* p) {
+    return (uint32_t)(unsigned char)p[0] |
+           (uint32_t)(unsigned char)p[1] << 8 |
+           (uint32_t)(unsigned char)p[2] << 16 |
+           (uint32_t)(unsigned char)p[3] << 24;
+  }
+
   void read_loop() {
     for (;;) {
-      char hdr[4];
-      if (!detail::recv_all(fd_, hdr, 4)) break;
-      uint32_t n = (uint32_t)(unsigned char)hdr[0] |
-                   (uint32_t)(unsigned char)hdr[1] << 8 |
-                   (uint32_t)(unsigned char)hdr[2] << 16 |
-                   (uint32_t)(unsigned char)hdr[3] << 24;
+      char hdr[8];
+      if (!detail::recv_all(fd_, hdr, 8)) break;
+      uint32_t n = le32(hdr);
+      uint32_t nbufs = le32(hdr + 4);
+      if (n > (1u << 30) || nbufs > 0) {
+        // out-of-band buffers are unrepresentable in pycodec (and never
+        // sent on cpp-bound traffic); oversized headers mean a protocol
+        // mismatch — drop the connection either way
+        break;
+      }
       std::string data(n, '\0');
       if (!detail::recv_all(fd_, &data[0], n)) break;
       PyVal frame;

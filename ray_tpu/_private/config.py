@@ -124,6 +124,30 @@ _declare("rpc_fuzz_ms", float, 0.0,
          "milliseconds (uniform).  Race tooling — perturbs message "
          "interleavings the way TSAN-style schedule stressing does for "
          "threads; see tests/test_sched_fuzz.py.  0 disables.")
+_declare("rpc_dispatch_threads", int, 256,
+         "Size of the process-wide RPC dispatch pool (replaces thread-"
+         "per-request).  Handlers that block (task pushes waiting on the "
+         "executor FIFO, buffered actor seqs, parked lease grants) each "
+         "hold one pool thread; the cap bounds runaway thread growth "
+         "while staying far above any realistic in-flight handler "
+         "count.  Values far below the default risk starving blocking "
+         "handlers that wait on other pooled RPCs.")
+_declare("rpc_inline_return_max_bytes", int, -1,
+         "Task/actor returns at most this size travel inline in the "
+         "push_tasks/actor_task reply instead of through the shared-"
+         "memory store + location round trip.  -1 (default) follows "
+         "inline_object_max_bytes.")
+_declare("task_submit_batch_max", int, 8,
+         "Max task specs coalesced into one push_tasks frame per leased "
+         "worker.  Specs carrying ObjectRef args always travel alone "
+         "(their worker-side dependency resolution must observe earlier "
+         "per-task acks).")
+_declare("lease_keepalive_ms", int, 200,
+         "How long a leased worker is held after its scheduling key's "
+         "queue drains before the lease is returned to the raylet.  "
+         "Back-to-back synchronous submissions reuse the warm lease and "
+         "connection instead of paying lease_worker + connect + "
+         "return_worker per task.  0 restores return-on-idle.")
 _declare("timeout_scale", float, 1.0,
          "Multiplier applied to liveness/startup timeouts at resolution "
          "time (the _SCALED flags below).  Loaded hosts — CI sharing one "
@@ -228,6 +252,10 @@ class Config:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._overrides: Dict[str, Any] = {}
+        # bumped on every override mutation: hot paths cache resolved flag
+        # values keyed on this (rpc._maybe_fuzz) instead of re-resolving
+        # through the lock + env lookup per call
+        self._gen = 0
         blob = os.environ.get(_SYSTEM_CONFIG_ENV)
         if blob:
             try:
@@ -264,11 +292,16 @@ class Config:
         return copy.deepcopy(value) if isinstance(value, (dict, list)) \
             else value
 
+    def generation(self) -> int:
+        """Monotonic override-mutation counter (see __init__)."""
+        return self._gen
+
     def set(self, name: str, value: Any) -> None:
         if name not in _FLAG_TABLE:
             raise KeyError(f"unknown ray_tpu config flag: {name!r}")
         with self._lock:
             self._overrides[name] = value
+            self._gen += 1
 
     def update(self, overrides: Dict[str, Any]) -> None:
         for k, v in (overrides or {}).items():
@@ -281,6 +314,7 @@ class Config:
     def set_overrides(self, overrides: Dict[str, Any]) -> None:
         with self._lock:
             self._overrides = dict(overrides)
+            self._gen += 1
 
     def overrides_env_blob(self) -> str:
         """Serialized overrides to pass to child processes via env."""

@@ -10,21 +10,68 @@ connection fails all in-flight calls with ``ConnectionError``).
 The data plane (large objects) never travels here — it goes through the
 shared-memory store / chunked transfer, mirroring the reference's strict
 control/data plane split (SURVEY.md §1).
+
+Fast path (docs/rpc_fastpath.md):
+
+* **Pooled dispatch** — requests run on a shared bounded thread pool
+  (``rpc_dispatch_threads``) instead of a freshly spawned thread per RPC;
+  on a 1-core box the per-request ``Thread.start()`` dominated small-RPC
+  cost.  Per-connection dispatch order is unchanged (one reader enqueues
+  in arrival order and the pool queue is FIFO).
+* **Fast-method registry** — a server may mark handlers that never block
+  and never call back into the connection (heartbeats, kv_get, liveness
+  probes); those run inline on the reader thread, skipping the pool hop
+  entirely.  Under schedule fuzz (``rpc_fuzz_ms``>0) fast methods fall
+  back to the pool so the fuzzer can still perturb their interleaving.
+* **Frame coalescing + scatter/gather writes** — frames are encoded to an
+  iovec (header / buffer-length table / pickle body / out-of-band
+  protocol-5 buffers) and written with ``sendmsg``; no length-prefix
+  concatenation copy.  Writers enqueue frames and the first writer in
+  drains the whole queue in one syscall batch, so back-to-back frames
+  (pipelined pushes, batched replies) coalesce into one send.
+* **recv_into framing** — the reader receives headers and pickle bodies
+  into one reusable growable buffer instead of recv()+join allocations;
+  out-of-band buffers land in fresh buffers (objects may keep views).
 """
 
 from __future__ import annotations
 
 import itertools
+import os
 import pickle
+import queue
+import random
 import socket
 import struct
 import threading
-from concurrent.futures import Future
-from typing import Any, Callable, Dict, Optional, Tuple
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
+from ray_tpu._private.config import CONFIG
 from ray_tpu._private.logging_utils import get_logger
 
 logger = get_logger("rpc")
+
+# cached (generation, value) of CONFIG.rpc_fuzz_ms: the old per-dispatch
+# `from ...config import CONFIG` + flag resolution (lock + env lookup +
+# parse) was measurable on the RPC hot path.  CONFIG.generation() bumps
+# on every set()/update(), so runtime overrides (ray_tpu.init's
+# system_config) still take effect; raw os.environ writes made after the
+# first dispatch are not observed (set the flag via CONFIG instead).
+_fuzz_gen = -1
+_fuzz_ms = 0.0
+
+
+def _fuzz_ms_now() -> float:
+    global _fuzz_gen, _fuzz_ms
+    gen = CONFIG.generation()
+    if gen != _fuzz_gen:
+        _fuzz_ms = CONFIG.rpc_fuzz_ms
+        _fuzz_gen = gen
+    return _fuzz_ms
 
 
 def _maybe_fuzz() -> None:
@@ -36,15 +83,37 @@ def _maybe_fuzz() -> None:
     arrive in order" fails under fuzz.  The race-sensitive suites
     (lease races, chaos, GCS fault tolerance) run under it in
     tests/test_sched_fuzz.py."""
-    from ray_tpu._private.config import CONFIG
-    ms = CONFIG.rpc_fuzz_ms
+    ms = _fuzz_ms_now()
     if ms > 0:
-        import random
-        import time as _time
-        _time.sleep(random.uniform(0.0, ms / 1000.0))
+        time.sleep(random.uniform(0.0, ms / 1000.0))
 
-_LEN = struct.Struct("<I")
+
+# wire format: one frame is
+#   <II>  (pickle_len, nbufs)
+#   nbufs * <Q>  out-of-band buffer lengths
+#   pickle body (protocol 5)
+#   out-of-band buffers, concatenated
+# All peers are in-repo daemons spawned from the same tree, so the format
+# needs no version negotiation.
+_HDR = struct.Struct("<II")
+_BLEN = struct.Struct("<Q")
 _REQUEST, _RESPONSE, _PUSH = 0, 1, 2
+
+# sendmsg iovec batching cap: well under any platform IOV_MAX, large
+# enough that a burst of small frames still coalesces into few syscalls
+_IOV_BATCH = 64
+# write-queue soft cap: beyond this, enqueuers block until the active
+# flusher drains (backpressure instead of unbounded buffering)
+_WQ_CAP = 1024
+# out-of-band buffer count cap, enforced on BOTH sides: the sender falls
+# back to in-band pickling past it, the receiver treats a bigger count
+# as a garbled header (protocol-mismatch guard)
+_NBUFS_MAX = 4096
+# frame payload ceiling, enforced on BOTH sides: the sender fails the
+# one oversized call with ValueError; the receiver treats a bigger
+# header as garbled and drops the connection.  Control-plane payloads
+# this large are a bug — bulk data belongs to the store/chunk transfer.
+_BODY_MAX = 1 << 30
 
 
 class RpcError(Exception):
@@ -59,26 +128,149 @@ class RemoteError(RpcError):
         self.cause = cause
 
 
-def _send_frame(sock: socket.socket, lock: threading.Lock, obj: Any) -> None:
-    data = pickle.dumps(obj, protocol=5)
-    with lock:
-        sock.sendall(_LEN.pack(len(data)) + data)
+class Deferred:
+    """Out-of-band reply handle: a handler returns one of these and some
+    other thread resolves it later, sending the response directly from
+    the resolving thread.
+
+    This removes the parked-thread pattern (handler blocks on an Event a
+    worker loop sets, then wakes just to return) — on a contended box
+    that wake-to-reply hop is a full context switch per RPC.  Resolution
+    and binding race safely: whichever happens second sends the reply."""
+
+    _UNSET = object()
+    __slots__ = ("_lock", "_conn", "_msg_id", "_result")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conn: Optional["Connection"] = None
+        self._msg_id: Optional[int] = None
+        self._result = Deferred._UNSET
+
+    def _bind(self, conn: "Connection", msg_id: int) -> None:
+        with self._lock:
+            self._conn, self._msg_id = conn, msg_id
+            result = self._result
+        if result is not Deferred._UNSET:
+            conn._respond(msg_id, result[0], result[1])
+
+    def resolve(self, value: Any) -> None:
+        self._finish(True, value)
+
+    def fail(self, error: BaseException) -> None:
+        self._finish(False, error)
+
+    def _finish(self, ok: bool, value: Any) -> None:
+        with self._lock:
+            if self._result is not Deferred._UNSET:
+                return  # already resolved
+            self._result = (ok, value)
+            conn, msg_id = self._conn, self._msg_id
+        if conn is not None:
+            conn._respond(msg_id, ok, value)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        b = sock.recv(min(n, 1 << 20))
-        if not b:
+# ---------------------------------------------------------------- dispatch
+_pool_lock = threading.Lock()
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_pid = 0
+
+
+def _dispatch_pool() -> ThreadPoolExecutor:
+    """Process-wide bounded executor for RPC request handlers.
+
+    Replaces thread-per-request: idle workers are reused, new ones spawn
+    only when all are busy (up to ``rpc_dispatch_threads``).  Per-
+    connection request order is preserved — the pool queue is FIFO and
+    each connection's reader enqueues in arrival order.  A request can
+    only wait on strictly-earlier traffic of its own connection (actor
+    seqs, dependency-ordered pushes), which is already dispatched ahead
+    of it, so the bound introduces no new deadlocks.
+
+    Fork guard: a forked child (zygote workers) inherits the executor
+    object but none of its threads — submitting into it would hang, so
+    the pool is re-created when the pid changes."""
+    global _pool, _pool_pid
+    pid = os.getpid()
+    if _pool is None or _pool_pid != pid:
+        with _pool_lock:
+            if _pool is None or _pool_pid != pid:
+                _pool = ThreadPoolExecutor(
+                    max_workers=max(1, CONFIG.rpc_dispatch_threads),
+                    thread_name_prefix="rpc-dispatch")
+                _pool_pid = pid
+    return _pool
+
+
+# ---------------------------------------------------------------- framing
+def _encode_frame(obj: Any) -> list:
+    """Pickle ``obj`` into an iovec [header, lentable?, body, *buffers].
+
+    Protocol-5 ``buffer_callback`` keeps large contiguous buffers (numpy
+    arrays, PickleBuffer-wrapped blobs) out of the pickle stream: they
+    ride the iovec zero-copy and ``sendmsg`` gathers them on the wire.
+    Non-contiguous buffers fall back to in-band pickling."""
+    pbufs: list = []
+    try:
+        body = pickle.dumps(obj, protocol=5, buffer_callback=pbufs.append)
+        if len(pbufs) > _NBUFS_MAX:
+            # a payload of thousands of small arrays: past the receiver's
+            # header sanity cap, so carry it in band instead
+            raise ValueError("too many out-of-band buffers")
+        raws = [pb.raw() for pb in pbufs]
+    except (BufferError, ValueError):
+        body = pickle.dumps(obj, protocol=5)
+        raws = []
+    if len(body) > _BODY_MAX or sum(len(r) for r in raws) > _BODY_MAX:
+        # fail THIS call (call_async surfaces it on the future) instead
+        # of letting the receiver's header guard drop the connection
+        raise ValueError(
+            f"rpc frame exceeds {_BODY_MAX} bytes; move bulk data "
+            f"through the object store")
+    iov = [_HDR.pack(len(body), len(raws))]
+    if raws:
+        iov.append(b"".join(_BLEN.pack(len(r)) for r in raws))
+    iov.append(body)
+    iov.extend(raws)
+    return iov
+
+
+def _sendmsg_all(sock: socket.socket, bufs: list) -> None:
+    """Scatter/gather send of the whole iovec, handling partial writes."""
+    pending = deque(memoryview(b).cast("B") if not isinstance(b, bytes)
+                    else memoryview(b) for b in bufs if len(b))
+    while pending:
+        sent = sock.sendmsg(list(itertools.islice(pending, _IOV_BATCH)))
+        while sent:
+            head = pending[0]
+            if len(head) <= sent:
+                sent -= len(head)
+                pending.popleft()
+            else:
+                pending[0] = head[sent:]
+                sent = 0
+
+
+def _recv_exact_into(sock: socket.socket, buf: memoryview, n: int) -> None:
+    got = 0
+    while got < n:
+        r = sock.recv_into(buf[got:n], n - got)
+        if not r:
             raise ConnectionError("socket closed")
-        chunks.append(b)
-        n -= len(b)
-    return b"".join(chunks)
+        got += r
 
 
-def _recv_frame(sock: socket.socket) -> Any:
-    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+def _grow(scratch: bytearray, n: int) -> bytearray:
+    if len(scratch) < n:
+        scratch = bytearray(max(n, 2 * len(scratch)))
+    return scratch
+
+
+def _normalize_fast(fast_methods):
+    """None, a predicate f(method, payload) -> bool, or a name set."""
+    if fast_methods is None or callable(fast_methods):
+        return fast_methods
+    return frozenset(fast_methods)
 
 
 class Connection:
@@ -87,30 +279,103 @@ class Connection:
     def __init__(self, sock: socket.socket,
                  handler: Optional[Callable[["Connection", str, Any], Any]] = None,
                  push_handler: Optional[Callable[[str, Any], None]] = None,
-                 on_close: Optional[Callable[["Connection"], None]] = None):
+                 on_close: Optional[Callable[["Connection"], None]] = None,
+                 fast_methods: Optional[Iterable[str]] = None):
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
-        self._wlock = threading.Lock()
         self._handler = handler
         self._push_handler = push_handler
         self._on_close = on_close
+        # handlers that never block and never call back into this
+        # connection run inline on the reader thread (no pool hop): a set
+        # of method names, or a predicate ``f(method, payload) -> bool``
+        # for payload-dependent decisions (e.g. ref-free task batches)
+        self._fast_methods = _normalize_fast(fast_methods)
         self._ids = itertools.count(1)
         self._inflight: Dict[int, Future] = {}
         self._inflight_lock = threading.Lock()
         self._closed = threading.Event()
+        # write-side frame queue: the first writer in becomes the flusher
+        # and drains everything queued behind it in coalesced sendmsg
+        # batches; later writers enqueue and return (or block at _WQ_CAP)
+        self._wq: deque = deque()
+        self._wq_lock = threading.Lock()
+        self._wq_cv = threading.Condition(self._wq_lock)
+        self._flushing = False
         self._push_queue = None   # created lazily on first push
         self.peer: Any = None  # attachable identity (e.g. worker id)
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
     # ------------------------------------------------------------------ send
+    def _send(self, obj: Any) -> None:
+        """Enqueue one frame and flush opportunistically.
+
+        If another thread is mid-flush it picks our frame up before it
+        releases the socket, so back-to-back frames from concurrent
+        writers coalesce into one ``sendmsg``.  Send failures close the
+        connection; writers whose frames were queued behind a failed
+        flush observe it through their futures (close() fails them)."""
+        iov = _encode_frame(obj)  # may raise (unpicklable payload)
+        with self._wq_lock:
+            while (len(self._wq) >= _WQ_CAP and self._flushing
+                   and not self._closed.is_set()):
+                self._wq_cv.wait(1.0)
+            if self._closed.is_set():
+                raise ConnectionError("connection closed")
+            self._wq.append(iov)
+            if self._flushing:
+                # the active flusher will send this frame after we return;
+                # materialize zero-copy views — the caller may mutate the
+                # backing buffer once its call returns
+                iov[:] = [b if isinstance(b, bytes) else bytes(b)
+                          for b in iov]
+                return
+            self._flushing = True
+        self._flush()
+
+    def _flush(self) -> None:
+        while True:
+            with self._wq_lock:
+                if not self._wq or self._closed.is_set():
+                    self._flushing = False
+                    self._wq.clear()
+                    self._wq_cv.notify_all()
+                    if self._closed.is_set():
+                        raise ConnectionError("connection closed")
+                    return
+                batch: list = []
+                while self._wq and len(batch) < _IOV_BATCH:
+                    batch.extend(self._wq.popleft())
+                self._wq_cv.notify_all()
+            try:
+                _sendmsg_all(self._sock, batch)
+            except BaseException:
+                # a partial write leaves the stream desynced — the
+                # connection is unusable regardless of the error type.
+                # _flushing stays SET forever: a concurrent _send racing
+                # the close() below must never become a new flusher and
+                # splice a fresh header into the half-sent frame.  Its
+                # frame lands in the queue unsent; close() fails its
+                # future (pushes are fire-and-forget anyway) and wakes
+                # cap-waiters.
+                with self._wq_lock:
+                    self._wq.clear()
+                    self._wq_cv.notify_all()
+                self.close()
+                raise
+
     def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
         fut = self.call_async(method, payload)
         try:
             return fut.result(timeout)
-        except TimeoutError:
+        except (_FutureTimeout, TimeoutError):
             # Drop the abandoned future so a late response isn't delivered
             # to it and _inflight doesn't grow unbounded on timeouts.
+            # NOTE: on 3.10 Future.result raises concurrent.futures
+            # .TimeoutError, which is NOT the builtin TimeoutError (they
+            # merged in 3.11) — catching only the builtin silently skipped
+            # this reap and _inflight leaked one entry per timed-out call.
             msg_id = getattr(fut, "_rpc_msg_id", None)
             if msg_id is not None:
                 with self._inflight_lock:
@@ -127,7 +392,7 @@ class Connection:
                 return fut
             self._inflight[msg_id] = fut
         try:
-            _send_frame(self._sock, self._wlock, (_REQUEST, msg_id, method, payload))
+            self._send((_REQUEST, msg_id, method, payload))
         except OSError as e:
             with self._inflight_lock:
                 self._inflight.pop(msg_id, None)
@@ -141,21 +406,67 @@ class Connection:
         return fut
 
     def push(self, method: str, payload: Any = None) -> None:
-        """Fire-and-forget message (pubsub notifications, log batches)."""
+        """Fire-and-forget message (pubsub notifications, log batches).
+
+        A dead socket closes the connection (so later pushes fail fast
+        and on_close/pubsub cleanup runs) and raises ConnectionError."""
         try:
-            _send_frame(self._sock, self._wlock, (_PUSH, 0, method, payload))
+            self._send((_PUSH, 0, method, payload))
         except OSError as e:
+            self.close()
             raise ConnectionError(str(e)) from e
 
     # ------------------------------------------------------------------ recv
     def _read_loop(self) -> None:
+        sock = self._sock
+        scratch = bytearray(64 * 1024)
         try:
             while True:
-                kind, msg_id, a, b = _recv_frame(self._sock)
+                view = memoryview(scratch)
+                _recv_exact_into(sock, view, _HDR.size)
+                body_len, nbufs = _HDR.unpack_from(view)
+                if body_len > _BODY_MAX or nbufs > _NBUFS_MAX:
+                    # garbled header (e.g. a peer speaking an older frame
+                    # layout): fail the connection instead of blocking on
+                    # a bogus multi-GB read
+                    raise ConnectionError("garbled rpc frame header")
+                bufs = None
+                if nbufs:
+                    lens_sz = _BLEN.size * nbufs
+                    scratch = _grow(scratch, lens_sz)
+                    view = memoryview(scratch)
+                    _recv_exact_into(sock, view, lens_sz)
+                    lens = [_BLEN.unpack_from(view, i * _BLEN.size)[0]
+                            for i in range(nbufs)]
+                    if sum(lens) > _BODY_MAX:
+                        # same sanity bound as the header: a corrupt u64
+                        # must not zero-fill a giant allocation
+                        raise ConnectionError("garbled rpc buffer table")
+                if len(scratch) < body_len:
+                    scratch = _grow(scratch, body_len)
+                view = memoryview(scratch)
+                _recv_exact_into(sock, view, body_len)
+                if nbufs:
+                    # out-of-band buffers get fresh storage: deserialized
+                    # objects (numpy views) may keep references into them
+                    bufs = []
+                    for ln in lens:
+                        b = bytearray(ln)
+                        _recv_exact_into(sock, memoryview(b), ln)
+                        bufs.append(b)
+                kind, msg_id, a, b = pickle.loads(view[:body_len],
+                                                  buffers=bufs)
                 if kind == _REQUEST:
-                    threading.Thread(
-                        target=self._handle_request, args=(msg_id, a, b),
-                        daemon=True).start()
+                    fm = self._fast_methods
+                    if (fm is not None and _fuzz_ms_now() == 0
+                            and (fm(a, b) if callable(fm) else a in fm)):
+                        # registered non-blocking handler: run inline on
+                        # the reader (the reply coalesces with whatever
+                        # the previous frame left in the write queue)
+                        self._handle_request(msg_id, a, b)
+                    else:
+                        _dispatch_pool().submit(
+                            self._handle_request, msg_id, a, b)
                 elif kind == _RESPONSE:
                     with self._inflight_lock:
                         fut = self._inflight.pop(msg_id, None)
@@ -173,28 +484,50 @@ class Connection:
                         # queue keeps per-connection push ordering (pubsub
                         # state transitions rely on it).
                         self._enqueue_push(a, b)
-        except (ConnectionError, OSError, EOFError, pickle.UnpicklingError):
+        except (ConnectionError, OSError, EOFError, RuntimeError,
+                pickle.UnpicklingError):
+            # RuntimeError: dispatch pool shut down at interpreter exit
             pass
         finally:
             self.close()
 
     def _enqueue_push(self, method: str, payload: Any) -> None:
         if self._push_queue is None:
-            import queue
             self._push_queue = queue.Queue()
             threading.Thread(target=self._push_loop, daemon=True).start()
         self._push_queue.put((method, payload))
 
     def _push_loop(self) -> None:
-        while not self._closed.is_set():
+        # keeps draining after close(): pushes already received rode the
+        # stream intact before the EOF, and droppers would lose delivered
+        # results (core_worker's task_done stream relies on this — see
+        # drain_pushes)
+        while True:
             try:
-                method, payload = self._push_queue.get(timeout=1.0)
-            except Exception:
+                method, payload = self._push_queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._closed.is_set():
+                    return  # closed AND backlog drained
                 continue
             try:
                 self._push_handler(method, payload)
             except Exception:
                 logger.exception("push handler failed for %s", method)
+            finally:
+                self._push_queue.task_done()
+
+    def drain_pushes(self, timeout: float = 5.0) -> None:
+        """Block until every push received before the connection closed
+        has been handed to the push handler.  Callers that reconcile
+        state after a connection death (e.g. requeueing work the peer
+        may have finished) must drain first or they race the serial push
+        thread's backlog."""
+        q = self._push_queue
+        if q is None:
+            return
+        deadline = time.monotonic() + timeout
+        while q.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.005)
 
     def _handle_request(self, msg_id: int, method: str, payload: Any) -> None:
         try:
@@ -202,20 +535,26 @@ class Connection:
                 raise RpcError(f"no handler for {method}")
             _maybe_fuzz()
             result = self._handler(self, method, payload)
-            reply = (_RESPONSE, msg_id, True, result)
+            if isinstance(result, Deferred):
+                # the reply is sent by whichever thread resolves it
+                result._bind(self, msg_id)
+                return
+            ok, value = True, result
         except BaseException as e:  # noqa: BLE001 - errors cross the wire
-            reply = (_RESPONSE, msg_id, False, e)
+            ok, value = False, e
+        self._respond(msg_id, ok, value)
+
+    def _respond(self, msg_id: int, ok: bool, value: Any) -> None:
         try:
-            _send_frame(self._sock, self._wlock, reply)
+            self._send((_RESPONSE, msg_id, ok, value))
         except OSError:
             self.close()
         except Exception as e:
             # Result/exception wasn't picklable — still answer the caller so
             # its call() never hangs.
             try:
-                _send_frame(self._sock, self._wlock,
-                            (_RESPONSE, msg_id, False,
-                             RpcError(f"unserializable {method} reply: {e!r}")))
+                self._send((_RESPONSE, msg_id, False,
+                            RpcError(f"unserializable reply: {e!r}")))
             except OSError:
                 self.close()
 
@@ -223,6 +562,9 @@ class Connection:
         if self._closed.is_set():
             return
         self._closed.set()
+        with self._wq_lock:
+            self._wq.clear()
+            self._wq_cv.notify_all()
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -249,18 +591,21 @@ class Connection:
 
 
 class Server:
-    """Threaded RPC server.
+    """Pooled RPC server.
 
-    ``handler(conn, method, payload)`` runs on a per-request thread; per-
+    ``handler(conn, method, payload)`` runs on a shared bounded dispatch
+    pool (or inline on the reader for registered ``fast_methods``); per-
     connection request *dispatch* order is preserved by the reader loop, and
     handlers that need strict ordering (actor queues) do their own sequencing.
     """
 
     def __init__(self, handler: Callable[[Connection, str, Any], Any],
                  host: str = "127.0.0.1", port: int = 0,
-                 on_disconnect: Optional[Callable[[Connection], None]] = None):
+                 on_disconnect: Optional[Callable[[Connection], None]] = None,
+                 fast_methods: Optional[Iterable[str]] = None):
         self._handler = handler
         self._on_disconnect = on_disconnect
+        self._fast_methods = _normalize_fast(fast_methods)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -273,7 +618,6 @@ class Server:
         self._accept_thread.start()
 
     def _accept_loop(self) -> None:
-        import time
         while not self._stopped.is_set():
             try:
                 sock, _ = self._listener.accept()
@@ -285,7 +629,8 @@ class Server:
                 time.sleep(0.1)
                 continue
             conn = Connection(sock, handler=self._handler,
-                              on_close=self._conn_closed)
+                              on_close=self._conn_closed,
+                              fast_methods=self._fast_methods)
             with self._lock:
                 self._conns.add(conn)
 
@@ -298,6 +643,18 @@ class Server:
     def connections(self) -> list[Connection]:
         with self._lock:
             return list(self._conns)
+
+    def rebind(self, handler: Callable[[Connection, str, Any], Any],
+               fast_methods=None) -> None:
+        """Swap the dispatch handler (and fast registry) for this server
+        AND its live connections — e.g. a worker extending its embedded
+        core-worker server with task-execution methods after startup."""
+        fast = _normalize_fast(fast_methods)
+        self._handler = handler
+        self._fast_methods = fast
+        for conn in self.connections():
+            conn._handler = handler
+            conn._fast_methods = fast
 
     def stop(self) -> None:
         self._stopped.set()
@@ -313,8 +670,9 @@ def connect(address: Tuple[str, int],
             push_handler: Optional[Callable[[str, Any], None]] = None,
             handler: Optional[Callable[[Connection, str, Any], Any]] = None,
             timeout: float = 30.0,
-            on_close: Optional[Callable[[Connection], None]] = None) -> Connection:
+            on_close: Optional[Callable[[Connection], None]] = None,
+            fast_methods: Optional[Iterable[str]] = None) -> Connection:
     sock = socket.create_connection(address, timeout=timeout)
     sock.settimeout(None)
     return Connection(sock, handler=handler, push_handler=push_handler,
-                      on_close=on_close)
+                      on_close=on_close, fast_methods=fast_methods)

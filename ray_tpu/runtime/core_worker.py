@@ -283,7 +283,7 @@ class _PullBudget:
 
 class _Lease:
     __slots__ = ("lease_id", "worker_id", "address", "conn", "key",
-                 "granting_addr")
+                 "granting_addr", "pending", "plock")
 
     def __init__(self, key, grant, conn):
         self.key = key
@@ -292,6 +292,14 @@ class _Lease:
         self.address = tuple(grant["address"])
         self.granting_addr = grant.get("granting_addr")  # None == local
         self.conn = conn
+        # task_id -> (spec, retries) of every unresolved spec pushed on
+        # this lease, in send order.  Resolution pops exactly once, under
+        # plock, from whichever arrives first: the worker's streamed
+        # task_done push (early, mid-frame) or the batch ack (authoritative
+        # backstop); on connection death the leftovers are the unexecuted
+        # tail (first entry = the spec that was executing).
+        self.pending: Dict[bytes, tuple] = {}
+        self.plock = threading.Lock()
 
 
 class CoreWorker:
@@ -350,6 +358,8 @@ class CoreWorker:
         # RequestNewWorkerIfNeeded :325)
         self._sched: Dict[str, Dict[str, Any]] = {}
         self._sched_lock = threading.Lock()
+        # wakes idle keepalive leases when new work lands on their key
+        self._sched_cv = threading.Condition(self._sched_lock)
         # task binary -> remaining OOM-kill retries (separate budget from
         # max_retries; reference task_oom_retries)
         self._oom_retries: Dict[bytes, int] = {}
@@ -407,6 +417,7 @@ class CoreWorker:
         with self._sched_lock:
             leases = [l for s in self._sched.values() for l in s["leases"]]
             self._sched.clear()
+            self._sched_cv.notify_all()  # abort idle keepalive waits
         for lease in leases:
             self._return_lease(lease)
         self._server.stop()
@@ -1131,6 +1142,10 @@ class CoreWorker:
             "owner_addr": list(self.address),
             "name": name or getattr(func, "__name__", "task"),
         }
+        if live_refs:
+            # ObjectRef-carrying specs never share a push_tasks frame —
+            # see _drain_batch_locked
+            spec["_refs"] = True
         trace_ctx = _current_trace_context()
         if trace_ctx:
             # auto span injection (reference _inject_tracing_into_function,
@@ -1265,6 +1280,7 @@ class CoreWorker:
             st = self._sched.get(key)
             if st is None:
                 st = {"queue": deque(), "leases": [], "requesting": False,
+                      "idle": 0,  # leases parked in keepalive
                       "resources": dict(resources), "strategy": strategy,
                       "env": env, "language": language}
                 self._sched[key] = st
@@ -1277,12 +1293,22 @@ class CoreWorker:
         st = self._sched_state(key, resources, strategy, env, language)
         with self._sched_lock:
             st["queue"].append((spec, retries))
+            self._sched_cv.notify_all()
         self._maybe_request_lease(key, st)
 
     def _maybe_request_lease(self, key: str, st) -> None:
         with self._sched_lock:
             if (st["requesting"] or not st["queue"]
-                    or self._shutdown.is_set()):
+                    or self._shutdown.is_set()
+                    or 0 < len(st["queue"]) <= st.get("idle", 0)):
+                # the last clause: idle keepalive leases were just
+                # notified and can absorb this little work by themselves
+                # (if one instead times out, it decrements "idle" and
+                # re-checks the queue under this same lock before
+                # exiting, so the task cannot be stranded).  A burst
+                # deeper than the parked capacity still requests leases —
+                # keepalive must not collapse fan-out for parallel
+                # workloads.
                 return
             st["requesting"] = True
         threading.Thread(target=self._lease_request_loop, args=(key, st),
@@ -1297,7 +1323,17 @@ class CoreWorker:
                         return
                 try:
                     grant = self._lease_with_spillback(key, st)
-                    conn = rpc.connect(tuple(grant["address"]))
+                    # the worker streams per-task task_done pushes over the
+                    # lease connection (early results for mid-frame specs);
+                    # the box defers binding until the lease exists
+                    lease_box: list = []
+
+                    def _on_push(method, payload, _box=lease_box):
+                        if method == "task_done" and _box:
+                            self._lease_task_done(_box[0], payload)
+
+                    conn = rpc.connect(tuple(grant["address"]),
+                                       push_handler=_on_push)
                 except SchedulingError as e:
                     # permanent strategy failure (pg removed, bad bundle
                     # index, hard affinity to a dead node): fail the queued
@@ -1321,6 +1357,7 @@ class CoreWorker:
                     time.sleep(0.2)
                     continue
                 lease = _Lease(key, grant, conn)
+                lease_box.append(lease)
                 with self._sched_lock:
                     st["leases"].append(lease)
                 threading.Thread(target=self._lease_worker_loop,
@@ -1483,53 +1520,134 @@ class CoreWorker:
         for spec, _ in items:
             self._store_task_error(spec, error)
 
-    # pushes in flight per lease connection: overlaps push RTT + spec
+    # task specs in flight per lease connection: overlaps push RTT + spec
     # serialization with worker execution (the worker drains its own FIFO
     # serially, so this changes delivery, not execution concurrency) —
     # reference push-queue pipelining, direct_task_transport.cc:174/213
     _PUSH_WINDOW = 8
 
+    def _drain_batch_locked(self, st, budget: int, batch_max: int) -> list:
+        """_sched_lock held: pop up to min(budget, batch_max) specs for
+        one push_tasks frame.  A spec with ObjectRef args always travels
+        alone: the worker resolves its dependencies before enqueueing it,
+        and a batch is only acked once every member has been enqueued —
+        so a dependent batched behind its in-frame producer would wait on
+        an ack that waits on it (head-of-line deadlock)."""
+        batch = []
+        limit = min(budget, batch_max)
+        while (st["queue"] and not self._shutdown.is_set()
+               and len(batch) < limit):
+            spec, retries = st["queue"][0]
+            if spec.get("_refs") and batch:
+                break
+            st["queue"].popleft()
+            batch.append((spec, retries))
+            if spec.get("_refs"):
+                break
+        return batch
+
     def _lease_worker_loop(self, key: str, st, lease: _Lease) -> None:
         """Pull tasks from the key's queue and pipeline them to this
-        worker: up to _PUSH_WINDOW unacked pushes ride the connection."""
-        inflight: deque = deque()   # (spec, retries, future)
+        worker: queued specs coalesce into batched ``push_tasks`` frames
+        (task_submit_batch_max per frame) that the worker executes in
+        order and acks in batch; up to _PUSH_WINDOW unacked specs ride
+        the connection across frames.  Mid-frame completions stream back
+        early as task_done pushes (resolved via lease.pending), so a
+        fast task batched behind a slow one is observable as soon as it
+        finishes — batch acks change framing, not completion latency.
+        When the queue drains the lease is parked for
+        ``lease_keepalive_ms`` before being returned, so back-to-back
+        synchronous submissions reuse the warm worker."""
+        inflight: deque = deque()   # (batch, future); batch: [(spec, retries)]
+        batch_max = max(1, CONFIG.task_submit_batch_max)
+        keepalive = max(0.0, CONFIG.lease_keepalive_ms / 1000.0)
         while True:
-            while len(inflight) < self._PUSH_WINDOW:
+            while True:
+                with lease.plock:
+                    budget = self._PUSH_WINDOW - len(lease.pending)
+                if budget <= 0:
+                    break
                 with self._sched_lock:
-                    if st["queue"] and not self._shutdown.is_set():
-                        spec, retries = st["queue"].popleft()
-                    else:
-                        break
+                    batch = self._drain_batch_locked(st, budget, batch_max)
+                if not batch:
+                    break
+                with lease.plock:
+                    for spec, retries in batch:
+                        lease.pending[spec["task_id"]] = (spec, retries)
                 # send failures surface through the future (call_async
                 # catches them internally), landing in the dead-worker
                 # path below like any mid-task connection loss
-                inflight.append((spec, retries,
-                                 lease.conn.call_async("push_task", spec)))
+                fut = lease.conn.call_async(
+                    "push_tasks", {"specs": [s for s, _ in batch]})
+                inflight.append((batch, fut))
             if not inflight:
                 with self._sched_lock:
                     # closing window: a task may have been enqueued after
                     # our empty-queue read above
                     if st["queue"] and not self._shutdown.is_set():
                         continue
+                    if (keepalive <= 0 or self._shutdown.is_set()
+                            or lease.conn.closed):
+                        st["leases"].remove(lease)
+                        break
+                    st["idle"] += 1
+                    deadline = time.monotonic() + keepalive
+                    while not st["queue"] and not self._shutdown.is_set():
+                        t = deadline - time.monotonic()
+                        if t <= 0:
+                            break
+                        self._sched_cv.wait(t)
+                    st["idle"] -= 1
+                    if (st["queue"] and not self._shutdown.is_set()
+                            and not lease.conn.closed):
+                        continue
                     st["leases"].remove(lease)
                 break
-            spec, retries, fut = inflight.popleft()
+            batch, fut = inflight.popleft()
             try:
                 reply = fut.result(None)
-                self._on_task_reply(spec, reply)
-            except (ConnectionError, OSError, rpc.RemoteError) as e:
-                if isinstance(e, rpc.RemoteError):
-                    self._store_task_error(spec, exc.RayTpuError(str(e)))
-                    continue
-                # Worker died mid-task. The worker drains its FIFO
-                # serially, so only this oldest unacked push can have been
-                # executing — it alone is charged retry/OOM budget; the
-                # younger in-flight pushes never ran and requeue for free.
-                oom = self._lease_was_oom_killed(lease)
-                with self._sched_lock:
-                    for s, r, _ in reversed(inflight):
-                        st["queue"].appendleft((s, r))
-                self._retry_or_fail_dead_worker(st, spec, retries, oom, e)
+            except rpc.RemoteError as e:
+                # dispatch-level failure of the whole frame (user task
+                # errors come back per-spec, not as RemoteError): fail its
+                # unresolved specs; the connection is healthy and keeps
+                # serving
+                for spec, _retries in batch:
+                    if self._lease_unresolve(lease, spec) is not None:
+                        self._store_task_error(spec, exc.RayTpuError(str(e)))
+                continue
+            except (ConnectionError, OSError) as e:
+                # Worker died mid-flight. It drains its FIFO serially, so
+                # of the unresolved specs (send order — task_done pushes
+                # already resolved everything that finished) only the
+                # FIRST is charged retry/OOM budget; the rest requeue
+                # free.  Send order approximates execution order: a ref-
+                # carrying spec resolving args slowly can be overtaken in
+                # the executor FIFO by a younger ref-free frame — the
+                # same approximation the per-push-thread path always made
+                # (pipelined pushes rode independent dispatch threads).
+                # Drain the connection's push backlog first: a task_done
+                # delivered just before the death must resolve its spec,
+                # not be charged as a worker crash.
+                try:
+                    lease.conn.drain_pushes()
+                except Exception:
+                    pass
+                with lease.plock:
+                    remaining = list(lease.pending.values())
+                    lease.pending.clear()
+                oom = (self._lease_was_oom_killed(lease) if remaining
+                       else False)
+                if remaining:
+                    with self._sched_lock:
+                        for s, r in reversed(remaining[1:]):
+                            st["queue"].appendleft((s, r))
+                        # wake parked keepalive leases: _maybe_request_
+                        # lease relies on them having been notified when
+                        # it declines to open a lease for a short queue
+                        self._sched_cv.notify_all()
+                    spec, retries = remaining[0]
+                    self._retry_or_fail_dead_worker(st, spec, retries,
+                                                    oom, e)
                 with self._sched_lock:
                     st["leases"].remove(lease)
                 try:
@@ -1538,8 +1656,47 @@ class CoreWorker:
                     pass
                 self._maybe_request_lease(key, st)
                 return
+            else:
+                self._consume_batch_reply(lease, batch, reply)
         self._return_lease(lease)
         self._maybe_request_lease(key, st)
+
+    def _lease_unresolve(self, lease: _Lease, spec) -> Optional[tuple]:
+        """Claim a spec for resolution: pops its pending entry exactly
+        once (None when a task_done push already resolved it)."""
+        with lease.plock:
+            return lease.pending.pop(spec["task_id"], None)
+
+    def _lease_task_done(self, lease: _Lease, payload: dict) -> None:
+        """Streamed per-task completion (worker push, ahead of the frame
+        ack).  Runs on the lease connection's serial push thread."""
+        with lease.plock:
+            item = lease.pending.pop(payload["task_id"], None)
+        if item is None:
+            return
+        self._apply_task_result(item[0], payload["res"])
+
+    def _apply_task_result(self, spec, res: dict) -> None:
+        err = res.get("err")
+        if err is not None:
+            self._store_task_error(spec, exc.RayTpuError(err))
+        else:
+            self._on_task_reply(spec, res["ok"])
+
+    def _consume_batch_reply(self, lease: _Lease, batch: list,
+                             reply: dict) -> None:
+        """Resolve one acked push_tasks frame: per-spec results in frame
+        order, skipping specs a task_done push resolved early."""
+        results = reply["results"]
+        for (spec, _retries), res in zip(batch, results):
+            if self._lease_unresolve(lease, spec) is not None:
+                self._apply_task_result(spec, res)
+        # a short reply (worker bug) must not strand the tail's owners
+        for spec, _retries in batch[len(results):]:
+            if self._lease_unresolve(lease, spec) is not None:
+                self._store_task_error(spec, exc.RayTpuError(
+                    f"worker returned no result for task "
+                    f"{spec.get('name', '')}"))
 
     def _retry_or_fail_dead_worker(self, st, spec, retries: int,
                                    oom: bool, e: BaseException) -> None:
@@ -1556,6 +1713,7 @@ class CoreWorker:
                             "retries left)", spec["name"], left - 1)
                 with self._sched_lock:
                     st["queue"].appendleft((spec, retries))
+                    self._sched_cv.notify_all()  # wake parked leases
             else:
                 self._store_task_error(
                     spec, exc.OutOfMemoryError(
@@ -1568,6 +1726,7 @@ class CoreWorker:
                         spec["name"], retries)
             with self._sched_lock:
                 st["queue"].appendleft((spec, retries - 1))
+                self._sched_cv.notify_all()  # wake parked leases
         else:
             self._store_task_error(spec, exc.WorkerCrashedError(
                 f"task {spec['name']} worker died: {e}"))
@@ -1974,13 +2133,28 @@ class _ActorPipe:
         self.next_seq = 0
         self.stream = ""
         self.broken = False
+        # sender thread holds a popped spec whose seq isn't assigned yet:
+        # the inline fast path must not overtake it (seq = submission
+        # order is the actor ordering guarantee)
+        self.draining = 0
         self.thread = threading.Thread(target=self._loop, daemon=True)
         self.thread.start()
 
     def enqueue(self, spec, retries: int) -> None:
         with self.cv:
-            self.queue.append((spec, retries))
-            self.cv.notify()
+            if (self.conn is None or self.conn.closed or self.broken
+                    or self.queue or self.draining):
+                # cold/broken/backed-up pipe: the sender thread plans it
+                self.queue.append((spec, retries))
+                self.cv.notify()
+                return
+            # warm idle pipe: assign the seq and send from the caller's
+            # thread — skips a sender-thread wake per call.  Wire order
+            # may interleave with a concurrent inline sender, but seqs
+            # are assigned under cv in submission order and the worker
+            # executes by seq, so ordering holds.
+            conn, seq, spec = self._assign_locked(spec, retries)
+        self._send_assigned(conn, seq, spec)
 
     def _loop(self) -> None:
         while True:
@@ -1991,25 +2165,56 @@ class _ActorPipe:
                     self._handle_break_locked()
                     continue
                 spec, retries = self.queue.popleft()
-            if not self._ensure_conn(spec):
-                continue
-            with self.cv:
-                seq = self.next_seq
-                self.next_seq += 1
-                spec = dict(spec, seq=seq, stream=self.stream)
-                self.inflight[seq] = (spec, retries)
-                conn = self.conn
-            fut = conn.call_async("actor_task", spec)
-            fut.add_done_callback(
-                lambda f, s=seq, sp=spec: self._on_done(s, sp, f))
+                self.draining += 1
+            try:
+                try:
+                    ok = self._ensure_conn(spec)
+                except (ConnectionError, OSError, TimeoutError):
+                    # the resolved address can be stale mid-restart (the
+                    # GCS may answer ALIVE with the dying worker's
+                    # address for a beat): requeue and retry.  This must
+                    # NOT escape — an uncaught connect error here kills
+                    # the only sender thread and every later call on the
+                    # pipe hangs to its get() timeout.
+                    with self.cv:
+                        self.queue.appendleft((spec, retries))
+                    time.sleep(0.2)
+                    continue
+                if not ok:
+                    continue
+                with self.cv:
+                    conn, seq, spec = self._assign_locked(spec, retries)
+            finally:
+                with self.cv:
+                    self.draining -= 1
+            self._send_assigned(conn, seq, spec)
+
+    def _assign_locked(self, spec, retries: int):
+        """cv held: stamp the next seq + current stream onto the spec
+        and register it in-flight.  Both send paths (inline enqueue and
+        the sender thread) MUST come through here — the stream stamp is
+        what lets _on_done distinguish a stale-connection failure from a
+        live break."""
+        seq = self.next_seq
+        self.next_seq += 1
+        spec = dict(spec, seq=seq, stream=self.stream)
+        self.inflight[seq] = (spec, retries)
+        return self.conn, seq, spec
+
+    def _send_assigned(self, conn, seq: int, spec) -> None:
+        fut = conn.call_async("actor_task", spec)
+        fut.add_done_callback(
+            lambda f, s=seq, sp=spec: self._on_done(s, sp, f))
 
     def _ensure_conn(self, spec) -> bool:
+        """True when a live connection is bound.  Raises ConnectionError/
+        OSError on a transient connect failure (caller retries); returns
+        False after failing the pipe's work on a permanent actor error."""
         with self.cv:
             if self.conn is not None and not self.conn.closed:
                 return True
         try:
             addr = self.core._resolve_actor(self.aid)
-            conn = rpc.connect(addr)
         except exc.RayTpuError as e:
             self.core._store_actor_error(spec, e)
             # fail everything queued: the actor is gone for good
@@ -2019,6 +2224,7 @@ class _ActorPipe:
             for sp, _ in dead:
                 self.core._store_actor_error(sp, e)
             return False
+        conn = rpc.connect(addr)
         with self.cv:
             self.conn = conn
             self.stream = WorkerID.from_random().hex()[:16]
@@ -2030,10 +2236,16 @@ class _ActorPipe:
             reply = fut.result()
         except (ConnectionError, OSError):
             # connection died; the sender thread re-plans everything that
-            # was in flight, so just flag the break
+            # was in flight, so just flag the break — but only if this
+            # failure belongs to the CURRENT stream.  An inline send can
+            # race break recovery: its call_async lands on the old closed
+            # conn after _handle_break_locked already re-planned that
+            # stream (including this seq) onto a fresh connection, and
+            # re-flagging would tear the healthy replacement down.
             with self.cv:
-                self.broken = True
-                self.cv.notify()
+                if spec.get("stream") == self.stream:
+                    self.broken = True
+                    self.cv.notify()
             return
         except rpc.RemoteError as e:
             self.core._store_actor_error(spec, exc.RayTpuError(str(e)))

@@ -62,6 +62,16 @@ class GcsServer:
 
     _TOMBSTONE = "__gcs_wal_tombstone__"
 
+    # Handlers that only take self._lock, never block, never WAL and never
+    # call back over the connection: the RPC layer runs them inline on the
+    # reader thread (rpc.py fast-method registry), skipping the dispatch-
+    # pool hop on the control plane's highest-frequency calls (liveness
+    # heartbeats, KV reads, actor-resolution polls).
+    FAST_METHODS = frozenset({
+        "heartbeat", "kv_get", "kv_exists", "kv_keys", "list_nodes",
+        "get_actor", "get_placement_group",
+    })
+
     SNAPSHOT_TABLES = ("_nodes", "_actors", "_named_actors", "_jobs",
                       "_kv", "_placement_groups")
 
@@ -88,7 +98,8 @@ class GcsServer:
         self._subs: Dict[str, List[rpc.Connection]] = {}
         self._node_conns: Dict[str, rpc.Connection] = {}
         self._server = rpc.Server(self._handle, host=host, port=port,
-                                  on_disconnect=self._on_disconnect)
+                                  on_disconnect=self._on_disconnect,
+                                  fast_methods=self.FAST_METHODS)
         self._stopped = threading.Event()
         self._retry_inflight = threading.Event()
         from ray_tpu._core.scheduler import make_scheduler

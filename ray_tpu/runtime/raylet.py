@@ -235,8 +235,19 @@ class Raylet:
         self._lock = threading.RLock()
         self._stopped = threading.Event()
 
+        # handlers that only touch in-memory state under short locks (no
+        # spawns, no GCS round trips, no disk): dispatched inline on the
+        # reader thread by the RPC fast path.  Lease/actor RPCs stay
+        # pooled — they block on spawns and dispatch scans.
+        # register_worker MUST be fast: lease_worker handlers park pool
+        # threads waiting on worker registration, so a registration
+        # queued behind a full pool of parked leases would wedge the
+        # whole wave until the lease timeout.
+        fast = frozenset({"was_oom_killed", "store_stats", "node_info",
+                          "list_workers", "spill_dir", "register_worker"})
         self._server = rpc.Server(self._handle, host=host,
-                                  on_disconnect=self._conn_closed)
+                                  on_disconnect=self._conn_closed,
+                                  fast_methods=fast)
         self.address = self._server.address
 
         self.gcs_address = tuple(gcs_address)
@@ -1177,7 +1188,12 @@ class Raylet:
         return handle
 
     def _rpc_register_worker(self, conn, p):
-        """Workers call home once their RPC server is up."""
+        """Workers call home once their RPC server is up.
+
+        Runs inline on the reader (fast method): the bookkeeping is a
+        short lock hold, and the pending-lease scan — which can spawn
+        workers and block — is kicked to its own thread so the reader
+        never stalls."""
         wid = p["worker_id"]
         with self._lock:
             h = self._workers.get(wid)
@@ -1187,7 +1203,8 @@ class Raylet:
             h.conn = conn
             conn.peer = ("worker", wid)
             h.ready.set()
-        self._dispatch_pending()
+        threading.Thread(target=self._dispatch_pending,
+                         daemon=True).start()
         return {"ok": True}
 
     def _wait_worker_ready(self, h: WorkerHandle) -> bool:

@@ -58,6 +58,11 @@ class WorkerProcess:
             # nested tasks/actors submitted from this worker inherit the
             # same env (reference: job/parent runtime_env inheritance)
             self.core.job_runtime_env = desc
+        # inline-return threshold, resolved once (a CONFIG attribute read
+        # per returned value is measurable on the small-task hot path)
+        inline_ret = CONFIG.rpc_inline_return_max_bytes
+        self._inline_ret_max = (CONFIG.inline_object_max_bytes
+                                if inline_ret < 0 else inline_ret)
         # actor state
         self.actor_instance: Any = None
         self.actor_id: Optional[str] = None
@@ -86,14 +91,29 @@ class WorkerProcess:
         core_handle = self.core._handle_rpc
 
         def dispatch(conn, method, payload):
-            if method in ("push_task", "actor_task", "create_actor", "kill",
-                          "profile"):
+            if method in ("push_task", "push_tasks", "actor_task",
+                          "create_actor", "kill", "profile"):
                 return self._handle(conn, method, payload)
             return core_handle(conn, method, payload)
 
-        self.core._server._handler = dispatch
-        for c in self.core._server.connections():
-            c._handler = dispatch
+        def fast(method, payload):
+            # deferred-reply handlers that only buffer + notify: run them
+            # inline on the reader thread (rpc.py fast path).  actor_task
+            # never blocks (seq buffering; the actor loop replies).
+            # push_tasks blocks only to resolve ObjectRef args — the
+            # owner marks such specs (singleton frames, "_refs"), and
+            # they take the pooled path so a slow dependency fetch can't
+            # stall the connection's reader.
+            if method == "actor_task":
+                return True
+            if method == "push_tasks":
+                try:
+                    return all(not s.get("_refs") for s in payload["specs"])
+                except (TypeError, KeyError):
+                    return False
+            return False
+
+        self.core._server.rebind(dispatch, fast_methods=fast)
 
         # register with the raylet; the raylet sends us requests
         # (create_actor, kill) back over this same duplex connection.
@@ -114,6 +134,8 @@ class WorkerProcess:
 
     # ------------------------------------------------------------- dispatch
     def _handle(self, conn, method, p):
+        if method == "push_tasks":
+            return self._run_queued_batch(conn, p)
         if method == "push_task":
             return self._run_queued(p)
         if method == "actor_task":
@@ -149,26 +171,94 @@ class WorkerProcess:
             return self._package_error(spec, e)
         done = threading.Event()
         out: dict = {}
+
+        def cb(reply, err):
+            if err is None:
+                out["reply"] = reply
+            else:
+                out["raise"] = err
+            done.set()
+
         with self._queue_cv:
-            self._queue.append(((spec, resolved), done, out))
+            self._queue.append((spec, resolved, cb))
             self._queue_cv.notify()
         done.wait()
         if "raise" in out:
             raise out["raise"]
         return out["reply"]
 
+    def _run_queued_batch(self, conn, p) -> "rpc.Deferred":
+        """Batched ``push_tasks`` frame: enqueue every spec to the serial
+        executor FIFO in frame order; the LAST completion resolves the
+        deferred batch ack directly from the executor thread (no handler
+        thread parked on the frame).  The owner guarantees only a
+        singleton frame carries ObjectRef args
+        (core_worker._drain_batch_locked), so the enqueue pass can never
+        block on a result the frame itself is yet to produce.  For
+        multi-spec frames each completion is ALSO streamed back
+        immediately as a task_done push: a fast task batched behind a
+        slow one resolves at its own finish time, not the frame's (the
+        batch ack is the idempotent backstop for lost pushes)."""
+        specs = p["specs"]
+        if not specs:
+            return {"results": []}   # nothing to defer on
+        d = rpc.Deferred()
+        state = {"left": len(specs), "results": [None] * len(specs)}
+        lock = threading.Lock()
+        stream = len(specs) > 1
+
+        def finish(i, spec, res):
+            if stream:
+                try:
+                    conn.push("task_done", {"task_id": spec["task_id"],
+                                            "res": res})
+                except Exception:
+                    # dead socket, unpicklable/oversized payload, …: the
+                    # batch ack is the authoritative backstop — a push
+                    # failure must NEVER stop 'left' from reaching zero
+                    # or the frame's Deferred ack (and the owner's lease
+                    # loop with it) hangs forever
+                    pass
+            with lock:
+                state["results"][i] = res
+                state["left"] -= 1
+                last = state["left"] == 0
+            if last:
+                d.resolve({"results": state["results"]})
+
+        for i, spec in enumerate(specs):
+            try:
+                resolved = self._resolve_args(spec["args"])
+            except Exception as e:      # dep failed: report as task error
+                finish(i, spec, {"ok": self._package_error(spec, e)})
+                continue
+
+            def cb(reply, err, i=i, spec=spec):
+                # non-Exception escapes (SystemExit, MemoryError) become
+                # per-spec textual errors so the rest of the frame's acks
+                # survive, mirroring the solo-push RemoteError path
+                finish(i, spec, {"ok": reply} if err is None
+                       else {"err": repr(err)})
+
+            with self._queue_cv:
+                self._queue.append((spec, resolved, cb))
+                self._queue_cv.notify()
+        return d
+
     def _exec_loop(self) -> None:
         while True:
             with self._queue_cv:
                 while not self._queue:
                     self._queue_cv.wait()
-                work, done, out = self._queue.pop(0)
-            spec, resolved = work
+                spec, resolved, cb = self._queue.pop(0)
             try:
-                out["reply"] = self._execute(spec, resolved)
+                reply, err = self._execute(spec, resolved), None
             except BaseException as e:  # noqa: BLE001
-                out["raise"] = e
-            done.set()
+                reply, err = None, e
+            try:
+                cb(reply, err)
+            except Exception:
+                logger.exception("task completion callback failed")
 
     def _resolve_args(self, blob: bytes) -> tuple:
         """Returns (args, kwargs, borrowed_oids); the caller must hand
@@ -253,7 +343,7 @@ class WorkerProcess:
         for i, value in enumerate(values):
             head, views = ser.serialize(value)
             size = ser.serialized_size(head, views)
-            if size <= CONFIG.inline_object_max_bytes:
+            if size <= self._inline_ret_max:
                 results.append({"data": ser.to_flat_bytes(head, views)})
             else:
                 oid = ObjectID.for_task_return(task_id, i)
@@ -279,7 +369,7 @@ class WorkerProcess:
         for j, value in enumerate(values):
             head, views = ser.serialize(value)
             size = ser.serialized_size(head, views)
-            if size <= CONFIG.inline_object_max_bytes:
+            if size <= self._inline_ret_max:
                 subs.append({"data": ser.to_flat_bytes(head, views)})
             else:
                 oid = ObjectID.for_task_return(task_id, j + 1)
@@ -343,19 +433,18 @@ class WorkerProcess:
                     type(self.actor_instance).__name__)
         return {"ok": True}
 
-    def _run_actor_task(self, spec) -> dict:
-        """Block until this (stream, seq)'s turn; executed on actor thread."""
-        done = threading.Event()
-        out: dict = {}
+    def _run_actor_task(self, spec) -> "rpc.Deferred":
+        """Buffer until this (stream, seq)'s turn; the actor thread that
+        executes the call resolves the deferred reply directly — no
+        handler thread parks per buffered seq, so deep pipelines hold no
+        dispatch threads and the completion skips a wake hop."""
+        d = rpc.Deferred()
         with self._actor_cv:
             stream = self._actor_streams.setdefault(
                 spec.get("stream", ""), {"next": 0, "buf": {}})
-            stream["buf"][spec["seq"]] = (spec, done, out)
+            stream["buf"][spec["seq"]] = (spec, d)
             self._actor_cv.notify_all()
-        done.wait()
-        if "raise" in out:
-            raise out["raise"]
-        return out["reply"]
+        return d
 
     def _next_actor_work(self):
         for stream in self._actor_streams.values():
@@ -372,20 +461,19 @@ class WorkerProcess:
                 while work is None:
                     self._actor_cv.wait()
                     work = self._next_actor_work()
-            spec, done, out = work
+            spec, d = work
             if self._actor_event_loop is not None:
-                self._dispatch_async(spec, done, out)
+                self._dispatch_async(spec, d)
             elif self._group_pools is not None:
                 try:
                     group = self._method_group(spec)
                 except ValueError as e:
-                    out["reply"] = self._package_error(spec, e)
-                    done.set()
+                    d.resolve(self._package_error(spec, e))
                     continue
                 self._group_pools[group].submit(
-                    self._run_actor_work, spec, done, out)
+                    self._run_actor_work, spec, d)
             else:
-                self._run_actor_work(spec, done, out)
+                self._run_actor_work(spec, d)
 
     def _method_group(self, spec) -> str:
         """Concurrency group for a call: per-call override, else the
@@ -406,14 +494,13 @@ class WorkerProcess:
                 f"(declared: {sorted(k for k in self._group_caps if k != '_default')})")
         return g
 
-    def _run_actor_work(self, spec, done, out) -> None:
+    def _run_actor_work(self, spec, d) -> None:
         try:
-            out["reply"] = self._execute_actor(spec)
+            d.resolve(self._execute_actor(spec))
         except BaseException as e:  # noqa: BLE001
-            out["raise"] = e
-        done.set()
+            d.fail(e)
 
-    def _dispatch_async(self, spec, done, out) -> None:
+    def _dispatch_async(self, spec, d) -> None:
         """Schedule one call onto the actor's event loop; the dispatcher
         never blocks, so calls pipeline up to their group's semaphore."""
         import asyncio
@@ -423,14 +510,12 @@ class WorkerProcess:
                 try:
                     sem = self._group_sems[self._method_group(spec)]
                 except ValueError as e:
-                    out["reply"] = self._package_error(spec, e)
+                    d.resolve(self._package_error(spec, e))
                     return
                 async with sem:
-                    out["reply"] = await self._execute_actor_async(spec)
+                    d.resolve(await self._execute_actor_async(spec))
             except BaseException as e:  # noqa: BLE001
-                out["raise"] = e
-            finally:
-                done.set()
+                d.fail(e)
 
         asyncio.run_coroutine_threadsafe(run(), self._actor_event_loop)
 

@@ -62,6 +62,7 @@ class ScriptedOwner(cw.CoreWorker):
         # is a seam this test file must think about explicitly
         self._sched = {}
         self._sched_lock = threading.Lock()
+        self._sched_cv = threading.Condition(self._sched_lock)
         self._shutdown = threading.Event()
         self._raylet = rpc.connect(raylet_addr)
         self._oom_retries = {}
@@ -108,10 +109,11 @@ class ScriptedOwner(cw.CoreWorker):
 
 
 def ok_worker():
-    """Worker that acks every push with one inline result."""
-    def push_task(conn, spec):
-        return {"results": [{"name": spec["name"]}]}
-    return FakePeer({"push_task": push_task})
+    """Worker that acks every push_tasks frame with per-spec results."""
+    def push_tasks(conn, p):
+        return {"results": [{"ok": {"results": [{"name": s["name"]}]}}
+                            for s in p["specs"]]}
+    return FakePeer({"push_tasks": push_tasks})
 
 
 def granting_raylet(worker, grants=None, returns=None):
@@ -219,12 +221,12 @@ def test_worker_death_charges_only_oldest_push():
     next lease.  A task with no retries left fails exactly once."""
     first = ok_worker()
 
-    def dying_push(conn, spec):
+    def dying_push(conn, p):
         # die with the whole pipeline unacked
         conn.close()
         raise rpc.RpcError("unreachable")  # conn gone; never delivered
 
-    dead = FakePeer({"push_task": dying_push})
+    dead = FakePeer({"push_tasks": dying_push})
     leases = [dead, first]
 
     def lease_worker(conn, p):
@@ -253,11 +255,11 @@ def test_worker_death_no_retries_fails_only_executing_task():
     so they are not charged)."""
     first = ok_worker()
 
-    def dying_push(conn, spec):
+    def dying_push(conn, p):
         conn.close()
         raise rpc.RpcError("unreachable")
 
-    dead = FakePeer({"push_task": dying_push})
+    dead = FakePeer({"push_tasks": dying_push})
     leases = [dead, first]
     r = FakePeer({"lease_worker": lambda conn, p: {
         "lease_id": "l", "worker_id": "w",
@@ -276,18 +278,20 @@ def test_worker_death_no_retries_fails_only_executing_task():
 
 
 def test_remote_error_keeps_lease_serving():
-    """A task raising on the worker (RemoteError reply) must not kill
-    the lease: subsequent pipelined tasks keep flowing on the same
-    connection, and the failed task is charged no worker-death retry."""
-    n = [0]
+    """A task failing on the worker (per-spec err entry in the batch
+    ack) must not kill the lease: subsequent pipelined tasks keep
+    flowing on the same connection, and the failed task is charged no
+    worker-death retry."""
+    def push_tasks(conn, p):
+        out = []
+        for s in p["specs"]:
+            if s["name"] == "bad":
+                out.append({"err": "user exception"})
+            else:
+                out.append({"ok": {"results": [{"name": s["name"]}]}})
+        return {"results": out}
 
-    def push_task(conn, spec):
-        n[0] += 1
-        if spec["name"] == "bad":
-            raise rpc.RpcError("user exception")
-        return {"results": [{"name": spec["name"]}]}
-
-    w = FakePeer({"push_task": push_task})
+    w = FakePeer({"push_tasks": push_tasks})
     r = granting_raylet(w)
     o = ScriptedOwner(r.address)
     try:
@@ -299,8 +303,37 @@ def test_remote_error_keeps_lease_serving():
         assert sorted(n_ for n_, _ in o.replies) == ["t0", "t1"]
         # no task was treated as a worker death: each pushed exactly once
         # (queue pressure may open a second lease; that's fine)
-        pushed = [p["name"] for p in w.called("push_task")]
+        pushed = [s["name"] for p in w.called("push_tasks")
+                  for s in p["specs"]]
         assert sorted(pushed) == ["bad", "t0", "t1"]
+    finally:
+        o.close()
+
+
+def test_frame_remote_error_fails_whole_batch():
+    """A dispatch-level RemoteError on a push_tasks frame (handler blew
+    up before producing per-spec results) fails every spec of THAT frame
+    without being charged as a worker death, and the lease keeps
+    serving later frames."""
+    n = [0]
+
+    def push_tasks(conn, p):
+        n[0] += 1
+        if n[0] == 1:
+            raise rpc.RpcError("frame dispatch exploded")
+        return {"results": [{"ok": {"results": [{"name": s["name"]}]}}
+                            for s in p["specs"]]}
+
+    w = FakePeer({"push_tasks": push_tasks})
+    r = granting_raylet(w)
+    o = ScriptedOwner(r.address)
+    try:
+        o.push("t0", retries=3)
+        o.wait_done(1)
+        assert [n_ for n_, _ in o.errors] == ["t0"]  # retries NOT consumed
+        o.push("t1")
+        o.wait_done(2)
+        assert [n_ for n_, _ in o.replies] == ["t1"]
     finally:
         o.close()
 
@@ -353,6 +386,6 @@ def test_lease_returned_when_queue_cancelled_before_grant():
         while not r.called("return_worker") and time.monotonic() < deadline:
             time.sleep(0.01)
         assert r.called("return_worker"), "cancelled grant leaked"
-        assert not w.called("push_task")
+        assert not w.called("push_tasks")
     finally:
         o.close()
