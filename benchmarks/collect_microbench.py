@@ -57,6 +57,10 @@ SECTIONS = {
     "data": dict(cmd=[sys.executable,
                       os.path.join(REPO, "benchmarks", "data_ingest.py")],
                  timeout=900),
+    "streaming": dict(cmd=[sys.executable,
+                           os.path.join(REPO, "benchmarks",
+                                        "streaming_perf.py")],
+                      timeout=600),
     "serve_llm": dict(cmd=[sys.executable,
                            os.path.join(REPO, "benchmarks", "serve_llm.py"),
                            "--suite", "--slots", "32", "--requests", "128"],
@@ -84,6 +88,12 @@ SECTIONS = {
 _CONTROL_PLANE_ROWS = {
     "single client tasks sync": "tasks_sync_ops_s",
     "1:1 actor calls sync": "actor_sync_ops_s",
+}
+
+# Streaming-generator rows (docs/streaming_generators.md): the per-item
+# report path's throughput must stay visible the same way.
+_STREAMING_ROWS = {
+    "streaming 100-yield": "streaming_items_s",
 }
 
 
@@ -114,6 +124,26 @@ def control_plane_deltas(core_rows, committed):
             continue
         prev, cur = base[row["name"]], row["ops_per_s"]
         out[key] = {"committed_ops_s": prev, "current_ops_s": cur,
+                    "ratio": round(cur / prev, 3)}
+    return out
+
+
+def streaming_deltas(stream_rows, committed):
+    """Same contract for the streaming section's items/s rows."""
+    if not committed:
+        return {}
+    base = {r["name"]: r.get("items_per_s")
+            for r in committed.get("streaming", []) if isinstance(r, dict)}
+    out = {}
+    for row in stream_rows:
+        if not isinstance(row, dict):
+            continue
+        key = _STREAMING_ROWS.get(row.get("name"))
+        if key is None or not base.get(row["name"]) \
+                or not row.get("items_per_s"):
+            continue
+        prev, cur = base[row["name"]], row["items_per_s"]
+        out[key] = {"committed_items_s": prev, "current_items_s": cur,
                     "ratio": round(cur / prev, 3)}
     return out
 
@@ -212,15 +242,26 @@ def main():
         prev = {}
     merge_preserve(out, prev, regenerated)
 
+    committed = None
+    if "core" in regenerated or "streaming" in regenerated:
+        committed = _committed_baseline(args.output)
     if "core" in regenerated:
-        deltas = control_plane_deltas(out["core"],
-                                      _committed_baseline(args.output))
+        deltas = control_plane_deltas(out["core"], committed)
         if deltas:
             out["control_plane_deltas"] = deltas
             for key, d in deltas.items():
                 tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
                 print(f"[collect] {key}: {d['committed_ops_s']:,.0f} -> "
                       f"{d['current_ops_s']:,.0f} ops/s "
+                      f"(x{d['ratio']}) [{tag}]", flush=True)
+    if "streaming" in regenerated:
+        deltas = streaming_deltas(out["streaming"], committed)
+        if deltas:
+            out["streaming_deltas"] = deltas
+            for key, d in deltas.items():
+                tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
+                print(f"[collect] {key}: {d['committed_items_s']:,.0f} -> "
+                      f"{d['current_items_s']:,.0f} items/s "
                       f"(x{d['ratio']}) [{tag}]", flush=True)
 
     with open(args.output, "w") as f:

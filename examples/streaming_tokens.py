@@ -1,0 +1,52 @@
+"""Serve LLM token streaming: print tokens as the replica decodes them.
+
+Drives a Serve LLM deployment (tiny preset, runnable under
+JAX_PLATFORMS=cpu) through the streaming path: the handle's
+``remote_streaming`` call routes to the replica's streaming entrypoint
+with ``num_returns="streaming"``, so every generated token arrives as
+its own ObjectRef the decode step it is produced — the first token
+prints while the request is still generating, instead of after the
+whole completion (docs/streaming_generators.md).
+
+Run:  JAX_PLATFORMS=cpu python examples/streaming_tokens.py
+"""
+import time
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    try:
+        app = serve.llm.build_app(
+            preset="tiny", num_slots=2, block_size=4, max_seq_len=128,
+            warmup_prompt_lens=[16])
+        handle = serve.run(app, name="llm-stream")
+
+        request = {"prompt": [1, 2, 3, 4, 5], "max_new_tokens": 24}
+        t0 = time.monotonic()
+        # route to LLMServer.stream: an async generator yielding one
+        # {"token": id} dict per decoded token, then a summary dict
+        gen = handle.stream.remote_streaming(request)
+        first_at = None
+        print("tokens: ", end="", flush=True)
+        for ref in gen:
+            item = ray_tpu.get(ref)
+            if "token" in item:
+                if first_at is None:
+                    first_at = time.monotonic() - t0
+                print(item["token"], end=" ", flush=True)
+            else:
+                total = time.monotonic() - t0
+                print(f"\nfinish_reason={item['finish_reason']} "
+                      f"num_tokens={item['num_tokens']}")
+                print(f"first token after {first_at:.3f}s, "
+                      f"full stream after {total:.3f}s")
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

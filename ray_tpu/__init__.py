@@ -13,7 +13,8 @@ from ray_tpu.api import (available_resources, cluster_resources, context,  # noq
 from ray_tpu.cross_language import (cpp_actor_class,  # noqa: F401
                                     cpp_function)
 from ray_tpu.runtime.core_worker import (ObjectRef,  # noqa: F401
-                                         ObjectRefGenerator)
+                                         ObjectRefGenerator,
+                                         StreamingObjectRefGenerator)
 
 __version__ = "0.1.0"
 
@@ -21,6 +22,6 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "get_actor", "kill", "nodes", "cluster_resources",
     "available_resources", "context", "get_runtime_context", "ObjectRef",
-    "ObjectRefGenerator", "CONFIG", "cpp_function", "cpp_actor_class",
-    "__version__",
+    "ObjectRefGenerator", "StreamingObjectRefGenerator", "CONFIG",
+    "cpp_function", "cpp_actor_class", "__version__",
 ]

@@ -137,6 +137,14 @@ _declare("rpc_inline_return_max_bytes", int, -1,
          "push_tasks/actor_task reply instead of through the shared-"
          "memory store + location round trip.  -1 (default) follows "
          "inline_object_max_bytes.")
+_declare("generator_backpressure_num_objects", int, -1,
+         "num_returns=\"streaming\" backpressure: a generator task may "
+         "have at most this many reported-but-unconsumed items in "
+         "flight; past it the producing worker pauses until the "
+         "consumer's next() acks consumption (the owner withholds item-"
+         "report replies).  <= 0 disables (unbounded stream).  Stamped "
+         "into each spec at submit time, so the OWNER's config governs "
+         "the stream it consumes.")
 _declare("task_submit_batch_max", int, 8,
          "Max task specs coalesced into one push_tasks frame per leased "
          "worker.  Specs carrying ObjectRef args always travel alone "

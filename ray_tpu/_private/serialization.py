@@ -100,6 +100,16 @@ def write_into(buf: memoryview, head_payload: bytes, views: List[memoryview]) ->
 
 def deserialize(buf: memoryview | bytes) -> Any:
     """Reconstruct from one contiguous buffer; numpy views stay zero-copy."""
+    return deserialize_with_viewinfo(buf)[0]
+
+
+def deserialize_with_viewinfo(buf: memoryview | bytes) -> Tuple[Any, bool]:
+    """Reconstruct from one contiguous buffer; returns (value,
+    holds_views).  ``holds_views`` is True when the payload carried
+    out-of-band buffers — the deserialized value (numpy arrays etc.) may
+    hold zero-copy views into ``buf``, so a shared-memory caller must
+    keep the segment pinned; when False the value is self-contained and
+    the pin can be released immediately."""
     buf = memoryview(buf)
     (meta_len,) = _HEADER.unpack(bytes(buf[:_HEADER.size]))
     off = _HEADER.size
@@ -114,7 +124,7 @@ def deserialize(buf: memoryview | bytes) -> Any:
     value = pickle.loads(payload, buffers=oob)
     if meta.get("err"):
         raise value
-    return value
+    return value, bool(meta["lens"])
 
 
 def error_type_of(buf: memoryview | bytes) -> int:

@@ -9,7 +9,8 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from ray_tpu._private.ids import ActorID
-from ray_tpu.runtime.core_worker import get_global_worker
+from ray_tpu.runtime.core_worker import (get_global_worker,
+                                         normalize_num_returns)
 
 
 def method(*args, **kwargs):
@@ -33,7 +34,10 @@ class ActorMethod:
                  concurrency_group: Optional[str] = None):
         self._handle = handle
         self._name = name
-        self._num_returns = num_returns
+        # one normalization point shared with RemoteFunction: string
+        # modes ("dynamic", "streaming") are validated here instead of
+        # silently falling through int-only selection in remote()
+        self._num_returns = normalize_num_returns(num_returns)
         self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
@@ -42,7 +46,13 @@ class ActorMethod:
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=self._num_returns,
             concurrency_group=self._concurrency_group)
-        return refs[0] if self._num_returns == 1 else refs
+        if self._num_returns == "streaming":
+            return worker.make_streaming_generator(refs[0])
+        if self._num_returns == 1 or self._num_returns == "dynamic":
+            # "dynamic" reserves one slot: its ref resolves to the
+            # ObjectRefGenerator at completion, same as task semantics
+            return refs[0]
+        return refs
 
     def options(self, num_returns: int = 1,
                 concurrency_group: Optional[str] = None) -> "ActorMethod":

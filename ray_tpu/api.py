@@ -15,8 +15,9 @@ from ray_tpu._private.ids import JobID
 from ray_tpu.actor import ActorClass, get_actor, kill  # noqa: F401
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu.runtime import core_worker as cw
-from ray_tpu.runtime.core_worker import (ObjectRef,
-                                          ObjectRefGenerator)  # noqa: F401
+from ray_tpu.runtime.core_worker import (ObjectRef,  # noqa: F401
+                                          ObjectRefGenerator,
+                                          StreamingObjectRefGenerator)
 from ray_tpu.runtime.node import NodeProcesses, new_session_dir
 
 _init_lock = threading.Lock()

@@ -321,6 +321,26 @@ class Dataset:
             return _repartition_refs(refs, num_blocks)
         return Dataset(self._plan.with_stage(_AllToAll("repartition", fn)))
 
+    def iter_repartitioned(self, rows_per_block: int,
+                           ) -> Iterator[Any]:
+        """Streaming repartition reader: one ``num_returns="streaming"``
+        task re-chunks the dataset into ``rows_per_block``-row blocks
+        and yields each the moment it is cut — the consumer (a training
+        input pipeline) holds the first re-chunked block while the task
+        is still reading later input blocks, instead of waiting for a
+        full repartition() barrier.  Backpressure
+        (``generator_backpressure_num_objects``) bounds how many
+        uncollected blocks accumulate in the object store when the
+        consumer is slower than the reader."""
+        if rows_per_block <= 0:
+            raise ValueError("rows_per_block must be positive")
+        import ray_tpu
+        refs = self._plan.execute()
+        reader = ray_tpu.remote(num_cpus=1)(_rechunk_stream) \
+            .options(num_returns="streaming")
+        for item_ref in reader.remote(rows_per_block, *refs):
+            yield ray_tpu.get(item_ref)
+
     def random_shuffle(self, *, seed: Optional[int] = None,
                        num_blocks: Optional[int] = None) -> "Dataset":
         """Push-based two-phase shuffle (cf. reference
@@ -860,6 +880,24 @@ def _sort_refs(refs: List[Any], key, descending) -> List[Any]:
 
 def _sample_block(block, n, key):
     return BlockAccessor.for_block(block).sample(n, key)
+
+
+def _rechunk_stream(rows_per_block: int, *blocks):
+    """Generator body of Dataset.iter_repartitioned: cut the input
+    blocks' row stream into ``rows_per_block``-row output blocks,
+    yielding each the moment it fills (streamed to the consumer as its
+    own object — never materializing the whole repartition)."""
+    pending: List[Any] = []
+    template = None
+    for block in blocks:
+        template = block
+        for row in BlockAccessor.for_block(block).iter_rows():
+            pending.append(row)
+            if len(pending) >= rows_per_block:
+                yield build_block_like(block, pending)
+                pending = []
+    if pending and template is not None:
+        yield build_block_like(template, pending)
 
 
 def _repartition_refs(refs: List[Any], num_blocks: int) -> List[Any]:

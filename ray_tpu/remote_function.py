@@ -9,7 +9,8 @@ from __future__ import annotations
 import functools
 from typing import Any, Dict, Optional
 
-from ray_tpu.runtime.core_worker import get_global_worker
+from ray_tpu.runtime.core_worker import (get_global_worker,
+                                         normalize_num_returns)
 
 
 class RemoteFunction:
@@ -20,7 +21,7 @@ class RemoteFunction:
                  scheduling_strategy: Any = None,
                  runtime_env: Optional[Dict[str, Any]] = None):
         self._func = func
-        self._num_returns = num_returns
+        self._num_returns = normalize_num_returns(num_returns)
         self._resources = dict(resources or {})
         self._resources["CPU"] = num_cpus
         if num_tpus:
@@ -41,6 +42,11 @@ class RemoteFunction:
         from ray_tpu.util import client as client_mod
         ctx = client_mod.current()
         if ctx is not None:
+            if self._num_returns == "streaming":
+                raise NotImplementedError(
+                    'num_returns="streaming" is not supported in '
+                    "remote-driver (client://) mode: the stream is owned "
+                    "by the submitting process")
             # remote-driver mode is decided at *call* time so functions
             # decorated before init("client://...") still route correctly
             return ctx.remote(
@@ -62,6 +68,9 @@ class RemoteFunction:
             name=getattr(self._func, "__name__", "task"),
             scheduling_strategy=encode_strategy(self._scheduling_strategy),
             runtime_env=worker.prepare_runtime_env(self._runtime_env))
+        if self._num_returns == "streaming":
+            # per-yield delivery: hand back the live stream, not a ref
+            return worker.make_streaming_generator(refs[0])
         if self._num_returns == 1 or self._num_returns == "dynamic":
             return refs[0]
         return refs

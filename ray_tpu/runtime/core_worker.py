@@ -104,8 +104,28 @@ def _rebuild_ref(id_bytes: bytes, owner_addr) -> "ObjectRef":
 
 
 def num_return_slots(num_returns) -> int:
-    """Owner-side return slots: "dynamic" reserves one (the generator)."""
-    return 1 if num_returns == "dynamic" else num_returns
+    """Owner-side return slots: "dynamic" and "streaming" reserve one
+    (the generator / completion-sentinel slot)."""
+    return 1 if num_returns in ("dynamic", "streaming") else num_returns
+
+
+_STRING_NUM_RETURNS = ("dynamic", "streaming")
+
+
+def normalize_num_returns(value, *, where: str = "num_returns"):
+    """Single validation point for the num_returns modes shared by
+    RemoteFunction and ActorMethod: a non-negative int, "dynamic"
+    (refs materialize when the whole task finishes), or "streaming"
+    (per-yield delivery through a StreamingObjectRefGenerator)."""
+    if value in _STRING_NUM_RETURNS:
+        return value
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise TypeError(
+            f"{where} must be a non-negative int, \"dynamic\" or "
+            f"\"streaming\"; got {value!r}")
+    if value < 0:
+        raise ValueError(f"{where} must be >= 0; got {value}")
+    return value
 
 
 class ObjectRefGenerator:
@@ -127,6 +147,103 @@ class ObjectRefGenerator:
 
     def __repr__(self):
         return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+
+class _StreamState:
+    """Owner-side record of one in-flight ``num_returns="streaming"``
+    task (the generator table entry).  Item indexes may arrive in any
+    order (reports and the task-level completion ride different
+    connections); the consumer always advances strictly by index."""
+
+    __slots__ = ("task_binary", "bp", "cv", "arrived", "consumed", "total",
+                 "failed", "parked", "closed", "max_unconsumed")
+
+    def __init__(self, task_binary: bytes, bp: int):
+        self.task_binary = task_binary
+        self.bp = bp                      # backpressure window (<=0: off)
+        self.cv = threading.Condition()
+        self.arrived: set = set()         # reported, not yet consumed
+        self.consumed = 0                 # next index the consumer wants
+        self.total: Optional[int] = None  # num_items once complete
+        self.failed = False               # terminal error stored in slot 0
+        self.closed = False               # consumer dropped the generator
+        # (index, Deferred) item reports parked for backpressure: each
+        # resolves when ITS item is consumed, so the producer's unacked
+        # window is exactly the unconsumed in-flight count
+        self.parked: List[tuple] = []
+        self.max_unconsumed = 0           # high-water mark (tests/stats)
+
+
+class _StreamExhausted:
+    """Internal sentinel returned by CoreWorker._stream_next at end of
+    stream (StopIteration must not cross executor/coroutine seams)."""
+
+
+class StreamingObjectRefGenerator:
+    """The value of a ``num_returns="streaming"`` task/actor call: each
+    ``__next__``/``__anext__`` blocks until the NEXT yielded item has
+    been reported by the executing worker and returns its ObjectRef —
+    the first item is observable while the task is still running, unlike
+    "dynamic" where refs appear only at task completion.  Consuming an
+    item acks it to the producer (releasing backpressure credit).
+
+    Not serializable: the stream is owned by the submitting process."""
+
+    def __init__(self, worker: "CoreWorker", state: _StreamState,
+                 ref: "ObjectRef"):
+        self._worker = worker
+        self._state = state
+        self._ref = ref
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        out = self._worker._stream_next(self._state, self._ref)
+        if out is _StreamExhausted:
+            raise StopIteration
+        return out
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> "ObjectRef":
+        import asyncio
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(
+            None, self._worker._stream_next, self._state, self._ref)
+        if out is _StreamExhausted:
+            raise StopAsyncIteration
+        return out
+
+    def completed(self) -> "ObjectRef":
+        """Ref that resolves when the whole generator task finishes:
+        to the full ObjectRefGenerator of item refs on success, to the
+        task's error on failure (the ``ray.get``-able completion
+        sentinel)."""
+        return self._ref
+
+    def close(self) -> None:
+        """Cancel the stream: parked producer reports are released with
+        a cancel verdict (the worker stops iterating the generator) and
+        unconsumed item objects are freed."""
+        self._worker._close_stream(self._state)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        raise TypeError(
+            "StreamingObjectRefGenerator is not serializable; it can "
+            "only be consumed by the process that submitted the task")
+
+    def __repr__(self):
+        st = self._state
+        return (f"StreamingObjectRefGenerator(consumed={st.consumed}, "
+                f"total={st.total})")
 
 
 _global_worker: Optional["CoreWorker"] = None
@@ -343,8 +460,19 @@ class CoreWorker:
         self._owner_conns_lock = threading.Lock()
         self._pull_budget = _PullBudget(CONFIG.pull_memory_cap_bytes)
 
+        # streaming-generator table: task binary -> _StreamState for every
+        # live num_returns="streaming" submission this process owns
+        self._streams: Dict[bytes, _StreamState] = {}
+        self._streams_lock = threading.Lock()
+
         self.store = SharedMemoryStore.attach(store_path)
-        self._server = rpc.Server(self._handle_rpc, host=host)
+        # report_generator_item only buffers + notifies (and may resolve
+        # a parked Deferred, which just enqueues a reply frame): run it
+        # inline on the reader thread — item delivery latency is the
+        # time-to-first-token path
+        self._server = rpc.Server(
+            self._handle_rpc, host=host,
+            fast_methods=frozenset({"report_generator_item"}))
         self.address = self._server.address
 
         self.gcs = GcsClient(gcs_address)
@@ -541,14 +669,36 @@ class CoreWorker:
                 except Exception:
                     pass
 
-    def _note_pin(self, oid: ObjectID) -> None:
+    def _note_pin(self, oid: ObjectID, pin_out: Optional[list] = None
+                  ) -> None:
         with self._pins_lock:
             self._pins[oid] = self._pins.get(oid, 0) + 1
+        if pin_out is not None:
+            pin_out.append(1)
 
     def _release_pins(self, oid: ObjectID) -> None:
         with self._pins_lock:
             count = self._pins.pop(oid, 0)
         for _ in range(count):
+            try:
+                self.store.release(oid)
+            except Exception:
+                break
+
+    def _release_pins_n(self, oid: ObjectID, n: int) -> None:
+        """Release exactly the ``n`` pins the caller itself took — a
+        concurrent fetch of the same object may hold live views under
+        its own pins, so blanket _release_pins would be unsound here."""
+        with self._pins_lock:
+            count = self._pins.get(oid, 0)
+            take = min(count, n)
+            if take <= 0:
+                return
+            if count - take <= 0:
+                self._pins.pop(oid, None)
+            else:
+                self._pins[oid] = count - take
+        for _ in range(take):
             try:
                 self.store.release(oid)
             except Exception:
@@ -657,10 +807,29 @@ class CoreWorker:
         oid = ref.id
         if oid in self._memory_cache:
             return self._memory_cache[oid]
-        data = self._fetch_serialized(ref, deadline)
+        pins: list = []   # shm pins THIS fetch takes (see _note_pin)
+        data = self._fetch_serialized(ref, deadline, pins)
         if data is None:
             raise exc.GetTimeoutError(f"get timed out on {ref}")
-        value = ser.deserialize(data)   # raises stored task errors
+        try:
+            # raises stored task errors
+            value, holds_views = ser.deserialize_with_viewinfo(data)
+        except BaseException:
+            # no value materialized, so nothing can hold views: drop the
+            # pins this fetch took or every get of a stored error / un-
+            # importable payload leaks one pin per attempt
+            if pins:
+                data = None
+                self._release_pins_n(oid, len(pins))
+            raise
+        if pins and not holds_views:
+            # self-contained value (no zero-copy views into the
+            # segment): drop our pins now instead of carrying them until
+            # cache eviction — a consumer draining a long generator
+            # stream must not pin every consumed item (the
+            # object_store.py:293 leak)
+            data = None
+            self._release_pins_n(oid, len(pins))
         self._memory_cache[oid] = value
         with self._owned_lock:
             borrowed = oid not in self._owned
@@ -692,7 +861,9 @@ class CoreWorker:
                 self._release_pins(oid)
 
     def _fetch_serialized(self, ref: ObjectRef,
-                          deadline: Optional[float]) -> Optional[memoryview]:
+                          deadline: Optional[float],
+                          pin_out: Optional[list] = None
+                          ) -> Optional[memoryview]:
         oid = ref.id
         # 1. owned inline
         with self._owned_lock:
@@ -707,7 +878,8 @@ class CoreWorker:
                 if data is not None:
                     return memoryview(data)
                 # owned but stored in shm somewhere
-                res = self._fetch_from_locations(oid, entry, deadline)
+                res = self._fetch_from_locations(oid, entry, deadline,
+                                                 pin_out)
                 if res is not None:
                     return res
                 if deadline is not None and time.monotonic() >= deadline:
@@ -724,10 +896,10 @@ class CoreWorker:
         res = self.store.get(oid, timeout=0.0)
         if res is not None:
             buf, _ = res
-            self._note_pin(oid)
+            self._note_pin(oid, pin_out)
             return buf
         # 3. ask the owner
-        return self._fetch_from_owner(ref, deadline)
+        return self._fetch_from_owner(ref, deadline, pin_out)
 
     def _alive_node_ids(self, max_age: float = 1.0) -> set:
         """Node liveness view, refreshed from the GCS at most every
@@ -758,7 +930,8 @@ class CoreWorker:
             return set(entry.locations)
 
     def _fetch_from_locations(self, oid: ObjectID, entry: _OwnedObject,
-                              deadline: Optional[float]
+                              deadline: Optional[float],
+                              pin_out: Optional[list] = None
                               ) -> Optional[memoryview]:
         """Owner-side fetch of an owned shm object: try every live location
         (local shm first, then raylets — including our own, which may hold
@@ -778,7 +951,7 @@ class CoreWorker:
             if self.node_id in locations:
                 res = self.store.get(oid, timeout=0.0)
                 if res is not None:
-                    self._note_pin(oid)
+                    self._note_pin(oid, pin_out)
                     return res[0]
             transient = False
             for node_hex in locations:
@@ -801,7 +974,8 @@ class CoreWorker:
             time.sleep(min(0.05 * attempt, 1.0))
 
     def _fetch_from_location_set(self, oid: ObjectID, locations: set,
-                                 deadline: Optional[float]
+                                 deadline: Optional[float],
+                                 pin_out: Optional[list] = None
                                  ) -> Optional[memoryview]:
         """Borrower-side single pass over owner-reported locations."""
         alive = self._alive_node_ids()
@@ -811,7 +985,7 @@ class CoreWorker:
             if node_hex == self.node_id:
                 res = self.store.get(oid, timeout=0.0)
                 if res is not None:
-                    self._note_pin(oid)
+                    self._note_pin(oid, pin_out)
                     return res[0]
             status, data = self._fetch_remote(node_hex, oid, deadline)
             if status == "ok":
@@ -905,7 +1079,9 @@ class CoreWorker:
         return conn
 
     def _fetch_from_owner(self, ref: ObjectRef,
-                          deadline: Optional[float]) -> Optional[memoryview]:
+                          deadline: Optional[float],
+                          pin_out: Optional[list] = None
+                          ) -> Optional[memoryview]:
         while True:
             t = self._remaining(deadline)
             try:
@@ -922,7 +1098,7 @@ class CoreWorker:
                     return memoryview(res["data"])
                 # location answer
                 data = self._fetch_from_location_set(
-                    ref.id, set(res["locations"]), deadline)
+                    ref.id, set(res["locations"]), deadline, pin_out)
                 if data is not None:
                     return data
             if deadline is not None and time.monotonic() >= deadline:
@@ -1146,6 +1322,12 @@ class CoreWorker:
             # ObjectRef-carrying specs never share a push_tasks frame —
             # see _drain_batch_locked
             spec["_refs"] = True
+        if num_returns == "streaming":
+            # the owner's config governs the stream it consumes; the
+            # worker honors the stamped window, so no env propagation of
+            # the flag is needed
+            spec["backpressure"] = CONFIG.generator_backpressure_num_objects
+            self._register_stream(task_id.binary(), spec["backpressure"])
         trace_ctx = _current_trace_context()
         if trace_ctx:
             # auto span injection (reference _inject_tracing_into_function,
@@ -1270,6 +1452,8 @@ class CoreWorker:
                     if entry.refcount <= 0:
                         self._free_entry_locked(oid, entry, freed)
         self._complete_frees(freed)
+        if spec.get("num_returns") == "streaming":
+            self._stream_finished(spec["task_id"], failed=True)
 
     # ----- per-key scheduling queue: leased workers pull pending specs -----
     def _sched_state(self, key: str, resources,
@@ -1795,6 +1979,22 @@ class CoreWorker:
                     entry.data = ser.to_flat_bytes(head, views)
                     entry.error = 0
                     self._memory_cache.pop(oid, None)
+                elif "streaming" in result:
+                    # completion sentinel of a num_returns="streaming"
+                    # task: items 1..N were adopted eagerly as their
+                    # reports arrived; slot 0 resolves to the full
+                    # ObjectRefGenerator (the ``completed()`` value) and
+                    # anchors the items' cleanup as dynamic children
+                    n = result["streaming"]["num_items"]
+                    children = [ObjectID.for_task_return(task_id, j + 1)
+                                for j in range(n)]
+                    entry.dynamic_children = list(children)
+                    refs = [ObjectRef(c, self.address, None)
+                            for c in children]
+                    head, views = ser.serialize(ObjectRefGenerator(refs))
+                    entry.data = ser.to_flat_bytes(head, views)
+                    entry.error = 0
+                    self._memory_cache.pop(oid, None)
                 else:
                     entry.error = result.get("error", 0)
                     if result.get("data") is not None:
@@ -1814,6 +2014,11 @@ class CoreWorker:
             self._evict_lineage_locked()
         self._complete_frees(freed)
         failed = any(r.get("error") for r in results)
+        if spec.get("num_returns") == "streaming":
+            total = next((r["streaming"]["num_items"] for r in results
+                          if "streaming" in r), None)
+            self._stream_finished(spec["task_id"], failed=failed,
+                                  total=total)
         self.events.record(task_id.hex(), "FAILED" if failed else "FINISHED",
                            name=spec["name"])
 
@@ -1844,6 +2049,194 @@ class CoreWorker:
             # ever deserializes the generator
             refs.append(ObjectRef(sub_oid, self.address, None))
         return refs
+
+    # ------------------------------------------- streaming generators
+    # Owner side of num_returns="streaming" (docs/streaming_generators.md):
+    # the executing worker reports every yield as a report_generator_item
+    # RPC on this worker's server; each item is adopted into the owned
+    # table the moment it arrives, the consumer's next() advances a
+    # strict index cursor, and backpressure is the withheld report reply
+    # (a parked Deferred resolves when ITS item is consumed, so the
+    # producer's unacked window equals the unconsumed in-flight count).
+
+    def _register_stream(self, task_binary: bytes, bp: int) -> _StreamState:
+        state = _StreamState(task_binary, bp)
+        with self._streams_lock:
+            self._streams[task_binary] = state
+        return state
+
+    def make_streaming_generator(self, ref: "ObjectRef"
+                                 ) -> StreamingObjectRefGenerator:
+        """Wrap a streaming task's slot-0 ref (its stream was registered
+        at submit time) into the consumer-facing generator."""
+        with self._streams_lock:
+            state = self._streams[ref.id.task_id().binary()]
+        return StreamingObjectRefGenerator(self, state, ref)
+
+    def _rpc_report_generator_item(self, p: dict):
+        """One yielded item from the executing worker: adopt ownership
+        eagerly (data inline or a shm location, exactly like a dynamic
+        child) and answer with consumption credit — immediately when the
+        backpressure window allows, else a Deferred parked until the
+        consumer reaches this item.  Replayed items (a retried worker
+        re-yielding an already-consumed prefix) ack immediately."""
+        tb = p["task_id"]
+        idx = p["index"]
+        with self._streams_lock:
+            state = self._streams.get(tb)
+        if state is None:
+            return {"cancel": True}   # consumer dropped the generator
+        with state.cv:
+            if state.closed:
+                # checked BEFORE adoption so a post-close report doesn't
+                # recreate entries _close_stream just freed (a racing
+                # close still gets them swept at task completion via
+                # slot 0's dynamic_children)
+                return {"cancel": True}
+        task_id = TaskID(tb)
+        oid = ObjectID.for_task_return(task_id, idx + 1)
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+            if entry is None:
+                entry = _OwnedObject()
+                slot0 = self._owned.get(
+                    ObjectID.for_task_return(task_id, 0))
+                if slot0 is not None:
+                    # re-running the task regenerates every item
+                    entry.task_spec = slot0.task_spec
+                self._owned[oid] = entry
+                lmeta = self._lineage_meta.get(tb)
+                if lmeta is not None:
+                    lmeta["slots"].add(oid)
+            if entry.state != "ready":
+                entry.error = p.get("error", 0)
+                if p.get("data") is not None:
+                    entry.data = p["data"]
+                else:
+                    entry.locations.add(p["location"])
+                entry.state = "ready"
+                entry.event.set()
+            elif p.get("location"):
+                # replay from a retried worker: the fresh copy's node may
+                # differ from the (possibly dead) original — record it so
+                # the consumer's fetch finds the live copy instead of
+                # burning another reconstruction
+                entry.locations.add(p["location"])
+        with state.cv:
+            if state.closed:
+                return {"cancel": True}
+            if idx >= state.consumed:
+                state.arrived.add(idx)
+            state.max_unconsumed = max(state.max_unconsumed,
+                                       len(state.arrived))
+            state.cv.notify_all()
+            if state.bp > 0 and idx >= state.consumed:
+                d = rpc.Deferred()
+                state.parked.append((idx, d))
+                return d
+            return {"consumed": state.consumed}
+
+    def _stream_next(self, state: _StreamState, ref: "ObjectRef",
+                     timeout: Optional[float] = None):
+        """Blocking next(): the ObjectRef of the next item in index
+        order, _StreamExhausted at end of stream, or the task's error
+        (raised) once the stream failed and every delivered item has
+        been consumed.  Consuming resolves parked producer reports."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        resolve: List = []
+        failed = False
+        claimed = -1
+        with state.cv:
+            while True:
+                idx = state.consumed
+                if idx in state.arrived:
+                    state.arrived.discard(idx)
+                    # claim THIS index under the lock: a concurrent
+                    # consumer may advance state.consumed again before
+                    # we build the ref below
+                    claimed = idx
+                    state.consumed = idx + 1
+                    resolve = [d for i, d in state.parked
+                               if i < state.consumed]
+                    state.parked = [(i, d) for i, d in state.parked
+                                    if i >= state.consumed]
+                    break
+                if state.total is not None and idx >= state.total:
+                    return _StreamExhausted
+                if state.failed:
+                    failed = True
+                    break
+                if state.closed:
+                    raise exc.RayTpuError(
+                        "streaming generator was closed")
+                t = self._remaining(deadline)
+                if not state.cv.wait(t if t is not None else 5.0) \
+                        and deadline is not None \
+                        and time.monotonic() >= deadline:
+                    raise exc.GetTimeoutError(
+                        "timed out waiting for the next generator item")
+        for d in resolve:
+            d.resolve({"consumed": state.consumed})
+        if failed:
+            # slot 0 holds the task's error payload: get() raises it
+            self.get([ref])
+            raise exc.RayTpuError(
+                "streaming generator task failed")  # unreachable backstop
+        oid = ObjectID.for_task_return(TaskID(state.task_binary),
+                                       claimed + 1)  # item j at slot j+1
+        return ObjectRef(oid, self.address, self)
+
+    def _stream_finished(self, task_binary: bytes, *, failed: bool,
+                         total: Optional[int] = None) -> None:
+        """Terminal task outcome reached the owner: wake the consumer.
+        A retryable worker death never lands here — the stream stays
+        open and the re-executed task replays its items."""
+        with self._streams_lock:
+            state = self._streams.get(task_binary)
+        if state is None:
+            return
+        resolve: List = []
+        with state.cv:
+            if failed:
+                state.failed = True
+            else:
+                state.total = total
+                # late credit: items past the consumer's cursor can no
+                # longer arrive, so nothing is parked for a reason
+                resolve = [d for _i, d in state.parked]
+                state.parked = []
+            state.cv.notify_all()
+        for d in resolve:
+            d.resolve({"consumed": state.consumed})
+
+    def _close_stream(self, state: _StreamState) -> None:
+        """Consumer dropped the generator: cancel parked reports (the
+        worker stops iterating), drop the table entry, and free
+        arrived-but-unconsumed item objects."""
+        with state.cv:
+            if state.closed:
+                return
+            state.closed = True
+            parked, state.parked = state.parked, []
+            orphans = list(state.arrived)
+            state.arrived.clear()
+            state.cv.notify_all()
+        for _i, d in parked:
+            d.resolve({"cancel": True})
+        with self._streams_lock:
+            self._streams.pop(state.task_binary, None)
+        if self._shutdown.is_set():
+            return
+        task_id = TaskID(state.task_binary)
+        freed: List[Tuple[ObjectID, set]] = []
+        with self._owned_lock:
+            for idx in orphans:
+                oid = ObjectID.for_task_return(task_id, idx + 1)
+                entry = self._owned.get(oid)
+                if entry is not None and entry.refcount <= 0 \
+                        and entry.state == "ready":
+                    self._free_entry_locked(oid, entry, freed)
+        self._complete_frees(freed)
 
     def prepare_runtime_env(self, raw: Optional[dict]) -> Optional[dict]:
         """Package+upload a raw runtime_env; memoised on the spec plus a
@@ -1947,10 +2340,7 @@ class CoreWorker:
                           max_task_retries: int = 0,
                           concurrency_group: Optional[str] = None
                           ) -> List[ObjectRef]:
-        if num_returns == "dynamic":
-            raise ValueError(
-                'num_returns="dynamic" is only supported for tasks, '
-                'not actor methods')
+        num_returns = normalize_num_returns(num_returns)
         task_id = TaskID.from_random()
         aid = actor_id.hex()
         spec = {
@@ -1964,12 +2354,15 @@ class CoreWorker:
         }
         if concurrency_group:
             spec["group"] = concurrency_group
+        if num_returns == "streaming":
+            spec["backpressure"] = CONFIG.generator_backpressure_num_objects
+            self._register_stream(task_id.binary(), spec["backpressure"])
         trace_ctx = _current_trace_context()
         if trace_ctx:
             spec["trace_ctx"] = trace_ctx
         refs = []
         with self._owned_lock:
-            for i in range(num_returns):
+            for i in range(num_return_slots(num_returns)):
                 oid = ObjectID.for_task_return(task_id, i)
                 self._owned[oid] = _OwnedObject()
                 refs.append(ObjectRef(oid, self.address, self))
@@ -1993,7 +2386,7 @@ class CoreWorker:
         data = ser.to_flat_bytes(head, views)
         freed: List[Tuple[ObjectID, set]] = []
         with self._owned_lock:
-            for i in range(spec["num_returns"]):
+            for i in range(num_return_slots(spec["num_returns"])):
                 oid = ObjectID.for_task_return(task_id, i)
                 entry = self._owned.get(oid)
                 if entry is not None:
@@ -2004,6 +2397,8 @@ class CoreWorker:
                     if entry.refcount <= 0:
                         self._free_entry_locked(oid, entry, freed)
         self._complete_frees(freed)
+        if spec.get("num_returns") == "streaming":
+            self._stream_finished(spec["task_id"], failed=True)
 
     def kill_actor(self, actor_id: ActorID) -> None:
         self.gcs.call("kill_actor", {"actor_id": actor_id.hex()})
@@ -2027,6 +2422,8 @@ class CoreWorker:
     def _handle_rpc(self, conn: rpc.Connection, method: str, p: Any) -> Any:
         if method == "get_object":
             return self._rpc_get_object(p or {})
+        if method == "report_generator_item":
+            return self._rpc_report_generator_item(p or {})
         if method == "core_worker_stats":
             return self._rpc_core_worker_stats(p or {})
         if method == "profile":

@@ -289,12 +289,25 @@ class SharedMemoryStore:
 
     def get_deserialized(self, object_id: ObjectID,
                          timeout: Optional[float] = 0.0):
-        """Returns (found, value). Zero-copy for numpy payloads; the object
-        stays pinned while views reference it (release on GC is the caller's
-        concern — we keep it pinned for safety)."""
+        """Returns (found, value). Zero-copy for numpy payloads: the
+        object stays pinned while the value may hold views into the
+        segment (release on GC is the caller's concern).  Payloads with
+        NO out-of-band buffers (plain pickled python objects) are fully
+        copied out by deserialization, so their pin is released here —
+        a long stream of consumed generator items must not keep every
+        item pinned in shm."""
         res = self.get(object_id, timeout)
         if res is None:
             return False, None
         buf, _meta = res
         from ray_tpu._private import serialization as ser
-        return True, ser.deserialize(buf)
+        try:
+            value, holds_views = ser.deserialize_with_viewinfo(buf)
+        except BaseException:
+            buf.release()
+            self.release(object_id)
+            raise
+        if not holds_views:
+            buf.release()
+            self.release(object_id)
+        return True, value

@@ -17,6 +17,7 @@ import argparse
 import os
 import threading
 import traceback
+from collections import deque
 from typing import Any, Dict, Optional
 
 import cloudpickle
@@ -30,6 +31,101 @@ from ray_tpu._private.logging_utils import get_logger, setup_component_logging
 from ray_tpu.runtime import core_worker as cw
 
 logger = get_logger("worker")
+
+
+class _StreamCancelled(Exception):
+    """The owner cancelled the stream (consumer dropped the generator,
+    or the owner process is gone): stop producing, finish cleanly."""
+
+
+class _StreamSession:
+    """Producer side of one num_returns="streaming" execution.
+
+    Each yielded item is serialized (inline bytes under the inline-
+    return threshold, else a shm primary copy + location) and pushed to
+    the owner as a ``report_generator_item`` call on the pooled owner
+    connection.  Backpressure: the owner withholds a report's reply
+    until that item is consumed, and the session caps unacked reports
+    at the spec's ``backpressure`` window — so at most that many
+    unconsumed items are ever in flight, and the producing generator
+    pauses (blocks in send()) until the consumer catches up."""
+
+    def __init__(self, core, spec, inline_max: int):
+        self.core = core
+        self.spec = spec
+        self.task_id = TaskID(spec["task_id"])
+        self.bp = int(spec.get("backpressure") or -1)
+        self.conn = core._owner_conn(tuple(spec["owner_addr"]))
+        self.inline_max = inline_max
+        self.outstanding: "deque" = deque()
+        self.index = 0
+
+    def send(self, value) -> None:
+        self._wait_for_credit()
+        head, views = ser.serialize(value)
+        payload = {"task_id": self.spec["task_id"], "index": self.index}
+        if ser.serialized_size(head, views) <= self.inline_max:
+            payload["data"] = ser.to_flat_bytes(head, views)
+        else:
+            oid = ObjectID.for_task_return(self.task_id, self.index + 1)
+            self.core.store_put(oid, head, views)
+            payload["location"] = self.core.node_id
+        try:
+            fut = self.conn.call_async("report_generator_item", payload)
+        except (ConnectionError, OSError):
+            raise _StreamCancelled from None
+        self.outstanding.append(fut)
+        self.index += 1
+
+    def _wait_for_credit(self) -> None:
+        if self.bp > 0:
+            # unacked window == unconsumed in-flight items: block here
+            # until the consumer acks (pausing the user generator)
+            while len(self.outstanding) >= self.bp:
+                self._consume_reply(self.outstanding.popleft())
+        else:
+            # unbounded stream: just reap replies that already landed so
+            # a long stream doesn't accumulate futures
+            while self.outstanding and self.outstanding[0].done():
+                self._consume_reply(self.outstanding.popleft())
+
+    def _consume_reply(self, fut) -> None:
+        try:
+            reply = fut.result(None)
+        except (ConnectionError, OSError, rpc.RpcError):
+            # owner unreachable: nobody is listening to this stream
+            raise _StreamCancelled from None
+        if reply and reply.get("cancel"):
+            raise _StreamCancelled
+
+    def finish(self, cancelled: bool = False) -> dict:
+        """Drain every outstanding report (so the owner has adopted all
+        items before the completion sentinel lands), then build the task
+        reply."""
+        if cancelled:
+            self.drain_quiet()
+        else:
+            try:
+                while self.outstanding:
+                    self._consume_reply(self.outstanding.popleft())
+            except _StreamCancelled:
+                cancelled = True
+                self.drain_quiet()
+        out = {"num_items": self.index}
+        if cancelled:
+            out["cancelled"] = True
+        return {"results": [{"streaming": out}]}
+
+    def drain_quiet(self) -> None:
+        """Best-effort wait for in-flight reports (error/cancel paths):
+        already-produced items should reach the owner before the task's
+        terminal reply does, but nothing here may raise."""
+        while self.outstanding:
+            fut = self.outstanding.popleft()
+            try:
+                fut.result(30.0)
+            except Exception:
+                break
 
 
 class WorkerProcess:
@@ -105,6 +201,10 @@ class WorkerProcess:
             # they take the pooled path so a slow dependency fetch can't
             # stall the connection's reader.
             if method == "actor_task":
+                return True
+            if method == "report_generator_item":
+                # nested streaming: this worker owns a streaming task it
+                # submitted; item adoption only buffers + notifies
                 return True
             if method == "push_tasks":
                 try:
@@ -328,6 +428,8 @@ class WorkerProcess:
         n = spec["num_returns"]
         if n == "dynamic":
             return self._package_dynamic(spec, result)
+        if n == "streaming":
+            return self._package_streaming(spec, result)
         if n == 0:
             values = []
         elif n == 1:
@@ -377,6 +479,52 @@ class WorkerProcess:
                 subs.append({"location": self.core.node_id})
         return {"results": [{"dynamic": subs}]}
 
+    def _package_streaming(self, spec, result) -> dict:
+        """num_returns="streaming": drive the user generator yield by
+        yield, delivering each item to the owner as it is produced (see
+        _StreamSession) instead of materializing the whole stream.  The
+        task reply is just the completion sentinel."""
+        try:
+            iterator = iter(result)
+        except TypeError:
+            return self._package_error(spec, TypeError(
+                'num_returns="streaming" requires the task to return an '
+                f"iterable or generator, got {type(result).__name__}"))
+        sess = _StreamSession(self.core, spec, self._inline_ret_max)
+        try:
+            for value in iterator:
+                sess.send(value)
+            return sess.finish()
+        except _StreamCancelled:
+            return sess.finish(cancelled=True)
+        except Exception as e:  # noqa: BLE001 - user errors cross the wire
+            # deliver already-reported items before the failure lands:
+            # the consumer drains the arrived prefix, THEN raises
+            sess.drain_quiet()
+            return self._package_error(spec, e)
+
+    async def _package_streaming_async(self, spec, agen) -> dict:
+        """Async-generator variant (async actors): iteration interleaves
+        on the event loop; each report (blocking RPC + possible
+        backpressure wait) runs in the default executor so a paused
+        stream never stalls the actor's loop."""
+        import asyncio
+        import functools
+        loop = asyncio.get_running_loop()
+        sess = _StreamSession(self.core, spec, self._inline_ret_max)
+        try:
+            async for value in agen:
+                await loop.run_in_executor(None, sess.send, value)
+            # finish() blocks on the tail reports' (possibly parked)
+            # replies — keep that off the loop too
+            return await loop.run_in_executor(None, sess.finish)
+        except _StreamCancelled:
+            return await loop.run_in_executor(
+                None, functools.partial(sess.finish, cancelled=True))
+        except Exception as e:  # noqa: BLE001
+            await loop.run_in_executor(None, sess.drain_quiet)
+            return self._package_error(spec, e)
+
     # --------------------------------------------------------------- actors
     def _create_actor(self, p) -> dict:
         import inspect
@@ -391,6 +539,7 @@ class WorkerProcess:
                                or {}).items()}
         self._actor_is_async = any(
             inspect.iscoroutinefunction(m)
+            or inspect.isasyncgenfunction(m)
             for _n, m in inspect.getmembers(cls, callable))
         max_concurrency = creation.get("max_concurrency")
         if max_concurrency is None:
@@ -565,6 +714,11 @@ class WorkerProcess:
             result = method(*args, **kwargs)
             if inspect.isawaitable(result):
                 result = await result
+            if spec["num_returns"] == "streaming" \
+                    and inspect.isasyncgen(result):
+                # async-generator streaming: iterate on the loop, report
+                # off it (see _package_streaming_async)
+                return await self._package_streaming_async(spec, result)
             return await loop.run_in_executor(
                 None, functools.partial(self._package_results, spec,
                                         result))

@@ -35,6 +35,9 @@ class _SubHandle:
     def remote(self, *args, **kwargs):
         return self._handle._route(self._method, args, kwargs)
 
+    def remote_streaming(self, *args, **kwargs):
+        return self._handle._route_streaming(self._method, args, kwargs)
+
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str):
@@ -133,6 +136,26 @@ class DeploymentHandle:
             self._lock.notify_all()
 
     def _route(self, method: str, args: tuple, kwargs: dict):
+        return self._route_impl(
+            lambda actor: actor.handle_request.remote(method, args, kwargs))
+
+    def _route_streaming(self, method: str, args: tuple, kwargs: dict):
+        """Streaming variant: submits the replica's
+        handle_request_streaming with num_returns="streaming" and
+        returns the live StreamingObjectRefGenerator.  The in-flight
+        count drops when the whole stream completes (its completion
+        sentinel resolves), so a long generation holds its concurrency
+        slot for its true duration."""
+        return self._route_impl(
+            lambda actor: actor.handle_request_streaming.options(
+                num_returns="streaming").remote(method, args, kwargs))
+
+    def _route_impl(self, submit):
+        """One routing loop for both request shapes: pick a replica
+        (power-of-two choices under max_concurrent_queries), call
+        ``submit(actor)``, and anchor the in-flight release on the
+        result's completion — the reply ref itself, or a streaming
+        generator's completion sentinel."""
         if self._replicas:
             self._maybe_refresh_bg()
         else:
@@ -155,7 +178,7 @@ class DeploymentHandle:
                 continue
             try:
                 actor = self._actor_for(replica)
-                ref = actor.handle_request.remote(method, args, kwargs)
+                out = submit(actor)
             except Exception:
                 # replica vanished (scale-down/crash): drop it locally,
                 # force-refresh the table, and retry until the deadline
@@ -170,16 +193,22 @@ class DeploymentHandle:
                 self._refresh(force=True)
                 time.sleep(0.05)
                 continue
-            # in-flight count drops the instant the reply lands — no
-            # polling drainer between a reply and the next admission
-            from ray_tpu.runtime.core_worker import get_global_worker
-            get_global_worker().add_ready_callback(
-                ref, lambda r=replica: self._release(r))
-            return ref
+            # in-flight count drops the instant the completion lands —
+            # no polling drainer between a reply and the next admission
+            anchor = out.completed() if hasattr(out, "completed") else out
+            self._worker().add_ready_callback(
+                anchor, lambda r=replica: self._release(r))
+            return out
 
     # ------------------------------------------------------------ user API
     def remote(self, *args, **kwargs):
         return self._route("__call__", args, kwargs)
+
+    def remote_streaming(self, *args, **kwargs):
+        """Route one request through the replica's streaming path:
+        returns a StreamingObjectRefGenerator whose items arrive as the
+        deployment's generator produces them (token streaming)."""
+        return self._route_streaming("__call__", args, kwargs)
 
     def try_remote(self, *args, **kwargs):
         """One-shot non-blocking route: submit to a replica with spare

@@ -100,6 +100,32 @@ class LLMServer:
             "latency_s": result.latency_s,
         }
 
+    async def stream(self, request: Dict[str, Any]):
+        """Token-streaming entrypoint: an async generator yielding one
+        ``{"token": id}`` dict per generated token as it is decoded,
+        then a final summary dict.  Reached via
+        ``handle.stream.remote_streaming(request)`` — the Serve handle
+        submits the replica's streaming path with
+        ``num_returns="streaming"``, so the caller's first item lands
+        before decode finishes (time-to-first-token, not
+        time-to-last)."""
+        from ray_tpu.serve.llm_engine import GenerationResult
+        async for item in self.engine.stream(
+                request["prompt"],
+                max_new_tokens=int(request.get("max_new_tokens", 32)),
+                temperature=float(request.get("temperature", 0.0)),
+                eos_id=request.get("eos_id")):
+            if isinstance(item, GenerationResult):
+                yield {
+                    "finish_reason": item.finish_reason,
+                    "num_tokens": len(item.tokens),
+                    "prompt_len": item.prompt_len,
+                    "time_to_first_token_s": item.time_to_first_token_s,
+                    "latency_s": item.latency_s,
+                }
+            else:
+                yield {"token": int(item)}
+
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats.snapshot(self.engine.num_slots)
 

@@ -510,6 +510,47 @@ class LLMEngine:
             raise out["err"]
         return out["ok"]
 
+    async def stream(self, prompt: List[int], *, max_new_tokens: int = 32,
+                     temperature: float = 0.0,
+                     eos_id: Optional[int] = None):
+        """Async-generator submit: yields each generated token id the
+        scheduling quantum it is decoded, then the final
+        GenerationResult as the last item.  This is the engine end of
+        the Serve token-streaming path (serve/llm.py LLMServer.stream →
+        replica handle_request_streaming → the caller's
+        StreamingObjectRefGenerator): the consumer holds the first
+        token while the block decode is still running.
+
+        on_token callbacks fire on the engine thread and are bridged
+        onto the calling event loop; the engine's completion delivery
+        is loop-ordered after every bridged token, so the final result
+        always follows the tokens it summarizes."""
+        import asyncio
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_token(tok: int) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, ("token", int(tok)))
+
+        fut = self.submit(prompt, max_new_tokens=max_new_tokens,
+                          temperature=temperature, eos_id=eos_id,
+                          on_token=on_token)
+        fut.add_done_callback(lambda f: q.put_nowait(("done", f)))
+        seen = 0
+        while True:
+            kind, val = await q.get()
+            if kind == "token":
+                seen += 1
+                yield val
+                continue
+            result = val.result()   # raises engine-fatal errors
+            # backstop: any token whose bridge callback lost the race
+            # with completion still reaches the consumer, in order
+            for tok in result.tokens[seen:]:
+                yield int(tok)
+            yield result
+            return
+
     def close(self):
         with self._lock:
             self._closed = True

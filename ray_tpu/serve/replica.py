@@ -76,6 +76,46 @@ class ReplicaActor:
             self._num_ongoing -= 1
             self._num_processed += 1
 
+    async def handle_request_streaming(self, method_name: str, args: tuple,
+                                       kwargs: dict):
+        """Streaming request path: called with num_returns="streaming"
+        (DeploymentHandle.remote_streaming), so every item this
+        async generator yields is delivered to the caller as its own
+        ObjectRef the moment it is produced — a Serve LLM request
+        streams its first token while decode is still running.  The
+        user target must return an (async) generator / iterable."""
+        self._num_ongoing += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            elif method_name in ("__call__", "", None):
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            result = target(*args, **kwargs)
+            if inspect.isawaitable(result):
+                result = await result
+            if hasattr(result, "__aiter__"):
+                async for item in result:
+                    yield item
+            else:
+                # sync generator: pull each (possibly blocking) step on
+                # the sync pool, matching handle_request's executor
+                # offload — a blocking per-item producer must not stall
+                # the replica's event loop for the whole stream
+                loop = asyncio.get_running_loop()
+                it = iter(result)
+                sentinel = object()
+                while True:
+                    item = await loop.run_in_executor(
+                        self._sync_pool, next, it, sentinel)
+                    if item is sentinel:
+                        break
+                    yield item
+        finally:
+            self._num_ongoing -= 1
+            self._num_processed += 1
+
     # ------------------------------------------------------------- control
     def reconfigure(self, user_config: Any) -> None:
         if not self._is_function and hasattr(self._callable, "reconfigure"):
