@@ -61,6 +61,13 @@ SECTIONS = {
                            os.path.join(REPO, "benchmarks",
                                         "streaming_perf.py")],
                       timeout=600),
+    # always-on runtime telemetry cost guard (docs/observability.md):
+    # interleaved same-box A/B of task throughput with
+    # RAY_TPU_TELEMETRY=0 vs 1; the overhead_pct row is the <=3% bar
+    "telemetry": dict(cmd=[sys.executable,
+                           os.path.join(REPO, "benchmarks",
+                                        "telemetry_overhead.py")],
+                      timeout=900),
     "serve_llm": dict(cmd=[sys.executable,
                            os.path.join(REPO, "benchmarks", "serve_llm.py"),
                            "--suite", "--slots", "32", "--requests", "128"],

@@ -217,6 +217,18 @@ _declare("task_events_flush_interval_ms", int, 500,
          "Period at which workers flush task events to the GCS task table.")
 _declare("gcs_max_task_events", int, 100000,
          "Max per-task records the GCS task table keeps before GC.")
+_declare("telemetry_enabled", bool, True,
+         "Always-on runtime telemetry (_private/runtime_metrics.py): "
+         "hot-path counters/histograms in every daemon and worker plus "
+         "the per-process flusher publishing them to the GCS KV "
+         "metrics/ namespace.  Also overridable as RAY_TPU_TELEMETRY=0 "
+         "(the bench kill-switch); disabling swaps instruments for "
+         "no-op stubs at binding time, so it must be off before the "
+         "process imports the instrumented modules.")
+_declare("telemetry_flush_interval_ms", int, 2000,
+         "Period of the runtime-metrics flusher pushing per-process "
+         "snapshots to the GCS KV (dashboard /metrics, list_metrics); "
+         "only metrics that changed since the last flush are re-sent.")
 
 # --------------------------------------------------------------------------- #
 # TPU / device model                                                          #

@@ -52,8 +52,35 @@ from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.logging_utils import get_logger
+from ray_tpu._private import runtime_metrics as rtm
 
 logger = get_logger("rpc")
+
+# hot-path instruments, bound once at import (docs/observability.md):
+# record calls are attribute arithmetic, no-ops when telemetry is off.
+# _TELEMETRY guards the few sites whose *argument* computation isn't
+# free (byte sums), so the kill switch removes that cost too.
+_TELEMETRY = rtm.enabled()
+_M_DISPATCH = rtm.histogram_family(
+    "ray_tpu_rpc_dispatch_ms", "per-method RPC handler latency (ms)")
+_M_INLINE = rtm.counter(
+    "ray_tpu_rpc_dispatch_inline_total",
+    "requests run inline on the reader thread (fast-method registry)")
+_M_POOLED = rtm.counter(
+    "ray_tpu_rpc_dispatch_pooled_total",
+    "requests dispatched through the shared thread pool")
+_M_FRAMES_OUT = rtm.counter(
+    "ray_tpu_rpc_frames_sent_total", "frames written to the wire")
+_M_SEND_BATCHES = rtm.counter(
+    "ray_tpu_rpc_send_batches_total",
+    "sendmsg flush batches (frames/batches = write coalescing factor)")
+_M_BYTES_OUT = rtm.counter(
+    "ray_tpu_rpc_bytes_sent_total", "payload bytes written to the wire")
+_M_BYTES_IN = rtm.counter(
+    "ray_tpu_rpc_bytes_received_total", "payload bytes read off the wire")
+_M_WQ_DEPTH = rtm.gauge(
+    "ray_tpu_rpc_write_queue_depth",
+    "high-water write-queue depth since the last flush", watermark=True)
 
 # cached (generation, value) of CONFIG.rpc_fuzz_ms: the old per-dispatch
 # `from ...config import CONFIG` + flag resolution (lock + env lookup +
@@ -324,6 +351,7 @@ class Connection:
             if self._closed.is_set():
                 raise ConnectionError("connection closed")
             self._wq.append(iov)
+            _M_WQ_DEPTH.set_max(len(self._wq))
             if self._flushing:
                 # the active flusher will send this frame after we return;
                 # materialize zero-copy views — the caller may mutate the
@@ -345,9 +373,15 @@ class Connection:
                         raise ConnectionError("connection closed")
                     return
                 batch: list = []
+                nframes = 0
                 while self._wq and len(batch) < _IOV_BATCH:
                     batch.extend(self._wq.popleft())
+                    nframes += 1
                 self._wq_cv.notify_all()
+            if _TELEMETRY:
+                _M_FRAMES_OUT.inc(nframes)
+                _M_SEND_BATCHES.inc()
+                _M_BYTES_OUT.inc(sum(len(b) for b in batch))
             try:
                 _sendmsg_all(self._sock, batch)
             except BaseException:
@@ -456,6 +490,10 @@ class Connection:
                         bufs.append(b)
                 kind, msg_id, a, b = pickle.loads(view[:body_len],
                                                   buffers=bufs)
+                if _TELEMETRY:
+                    _M_BYTES_IN.inc(_HDR.size + body_len +
+                                    ((_BLEN.size * nbufs + sum(lens))
+                                     if nbufs else 0))
                 if kind == _REQUEST:
                     fm = self._fast_methods
                     if (fm is not None and _fuzz_ms_now() == 0
@@ -463,8 +501,10 @@ class Connection:
                         # registered non-blocking handler: run inline on
                         # the reader (the reply coalesces with whatever
                         # the previous frame left in the write queue)
+                        _M_INLINE.inc()
                         self._handle_request(msg_id, a, b)
                     else:
+                        _M_POOLED.inc()
                         _dispatch_pool().submit(
                             self._handle_request, msg_id, a, b)
                 elif kind == _RESPONSE:
@@ -530,18 +570,22 @@ class Connection:
             time.sleep(0.005)
 
     def _handle_request(self, msg_id: int, method: str, payload: Any) -> None:
+        t0 = rtm.now()
         try:
             if self._handler is None:
                 raise RpcError(f"no handler for {method}")
             _maybe_fuzz()
             result = self._handler(self, method, payload)
             if isinstance(result, Deferred):
-                # the reply is sent by whichever thread resolves it
+                # the reply is sent by whichever thread resolves it;
+                # latency here covers the synchronous handler part only
                 result._bind(self, msg_id)
+                _M_DISPATCH.observe_since(method, t0)
                 return
             ok, value = True, result
         except BaseException as e:  # noqa: BLE001 - errors cross the wire
             ok, value = False, e
+        _M_DISPATCH.observe_since(method, t0)
         self._respond(msg_id, ok, value)
 
     def _respond(self, msg_id: int, ok: bool, value: Any) -> None:

@@ -22,9 +22,18 @@ PENDING_NODE_ASSIGNMENT = "PENDING_NODE_ASSIGNMENT"
 RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
+# instant markers (timeline dots, not lifecycle transitions): they never
+# update a record's state — a streaming task stays RUNNING while its
+# per-yield STREAM_ITEM instants accumulate
+STREAM_ITEM = "STREAM_ITEM"
+_INSTANT_STATES = frozenset({STREAM_ITEM})
 
 _STATE_RANK = {SUBMITTED: 1, PENDING_NODE_ASSIGNMENT: 2, RUNNING: 3,
                FINISHED: 4, FAILED: 4}
+
+# per-record event-list bound: long streams / chatty spans must not grow
+# one task's record without limit (the first and last halves survive)
+_EVENTS_PER_TASK_CAP = 512
 
 
 class TaskEventBuffer:
@@ -47,6 +56,8 @@ class TaskEventBuffer:
 
     def record(self, task_id: str, state: str, *, name: str = "",
                **extra: Any) -> None:
+        if self._stop.is_set():
+            return  # stopped: a late event must not restart the flusher
         ev = {"task_id": task_id, "state": state, "name": name,
               "ts": time.time()}
         ev.update(self._defaults)
@@ -85,7 +96,14 @@ class TaskEventBuffer:
             self.flush()
 
     def stop(self) -> None:
+        """Idempotent shutdown: stop the flusher, push the tail batch,
+        and JOIN the flush thread — without the join, a final in-flight
+        ``flush()`` races this one for the same batch and can re-queue
+        events into a buffer nobody will ever drain again."""
         self._stop.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=2.0)
         self.flush()
 
 
@@ -114,7 +132,7 @@ class GcsTaskTable:
                     self._tasks[tid] = rec
                     self._order.append(tid)
                 for field in ("name", "job_id", "actor_id", "func_or_class",
-                              "error_type"):
+                              "error_type", "trace_id"):
                     if ev.get(field):
                         rec[field] = ev[field]
                 # execution attribution: node/worker come from the executing
@@ -126,19 +144,36 @@ class GcsTaskTable:
                             rec[field] = ev[field]
                 # out-of-order delivery: a worker's RUNNING may arrive after
                 # the owner's FINISHED (independent flush clocks) — never let
-                # a non-terminal state overwrite a terminal one
-                rank = _STATE_RANK.get(ev["state"], 0)
-                if rank >= _STATE_RANK.get(rec["state"], -1):
-                    rec["state"] = ev["state"]
-                rec["events"].append({"state": ev["state"], "ts": ev["ts"]})
+                # a non-terminal state overwrite a terminal one.  Instant
+                # markers (STREAM_ITEM) never touch the state at all.
+                if ev["state"] not in _INSTANT_STATES:
+                    rank = _STATE_RANK.get(ev["state"], 0)
+                    if rank >= _STATE_RANK.get(rec["state"], -1):
+                        rec["state"] = ev["state"]
+                entry = {"state": ev["state"], "ts": ev["ts"]}
+                if "index" in ev:   # per-yield stream instants
+                    entry["index"] = ev["index"]
+                rec["events"].append(entry)
                 rec["events"].sort(key=lambda e: e["ts"])
+                if len(rec["events"]) > _EVENTS_PER_TASK_CAP:
+                    half = _EVENTS_PER_TASK_CAP // 2
+                    rec["events"] = (rec["events"][:half] +
+                                     rec["events"][-half:])
+                    rec["events_truncated"] = True
                 if ev["state"] == SUBMITTED:
                     rec["creation_time"] = ev["ts"]
                 elif ev["state"] == RUNNING:
                     rec["start_time"] = ev["ts"]
                 elif ev["state"] in (FINISHED, FAILED):
                     rec["end_time"] = ev["ts"]
+            # Eviction scans PAST live entries (bounded) instead of
+            # stopping at the first one: a long-running task at the head
+            # of first-seen order used to re-append itself and break,
+            # blocking eviction of every terminal task queued behind it —
+            # the table then grew far beyond gcs_max_task_events.
             cap = CONFIG.gcs_max_task_events
+            spared = 0
+            max_spared = min(len(self._order), 256)
             while len(self._tasks) > cap and self._order:
                 victim = self._order.popleft()
                 rec = self._tasks.get(victim)
@@ -149,8 +184,10 @@ class GcsTaskTable:
                     del self._tasks[victim]
                     dropped += 1
                 else:
-                    self._order.append(victim)  # still live; spare it
-                    break
+                    self._order.append(victim)  # still live; rotate past
+                    spared += 1
+                    if spared >= max_spared:
+                        break  # everything scanned is live: give up for now
         return dropped
 
     def list(self, *, job_id: Optional[str] = None,

@@ -279,32 +279,29 @@ class DashboardHead:
 
     # -------------------------------------------------------------- metrics
     async def _metrics(self, request) -> web.Response:
-        """Prometheus text exposition of user metrics + cluster gauges
-        (reference modules/metrics + metrics_agent prometheus_exporter)."""
+        """Prometheus text exposition of user + runtime metrics and
+        cluster gauges (reference modules/metrics + metrics_agent
+        prometheus_exporter).  Both metric families live in the GCS KV
+        ``metrics/`` namespace in one wire format; histograms render as
+        conformant cumulative ``_bucket{le=...}``/``_count``/``_sum``
+        series (runtime_metrics.prometheus_exposition)."""
         def build() -> str:
-            lines: List[str] = []
-            seen_meta = set()
-            for key in self.gcs.kv_keys("metrics/"):
+            from ray_tpu._private.runtime_metrics import \
+                prometheus_exposition
+            entries = []
+            for key in sorted(self.gcs.kv_keys("metrics/")):
                 raw = self.gcs.kv_get(key)
                 if not raw:
                     continue
                 _, name, worker = key.split("/", 2)
-                data = json.loads(raw)
-                if name not in seen_meta:
-                    seen_meta.add(name)
-                    if data.get("description"):
-                        lines.append(
-                            f"# HELP {name} {data['description']}")
-                    mtype = data.get("type", "untyped")
-                    if mtype not in ("counter", "gauge", "histogram"):
-                        mtype = "untyped"
-                    lines.append(f"# TYPE {name} {mtype}")
-                for tagjson, value in data.get("values", {}).items():
-                    tags = dict(json.loads(tagjson))
-                    tags["worker"] = worker
-                    tag_str = ",".join(
-                        f'{k}="{v}"' for k, v in sorted(tags.items()))
-                    lines.append(f"{name}{{{tag_str}}} {value}")
+                try:
+                    entries.append((name, worker, json.loads(raw)))
+                except ValueError:
+                    continue
+            lines: List[str] = []
+            text = prometheus_exposition(entries)
+            if text:
+                lines.append(text)
             # built-in cluster gauges
             nodes = self.gcs.call("list_nodes")
             alive = [n for n in nodes if n.get("alive")]

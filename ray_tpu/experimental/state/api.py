@@ -161,33 +161,195 @@ def timeline(path: Optional[str] = None) -> List[dict]:
     """Chrome-trace (catapult) events from the GCS task table.
 
     Analog of `ray timeline` (/root/reference/python/ray/_private/
-    state.py:829): each task's RUNNING->FINISHED span becomes a complete
-    ("X") event on its worker's row; load the output in chrome://tracing
-    or Perfetto.
+    state.py:829), RPC/stream-aware:
+
+    * each task's RUNNING->FINISHED span is a complete ("X") event on
+      its worker's row;
+    * the SUBMITTED->RUNNING gap becomes a ``(queued)`` slice in the
+      ``queue_wait`` category, so scheduling/lease latency is visible
+      next to execution time;
+    * streaming generators emit one instant ("i") per reported yield
+      (``STREAM_ITEM`` task events), so per-item pacing and
+      backpressure pauses show up between the task's start and end;
+    * every event carries the submitting span's ``trace_id`` in its
+      args when one was propagated, so user spans (``span(...)``),
+      tasks and stream items correlate in Perfetto.
+
+    Load the output in chrome://tracing or ui.perfetto.dev.
     """
     events: List[dict] = []
     for t in list_tasks():
         start = end = None
+        items = []
         for ev in t.get("events", []):
             if ev["state"] == "RUNNING":
                 start = ev["ts"]
             elif ev["state"] in ("FINISHED", "FAILED"):
                 end = ev["ts"]
+            elif ev["state"] == "STREAM_ITEM":
+                items.append(ev)
         if start is None:
             continue
         if end is None or end < start:
             end = start
+        pid = t.get("node_id", "node")[:8]
+        tid = t.get("worker_id", "worker")[:8]
+        args = {"task_id": t["task_id"], "state": t["state"]}
+        if t.get("trace_id"):
+            args["trace_id"] = t["trace_id"]
+        queued = t.get("creation_time")
+        if queued is not None and queued < start:
+            # SUBMITTED -> RUNNING: the owner-side queue + lease wait
+            events.append({
+                "name": f"{t.get('name', 'task')} (queued)",
+                "cat": "queue_wait",
+                "ph": "X",
+                "ts": queued * 1e6,
+                "dur": (start - queued) * 1e6,
+                "pid": pid,
+                "tid": tid,
+                "args": dict(args),
+            })
         events.append({
             "name": t.get("name", "task"),
             "cat": "task",
             "ph": "X",
             "ts": start * 1e6,
             "dur": (end - start) * 1e6,
-            "pid": t.get("node_id", "node")[:8],
-            "tid": t.get("worker_id", "worker")[:8],
-            "args": {"task_id": t["task_id"], "state": t["state"]},
+            "pid": pid,
+            "tid": tid,
+            "args": args,
         })
+        for ev in items:
+            events.append({
+                "name": f"{t.get('name', 'task')}[{ev.get('index', '?')}]",
+                "cat": "stream_item",
+                "ph": "i",
+                "s": "t",  # thread-scoped instant
+                "ts": ev["ts"] * 1e6,
+                "pid": pid,
+                "tid": tid,
+                "args": dict(args, index=ev.get("index")),
+            })
     if path:
         with open(path, "w") as f:
             json.dump(events, f)
     return events
+
+
+# ------------------------------------------------------------------ metrics
+def list_metrics(prefix: str = "") -> List[dict]:
+    """Cluster-wide metric series from the GCS KV ``metrics/`` namespace
+    (user metrics AND the always-on runtime metrics), merged across
+    processes.  One row per (metric, tag set): counters carry ``value``
+    (summed); gauges carry ``value`` (summed — right for additive
+    gauges like pin counts and pool sizes) AND ``max`` (the largest
+    single process's reading — the honest aggregate for point-in-time
+    or watermark gauges like queue depth); histograms carry
+    ``count``/``sum``/``mean`` and bucket-estimated ``p50``/``p95``
+    (each quantile reported as the upper bound of the bucket it lands
+    in)."""
+    gcs = _gcs()
+    merged: Dict[tuple, dict] = {}
+    for key in gcs.kv_keys("metrics/" + prefix):
+        raw = gcs.kv_get(key)
+        if not raw:
+            continue
+        try:
+            _, name, _worker = key.split("/", 2)
+            data = json.loads(raw)
+        except ValueError:
+            continue
+        for tagjson, val in (data.get("values") or {}).items():
+            row = merged.setdefault((name, tagjson), {
+                "name": name,
+                "type": data.get("type", "untyped"),
+                "description": data.get("description", ""),
+                "tags": dict(json.loads(tagjson)),
+            })
+            if isinstance(val, dict):      # histogram wire format
+                row.setdefault("buckets", {})
+                for le, n in (val.get("buckets") or {}).items():
+                    row["buckets"][le] = row["buckets"].get(le, 0) + n
+                row["sum"] = row.get("sum", 0.0) + val.get("sum", 0.0)
+                row["count"] = row.get("count", 0) + val.get("count", 0)
+            else:
+                row["value"] = row.get("value", 0.0) + val
+                if data.get("type") == "gauge":
+                    row["max"] = max(row.get("max", float("-inf")), val)
+    out = []
+    for row in merged.values():
+        if "buckets" in row:
+            count = row.get("count", 0)
+            row["mean"] = (row.get("sum", 0.0) / count) if count else 0.0
+            row["p50"] = _bucket_quantile(row["buckets"], count, 0.5)
+            row["p95"] = _bucket_quantile(row["buckets"], count, 0.95)
+        out.append(row)
+    out.sort(key=lambda r: (r["name"], sorted(r["tags"].items())))
+    return out
+
+
+def _bucket_quantile(buckets: Dict[str, int], count: int,
+                     q: float) -> float:
+    """Upper-bound estimate of quantile ``q`` from cumulative bucket
+    counts; returns ``inf`` when it lands in the overflow bucket."""
+    if not count:
+        return 0.0
+    target = q * count
+    cum = 0
+    for le in sorted((k for k in buckets if k not in ("+Inf", "inf")),
+                     key=float):
+        cum += buckets[le]
+        if cum >= target:
+            return float(le)
+    return float("inf")
+
+
+def metrics_summary() -> str:
+    """Operator-facing runtime-telemetry table (``ray-tpu summary
+    metrics``): top RPC methods by p50/p95, latency histograms, stream
+    stalls, pin counts — telemetry without the dashboard."""
+    rows = list_metrics()
+    lines: List[str] = []
+
+    rpc_rows = [r for r in rows if r["name"] == "ray_tpu_rpc_dispatch_ms"
+                and r.get("count")]
+    if rpc_rows:
+        rpc_rows.sort(key=lambda r: -r.get("p95", 0.0))
+        lines.append("== RPC dispatch latency (ms) ==")
+        lines.append("%-28s %10s %9s %9s" % ("METHOD", "COUNT", "P50",
+                                             "P95"))
+        for r in rpc_rows[:15]:
+            lines.append("%-28s %10d %9.3g %9.3g" % (
+                r["tags"].get("method", "?")[:28], r["count"],
+                r.get("p50", 0.0), r.get("p95", 0.0)))
+        lines.append("")
+
+    hist_rows = [r for r in rows if r["type"] == "histogram"
+                 and r["name"] != "ray_tpu_rpc_dispatch_ms"
+                 and r.get("count")]
+    if hist_rows:
+        lines.append("== Latency / size distributions ==")
+        lines.append("%-36s %10s %9s %9s %9s" % (
+            "NAME", "COUNT", "MEAN", "P50", "P95"))
+        for r in hist_rows:
+            tag = ",".join(f"{k}={v}" for k, v in sorted(
+                r["tags"].items()))
+            name = r["name"] + (f"{{{tag}}}" if tag else "")
+            lines.append("%-36s %10d %9.3g %9.3g %9.3g" % (
+                name[:36], r["count"], r.get("mean", 0.0),
+                r.get("p50", 0.0), r.get("p95", 0.0)))
+        lines.append("")
+
+    scalar_rows = [r for r in rows if r["type"] in ("counter", "gauge")
+                   and "value" in r]
+    if scalar_rows:
+        lines.append("== Counters / gauges ==")
+        for r in scalar_rows:
+            extra = ""
+            if "max" in r and r["max"] != r["value"]:
+                extra = "  (max/process %g)" % r["max"]
+            lines.append("%-44s %14g%s" % (r["name"][:44], r["value"],
+                                           extra))
+
+    return "\n".join(lines) if lines else "(no metrics published yet)"

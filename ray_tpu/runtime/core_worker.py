@@ -33,6 +33,7 @@ import cloudpickle
 from ray_tpu import exceptions as exc
 from ray_tpu.exceptions import SchedulingError
 from ray_tpu._private import rpc
+from ray_tpu._private import runtime_metrics as rtm
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
@@ -43,6 +44,34 @@ from ray_tpu.runtime.object_store import SharedMemoryStore
 logger = get_logger("core_worker")
 
 _INLINE_MAX = None  # resolved lazily from CONFIG
+
+# hot-path telemetry (docs/observability.md): bound once, attribute
+# arithmetic per record, no-ops when RAY_TPU_TELEMETRY=0.  _TELEMETRY
+# guards the sites with real bookkeeping (the _task_t0 stamp dict),
+# so the kill switch removes that cost too.
+_TELEMETRY = rtm.enabled()
+_M_PUT = rtm.histogram("ray_tpu_put_ms", "ray.put latency (ms)")
+_M_GET = rtm.histogram("ray_tpu_get_ms", "per-ref ray.get latency (ms)")
+_M_TASK_E2E = rtm.histogram(
+    "ray_tpu_task_e2e_ms",
+    "task submit -> terminal reply latency at the owner (ms)")
+_M_PUSH_BATCH = rtm.histogram(
+    "ray_tpu_task_push_batch_size",
+    "task specs coalesced per push_tasks frame",
+    boundaries=rtm.COUNT_BOUNDARIES)
+_M_QUEUE_WAIT = rtm.histogram(
+    "ray_tpu_task_queue_wait_ms",
+    "task submit -> dispatch-to-worker wait at the owner (ms); the "
+    "metric twin of the timeline's SUBMITTED->RUNNING queue_wait slice")
+_M_STREAM_ITEMS = rtm.counter(
+    "ray_tpu_stream_items_total",
+    "streaming-generator items reported to this owner")
+_M_STREAM_STALLS = rtm.counter(
+    "ray_tpu_stream_backpressure_stalls_total",
+    "item reports parked for backpressure (consumer behind producer)")
+_M_STREAM_PARKED = rtm.histogram(
+    "ray_tpu_stream_parked_report_ms",
+    "time an item report spent parked before consumption released it")
 
 
 class ObjectRef:
@@ -167,9 +196,10 @@ class _StreamState:
         self.total: Optional[int] = None  # num_items once complete
         self.failed = False               # terminal error stored in slot 0
         self.closed = False               # consumer dropped the generator
-        # (index, Deferred) item reports parked for backpressure: each
-        # resolves when ITS item is consumed, so the producer's unacked
-        # window is exactly the unconsumed in-flight count
+        # (index, Deferred, t_parked) item reports parked for
+        # backpressure: each resolves when ITS item is consumed, so the
+        # producer's unacked window is exactly the unconsumed in-flight
+        # count; t_parked feeds the parked-report-time histogram
         self.parked: List[tuple] = []
         self.max_unconsumed = 0           # high-water mark (tests/stats)
 
@@ -420,6 +450,18 @@ class _Lease:
 
 
 class CoreWorker:
+    # class-level defaults: the lease loop's queue-wait telemetry reads
+    # these dicts, and test doubles that borrow the loop with a minimal
+    # __init__ must see an (empty) mapping, not an AttributeError.
+    # Real instances shadow them with their own dicts in __init__.
+    # _task_t0 feeds the e2e histogram (popped at the terminal reply);
+    # _task_tq feeds queue-wait and is popped at FIRST dispatch, so a
+    # retry requeued after a worker death is never re-observed with the
+    # original submit stamp (that sample would include the first
+    # attempt's execution time).
+    _task_t0: Dict[bytes, float] = {}
+    _task_tq: Dict[bytes, float] = {}
+
     def __init__(self, *, mode: str, gcs_address: Tuple[str, int],
                  raylet_address: Tuple[str, int], store_path: str,
                  node_id: str, job_id: Optional[JobID] = None,
@@ -535,9 +577,27 @@ class CoreWorker:
             self.gcs, job_id=self.job_id.hex() if mode == "driver" else "",
             node_id=node_id, worker_id=self.worker_id.hex())
 
+        # submit-time monotonic stamps: e2e latency + first-dispatch wait
+        self._task_t0: Dict[bytes, float] = {}
+        self._task_tq: Dict[bytes, float] = {}
+        # runtime telemetry rides the GCS KV: bind this process's flusher
+        # and the poll-time pin-count gauge (zero hot-path cost); both
+        # are unhooked in shutdown() so this CoreWorker (and everything
+        # its caches pin) stays collectable after ray_tpu.shutdown()
+        self._pins_gauge_cb = lambda: sum(self._pins.values())
+        rtm.gauge_callback("ray_tpu_shm_pins",
+                           "shared-memory pins held by this process",
+                           self._pins_gauge_cb)
+        rtm.attach(self.gcs.kv_put,
+                   ident=f"{mode}-{self.worker_id.hex()[:12]}")
+
     # ------------------------------------------------------------ lifecycle
     def shutdown(self) -> None:
         self._shutdown.set()
+        # unhook telemetry publishing bound to this worker's GCS client
+        # (a newer worker's attach/callback is left untouched)
+        rtm.detach(self.gcs.kv_put)
+        rtm.remove_gauge_callback("ray_tpu_shm_pins", self._pins_gauge_cb)
         try:
             self.events.stop()
         except Exception:
@@ -716,6 +776,7 @@ class CoreWorker:
 
     # ------------------------------------------------------------- put/get
     def put(self, value: Any) -> ObjectRef:
+        _t0 = rtm.now()
         with self._counter_lock:
             self._put_counter += 1
             idx = self._put_counter
@@ -733,6 +794,7 @@ class CoreWorker:
             self.store_put(oid, head, views)
             entry.locations.add(self.node_id)
         entry.event.set()
+        _M_PUT.observe_since(_t0)
         return ObjectRef(oid, self.address, self)
 
     def store_put(self, oid: ObjectID, head, views,
@@ -807,6 +869,7 @@ class CoreWorker:
         oid = ref.id
         if oid in self._memory_cache:
             return self._memory_cache[oid]
+        _t0 = rtm.now()
         pins: list = []   # shm pins THIS fetch takes (see _note_pin)
         data = self._fetch_serialized(ref, deadline, pins)
         if data is None:
@@ -838,6 +901,7 @@ class CoreWorker:
             self._borrowed_tokens[oid] = tok
             self._borrowed_cache_order.append((oid, tok))
             self._maybe_trim_cache()
+        _M_GET.observe_since(_t0)
         return value
 
     def _drop_cached(self, oid: ObjectID) -> None:
@@ -1359,6 +1423,10 @@ class CoreWorker:
             self._lineage_order.append(task_id.binary())
             self._lineage_bytes += lineage_size
             self._evict_lineage_locked()
+        if _TELEMETRY:
+            self._task_t0[task_id.binary()] = rtm.now()
+            self._task_tq[task_id.binary()] = self._task_t0[
+                task_id.binary()]
         self._enqueue_task(key, resources, spec, max_retries,
                            strategy=scheduling_strategy, env=runtime_env,
                            language=language)
@@ -1435,6 +1503,10 @@ class CoreWorker:
         task_id = TaskID(spec["task_id"])
         self._arg_refs.pop(spec["task_id"], None)
         self._oom_retries.pop(spec["task_id"], None)
+        t0 = self._task_t0.pop(spec["task_id"], None)
+        self._task_tq.pop(spec["task_id"], None)
+        if t0 is not None:
+            _M_TASK_E2E.observe_since(t0)
         self.events.record(task_id.hex(), "FAILED", name=spec.get("name", ""),
                            error_type=type(error).__name__)
         head, views = ser.serialize(error, error_type=error_code)
@@ -1755,6 +1827,13 @@ class CoreWorker:
                     batch = self._drain_batch_locked(st, budget, batch_max)
                 if not batch:
                     break
+                if _TELEMETRY:
+                    _M_PUSH_BATCH.observe(len(batch))
+                    t_now = rtm.now()
+                    for _spec, _r in batch:
+                        t_sub = self._task_tq.pop(_spec["task_id"], None)
+                        if t_sub is not None:
+                            _M_QUEUE_WAIT.observe((t_now - t_sub) * 1000.0)
                 with lease.plock:
                     for spec, retries in batch:
                         lease.pending[spec["task_id"]] = (spec, retries)
@@ -1953,6 +2032,10 @@ class CoreWorker:
 
     def _on_task_reply(self, spec, reply) -> None:
         task_id = TaskID(spec["task_id"])
+        t0 = self._task_t0.pop(spec["task_id"], None)
+        self._task_tq.pop(spec["task_id"], None)
+        if t0 is not None:
+            _M_TASK_E2E.observe_since(t0)
         results = reply["results"]
         freed: List[Tuple[ObjectID, set]] = []
         with self._owned_lock:
@@ -2122,6 +2205,7 @@ class CoreWorker:
                 # the consumer's fetch finds the live copy instead of
                 # burning another reconstruction
                 entry.locations.add(p["location"])
+        _M_STREAM_ITEMS.inc()
         with state.cv:
             if state.closed:
                 return {"cancel": True}
@@ -2132,7 +2216,8 @@ class CoreWorker:
             state.cv.notify_all()
             if state.bp > 0 and idx >= state.consumed:
                 d = rpc.Deferred()
-                state.parked.append((idx, d))
+                state.parked.append((idx, d, rtm.now()))
+                _M_STREAM_STALLS.inc()
                 return d
             return {"consumed": state.consumed}
 
@@ -2156,10 +2241,10 @@ class CoreWorker:
                     # we build the ref below
                     claimed = idx
                     state.consumed = idx + 1
-                    resolve = [d for i, d in state.parked
+                    resolve = [(d, t) for i, d, t in state.parked
                                if i < state.consumed]
-                    state.parked = [(i, d) for i, d in state.parked
-                                    if i >= state.consumed]
+                    state.parked = [p for p in state.parked
+                                    if p[0] >= state.consumed]
                     break
                 if state.total is not None and idx >= state.total:
                     return _StreamExhausted
@@ -2175,7 +2260,8 @@ class CoreWorker:
                         and time.monotonic() >= deadline:
                     raise exc.GetTimeoutError(
                         "timed out waiting for the next generator item")
-        for d in resolve:
+        for d, t_parked in resolve:
+            _M_STREAM_PARKED.observe_since(t_parked)
             d.resolve({"consumed": state.consumed})
         if failed:
             # slot 0 holds the task's error payload: get() raises it
@@ -2203,7 +2289,7 @@ class CoreWorker:
                 state.total = total
                 # late credit: items past the consumer's cursor can no
                 # longer arrive, so nothing is parked for a reason
-                resolve = [d for _i, d in state.parked]
+                resolve = [d for _i, d, _t in state.parked]
                 state.parked = []
             state.cv.notify_all()
         for d in resolve:
@@ -2221,7 +2307,7 @@ class CoreWorker:
             orphans = list(state.arrived)
             state.arrived.clear()
             state.cv.notify_all()
-        for _i, d in parked:
+        for _i, d, _t in parked:
             d.resolve({"cancel": True})
         with self._streams_lock:
             self._streams.pop(state.task_binary, None)
@@ -2371,6 +2457,8 @@ class CoreWorker:
             if pipe is None:
                 pipe = _ActorPipe(self, aid)
                 self._actor_pipes[aid] = pipe
+        if _TELEMETRY:
+            self._task_t0[task_id.binary()] = rtm.now()
         pipe.enqueue(spec, max_task_retries)
         self.events.record(task_id.hex(), "SUBMITTED", name=method_name,
                            actor_id=aid)
@@ -2379,6 +2467,10 @@ class CoreWorker:
     def _store_actor_error(self, spec, error: BaseException) -> None:
         task_id = TaskID(spec["task_id"])
         self._arg_refs.pop(spec["task_id"], None)
+        t0 = self._task_t0.pop(spec["task_id"], None)
+        self._task_tq.pop(spec["task_id"], None)
+        if t0 is not None:
+            _M_TASK_E2E.observe_since(t0)
         self.events.record(task_id.hex(), "FAILED", name=spec.get("name", ""),
                            actor_id=spec.get("actor_id", ""),
                            error_type=type(error).__name__)

@@ -112,6 +112,11 @@ class GcsServer:
             self._recover(persist_path)
             if CONFIG.gcs_wal_enabled:
                 self._wal_fh = open(persist_path + ".wal", "ab")
+        # runtime telemetry: the GCS flushes its own hot-path metrics
+        # (RPC dispatch latency etc.) straight into its KV table — no
+        # WAL record, metrics are ephemeral monitoring data
+        from ray_tpu._private import runtime_metrics as rtm
+        rtm.attach(self._metrics_kv_put, ident="gcs")
         self._health_thread = threading.Thread(target=self._health_loop,
                                                daemon=True)
         self._health_thread.start()
@@ -358,6 +363,8 @@ class GcsServer:
 
     def stop(self) -> None:
         self._stopped.set()
+        from ray_tpu._private import runtime_metrics as rtm
+        rtm.detach(self._metrics_kv_put)
         self._server.stop()
         snap = getattr(self, "_snap_thread", None)
         if snap is not None:
@@ -372,12 +379,25 @@ class GcsServer:
     # whose fan-out the payload can't name (finish_job kills the job's
     # actors, actor_failed drives the restart FSM) journal from inside
     # the transition instead and are mapped to no hints here.
+    # metrics/ keys are ephemeral monitoring data republished every
+    # flush interval by every process: journaling them would grow the
+    # WAL without bound and pay a per-metric fsync, and even marking
+    # the snapshot dirty would make an otherwise-idle cluster rewrite
+    # its snapshot continuously — so their mutations skip durability
+    _SKIP_DURABILITY = object()
+
     _MUTATING_RPCS: Dict[str, Any] = {
         "register_node": lambda p: (("_nodes", p["node_id"]),),
         "register_job": lambda p: (("_jobs", p["job_id"]),),
         "finish_job": lambda p: (),
-        "kv_put": lambda p: (("_kv", p["key"]),),
-        "kv_del": lambda p: (("_kv", p["key"]),),
+        "kv_put": lambda p: (
+            GcsServer._SKIP_DURABILITY
+            if p["key"].startswith("metrics/")
+            else (("_kv", p["key"]),)),
+        "kv_del": lambda p: (
+            GcsServer._SKIP_DURABILITY
+            if p["key"].startswith("metrics/")
+            else (("_kv", p["key"]),)),
         "register_actor": lambda p: (("_actors", p["actor_id"]),
                                      ("_named_actors", None)),
         "actor_ready": lambda p: (("_actors", p["actor_id"]),),
@@ -435,7 +455,9 @@ class GcsServer:
         out = fn(conn, p or {})
         hints = self._MUTATING_RPCS.get(method)
         if hints is not None:
-            self._mark_dirty(*hints(p or {}))
+            h = hints(p or {})
+            if h is not self._SKIP_DURABILITY:
+                self._mark_dirty(*h)
         return out
 
     def _on_disconnect(self, conn: rpc.Connection) -> None:
@@ -541,6 +563,33 @@ class GcsServer:
                 out.append(d)
             return out
 
+    def _prune_stale_metrics(self, now: Optional[float] = None) -> int:
+        """Delete RUNTIME metrics/ KV entries whose payload ts is
+        stale: the publishing process is gone (or wedged), and its
+        frozen last snapshot must not haunt /metrics and list_metrics
+        forever.  Only payloads self-marked ``runtime`` are eligible —
+        runtime flushers keep-alive their ts even when idle, so
+        staleness means death; user metrics (util/metrics.py) flush on
+        record only, and an idle live process's once-set gauge must
+        not be swept."""
+        import json as _json
+        from ray_tpu._private.runtime_metrics import METRICS_STALE_AFTER_S
+        now = time.time() if now is None else now
+        pruned = 0
+        with self._lock:
+            for key in [k for k in self._kv if k.startswith("metrics/")]:
+                try:
+                    blob = _json.loads(self._kv[key])
+                    ts = blob.get("ts")
+                    swept = bool(blob.get("runtime"))
+                except (ValueError, TypeError, AttributeError):
+                    continue
+                if swept and (ts is None
+                              or now - ts > METRICS_STALE_AFTER_S):
+                    del self._kv[key]
+                    pruned += 1
+        return pruned
+
     def _health_loop(self) -> None:
         period = CONFIG.heartbeat_period_ms / 1000.0
         threshold = CONFIG.health_check_failure_threshold
@@ -561,6 +610,13 @@ class GcsServer:
                     for pg in self._placement_groups.values())
             for nid in dead:
                 self._mark_node_dead(nid)
+            # dead processes leave their last metrics snapshot behind in
+            # the KV; sweep keys whose payload ts went stale (live
+            # flushers refresh ts every few intervals) so /metrics and
+            # list_metrics don't report frozen gauges forever and KV
+            # cardinality stays bounded under worker churn
+            if ticks % 50 == 0:
+                self._prune_stale_metrics()
             # actors/pgs parked with "no feasible node" are otherwise only
             # retried on node registration — also retry as resources free
             # up (freshly reported by heartbeats), else a full-but-draining
@@ -704,6 +760,11 @@ class GcsServer:
             name=p.get("name"), limit=int(p.get("limit", 10000)))
 
     # ------------------------------------------------------------------- kv
+    def _metrics_kv_put(self, key: str, value: bytes) -> None:
+        """Runtime-metrics flusher sink: plain KV write, never WALed."""
+        with self._lock:
+            self._kv[key] = value
+
     def _rpc_kv_put(self, conn, p):
         with self._lock:
             existed = p["key"] in self._kv

@@ -30,11 +30,19 @@ from typing import Any, Dict, List, Optional, Tuple
 import psutil
 
 from ray_tpu._private import rpc
+from ray_tpu._private import runtime_metrics as rtm
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.logging_utils import get_logger
 from ray_tpu.runtime.gcs import GcsClient
 from ray_tpu.runtime.object_store import SharedMemoryStore
+
+# lease-path telemetry (docs/observability.md)
+_M_LEASE = rtm.histogram(
+    "ray_tpu_lease_grant_ms",
+    "lease request queued -> grant latency at this raylet (ms)")
+_M_SPAWNS = rtm.counter(
+    "ray_tpu_workers_spawned_total", "worker processes spawned")
 
 logger = get_logger("raylet")
 
@@ -261,6 +269,14 @@ class Raylet:
             "resources": self.resources,
             "labels": self.labels,
         })
+
+        # runtime telemetry: worker-pool gauge polled at flush time, and
+        # this raylet's flusher publishing into the GCS KV
+        rtm.gauge_callback("ray_tpu_worker_pool_size",
+                           "workers registered to this raylet",
+                           lambda: len(self._workers))
+        rtm.attach(self.gcs.kv_put,
+                   ident="raylet-" + self.node_id.hex()[:12])
 
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
@@ -883,6 +899,7 @@ class Raylet:
     def _spawn_worker(self, job_id: Optional[str],
                       env_overrides: Optional[Dict[str, str]] = None,
                       language: Optional[str] = None) -> WorkerHandle:
+        _M_SPAWNS.inc()
         worker_id = WorkerID.from_random()
         if language == "cpp":
             return self._spawn_cpp_worker(worker_id, job_id, env_overrides)
@@ -1462,6 +1479,7 @@ class Raylet:
                     req["event"].set()
                     continue
             lease_id = WorkerID.from_random().hex()
+            _M_LEASE.observe((time.monotonic() - req["t_queued"]) * 1000.0)
             grant = {
                 "lease_id": lease_id,
                 "worker_id": handle.worker_id.hex(),
@@ -1625,6 +1643,9 @@ class Raylet:
     # ------------------------------------------------------------------ stop
     def shutdown(self) -> None:
         self._stopped.set()
+        # unhook telemetry publishing bound to this raylet's GCS client
+        rtm.detach(self.gcs.kv_put)
+        rtm.remove_gauge_callback("ray_tpu_worker_pool_size")
         if self._log_monitor is not None:
             self._log_monitor.stop()
         with self._lock:

@@ -24,6 +24,7 @@ import cloudpickle
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import rpc
+from ray_tpu._private import runtime_metrics as rtm
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
@@ -31,6 +32,19 @@ from ray_tpu._private.logging_utils import get_logger, setup_component_logging
 from ray_tpu.runtime import core_worker as cw
 
 logger = get_logger("worker")
+
+# executor-side telemetry (docs/observability.md)
+_M_EXEC = rtm.histogram_family(
+    "ray_tpu_task_exec_ms", "task/actor-method execution time (ms)",
+    tag_key="func")
+_M_CREDIT_WAIT = rtm.histogram(
+    "ray_tpu_stream_credit_wait_ms",
+    "time a streaming producer spent paused on backpressure credit")
+
+# per-yield STREAM_ITEM instants are recorded into the task table only
+# for the first N items of a stream: the timeline stays readable and one
+# long stream can't flood the (bounded) per-task event list
+_STREAM_EVENT_CAP = 256
 
 
 class _StreamCancelled(Exception):
@@ -74,6 +88,15 @@ class _StreamSession:
             fut = self.conn.call_async("report_generator_item", payload)
         except (ConnectionError, OSError):
             raise _StreamCancelled from None
+        if self.index < _STREAM_EVENT_CAP:
+            # per-yield instant for the timeline (ph="i" in Perfetto),
+            # carrying the submitter's trace id so user spans, the task
+            # span and its stream items correlate
+            tc = self.spec.get("trace_ctx")
+            self.core.events.record(
+                self.task_id.hex(), "STREAM_ITEM",
+                name=self.spec.get("name", ""), index=self.index,
+                **({"trace_id": tc["trace_id"]} if tc else {}))
         self.outstanding.append(fut)
         self.index += 1
 
@@ -81,8 +104,11 @@ class _StreamSession:
         if self.bp > 0:
             # unacked window == unconsumed in-flight items: block here
             # until the consumer acks (pausing the user generator)
-            while len(self.outstanding) >= self.bp:
-                self._consume_reply(self.outstanding.popleft())
+            if len(self.outstanding) >= self.bp:
+                t0 = rtm.now()
+                while len(self.outstanding) >= self.bp:
+                    self._consume_reply(self.outstanding.popleft())
+                _M_CREDIT_WAIT.observe_since(t0)
         else:
             # unbounded stream: just reap replies that already landed so
             # a long stream doesn't accumulate futures
@@ -396,14 +422,22 @@ class WorkerProcess:
         # under the caller's span (auto span injection)
         propagate_trace_context(trace_ctx)
         borrowed = []
+        t_exec = None
         try:
             args, kwargs, borrowed = (resolved if resolved is not None
                                       else self._resolve_args(spec["args"]))
+            t_exec = rtm.now()
             result = fn(*args, **kwargs)
             return self._package_results(spec, result)
         except Exception as e:  # noqa: BLE001 - user errors cross the wire
             return self._package_error(spec, e)
         finally:
+            # observed in the finally so the sample covers generator
+            # tasks (fn() only CREATES the generator — the iteration
+            # happens inside _package_results/_StreamSession) and
+            # failed executions alike
+            if t_exec is not None:
+                _M_EXEC.observe_since(spec.get("name", ""), t_exec)
             propagate_trace_context(None)
             self.core.release_borrowed(borrowed)
 
@@ -703,6 +737,7 @@ class WorkerProcess:
             return err
         loop = asyncio.get_running_loop()
         borrowed = []
+        t_exec = None
         try:
             args, kwargs, borrowed = await loop.run_in_executor(
                 None, self._resolve_args, spec["args"])
@@ -711,6 +746,7 @@ class WorkerProcess:
                 os._exit(0)
             import inspect
             method = getattr(self.actor_instance, spec["method"])
+            t_exec = rtm.now()
             result = method(*args, **kwargs)
             if inspect.isawaitable(result):
                 result = await result
@@ -725,6 +761,10 @@ class WorkerProcess:
         except Exception as e:  # noqa: BLE001
             return self._package_error(spec, e)
         finally:
+            # in the finally: covers async-generator streaming (the
+            # iteration happens in _package_streaming_async) and errors
+            if t_exec is not None:
+                _M_EXEC.observe_since(spec.get("method", ""), t_exec)
             propagate_trace_context(None)
             self.core.release_borrowed(borrowed)
 
@@ -735,17 +775,23 @@ class WorkerProcess:
         if err is not None:
             return err
         borrowed = []
+        t_exec = None
         try:
             args, kwargs, borrowed = self._resolve_args(spec["args"])
             if spec["method"] == "__ray_terminate__":
                 import os
                 os._exit(0)
             method = getattr(self.actor_instance, spec["method"])
+            t_exec = rtm.now()
             result = method(*args, **kwargs)
             return self._package_results(spec, result)
         except Exception as e:  # noqa: BLE001
             return self._package_error(spec, e)
         finally:
+            # finally-observed: covers sync-generator streaming (driven
+            # inside _package_results) and failed calls
+            if t_exec is not None:
+                _M_EXEC.observe_since(spec.get("method", ""), t_exec)
             propagate_trace_context(None)
             self.core.release_borrowed(borrowed)
 

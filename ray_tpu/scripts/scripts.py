@@ -12,7 +12,7 @@ Usage:
   python -m ray_tpu.scripts stop
   python -m ray_tpu.scripts status [--address ...]
   python -m ray_tpu.scripts list tasks|actors|nodes|jobs|objects|workers|placement-groups
-  python -m ray_tpu.scripts summary tasks|actors|objects
+  python -m ray_tpu.scripts summary tasks|actors|objects|metrics
   python -m ray_tpu.scripts memory
   python -m ray_tpu.scripts timeline [-o trace.json]
   python -m ray_tpu.scripts job submit|status|logs|stop|list ...
@@ -274,6 +274,11 @@ def cmd_list(args) -> None:
 def cmd_summary(args) -> None:
     _connect(args)
     from ray_tpu.experimental import state
+    if args.resource == "metrics":
+        # runtime telemetry as a sorted operator table (top RPC methods
+        # by p50/p95, stream stalls, pin counts) — docs/observability.md
+        print(state.metrics_summary())
+        return
     fn = {"tasks": state.summarize_tasks,
           "actors": state.summarize_actors,
           "objects": state.summarize_objects}[args.resource]
@@ -548,7 +553,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_list)
 
     sp = sub.add_parser("summary", help="summarize cluster state")
-    sp.add_argument("resource", choices=["tasks", "actors", "objects"])
+    sp.add_argument("resource",
+                    choices=["tasks", "actors", "objects", "metrics"])
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_summary)
 

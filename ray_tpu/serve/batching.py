@@ -19,6 +19,11 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
+from ray_tpu._private import runtime_metrics as rtm
+
+_M_BATCH = rtm.histogram(
+    "ray_tpu_serve_batch_size", "@serve.batch coalesced batch sizes",
+    boundaries=rtm.COUNT_BOUNDARIES)
 
 _QUEUE_CREATE_LOCK = threading.Lock()
 _QUEUES: dict = {}
@@ -95,6 +100,7 @@ class _BatchQueue:
                     self._lock.wait(timeout=deadline - time.monotonic())
                 batch = self._items[:self._max]
                 del self._items[:len(batch)]
+            _M_BATCH.observe(len(batch))
             instance = batch[0][0]
             args = [b[1] for b in batch]
             try:

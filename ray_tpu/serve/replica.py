@@ -13,6 +13,15 @@ import inspect
 import time
 from typing import Any, Dict
 
+from ray_tpu._private import runtime_metrics as rtm
+
+_M_REQ = rtm.histogram_family(
+    "ray_tpu_serve_request_ms",
+    "serve request latency per deployment (ms); streaming requests are "
+    "timed first call -> last yield", tag_key="deployment")
+_M_ONGOING = rtm.gauge(
+    "ray_tpu_serve_ongoing", "in-flight serve requests on this replica")
+
 
 class ReplicaActor:
     """Runs as an *async* ray_tpu actor (handle_request is a coroutine, so
@@ -52,6 +61,8 @@ class ReplicaActor:
                              kwargs: dict) -> Any:
         import functools
         self._num_ongoing += 1
+        _M_ONGOING.set(self._num_ongoing)
+        _t0 = rtm.now()
         try:
             if self._is_function:
                 target = self._callable
@@ -75,6 +86,8 @@ class ReplicaActor:
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1
+            _M_ONGOING.set(self._num_ongoing)
+            _M_REQ.observe_since(self.deployment_name, _t0)
 
     async def handle_request_streaming(self, method_name: str, args: tuple,
                                        kwargs: dict):
@@ -85,6 +98,8 @@ class ReplicaActor:
         streams its first token while decode is still running.  The
         user target must return an (async) generator / iterable."""
         self._num_ongoing += 1
+        _M_ONGOING.set(self._num_ongoing)
+        _t0 = rtm.now()
         try:
             if self._is_function:
                 target = self._callable
@@ -115,6 +130,8 @@ class ReplicaActor:
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1
+            _M_ONGOING.set(self._num_ongoing)
+            _M_REQ.observe_since(self.deployment_name, _t0)
 
     # ------------------------------------------------------------- control
     def reconfigure(self, user_config: Any) -> None:

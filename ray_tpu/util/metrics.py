@@ -69,7 +69,9 @@ class _MetricBase:
             if not self._dirty or \
                     (not force and now - self._last_flush < _FLUSH_PERIOD_S):
                 return
-            snapshot = {json.dumps(dict(k)): v
+            snapshot = {json.dumps(dict(k)):
+                        (dict(v, buckets=dict(v["buckets"]))
+                         if isinstance(v, dict) else v)
                         for k, v in self._values.items()}
             self._dirty = False
             self._last_flush = now
@@ -113,7 +115,14 @@ class Gauge(_MetricBase):
 
 
 class Histogram(_MetricBase):
-    """Distribution over configured boundaries; stores per-bucket counts."""
+    """Distribution over configured boundaries.
+
+    Each tag set stores ``{"buckets": {le: count}, "sum", "count"}`` —
+    the shared histogram wire format (also used by the runtime-metrics
+    layer, _private/runtime_metrics.py) that the dashboard renders as
+    conformant Prometheus ``<name>_bucket{le=...}`` (cumulative, with
+    ``+Inf``) plus ``<name>_count``/``<name>_sum`` series, instead of
+    the old raw per-bucket counts with an ``le`` tag on the bare name."""
 
     _TYPE = "histogram"
 
@@ -127,10 +136,17 @@ class Histogram(_MetricBase):
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
-        bucket = next((b for b in self._boundaries if value <= b), float("inf"))
-        key = self._tagkey(tags) + (("le", str(bucket)),)
+        bucket = next((repr(float(b)) for b in self._boundaries
+                       if value <= b), "+Inf")
+        key = self._tagkey(tags)
         with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + 1
+            rec = self._values.get(key)
+            if not isinstance(rec, dict):
+                rec = self._values[key] = {"buckets": {}, "sum": 0.0,
+                                           "count": 0}
+            rec["buckets"][bucket] = rec["buckets"].get(bucket, 0) + 1
+            rec["sum"] += value
+            rec["count"] += 1
             self._dirty = True
         self._maybe_flush()
 

@@ -1,0 +1,352 @@
+"""Always-on runtime telemetry (_private/runtime_metrics.py): hot-path
+instruments, flush-to-GCS, the kill switch, Prometheus conformance, and
+the task-event table fixes that ride along (docs/observability.md)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+
+def _wait_for(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------- instrument units
+def test_hot_path_instruments():
+    from ray_tpu._private import runtime_metrics as rtm
+
+    c = rtm.counter("tm_unit_total", "count things")
+    c.inc()
+    c.inc(4)
+    h = rtm.histogram("tm_unit_ms", "latency", boundaries=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(500.0)   # overflow bucket
+    t0 = rtm.now()
+    h.observe_since(t0)  # ~0 ms -> first bucket
+    f = rtm.histogram_family("tm_unit_fam", "per-method", tag_key="method")
+    f.observe("alpha", 2.0)
+    f.get("beta").observe(3.0)
+    g = rtm.gauge("tm_unit_peak", watermark=True)
+    g.set_max(7)
+    g.set_max(2)   # must not lower the high-water mark
+    rtm.gauge_callback("tm_unit_cb", "polled", lambda: 11.0)
+
+    snap = rtm.snapshot()
+    assert snap["tm_unit_total"]["values"]["{}"] == 5.0
+    hist = snap["tm_unit_ms"]["values"]["{}"]
+    assert hist["count"] == 4
+    assert hist["buckets"]["+Inf"] == 1
+    assert hist["sum"] == pytest.approx(505.5, abs=1.0)
+    fam = snap["tm_unit_fam"]["values"]
+    assert json.dumps({"method": "alpha"}) in fam
+    assert fam[json.dumps({"method": "beta"})]["count"] == 1
+    assert snap["tm_unit_peak"]["values"]["{}"] == 7
+    assert snap["tm_unit_cb"]["values"]["{}"] == 11.0
+    # a plain snapshot (debugging) must NOT consume the high-water
+    # mark; only the flusher's reset_watermarks snapshot does
+    assert rtm.snapshot()["tm_unit_peak"]["values"]["{}"] == 7
+    assert rtm.snapshot(
+        reset_watermarks=True)["tm_unit_peak"]["values"]["{}"] == 7
+    assert rtm.snapshot()["tm_unit_peak"]["values"]["{}"] == 0.0
+
+
+def test_histogram_family_label_cap():
+    from ray_tpu._private import runtime_metrics as rtm
+
+    f = rtm.HistogramFamily("tm_capfam", max_labels=4)
+    for i in range(20):
+        f.observe(f"label-{i}", 1.0)
+    labels = f.labels()
+    assert len(labels) <= 5  # 4 real + __other__ overflow
+    assert "__other__" in labels
+
+
+def test_kill_switch_returns_noops():
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu._private.config import CONFIG
+
+    CONFIG.set("telemetry_enabled", False)
+    try:
+        c = rtm.counter("tm_killed_total")
+        h = rtm.histogram("tm_killed_ms")
+        f = rtm.histogram_family("tm_killed_fam")
+        g = rtm.gauge("tm_killed_gauge")
+        rtm.gauge_callback("tm_killed_cb", "", lambda: 1.0)
+        # all record calls are no-ops and nothing registers
+        c.inc()
+        h.observe(1.0)
+        h.observe_since(rtm.now())
+        f.observe("m", 1.0)
+        f.get("m").observe(2.0)
+        g.set(3.0)
+        g.set_max(4.0)
+        snap = rtm.snapshot()
+        assert not any(k.startswith("tm_killed") for k in snap)
+    finally:
+        CONFIG.set("telemetry_enabled", True)
+
+
+def test_concurrent_counter_is_approximately_lossless():
+    """The lock-free record path may lose the odd update under races,
+    but must stay in the right order of magnitude (monitoring data)."""
+    from ray_tpu._private import runtime_metrics as rtm
+
+    c = rtm.counter("tm_race_total")
+
+    def worker():
+        for _ in range(10000):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value >= 10000  # at least one thread's worth survived fully
+
+
+# ------------------------------------------------------ prometheus render
+def test_prometheus_exposition_conformant():
+    from ray_tpu._private.runtime_metrics import prometheus_exposition
+
+    entries = [
+        ("req_total", "w1", {"type": "counter", "description": "reqs",
+                             "values": {"{}": 5.0}}),
+        ("lat_ms", "w1", {
+            "type": "histogram", "description": "latency",
+            "values": {json.dumps({"method": "m"}): {
+                "buckets": {"1.0": 2, "10.0": 3, "+Inf": 1},
+                "sum": 40.0, "count": 6}}}),
+    ]
+    text = prometheus_exposition(entries)
+    lines = text.splitlines()
+    assert "# TYPE req_total counter" in lines
+    assert 'req_total{worker="w1"} 5.0' in lines
+    assert "# TYPE lat_ms histogram" in lines
+    # cumulative buckets, +Inf present, bucket series on <name>_bucket
+    assert 'lat_ms_bucket{le="1.0",method="m",worker="w1"} 2' in lines
+    assert 'lat_ms_bucket{le="10.0",method="m",worker="w1"} 5' in lines
+    assert 'lat_ms_bucket{le="+Inf",method="m",worker="w1"} 6' in lines
+    assert 'lat_ms_count{method="m",worker="w1"} 6' in lines
+    assert 'lat_ms_sum{method="m",worker="w1"} 40.0' in lines
+    # no raw per-bucket samples on the bare histogram name
+    assert not any(l.startswith("lat_ms{") for l in lines)
+
+
+def test_user_histogram_conformant_via_exposition(ray_start_regular):
+    """util.metrics.Histogram stores buckets+sum+count and renders as a
+    conformant Prometheus histogram (the old format emitted raw bucket
+    counts with an `le` tag on the bare metric name)."""
+    from ray_tpu._private.runtime_metrics import prometheus_exposition
+    from ray_tpu.util import metrics as um
+
+    h = um.Histogram("tm_app_s", "app", boundaries=[0.1, 1.0],
+                     tag_keys=("route",))
+    for v in (0.05, 0.5, 7.0):
+        h.observe(v, tags={"route": "r"})
+    h.flush()
+
+    snap = um.query_metrics("tm_app_s")
+    assert snap, "histogram did not reach the GCS KV"
+    key, data = next(iter(snap.items()))
+    rec = next(iter(data["values"].values()))
+    assert rec["count"] == 3 and rec["buckets"]["+Inf"] == 1
+    text = prometheus_exposition(
+        [("tm_app_s", key.split("/")[-1], data)])
+    assert 'le="+Inf"' in text
+    assert "tm_app_s_count" in text and "tm_app_s_sum" in text
+
+
+# ----------------------------------------------------------- flush-to-GCS
+def test_runtime_metrics_flush_to_gcs(ray_start_regular):
+    """Hot-path instruments from every component land in the GCS KV
+    metrics/ namespace and surface through list_metrics()."""
+    import ray_tpu
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu.experimental.state import list_metrics
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get([f.remote(i) for i in range(3)]) == [1, 2, 3]
+    rtm.flush_now()   # driver-side metrics, without waiting the interval
+
+    def _published():
+        names = {r["name"] for r in list_metrics(prefix="ray_tpu_")}
+        if not {"ray_tpu_task_e2e_ms", "ray_tpu_rpc_dispatch_ms",
+                "ray_tpu_lease_grant_ms"} <= names:
+            return False
+        # per-method dispatch rows (worker/raylet flush on their own
+        # 2 s ticks, so the task-path methods can trail the first keys)
+        methods = {r["tags"].get("method")
+                   for r in list_metrics(prefix="ray_tpu_rpc_dispatch_ms")}
+        return "push_tasks" in methods or "lease_worker" in methods
+
+    _wait_for(_published, msg="runtime metrics in GCS KV")
+    rows = {r["name"]: r for r in list_metrics(prefix="ray_tpu_")
+            if not r["tags"]}
+    e2e = rows["ray_tpu_task_e2e_ms"]
+    assert e2e["count"] >= 3 and e2e["p95"] > 0
+
+
+def test_metrics_summary_table(ray_start_regular):
+    import ray_tpu
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu.experimental.state import metrics_summary
+
+    @ray_tpu.remote
+    def f():
+        return 0
+
+    ray_tpu.get(f.remote())
+    rtm.flush_now()
+    _wait_for(lambda: "RPC dispatch latency" in metrics_summary(),
+              msg="summary table with RPC section")
+    text = metrics_summary()
+    assert "P95" in text and "ray_tpu" in text
+
+
+def test_gcs_skips_durability_for_metrics_keys(tmp_path):
+    """Per-interval metric flushes must not grow the WAL or dirty the
+    snapshot: only real KV mutations pay durability."""
+    from ray_tpu.runtime.gcs import GcsServer
+
+    gcs = GcsServer(persist_path=str(tmp_path / "gcs.json"))
+    try:
+        gcs._dirty.clear()
+        seq0 = gcs._wal_seq
+        gcs._handle(None, "kv_put", {"key": "metrics/m/x",
+                                     "value": b"{}"})
+        assert gcs._wal_seq == seq0, "metrics kv_put was WALed"
+        assert not gcs._dirty.is_set(), "metrics kv_put dirtied snapshot"
+        gcs._handle(None, "kv_put", {"key": "real_key", "value": b"v"})
+        assert gcs._wal_seq > seq0 and gcs._dirty.is_set()
+    finally:
+        gcs.stop()
+
+
+def test_gcs_prunes_stale_metrics_keys(tmp_path):
+    """A dead process's frozen last snapshot is swept once its payload
+    ts goes stale; fresh keys survive."""
+    from ray_tpu.runtime.gcs import GcsServer
+
+    gcs = GcsServer()
+    try:
+        now = time.time()
+        gcs._metrics_kv_put(
+            "metrics/m/dead",
+            json.dumps({"ts": now - 600, "runtime": True}).encode())
+        gcs._metrics_kv_put(
+            "metrics/m/alive",
+            json.dumps({"ts": now, "runtime": True}).encode())
+        # a user metric (no runtime marker) has no ts keep-alive: a
+        # once-set gauge from a live-but-idle process must NOT be swept
+        gcs._metrics_kv_put("metrics/user_gauge/w1",
+                            json.dumps({"ts": now - 600}).encode())
+        pruned = gcs._prune_stale_metrics(now)
+        assert pruned == 1
+        with gcs._lock:
+            assert "metrics/m/alive" in gcs._kv
+            assert "metrics/m/dead" not in gcs._kv
+            assert "metrics/user_gauge/w1" in gcs._kv
+    finally:
+        gcs.stop()
+
+
+def test_list_metrics_gauge_max_aggregation(ray_start_regular):
+    """Gauges merged across processes report both the sum (additive
+    gauges) and the largest single-process reading (point-in-time)."""
+    import ray_tpu
+    from ray_tpu.experimental.state import list_metrics
+    w = ray_tpu.runtime.core_worker.get_global_worker()
+    for ident, v in (("p1", 4.0), ("p2", 1.0)):
+        w.gcs.kv_put(f"metrics/tm_depth/{ident}", json.dumps({
+            "type": "gauge", "description": "", "ts": time.time(),
+            "values": {"{}": v}}).encode())
+    row = next(r for r in list_metrics(prefix="tm_depth"))
+    assert row["value"] == 5.0 and row["max"] == 4.0
+
+
+# ------------------------------------------------------- task_events fixes
+def test_task_table_eviction_scans_past_live_head():
+    """A live (non-terminal) task at the head of first-seen order must
+    not block eviction of terminal tasks queued behind it (the
+    eviction-stall satellite)."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu._private.task_events import GcsTaskTable
+
+    CONFIG.set("gcs_max_task_events", 10)
+    try:
+        table = GcsTaskTable()
+        # live head, seen first
+        table.put_events([{"task_id": "live-0", "state": "RUNNING",
+                           "name": "head", "ts": time.time()}])
+        # a wave of terminal tasks behind it
+        for i in range(50):
+            table.put_events([
+                {"task_id": f"done-{i}", "state": "SUBMITTED",
+                 "name": "t", "ts": time.time()},
+                {"task_id": f"done-{i}", "state": "FINISHED",
+                 "name": "t", "ts": time.time()},
+            ])
+        rows = table.list()
+        assert len(rows) <= 10, (
+            f"table grew to {len(rows)} records past the cap of 10")
+        # the live head survived: live entries are spared, not evicted
+        assert any(r["task_id"] == "live-0" for r in rows)
+    finally:
+        CONFIG.set("gcs_max_task_events", 100000)
+
+
+def test_task_event_buffer_stop_joins_and_noops():
+    """stop() joins the flush thread (no racing final flush) and a
+    record() after stop is a no-op."""
+    from ray_tpu._private.task_events import TaskEventBuffer
+
+    calls = []
+
+    class FakeGcs:
+        def call(self, method, payload, timeout=None):
+            calls.append(payload)
+
+    buf = TaskEventBuffer(FakeGcs())
+    buf.record("t1", "SUBMITTED", name="x")
+    _wait_for(lambda: buf._thread is not None, msg="flush thread started")
+    buf.stop()
+    assert not buf._thread.is_alive(), "stop() must join the flush thread"
+    flushed = sum(len(p["events"]) for p in calls)
+    assert flushed == 1
+    buf.record("t2", "SUBMITTED", name="y")   # after stop: dropped
+    buf.flush()
+    assert sum(len(p["events"]) for p in calls) == 1
+    assert all(ev["task_id"] != "t2"
+               for p in calls for ev in p["events"])
+
+
+def test_task_table_event_list_bounded():
+    """One chatty task (a long stream's per-yield instants) cannot grow
+    its record's event list without bound."""
+    from ray_tpu._private.task_events import GcsTaskTable
+
+    table = GcsTaskTable()
+    events = [{"task_id": "s1", "state": "STREAM_ITEM", "name": "gen",
+               "ts": time.time() + i * 1e-6, "index": i}
+              for i in range(2000)]
+    table.put_events(events)
+    rec = table.list()[0]
+    assert len(rec["events"]) <= 512
+    assert rec.get("events_truncated")
+    # instants never become the record's lifecycle state
+    table.put_events([{"task_id": "s1", "state": "RUNNING", "name": "gen",
+                       "ts": time.time()}])
+    rec = table.list()[0]
+    assert rec["state"] == "RUNNING"

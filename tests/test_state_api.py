@@ -51,6 +51,96 @@ def test_list_tasks_and_timeline(ray_start_regular):
                for e in spans)
 
 
+def test_timeline_queue_wait_slices(ray_start_regular):
+    """The enriched timeline carries SUBMITTED->RUNNING queue-wait
+    slices next to each task's execution span."""
+    import ray_tpu
+    from ray_tpu.experimental.state import timeline
+
+    @ray_tpu.remote
+    def queued_task():
+        return 1
+
+    assert ray_tpu.get([queued_task.remote() for _ in range(3)]) == [1] * 3
+
+    def _has_queue_slices():
+        ev = timeline()
+        waits = [e for e in ev if e["cat"] == "queue_wait"
+                 and e["name"].startswith("queued_task")]
+        runs = [e for e in ev if e["cat"] == "task"
+                and e["name"] == "queued_task"]
+        return len(waits) >= 1 and len(runs) >= 3
+
+    _wait_for(_has_queue_slices, msg="queue-wait slices in timeline")
+    ev = timeline()
+    wait = next(e for e in ev if e["cat"] == "queue_wait"
+                and e["name"].startswith("queued_task"))
+    run = next(e for e in ev if e["cat"] == "task"
+               and e["name"] == "queued_task"
+               and e["args"]["task_id"] == wait["args"]["task_id"])
+    assert wait["ph"] == "X" and wait["dur"] >= 0
+    # the queued slice ends where the running span starts
+    assert wait["ts"] + wait["dur"] == pytest.approx(run["ts"], abs=1.0)
+
+
+def test_timeline_stream_item_instants(ray_start_regular):
+    """Streaming generators leave one instant per reported yield on the
+    executing worker's timeline row."""
+    import ray_tpu
+    from ray_tpu.experimental.state import timeline
+
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i
+
+    g = gen.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in g] == [0, 1, 2, 3]
+
+    def _has_instants():
+        items = [e for e in timeline() if e["cat"] == "stream_item"]
+        return len(items) >= 4
+
+    _wait_for(_has_instants, msg="stream item instants in timeline")
+    items = sorted((e for e in timeline() if e["cat"] == "stream_item"),
+                   key=lambda e: e["args"]["index"])
+    assert [e["args"]["index"] for e in items[:4]] == [0, 1, 2, 3]
+    assert all(e["ph"] == "i" for e in items)
+    run = next(e for e in timeline() if e["cat"] == "task"
+               and e["name"] == "gen")
+    # instants sit on the same worker row as the task span
+    assert all(e["tid"] == run["tid"] for e in items)
+
+
+def test_timeline_trace_id_correlation(ray_start_regular):
+    """A span() on the driver propagates its trace_id through the
+    submitted task into the timeline, so user spans and tasks correlate
+    in Perfetto."""
+    import ray_tpu
+    from ray_tpu.experimental.state import timeline
+    from ray_tpu.util.tracing.tracing_helper import (get_trace_context,
+                                                     span)
+
+    @ray_tpu.remote
+    def traced_task():
+        return 1
+
+    with span("driver-work"):
+        driver_trace = get_trace_context()["trace_id"]
+        assert ray_tpu.get(traced_task.remote()) == 1
+
+    def _correlated():
+        ev = timeline()
+        task = [e for e in ev if e["name"] == "traced_task"
+                and e["cat"] == "task"
+                and e["args"].get("trace_id") == driver_trace]
+        spans = [e for e in ev if e["name"] == "span:driver-work"
+                 and e["args"].get("trace_id") == driver_trace]
+        return task and spans
+
+    _wait_for(_correlated, msg="trace-correlated task + span in timeline")
+
+
 def test_failed_task_state(ray_start_regular):
     import ray_tpu
     from ray_tpu.experimental.state import list_tasks
