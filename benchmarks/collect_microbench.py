@@ -61,6 +61,14 @@ SECTIONS = {
                            os.path.join(REPO, "benchmarks",
                                         "streaming_perf.py")],
                       timeout=600),
+    # compiled static graphs (docs/compiled_dag.md): interleaved A/B of
+    # a 3-stage actor chain, compiled (shm channels, zero per-call task
+    # submission) vs classic dag.execute(); the speedup row is the >=5x
+    # bar and the shm-growth row the ==0 slot-reuse bar
+    "compiled_dag": dict(cmd=[sys.executable,
+                              os.path.join(REPO, "benchmarks",
+                                           "compiled_dag_perf.py")],
+                         timeout=600),
     # always-on runtime telemetry cost guard (docs/observability.md):
     # interleaved same-box A/B of task throughput with
     # RAY_TPU_TELEMETRY=0 vs 1; the overhead_pct row is the <=3% bar
@@ -101,6 +109,12 @@ _CONTROL_PLANE_ROWS = {
 # report path's throughput must stay visible the same way.
 _STREAMING_ROWS = {
     "streaming 100-yield": "streaming_items_s",
+}
+
+# Compiled-DAG rows (docs/compiled_dag.md): the channel hot loop's
+# per-execute rate must stay visible the same way.
+_COMPILED_DAG_ROWS = {
+    "compiled_dag 3-stage": "compiled_dag_execs_s",
 }
 
 
@@ -151,6 +165,26 @@ def streaming_deltas(stream_rows, committed):
             continue
         prev, cur = base[row["name"]], row["items_per_s"]
         out[key] = {"committed_items_s": prev, "current_items_s": cur,
+                    "ratio": round(cur / prev, 3)}
+    return out
+
+
+def compiled_dag_deltas(rows, committed):
+    """Same contract for the compiled-DAG section's executes/s row."""
+    if not committed:
+        return {}
+    base = {r["name"]: r.get("ops_per_s")
+            for r in committed.get("compiled_dag", []) if isinstance(r, dict)}
+    out = {}
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        key = _COMPILED_DAG_ROWS.get(row.get("name"))
+        if key is None or not base.get(row["name"]) \
+                or not row.get("ops_per_s"):
+            continue
+        prev, cur = base[row["name"]], row["ops_per_s"]
+        out[key] = {"committed_execs_s": prev, "current_execs_s": cur,
                     "ratio": round(cur / prev, 3)}
     return out
 
@@ -250,7 +284,7 @@ def main():
     merge_preserve(out, prev, regenerated)
 
     committed = None
-    if "core" in regenerated or "streaming" in regenerated:
+    if regenerated & {"core", "streaming", "compiled_dag"}:
         committed = _committed_baseline(args.output)
     if "core" in regenerated:
         deltas = control_plane_deltas(out["core"], committed)
@@ -269,6 +303,15 @@ def main():
                 tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
                 print(f"[collect] {key}: {d['committed_items_s']:,.0f} -> "
                       f"{d['current_items_s']:,.0f} items/s "
+                      f"(x{d['ratio']}) [{tag}]", flush=True)
+    if "compiled_dag" in regenerated:
+        deltas = compiled_dag_deltas(out["compiled_dag"], committed)
+        if deltas:
+            out["compiled_dag_deltas"] = deltas
+            for key, d in deltas.items():
+                tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
+                print(f"[collect] {key}: {d['committed_execs_s']:,.0f} -> "
+                      f"{d['current_execs_s']:,.0f} execs/s "
                       f"(x{d['ratio']}) [{tag}]", flush=True)
 
     with open(args.output, "w") as f:

@@ -59,6 +59,16 @@ class ActorMethod:
         return ActorMethod(self._handle, self._name, num_returns,
                            concurrency_group)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG authoring against a LIVE actor (cf. reference
+        actor-method ``.bind``): the node targets this handle's existing
+        instance — classic ``execute()`` submits a normal actor task,
+        and ``experimental_compile()`` schedules the method into a
+        compiled graph without creating a new actor."""
+        from ray_tpu.dag.dag_node import ClassMethodNode, ExistingActorNode
+        return ClassMethodNode(ExistingActorNode(self._handle), self._name,
+                               args, kwargs)
+
 
 def _collect_method_opts(cls) -> Dict[str, dict]:
     """Per-method @ray_tpu.method(...) options, harvested from the class at

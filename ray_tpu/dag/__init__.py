@@ -8,7 +8,18 @@ Used by Workflow for durable execution.
 """
 
 from ray_tpu.dag.dag_node import (ClassMethodNode, ClassNode, DAGNode,
-                                  FunctionNode, InputNode)
+                                  ExistingActorNode, FunctionNode, InputNode)
+
+
+def __getattr__(name):
+    # compiled-DAG types import the runtime; load them lazily so plain
+    # graph authoring never pays for it
+    if name in ("CompiledDAG", "CompiledDAGRef"):
+        from ray_tpu.dag import compiled_dag
+        return getattr(compiled_dag, name)
+    raise AttributeError(name)
+
 
 __all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode",
-           "InputNode"]
+           "ExistingActorNode", "InputNode", "CompiledDAG",
+           "CompiledDAGRef"]

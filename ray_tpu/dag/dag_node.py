@@ -49,6 +49,17 @@ class DAGNode:
         input_value = input_values[0] if input_values else None
         return self._execute_recursive({}, input_value)
 
+    def experimental_compile(self, *, max_inflight: int = 2,
+                             buffer_size_bytes: int = 1 << 20,
+                             name: str = ""):
+        """Compile an actor-method-only graph into a ``CompiledDAG``:
+        preallocated shm channels per edge + resident actor loops, so
+        ``execute()`` pays zero per-call task submission (see
+        dag/compiled_dag.py and docs/compiled_dag.md)."""
+        from ray_tpu.dag.compiled_dag import CompiledDAG
+        return CompiledDAG(self, max_inflight=max_inflight,
+                           buffer_size_bytes=buffer_size_bytes, name=name)
+
     def walk(self) -> List["DAGNode"]:
         """All nodes, dependencies first, each once."""
         seen: set = set()
@@ -131,6 +142,19 @@ class _ClassMethodStub:
     def bind(self, *args, **kwargs) -> "ClassMethodNode":
         return ClassMethodNode(self._class_node, self._method_name,
                                args, kwargs)
+
+
+class ExistingActorNode(DAGNode):
+    """A live ActorHandle bound into a DAG (``handle.method.bind(...)``):
+    unlike ClassNode, executing/compiling it never creates an actor —
+    the graph runs against the caller's existing instance."""
+
+    def __init__(self, handle):
+        super().__init__((), {})
+        self._handle = handle
+
+    def _execute_impl(self, cache, input_value):
+        return self._handle
 
 
 class ClassMethodNode(DAGNode):

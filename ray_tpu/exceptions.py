@@ -84,3 +84,28 @@ class SchedulingError(RayTpuError):
     """A scheduling strategy can never be satisfied (placement group
     removed, bundle index out of range, hard affinity to a dead node) —
     permanent, not retried."""
+
+
+class ChannelError(RayTpuError):
+    """Base class for compiled-DAG shared-memory channel errors
+    (experimental/channel.py)."""
+
+
+class ChannelTimeoutError(ChannelError, TimeoutError):
+    """A blocking channel read/write did not complete in time."""
+
+
+class ChannelClosedError(ChannelError):
+    """The channel was poisoned (teardown, or a participant died): no
+    further items will ever arrive, blocked peers must unwind."""
+
+
+class DAGCompileError(RayTpuError):
+    """``experimental_compile()`` rejected the graph (not actor-method
+    only, no/duplicate InputNode, cycle, dead actor, remote actor, ...)."""
+
+
+class DAGUnavailableError(RayTpuError):
+    """A compiled DAG lost a participating actor (or was torn down) and
+    can no longer execute; recompile to get a fresh one — the compiled-
+    graph analog of ObjectLostError."""

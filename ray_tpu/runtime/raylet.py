@@ -1009,9 +1009,16 @@ class Raylet:
                 for f in (out_f, err_f):   # the child holds its own dups
                     if f is not None:
                         f.close()
-        pending = handle.proc
-        handle.proc = proc
-        if getattr(pending, "terminated", False):
+        # the pending->real swap and the terminated-flag check happen
+        # under the SAME lock _kill_worker signals under: without it, a
+        # kill could read the placeholder, lose the race to this swap
+        # (which then reads terminated=False), and mark an orphaned
+        # placeholder — leaking a live worker (TOCTOU)
+        with self._lock:
+            pending = handle.proc
+            handle.proc = proc
+            terminated = getattr(pending, "terminated", False)
+        if terminated:
             # a kill landed while the process was still being spawned:
             # apply it now instead of leaking a live worker
             try:
@@ -1257,19 +1264,26 @@ class Raylet:
                      force: bool = False) -> None:
         with self._lock:
             h = self._workers.get(wid)
-        if h is None:
-            return
-        try:
-            # force=SIGKILL for OOM kills: a SIGTERM trap (or a long native
-            # call) would let the hog survive untracked while the monitor
-            # serially kills innocent workers (reference memory monitor
-            # kills with SIGKILL for the same reason)
-            if force:
-                h.proc.kill()
-            else:
-                h.proc.terminate()
-        except OSError:
-            pass
+            if h is None:
+                return
+            try:
+                # force=SIGKILL for OOM kills: a SIGTERM trap (or a long
+                # native call) would let the hog survive untracked while
+                # the monitor serially kills innocent workers (reference
+                # memory monitor kills with SIGKILL for the same reason).
+                # The read of handle.proc AND the signal both stay under
+                # _lock: signaling a _PendingProc placeholder must be
+                # ordered against _spawn_worker's swap — either the swap
+                # already installed the real proc (we signal it), or our
+                # terminated mark is still on the placeholder when the
+                # spawner checks it under this same lock.  Signals are
+                # non-blocking, so holding the lock here is cheap.
+                if force:
+                    h.proc.kill()
+                else:
+                    h.proc.terminate()
+            except OSError:
+                pass
         self._on_worker_dead(wid, reason)
 
     def _kill_actor_worker(self, actor_id: str) -> None:

@@ -14,6 +14,8 @@ calls in submission order.
 from __future__ import annotations
 
 import argparse
+import asyncio
+import inspect
 import os
 import threading
 import traceback
@@ -154,6 +156,213 @@ class _StreamSession:
                 break
 
 
+class _CompiledDagRunner:
+    """Actor-side resident loop of one compiled DAG (docs/compiled_dag.md).
+
+    Installed by the driver via ``__ray_dag_install__`` (an ordinary
+    actor task over the pooled actor connection).  One daemon thread per
+    (DAG, actor): each iteration it runs this actor's ops in the DAG's
+    topological order — blocking read of every input channel, the bound
+    method, one in-place write of the output channel — so repeated
+    ``execute()`` calls cost ZERO task submissions here.  Error items
+    forward downstream without executing the method; channel poisoning
+    (teardown / worker death at the driver) unwinds the loop."""
+
+    def __init__(self, worker: "WorkerProcess", payload: dict):
+        from ray_tpu.experimental import channel as chan
+        self.worker = worker
+        self.core = worker.core
+        self.dag_id = payload["dag_id"]
+        self.name = payload.get("name", "dag")
+        self.event_cap = int(payload.get("event_cap", 0))
+        self.job_id = payload.get("job_id", "")
+        self._chan_mod = chan
+        self._stop = threading.Event()
+        self._channels: Dict[bytes, Any] = {}
+        self.ops = []
+        try:
+            for desc in payload["ops"]:
+                bound = getattr(worker.actor_instance, desc["method"])
+                self.ops.append({
+                    "method": desc["method"],
+                    "bound": bound,
+                    "reads": [chan.ChannelReader(self._attach(r["id"]),
+                                                 r["reader"])
+                              for r in desc["reads"]],
+                    "writer": chan.ChannelWriter(
+                        self._attach(desc["out"]["id"])),
+                    "args": desc["args"],
+                    "kwargs": desc["kwargs"],
+                })
+        except BaseException:
+            self._release()
+            raise
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"dag-loop-{self.dag_id[:8]}")
+        self._thread.start()
+        if self.job_id:
+            # a driver that dies without teardown() never poisons the
+            # channels: on a detached actor this loop (and its channel
+            # pins) would otherwise outlive the driver forever.  Watch
+            # the driver's GCS job record and unwind when it finishes —
+            # the channel waits honor _stop at every poison-check tick.
+            self._watchdog = threading.Thread(
+                target=self._watch_driver, daemon=True,
+                name=f"dag-watch-{self.dag_id[:8]}")
+            self._watchdog.start()
+
+    _DRIVER_POLL_S = 10.0
+
+    def _watch_driver(self) -> None:
+        while not self._stop.wait(self._DRIVER_POLL_S):
+            try:
+                jobs = self.core.gcs.call("list_jobs", {}, timeout=5)
+            except Exception:
+                continue        # GCS hiccup: not a death verdict
+            state = next((j.get("state") for j in jobs
+                          if j.get("job_id") == self.job_id), None)
+            if state is not None and state != "RUNNING":
+                for ch in self._channels.values():
+                    try:
+                        ch.poison(self._chan_mod.POISON_WORKER_DIED)
+                    except Exception:
+                        pass
+                self._stop.set()
+                return
+
+    def _attach(self, oid_bytes: bytes):
+        ch = self._channels.get(oid_bytes)
+        if ch is None:
+            ch = self._chan_mod.Channel.attach(
+                self.core.store, ObjectID(oid_bytes), timeout=10.0)
+            self._channels[oid_bytes] = ch
+        return ch
+
+    def _release(self) -> None:
+        for ch in self._channels.values():
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+    def _loop(self) -> None:
+        from ray_tpu.exceptions import ChannelError
+        idx = 0
+        try:
+            while not self._stop.is_set():
+                for op in self.ops:
+                    self._run_op(op, idx)
+                idx += 1
+        except ChannelError:
+            pass        # poisoned (teardown / participant death): unwind
+        except Exception:
+            logger.exception("compiled DAG %s loop failed", self.dag_id[:8])
+            # the loop dying with the actor still ALIVE is invisible to
+            # the driver's liveness poll: poison every attached channel
+            # so blocked peers unwind with DAGUnavailableError instead
+            # of hanging forever
+            for ch in self._channels.values():
+                try:
+                    ch.poison(self._chan_mod.POISON_WORKER_DIED)
+                except Exception:
+                    pass
+        finally:
+            self._release()
+            # self-remove so an unwound loop (driver death, poison, or
+            # crash) doesn't leave a dead entry; _dag_teardown pops
+            # before calling shutdown(), so this is a no-op there
+            with self.worker._dag_lock:
+                if self.worker._dag_runners.get(self.dag_id) is self:
+                    del self.worker._dag_runners[self.dag_id]
+
+    def _record(self, idx: int, state: str, method: str, **extra) -> None:
+        if idx >= self.event_cap:
+            return
+        from ray_tpu.dag.compiled_dag import _exec_task_id, _exec_trace_id
+        self.core.events.record(
+            _exec_task_id(self.dag_id, idx), state,
+            name=f"dag:{self.name}:{method}",
+            trace_id=_exec_trace_id(self.dag_id, idx), **extra)
+
+    def _run_op(self, op, idx: int) -> None:
+        chan = self._chan_mod
+        raw = [r.read_raw(stop=self._stop) for r in op["reads"]]
+        err_payload = next((p for p, f in raw if f & chan.FLAG_ERROR), None)
+        if err_payload is not None:
+            # an upstream stage failed this execution: forward ITS error
+            # unchanged (mirrors the TaskError propagation semantics of
+            # the classic task chain) and skip the method
+            op["writer"].write_raw(err_payload, chan.FLAG_ERROR,
+                                   stop=self._stop)
+            return
+        self._record(idx, "RUNNING", op["method"])
+        t_exec = rtm.now()
+        try:
+            values = [ser.deserialize(p) for p, _f in raw]
+            args = [values[d["i"]] if d["t"] == "read" else d["v"]
+                    for d in op["args"]]
+            kwargs = {k: (values[d["i"]] if d["t"] == "read" else d["v"])
+                      for k, d in op["kwargs"].items()}
+            aloop = self.worker._actor_event_loop
+            if aloop is not None:
+                # async actor: run the whole call on the actor's event
+                # loop (awaiting coroutine results there), so DAG ops
+                # interleave with classic calls under the actor's normal
+                # asyncio serialization instead of racing them
+                async def _call():
+                    r = op["bound"](*args, **kwargs)
+                    if inspect.isawaitable(r):
+                        r = await r
+                    return r
+
+                result = asyncio.run_coroutine_threadsafe(
+                    _call(), aloop).result()
+            else:
+                # sync actor: share the worker's method mutex with the
+                # classic sequential path so actor state never sees two
+                # concurrent method frames (threaded concurrency-group
+                # actors already opted out of that guarantee)
+                with self.worker._method_mutex:
+                    result = op["bound"](*args, **kwargs)
+                if inspect.isawaitable(result):
+                    result = asyncio.run(result)
+        except Exception as e:  # noqa: BLE001 - user errors cross the graph
+            _M_EXEC.observe_since(op["method"], t_exec)
+            err = e if isinstance(e, exc.TaskError) else exc.TaskError(
+                op["method"], e, traceback.format_exc())
+            head, views = ser.serialize(err, error_type=ser.ERROR_TASK)
+            op["writer"].write_payload(head, views, flags=chan.FLAG_ERROR,
+                                       stop=self._stop)
+            self._record(idx, "FAILED", op["method"],
+                         error_type=type(e).__name__)
+            return
+        _M_EXEC.observe_since(op["method"], t_exec)
+        try:
+            op["writer"].write(result, stop=self._stop)
+        except exc.ChannelError:
+            raise               # poison/teardown: unwind the loop
+        except Exception as e:  # noqa: BLE001
+            # a result that cannot be serialized (or exceeds the slot
+            # capacity) must become an error ITEM, not kill the loop —
+            # the driver is owed exactly one output per execution
+            err = exc.TaskError(op["method"], e, traceback.format_exc())
+            head, views = ser.serialize(err, error_type=ser.ERROR_TASK)
+            op["writer"].write_payload(head, views, flags=chan.FLAG_ERROR,
+                                       stop=self._stop)
+            self._record(idx, "FAILED", op["method"],
+                         error_type=type(e).__name__)
+            return
+        self._record(idx, "FINISHED", op["method"])
+
+    def shutdown(self) -> None:
+        """Teardown: the driver has already poisoned the channels, so a
+        blocked read/write is waking up; stop, join, release pins."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._release()
+
+
 class WorkerProcess:
     def __init__(self, args):
         self.worker_id = WorkerID.from_hex(args.worker_id)
@@ -193,6 +402,14 @@ class WorkerProcess:
         self._group_caps: Dict[str, int] = {}
         self._group_sems: Dict[str, Any] = {}   # async: per-group Semaphore
         self._group_pools: Optional[Dict[str, Any]] = None  # threaded
+        # resident compiled-DAG loops installed on this actor
+        # (docs/compiled_dag.md): dag_id -> _CompiledDagRunner
+        self._dag_runners: Dict[str, _CompiledDagRunner] = {}
+        self._dag_lock = threading.Lock()
+        # serializes method frames between the classic sequential path
+        # and resident DAG loop threads (RLock: a method that calls back
+        # into itself via the same thread must not self-deadlock)
+        self._method_mutex = threading.RLock()
         # per caller-stream ordered queues (ActorSchedulingQueue analog):
         # {stream_id: {"next": int, "buf": {seq: work}}}
         self._actor_streams: Dict[str, Dict[str, Any]] = {}
@@ -744,6 +961,9 @@ class WorkerProcess:
             if spec["method"] == "__ray_terminate__":
                 import os
                 os._exit(0)
+            dag_reply = self._maybe_dag_control(spec, args)
+            if dag_reply is not None:
+                return dag_reply
             import inspect
             method = getattr(self.actor_instance, spec["method"])
             t_exec = rtm.now()
@@ -768,6 +988,37 @@ class WorkerProcess:
             propagate_trace_context(None)
             self.core.release_borrowed(borrowed)
 
+    # ------------------------------------------------- compiled DAG loops
+    def _dag_install(self, p: dict) -> dict:
+        """``__ray_dag_install__``: start this actor's resident loop for
+        one compiled DAG (rides the ordinary pooled actor-task path)."""
+        with self._dag_lock:
+            if p["dag_id"] in self._dag_runners:
+                raise exc.RayTpuError(
+                    f"compiled DAG {p['dag_id'][:8]} is already installed "
+                    f"on this actor")
+            runner = _CompiledDagRunner(self, p)
+            self._dag_runners[p["dag_id"]] = runner
+        return {"ok": True, "ops": len(runner.ops)}
+
+    def _dag_teardown(self, p: dict) -> dict:
+        """``__ray_dag_teardown__``: stop the loop and drop its pins
+        (the driver poisoned the channels before calling this)."""
+        with self._dag_lock:
+            runner = self._dag_runners.pop(p["dag_id"], None)
+        if runner is not None:
+            runner.shutdown()
+        return {"ok": True}
+
+    def _maybe_dag_control(self, spec, args) -> Optional[dict]:
+        """Compiled-DAG control methods shared by the sync and async
+        actor execution paths; returns a reply dict or None."""
+        if spec["method"] == "__ray_dag_install__":
+            return self._package_results(spec, self._dag_install(args[0]))
+        if spec["method"] == "__ray_dag_teardown__":
+            return self._package_results(spec, self._dag_teardown(args[0]))
+        return None
+
     def _execute_actor(self, spec) -> dict:
         from ray_tpu.util.tracing.tracing_helper import \
             propagate_trace_context
@@ -781,8 +1032,21 @@ class WorkerProcess:
             if spec["method"] == "__ray_terminate__":
                 import os
                 os._exit(0)
+            dag_reply = self._maybe_dag_control(spec, args)
+            if dag_reply is not None:
+                return dag_reply
             method = getattr(self.actor_instance, spec["method"])
             t_exec = rtm.now()
+            if self._group_pools is None:
+                # sequential actor: resident compiled-DAG loops share
+                # this mutex, so actor state never sees two concurrent
+                # method frames; threaded concurrency-group actors opted
+                # out of that guarantee and skip it.  _package_results
+                # stays INSIDE the mutex: a streaming generator's body
+                # runs lazily in there and is still this method's frame.
+                with self._method_mutex:
+                    result = method(*args, **kwargs)
+                    return self._package_results(spec, result)
             result = method(*args, **kwargs)
             return self._package_results(spec, result)
         except Exception as e:  # noqa: BLE001

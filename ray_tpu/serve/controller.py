@@ -210,10 +210,19 @@ class ServeController:
             # bound on demand: upscaling on it is safe (e.g. a new
             # replica still compiling must not freeze a burst response),
             # but a phantom downscale would kill real work — suppressed.
+            # The policy sees ONLY non-draining replicas (count and
+            # ongoing): draining replicas take no new traffic, so their
+            # near-zero ongoing would dilute the per-replica average and
+            # suppress a needed upscale, while their finishing tails
+            # would inflate demand and flap a scale-down back up.
             auto = config.get("autoscaling_config")
             if auto and healthy_current:
-                new_target = self._autoscale(name, auto, total_ongoing,
-                                             len(healthy_current), target)
+                serving = [t for t in healthy_current
+                           if not replicas[t].get("draining")]
+                serving_ongoing = sum(
+                    replicas[t].get("last_ongoing", 0.0) for t in serving)
+                new_target = self._autoscale(name, auto, serving_ongoing,
+                                             len(serving), target)
                 if new_target > target or not metrics_partial:
                     target = new_target
 
@@ -326,7 +335,12 @@ class ServeController:
     def _autoscale(self, name: str, auto: Dict[str, Any], total_ongoing:
                    float, num_replicas: int, target: int) -> int:
         """Queue-depth policy, cf. reference
-        serve/_private/autoscaling_policy.py (calculate_desired_num_replicas).
+        serve/_private/autoscaling_policy.py (calculate_desired_num_replicas):
+        ``desired = num_replicas * (avg_ongoing / target_per_replica)``.
+
+        ``num_replicas`` and ``total_ongoing`` MUST cover the same set —
+        the NON-draining replicas (the caller filters) — or the average
+        is diluted/inflated by replicas that take no new traffic.
         """
         desired = math.ceil(
             total_ongoing /

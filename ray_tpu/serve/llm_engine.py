@@ -193,11 +193,19 @@ class LLMEngine:
         if paged:
             self.page_size = page_size
             self.max_pages = -(-cfg.max_seq_len // page_size)
-            # page 0 is the scratch page (zeroed tables point there);
-            # default pool: 4x the slots' worst case, so the ready queue
-            # can prefill well ahead of slot turnover
+            # page 0 is the scratch page (zeroed tables point there).
+            # Default pool: HBM PARITY with the dense cache — the dense
+            # engine allocates (num_slots + 1) full-length rows (the +1
+            # is the scratch row), i.e. (num_slots + 1) * max_pages
+            # page-equivalents, so flipping paged=True on a deployment
+            # that fit in dense mode can never OOM it.  The old default
+            # (4 * num_slots * max_pages) allocated ~4x the dense
+            # cache's HBM for prefill-ahead headroom; deployments that
+            # want the ready queue to prefill well ahead of slot
+            # turnover should pass kv_pool_pages explicitly (e.g.
+            # benchmarks/serve_llm.py sizes it per request load).
             self.kv_pool_pages = (kv_pool_pages if kv_pool_pages
-                                  else 1 + 4 * num_slots * self.max_pages)
+                                  else 1 + (num_slots + 1) * self.max_pages)
             self.model = GPT(cfg, decode=True,
                              paged_pages=self.kv_pool_pages,
                              page_size=page_size)
@@ -464,7 +472,29 @@ class LLMEngine:
                         for _ in range(burst)]
                 await asyncio.gather(*futs)
 
-            asyncio.run(_burst())
+            # mirror submit()'s loop-aware dual path: asyncio.run()
+            # raises inside a running event loop (an async serve replica
+            # warming up from a coroutine), so drive the burst from a
+            # helper thread that owns its own loop instead
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                asyncio.run(_burst())
+            else:
+                out: dict = {}
+
+                def _runner():
+                    try:
+                        asyncio.run(_burst())
+                    except BaseException as e:  # noqa: BLE001
+                        out["err"] = e
+
+                t = threading.Thread(target=_runner,
+                                     name="llm-warmup-burst")
+                t.start()
+                t.join()
+                if "err" in out:
+                    raise out["err"]
 
     def submit(self, prompt: List[int], *, max_new_tokens: int = 32,
                temperature: float = 0.0, eos_id: Optional[int] = None,
