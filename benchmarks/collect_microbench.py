@@ -69,6 +69,15 @@ SECTIONS = {
                               os.path.join(REPO, "benchmarks",
                                            "compiled_dag_perf.py")],
                          timeout=600),
+    # bulk data plane (docs/object_transfer.md): interleaved same-box A/B
+    # of a 64 MiB cross-node pull — pipelined zero-copy engine vs the
+    # legacy serial algorithm (>=3x bar), striped 2-source vs 1 (>1x
+    # bar), shm growth == object size (zero-copy bar), and the
+    # prefetch-overlap task-e2e saving
+    "object_transfer": dict(cmd=[sys.executable,
+                                 os.path.join(REPO, "benchmarks",
+                                              "object_transfer_perf.py")],
+                            timeout=900),
     # always-on runtime telemetry cost guard (docs/observability.md):
     # interleaved same-box A/B of task throughput with
     # RAY_TPU_TELEMETRY=0 vs 1; the overhead_pct row is the <=3% bar
@@ -115,6 +124,13 @@ _STREAMING_ROWS = {
 # per-execute rate must stay visible the same way.
 _COMPILED_DAG_ROWS = {
     "compiled_dag 3-stage": "compiled_dag_execs_s",
+}
+
+# Object-transfer rows (docs/object_transfer.md): the data plane's pull
+# bandwidth must stay visible the same way (mb_per_s rows).
+_OBJECT_TRANSFER_ROWS = {
+    "pull 64MiB pipelined": "pull_pipelined_mb_s",
+    "pull 64MiB striped 2-source busy hosts": "pull_striped_mb_s",
 }
 
 
@@ -185,6 +201,27 @@ def compiled_dag_deltas(rows, committed):
             continue
         prev, cur = base[row["name"]], row["ops_per_s"]
         out[key] = {"committed_execs_s": prev, "current_execs_s": cur,
+                    "ratio": round(cur / prev, 3)}
+    return out
+
+
+def object_transfer_deltas(rows, committed):
+    """Same contract for the object-transfer section's bandwidth rows."""
+    if not committed:
+        return {}
+    base = {r["name"]: r.get("mb_per_s")
+            for r in committed.get("object_transfer", [])
+            if isinstance(r, dict)}
+    out = {}
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        key = _OBJECT_TRANSFER_ROWS.get(row.get("name"))
+        if key is None or not base.get(row["name"]) \
+                or not row.get("mb_per_s"):
+            continue
+        prev, cur = base[row["name"]], row["mb_per_s"]
+        out[key] = {"committed_mb_s": prev, "current_mb_s": cur,
                     "ratio": round(cur / prev, 3)}
     return out
 
@@ -284,7 +321,8 @@ def main():
     merge_preserve(out, prev, regenerated)
 
     committed = None
-    if regenerated & {"core", "streaming", "compiled_dag"}:
+    if regenerated & {"core", "streaming", "compiled_dag",
+                      "object_transfer"}:
         committed = _committed_baseline(args.output)
     if "core" in regenerated:
         deltas = control_plane_deltas(out["core"], committed)
@@ -312,6 +350,15 @@ def main():
                 tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
                 print(f"[collect] {key}: {d['committed_execs_s']:,.0f} -> "
                       f"{d['current_execs_s']:,.0f} execs/s "
+                      f"(x{d['ratio']}) [{tag}]", flush=True)
+    if "object_transfer" in regenerated:
+        deltas = object_transfer_deltas(out["object_transfer"], committed)
+        if deltas:
+            out["object_transfer_deltas"] = deltas
+            for key, d in deltas.items():
+                tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
+                print(f"[collect] {key}: {d['committed_mb_s']:,.0f} -> "
+                      f"{d['current_mb_s']:,.0f} MB/s "
                       f"(x{d['ratio']}) [{tag}]", flush=True)
 
     with open(args.output, "w") as f:

@@ -3,8 +3,11 @@
 // Wire-compatible with ray_tpu/_private/rpc.py — framed pickled 4-tuples
 // (kind, msg_id, a, b) over TCP, full duplex: either side can issue
 // requests; responses are matched by msg_id.  Frame layout (see
-// docs/rpc_fastpath.md):
-//   u32 pickle_len | u32 nbufs | nbufs * u64 buf_len | pickle | bufs
+// docs/rpc_fastpath.md; kind/msg_id are duplicated in the header so the
+// Python reader can route out-of-band buffers to a registered sink
+// before unpickling — docs/object_transfer.md):
+//   u32 pickle_len | u32 nbufs | u8 kind | u64 msg_id
+//   | nbufs * u64 buf_len | pickle | bufs
 // The C++ side always sends nbufs == 0 (pycodec pickles everything in
 // band); inbound out-of-band buffers (protocol-5 numpy payloads) are not
 // representable in pycodec, so such frames drop the connection — they
@@ -156,13 +159,18 @@ class Conn {
     std::string err;
   };
 
+  static const size_t kHdrSize = 17;  // <IIBQ>, packed little-endian
+
   void send_frame(const PyVal& frame) {
     std::string data = pycodec::pickle_dumps(frame);
-    char hdr[8];
+    char hdr[kHdrSize];
     uint32_t n = (uint32_t)data.size();
+    uint64_t id = (uint64_t)frame.items[1].i;
     for (int j = 0; j < 4; ++j) hdr[j] = (char)(n >> (8 * j));
     for (int j = 4; j < 8; ++j) hdr[j] = 0;  // nbufs == 0: all in band
-    std::string buf(hdr, 8);
+    hdr[8] = (char)frame.items[0].i;         // kind
+    for (int j = 0; j < 8; ++j) hdr[9 + j] = (char)(id >> (8 * j));
+    std::string buf(hdr, kHdrSize);
     buf += data;
     try {
       detail::send_all(fd_, buf.data(), buf.size(), wlock_);
@@ -181,10 +189,13 @@ class Conn {
 
   void read_loop() {
     for (;;) {
-      char hdr[8];
-      if (!detail::recv_all(fd_, hdr, 8)) break;
+      char hdr[kHdrSize];
+      if (!detail::recv_all(fd_, hdr, kHdrSize)) break;
       uint32_t n = le32(hdr);
       uint32_t nbufs = le32(hdr + 4);
+      // hdr[8] (kind) and hdr[9..16] (msg_id) duplicate the pickled
+      // tuple; the C++ side has no buffer sinks, so routing still uses
+      // the tuple below
       if (n > (1u << 30) || nbufs > 0) {
         // out-of-band buffers are unrepresentable in pycodec (and never
         // sent on cpp-bound traffic); oversized headers mean a protocol

@@ -209,6 +209,32 @@ _declare("pull_memory_cap_bytes", int, 512 * 1024**2,
          "Admission cap on the total bytes of concurrently in-flight remote "
          "object pulls per process (reference PullManager's bounded pull "
          "quota, pull_manager.h:52); pulls beyond it queue FIFO.")
+_declare("object_pull_window", int, 8,
+         "Pipelined pull depth: chunk requests kept in flight per source "
+         "(a striped pull keeps up to window * nsources in flight).  1 "
+         "restores one-chunk-per-RTT serial pulls (the A/B baseline in "
+         "benchmarks/object_transfer_perf.py); docs/object_transfer.md.")
+_declare("object_pull_max_sources", int, 4,
+         "Max nodes a single pull stripes chunk ranges across when the "
+         "location set holds multiple live copies.  1 disables striping.")
+_declare("locality_aware_scheduling", bool, True,
+         "Weigh argument bytes already local when placing leases: a "
+         "lease request carrying argument locations may be redirected to "
+         "the feasible node holding the most argument bytes (reference "
+         "locality-aware lease policy, locality_data_provider).")
+_declare("locality_min_arg_bytes", int, 1024 * 1024,
+         "Arguments at least this size participate in locality-aware "
+         "placement and raylet-side prefetch; below it transfer cost is "
+         "noise next to lease latency.")
+_declare("object_prefetch_enabled", bool, True,
+         "Raylet-side argument prefetch: a granted lease request's "
+         "missing large arguments start pulling into local shm "
+         "concurrently with worker lease/startup, so transfer overlaps "
+         "scheduling instead of serializing after it.")
+_declare("prefetch_pin_ttl_s", float, 60.0,
+         "Safety-net lifetime of raylet prefetch pins: pins not released "
+         "by their lease's return (e.g. the lease request timed out or "
+         "the task was cancelled before dispatch) drop after this long.")
 _declare("log_to_driver", bool, True, "Forward worker stdout/stderr to the driver.")
 _declare("event_stats", bool, False, "Record per-handler event-loop stats.")
 _declare("task_events_buffer_size", int, 10000,

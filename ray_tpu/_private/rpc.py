@@ -32,6 +32,12 @@ Fast path (docs/rpc_fastpath.md):
 * **recv_into framing** — the reader receives headers and pickle bodies
   into one reusable growable buffer instead of recv()+join allocations;
   out-of-band buffers land in fresh buffers (objects may keep views).
+* **Stable frames** (docs/object_transfer.md) — a sender that guarantees
+  a frame's out-of-band buffers stay immutable until written (sealed shm
+  slices) passes ``stable=True`` + an ``on_sent`` hook: the write queue
+  skips the defensive copy queued frames normally pay and fires the hook
+  exactly once when the frame drains (or is dropped on failure), so the
+  raylet's chunk server holds its shm pin only for the write's lifetime.
 """
 
 from __future__ import annotations
@@ -116,13 +122,18 @@ def _maybe_fuzz() -> None:
 
 
 # wire format: one frame is
-#   <II>  (pickle_len, nbufs)
+#   <IIBQ>  (pickle_len, nbufs, kind, msg_id)
 #   nbufs * <Q>  out-of-band buffer lengths
 #   pickle body (protocol 5)
 #   out-of-band buffers, concatenated
-# All peers are in-repo daemons spawned from the same tree, so the format
-# needs no version negotiation.
-_HDR = struct.Struct("<II")
+# kind/msg_id ride the fixed header (duplicating the pickled tuple) so
+# the reader can route a response's out-of-band buffers to a registered
+# buffer sink BEFORE unpickling — the bulk-data pull path receives chunk
+# payloads straight into their shm destination offsets with recv_into
+# (docs/object_transfer.md), no per-chunk allocation or copy.
+# All peers are in-repo daemons spawned from the same tree (csrc/rpcnet.h
+# is the C++ twin), so the format needs no version negotiation.
+_HDR = struct.Struct("<IIBQ")
 _BLEN = struct.Struct("<Q")
 _REQUEST, _RESPONSE, _PUSH = 0, 1, 2
 
@@ -163,7 +174,15 @@ class Deferred:
     This removes the parked-thread pattern (handler blocks on an Event a
     worker loop sets, then wakes just to return) — on a contended box
     that wake-to-reply hop is a full context switch per RPC.  Resolution
-    and binding race safely: whichever happens second sends the reply."""
+    and binding race safely: whichever happens second sends the reply.
+
+    ``resolve(value, stable=True, on_sent=cb)`` marks the reply frame's
+    out-of-band buffers as immutable-until-sent: the write queue ships
+    them zero-copy (no defensive materialization) and invokes ``cb``
+    exactly once after the frame drains to the socket — or is dropped by
+    a connection failure.  The raylet's chunk server uses this to pin a
+    shm slice only for the lifetime of the write
+    (docs/object_transfer.md)."""
 
     _UNSET = object()
     __slots__ = ("_lock", "_conn", "_msg_id", "_result")
@@ -179,22 +198,37 @@ class Deferred:
             self._conn, self._msg_id = conn, msg_id
             result = self._result
         if result is not Deferred._UNSET:
-            conn._respond(msg_id, result[0], result[1])
+            ok, value, stable, on_sent = result
+            conn._respond(msg_id, ok, value, stable=stable,
+                          on_sent=on_sent)
 
-    def resolve(self, value: Any) -> None:
-        self._finish(True, value)
+    def resolve(self, value: Any, *, stable: bool = False,
+                on_sent: Optional[Callable[[], None]] = None) -> None:
+        self._finish(True, value, stable, on_sent)
 
     def fail(self, error: BaseException) -> None:
-        self._finish(False, error)
+        self._finish(False, error, False, None)
 
-    def _finish(self, ok: bool, value: Any) -> None:
+    def _finish(self, ok: bool, value: Any, stable: bool,
+                on_sent: Optional[Callable[[], None]]) -> None:
         with self._lock:
             if self._result is not Deferred._UNSET:
-                return  # already resolved
-            self._result = (ok, value)
+                if on_sent is not None:
+                    _run_cb(on_sent)  # double-resolve must not leak pins
+                return
+            self._result = (ok, value, stable, on_sent)
             conn, msg_id = self._conn, self._msg_id
         if conn is not None:
-            conn._respond(msg_id, ok, value)
+            conn._respond(msg_id, ok, value, stable=stable, on_sent=on_sent)
+
+
+def _run_cb(cb: Optional[Callable[[], None]]) -> None:
+    if cb is None:
+        return
+    try:
+        cb()
+    except Exception:
+        logger.exception("rpc on_sent callback failed")
 
 
 # ---------------------------------------------------------------- dispatch
@@ -231,7 +265,8 @@ def _dispatch_pool() -> ThreadPoolExecutor:
 
 # ---------------------------------------------------------------- framing
 def _encode_frame(obj: Any) -> list:
-    """Pickle ``obj`` into an iovec [header, lentable?, body, *buffers].
+    """Pickle the (kind, msg_id, a, b) tuple ``obj`` into an iovec
+    [header, lentable?, body, *buffers].
 
     Protocol-5 ``buffer_callback`` keeps large contiguous buffers (numpy
     arrays, PickleBuffer-wrapped blobs) out of the pickle stream: they
@@ -254,7 +289,7 @@ def _encode_frame(obj: Any) -> list:
         raise ValueError(
             f"rpc frame exceeds {_BODY_MAX} bytes; move bulk data "
             f"through the object store")
-    iov = [_HDR.pack(len(body), len(raws))]
+    iov = [_HDR.pack(len(body), len(raws), obj[0], obj[1] or 0)]
     if raws:
         iov.append(b"".join(_BLEN.pack(len(r)) for r in raws))
     iov.append(body)
@@ -321,6 +356,15 @@ class Connection:
         self._ids = itertools.count(1)
         self._inflight: Dict[int, Future] = {}
         self._inflight_lock = threading.Lock()
+        # buffer sinks (docs/object_transfer.md): msg_id -> f(lens) that
+        # may hand the reader destination memoryviews for a response's
+        # out-of-band buffers (recv_into shm, zero-copy).  _sink_active
+        # marks the one the reader is currently receiving into so
+        # discard_sinks can wait it out before its memory is released.
+        self._sinks: Dict[int, Callable] = {}
+        self._sink_lock = threading.Lock()
+        self._sink_cv = threading.Condition(self._sink_lock)
+        self._sink_active: Optional[int] = None
         self._closed = threading.Event()
         # write-side frame queue: the first writer in becomes the flusher
         # and drains everything queued behind it in coalesced sendmsg
@@ -335,47 +379,73 @@ class Connection:
         self._reader.start()
 
     # ------------------------------------------------------------------ send
-    def _send(self, obj: Any) -> None:
+    def _send(self, obj: Any, stable: bool = False,
+              on_sent: Optional[Callable[[], None]] = None) -> None:
         """Enqueue one frame and flush opportunistically.
 
         If another thread is mid-flush it picks our frame up before it
         releases the socket, so back-to-back frames from concurrent
         writers coalesce into one ``sendmsg``.  Send failures close the
         connection; writers whose frames were queued behind a failed
-        flush observe it through their futures (close() fails them)."""
-        iov = _encode_frame(obj)  # may raise (unpicklable payload)
+        flush observe it through their futures (close() fails them).
+
+        ``stable=True`` promises the frame's buffers stay immutable until
+        ``on_sent`` fires, so a queued frame keeps its zero-copy views
+        instead of being defensively materialized — the bulk-data path's
+        contract (shm slices of sealed objects).  ``on_sent`` runs
+        exactly once: after the frame hits the socket, or when it is
+        dropped by a failed flush / close()."""
+        try:
+            iov = _encode_frame(obj)  # may raise (unpicklable payload)
+        except BaseException:
+            _run_cb(on_sent)  # frame never enqueued: release pins here
+            raise
         with self._wq_lock:
             while (len(self._wq) >= _WQ_CAP and self._flushing
                    and not self._closed.is_set()):
                 self._wq_cv.wait(1.0)
             if self._closed.is_set():
+                _run_cb(on_sent)
                 raise ConnectionError("connection closed")
-            self._wq.append(iov)
+            self._wq.append((iov, on_sent))
             _M_WQ_DEPTH.set_max(len(self._wq))
             if self._flushing:
-                # the active flusher will send this frame after we return;
-                # materialize zero-copy views — the caller may mutate the
-                # backing buffer once its call returns
-                iov[:] = [b if isinstance(b, bytes) else bytes(b)
-                          for b in iov]
+                if not stable:
+                    # the active flusher sends this frame after we return;
+                    # materialize zero-copy views — the caller may mutate
+                    # the backing buffer once its call returns
+                    iov[:] = [b if isinstance(b, bytes) else bytes(b)
+                              for b in iov]
                 return
             self._flushing = True
         self._flush()
+
+    def _drain_wq_locked(self) -> list:
+        """_wq_lock held: clear the queue, returning its on_sent hooks."""
+        cbs = [cb for _iov, cb in self._wq if cb is not None]
+        self._wq.clear()
+        self._wq_cv.notify_all()
+        return cbs
 
     def _flush(self) -> None:
         while True:
             with self._wq_lock:
                 if not self._wq or self._closed.is_set():
                     self._flushing = False
-                    self._wq.clear()
-                    self._wq_cv.notify_all()
+                    dropped = self._drain_wq_locked()
                     if self._closed.is_set():
+                        for cb in dropped:
+                            _run_cb(cb)
                         raise ConnectionError("connection closed")
                     return
                 batch: list = []
+                sent_cbs: list = []
                 nframes = 0
                 while self._wq and len(batch) < _IOV_BATCH:
-                    batch.extend(self._wq.popleft())
+                    iov, cb = self._wq.popleft()
+                    batch.extend(iov)
+                    if cb is not None:
+                        sent_cbs.append(cb)
                     nframes += 1
                 self._wq_cv.notify_all()
             if _TELEMETRY:
@@ -394,10 +464,13 @@ class Connection:
                 # future (pushes are fire-and-forget anyway) and wakes
                 # cap-waiters.
                 with self._wq_lock:
-                    self._wq.clear()
-                    self._wq_cv.notify_all()
+                    dropped = self._drain_wq_locked()
+                for cb in itertools.chain(sent_cbs, dropped):
+                    _run_cb(cb)
                 self.close()
                 raise
+            for cb in sent_cbs:
+                _run_cb(cb)
 
     def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
         fut = self.call_async(method, payload)
@@ -414,9 +487,17 @@ class Connection:
             if msg_id is not None:
                 with self._inflight_lock:
                     self._inflight.pop(msg_id, None)
+                self.discard_sinks((msg_id,))
             raise
 
-    def call_async(self, method: str, payload: Any = None) -> Future:
+    def call_async(self, method: str, payload: Any = None,
+                   buffer_sink: Optional[Callable] = None) -> Future:
+        """``buffer_sink``: optional ``f(lens) -> list[memoryview] |
+        None`` consulted when this call's response arrives carrying
+        out-of-band buffers — returning destination views makes the
+        reader ``recv_into`` them directly (the pull engine passes shm
+        offsets).  The caller promises the views stay writable until the
+        future resolves or ``discard_sinks`` returns."""
         fut: Future = Future()
         msg_id = next(self._ids)
         fut._rpc_msg_id = msg_id  # used by call() to reap timed-out futures
@@ -425,19 +506,64 @@ class Connection:
                 fut.set_exception(ConnectionError("connection closed"))
                 return fut
             self._inflight[msg_id] = fut
+        if buffer_sink is not None:
+            with self._sink_lock:
+                self._sinks[msg_id] = buffer_sink
         try:
             self._send((_REQUEST, msg_id, method, payload))
         except OSError as e:
-            with self._inflight_lock:
-                self._inflight.pop(msg_id, None)
+            self._reap_failed_send(msg_id)
             if not fut.done():  # close() may have failed it concurrently
                 fut.set_exception(ConnectionError(str(e)))
         except Exception as e:  # e.g. unpicklable payload
-            with self._inflight_lock:
-                self._inflight.pop(msg_id, None)
+            self._reap_failed_send(msg_id)
             if not fut.done():
                 fut.set_exception(e)
         return fut
+
+    def _reap_failed_send(self, msg_id: int) -> None:
+        with self._inflight_lock:
+            self._inflight.pop(msg_id, None)
+        with self._sink_lock:
+            self._sinks.pop(msg_id, None)
+
+    def abandon(self, msg_ids: Iterable[int],
+                timeout: float = 2.0) -> None:
+        """Give up on outstanding ``call_async`` futures: drop their
+        ``_inflight`` entries (a late response is discarded instead of
+        delivered, and the map can't grow unbounded on a pooled
+        connection to a wedged-but-alive peer) and withdraw their buffer
+        sinks (``discard_sinks``)."""
+        ids = list(msg_ids)
+        with self._inflight_lock:
+            for m in ids:
+                self._inflight.pop(m, None)
+        self.discard_sinks(ids, timeout)
+
+    def discard_sinks(self, msg_ids: Iterable[int],
+                      timeout: float = 2.0) -> None:
+        """Withdraw buffer sinks: after this returns the reader will
+        never again touch their destination views, so the caller may
+        release the backing memory (abort a partially pulled shm
+        create).  If the reader is mid-``recv_into`` one of them and
+        doesn't finish within ``timeout`` (peer wedged mid-frame), the
+        connection is closed — the shutdown unblocks the recv."""
+        ids = set(msg_ids)
+        if not ids:
+            return
+        with self._sink_lock:
+            for m in ids:
+                self._sinks.pop(m, None)
+            deadline = time.monotonic() + timeout
+            while self._sink_active in ids \
+                    and time.monotonic() < deadline:
+                self._sink_cv.wait(max(0.01,
+                                       deadline - time.monotonic()))
+        if self._sink_active in ids:
+            self.close()  # unblocks the wedged recv; reader clears active
+            with self._sink_lock:
+                while self._sink_active in ids:
+                    self._sink_cv.wait(1.0)
 
     def push(self, method: str, payload: Any = None) -> None:
         """Fire-and-forget message (pubsub notifications, log batches).
@@ -451,6 +577,38 @@ class Connection:
             raise ConnectionError(str(e)) from e
 
     # ------------------------------------------------------------------ recv
+    def _take_sink(self, msg_id: int, lens: list) -> Optional[list]:
+        """Reader side: destination views for this response's buffers if
+        a sink is registered and accepts them; marks the sink active so
+        discard_sinks won't let the memory go while we recv into it."""
+        with self._sink_lock:
+            sink = self._sinks.pop(msg_id, None)
+            if sink is None:
+                return None
+            try:
+                dests = sink(lens)
+            except Exception:
+                logger.exception("buffer sink failed")
+                dests = None
+            if dests is not None and (
+                    len(dests) != len(lens)
+                    or any(len(d) != n for d, n in zip(dests, lens))):
+                # a miscounted/missized sink would desync the frame
+                # stream and tear down the shared connection — fall back
+                # to fresh storage instead of trusting it
+                logger.error("buffer sink returned wrong shapes for "
+                             "msg %d; ignoring it", msg_id)
+                dests = None
+            if dests is not None:
+                self._sink_active = msg_id
+            return dests
+
+    def _sink_done(self) -> None:
+        if self._sink_active is not None:
+            with self._sink_lock:
+                self._sink_active = None
+                self._sink_cv.notify_all()
+
     def _read_loop(self) -> None:
         sock = self._sock
         scratch = bytearray(64 * 1024)
@@ -458,13 +616,14 @@ class Connection:
             while True:
                 view = memoryview(scratch)
                 _recv_exact_into(sock, view, _HDR.size)
-                body_len, nbufs = _HDR.unpack_from(view)
+                body_len, nbufs, kind, msg_id = _HDR.unpack_from(view)
                 if body_len > _BODY_MAX or nbufs > _NBUFS_MAX:
                     # garbled header (e.g. a peer speaking an older frame
                     # layout): fail the connection instead of blocking on
                     # a bogus multi-GB read
                     raise ConnectionError("garbled rpc frame header")
                 bufs = None
+                lens = ()
                 if nbufs:
                     lens_sz = _BLEN.size * nbufs
                     scratch = _grow(scratch, lens_sz)
@@ -481,15 +640,27 @@ class Connection:
                 view = memoryview(scratch)
                 _recv_exact_into(sock, view, body_len)
                 if nbufs:
-                    # out-of-band buffers get fresh storage: deserialized
-                    # objects (numpy views) may keep references into them
-                    bufs = []
-                    for ln in lens:
-                        b = bytearray(ln)
-                        _recv_exact_into(sock, memoryview(b), ln)
-                        bufs.append(b)
+                    # a registered buffer sink (pull engine) receives the
+                    # payload straight into its shm destination offsets;
+                    # otherwise out-of-band buffers get fresh storage —
+                    # deserialized objects (numpy views) may keep
+                    # references into them
+                    bufs = self._take_sink(msg_id, lens) \
+                        if kind == _RESPONSE else None
+                    if bufs is not None:
+                        for ln, dest in zip(lens, bufs):
+                            _recv_exact_into(sock, dest, ln)
+                    else:
+                        bufs = []
+                        for ln in lens:
+                            b = bytearray(ln)
+                            _recv_exact_into(sock, memoryview(b), ln)
+                            bufs.append(b)
                 kind, msg_id, a, b = pickle.loads(view[:body_len],
                                                   buffers=bufs)
+                # the buffers are fully received and wrapped: a pending
+                # discard_sinks may release their memory from here on
+                self._sink_done()
                 if _TELEMETRY:
                     _M_BYTES_IN.inc(_HDR.size + body_len +
                                     ((_BLEN.size * nbufs + sum(lens))
@@ -508,6 +679,11 @@ class Connection:
                         _dispatch_pool().submit(
                             self._handle_request, msg_id, a, b)
                 elif kind == _RESPONSE:
+                    if self._sinks:
+                        # an in-band reply (absent / spilled data) never
+                        # consults its sink: drop the registration here
+                        with self._sink_lock:
+                            self._sinks.pop(msg_id, None)
                     with self._inflight_lock:
                         fut = self._inflight.pop(msg_id, None)
                     if fut is not None:
@@ -529,6 +705,7 @@ class Connection:
             # RuntimeError: dispatch pool shut down at interpreter exit
             pass
         finally:
+            self._sink_done()  # a discard_sinks waiter must not hang
             self.close()
 
     def _enqueue_push(self, method: str, payload: Any) -> None:
@@ -588,9 +765,14 @@ class Connection:
         _M_DISPATCH.observe_since(method, t0)
         self._respond(msg_id, ok, value)
 
-    def _respond(self, msg_id: int, ok: bool, value: Any) -> None:
+    def _respond(self, msg_id: int, ok: bool, value: Any,
+                 stable: bool = False,
+                 on_sent: Optional[Callable[[], None]] = None) -> None:
+        # _send owns on_sent once called: it fires the hook itself on
+        # every failure path, so no branch here may re-run it
         try:
-            self._send((_RESPONSE, msg_id, ok, value))
+            self._send((_RESPONSE, msg_id, ok, value), stable=stable,
+                       on_sent=on_sent)
         except OSError:
             self.close()
         except Exception as e:
@@ -607,8 +789,9 @@ class Connection:
             return
         self._closed.set()
         with self._wq_lock:
-            self._wq.clear()
-            self._wq_cv.notify_all()
+            dropped = self._drain_wq_locked()
+        for cb in dropped:
+            _run_cb(cb)  # unsent stable frames must still release pins
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -622,6 +805,8 @@ class Connection:
         for fut in inflight.values():
             if not fut.done():
                 fut.set_exception(ConnectionError("connection closed"))
+        with self._sink_lock:
+            self._sinks.clear()  # registered-but-unserved destinations
         if self._on_close is not None:
             cb, self._on_close = self._on_close, None
             try:

@@ -24,9 +24,12 @@ FINISHED = "FINISHED"
 FAILED = "FAILED"
 # instant markers (timeline dots, not lifecycle transitions): they never
 # update a record's state — a streaming task stays RUNNING while its
-# per-yield STREAM_ITEM instants accumulate
+# per-yield STREAM_ITEM instants accumulate, and a PULL (one inter-node
+# object transfer for the task's output, docs/object_transfer.md) rides
+# whatever lifecycle state the task is in
 STREAM_ITEM = "STREAM_ITEM"
-_INSTANT_STATES = frozenset({STREAM_ITEM})
+PULL = "PULL"
+_INSTANT_STATES = frozenset({STREAM_ITEM, PULL})
 
 _STATE_RANK = {SUBMITTED: 1, PENDING_NODE_ASSIGNMENT: 2, RUNNING: 3,
                FINISHED: 4, FAILED: 4}
@@ -153,6 +156,11 @@ class GcsTaskTable:
                 entry = {"state": ev["state"], "ts": ev["ts"]}
                 if "index" in ev:   # per-yield stream instants
                     entry["index"] = ev["index"]
+                for field in ("dur_ms", "bytes", "nsources", "object_id",
+                              "node_id", "worker_id"):
+                    if field in ev:  # per-pull transfer slices (node/
+                        # worker = the PULLING process, not the producer)
+                        entry[field] = ev[field]
                 rec["events"].append(entry)
                 rec["events"].sort(key=lambda e: e["ts"])
                 if len(rec["events"]) > _EVENTS_PER_TASK_CAP:

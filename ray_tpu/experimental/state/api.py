@@ -171,6 +171,9 @@ def timeline(path: Optional[str] = None) -> List[dict]:
     * streaming generators emit one instant ("i") per reported yield
       (``STREAM_ITEM`` task events), so per-item pacing and
       backpressure pauses show up between the task's start and end;
+    * inter-node object pulls of a task's output appear as ``transfer``
+      slices (``PULL`` events carrying duration/bytes/source count,
+      docs/object_transfer.md) on the pulling process's row;
     * every event carries the submitting span's ``trace_id`` in its
       args when one was propagated, so user spans (``span(...)``),
       tasks and stream items correlate in Perfetto.
@@ -181,6 +184,7 @@ def timeline(path: Optional[str] = None) -> List[dict]:
     for t in list_tasks():
         start = end = None
         items = []
+        pulls = []
         for ev in t.get("events", []):
             if ev["state"] == "RUNNING":
                 start = ev["ts"]
@@ -188,6 +192,29 @@ def timeline(path: Optional[str] = None) -> List[dict]:
                 end = ev["ts"]
             elif ev["state"] == "STREAM_ITEM":
                 items.append(ev)
+            elif ev["state"] == "PULL":
+                pulls.append(ev)
+        for ev in pulls:
+            # a pull may happen long after the task finished (a borrower
+            # fetching the output): its slice stands on its own
+            dur_s = float(ev.get("dur_ms", 0.0)) / 1e3
+            events.append({
+                "name": f"pull {ev.get('object_id', '?')[:12]} "
+                        f"({ev.get('bytes', 0)} B)",
+                "cat": "transfer",
+                "ph": "X",
+                "ts": (ev["ts"] - dur_s) * 1e6,
+                "dur": dur_s * 1e6,
+                # the slice belongs to the PULLING process's row (the
+                # event stamps it); older events without the stamp fall
+                # back to the producing task's row
+                "pid": ev.get("node_id", t.get("node_id", "node"))[:8],
+                "tid": ev.get("worker_id",
+                              t.get("worker_id", "worker"))[:8],
+                "args": {"task_id": t["task_id"],
+                         "bytes": ev.get("bytes", 0),
+                         "nsources": ev.get("nsources", 0)},
+            })
         if start is None:
             continue
         if end is None or end < start:
@@ -311,6 +338,39 @@ def metrics_summary() -> str:
     stalls, pin counts — telemetry without the dashboard."""
     rows = list_metrics()
     lines: List[str] = []
+
+    # object-transfer data plane (docs/object_transfer.md): regressions
+    # visible without rerunning benchmarks/object_transfer_perf.py
+    byname = {(r["name"], tuple(sorted(r["tags"].items()))): r
+              for r in rows}
+
+    def _scalar(name):
+        row = byname.get((name, ()))
+        return row.get("value", 0.0) if row else 0.0
+
+    pulled = _scalar("ray_tpu_pull_bytes_total")
+    rtt = byname.get(("ray_tpu_pull_chunk_rtt_ms", ()))
+    local_hits = _scalar("ray_tpu_fetch_local_hits_total")
+    remote = _scalar("ray_tpu_fetch_remote_pulls_total")
+    pf_reqs = _scalar("ray_tpu_prefetch_requests_total")
+    pf_hits = _scalar("ray_tpu_prefetch_hits_total")
+    if pulled or remote or pf_reqs:
+        lines.append("== Object transfer ==")
+        lines.append("%-34s %14s" % ("bytes pulled", f"{pulled:,.0f}"))
+        if rtt and rtt.get("count"):
+            lines.append("%-34s %9.3g / %.3g ms" % (
+                "chunk RTT p50/p95", rtt.get("p50", 0.0),
+                rtt.get("p95", 0.0)))
+        fetches = local_hits + remote
+        if fetches:
+            lines.append("%-34s %13.1f%%" % (
+                "local-hit ratio (fetches)",
+                100.0 * local_hits / fetches))
+        if pf_reqs:
+            lines.append("%-34s %13.1f%%" % (
+                "prefetch hit ratio",
+                100.0 * pf_hits / pf_reqs))
+        lines.append("")
 
     rpc_rows = [r for r in rows if r["name"] == "ray_tpu_rpc_dispatch_ms"
                 and r.get("count")]

@@ -35,6 +35,7 @@ from ray_tpu.exceptions import SchedulingError
 from ray_tpu._private import rpc
 from ray_tpu._private import runtime_metrics as rtm
 from ray_tpu._private import serialization as ser
+from ray_tpu._private import transfer
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.logging_utils import get_logger
@@ -72,6 +73,13 @@ _M_STREAM_STALLS = rtm.counter(
 _M_STREAM_PARKED = rtm.histogram(
     "ray_tpu_stream_parked_report_ms",
     "time an item report spent parked before consumption released it")
+_M_FETCH_LOCAL = rtm.counter(
+    "ray_tpu_fetch_local_hits_total",
+    "borrowed-object fetches served from local shm (prefetch/locality "
+    "hits: the bytes were already here)")
+_M_FETCH_REMOTE = rtm.counter(
+    "ray_tpu_fetch_remote_pulls_total",
+    "borrowed-object fetches that had to pull from a remote node")
 
 
 class ObjectRef:
@@ -363,13 +371,15 @@ class _NotifyingEvent:
 
 class _OwnedObject:
     __slots__ = ("state", "data", "error", "locations", "event", "refcount",
-                 "task_spec", "dynamic_children", "recovering")
+                 "task_spec", "dynamic_children", "recovering", "size")
 
     def __init__(self):
         self.state = "pending"       # pending | ready
         self.data: Optional[bytes] = None     # serialized inline payload
         self.error = 0
         self.locations: set = set()  # node_id hex with a shm copy
+        self.size = 0                # serialized bytes of a shm copy
+        #   (0 = inline or unknown); feeds locality-aware lease hints
         self.event = _NotifyingEvent()
         self.refcount = 0
         # lineage for reconstruction: {"spec","resources","key",
@@ -383,49 +393,10 @@ class _OwnedObject:
         self.recovering = False
 
 
-class _PullBudget:
-    """Admission control over concurrently buffered pull bytes (reference
-    PullManager's bounded quota, pull_manager.h:52): N parallel gets of
-    large objects queue here instead of overcommitting process memory.
-    An object larger than the whole cap is admitted alone (capped at the
-    full budget) so it can never deadlock."""
-
-    def __init__(self, cap: int):
-        self.cap = max(1, cap)
-        self.used = 0
-        self.cv = threading.Condition()
-        self._waiters: deque = deque()  # FIFO tickets
-
-    def acquire(self, n: int, deadline: Optional[float]) -> bool:
-        n = min(n, self.cap)
-        ticket = object()
-        with self.cv:
-            self._waiters.append(ticket)
-            try:
-                while True:
-                    # strict FIFO: only the head ticket may admit — a big
-                    # pull can't be starved by a stream of smaller ones
-                    # slipping past it whenever they happen to fit
-                    if self._waiters[0] is ticket and \
-                            (self.used + n <= self.cap or self.used == 0):
-                        self.used += n
-                        return True
-                    t = None if deadline is None \
-                        else max(0.0, deadline - time.monotonic())
-                    if t is not None and t <= 0:
-                        return False
-                    if not self.cv.wait(timeout=t if t is not None
-                                        else 5.0) and deadline is not None:
-                        return False
-            finally:
-                self._waiters.remove(ticket)
-                self.cv.notify_all()
-
-    def release(self, n: int) -> None:
-        n = min(n, self.cap)
-        with self.cv:
-            self.used = max(0, self.used - n)
-            self.cv.notify_all()
+# Pull admission control lives with the data-plane engine now
+# (_private/transfer.py); the name stays importable here for callers and
+# tests that treat it as part of the core worker's surface.
+_PullBudget = transfer.PullBudget
 
 
 class _Lease:
@@ -498,9 +469,11 @@ class CoreWorker:
         # them completes (otherwise the owner may free the object before the
         # executing worker fetches it)
         self._arg_refs: Dict[bytes, list] = {}
-        self._owner_conns: Dict[Tuple[str, int], rpc.Connection] = {}
-        self._owner_conns_lock = threading.Lock()
+        self._owner_conns = transfer.ConnCache()
         self._pull_budget = _PullBudget(CONFIG.pull_memory_cap_bytes)
+        # bulk data plane (docs/object_transfer.md): pipelined multi-
+        # source shm-direct pulls over the pooled connection cache
+        self._puller: Optional[transfer.ObjectPuller] = None
 
         # streaming-generator table: task binary -> _StreamState for every
         # live num_returns="streaming" submission this process owns
@@ -511,11 +484,16 @@ class CoreWorker:
         # report_generator_item only buffers + notifies (and may resolve
         # a parked Deferred, which just enqueues a reply frame): run it
         # inline on the reader thread — item delivery latency is the
-        # time-to-first-token path
+        # time-to-first-token path.  report_object_location is a dict
+        # update under _owned_lock.
         self._server = rpc.Server(
             self._handle_rpc, host=host,
-            fast_methods=frozenset({"report_generator_item"}))
+            fast_methods=frozenset({"report_generator_item",
+                                    "report_object_location"}))
         self.address = self._server.address
+        self._puller = transfer.ObjectPuller(
+            self.store, self._node_address, self._owner_conn,
+            budget=self._pull_budget)
 
         self.gcs = GcsClient(gcs_address)
         self.raylet_addr = tuple(raylet_address)
@@ -793,6 +771,7 @@ class CoreWorker:
         else:
             self.store_put(oid, head, views)
             entry.locations.add(self.node_id)
+            entry.size = size
         entry.event.set()
         _M_PUT.observe_since(_t0)
         return ObjectRef(oid, self.address, self)
@@ -956,10 +935,12 @@ class CoreWorker:
                         f"object {oid.hex()[:16]} lost: all copies are gone "
                         f"and it cannot be reconstructed (put objects and "
                         f"tasks out of retries are unrecoverable)")
-        # 2. local shm
+        # 2. local shm (argument prefetch lands borrowed copies here:
+        # the hit counter is the numerator of the prefetch hit ratio)
         res = self.store.get(oid, timeout=0.0)
         if res is not None:
             buf, _ = res
+            _M_FETCH_LOCAL.inc()
             self._note_pin(oid, pin_out)
             return buf
         # 3. ask the owner
@@ -997,15 +978,16 @@ class CoreWorker:
                               deadline: Optional[float],
                               pin_out: Optional[list] = None
                               ) -> Optional[memoryview]:
-        """Owner-side fetch of an owned shm object: try every live location
-        (local shm first, then raylets — including our own, which may hold
-        it as a spill file).  Returns None only once the object is genuinely
-        unavailable — every location is dead, or definitively reports the
-        copy gone, or has been unreachable past fetch_fail_timeout_s — so
-        the caller can decide between reconstruction and timeout.  A raylet
-        that *answers* "absent" drops that location immediately; a raylet
-        that can't be reached gets the grace window (its node may just be
-        restarting) instead of triggering a duplicate re-execution."""
+        """Owner-side fetch of an owned shm object: local shm first, then
+        one striped pull across every live location at once (including our
+        own raylet, which may hold it as a spill file).  Returns None only
+        once the object is genuinely unavailable — every location is dead,
+        or definitively reports the copy gone, or has been unreachable
+        past fetch_fail_timeout_s — so the caller can decide between
+        reconstruction and timeout.  A raylet that *answers* "absent"
+        drops that location immediately; a raylet that can't be reached
+        gets the grace window (its node may just be restarting) instead of
+        triggering a duplicate re-execution."""
         grace = time.monotonic() + CONFIG.fetch_fail_timeout_s
         attempt = 0
         while True:
@@ -1017,19 +999,19 @@ class CoreWorker:
                 if res is not None:
                     self._note_pin(oid, pin_out)
                     return res[0]
-            transient = False
-            for node_hex in locations:
-                status, data = self._fetch_remote(node_hex, oid, deadline)
-                if status == "ok":
-                    return memoryview(data)
-                if status == "absent":
-                    # evicted/never there: that location is authoritative
-                    # about itself — forget it
+            out = self._puller.pull(oid, sorted(locations), deadline)
+            if out.absent:
+                # evicted/never there: those locations are authoritative
+                # about themselves — forget them
+                with self._owned_lock:
+                    entry.locations -= out.absent
+            if out.status == "ok":
+                self._finish_pull(oid, out, pin_out)
+                if out.published:
                     with self._owned_lock:
-                        entry.locations.discard(node_hex)
-                else:
-                    transient = True
-            if not transient:
+                        entry.locations.add(self.node_id)
+                return out.data if out.published else memoryview(out.data)
+            if not out.transient:
                 return None  # every remaining location answered "absent"
             now = time.monotonic()
             if now >= grace or (deadline is not None and now >= deadline):
@@ -1037,24 +1019,72 @@ class CoreWorker:
             attempt += 1
             time.sleep(min(0.05 * attempt, 1.0))
 
-    def _fetch_from_location_set(self, oid: ObjectID, locations: set,
+    def _fetch_from_location_set(self, ref: "ObjectRef", locations: set,
                                  deadline: Optional[float],
                                  pin_out: Optional[list] = None
                                  ) -> Optional[memoryview]:
-        """Borrower-side single pass over owner-reported locations."""
+        """Borrower-side striped pull over owner-reported locations."""
+        oid = ref.id
         alive = self._alive_node_ids()
-        for node_hex in locations:
-            if alive and node_hex not in alive:
-                continue
-            if node_hex == self.node_id:
-                res = self.store.get(oid, timeout=0.0)
-                if res is not None:
-                    self._note_pin(oid, pin_out)
-                    return res[0]
-            status, data = self._fetch_remote(node_hex, oid, deadline)
-            if status == "ok":
-                return memoryview(data)
-        return None
+        if self.node_id in locations:
+            res = self.store.get(oid, timeout=0.0)
+            if res is not None:
+                self._note_pin(oid, pin_out)
+                return res[0]
+        # self stays in the source set: our own raylet may hold the copy
+        # as a spill file (the engine's pull restores or streams it)
+        sources = [nh for nh in sorted(locations)
+                   if not alive or nh in alive]
+        if not sources:
+            return None
+        out = self._puller.pull(oid, sources, deadline)
+        if out.status != "ok":
+            return None
+        self._finish_pull(oid, out, pin_out)
+        if out.published:
+            # tell the owner this node now holds a copy: later pulls can
+            # stripe across us, and the final free sweeps our copy too
+            self._report_location(ref, out.bytes)
+            return out.data
+        return memoryview(out.data)
+
+    def _finish_pull(self, oid: ObjectID, out, pin_out) -> None:
+        """Shared bookkeeping for a successful remote pull."""
+        _M_FETCH_REMOTE.inc()
+        if out.published:
+            # the engine holds the single store pin for the sealed copy;
+            # account it like any local-shm pin this fetch took
+            self._note_pin(oid, pin_out)
+        if out.bytes >= CONFIG.object_transfer_chunk_bytes \
+                and not oid.is_put():
+            # put objects have a pseudo task id with no task record:
+            # recording against it would fabricate a phantom stub row in
+            # the GCS task table / `ray-tpu status`
+            # timeline slice per multi-chunk pull (docs/observability.md):
+            # rides the producing task's event record
+            # no name: the task record keeps the producing task's name
+            # the event rides the producing task's record, but the slice
+            # belongs to THIS process's row — stamp the puller's ids
+            self.events.record(
+                oid.task_id().hex(), "PULL",
+                dur_ms=round(out.duration_s * 1000.0, 3),
+                bytes=out.bytes, nsources=out.nsources,
+                object_id=oid.hex()[:16],
+                node_id=self.node_id,
+                worker_id=self.worker_id.hex())
+
+    def _report_location(self, ref: "ObjectRef", size: int) -> None:
+        """Fire-and-forget location update to the owner after a pulled
+        copy was published into local shm (the ownership directory's
+        OnObjectLocationAdded analog): grows the owner's location set so
+        later pulls can stripe across this node."""
+        try:
+            conn = self._owner_conn(tuple(ref.owner_addr))
+            conn.call_async("report_object_location",
+                            {"object_id": ref.id.binary(),
+                             "node_id": self.node_id, "size": size})
+        except Exception:
+            pass  # purely an optimization; the owner survives without it
 
     def _node_address(self, node_hex: str) -> Optional[Tuple[str, int]]:
         node = self._node_table.get(node_hex)
@@ -1064,83 +1094,8 @@ class CoreWorker:
             node = self._node_table.get(node_hex)
         return tuple(node["address"]) if node else None
 
-    def _fetch_remote(self, node_hex: str, oid: ObjectID,
-                      deadline: Optional[float]
-                      ) -> Tuple[str, Optional[bytes]]:
-        """Pull one object from a remote raylet, chunk by chunk: each RPC
-        frame carries at most object_transfer_chunk_bytes, so large objects
-        stream with bounded memory on both sides (reference PullManager /
-        chunked ObjectManager::Push semantics).
-
-        Returns (status, data): "ok" with the bytes; "absent" when the
-        raylet answered but has no copy (authoritative — evicted or freed);
-        "error" on transport failures (transient: node may be restarting)."""
-        addr = self._node_address(node_hex)
-        if addr is None:
-            return "error", None
-        chunk = CONFIG.object_transfer_chunk_bytes
-        try:
-            conn = rpc.connect(addr, timeout=5.0)
-            try:
-                first = conn.call("fetch_object_chunk",
-                                  {"object_id": oid.binary(),
-                                   "offset": 0, "length": chunk,
-                                   "timeout": 0.0},
-                                  timeout=CONFIG.raylet_rpc_timeout_s)
-                if first is None:
-                    return "absent", None
-                total = first["total"]
-                if total <= chunk:
-                    return "ok", first["data"]
-                # admission: multi-chunk pulls reserve their full buffer
-                # from the process-wide quota before allocating, so N
-                # concurrent gets of large objects queue instead of
-                # overcommitting memory.  Drop the first chunk before
-                # queueing — a parked waiter must hold no payload bytes
-                # (re-fetching one chunk later is cheaper than cap-exempt
-                # memory per waiter); the idle TCP conn it keeps is fds,
-                # not memory
-                first = None
-                if not self._pull_budget.acquire(total, deadline):
-                    return "error", None  # quota wait timed out: transient
-                try:
-                    out = bytearray(total)
-                    off = 0
-                    while off < total:
-                        if deadline is not None and \
-                                time.monotonic() >= deadline:
-                            return "error", None  # honor get(timeout=)
-                        res = conn.call("fetch_object_chunk",
-                                        {"object_id": oid.binary(),
-                                         "offset": off, "length": chunk,
-                                         "timeout": 0.0},
-                                        timeout=CONFIG.raylet_rpc_timeout_s)
-                        if res is None or not res["data"]:
-                            return "absent", None  # evicted mid-transfer
-                        out[off:off + len(res["data"])] = res["data"]
-                        off += len(res["data"])
-                    return "ok", bytes(out)
-                finally:
-                    self._pull_budget.release(total)
-            finally:
-                conn.close()
-        except (ConnectionError, rpc.RemoteError, TimeoutError, OSError):
-            return "error", None
-
     def _owner_conn(self, addr: Tuple[str, int]) -> rpc.Connection:
-        addr = tuple(addr)
-        with self._owner_conns_lock:
-            conn = self._owner_conns.get(addr)
-            if conn is not None and not conn.closed:
-                return conn
-        conn = rpc.connect(addr, timeout=5.0)
-        with self._owner_conns_lock:
-            old = self._owner_conns.get(addr)
-            if old is not None and not old.closed:
-                conn.close()
-                return old
-            self._owner_conns[addr] = conn
-        return conn
+        return self._owner_conns.get(tuple(addr))
 
     def _fetch_from_owner(self, ref: ObjectRef,
                           deadline: Optional[float],
@@ -1162,7 +1117,7 @@ class CoreWorker:
                     return memoryview(res["data"])
                 # location answer
                 data = self._fetch_from_location_set(
-                    ref.id, set(res["locations"]), deadline, pin_out)
+                    ref, set(res["locations"]), deadline, pin_out)
                 if data is not None:
                     return data
             if deadline is not None and time.monotonic() >= deadline:
@@ -1627,6 +1582,46 @@ class CoreWorker:
             if need_more:
                 self._maybe_request_lease(key, st)
 
+    def _arg_hints(self, st) -> dict:
+        """Argument locations/sizes of the queued tasks this lease will
+        serve (head of the key's queue), for locality-aware placement and
+        raylet-side prefetch (docs/object_transfer.md).  Only owned,
+        ready, shm-resident arguments at least locality_min_arg_bytes
+        participate — below that, transfer cost is noise next to lease
+        latency, and pending/inline/borrowed arguments have no location
+        worth weighing."""
+        if not (CONFIG.locality_aware_scheduling
+                or CONFIG.object_prefetch_enabled):
+            return {}
+        with self._sched_lock:
+            specs = [spec for spec, _r in itertools.islice(
+                st["queue"], 0, 4)]
+        locs: Dict[str, float] = {}
+        prefetch: List[dict] = []
+        seen: set = set()
+        for spec in specs:
+            for ref in self._arg_refs.get(spec["task_id"], ()):
+                if ref.id.binary() in seen:
+                    continue
+                seen.add(ref.id.binary())
+                with self._owned_lock:
+                    entry = self._owned.get(ref.id)
+                    if (entry is None or entry.state != "ready"
+                            or entry.data is not None
+                            or entry.size < CONFIG.locality_min_arg_bytes
+                            or not entry.locations):
+                        continue
+                    size = entry.size
+                    locations = sorted(entry.locations)
+                for nh in locations:
+                    locs[nh] = locs.get(nh, 0.0) + size
+                prefetch.append({"object_id": ref.id.binary(),
+                                 "size": size, "locations": locations,
+                                 "owner": list(self.address)})
+        if not prefetch:
+            return {}
+        return {"arg_locs": locs, "prefetch": prefetch}
+
     def _lease_with_spillback(self, key: str, st) -> dict:
         """Lease locally; follow at most two retry_at redirects (the
         reference's spillback, direct_task_transport.cc retry_at_raylet).
@@ -1642,6 +1637,7 @@ class CoreWorker:
         payload = {"key": key, "resources": st["resources"],
                    "job_id": self.job_id.hex(), "env": st.get("env"),
                    "language": st.get("language")}
+        payload.update(self._arg_hints(st))
         target_addr = None  # None -> local raylet
         for hop in range(3):
             if target_addr is None:
@@ -1690,6 +1686,11 @@ class CoreWorker:
         base = {"key": key, "resources": st["resources"],
                 "job_id": self.job_id.hex(), "spillback": 2,
                 "env": st.get("env"), "language": st.get("language")}
+        # spillback=2 means the strategy's node choice is final — no
+        # locality redirect — but the chosen raylet still prefetches
+        hints = self._arg_hints(st)
+        if hints.get("prefetch"):
+            base["prefetch"] = hints["prefetch"]
         kind = strategy.get("type")
         if kind == "placement_group":
             pg_id = strategy["pg_id"]
@@ -2085,6 +2086,7 @@ class CoreWorker:
                         self._memory_cache.pop(oid, None)
                     else:
                         entry.locations.add(result["location"])
+                        entry.size = int(result.get("size", 0))
                 entry.state = "ready"
                 entry.event.set()
                 # the last user ref may have been dropped while this slot
@@ -2124,6 +2126,7 @@ class CoreWorker:
                 sub_entry.data = sub["data"]
             else:
                 sub_entry.locations.add(sub["location"])
+                sub_entry.size = int(sub.get("size", 0))
             sub_entry.state = "ready"
             sub_entry.event.set()
             # unbound refs (worker=None): these only exist to be serialized
@@ -2197,6 +2200,7 @@ class CoreWorker:
                     entry.data = p["data"]
                 else:
                     entry.locations.add(p["location"])
+                    entry.size = int(p.get("size", 0))
                 entry.state = "ready"
                 entry.event.set()
             elif p.get("location"):
@@ -2516,6 +2520,8 @@ class CoreWorker:
             return self._rpc_get_object(p or {})
         if method == "report_generator_item":
             return self._rpc_report_generator_item(p or {})
+        if method == "report_object_location":
+            return self._rpc_report_object_location(p or {})
         if method == "core_worker_stats":
             return self._rpc_core_worker_stats(p or {})
         if method == "profile":
@@ -2552,6 +2558,21 @@ class CoreWorker:
             "pending_tasks": pending,
             "active_leases": leases,
         }
+
+    def _rpc_report_object_location(self, p) -> dict:
+        """A borrower (or a raylet prefetch) published a pulled copy of
+        an object we own into its node's shm — the ownership directory's
+        OnObjectLocationAdded analog.  Growing the location set lets
+        later pulls stripe across the new copy and the final free sweep
+        it; a report for an unknown/inline object is a no-op."""
+        oid = ObjectID(p["object_id"])
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+            if entry is not None and entry.data is None:
+                entry.locations.add(p["node_id"])
+                if not entry.size and p.get("size"):
+                    entry.size = int(p["size"])
+        return {"ok": True}
 
     def _rpc_get_object(self, p) -> Optional[dict]:
         """Owner side of borrower gets: inline data or known locations."""

@@ -19,18 +19,21 @@ chips unless ``tpu_chips_per_host`` subdivides visible devices.
 from __future__ import annotations
 
 import os
+import pickle
 import signal
 import subprocess
 import sys
 import threading
 import time
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import psutil
 
 from ray_tpu._private import rpc
 from ray_tpu._private import runtime_metrics as rtm
+from ray_tpu._private import transfer
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.logging_utils import get_logger
@@ -43,6 +46,26 @@ _M_LEASE = rtm.histogram(
     "lease request queued -> grant latency at this raylet (ms)")
 _M_SPAWNS = rtm.counter(
     "ray_tpu_workers_spawned_total", "worker processes spawned")
+# data-plane serving + prefetch telemetry (docs/object_transfer.md)
+_M_CHUNKS_SERVED = rtm.counter(
+    "ray_tpu_chunks_served_total",
+    "object chunks served to remote pullers from this node")
+_M_CHUNK_BYTES_OUT = rtm.counter(
+    "ray_tpu_chunk_bytes_served_total",
+    "object bytes served to remote pullers (zero-copy shm slices)")
+_M_PREFETCH_REQS = rtm.counter(
+    "ray_tpu_prefetch_requests_total",
+    "large task arguments a lease request asked this raylet to prefetch")
+_M_PREFETCH_HITS = rtm.counter(
+    "ray_tpu_prefetch_hits_total",
+    "prefetch requests already satisfied by a local copy")
+_M_PREFETCH_BYTES = rtm.counter(
+    "ray_tpu_prefetch_bytes_total",
+    "argument bytes pulled into local shm ahead of task dispatch")
+_M_LOCALITY_HITS = rtm.counter(
+    "ray_tpu_locality_lease_redirects_total",
+    "lease requests redirected to the node holding the most argument "
+    "bytes (locality-aware placement)")
 
 logger = get_logger("raylet")
 
@@ -251,8 +274,14 @@ class Raylet:
         # threads waiting on worker registration, so a registration
         # queued behind a full pool of parked leases would wedge the
         # whole wave until the lease timeout.
+        # fetch_object_chunk is fast too: a shm hit is a pin + an enqueued
+        # zero-copy reply frame (the spilled/absent path hands itself to
+        # the dispatch pool behind a Deferred before doing anything slow),
+        # so pipelined pulls are served back-to-back off the reader with
+        # their replies coalescing into shared sendmsg batches.
         fast = frozenset({"was_oom_killed", "store_stats", "node_info",
-                          "list_workers", "spill_dir", "register_worker"})
+                          "list_workers", "spill_dir", "register_worker",
+                          "fetch_object_chunk", "object_pins"})
         self._server = rpc.Server(self._handle, host=host,
                                   on_disconnect=self._conn_closed,
                                   fast_methods=fast)
@@ -333,6 +362,38 @@ class Raylet:
         self._obj_spiller = threading.Thread(target=self._object_spill_loop,
                                              daemon=True)
         self._obj_spiller.start()
+
+        # bulk data plane, raylet side (docs/object_transfer.md): pooled
+        # peer connections + a pull engine for argument prefetch.  The
+        # prefetch budget shares the process-wide cap semantics with
+        # client pulls so a wave of lease requests can't overcommit shm.
+        self._conn_cache = transfer.ConnCache()
+        # (ts, nodes) list_nodes snapshot (_gcs_nodes): one tuple so
+        # concurrent lease handlers read it atomically.  Callers pick
+        # their own staleness bound — availability is advisory (a
+        # locality-redirect target re-checks feasibility and can spill
+        # back), and addresses are stabler still.
+        self._nodes_snapshot: Tuple[float, list] = (0.0, [])
+        self._prefetch_budget = transfer.PullBudget(
+            CONFIG.pull_memory_cap_bytes)
+        self._puller = transfer.ObjectPuller(
+            self.store, self._peer_address, self._conn_cache.get,
+            budget=self._prefetch_budget)
+        # oid binary -> (pinned view, expires_at): prefetched arguments
+        # stay pinned so eviction/spill can't undo the transfer before
+        # the task runs; dropped on free, else reaped after
+        # prefetch_pin_ttl_s (lease timed out / task cancelled)
+        self._prefetch_pins: Dict[bytes, Tuple[memoryview, float]] = {}
+        self._prefetch_inflight: set = set()
+        # freed while its prefetch was still pulling: the completion must
+        # discard the copy instead of pinning a resurrected object
+        self._prefetch_freed: set = set()
+        self._prefetch_lock = threading.Lock()
+        # bounded: a lease storm carrying many large-arg entries queues
+        # here instead of spawning a thread per argument (PullBudget
+        # bounds the bytes, this bounds the threads)
+        self._prefetch_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="arg-prefetch")
 
         # host-memory monitor + OOM worker-killing policy (reference
         # MemoryMonitor, memory_monitor.h:52 + worker_killing_policy.h)
@@ -516,6 +577,7 @@ class Raylet:
     def _object_spill_loop(self) -> None:
         while not self._stopped.wait(0.2):
             try:
+                self._reap_prefetch_pins()
                 self._retry_deferred_frees()
                 self._object_spill_scan()
             except Exception:
@@ -596,13 +658,14 @@ class Raylet:
         logger.debug("spilled %s (%d bytes)", oid.hex()[:12], size)
         return True
 
-    def _fetch_spilled_chunk(self, oid, p) -> Optional[dict]:
-        """Serve a chunk of a spilled object, racing safely against a
-        concurrent restore (which removes the file and re-creates the shm
-        copy): a None return is authoritative 'absent' to owners, so every
-        transient mid-handoff window must be retried, never reported —
-        and an exhausted run of flaky storage reads raises (the owner
-        maps a transport error to 'transient', never to lost)."""
+    def _fetch_spilled_chunk(self, oid, p):
+        """Serve a chunk of a spilled object as (value, on_sent), racing
+        safely against a concurrent restore (which removes the file and
+        re-creates the shm copy): a None value is authoritative 'absent'
+        to owners, so every transient mid-handoff window must be retried,
+        never reported — and an exhausted run of flaky storage reads
+        raises (the owner maps a transport error to 'transient', never to
+        lost)."""
         io_error = None
         for _ in range(3):
             with self._lock:
@@ -616,8 +679,8 @@ class Raylet:
                     restoring = oid.binary() in self._restoring
                 res = self.store.get(oid, timeout=2.0 if restoring else 0.0)
                 if res is None:
-                    return None
-                return self._chunk_from_shm(oid, res, p)
+                    return None, None
+                return self._chunk_reply(oid, res, p)
             size, meta = rec
             # restore into shm when it fits under the spill threshold
             # (reference LocalObjectManager restore / plasma re-create
@@ -630,13 +693,19 @@ class Raylet:
                     # sealed yet
                     res = self.store.get(oid, timeout=2.0)
                     if res is not None:
-                        return self._chunk_from_shm(oid, res, p)
-                    continue
+                        return self._chunk_reply(oid, res, p)
+                    # "restored concurrently" may actually be a remote
+                    # pull's UNSEALED destination create for this very
+                    # object (it will seal only after we answer) — fall
+                    # through and serve from the spill file, which that
+                    # restore-miss left intact.  A true concurrent
+                    # restore deleted the file: FileNotFoundError below
+                    # re-resolves, keeping the old retry behavior.
             sstore, skey = self._spill_loc(oid)
             try:
                 data = sstore.read_bytes(skey, int(p.get("offset", 0)),
                                          int(p.get("length", size)))
-                return {"total": size, "meta": meta, "data": data}
+                return {"total": size, "meta": meta, "data": data}, None
             except FileNotFoundError:
                 continue  # restored (or freed) under us: re-resolve
             except OSError as e:
@@ -646,18 +715,7 @@ class Raylet:
             raise rpc.RpcError(
                 f"spill storage read failed for {oid.hex()[:12]}: "
                 f"{io_error}")
-        return None
-
-    def _chunk_from_shm(self, oid, res, p) -> dict:
-        buf, meta = res
-        try:
-            off = int(p.get("offset", 0))
-            length = int(p.get("length", len(buf)))
-            return {"total": len(buf), "meta": meta,
-                    "data": bytes(buf[off:off + length])}
-        finally:
-            buf.release()
-            self.store.release(oid)
+        return None, None
 
     def _restore_one(self, oid, size: int, meta: int) -> bool:
         from ray_tpu.exceptions import ObjectStoreFullError
@@ -749,6 +807,14 @@ class Raylet:
         from ray_tpu._private.ids import ObjectID
         for ob in p.get("object_ids", ()):
             oid = ObjectID(ob)
+            # a prefetch pin must never turn a free into a deferred retry
+            # loop: drop ours first, then delete.  An in-flight prefetch
+            # gets a tombstone so its completion discards the copy
+            # instead of resurrecting a freed object under a 60 s pin.
+            with self._prefetch_lock:
+                if bytes(ob) in self._prefetch_inflight:
+                    self._prefetch_freed.add(bytes(ob))
+            self._release_prefetch_pin(bytes(ob))
             deleted = self.store.delete(oid)
             sstore, skey = self._spill_loc(oid)
             with self._lock:
@@ -1367,6 +1433,18 @@ class Raylet:
         need.setdefault("CPU", 1.0)
         bundle = p.get("bundle")  # [pg_id_hex, index] -> lease from the pool
         pool_key = f"{bundle[0]}:{int(bundle[1])}" if bundle else None
+        spillback = int(p.get("spillback", 0))
+        if pool_key is None and spillback == 0 and \
+                CONFIG.locality_aware_scheduling and p.get("arg_locs"):
+            # locality-aware placement (docs/object_transfer.md): on the
+            # first hop only (no redirect ping-pong), prefer the feasible
+            # node already holding the most argument bytes.  Decided
+            # before the env build below: a redirected lease must not
+            # pay a cold pip install on the node it is about to leave.
+            target = self._locality_candidate(need, p["arg_locs"])
+            if target is not None:
+                _M_LOCALITY_HITS.inc()
+                return {"retry_at": list(target)}
         # cold pip-env builds run here, on the requester's own RPC thread
         # (its lease call is what's waiting) — never inside
         # _dispatch_pending, which register/reap paths also drive
@@ -1382,7 +1460,6 @@ class Raylet:
                 if pool_key not in self._bundle_pools:
                     raise rpc.RpcError(
                         f"bundle {pool_key} not reserved on this node")
-        spillback = int(p.get("spillback", 0))
         if pool_key is None and spillback < 2:
             with self._res_lock:
                 local_ok = all(self.available.get(r, 0) >= v
@@ -1391,6 +1468,12 @@ class Raylet:
                 target = self._find_remote_candidate(need)
                 if target is not None:
                     return {"retry_at": list(target)}
+        if CONFIG.object_prefetch_enabled and p.get("prefetch"):
+            # serving this lease here: start pulling its missing large
+            # arguments NOW, overlapping worker spawn/lease wait below —
+            # one pool job per argument, so they also overlap each other
+            for e in p["prefetch"]:
+                self._prefetch_pool.submit(self._prefetch_one, e)
         fut_holder: Dict[str, Any] = {}
         event = threading.Event()
         req = {"key": p.get("key", ""), "resources": p.get("resources", {}),
@@ -1612,22 +1695,213 @@ class Raylet:
         """Chunked inter-node transfer: one [offset, offset+length) slice
         per call, so a multi-GB object never occupies a multi-GB RPC frame
         on either side (cf. ObjectManager::Push chunked transfer,
-        object_manager.cc:338 / push_manager.h:29)."""
+        object_manager.cc:338 / push_manager.h:29).
+
+        Runs inline on the reader thread (fast-method registry): a shm hit
+        costs one pin plus an enqueued reply frame.  With ``oob`` the
+        reply carries the shm slice itself as a pickle-5 out-of-band
+        buffer on a *stable* frame — no ``bytes()`` copy per chunk; the
+        pin is held until the write drains to the socket (rpc.py stable
+        frames).  The spilled/absent path parks behind a Deferred on the
+        dispatch pool so the reader never blocks on disk or restores."""
         from ray_tpu._private.ids import ObjectID
         oid = ObjectID(p["object_id"])
-        res = self.store.get(oid, timeout=p.get("timeout", 0.0))
-        if res is None:
-            return self._fetch_spilled_chunk(oid, p)
+        res = self.store.get(oid, timeout=0.0)
+        if res is not None:
+            value, on_sent = self._chunk_reply(oid, res, p)
+            if on_sent is None:
+                return value
+            d = rpc.Deferred()
+            d.resolve(value, stable=True, on_sent=on_sent)
+            return d
+        d = rpc.Deferred()
+
+        def run():
+            try:
+                value, on_sent = self._fetch_spilled_chunk(oid, p)
+                d.resolve(value, stable=on_sent is not None,
+                          on_sent=on_sent)
+            except BaseException as e:  # noqa: BLE001 - crosses the wire
+                d.fail(e)
+
+        rpc._dispatch_pool().submit(run)
+        return d
+
+    def _chunk_reply(self, oid, res, p):
+        """-> (reply value, on_sent or None) for a pinned shm hit."""
         buf, meta = res
-        try:
-            total = len(buf)
-            off = int(p.get("offset", 0))
-            length = int(p.get("length", total))
-            return {"total": total, "meta": meta,
-                    "data": bytes(buf[off:off + length])}
-        finally:
+        total = len(buf)
+        off = int(p.get("offset", 0))
+        end = min(off + int(p.get("length", total)), total)
+        _M_CHUNKS_SERVED.inc()
+        _M_CHUNK_BYTES_OUT.inc(max(0, end - off))
+        if not p.get("oob"):
+            # legacy/serial callers: copy out and release immediately
+            try:
+                return ({"total": total, "meta": meta,
+                         "data": bytes(buf[off:end])}, None)
+            finally:
+                buf.release()
+                self.store.release(oid)
+        piece = buf[off:end]
+
+        def _release(piece=piece, buf=buf, oid=oid):
+            # fires exactly once when the frame drains (or is dropped):
+            # the only store pin this chunk ever took ends here
+            piece.release()
             buf.release()
             self.store.release(oid)
+
+        return ({"total": total, "meta": meta,
+                 "data": pickle.PickleBuffer(piece)}, _release)
+
+    def _rpc_object_pins(self, conn, p):
+        """Pin counts of sealed local objects (tests + `ray-tpu memory`
+        debugging: is a prefetch pin / reader still holding this?)."""
+        want = set(p.get("object_ids", ())) if p.get("object_ids") else None
+        out = {}
+        for oid, _size, _tick, pins in self.store.list_objects():
+            if want is None or oid.binary() in want:
+                out[oid.hex()] = pins
+        return out
+
+    # ------------------------------------------------- argument prefetch
+    def _gcs_nodes(self, max_age: float) -> list:
+        """list_nodes snapshot at most ``max_age`` seconds old ([] when
+        the GCS is unreachable and nothing is cached).  One cache serves
+        locality placement and prefetch address resolution — the lease
+        path must not pay a GCS round trip per request."""
+        ts, nodes = self._nodes_snapshot
+        now = time.monotonic()
+        if now - ts > max_age:
+            try:
+                nodes = self.gcs.call("list_nodes", timeout=2)
+            except (ConnectionError, rpc.RpcError, TimeoutError):
+                return nodes  # stale beats nothing
+            self._nodes_snapshot = (now, nodes)
+        return nodes
+
+    def _peer_address(self, node_hex: str) -> Optional[Tuple[str, int]]:
+        """node hex -> raylet address (prefetch pulls resolve many
+        sources per lease wave, so tolerate a 5 s-stale snapshot)."""
+        for n in self._gcs_nodes(5.0):
+            if n["node_id"] == node_hex and n.get("alive"):
+                return tuple(n["address"])
+        return None
+
+    def _prefetch_one(self, e: dict) -> None:
+        """Pull one lease argument into local shm concurrently with
+        worker lease/startup (docs/object_transfer.md: transfer overlaps
+        scheduling instead of serializing after it).  Runs on the
+        bounded prefetch pool; the lease grant never waits for it."""
+        from ray_tpu._private.ids import ObjectID
+        ob = bytes(e["object_id"])
+        oid = ObjectID(ob)
+        _M_PREFETCH_REQS.inc()
+        with self._prefetch_lock:
+            if ob in self._prefetch_pins or ob in self._prefetch_inflight:
+                _M_PREFETCH_HITS.inc()
+                return
+            self._prefetch_inflight.add(ob)
+        try:
+            with self._lock:
+                spilled_here = ob in self._spilled
+            if spilled_here or self.store.contains(oid):
+                # already on this node (shm or our spill dir): the
+                # task's own fetch restores/pins it on demand
+                _M_PREFETCH_HITS.inc()
+                return
+            sources = [nh for nh in e.get("locations", ())
+                       if nh != self.node_id.hex()]
+            if not sources:
+                return
+            out = self._puller.pull(
+                oid, sources,
+                deadline=time.monotonic() + CONFIG.prefetch_pin_ttl_s,
+                publish_small=True)
+            if out.status != "ok" or not out.published:
+                return
+            with self._prefetch_lock:
+                freed = ob in self._prefetch_freed
+                if not freed:
+                    self._prefetch_pins[ob] = (
+                        out.data,
+                        time.monotonic() + CONFIG.prefetch_pin_ttl_s)
+            if freed:
+                # freed while we were pulling: discard the resurrected
+                # copy instead of pinning bytes nobody can ever use
+                out.data.release()
+                self.store.release(oid)
+                self.store.delete(oid)
+                return
+            _M_PREFETCH_BYTES.inc(out.bytes)
+            owner = e.get("owner")
+            if owner:
+                # grow the owner's location set: the final free must
+                # sweep this copy, and later pulls can stripe off us
+                try:
+                    conn = self._conn_cache.get(tuple(owner))
+                    conn.call_async(
+                        "report_object_location",
+                        {"object_id": ob,
+                         "node_id": self.node_id.hex(),
+                         "size": out.bytes})
+                except Exception:
+                    pass
+        except Exception:
+            logger.exception("argument prefetch failed for %s",
+                             oid.hex()[:12])
+        finally:
+            with self._prefetch_lock:
+                self._prefetch_inflight.discard(ob)
+                self._prefetch_freed.discard(ob)
+
+    def _release_prefetch_pin(self, ob: bytes) -> None:
+        with self._prefetch_lock:
+            rec = self._prefetch_pins.pop(ob, None)
+        if rec is None:
+            return
+        view, _exp = rec
+        try:
+            view.release()
+        except (BufferError, AttributeError):
+            pass
+        from ray_tpu._private.ids import ObjectID
+        self.store.release(ObjectID(ob))
+
+    def _reap_prefetch_pins(self) -> None:
+        """Safety net (spill loop, every 0.2 s): a pin whose lease never
+        dispatched — request timed out, task cancelled before dispatch —
+        must not keep its bytes unevictable forever."""
+        now = time.monotonic()
+        with self._prefetch_lock:
+            expired = [ob for ob, (_v, exp) in self._prefetch_pins.items()
+                       if exp <= now]
+        for ob in expired:
+            self._release_prefetch_pin(ob)
+
+    def _locality_candidate(self, need: Dict[str, float],
+                            arg_locs: Dict[str, float]):
+        """The feasible node already holding strictly more argument bytes
+        than this one, if any (reference locality-aware lease policy /
+        locality_data_provider): its address, else None."""
+        local_bytes = float(arg_locs.get(self.node_id.hex(), 0.0))
+        best = None
+        best_bytes = local_bytes
+        nodes = self._gcs_nodes(1.0)
+        for node in nodes:
+            nh = node["node_id"]
+            if nh == self.node_id.hex() or not node.get("alive"):
+                continue
+            nbytes = float(arg_locs.get(nh, 0.0))
+            if nbytes <= best_bytes or \
+                    nbytes < CONFIG.locality_min_arg_bytes:
+                continue
+            if all(node["available"].get(r, 0) >= v
+                   for r, v in need.items()):
+                best = tuple(node["address"])
+                best_bytes = nbytes
+        return best
 
     def _rpc_list_workers(self, conn, p):
         """Registered worker processes on this node (state API fan-out)."""
@@ -1687,6 +1961,12 @@ class Raylet:
             except subprocess.TimeoutExpired:
                 self._zygote_proc.kill()
         self._server.stop()
+        self._prefetch_pool.shutdown(wait=False)
+        self._conn_cache.close()
+        with self._prefetch_lock:
+            pins = list(self._prefetch_pins)
+        for ob in pins:
+            self._release_prefetch_pin(ob)
         try:
             self.gcs.close()
         except Exception:

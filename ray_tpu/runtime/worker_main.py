@@ -80,12 +80,14 @@ class _StreamSession:
         self._wait_for_credit()
         head, views = ser.serialize(value)
         payload = {"task_id": self.spec["task_id"], "index": self.index}
-        if ser.serialized_size(head, views) <= self.inline_max:
+        size = ser.serialized_size(head, views)
+        if size <= self.inline_max:
             payload["data"] = ser.to_flat_bytes(head, views)
         else:
             oid = ObjectID.for_task_return(self.task_id, self.index + 1)
             self.core.store_put(oid, head, views)
             payload["location"] = self.core.node_id
+            payload["size"] = size
         try:
             fut = self.conn.call_async("report_generator_item", payload)
         except (ConnectionError, OSError):
@@ -701,7 +703,9 @@ class WorkerProcess:
             else:
                 oid = ObjectID.for_task_return(task_id, i)
                 self.core.store_put(oid, head, views)
-                results.append({"location": self.core.node_id})
+                # size feeds the owner's locality/prefetch lease hints
+                results.append({"location": self.core.node_id,
+                                "size": size})
         return {"results": results}
 
     def _package_dynamic(self, spec, result) -> dict:
@@ -727,7 +731,7 @@ class WorkerProcess:
             else:
                 oid = ObjectID.for_task_return(task_id, j + 1)
                 self.core.store_put(oid, head, views)
-                subs.append({"location": self.core.node_id})
+                subs.append({"location": self.core.node_id, "size": size})
         return {"results": [{"dynamic": subs}]}
 
     def _package_streaming(self, spec, result) -> dict:

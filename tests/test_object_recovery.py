@@ -119,6 +119,153 @@ def test_unreconstructable_raises_object_lost(ray_start_cluster):
     ray_tpu.shutdown()
 
 
+def _replicated_big_object(cluster, tmp_path, elems=2 * 1024 * 1024):
+    """Produce a shm object on a 'src' node and read it from a 'dst' node
+    so the owner's location set holds two live copies (the borrower's
+    published pull / the dst raylet's argument prefetch both report their
+    copy back).  Returns (ref, marker_path)."""
+    marker = str(tmp_path / "producer_runs.txt")
+
+    @ray_tpu.remote(resources={"src": 1}, num_cpus=1)
+    def produce():
+        with open(marker, "a") as f:
+            f.write("x")
+        return np.arange(elems, dtype=np.float64)  # 16 MiB shm object
+
+    @ray_tpu.remote(resources={"dst": 1}, num_cpus=1)
+    def consume(x):
+        return float(x[-1])
+
+    ref = produce.remote()
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == float(elems - 1)
+    w = _worker()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        with w._owned_lock:
+            locs = set(w._owned[ref.id].locations)
+        if len(locs) >= 2:
+            return ref, marker
+        time.sleep(0.1)
+    raise TimeoutError(f"object never replicated: locations={locs}")
+
+
+def test_striped_pull_completes_after_source_eviction(
+        ray_start_cluster, tmp_path, monkeypatch):
+    """Freeing one source's copy mid-striped-pull doesn't fail (or
+    restart) the transfer: the 'absent' answer is authoritative for that
+    source only, its outstanding chunk ranges re-queue onto the survivor,
+    and the object is never re-produced through lineage (the producer
+    runs exactly once) — docs/object_transfer.md failover protocol."""
+    # 128 KiB chunks: the 16 MiB pull moves in 128 chunks, so the
+    # mid-transfer free lands while ranges are genuinely outstanding
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES", "131072")
+    import threading
+
+    from ray_tpu._private import rpc
+
+    cluster = ray_start_cluster
+    node_src = cluster.add_node(resources={"CPU": 2, "src": 2})
+    node_dst = cluster.add_node(resources={"CPU": 2, "dst": 2})
+    cluster.wait_for_nodes(3)
+    ray_tpu.init(num_cpus=1, address=cluster.address)
+    ref, marker = _replicated_big_object(cluster, tmp_path)
+
+    def free_on_dst():
+        time.sleep(0.03)  # let the driver's pull get chunks in flight
+        conn = rpc.connect(node_dst.address, timeout=5.0)
+        try:
+            conn.call("free_objects",
+                      {"object_ids": [ref.id.binary()]}, timeout=10)
+        finally:
+            conn.close()
+
+    _worker()._memory_cache.clear()
+    t = threading.Thread(target=free_on_dst, daemon=True)
+    t.start()
+    value = ray_tpu.get(ref, timeout=120)
+    t.join(timeout=30)
+    assert value.shape == (2 * 1024 * 1024,)
+    assert float(value[0]) == 0.0
+    assert float(value[-1]) == float(2 * 1024 * 1024 - 1)
+    # the transfer completed from the surviving copy — no lineage
+    # re-execution, i.e. the pull was never restarted from scratch
+    assert open(marker).read() == "x"
+    # src still holds its copy (only dst's was freed)
+    assert node_src.node_id in _worker()._owned[ref.id].locations
+    ray_tpu.shutdown()
+
+
+def test_prefetch_pin_released_when_task_never_dispatches(
+        ray_start_cluster, monkeypatch, tmp_path):
+    """A lease request's argument prefetch pins the pulled copy so
+    eviction can't undo the transfer before the task runs — but a task
+    that never dispatches (cancelled / blocked past its lease) must not
+    leak that pin: the TTL reaper drops it, and the task still runs
+    correctly afterwards (docs/object_transfer.md prefetch contract)."""
+    monkeypatch.setenv("RAY_TPU_PREFETCH_PIN_TTL_S", "3.0")
+    from ray_tpu._private import rpc
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = ray_start_cluster
+    node2 = cluster.add_node(resources={"CPU": 1})
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(num_cpus=1, address=cluster.address)
+    pin_to_node2 = NodeAffinitySchedulingStrategy(node2.node_id)
+    release = str(tmp_path / "release.flag")
+    started = str(tmp_path / "blocker_started.flag")
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=pin_to_node2)
+    def blocker():
+        open(started, "w").close()
+        while not os.path.exists(release):
+            time.sleep(0.05)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=pin_to_node2)
+    def consume(x):
+        return float(x.sum())
+
+    blocker_ref = blocker.remote()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not os.path.exists(started):
+        time.sleep(0.05)
+    assert os.path.exists(started)  # node2's only CPU is now occupied
+    big = ray_tpu.put(np.ones(1024 * 1024, dtype=np.float64))  # 8 MiB
+    target_ref = consume.remote(big)  # parks behind the blocker
+
+    def pins_on_node2() -> int:
+        conn = rpc.connect(node2.address, timeout=5.0)
+        try:
+            out = conn.call("object_pins",
+                            {"object_ids": [big.id.binary()]}, timeout=10)
+        finally:
+            conn.close()
+        return int(out.get(big.id.hex(), 0))
+
+    # prefetch fired on lease arrival: the argument lands in node2's shm,
+    # pinned, while the task is still parked behind the blocker
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and pins_on_node2() <= 0:
+        time.sleep(0.1)
+    assert pins_on_node2() >= 1, "argument was never prefetched + pinned"
+
+    # the task never dispatches; the pin must drop after the TTL instead
+    # of keeping the bytes unevictable forever
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and pins_on_node2() > 0:
+        time.sleep(0.2)
+    assert pins_on_node2() == 0, "prefetch pin leaked past its TTL"
+
+    # end-to-end sanity: unblocking dispatches the task, whose fetch is a
+    # local hit (the prefetched copy is unpinned, not deleted)
+    open(release, "w").close()
+    assert ray_tpu.get(blocker_ref, timeout=120) == "done"
+    assert ray_tpu.get(target_ref, timeout=120) == float(1024 * 1024)
+    ray_tpu.shutdown()
+
+
 def test_spill_and_restore_roundtrip():
     """A working set ~3x the store capacity round-trips through disk spill
     (reference LocalObjectManager + external_storage semantics)."""
@@ -137,6 +284,49 @@ def test_spill_and_restore_roundtrip():
         assert float(value[0]) == float(i)
         assert float(value[-1]) == float(i)
         del value
+    ray_tpu.shutdown()
+
+
+def test_spilled_chunk_served_despite_unsealed_local_create():
+    """A chunk request for a locally-spilled object must serve from the
+    spill file even while an UNSEALED create for the same oid sits in the
+    shared store (a pull's destination buffer, which only seals after
+    this very reply): answering absent there is what drops a node with a
+    perfectly recoverable copy from the owner's location set."""
+    from ray_tpu._private import rpc
+
+    store_mem = 48 * 1024 * 1024
+    ray_tpu.init(num_cpus=2, object_store_memory=store_mem)
+    w = _worker()
+    refs = [ray_tpu.put(np.full(1024 * 1024, i, dtype=np.float64))
+            for i in range(10)]  # 80 MiB: the oldest objects spill
+    deadline = time.monotonic() + 30
+    spilled = None
+    while spilled is None and time.monotonic() < deadline:
+        for r in refs:
+            if not w.store.contains(r.id):
+                spilled = r
+                break
+        time.sleep(0.1)
+    assert spilled is not None, "nothing spilled"
+    # stage the race: the pull engine has allocated (not yet sealed) the
+    # destination for this object in the node's shared store
+    with w._owned_lock:
+        size = w._owned[spilled.id].size
+    buf = w.store.create(spilled.id, size, allow_evict=False)
+    try:
+        conn = rpc.connect(tuple(w.raylet_addr), timeout=5)
+        try:
+            res = conn.call("fetch_object_chunk",
+                            {"object_id": spilled.id.binary(), "offset": 0,
+                             "length": size, "timeout": 0.0}, timeout=30)
+        finally:
+            conn.close()
+        assert res is not None, "raylet answered authoritative absent"
+        assert res["total"] == size and len(res["data"]) == size
+    finally:
+        buf.release()
+        w.store.abort(spilled.id)
     ray_tpu.shutdown()
 
 

@@ -181,6 +181,83 @@ def test_timed_out_call_is_reaped(fuzz):
         srv.stop()
 
 
+def test_buffer_sink_receives_payload_in_place(fuzz):
+    """A call's registered buffer sink gets the response's out-of-band
+    payload recv_into'd straight into its destination view, and the
+    deserialized reply references that same memory (the data plane's
+    zero-copy landing, docs/object_transfer.md)."""
+    import pickle
+
+    blob = bytes(range(256)) * 16  # 4 KiB
+
+    def handler(conn, method, payload):
+        if method == "oob":
+            return {"data": pickle.PickleBuffer(blob)}
+        return {"data": blob}  # in band: the sink must NOT be used
+
+    srv = rpc.Server(handler)
+    conn = rpc.connect(srv.address)
+    try:
+        dest = bytearray(len(blob))
+        hits = []
+
+        def sink(lens):
+            if len(lens) == 1 and lens[0] <= len(dest):
+                hits.append(lens[0])
+                return [memoryview(dest)[:lens[0]]]
+            return None
+
+        res = conn.call_async("oob", buffer_sink=sink).result(30)
+        assert hits == [len(blob)]
+        assert bytes(dest) == blob, "payload did not land in the sink"
+        assert bytes(res["data"]) == blob
+        assert not conn._sinks, "consumed sink must be unregistered"
+
+        # an in-band reply never consults the sink but still drops the
+        # registration (no leak)
+        dest2 = bytearray(len(blob))
+        res2 = conn.call_async(
+            "inband",
+            buffer_sink=lambda lens: [memoryview(dest2)[:lens[0]]]
+        ).result(30)
+        assert bytes(res2["data"]) == blob
+        assert bytes(dest2) == bytes(len(blob)), "sink wrongly used"
+        assert not conn._sinks
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_discarded_sink_falls_back_to_fresh_storage(fuzz):
+    """discard_sinks withdraws a destination before the reply lands: the
+    reader must fall back to fresh storage and never touch the withdrawn
+    view (the engine releases it right after)."""
+    import pickle
+
+    blob = b"q" * 1024
+    gate = threading.Event()
+
+    def handler(conn, method, payload):
+        gate.wait(10)  # hold the reply until the sink is withdrawn
+        return {"data": pickle.PickleBuffer(blob)}
+
+    srv = rpc.Server(handler)
+    conn = rpc.connect(srv.address)
+    try:
+        dest = bytearray(len(blob))
+        fut = conn.call_async(
+            "oob", buffer_sink=lambda lens: [memoryview(dest)[:lens[0]]])
+        conn.discard_sinks([fut._rpc_msg_id])
+        gate.set()
+        res = fut.result(30)
+        assert bytes(res["data"]) == blob
+        assert bytes(dest) == bytes(len(blob)), \
+            "withdrawn sink was written to"
+    finally:
+        conn.close()
+        srv.stop()
+
+
 def test_push_closes_connection_on_dead_socket(fuzz):
     """Satellite: push() on a dead socket must close the connection (so
     pubsub cleanup runs and later pushes fail fast) instead of silently
@@ -247,6 +324,9 @@ def _make_owner(raylet_addr):
             self._shutdown = threading.Event()
             self._raylet = rpc.connect(raylet_addr)
             self._oom_retries = {}
+            self._arg_refs = {}
+            self._owned = {}
+            self._owned_lock = threading.Lock()
             self.job_id = JobID.from_random()
             self.replies = []
             self.errors = []
