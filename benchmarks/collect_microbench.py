@@ -78,6 +78,15 @@ SECTIONS = {
                                  os.path.join(REPO, "benchmarks",
                                               "object_transfer_perf.py")],
                             timeout=900),
+    # collective data plane (docs/collective.md): interleaved same-box
+    # A/B of the rebuilt DCN group (pipelined shm/TCP ring,
+    # hierarchical) vs the legacy blocking ring at 1KiB/1MiB/64MiB and
+    # world sizes 2/4/8 (>=3x bar at 64MiB ws4), the zero-TCP-bytes
+    # same-node bar, and the multi-source 64MiB broadcast (>=2 sources)
+    "collective": dict(cmd=[sys.executable,
+                            os.path.join(REPO, "benchmarks",
+                                         "collective_perf.py")],
+                       timeout=2400),
     # always-on runtime telemetry cost guard (docs/observability.md):
     # interleaved same-box A/B of task throughput with
     # RAY_TPU_TELEMETRY=0 vs 1; the overhead_pct row is the <=3% bar
@@ -131,6 +140,14 @@ _COMPILED_DAG_ROWS = {
 _OBJECT_TRANSFER_ROWS = {
     "pull 64MiB pipelined": "pull_pipelined_mb_s",
     "pull 64MiB striped 2-source busy hosts": "pull_striped_mb_s",
+}
+
+# Collective rows (docs/collective.md): the DCN data plane's allreduce /
+# broadcast bandwidth must stay visible the same way.
+_COLLECTIVE_ROWS = {
+    "allreduce 64MiB ws4 new": "collective_allreduce_ws4_mb_s",
+    "allreduce 64MiB ws2 new": "collective_allreduce_ws2_mb_s",
+    "broadcast 64MiB ws4 new": "collective_broadcast_ws4_mb_s",
 }
 
 
@@ -217,6 +234,27 @@ def object_transfer_deltas(rows, committed):
         if not isinstance(row, dict):
             continue
         key = _OBJECT_TRANSFER_ROWS.get(row.get("name"))
+        if key is None or not base.get(row["name"]) \
+                or not row.get("mb_per_s"):
+            continue
+        prev, cur = base[row["name"]], row["mb_per_s"]
+        out[key] = {"committed_mb_s": prev, "current_mb_s": cur,
+                    "ratio": round(cur / prev, 3)}
+    return out
+
+
+def collective_deltas(rows, committed):
+    """Same contract for the collective section's bandwidth rows."""
+    if not committed:
+        return {}
+    base = {r["name"]: r.get("mb_per_s")
+            for r in committed.get("collective", [])
+            if isinstance(r, dict)}
+    out = {}
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        key = _COLLECTIVE_ROWS.get(row.get("name"))
         if key is None or not base.get(row["name"]) \
                 or not row.get("mb_per_s"):
             continue
@@ -322,7 +360,7 @@ def main():
 
     committed = None
     if regenerated & {"core", "streaming", "compiled_dag",
-                      "object_transfer"}:
+                      "object_transfer", "collective"}:
         committed = _committed_baseline(args.output)
     if "core" in regenerated:
         deltas = control_plane_deltas(out["core"], committed)
@@ -355,6 +393,15 @@ def main():
         deltas = object_transfer_deltas(out["object_transfer"], committed)
         if deltas:
             out["object_transfer_deltas"] = deltas
+            for key, d in deltas.items():
+                tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
+                print(f"[collect] {key}: {d['committed_mb_s']:,.0f} -> "
+                      f"{d['current_mb_s']:,.0f} MB/s "
+                      f"(x{d['ratio']}) [{tag}]", flush=True)
+    if "collective" in regenerated:
+        deltas = collective_deltas(out["collective"], committed)
+        if deltas:
+            out["collective_deltas"] = deltas
             for key, d in deltas.items():
                 tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
                 print(f"[collect] {key}: {d['committed_mb_s']:,.0f} -> "

@@ -1,0 +1,321 @@
+"""Collective data-plane microbenchmark (docs/collective.md).
+
+Interleaved same-box A/B of the rebuilt DCN collective group against the
+pre-ISSUE-6 ring, so this box's VM-throttle drift hits both arms equally
+(medians of per-round rates are reported):
+
+* **new vs legacy allreduce** — allreduce at 1 KiB / 1 MiB / 64 MiB and
+  world sizes 2/4/8 on a same-node group.  The new arm rides the data
+  plane (segmented pipelined ring, shm channels between the colocated
+  ranks, hierarchical reduce, recursive doubling below the small
+  threshold); the legacy arm reproduces the old algorithm verbatim
+  (``legacy_allreduce`` below: one blocking ``conn.call`` per ring step
+  carrying a fully-pickled numpy copy over TCP loopback, zero overlap).
+  The 64 MiB world-4 row is the >=3x acceptance bar.
+* **zero-TCP assertion** — after the new-arm ops, every rank's
+  ``ray_tpu_collective_tcp_bytes_total`` must read exactly 0 on the
+  same-node group (the shm-transport bar).
+* **broadcast 64 MiB** — new (object-transfer-plane route) vs legacy
+  (store-and-forward ring).
+* **multi-source broadcast** — a 4-rank group spread over 4 simulated
+  nodes (cluster_utils), rank starts staggered so completed ranks
+  become additional sources: per-rank ``ray_tpu_pull_sources``
+  telemetry must show >= 2 distinct sources used by at least one rank
+  (the ROADMAP item 4 weight-sync shape).
+
+Run on an IDLE box (MICROBENCH policy): ratios are load-sensitive.
+
+Prints JSON lines (names are collect_microbench delta keys):
+  {"name": "allreduce <size> ws<N> legacy", "mb_per_s": ...}
+  {"name": "allreduce <size> ws<N> new",    "mb_per_s": ...}
+  {"name": "allreduce <size> ws<N> speedup", "speedup": ...}
+  {"name": "collective tcp bytes same-node", "tcp_bytes": 0.0}
+  {"name": "broadcast 64MiB ws4 legacy|new", "mb_per_s": ...}
+  {"name": "bcast 64MiB multi-source", "nsources_max": ..., ...}
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("RAY_TPU_TELEMETRY", "1")
+
+ROUNDS = int(os.environ.get("COLLECTIVE_BENCH_ROUNDS", "3"))
+WORLDS = [int(w) for w in
+          os.environ.get("COLLECTIVE_BENCH_WORLDS", "2,4,8").split(",")]
+SIZES = [("1KiB", 256), ("1MiB", 256 * 1024), ("64MiB", 16 * 1024 * 1024)]
+SKIP_MULTINODE = os.environ.get("COLLECTIVE_BENCH_SKIP_MULTINODE") == "1"
+
+import numpy as np  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@ray_tpu.remote
+class BenchRank:
+    def __init__(self, world, rank, name, cfg=None):
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.util import collective as col
+        CONFIG.update(cfg or {})
+        self.col = col
+        self.name = name
+        self.rank = rank
+        self.world = world
+        col.init_collective_group(world, rank, group_name=name,
+                                  timeout=120.0)
+        self.x = None
+
+    def prep(self, nelems):
+        self.x = np.random.RandomState(self.rank).uniform(
+            1.0, 2.0, nelems).astype(np.float32)
+        return True
+
+    def allreduce_new(self):
+        t0 = time.perf_counter()
+        out = self.col.allreduce(self.x, self.name)
+        dt = time.perf_counter() - t0
+        return dt, float(out[0])
+
+    def broadcast_new(self, src, stagger_s=0.0):
+        if stagger_s and self.rank != src:
+            time.sleep(stagger_s * self.rank)
+        t0 = time.perf_counter()
+        out = self.col.broadcast(self.x, src, self.name)
+        dt = time.perf_counter() - t0
+        return dt, float(out[0])
+
+    # ------------------------------------------------------------ legacy
+    # The pre-ISSUE-6 ring, verbatim: every step is one blocking
+    # conn.call("msg") carrying a fully-pickled numpy chunk copy over
+    # TCP, recv via the mailbox — send -> recv -> reduce serialized,
+    # ~4 copies per tensor, loopback TCP between colocated ranks.
+    def _legacy_send(self, g, peer, tag, data):
+        g._conn_to(peer).call(
+            "msg", {"src": self.rank, "tag": tag, "data": data},
+            timeout=120.0)
+
+    def _legacy_recv(self, g, peer, tag):
+        return g._mailbox.get(peer, tag, 120.0)
+
+    def legacy_allreduce(self):
+        from ray_tpu.util.collective.collective import _get
+        g = _get(self.name)
+        x = self.x
+        n = self.world
+        t0 = time.perf_counter()
+        if n == 1:
+            return 0.0, float(x[0])
+        self._lseq = getattr(self, "_lseq", 0) + 1
+        tag = f"lg:{self._lseq}"   # unsequenced: mailbox keeps it
+        flat = x.reshape(-1).astype(x.dtype, copy=True)
+        chunks = np.array_split(flat, n)
+        nxt, prv = (self.rank + 1) % n, (self.rank - 1) % n
+        for step in range(n - 1):
+            send_idx = (self.rank - step) % n
+            recv_idx = (self.rank - step - 1) % n
+            self._legacy_send(g, nxt, f"{tag}:rs{step}", chunks[send_idx])
+            incoming = self._legacy_recv(g, prv, f"{tag}:rs{step}")
+            chunks[recv_idx] = np.add(chunks[recv_idx], incoming)
+        for step in range(n - 1):
+            send_idx = (self.rank - step + 1) % n
+            recv_idx = (self.rank - step) % n
+            self._legacy_send(g, nxt, f"{tag}:ag{step}", chunks[send_idx])
+            chunks[recv_idx] = self._legacy_recv(g, prv, f"{tag}:ag{step}")
+        out = np.concatenate(chunks).reshape(x.shape)
+        dt = time.perf_counter() - t0
+        return dt, float(out[0])
+
+    def legacy_broadcast(self, src):
+        from ray_tpu.util.collective.collective import _get
+        g = _get(self.name)
+        n = self.world
+        t0 = time.perf_counter()
+        self._lseq = getattr(self, "_lseq", 0) + 1
+        tag = f"lgb:{self._lseq}"
+        if self.rank == src:
+            out = self.x
+        else:
+            out = self._legacy_recv(g, (self.rank - 1) % n, tag)
+        nxt = (self.rank + 1) % n
+        if nxt != src:
+            self._legacy_send(g, nxt, tag, out)
+        dt = time.perf_counter() - t0
+        return dt, float(np.asarray(out)[0])
+
+    def metric(self, name):
+        from ray_tpu._private import runtime_metrics as rtm
+        rec = rtm.snapshot().get(name)
+        return rec["values"] if rec else None
+
+    def destroy(self):
+        self.col.destroy_collective_group(self.name)
+        return True
+
+
+def emit(row):
+    print(json.dumps(row), flush=True)
+
+
+def median_rate(times_s, nbytes):
+    return round(nbytes / max(1e-9, statistics.median(times_s)) / 2**20, 1)
+
+
+def bench_same_node():
+    ray_tpu.init(num_cpus=max(WORLDS) + 2,
+                 object_store_memory=1024 * 1024 * 1024)
+    try:
+        for world in WORLDS:
+            name = f"bench-{world}"
+            ranks = [BenchRank.remote(world, r, name) for r in range(world)]
+            for label, nelems in SIZES:
+                nbytes = nelems * 4
+                ray_tpu.get([r.prep.remote(nelems) for r in ranks],
+                            timeout=120)
+                new_t, old_t = [], []
+                for _ in range(ROUNDS):
+                    # interleaved A/B: box drift hits both arms equally
+                    outs = ray_tpu.get(
+                        [r.allreduce_new.remote() for r in ranks],
+                        timeout=600)
+                    new_t.append(max(dt for dt, _ in outs))
+                    outs = ray_tpu.get(
+                        [r.legacy_allreduce.remote() for r in ranks],
+                        timeout=600)
+                    old_t.append(max(dt for dt, _ in outs))
+                if label == "1KiB":
+                    # latency regime: MB/s rounds to noise
+                    old_l = statistics.median(old_t) * 1000.0
+                    new_l = statistics.median(new_t) * 1000.0
+                    emit({"name": f"allreduce {label} ws{world} legacy",
+                          "lat_ms": round(old_l, 2)})
+                    emit({"name": f"allreduce {label} ws{world} new",
+                          "lat_ms": round(new_l, 2)})
+                    emit({"name": f"allreduce {label} ws{world} speedup",
+                          "speedup": round(old_l / max(1e-6, new_l), 2)})
+                    continue
+                new_r = median_rate(new_t, nbytes)
+                old_r = median_rate(old_t, nbytes)
+                emit({"name": f"allreduce {label} ws{world} legacy",
+                      "mb_per_s": old_r})
+                emit({"name": f"allreduce {label} ws{world} new",
+                      "mb_per_s": new_r})
+                emit({"name": f"allreduce {label} ws{world} speedup",
+                      "speedup": round(new_r / max(0.001, old_r), 2)})
+            if world == 4:
+                # broadcast 64 MiB A/B on the same 4-rank group
+                nelems = SIZES[-1][1]
+                nbytes = nelems * 4
+                ray_tpu.get([r.prep.remote(nelems) for r in ranks],
+                            timeout=120)
+                new_t, old_t = [], []
+                for _ in range(ROUNDS):
+                    outs = ray_tpu.get(
+                        [r.broadcast_new.remote(0) for r in ranks],
+                        timeout=600)
+                    new_t.append(max(dt for dt, _ in outs))
+                    outs = ray_tpu.get(
+                        [r.legacy_broadcast.remote(0) for r in ranks],
+                        timeout=600)
+                    old_t.append(max(dt for dt, _ in outs))
+                emit({"name": "broadcast 64MiB ws4 legacy",
+                      "mb_per_s": median_rate(old_t, nbytes)})
+                emit({"name": "broadcast 64MiB ws4 new",
+                      "mb_per_s": median_rate(new_t, nbytes)})
+            if world == max(WORLDS):
+                tcp = 0.0
+                for r in ranks:
+                    v = ray_tpu.get(r.metric.remote(
+                        "ray_tpu_collective_tcp_bytes_total"), timeout=60)
+                    tcp += v["{}"] if v else 0.0
+                # same-node group: the shm transport must have moved
+                # EVERY collective byte (legacy arm bypasses the
+                # counter by construction)
+                emit({"name": "collective tcp bytes same-node",
+                      "tcp_bytes": tcp, "bar": "== 0"})
+            ray_tpu.get([r.destroy.remote() for r in ranks], timeout=120)
+            for r in ranks:
+                ray_tpu.kill(r)
+    finally:
+        ray_tpu.shutdown()
+
+
+def bench_multi_source():
+    """4 ranks on 4 simulated nodes: the cross-node (DCN) regime.
+    Interleaved allreduce A/B (pipelined zero-copy TCP ring vs the
+    legacy blocking ring — the transport-apples comparison the >=3x
+    bar describes), then the staggered multi-source broadcast
+    (>= 2 distinct sources observed)."""
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster(head_resources={"CPU": 4, "colrank0": 1},
+                      object_store_memory=512 * 1024 * 1024)
+    try:
+        for i in range(1, 4):
+            cluster.add_node(resources={"CPU": 4, f"colrank{i}": 1},
+                             object_store_memory=512 * 1024 * 1024)
+        ray_tpu.init(address=cluster.address)
+        nelems = 16 * 1024 * 1024  # 64 MiB float32
+        ranks = []
+        for i in range(4):
+            ranks.append(BenchRank.options(
+                resources={f"colrank{i}": 1}).remote(4, i, "ms-bcast"))
+        ray_tpu.get([r.prep.remote(nelems) for r in ranks], timeout=300)
+        nbytes = nelems * 4
+        new_t, old_t = [], []
+        for _ in range(ROUNDS):
+            outs = ray_tpu.get([r.allreduce_new.remote() for r in ranks],
+                               timeout=900)
+            new_t.append(max(dt for dt, _ in outs))
+            outs = ray_tpu.get(
+                [r.legacy_allreduce.remote() for r in ranks],
+                timeout=900)
+            old_t.append(max(dt for dt, _ in outs))
+        new_r = median_rate(new_t, nbytes)
+        old_r = median_rate(old_t, nbytes)
+        emit({"name": "allreduce 64MiB ws4 multinode legacy",
+              "mb_per_s": old_r})
+        emit({"name": "allreduce 64MiB ws4 multinode new",
+              "mb_per_s": new_r})
+        emit({"name": "allreduce 64MiB ws4 multinode speedup",
+              "speedup": round(new_r / max(0.001, old_r), 2)})
+        t0 = time.perf_counter()
+        ray_tpu.get([r.broadcast_new.remote(0, stagger_s=1.0)
+                     for r in ranks], timeout=900)
+        dt = time.perf_counter() - t0
+        nsources = []
+        for r in ranks[1:]:
+            v = ray_tpu.get(
+                r.metric.remote("ray_tpu_pull_sources"), timeout=60)
+            if v:
+                buckets = v["{}"]["buckets"]
+                # highest non-empty bucket boundary ~ max sources seen
+                nsources.append(max(float(k) if k != "+Inf" else 99.0
+                                    for k in buckets))
+        emit({"name": "bcast 64MiB multi-source",
+              "ranks": 4, "wall_s": round(dt, 2),
+              "nsources_max": max(nsources) if nsources else 0,
+              "nsources_per_rank": nsources,
+              "bar": "nsources_max >= 2"})
+        ray_tpu.get([r.destroy.remote() for r in ranks], timeout=120)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def main():
+    bench_same_node()
+    if not SKIP_MULTINODE:
+        bench_multi_source()
+
+
+if __name__ == "__main__":
+    main()

@@ -273,6 +273,53 @@ _declare("mesh_default_axes", dict, {"data": -1},
 _declare("collective_rendezvous_timeout_s", float, 60.0,
          "Timeout for host-collective group rendezvous via the GCS KV store.")
 _declare("collective_op_timeout_s", float, 120.0, "Host collective op timeout.")
+_declare("collective_chunk_bytes", int, 8 * 1024 * 1024,
+         "Ring-collective segment size: tensors are segmented into pieces "
+         "of this size that pipeline through the ring (step k+1's send "
+         "overlaps step k's recv+reduce) and bound per-message memory; "
+         "also the shm-channel slot payload size, so each same-node link "
+         "maps nslots * this many bytes of the store segment "
+         "(docs/collective.md).")
+_declare("collective_inflight_segments", int, 4,
+         "Pipelined take-request depth per collective link: how many "
+         "segment requests a rank keeps in flight to its ring "
+         "predecessor (each needs one staging buffer of "
+         "collective_chunk_bytes during reduce phases).")
+_declare("collective_small_max_bytes", int, 32 * 1024,
+         "Tensors at most this size use the latency-optimal recursive-"
+         "doubling allreduce (log2(N) rounds of whole-tensor exchanges) "
+         "instead of the bandwidth-optimal segmented ring.")
+_declare("collective_shm_enabled", bool, True,
+         "Exchange collective segments between same-node ranks over "
+         "shared-memory ring channels (experimental/channel.py) instead "
+         "of TCP loopback.")
+_declare("collective_shm_slot_bytes", int, 1024 * 1024,
+         "Slot payload size of same-node collective shm ring channels; "
+         "groups with colocated ranks segment by "
+         "min(collective_chunk_bytes, this), so a link costs "
+         "~slots * this of store segment instead of slots * chunk "
+         "(8 MiB chunks would charge ~50 MB per link).")
+_declare("collective_shm_slots", int, 6,
+         "Ring-slot count of each same-node collective shm channel "
+         "(per-directed-pair buffering; >= inflight window + 2 keeps "
+         "the pipelined ring from blocking on ring credit).")
+_declare("collective_flat_shm", bool, True,
+         "Single-node groups allreduce through the flat shared-memory "
+         "arena (each rank publishes its input once into the node store "
+         "and reduces its own chunk from all peers' mapped views) "
+         "instead of the segmented ring, when ~2.5x the group's tensor "
+         "footprint fits the store (docs/collective.md).")
+_declare("collective_hierarchical", bool, True,
+         "Two-level collectives when ranks are colocated: intra-node "
+         "reduce over shm to a per-node leader, inter-node ring among "
+         "leaders, intra-node broadcast of the result.")
+_declare("collective_bcast_store_min_bytes", int, 4 * 1024 * 1024,
+         "broadcast() payloads at least this size move over the object-"
+         "transfer data plane instead of the ring — when the group spans "
+         "more than one node: the source puts the tensor once and every "
+         "rank pulls it multi-source-striped, each completed rank "
+         "becoming an additional source (docs/object_transfer.md).  "
+         "Same-node-only groups always use the shm ring chain.")
 
 # --------------------------------------------------------------------------- #
 # Libraries                                                                   #

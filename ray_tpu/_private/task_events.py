@@ -24,12 +24,15 @@ FINISHED = "FINISHED"
 FAILED = "FAILED"
 # instant markers (timeline dots, not lifecycle transitions): they never
 # update a record's state — a streaming task stays RUNNING while its
-# per-yield STREAM_ITEM instants accumulate, and a PULL (one inter-node
+# per-yield STREAM_ITEM instants accumulate, a PULL (one inter-node
 # object transfer for the task's output, docs/object_transfer.md) rides
-# whatever lifecycle state the task is in
+# whatever lifecycle state the task is in, and a COLLECTIVE (one host-
+# collective op on a rank's synthetic ``col-<group>-r<rank>`` record,
+# docs/collective.md) never has a lifecycle at all
 STREAM_ITEM = "STREAM_ITEM"
 PULL = "PULL"
-_INSTANT_STATES = frozenset({STREAM_ITEM, PULL})
+COLLECTIVE = "COLLECTIVE"
+_INSTANT_STATES = frozenset({STREAM_ITEM, PULL, COLLECTIVE})
 
 _STATE_RANK = {SUBMITTED: 1, PENDING_NODE_ASSIGNMENT: 2, RUNNING: 3,
                FINISHED: 4, FAILED: 4}
@@ -157,9 +160,11 @@ class GcsTaskTable:
                 if "index" in ev:   # per-yield stream instants
                     entry["index"] = ev["index"]
                 for field in ("dur_ms", "bytes", "nsources", "object_id",
-                              "node_id", "worker_id"):
-                    if field in ev:  # per-pull transfer slices (node/
-                        # worker = the PULLING process, not the producer)
+                              "node_id", "worker_id", "op", "algo",
+                              "world"):
+                    if field in ev:  # per-pull transfer / per-op
+                        # collective slices (node/worker = the pulling /
+                        # participating process, not a producer task)
                         entry[field] = ev[field]
                 rec["events"].append(entry)
                 rec["events"].sort(key=lambda e: e["ts"])

@@ -64,6 +64,11 @@ _M_FAILOVER = rtm.counter(
 _M_BUDGET_WAIT = rtm.histogram(
     "ray_tpu_pull_budget_wait_ms",
     "time a multi-chunk pull waited for pull-budget admission (ms)")
+_M_SOURCES = rtm.histogram(
+    "ray_tpu_pull_sources",
+    "distinct sources a completed multi-chunk pull striped across "
+    "(collective broadcast fan-out rides this, docs/collective.md)",
+    boundaries=rtm.COUNT_BOUNDARIES)
 
 
 class PullBudget:
@@ -181,12 +186,13 @@ class PullOutcome:
 
 
 class _SourceState:
-    __slots__ = ("node", "conn", "outcome")
+    __slots__ = ("node", "conn", "outcome", "served")
 
     def __init__(self, node: str, conn: rpc.Connection):
         self.node = node
         self.conn = conn
         self.outcome = "ok"
+        self.served = 0   # chunks this source actually delivered
 
 
 class _PullState:
@@ -420,6 +426,11 @@ class ObjectPuller:
 
         if ps.done >= total:
             _M_PULL_BYTES.inc(total)
+            # only sources that actually delivered chunks count: an
+            # idle secondary (primary drained the queue first) must not
+            # inflate the striping fan-out this records
+            _M_SOURCES.observe(
+                sum(1 for st in states if st.served > 0))
             data, published = self._publish_dest(oid, dest, mv, kind)
             if data is None:
                 # sealed copy vanished before we could pin it (freed or
@@ -547,6 +558,7 @@ class ObjectPuller:
                 fail("absent", popped=off, popped_fut=fut)
                 return
             _M_CHUNK_RTT.observe((time.monotonic() - t_sent) * 1000.0)
+            st.served += 1
             if not used:
                 # in-band reply (spilled-object path, legacy server):
                 # land it at its offset ourselves

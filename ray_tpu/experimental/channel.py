@@ -138,6 +138,13 @@ class Channel:
         self._slot_stride = _SLOT_HEADER + self.capacity
         self._closed = False
         self._close_lock = threading.Lock()
+        # yield-spin budget before the sleep backoff: compiled DAGs
+        # keep the aggressive default (latency-critical, usually more
+        # cores than spinners); participants with MANY channels per
+        # core (collective rings, docs/collective.md) turn it down —
+        # N ranks yield-spinning on fewer cores starve the one rank
+        # that has real work, inverting the latency win
+        self.spin_yields = _SPIN_YIELDS
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -243,7 +250,7 @@ class Channel:
               stop: Optional[threading.Event], what: str) -> None:
         """Poll ``ready()`` with yield-spin then backoff; raises on
         poison / stop / timeout.  Shared by reader and writer."""
-        for _ in range(_SPIN_YIELDS):
+        for _ in range(self.spin_yields):
             if ready():
                 return
             time.sleep(0)
@@ -281,6 +288,13 @@ class ChannelWriter:
     def __init__(self, channel: Channel):
         self.channel = channel
         self.seq = 0                   # items published so far
+
+    def writable(self) -> bool:
+        """True when the ring has a free slot, i.e. the next write will
+        not block on ring credit.  Callers that must never block (the
+        collective segment outbox, docs/collective.md) poll this and
+        queue locally instead."""
+        return self.channel._min_acks() > self.seq - self.channel.nslots
 
     def write_payload(self, head: bytes, views: List[memoryview],
                       flags: int = 0, timeout: Optional[float] = None,
@@ -348,12 +362,16 @@ class ChannelReader:
         self.idx = idx
         self.seq = 0                   # items consumed so far
 
-    def read_raw(self, timeout: Optional[float] = None,
-                 stop: Optional[threading.Event] = None
-                 ) -> Tuple[bytes, int]:
-        """Blocking next item as (payload bytes, flags).  The payload is
-        copied out of the ring before acking, so the returned bytes stay
-        valid across slot reuse."""
+    def read_zc(self, timeout: Optional[float] = None,
+                stop: Optional[threading.Event] = None):
+        """Zero-copy blocking read: returns ``(payload_view, flags,
+        ack)``.  The view maps the ring slot DIRECTLY — consume it
+        (deserialize / reduce / copy out), then call ``ack()`` exactly
+        once to release the slot; the view is invalid afterwards.  Acks
+        must fire in read order (each ack publishes its own cumulative
+        counter, so acking item k+1 before k would release k's slot
+        early).  The collective shm transport reduces straight out of
+        the ring through this (docs/collective.md)."""
         ch = self.channel
         k = self.seq
         off = ch._slot_off(k)
@@ -368,10 +386,27 @@ class ChannelReader:
             _M_READ_WAIT.observe_since(t0)
         size = _U64.unpack_from(view, off + 8)[0]
         flags = _U64.unpack_from(view, off + 16)[0]
-        payload = bytes(view[off + _SLOT_HEADER:off + _SLOT_HEADER + size])
-        # ack AFTER the copy: the writer may reuse the slot immediately
-        _U64.pack_into(view, ch._acks_off + 8 * self.idx, want)
+        payload = view[off + _SLOT_HEADER:off + _SLOT_HEADER + size]
+
+        def ack(_view=view, _ch=ch, _idx=self.idx, _want=want):
+            try:
+                _U64.pack_into(_view, _ch._acks_off + 8 * _idx, _want)
+            except ValueError:
+                pass  # channel closed underneath a late ack
+
         self.seq = want
+        return payload, flags, ack
+
+    def read_raw(self, timeout: Optional[float] = None,
+                 stop: Optional[threading.Event] = None
+                 ) -> Tuple[bytes, int]:
+        """Blocking next item as (payload bytes, flags).  The payload is
+        copied out of the ring before acking, so the returned bytes stay
+        valid across slot reuse."""
+        view, flags, ack = self.read_zc(timeout=timeout, stop=stop)
+        payload = bytes(view)
+        # ack AFTER the copy: the writer may reuse the slot immediately
+        ack()
         return payload, flags
 
     def read(self, timeout: Optional[float] = None,

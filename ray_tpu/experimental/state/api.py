@@ -174,6 +174,9 @@ def timeline(path: Optional[str] = None) -> List[dict]:
     * inter-node object pulls of a task's output appear as ``transfer``
       slices (``PULL`` events carrying duration/bytes/source count,
       docs/object_transfer.md) on the pulling process's row;
+    * host-collective ops appear as ``collective`` slices
+      (``COLLECTIVE`` events carrying op/algorithm/bytes/world size,
+      docs/collective.md) on each participating rank's row;
     * every event carries the submitting span's ``trace_id`` in its
       args when one was propagated, so user spans (``span(...)``),
       tasks and stream items correlate in Perfetto.
@@ -185,6 +188,7 @@ def timeline(path: Optional[str] = None) -> List[dict]:
         start = end = None
         items = []
         pulls = []
+        cols = []
         for ev in t.get("events", []):
             if ev["state"] == "RUNNING":
                 start = ev["ts"]
@@ -194,6 +198,31 @@ def timeline(path: Optional[str] = None) -> List[dict]:
                 items.append(ev)
             elif ev["state"] == "PULL":
                 pulls.append(ev)
+            elif ev["state"] == "COLLECTIVE":
+                cols.append(ev)
+        for ev in cols:
+            # one host-collective op (docs/collective.md): rides the
+            # rank's synthetic col-<group>-r<rank> record, which has no
+            # lifecycle of its own — the slice stands alone on the
+            # participating process's row
+            dur_s = float(ev.get("dur_ms", 0.0)) / 1e3
+            events.append({
+                "name": f"{ev.get('op', 'collective')}"
+                        f"[{ev.get('algo', '?')}]"
+                        f" ({ev.get('bytes', 0)} B)",
+                "cat": "collective",
+                "ph": "X",
+                "ts": (ev["ts"] - dur_s) * 1e6,
+                "dur": dur_s * 1e6,
+                "pid": ev.get("node_id", t.get("node_id", "node"))[:8],
+                "tid": ev.get("worker_id",
+                              t.get("worker_id", "worker"))[:8],
+                "args": {"task_id": t["task_id"],
+                         "bytes": ev.get("bytes", 0),
+                         "op": ev.get("op", ""),
+                         "algo": ev.get("algo", ""),
+                         "world": ev.get("world", 0)},
+            })
         for ev in pulls:
             # a pull may happen long after the task finished (a borrower
             # fetching the output): its slice stands on its own

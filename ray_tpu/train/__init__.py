@@ -12,7 +12,7 @@ from ray_tpu.train.base_trainer import (BackendConfig,  # noqa: F401
 from ray_tpu.train.huggingface_trainer import \
     HuggingFaceTrainer  # noqa: F401
 from ray_tpu.train.jax_trainer import (JaxConfig, JaxTrainer,  # noqa: F401
-                                       get_mesh)
+                                       get_mesh, sync_gradients)
 from ray_tpu.train.gbdt_trainer import (GBDTTrainer,  # noqa: F401
                                         LightGBMTrainer, SklearnPredictor,
                                         XGBoostTrainer)
@@ -30,6 +30,7 @@ from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401
 __all__ = [
     "BaseTrainer", "DataParallelTrainer", "BackendConfig",
     "TrainingFailedError", "JaxTrainer", "JaxConfig", "get_mesh",
+    "sync_gradients",
     "TorchTrainer", "TorchConfig", "prepare_model", "prepare_data_loader",
     "WorkerGroup", "TrainWorker", "make_sharded_train", "OptimizerConfig",
     "make_vision_train", "classification_loss_fn", "Predictor",

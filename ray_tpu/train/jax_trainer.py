@@ -11,7 +11,9 @@ step function (see ray_tpu.train.step.make_sharded_train).
 
 from __future__ import annotations
 
+import os
 import threading
+import uuid
 from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.air.config import RunConfig, ScalingConfig
@@ -28,18 +30,35 @@ class JaxConfig(BackendConfig):
     host); in tests each worker sees the 8 virtual CPU devices of its own
     process — ``world_size=1`` exercises real meshes, multi-worker exercises
     the rendezvous path.
+
+    ``host_collective`` (default on for multi-worker gangs) additionally
+    rendezvouses a DCN collective group over the workers
+    (docs/collective.md), so loops whose gradient reduction is NOT
+    compiled into the step — workers running separate JAX runtimes,
+    cross-slice sync — go through the host data plane's ``allreduce``
+    via :func:`ray_tpu.train.sync_gradients`.
     """
 
     def __init__(self, init_distributed: bool = True,
-                 platform: Optional[str] = None):
+                 platform: Optional[str] = None,
+                 host_collective: bool = True):
         self.init_distributed = init_distributed
         # force a backend on the workers (e.g. "cpu" to rendezvous a
         # multi-process gloo mesh in tests / on chipless hosts); None
         # keeps whatever the worker environment selects (libtpu on pods)
         self.platform = platform
+        self.host_collective = host_collective
+        self._group_name: Optional[str] = None
 
     def on_start(self, worker_group: WorkerGroup,
                  scaling: ScalingConfig) -> None:
+        if scaling.num_workers > 1 and self.host_collective:
+            # unique name per run: the nonce-namespaced rendezvous makes
+            # even name reuse safe, but a fresh name keeps concurrent
+            # trainers in one cluster from colliding at all
+            self._group_name = f"train-{uuid.uuid4().hex[:8]}"
+            worker_group.execute("init_host_collective",
+                                 scaling.num_workers, self._group_name)
         if not self.init_distributed or scaling.num_workers <= 1:
             return
         if self.platform:
@@ -51,6 +70,13 @@ class JaxConfig(BackendConfig):
         worker_group.execute("setup_jax_distributed", coordinator)
 
     def on_shutdown(self, worker_group: WorkerGroup) -> None:
+        if self._group_name is not None:
+            try:
+                worker_group.execute("destroy_host_collective",
+                                     self._group_name)
+            except Exception:
+                pass
+            self._group_name = None
         try:
             worker_group.execute("shutdown_jax_distributed")
         except Exception:
@@ -94,6 +120,56 @@ class JaxTrainer(DataParallelTrainer):
             run_config=run_config,
             datasets=datasets,
             resume_from_checkpoint=resume_from_checkpoint)
+
+
+def sync_gradients(tree: Any, *, group_name: Optional[str] = None,
+                   op: str = "sum", average: bool = True) -> Any:
+    """Gradient sync over the gang's host (DCN) collective group.
+
+    Flattens a pytree of arrays, buckets the leaves into ONE contiguous
+    buffer per dtype (one ``allreduce`` per dtype instead of one per
+    leaf — the classic gradient-bucketing trick), reduces the buckets
+    through :func:`ray_tpu.util.collective.allreduce` (pipelined ring /
+    hierarchical shm data plane, docs/collective.md) and unflattens.
+    ``average=True`` divides float results by the world size.
+
+    Inside a :class:`JaxTrainer` loop the group set up by ``JaxConfig``
+    (``host_collective=True``) is found automatically; no-op when no
+    group exists (single-worker runs).
+    """
+    import jax
+    import numpy as np
+    from ray_tpu.util import collective as col
+
+    group_name = group_name or os.environ.get(
+        "RAY_TPU_TRAIN_COLLECTIVE_GROUP", "")
+    if not group_name or not col.is_group_initialized(group_name):
+        return tree
+    world = col.get_collective_group_size(group_name)
+    if world <= 1:
+        return tree
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrs = [np.asarray(leaf) for leaf in leaves]
+    by_dtype: Dict[Any, list] = {}
+    for idx, a in enumerate(arrs):
+        by_dtype.setdefault(a.dtype, []).append(idx)
+    out = list(arrs)
+    for dtype, idxs in by_dtype.items():
+        # allreduce never mutates its input (ring/rd copy internally,
+        # the shm arena reads slab-side): single-leaf buckets need no
+        # defensive copy
+        bucket = np.concatenate(
+            [arrs[i].reshape(-1) for i in idxs]) if len(idxs) > 1 \
+            else arrs[idxs[0]].reshape(-1)
+        reduced = col.allreduce(bucket, group_name, op)
+        if average and op == "sum" and np.issubdtype(dtype, np.floating):
+            reduced = reduced / world
+        off = 0
+        for i in idxs:
+            n = arrs[i].size
+            out[i] = reduced[off:off + n].reshape(arrs[i].shape)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def get_mesh(mesh_shape: Optional[Dict[str, int]] = None):

@@ -92,6 +92,33 @@ class TrainWorker:
         import jax
         return jax.device_count()
 
+    # -- host (DCN) collectives -------------------------------------------
+    def init_host_collective(self, world_size: int,
+                             group_name: str) -> None:
+        """Join the gang's host-collective group (docs/collective.md):
+        the DCN plane gradient sync / weight broadcast ride when the
+        reduction isn't compiled into the step (cross-runtime workers,
+        cross-slice sync).  The group name is exported so
+        :func:`ray_tpu.train.sync_gradients` finds it from inside the
+        user train loop."""
+        from ray_tpu.util import collective as col
+        col.init_collective_group(world_size, self.world_rank,
+                                  group_name=group_name)
+        os.environ["RAY_TPU_TRAIN_COLLECTIVE_GROUP"] = group_name
+
+    def destroy_host_collective(self, group_name: str) -> None:
+        from ray_tpu.util import collective as col
+        try:
+            col.destroy_collective_group(group_name)
+        finally:
+            os.environ.pop("RAY_TPU_TRAIN_COLLECTIVE_GROUP", None)
+
+    def host_allreduce(self, arr, op: str = "sum"):
+        """Debug/test hook: one allreduce on the gang's host group."""
+        from ray_tpu.util import collective as col
+        return col.allreduce(
+            arr, os.environ["RAY_TPU_TRAIN_COLLECTIVE_GROUP"], op)
+
     # -- train loop lifecycle ---------------------------------------------
     def start_training(self, train_fn: Callable, config: Dict[str, Any],
                        *, trial_name: str = "", trial_id: str = "",

@@ -1,30 +1,55 @@
-"""Host-level (DCN) collective groups: ring allreduce & friends over TCP.
+"""Host-level (DCN) collective groups: a zero-copy pipelined data plane.
 
 Design notes (vs the reference's NCCL/Gloo groups,
 /root/reference/python/ray/util/collective/collective_group/):
 
 - Rendezvous rides the GCS KV (the reference uses a named actor store):
-  each rank publishes its listening address under
-  ``collective/<group>/<rank>`` and polls for the full ring.
+  rank 0 publishes a per-incarnation **nonce** that namespaces every
+  address key (``collective/<group>/<nonce>/<rank>``), so re-creating a
+  group with a previously-used name can never rendezvous against a dead
+  incarnation's stale address; each rank publishes its listening
+  address + node id and polls for the full ring.
 - allreduce/reducescatter/allgather use the bandwidth-optimal ring
   algorithm (2*(N-1) steps, each moving 1/N of the data), the same
-  schedule NCCL uses — here over host sockets because on TPU the
-  intra-slice fabric (ICI) is only reachable in-graph via XLA.
+  schedule NCCL uses — **pipelined**: tensors are segmented into
+  ``collective_chunk_bytes`` pieces chained per segment, so step k+1's
+  send overlaps step k's recv+reduce (docs/collective.md).
+- Transports (ray_tpu/util/collective/transport.py): same-node ranks
+  exchange segments over shared-memory ring channels; cross-node pairs
+  use receiver-driven TCP pull links whose replies land via
+  ``recv_into`` buffer sinks directly in the consumer's accumulator /
+  output buffer (zero-copy, docs/rpc_fastpath.md).
+- Small tensors (<= ``collective_small_max_bytes``) take a latency-
+  optimal recursive-doubling path; colocated ranks take a hierarchical
+  two-level path (intra-node shm reduce -> inter-node leader ring ->
+  intra-node shm broadcast); large ``broadcast()`` payloads ride the
+  multi-source object-transfer plane (docs/object_transfer.md), every
+  completed rank becoming an additional source.
 - Tensors are numpy arrays (JAX arrays are converted on the way in and
   returned as numpy; callers on the hot path should use in-graph
-  collectives instead).
+  collectives instead — see :mod:`ray_tpu.util.collective.ici`).
 """
 
 from __future__ import annotations
 
+import json
+import pickle
 import threading
 import time
+import uuid
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ray_tpu._private import rpc
+from ray_tpu._private import runtime_metrics as rtm
+from ray_tpu._private.config import CONFIG
 from ray_tpu.runtime.core_worker import get_global_worker
+from ray_tpu.util.collective.transport import (_M_TCP_BYTES, ServeBoard,
+                                               ShmArena, ShmLink, TcpLink,
+                                               Window, _chunk_bounds,
+                                               _remaining, tag_seq)
 
 
 class ReduceOp:
@@ -41,8 +66,30 @@ _REDUCERS = {
     ReduceOp.MAX: np.maximum,
 }
 
-_groups: Dict[str, "_Group"] = {}
+_groups: Dict[str, Any] = {}
 _groups_lock = threading.Lock()
+# slot sentinel held while a _Group is under construction: the duplicate-
+# name check and the insert form one atomic claim, so two racing
+# init_collective_group calls can never both construct (and leak) a group
+_PENDING = object()
+
+# per-op/per-algorithm telemetry (docs/collective.md)
+_BYTES_BOUNDARIES = tuple(float(1 << s) for s in range(10, 31, 2))
+_M_OP_MS = rtm.histogram_family(
+    "ray_tpu_collective_op_ms",
+    "collective op latency by op/algorithm (ms)", tag_key="op")
+_M_OP_BYTES = rtm.histogram_family(
+    "ray_tpu_collective_op_bytes",
+    "collective op tensor payload bytes by op/algorithm", tag_key="op",
+    boundaries=_BYTES_BOUNDARIES)
+_M_BCAST_STORE = rtm.counter(
+    "ray_tpu_collective_bcast_store_total",
+    "broadcasts routed over the multi-source object-transfer plane")
+
+# COLLECTIVE timeline slices: cap per group so chatty training loops
+# can't grow the GCS task table without bound (same rationale as the
+# 256-instants-per-stream cap, docs/observability.md)
+_TIMELINE_OPS_CAP = 256
 
 
 def _as_numpy(tensor: Any) -> np.ndarray:
@@ -51,26 +98,65 @@ def _as_numpy(tensor: Any) -> np.ndarray:
     return np.asarray(tensor)
 
 
+# _remaining / _chunk_bounds come from transport.py: both endpoints of
+# every link must derive identical segmentation, so there is exactly
+# one definition
+
+
+
+
+class _StagingPool:
+    """``depth`` reusable receive buffers for in-flight reduce segments.
+
+    Slot rotation is safe because the Window processes completions in
+    issue order: slot j is handed out again only after item j-depth has
+    been fully consumed."""
+
+    def __init__(self, depth: int, seg_elems: int, dtype):
+        self._bufs = [np.empty(seg_elems, dtype) for _ in range(depth)]
+        self._i = 0
+
+    def take(self, elems: int) -> np.ndarray:
+        buf = self._bufs[self._i % len(self._bufs)]
+        self._i += 1
+        return buf[:elems]
+
+
 class _Mailbox:
-    """Incoming messages keyed by (src_rank, tag)."""
+    """Incoming push messages keyed by (src_rank, tag).
+
+    Hygiene (ISSUE 6): queues are deques (O(1) pop), and messages whose
+    tag belongs to an op older than the group's current op sequence are
+    dropped on arrival — a recv that timed out can no longer leave its
+    late-arriving message queued forever to poison the next op that
+    reuses the (src, tag) slot.  Unsequenced tags (p2p) are exempt."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._msgs: Dict[Tuple[int, str], List[Any]] = {}
+        self._msgs: Dict[Tuple[int, str], deque] = {}
+        self._floor = 0
+        self._closed = False
 
     def put(self, src: int, tag: str, payload: Any) -> None:
+        seq = tag_seq(tag)
         with self._cv:
-            self._msgs.setdefault((src, tag), []).append(payload)
+            if self._closed:
+                return
+            if seq is not None and seq < self._floor:
+                return  # stale: its op already finished or timed out
+            self._msgs.setdefault((src, tag), deque()).append(payload)
             self._cv.notify_all()
 
     def get(self, src: int, tag: str, timeout: float) -> Any:
         deadline = time.monotonic() + timeout
         with self._cv:
             while True:
+                if self._closed:
+                    raise RuntimeError("collective group destroyed")
                 q = self._msgs.get((src, tag))
                 if q:
-                    msg = q.pop(0)
+                    msg = q.popleft()
                     if not q:
                         del self._msgs[(src, tag)]
                     return msg
@@ -80,6 +166,19 @@ class _Mailbox:
                         f"collective recv (src={src}, tag={tag}) timed out")
                 self._cv.wait(remaining)
 
+    def expire_below(self, seq_floor: int) -> None:
+        with self._cv:
+            self._floor = seq_floor
+            for key in [k for k in self._msgs
+                        if (tag_seq(k[1]) or seq_floor) < seq_floor]:
+                del self._msgs[key]
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._msgs.clear()
+            self._cv.notify_all()
+
 
 class _Group:
     def __init__(self, name: str, world_size: int, rank: int,
@@ -88,41 +187,130 @@ class _Group:
         self.world_size = world_size
         self.rank = rank
         self.timeout = timeout
+        worker = get_global_worker()
+        self._worker = worker
+        self._store = getattr(worker, "store", None)
+        self._node = getattr(worker, "node_id", "")
         self._mailbox = _Mailbox()
-        self._server = rpc.Server(self._handle)
+        self._board = ServeBoard()
+        # "msg" never blocks (mailbox append): inline on the reader.
+        # "take" stays POOLED: an already-published entry resolves its
+        # reply inside the handler, and that send can block on a
+        # saturated socket — blocking the reader thread would deadlock
+        # a full-duplex ring under load.
+        self._server = rpc.Server(self._handle,
+                                  fast_methods=("msg", "rdv"))
         self._conns: Dict[int, rpc.Connection] = {}
         self._conns_lock = threading.Lock()
+        self._links: Dict[int, Any] = {}
+        self._links_lock = threading.Lock()
         self._seq = 0
-        self._rendezvous()
+        self._op_lock = threading.Lock()
+        self._op_count = 0
+        self._destroyed = threading.Event()
+        try:
+            self._rendezvous()
+        except BaseException:
+            self._server.stop()
+            raise
 
     # ------------------------------------------------------------ plumbing
     def _handle(self, conn: rpc.Connection, method: str, p: Any) -> Any:
         if method == "msg":
             self._mailbox.put(p["src"], p["tag"], p["data"])
             return True
+        if method == "take":
+            return self._board.take(p["src"], p["tag"])
+        if method == "rdv":
+            # rendezvous confirmation: a joiner accepts a collected
+            # address set only after rank 0 (always part of the live
+            # incarnation) acknowledges the nonce — a dead
+            # incarnation's complete key set can't satisfy this (its
+            # rank 0 is gone or answers with a different nonce)
+            return p.get("nonce") == self.nonce
         raise rpc.RpcError(f"collective: unknown method {method}")
 
     def _rendezvous(self) -> None:
-        import json
-        gcs = get_global_worker().gcs
-        key = f"collective/{self.name}/{self.rank}"
-        gcs.kv_put(key, json.dumps(list(self._server.address)).encode())
-        self._addrs: Dict[int, Tuple[str, int]] = {}
+        gcs = self._worker.gcs
+        base = f"collective/{self.name}"
         deadline = time.monotonic() + self.timeout
+        if self.rank == 0:
+            # fresh incarnation: sweep every key of prior incarnations
+            # FIRST (their addresses may belong to dead ranks), then
+            # publish the nonce that namespaces this one's keys
+            try:
+                for k in gcs.kv_keys(base + "/"):
+                    gcs.kv_del(k)
+            except Exception:
+                pass
+            self.nonce = uuid.uuid4().hex[:12]
+            gcs.kv_put(f"{base}/nonce", self.nonce.encode())
+        else:
+            self.nonce = self._poll_nonce(gcs, base, deadline)
+        me = json.dumps([self._server.address[0],
+                         int(self._server.address[1]), self._node])
+        gcs.kv_put(f"{base}/{self.nonce}/{self.rank}", me.encode())
+        self._addrs: Dict[int, Tuple[str, int]] = {}
+        self._nodes: Dict[int, str] = {}
         while len(self._addrs) < self.world_size:
             for r in range(self.world_size):
                 if r in self._addrs:
                     continue
-                raw = gcs.kv_get(f"collective/{self.name}/{r}")
+                raw = gcs.kv_get(f"{base}/{self.nonce}/{r}")
                 if raw is not None:
-                    host, port = json.loads(raw.decode())
+                    host, port, node = json.loads(raw.decode())
                     self._addrs[r] = (host, int(port))
-            if len(self._addrs) < self.world_size:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"collective group {self.name!r}: only "
-                        f"{len(self._addrs)}/{self.world_size} ranks showed")
-                time.sleep(0.05)
+                    self._nodes[r] = node
+            if len(self._addrs) == self.world_size:
+                if self.rank == 0 or self._confirm_rank0():
+                    break
+                # a complete-looking key set under a dead incarnation's
+                # nonce: rank 0 never confirmed it — rejoin below
+                self._addrs.clear()
+                self._nodes.clear()
+            if self.rank != 0:
+                # a rank that read a dead incarnation's leftover nonce
+                # migrates the moment rank 0 publishes the fresh one
+                raw = gcs.kv_get(f"{base}/nonce")
+                cur = raw.decode() if raw is not None else None
+                if cur is not None and cur != self.nonce:
+                    gcs.kv_del(f"{base}/{self.nonce}/{self.rank}")
+                    self.nonce = cur
+                    gcs.kv_put(f"{base}/{cur}/{self.rank}", me.encode())
+                    self._addrs.clear()
+                    self._nodes.clear()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective group {self.name!r}: only "
+                    f"{len(self._addrs)}/{self.world_size} ranks showed")
+            time.sleep(0.05)
+
+    def _confirm_rank0(self) -> bool:
+        """Joiner-side rendezvous confirmation (see the ``rdv``
+        handler): True only when the rank-0 address we collected
+        answers AND acknowledges our nonce."""
+        try:
+            conn = rpc.connect(self._addrs[0], timeout=2.0)
+        except (OSError, ConnectionError):
+            return False
+        try:
+            return bool(conn.call("rdv", {"nonce": self.nonce},
+                                  timeout=5.0))
+        except Exception:
+            return False
+        finally:
+            conn.close()
+
+    def _poll_nonce(self, gcs, base: str, deadline: float) -> str:
+        while True:
+            raw = gcs.kv_get(f"{base}/nonce")
+            if raw is not None:
+                return raw.decode()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective group {self.name!r}: rank 0 never "
+                    f"published the rendezvous nonce")
+            time.sleep(0.05)
 
     def _conn_to(self, peer: int) -> rpc.Connection:
         with self._conns_lock:
@@ -132,134 +320,659 @@ class _Group:
                 self._conns[peer] = conn
             return conn
 
-    def _send(self, peer: int, tag: str, data: Any) -> None:
-        self._conn_to(peer).call(
-            "msg", {"src": self.rank, "tag": tag, "data": data},
-            timeout=self.timeout)
+    def _link(self, peer: int):
+        with self._links_lock:
+            ln = self._links.get(peer)
+            if ln is None:
+                win = max(1, CONFIG.collective_inflight_segments)
+                if (CONFIG.collective_shm_enabled
+                        and self._store is not None
+                        and self._nodes.get(peer) == self._node):
+                    ln = ShmLink(
+                        self._store, self.name, self.nonce, self.rank,
+                        peer,
+                        capacity=self._seg_bytes() + 4096,
+                        nslots=max(CONFIG.collective_shm_slots, win + 2),
+                        # waits pump EVERY shm outbox of the group: the
+                        # segment a parked peer needs may be queued on a
+                        # different link than the one being waited on
+                        pump_all=self._pump_shm_outboxes)
+                else:
+                    ln = TcpLink(self, peer)
+                self._links[peer] = ln
+            return ln
 
-    def _recv(self, peer: int, tag: str) -> Any:
-        return self._mailbox.get(peer, tag, self.timeout)
+    def _pump_shm_outboxes(self) -> None:
+        """Non-blocking: move queued segments of EVERY shm link into
+        their rings while credit lasts (called from wait slices and the
+        op-end drain; single op thread, so no cross-link locking)."""
+        with self._links_lock:
+            links = list(self._links.values())
+        for ln in links:
+            if isinstance(ln, ShmLink):
+                ln._pump_outbox()
 
-    def _next_tag(self, opname: str) -> str:
-        # all ranks call collectives in the same order => same sequence
+    # ------------------------------------------------------- op lifecycle
+    def _begin(self) -> Tuple[int, float, float]:
+        if self._destroyed.is_set():
+            raise RuntimeError(
+                f"collective group {self.name!r} is destroyed")
         self._seq += 1
-        return f"{opname}:{self._seq}"
+        seq = self._seq
+        # hygiene: anything still parked/queued for older ops belongs to
+        # a peer that timed out — fail/drop it instead of letting it
+        # poison this op's tag space
+        self._mailbox.expire_below(seq)
+        self._board.sweep_below(seq)
+        with self._links_lock:
+            links = list(self._links.values())
+        for ln in links:
+            if isinstance(ln, ShmLink):
+                ln.drop_stashed_below(seq)
+        deadline = time.monotonic() + CONFIG.collective_op_timeout_s
+        return seq, deadline, rtm.now()
+
+    def _end(self, op: str, algo: str, nbytes: int, deadline: float,
+             t0: float) -> None:
+        # shm links: release the last read slot and drain outboxed
+        # segments peers are still parked on
+        with self._links_lock:
+            links = list(self._links.values())
+        for ln in links:
+            ln.finish_op(deadline)
+        # published stable frames reference this op's buffers: wait for
+        # every peer to collect AND for the frames to drain to the
+        # socket before the caller may mutate/free them
+        self._board.wait_clear(deadline)
+        label = f"{op}/{algo}"
+        _M_OP_MS.observe_since(label, t0)
+        _M_OP_BYTES.observe(label, float(nbytes))
+        self._timeline(op, algo, nbytes, t0)
+
+    def _timeline(self, op: str, algo: str, nbytes: int,
+                  t0: float) -> None:
+        if self._op_count >= _TIMELINE_OPS_CAP:
+            return
+        self._op_count += 1
+        events = getattr(self._worker, "events", None)
+        if events is None:
+            return
+        try:
+            events.record(
+                f"col-{self.name}-r{self.rank}", "COLLECTIVE",
+                name=f"collective:{self.name}",
+                dur_ms=round((rtm.now() - t0) * 1000.0, 3),
+                bytes=int(nbytes), op=op, algo=algo,
+                world=self.world_size, node_id=self._node,
+                worker_id=self._worker.worker_id.hex())
+        except Exception:
+            pass
+
+    def _seg_bytes(self) -> int:
+        """Segment size for this group's ops: the configured chunk,
+        capped at the shm slot size when any ranks are colocated (shm
+        ring slots are sized for the cap, and both endpoints of every
+        pair must derive the same segmentation)."""
+        chunk = CONFIG.collective_chunk_bytes
+        if (CONFIG.collective_shm_enabled and self._store is not None
+                and len(set(self._nodes.values())) < self.world_size):
+            return min(chunk, CONFIG.collective_shm_slot_bytes)
+        return chunk
+
+    def _seg_elems_of(self, itemsize: int) -> int:
+        return max(1, self._seg_bytes() // max(1, itemsize))
+
+    def _arena(self) -> ShmArena:
+        if getattr(self, "_arena_inst", None) is None:
+            self._arena_inst = ShmArena(
+                self._store, self.name, self.nonce, self.rank,
+                list(range(self.world_size)))
+        return self._arena_inst
+
+    def _flat_shm_ok(self, nbytes: int) -> bool:
+        """Deterministic across ranks: config + topology + the shared
+        segment's fixed capacity (identical on every local rank), never
+        current occupancy.  Occupancy blindness is backstopped at slab
+        allocation: a failing rank poisons the arena (peers unwind in
+        seconds) and every rank flips to the ring for later ops."""
+        if getattr(self, "_arena_broken", False):
+            return False
+        if not (CONFIG.collective_shm_enabled and CONFIG.collective_flat_shm
+                and self._store is not None
+                and len(set(self._nodes.values())) == 1):
+            return False
+        try:
+            cap = self._store.stats()["capacity"]
+        except Exception:
+            return False
+        return (self.world_size + 1) * nbytes * 2.5 <= cap
+
+    def _hier_worthwhile(self) -> bool:
+        """Two-level only pays when it cuts INTER-NODE traffic: several
+        nodes AND colocated ranks.  A single-node group is better off
+        on the flat shm ring — funneling every byte through one leader
+        process serializes the reduction work the ring spreads across
+        ranks."""
+        nnodes = len(set(self._nodes.values()))
+        return 1 < nnodes < self.world_size
+
+    # ------------------------------------------------- small-tensor plane
+    def _small_send(self, peer: int, tag: str, arr: np.ndarray,
+                    deadline: float) -> None:
+        ln = self._link(peer)
+        if isinstance(ln, ShmLink):
+            ln.publish(tag, arr, deadline)
+            return
+        conn = self._conn_to(peer)
+        conn.call_async("msg",
+                        {"src": self.rank, "tag": tag, "data": arr})
+        _M_TCP_BYTES.inc(arr.nbytes)
+
+    def _small_recv(self, peer: int, tag: str,
+                    deadline: float) -> np.ndarray:
+        ln = self._link(peer)
+        if isinstance(ln, ShmLink):
+            arr, _ = ln.wait(tag, deadline)
+            # shm wait returns a ring-slot view valid only until the
+            # next link op; small-path values are retained (rd
+            # accumulators, headers) so own them here
+            return np.array(arr, copy=True)
+        data = self._mailbox.get(peer, tag, _remaining(deadline))
+        arr = _as_numpy(data)
+        _M_TCP_BYTES.inc(arr.nbytes)
+        return arr
+
+    # ------------------------------------------------------- ring engines
+    # NOTE: the windowed pipelined-ring pattern below (segs helper, lazy
+    # init deque, done closures, drain) recurs with schedule-offset
+    # variations in reducescatter/allgather/_ring_broadcast_recv.  The
+    # offsets differ subtly per op (see each docstring); factoring one
+    # parameterized engine is deliberate future work — change the
+    # pump/publish discipline in ALL FOUR places or in none.
+    def _ring_allreduce(self, flat: np.ndarray, participants: List[int],
+                        reducer, seq: int, deadline: float) -> None:
+        """Pipelined ring allreduce over ``participants``, in place on
+        ``flat``: reduce-scatter then allgather, each chunk segmented
+        into ``collective_chunk_bytes`` pieces chained per segment —
+        receiving segment (k, s) immediately reduces and publishes
+        segment (k+1, s), so successive ring steps overlap (the NCCL
+        schedule, full duplex)."""
+        m = len(participants)
+        if m == 1 or flat.size == 0:
+            return
+        i = participants.index(self.rank)
+        plink = self._link(participants[(i - 1) % m])
+        nlink = self._link(participants[(i + 1) % m])
+        bounds = _chunk_bounds(flat.size, m)
+        se = self._seg_elems_of(flat.itemsize)
+        win = Window(CONFIG.collective_inflight_segments, deadline)
+        staging = _StagingPool(win.depth, min(se, max(1, flat.size)),
+                               flat.dtype)
+
+        def segs(c):
+            a, b = bounds[c]
+            return [(s, min(s + se, b)) for s in range(a, b, se)]
+
+        # own chunk's initial publishes go out lazily, one per request
+        # issued below, so a bounded shm ring can never absorb a whole
+        # chunk's burst before its reader starts consuming
+        init = deque((f"{seq}:rs0:{a}", flat[a:b]) for a, b in segs(i))
+
+        def pump_init():
+            if init:
+                tag, arr = init.popleft()
+                nlink.publish(tag, arr, deadline)
+
+        last = m - 2
+
+        def rs_done(k, a, b):
+            def done(arr, in_place):
+                rng = flat[a:b]
+                reducer(rng, arr, out=rng)
+                if k < last:
+                    nlink.publish(f"{seq}:rs{k + 1}:{a}", rng, deadline)
+                else:
+                    nlink.publish(f"{seq}:ag0:{a}", rng, deadline)
+            return done
+
+        def ag_done(k, a, b):
+            def done(arr, in_place):
+                rng = flat[a:b]
+                if not in_place:
+                    np.copyto(rng, arr)
+                if k < last:
+                    nlink.publish(f"{seq}:ag{k + 1}:{a}", rng, deadline)
+            return done
+
+        for k in range(m - 1):
+            for a, b in segs((i - k - 1) % m):
+                pump_init()
+                win.push(plink, f"{seq}:rs{k}:{a}", staging.take(b - a),
+                         rs_done(k, a, b))
+        for k in range(m - 1):
+            for a, b in segs((i - k) % m):
+                pump_init()
+                # allgather segments land straight in their final
+                # position in the output buffer (recv_into zero-copy)
+                win.push(plink, f"{seq}:ag{k}:{a}", flat[a:b],
+                         ag_done(k, a, b))
+        while init:
+            pump_init()
+        win.drain()
+
+    def _hier_allreduce(self, flat: np.ndarray, reducer, seq: int,
+                        deadline: float) -> np.ndarray:
+        """Two-level allreduce: intra-node reduce to a per-node leader
+        (shm), ring among leaders (one rank per node), intra-node
+        broadcast of the result."""
+        by_node: Dict[str, List[int]] = {}
+        for r in range(self.world_size):
+            by_node.setdefault(self._nodes.get(r, ""), []).append(r)
+        local = sorted(by_node[self._nodes.get(self.rank, "")])
+        leader = local[0]
+        leaders = sorted(min(rs) for rs in by_node.values())
+        se = self._seg_elems_of(flat.itemsize)
+        segs = [(a, min(a + se, flat.size))
+                for a in range(0, flat.size, se)]
+        win = Window(CONFIG.collective_inflight_segments, deadline)
+        if self.rank != leader:
+            ln = self._link(leader)
+            for a, b in segs:
+                ln.publish(f"{seq}:hr{self.rank}:{a}", flat[a:b],
+                           deadline)
+            for a, b in segs:
+                def done(arr, in_place, a=a, b=b):
+                    if not in_place:
+                        np.copyto(flat[a:b], arr)
+                win.push(ln, f"{seq}:hb:{a}", flat[a:b], done)
+            win.drain()
+            return flat
+        staging = _StagingPool(win.depth, min(se, max(1, flat.size)),
+                               flat.dtype)
+        for a, b in segs:
+            for mr in local[1:]:
+                def done(arr, in_place, a=a, b=b):
+                    rng = flat[a:b]
+                    reducer(rng, arr, out=rng)
+                win.push(self._link(mr), f"{seq}:hr{mr}:{a}",
+                         staging.take(b - a), done)
+        win.drain()
+        if len(leaders) > 1:
+            self._ring_allreduce(flat, leaders, reducer, seq, deadline)
+        for mr in local[1:]:
+            ln = self._link(mr)
+            for a, b in segs:
+                ln.publish(f"{seq}:hb:{a}", flat[a:b], deadline)
+        return flat
+
+    def _rd_allreduce(self, flat: np.ndarray, reducer, seq: int,
+                      deadline: float) -> np.ndarray:
+        """Latency-optimal recursive doubling for small tensors:
+        log2(N) whole-tensor exchange rounds (non-power-of-2 handled by
+        folding the extra ranks into the power-of-2 core first)."""
+        n, r = self.world_size, self.rank
+        p = 1 << (n.bit_length() - 1)
+        extra = n - p
+        acc = flat
+        if r >= p:
+            self._small_send(r - p, f"{seq}:rdi", acc, deadline)
+            return self._small_recv(r - p, f"{seq}:rdo", deadline)
+        if r < extra:
+            inc = self._small_recv(r + p, f"{seq}:rdi", deadline)
+            acc = reducer(acc, inc)
+        k = 1
+        while k < p:
+            partner = r ^ k
+            self._small_send(partner, f"{seq}:rdx{k}", acc, deadline)
+            inc = self._small_recv(partner, f"{seq}:rdx{k}", deadline)
+            acc = reducer(acc, inc)
+            k <<= 1
+        if r < extra:
+            self._small_send(r + p, f"{seq}:rdo", acc, deadline)
+        return acc
 
     # ---------------------------------------------------------- primitives
-    def send(self, tensor: Any, dst: int, tag: str = "p2p") -> None:
-        self._send(dst, tag, _as_numpy(tensor))
-
-    def recv(self, src: int, tag: str = "p2p") -> np.ndarray:
-        return self._recv(src, tag)
-
-    def broadcast(self, tensor: Any, src: int) -> np.ndarray:
-        tag = self._next_tag("bcast")
-        if self.world_size == 1:
-            return _as_numpy(tensor)
-        # ring forward: src -> src+1 -> ... -> src-1
-        if self.rank == src:
-            out = _as_numpy(tensor)
-        else:
-            out = self._recv((self.rank - 1) % self.world_size, tag)
-        nxt = (self.rank + 1) % self.world_size
-        if nxt != src:
-            self._send(nxt, tag, out)
-        return out
-
     def allreduce(self, tensor: Any, op: str = ReduceOp.SUM) -> np.ndarray:
-        """Ring allreduce: reduce-scatter then allgather, 2(N-1) steps."""
         x = _as_numpy(tensor)
-        n = self.world_size
-        if n == 1:
-            return x.copy()
-        tag = self._next_tag("ar")
-        reducer = _REDUCERS[op]
-        flat = x.reshape(-1).astype(x.dtype, copy=True)
-        chunks = np.array_split(flat, n)
-        nxt, prv = (self.rank + 1) % n, (self.rank - 1) % n
-        # reduce-scatter: after N-1 steps, rank r owns the fully-reduced
-        # chunk (r+1) % n
-        for step in range(n - 1):
-            send_idx = (self.rank - step) % n
-            recv_idx = (self.rank - step - 1) % n
-            self._send(nxt, f"{tag}:rs{step}", chunks[send_idx])
-            incoming = self._recv(prv, f"{tag}:rs{step}")
-            chunks[recv_idx] = reducer(chunks[recv_idx], incoming)
-        # allgather: circulate the reduced chunks
-        for step in range(n - 1):
-            send_idx = (self.rank - step + 1) % n
-            recv_idx = (self.rank - step) % n
-            self._send(nxt, f"{tag}:ag{step}", chunks[send_idx])
-            chunks[recv_idx] = self._recv(prv, f"{tag}:ag{step}")
-        out = np.concatenate(chunks).reshape(x.shape)
-        return out
-
-    def reduce(self, tensor: Any, dst: int,
-               op: str = ReduceOp.SUM) -> np.ndarray:
-        """Reduce to ``dst`` (star gather; fine for control-plane sizes)."""
-        x = _as_numpy(tensor)
-        tag = self._next_tag("red")
         if self.world_size == 1:
             return x.copy()
-        if self.rank == dst:
-            acc = x.astype(x.dtype, copy=True)
-            reducer = _REDUCERS[op]
-            for r in range(self.world_size):
-                if r == dst:
-                    continue
-                acc = reducer(acc, self._recv(r, tag))
-            return acc
-        self._send(dst, tag, x)
-        return x
-
-    def allgather(self, tensor: Any) -> List[np.ndarray]:
-        x = _as_numpy(tensor)
-        n = self.world_size
-        if n == 1:
-            return [x.copy()]
-        tag = self._next_tag("allg")
-        nxt, prv = (self.rank + 1) % n, (self.rank - 1) % n
-        parts: List[Optional[np.ndarray]] = [None] * n
-        parts[self.rank] = x
-        for step in range(n - 1):
-            send_idx = (self.rank - step) % n
-            self._send(nxt, f"{tag}:{step}", parts[send_idx])
-            recv_idx = (self.rank - step - 1) % n
-            parts[recv_idx] = self._recv(prv, f"{tag}:{step}")
-        return [p for p in parts]
+        reducer = _REDUCERS[op]
+        with self._op_lock:
+            seq, deadline, t0 = self._begin()
+            if x.nbytes > CONFIG.collective_small_max_bytes \
+                    and self._flat_shm_ok(x.nbytes):
+                # the arena reads the input slab-side: no private
+                # working copy needed
+                algo = "flatshm"
+                src = np.ascontiguousarray(x).reshape(-1)
+                out = np.empty_like(src)
+                try:
+                    self._arena().allreduce(src, out, reducer, deadline)
+                except Exception:
+                    # slab allocation failure / poison: THIS op fails on
+                    # every rank (the poison propagates), later ops take
+                    # the ring — all ranks converge on the same verdict
+                    self._arena_broken = True
+                    raise
+                self._end("allreduce", algo, x.nbytes, deadline, t0)
+                return out.reshape(x.shape)
+            flat = np.array(x, copy=True).reshape(-1)
+            if flat.nbytes <= CONFIG.collective_small_max_bytes:
+                algo = "rd"
+                out = self._rd_allreduce(flat, reducer, seq, deadline)
+            elif CONFIG.collective_hierarchical and self._hier_worthwhile():
+                algo = "hier"
+                out = self._hier_allreduce(flat, reducer, seq, deadline)
+            else:
+                algo = "ring"
+                self._ring_allreduce(flat, list(range(self.world_size)),
+                                     reducer, seq, deadline)
+                out = flat
+            self._end("allreduce", algo, x.nbytes, deadline, t0)
+        if not out.flags.writeable:
+            out = out.copy()
+        return out.reshape(x.shape)
 
     def reducescatter(self, tensor: Any,
                       op: str = ReduceOp.SUM) -> np.ndarray:
-        """Each rank gets its reduced 1/N shard (ring reduce-scatter)."""
+        """Each rank gets its reduced 1/N shard (pipelined ring
+        reduce-scatter; schedule offset -1 vs allreduce's so rank r
+        finishes owning chunk r, matching allgather's index==rank
+        convention)."""
         x = _as_numpy(tensor)
-        n = self.world_size
+        n, i = self.world_size, self.rank
         if n == 1:
             return x.copy()
-        tag = self._next_tag("rs")
         reducer = _REDUCERS[op]
-        flat = x.reshape(-1).astype(x.dtype, copy=True)
-        chunks = np.array_split(flat, n)
-        nxt, prv = (self.rank + 1) % n, (self.rank - 1) % n
-        # offset -1 vs allreduce's schedule so rank r finishes owning chunk
-        # r (each rank gets *its own* reduced shard, matching allgather's
-        # index==rank convention)
-        for step in range(n - 1):
-            send_idx = (self.rank - step - 1) % n
-            recv_idx = (self.rank - step - 2) % n
-            self._send(nxt, f"{tag}:{step}", chunks[send_idx])
-            incoming = self._recv(prv, f"{tag}:{step}")
-            chunks[recv_idx] = reducer(chunks[recv_idx], incoming)
-        return chunks[self.rank]
+        with self._op_lock:
+            seq, deadline, t0 = self._begin()
+            flat = np.array(x, copy=True).reshape(-1)
+            bounds = _chunk_bounds(flat.size, n)
+            se = self._seg_elems_of(flat.itemsize)
+            plink = self._link((i - 1) % n)
+            nlink = self._link((i + 1) % n)
+            win = Window(CONFIG.collective_inflight_segments, deadline)
+            staging = _StagingPool(win.depth, min(se, max(1, flat.size)),
+                                   flat.dtype)
+
+            def segs(c):
+                a, b = bounds[c]
+                return [(s, min(s + se, b)) for s in range(a, b, se)]
+
+            init = deque((f"{seq}:rs0:{a}", flat[a:b])
+                         for a, b in segs((i - 1) % n))
+            last = n - 2
+
+            def rs_done(k, a, b):
+                def done(arr, in_place):
+                    rng = flat[a:b]
+                    reducer(rng, arr, out=rng)
+                    if k < last:
+                        nlink.publish(f"{seq}:rs{k + 1}:{a}", rng,
+                                      deadline)
+                return done
+
+            for k in range(n - 1):
+                for a, b in segs((i - k - 2) % n):
+                    if init:
+                        tag, arr = init.popleft()
+                        nlink.publish(tag, arr, deadline)
+                    win.push(plink, f"{seq}:rs{k}:{a}",
+                             staging.take(b - a), rs_done(k, a, b))
+            while init:
+                tag, arr = init.popleft()
+                nlink.publish(tag, arr, deadline)
+            win.drain()
+            a, b = bounds[i]
+            out = flat[a:b].copy()
+            self._end("reducescatter", "ring", x.nbytes, deadline, t0)
+        return out
+
+    def allgather(self, tensor: Any) -> List[np.ndarray]:
+        x = _as_numpy(tensor)
+        n, i = self.world_size, self.rank
+        if n == 1:
+            return [x.copy()]
+        with self._op_lock:
+            seq, deadline, t0 = self._begin()
+            flat = np.ascontiguousarray(x).reshape(-1)
+            sz = flat.size
+            out = np.empty(n * sz, flat.dtype)
+            np.copyto(out[i * sz:(i + 1) * sz], flat)
+            se = self._seg_elems_of(flat.itemsize)
+            plink = self._link((i - 1) % n)
+            nlink = self._link((i + 1) % n)
+            win = Window(CONFIG.collective_inflight_segments, deadline)
+
+            def segs(c):
+                a, b = c * sz, (c + 1) * sz
+                return [(s, min(s + se, b)) for s in range(a, b, se)]
+
+            init = deque((f"{seq}:ag0:{a}", out[a:b])
+                         for a, b in segs(i))
+            last = n - 2
+
+            def ag_done(k, a, b):
+                def done(arr, in_place):
+                    rng = out[a:b]
+                    if not in_place:
+                        np.copyto(rng, arr)
+                    if k < last:
+                        nlink.publish(f"{seq}:ag{k + 1}:{a}", rng,
+                                      deadline)
+                return done
+
+            for k in range(n - 1):
+                for a, b in segs((i - k - 1) % n):
+                    if init:
+                        tag, arr = init.popleft()
+                        nlink.publish(tag, arr, deadline)
+                    win.push(plink, f"{seq}:ag{k}:{a}", out[a:b],
+                             ag_done(k, a, b))
+            while init:
+                tag, arr = init.popleft()
+                nlink.publish(tag, arr, deadline)
+            win.drain()
+            self._end("allgather", "ring", x.nbytes, deadline, t0)
+        return [out[k * sz:(k + 1) * sz].reshape(x.shape)
+                for k in range(n)]
+
+    def broadcast(self, tensor: Any, src: int) -> np.ndarray:
+        x = _as_numpy(tensor)
+        if self.world_size == 1:
+            return x
+        with self._op_lock:
+            seq, deadline, t0 = self._begin()
+            # the source decides the route and ships it (with shape/
+            # dtype, and the ObjectRef on the store route) down a chain
+            # of small header messages
+            n, pos = self.world_size, (self.rank - src) % self.world_size
+            nxt = (self.rank + 1) % n
+            prv = (self.rank - 1) % n
+            if self.rank == src:
+                # the store route pays off when ranks are on OTHER
+                # nodes (multi-source striped pulls, every completed
+                # rank another source); a same-node-only group is
+                # faster on the pipelined shm ring chain
+                use_store = (
+                    x.nbytes >= CONFIG.collective_bcast_store_min_bytes
+                    and len(set(self._nodes.values())) > 1)
+                ref = None
+                if use_store:
+                    import ray_tpu
+                    ref = ray_tpu.put(np.ascontiguousarray(x))
+                meta = (list(x.shape), x.dtype.str,
+                        "store" if use_store else "ring",
+                        pickle.dumps(ref) if use_store else b"")
+                hdr = np.frombuffer(pickle.dumps(meta), np.uint8)
+                self._small_send(nxt, f"{seq}:bch", hdr, deadline)
+                algo = "store" if use_store else "ring"
+                if use_store:
+                    _M_BCAST_STORE.inc()
+                    out = x
+                else:
+                    flat = np.ascontiguousarray(x).reshape(-1)
+                    self._ring_broadcast_src(flat, seq, deadline)
+                    out = x
+            else:
+                hdr = self._small_recv(prv, f"{seq}:bch", deadline)
+                shape, dtype_str, route, refb = pickle.loads(
+                    bytes(hdr))
+                if nxt != src:
+                    self._small_send(nxt, f"{seq}:bch", hdr, deadline)
+                algo = route
+                if route == "store":
+                    _M_BCAST_STORE.inc()
+                    out = self._bcast_pull(refb, shape, dtype_str,
+                                           deadline)
+                else:
+                    size = int(np.prod(shape)) if shape else 1
+                    flat = np.empty(size, np.dtype(dtype_str))
+                    self._ring_broadcast_recv(flat, pos, seq, deadline)
+                    out = flat.reshape(shape)
+            if algo == "store":
+                # keep the source's ref alive until every rank pulled
+                # (each completed rank becomes an additional source for
+                # the stripers behind it)
+                self._rd_allreduce(np.zeros(1, np.float32), np.add, seq,
+                                   deadline)
+            self._end("broadcast", algo, x.nbytes, deadline, t0)
+        return out
+
+    def _bcast_pull(self, refb: bytes, shape, dtype_str,
+                    deadline: float) -> np.ndarray:
+        import ray_tpu
+        ref = pickle.loads(refb)
+        val = ray_tpu.get(ref, timeout=_remaining(deadline))
+        out = np.array(val, copy=True)
+        del val, ref
+        return out.reshape(shape)
+
+    def _ring_broadcast_src(self, flat: np.ndarray, seq: int,
+                            deadline: float) -> None:
+        nlink = self._link((self.rank + 1) % self.world_size)
+        se = self._seg_elems_of(flat.itemsize)
+        for a in range(0, flat.size, se):
+            b = min(a + se, flat.size)
+            nlink.publish(f"{seq}:bc:{a}", flat[a:b], deadline)
+
+    def _ring_broadcast_recv(self, flat: np.ndarray, pos: int, seq: int,
+                             deadline: float) -> None:
+        """Pipelined chain forward: each landed segment is immediately
+        republished to the next hop while later segments are still in
+        flight."""
+        n = self.world_size
+        plink = self._link((self.rank - 1) % n)
+        forward = pos < n - 1
+        nlink = self._link((self.rank + 1) % n) if forward else None
+        se = self._seg_elems_of(flat.itemsize)
+        win = Window(CONFIG.collective_inflight_segments, deadline)
+        for a in range(0, flat.size, se):
+            b = min(a + se, flat.size)
+
+            def done(arr, in_place, a=a, b=b):
+                rng = flat[a:b]
+                if not in_place:
+                    np.copyto(rng, arr)
+                if forward:
+                    nlink.publish(f"{seq}:bc:{a}", rng, deadline)
+            win.push(plink, f"{seq}:bc:{a}", flat[a:b], done)
+        win.drain()
+
+    def reduce(self, tensor: Any, dst: int,
+               op: str = ReduceOp.SUM) -> np.ndarray:
+        """Reduce to ``dst`` (windowed chunked star gather)."""
+        x = _as_numpy(tensor)
+        if self.world_size == 1:
+            return x.copy()
+        reducer = _REDUCERS[op]
+        with self._op_lock:
+            seq, deadline, t0 = self._begin()
+            flat = np.ascontiguousarray(x).reshape(-1)
+            se = self._seg_elems_of(flat.itemsize)
+            segs = [(a, min(a + se, flat.size))
+                    for a in range(0, flat.size, se)]
+            if self.rank != dst:
+                ln = self._link(dst)
+                for a, b in segs:
+                    ln.publish(f"{seq}:red{self.rank}:{a}", flat[a:b],
+                               deadline)
+                self._end("reduce", "gather", x.nbytes, deadline, t0)
+                return x
+            acc = np.array(flat, copy=True)
+            win = Window(CONFIG.collective_inflight_segments, deadline)
+            staging = _StagingPool(win.depth,
+                                   min(se, max(1, flat.size)), flat.dtype)
+            for a, b in segs:
+                for r in range(self.world_size):
+                    if r == dst:
+                        continue
+
+                    def done(arr, in_place, a=a, b=b):
+                        rng = acc[a:b]
+                        reducer(rng, arr, out=rng)
+                    win.push(self._link(r), f"{seq}:red{r}:{a}",
+                             staging.take(b - a), done)
+            win.drain()
+            self._end("reduce", "gather", x.nbytes, deadline, t0)
+        return acc.reshape(x.shape)
+
+    def send(self, tensor: Any, dst: int, tag: str = "p2p") -> None:
+        # p2p deliberately stays on the push/mailbox path even for
+        # same-node peers: it may run CONCURRENTLY with collectives
+        # (no _op_lock), and the shm links' single-writer rings and
+        # lock-free outbox pump are only safe under the op lock's
+        # serialization.  The conn/mailbox path is thread-safe.
+        x = _as_numpy(tensor)
+        self._conn_to(dst).call(
+            "msg", {"src": self.rank, "tag": tag, "data": x},
+            timeout=CONFIG.collective_op_timeout_s)
+        _M_TCP_BYTES.inc(x.nbytes)
+
+    def recv(self, src: int, tag: str = "p2p") -> np.ndarray:
+        data = self._mailbox.get(src, tag,
+                                 CONFIG.collective_op_timeout_s)
+        arr = _as_numpy(data)
+        _M_TCP_BYTES.inc(arr.nbytes)
+        return arr
 
     def barrier(self) -> None:
         self.allreduce(np.zeros(1, np.float32))
 
     def destroy(self) -> None:
+        self._destroyed.set()
         try:
-            gcs = get_global_worker().gcs
-            gcs.kv_del(f"collective/{self.name}/{self.rank}")
+            gcs = self._worker.gcs
+            base = f"collective/{self.name}"
+            gcs.kv_del(f"{base}/{self.nonce}/{self.rank}")
+            if self.rank == 0:
+                # sweep the incarnation's remaining keys so a future
+                # same-name group can't even see them — but only delete
+                # the nonce key if it is still OURS: a newer same-name
+                # incarnation may already have published its own, and
+                # deleting that would wedge its joiners' nonce poll
+                raw = gcs.kv_get(f"{base}/nonce")
+                if raw is not None and raw.decode() == self.nonce:
+                    gcs.kv_del(f"{base}/nonce")
+                for k in gcs.kv_keys(f"{base}/{self.nonce}/"):
+                    gcs.kv_del(k)
         except Exception:
             pass
+        self._board.close()
+        self._mailbox.close()
+        if getattr(self, "_arena_inst", None) is not None:
+            try:
+                self._arena_inst.close()
+            except Exception:
+                pass
+            self._arena_inst = None
+        with self._links_lock:
+            links, self._links = list(self._links.values()), {}
+        for ln in links:
+            try:
+                ln.close()  # poisons shm rings: blocked peers unwind
+            except Exception:
+                pass
         with self._conns_lock:
             for conn in self._conns.values():
                 try:
@@ -286,7 +999,14 @@ def init_collective_group(world_size: int, rank: int,
     with _groups_lock:
         if group_name in _groups:
             raise RuntimeError(f"group {group_name!r} already initialized")
-    g = _Group(group_name, world_size, rank, timeout)
+        _groups[group_name] = _PENDING  # claim the slot atomically
+    try:
+        g = _Group(group_name, world_size, rank, timeout)
+    except BaseException:
+        with _groups_lock:
+            if _groups.get(group_name) is _PENDING:
+                del _groups[group_name]
+        raise
     with _groups_lock:
         _groups[group_name] = g
 
@@ -294,7 +1014,7 @@ def init_collective_group(world_size: int, rank: int,
 def _get(group_name: str) -> _Group:
     with _groups_lock:
         g = _groups.get(group_name)
-    if g is None:
+    if g is None or g is _PENDING:
         raise RuntimeError(
             f"collective group {group_name!r} is not initialized")
     return g
@@ -302,14 +1022,17 @@ def _get(group_name: str) -> _Group:
 
 def is_group_initialized(group_name: str = "default") -> bool:
     with _groups_lock:
-        return group_name in _groups
+        g = _groups.get(group_name)
+    return g is not None and g is not _PENDING
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
     with _groups_lock:
-        g = _groups.pop(group_name, None)
-    if g is not None:
-        g.destroy()
+        g = _groups.get(group_name)
+        if g is None or g is _PENDING:
+            return
+        del _groups[group_name]
+    g.destroy()
 
 
 def get_rank(group_name: str = "default") -> int:
