@@ -98,6 +98,19 @@ SECTIONS = {
                            os.path.join(REPO, "benchmarks", "serve_llm.py"),
                            "--suite", "--slots", "32", "--requests", "128"],
                       timeout=5400),
+    # disaggregated prefill/decode serving (docs/serve_disagg.md):
+    # closed-loop interleaved A/B at 1k concurrent streaming
+    # connections, colocated vs split pools at equal chip count —
+    # the ab row carries the bars (ttft_p99_ratio >= 2,
+    # tokens_per_s_ratio >= 0.9, handoff p50 < one decode block,
+    # errors == 0)
+    "serve_disagg": dict(cmd=[sys.executable,
+                              os.path.join(REPO, "benchmarks",
+                                           "serve_disagg.py"),
+                              "--connections", "1000",
+                              "--duration", "90",
+                              "--new-tokens", "96"],
+                         timeout=3600),
     "rl": dict(cmd=[sys.executable,
                     os.path.join(REPO, "benchmarks", "rl_perf.py")],
                timeout=3600),   # PPO-to-150 + 2 IMPALA rows on 1 core
@@ -149,6 +162,42 @@ _COLLECTIVE_ROWS = {
     "allreduce 64MiB ws2 new": "collective_allreduce_ws2_mb_s",
     "broadcast 64MiB ws4 new": "collective_broadcast_ws4_mb_s",
 }
+
+# Disaggregated-serving rows (docs/serve_disagg.md): the A/B bars must
+# stay visible the same way — rows are keyed by "metric" and the
+# tracked value differs per row.
+_SERVE_DISAGG_ROWS = {
+    "serve_disagg_ab": ("ttft_p99_ratio", "disagg_ttft_p99_ratio"),
+    "serve_disagg_disaggregated": ("tokens_per_s",
+                                   "disagg_tokens_per_s"),
+}
+
+
+def serve_disagg_deltas(rows, committed):
+    """Same contract as the other delta families for the serve_disagg
+    section's bar rows."""
+    if not committed:
+        return {}
+    base = {}
+    for r in committed.get("serve_disagg", []):
+        if isinstance(r, dict) and r.get("metric") in _SERVE_DISAGG_ROWS:
+            field, key = _SERVE_DISAGG_ROWS[r["metric"]]
+            if r.get(field):
+                base[key] = (field, r[field])
+    out = {}
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        spec = _SERVE_DISAGG_ROWS.get(row.get("metric"))
+        if spec is None:
+            continue
+        field, key = spec
+        if key not in base or not row.get(field):
+            continue
+        prev, cur = base[key][1], row[field]
+        out[key] = {"committed": prev, "current": cur,
+                    "ratio": round(cur / prev, 3)}
+    return out
 
 
 def _committed_baseline(path):
@@ -360,7 +409,7 @@ def main():
 
     committed = None
     if regenerated & {"core", "streaming", "compiled_dag",
-                      "object_transfer", "collective"}:
+                      "object_transfer", "collective", "serve_disagg"}:
         committed = _committed_baseline(args.output)
     if "core" in regenerated:
         deltas = control_plane_deltas(out["core"], committed)
@@ -407,6 +456,15 @@ def main():
                 print(f"[collect] {key}: {d['committed_mb_s']:,.0f} -> "
                       f"{d['current_mb_s']:,.0f} MB/s "
                       f"(x{d['ratio']}) [{tag}]", flush=True)
+    if "serve_disagg" in regenerated:
+        deltas = serve_disagg_deltas(out["serve_disagg"], committed)
+        if deltas:
+            out["serve_disagg_deltas"] = deltas
+            for key, d in deltas.items():
+                tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
+                print(f"[collect] {key}: {d['committed']:,.2f} -> "
+                      f"{d['current']:,.2f} (x{d['ratio']}) [{tag}]",
+                      flush=True)
 
     with open(args.output, "w") as f:
         json.dump(out, f, indent=1)

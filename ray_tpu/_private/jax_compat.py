@@ -1,0 +1,44 @@
+"""Version-compat shims for renamed jax APIs.
+
+The kernels/meshes in this repo are written against the current jax
+names; older installs (and some TPU-pinned builds) still carry the
+pre-rename ones.  Everything here resolves at import time against the
+installed jax so call sites stay on one spelling:
+
+  - ``shard_map``: ``jax.shard_map`` vs
+    ``jax.experimental.shard_map.shard_map`` (which also still calls
+    the ``check_vma`` kwarg ``check_rep``).
+  - ``axis_size``: ``jax.lax.axis_size`` vs the classic
+    ``lax.psum(1, axis)`` — which constant-folds to a python int inside
+    shard_map, so it stays usable as a static bound (``range(n)``).
+  - ``tpu_compiler_params``: ``pltpu.CompilerParams`` vs
+    ``pltpu.TPUCompilerParams``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_legacy(*args, **kwargs)
+
+
+def axis_size(axis_name):
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
+def tpu_compiler_params(**kwargs):
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
+    return cls(**kwargs)

@@ -26,13 +26,16 @@ FAILED = "FAILED"
 # update a record's state — a streaming task stays RUNNING while its
 # per-yield STREAM_ITEM instants accumulate, a PULL (one inter-node
 # object transfer for the task's output, docs/object_transfer.md) rides
-# whatever lifecycle state the task is in, and a COLLECTIVE (one host-
+# whatever lifecycle state the task is in, a COLLECTIVE (one host-
 # collective op on a rank's synthetic ``col-<group>-r<rank>`` record,
-# docs/collective.md) never has a lifecycle at all
+# docs/collective.md) never has a lifecycle at all, and a HANDOFF (one
+# export/import leg of a disaggregated-serving KV handoff on a
+# synthetic ``handoff-<object>`` record, docs/serve_disagg.md) likewise
 STREAM_ITEM = "STREAM_ITEM"
 PULL = "PULL"
 COLLECTIVE = "COLLECTIVE"
-_INSTANT_STATES = frozenset({STREAM_ITEM, PULL, COLLECTIVE})
+HANDOFF = "HANDOFF"
+_INSTANT_STATES = frozenset({STREAM_ITEM, PULL, COLLECTIVE, HANDOFF})
 
 _STATE_RANK = {SUBMITTED: 1, PENDING_NODE_ASSIGNMENT: 2, RUNNING: 3,
                FINISHED: 4, FAILED: 4}
@@ -161,10 +164,11 @@ class GcsTaskTable:
                     entry["index"] = ev["index"]
                 for field in ("dur_ms", "bytes", "nsources", "object_id",
                               "node_id", "worker_id", "op", "algo",
-                              "world"):
+                              "world", "stage", "npages"):
                     if field in ev:  # per-pull transfer / per-op
-                        # collective slices (node/worker = the pulling /
-                        # participating process, not a producer task)
+                        # collective / KV-handoff slices (node/worker =
+                        # the pulling / participating process, not a
+                        # producer task)
                         entry[field] = ev[field]
                 rec["events"].append(entry)
                 rec["events"].sort(key=lambda e: e["ts"])

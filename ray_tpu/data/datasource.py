@@ -134,10 +134,15 @@ def parquet_tasks(paths, columns: Optional[List[str]] = None,
 
     def read_one(path: str, vals):
         import pyarrow.parquet as pq
-        if _is_remote(path):
-            table = pq.read_table(_open(path), columns=columns)
-        else:
-            table = pq.read_table(path, columns=columns)
+        # read THIS file only, not pq.read_table: read_table routes
+        # through the dataset API, whose hive inference re-derives
+        # partition columns from the path with GUESSED dtypes
+        # (year=2024 -> int32) — shadowing the path parser's string
+        # values that add_partition_columns appends below (it skips
+        # columns that already exist).  ParquetFile reads the file as a
+        # file; partition enrichment stays the parser's job.
+        src = _open(path) if _is_remote(path) else path
+        table = pq.ParquetFile(src).read(columns=columns)
         return add_partition_columns(table, vals) if vals else table
 
     return [ReadTask(lambda p=f, v=(values[i] if values else None):

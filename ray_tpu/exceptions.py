@@ -109,3 +109,12 @@ class DAGUnavailableError(RayTpuError):
     """A compiled DAG lost a participating actor (or was torn down) and
     can no longer execute; recompile to get a fresh one — the compiled-
     graph analog of ObjectLostError."""
+
+
+class KVPoolFullError(RayTpuError):
+    """A disaggregated-serving KV handoff could not be admitted: the
+    decode engine's import wait queue is at its configured cap
+    (``import_queue_max``).  Raised synchronously at submit — a fast
+    typed rejection the serving layer uses to re-queue / re-route the
+    handoff to another replica instead of piling more waiters onto a
+    saturated pool (docs/serve_disagg.md)."""

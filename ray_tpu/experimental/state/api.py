@@ -177,6 +177,10 @@ def timeline(path: Optional[str] = None) -> List[dict]:
     * host-collective ops appear as ``collective`` slices
       (``COLLECTIVE`` events carrying op/algorithm/bytes/world size,
       docs/collective.md) on each participating rank's row;
+    * disaggregated-serving KV handoffs appear as ``handoff`` slices
+      (``HANDOFF`` events carrying stage/bytes/pages,
+      docs/serve_disagg.md) on the exporting and importing replicas'
+      rows;
     * every event carries the submitting span's ``trace_id`` in its
       args when one was propagated, so user spans (``span(...)``),
       tasks and stream items correlate in Perfetto.
@@ -189,6 +193,7 @@ def timeline(path: Optional[str] = None) -> List[dict]:
         items = []
         pulls = []
         cols = []
+        handoffs = []
         for ev in t.get("events", []):
             if ev["state"] == "RUNNING":
                 start = ev["ts"]
@@ -200,6 +205,8 @@ def timeline(path: Optional[str] = None) -> List[dict]:
                 pulls.append(ev)
             elif ev["state"] == "COLLECTIVE":
                 cols.append(ev)
+            elif ev["state"] == "HANDOFF":
+                handoffs.append(ev)
         for ev in cols:
             # one host-collective op (docs/collective.md): rides the
             # rank's synthetic col-<group>-r<rank> record, which has no
@@ -222,6 +229,27 @@ def timeline(path: Optional[str] = None) -> List[dict]:
                          "op": ev.get("op", ""),
                          "algo": ev.get("algo", ""),
                          "world": ev.get("world", 0)},
+            })
+        for ev in handoffs:
+            # one export/import leg of a disaggregated-serving KV
+            # handoff (docs/serve_disagg.md): rides a synthetic
+            # ``handoff-<object>`` record with no lifecycle — the slice
+            # stands alone on the exporting/importing replica's row
+            dur_s = float(ev.get("dur_ms", 0.0)) / 1e3
+            events.append({
+                "name": f"kv_handoff {ev.get('stage', '?')} "
+                        f"({ev.get('bytes', 0)} B)",
+                "cat": "handoff",
+                "ph": "X",
+                "ts": (ev["ts"] - dur_s) * 1e6,
+                "dur": dur_s * 1e6,
+                "pid": ev.get("node_id", t.get("node_id", "node"))[:8],
+                "tid": ev.get("worker_id",
+                              t.get("worker_id", "worker"))[:8],
+                "args": {"task_id": t["task_id"],
+                         "bytes": ev.get("bytes", 0),
+                         "stage": ev.get("stage", ""),
+                         "npages": ev.get("npages", 0)},
             })
         for ev in pulls:
             # a pull may happen long after the task finished (a borrower
@@ -399,6 +427,26 @@ def metrics_summary() -> str:
             lines.append("%-34s %13.1f%%" % (
                 "prefetch hit ratio",
                 100.0 * pf_hits / pf_reqs))
+        lines.append("")
+
+    # disaggregated serving (docs/serve_disagg.md): handoff movement
+    # cost + per-pool latency, visible without the dashboard
+    handoff_rows = [r for r in rows
+                    if r["name"] in ("ray_tpu_serve_handoff_bytes",
+                                     "ray_tpu_serve_handoff_ms")
+                    and r.get("count")]
+    if handoff_rows:
+        lines.append("== Serve KV handoff ==")
+        lines.append("%-34s %10s %9s %9s" % ("STAGE", "COUNT", "P50",
+                                             "P95"))
+        for r in sorted(handoff_rows,
+                        key=lambda r: (r["name"],
+                                       r["tags"].get("stage", ""))):
+            unit = "B" if r["name"].endswith("bytes") else "ms"
+            stage = r["tags"].get("stage", "?")
+            lines.append("%-34s %10d %9.3g %9.3g" % (
+                f"{stage} ({unit})", r["count"], r.get("p50", 0.0),
+                r.get("p95", 0.0)))
         lines.append("")
 
     rpc_rows = [r for r in rows if r["name"] == "ray_tpu_rpc_dispatch_ms"

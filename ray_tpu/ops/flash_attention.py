@@ -21,6 +21,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# pltpu.TPUCompilerParams -> CompilerParams rename shim
+from ray_tpu._private.jax_compat import tpu_compiler_params as \
+    _CompilerParams
+
 NEG_INF = -1e30
 
 
@@ -109,7 +113,7 @@ def _fwd(q3, k3, v3, causal: bool, sm_scale: float,
             jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
             jax.ShapeDtypeStruct((bh, q_len, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3)
@@ -225,7 +229,7 @@ def _bwd(q3, k3, v3, o3, lse, do3, causal: bool, sm_scale: float,
         in_specs=[qspec, full_kv, full_kv, qspec, vec_q, vec_q],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, q_len, d), q3.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
@@ -239,7 +243,7 @@ def _bwd(q3, k3, v3, o3, lse, do3, causal: bool, sm_scale: float,
         out_specs=[kspec, kspec],
         out_shape=[jax.ShapeDtypeStruct((bh, kv_len, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, kv_len, d), v3.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)

@@ -24,6 +24,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+# renamed-API shims (shard_map promotion, lax.axis_size)
+from ray_tpu._private.jax_compat import axis_size as _axis_size
+from ray_tpu._private.jax_compat import shard_map as _shard_map
+
 NEG_INF = -1e30
 
 
@@ -52,7 +56,7 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     (shard i holds global positions [i*S_local, (i+1)*S_local)).
     """
     b, s_local, h, d = q.shape
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     scale = sm_scale if sm_scale is not None else d ** -0.5
     q_scaled = q.astype(jnp.float32) * scale
@@ -112,5 +116,5 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     spec = P(batch_axes, axis_name, None, None)
     fn = functools.partial(ring_attention_local, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -21,6 +21,10 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
+# renamed-API shims (shard_map promotion, lax.axis_size)
+from ray_tpu._private.jax_compat import axis_size as _axis_size
+from ray_tpu._private.jax_compat import shard_map as _shard_map
+
 
 def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
                             axis_name: str, causal: bool = True,
@@ -32,7 +36,7 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     H % axis_size == 0 and KvH % axis_size == 0 (repeat KV first for GQA
     ratios finer than the axis size).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if q.shape[2] % n or k.shape[2] % n:
         raise ValueError(
             f"heads {q.shape[2]}/kv_heads {k.shape[2]} not divisible by "
@@ -65,5 +69,5 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     spec = P(batch_axes, axis_name, None, None)
     fn = functools.partial(ulysses_attention_local, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale, impl=impl)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -25,6 +25,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+# shard_map promotion shim (_shard_map vs jax.experimental.shard_map)
+from ray_tpu._private.jax_compat import shard_map as _shard_map
+
 
 def _stage_perm(n: int):
     return [(i, (i + 1) % n) for i in range(n)]
@@ -95,7 +98,7 @@ def spmd_pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
         outbuf = jnp.where(idx == n_stages - 1, outbuf, jnp.zeros_like(outbuf))
         return jax.lax.psum(outbuf, axis_name)
 
-    out = jax.shard_map(local, mesh=mesh,
+    out = _shard_map(local, mesh=mesh,
                         in_specs=(param_specs, x_spec),
                         out_specs=x_spec, check_vma=False)(stage_params, xs)
     return out.reshape(b, *out.shape[2:])

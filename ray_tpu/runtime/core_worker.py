@@ -193,7 +193,7 @@ class _StreamState:
     connections); the consumer always advances strictly by index."""
 
     __slots__ = ("task_binary", "bp", "cv", "arrived", "consumed", "total",
-                 "failed", "parked", "closed", "max_unconsumed")
+                 "failed", "parked", "closed", "max_unconsumed", "waiters")
 
     def __init__(self, task_binary: bytes, bp: int):
         self.task_binary = task_binary
@@ -204,6 +204,10 @@ class _StreamState:
         self.total: Optional[int] = None  # num_items once complete
         self.failed = False               # terminal error stored in slot 0
         self.closed = False               # consumer dropped the generator
+        # event-driven consumers (async __anext__): callbacks fired on
+        # the next state change instead of a thread blocking on cv —
+        # 1000 concurrent awaited streams cost 0 threads, not 1000
+        self.waiters: List = []
         # (index, Deferred, t_parked) item reports parked for
         # backpressure: each resolves when ITS item is consumed, so the
         # producer's unacked window is exactly the unconsumed in-flight
@@ -246,13 +250,28 @@ class StreamingObjectRefGenerator:
         return self
 
     async def __anext__(self) -> "ObjectRef":
+        """Event-driven await: non-blocking claim attempts with a
+        state-change waiter between them — no executor thread parks for
+        the wait, so thousands of concurrently-awaited streams coexist
+        on one event loop (the serve_disagg 1k-connection harness
+        shape; the old executor hop capped concurrency at the thread
+        pool size)."""
         import asyncio
         loop = asyncio.get_running_loop()
-        out = await loop.run_in_executor(
-            None, self._worker._stream_next, self._state, self._ref)
-        if out is _StreamExhausted:
-            raise StopAsyncIteration
-        return out
+        while True:
+            out = self._worker._stream_try_next(self._state, self._ref)
+            if out is _StreamExhausted:
+                raise StopAsyncIteration
+            if out is not None:
+                return out
+            fut = loop.create_future()
+
+            def _wake(_loop=loop, _fut=fut):
+                _loop.call_soon_threadsafe(
+                    lambda: _fut.done() or _fut.set_result(None))
+
+            self._worker._stream_add_waiter(self._state, _wake)
+            await fut
 
     def completed(self) -> "ObjectRef":
         """Ref that resolves when the whole generator task finishes:
@@ -2217,13 +2236,80 @@ class CoreWorker:
                 state.arrived.add(idx)
             state.max_unconsumed = max(state.max_unconsumed,
                                        len(state.arrived))
-            state.cv.notify_all()
+            self._stream_wake(state)
             if state.bp > 0 and idx >= state.consumed:
                 d = rpc.Deferred()
                 state.parked.append((idx, d, rtm.now()))
                 _M_STREAM_STALLS.inc()
                 return d
             return {"consumed": state.consumed}
+
+    @staticmethod
+    def _stream_wake(state: _StreamState) -> None:
+        """Wake both consumer styles; call with ``state.cv`` held."""
+        state.cv.notify_all()
+        if state.waiters:
+            waiters, state.waiters = state.waiters, []
+            for cb in waiters:
+                try:
+                    cb()          # only schedules a loop callback
+                except Exception:
+                    pass
+
+    def _stream_add_waiter(self, state: _StreamState, cb) -> None:
+        """Register a one-shot state-change callback for an async
+        consumer; fires immediately when progress is already available
+        (the caller loops and re-tries the claim)."""
+        with state.cv:
+            ready = (state.consumed in state.arrived or state.failed
+                     or state.closed
+                     or (state.total is not None
+                         and state.consumed >= state.total))
+            if not ready:
+                state.waiters.append(cb)
+                return
+        try:
+            cb()
+        except Exception:
+            pass
+
+    def _stream_try_next(self, state: _StreamState, ref: "ObjectRef"):
+        """Non-blocking next(): the next item's ObjectRef,
+        _StreamExhausted at end of stream, None when nothing is
+        available yet, or raises the stream's terminal error — the
+        claim half of _stream_next without the cv wait (async
+        consumers interleave it with _stream_add_waiter)."""
+        resolve: List = []
+        failed = False
+        with state.cv:
+            idx = state.consumed
+            if idx in state.arrived:
+                state.arrived.discard(idx)
+                state.consumed = idx + 1
+                resolve = [(d, t) for i, d, t in state.parked
+                           if i < state.consumed]
+                state.parked = [p for p in state.parked
+                                if p[0] >= state.consumed]
+            elif state.total is not None and idx >= state.total:
+                return _StreamExhausted
+            elif state.failed:
+                failed = True
+            elif state.closed:
+                raise exc.RayTpuError("streaming generator was closed")
+            else:
+                return None
+        for d, t_parked in resolve:
+            _M_STREAM_PARKED.observe_since(t_parked)
+            d.resolve({"consumed": state.consumed})
+        if failed:
+            # slot 0 holds the task's error payload: get() raises it
+            # (the terminal reply that set ``failed`` also readied it)
+            self.get([ref])
+            raise exc.RayTpuError(
+                "streaming generator task failed")  # unreachable backstop
+        oid = ObjectID.for_task_return(TaskID(state.task_binary),
+                                       idx + 1)    # item j at slot j+1
+        return ObjectRef(oid, self.address, self)
 
     def _stream_next(self, state: _StreamState, ref: "ObjectRef",
                      timeout: Optional[float] = None):
@@ -2295,7 +2381,7 @@ class CoreWorker:
                 # longer arrive, so nothing is parked for a reason
                 resolve = [d for _i, d, _t in state.parked]
                 state.parked = []
-            state.cv.notify_all()
+            self._stream_wake(state)
         for d in resolve:
             d.resolve({"consumed": state.consumed})
 
@@ -2310,7 +2396,7 @@ class CoreWorker:
             parked, state.parked = state.parked, []
             orphans = list(state.arrived)
             state.arrived.clear()
-            state.cv.notify_all()
+            self._stream_wake(state)
         for _i, d, _t in parked:
             d.resolve({"cancel": True})
         with self._streams_lock:

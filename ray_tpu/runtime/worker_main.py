@@ -49,6 +49,46 @@ _M_CREDIT_WAIT = rtm.histogram(
 _STREAM_EVENT_CAP = 256
 
 
+def _probe_small(value, budget: int = 32768, depth: int = 0) -> int:
+    """Cheap structural probe for the async-actor inline-return fast
+    path: returns the remaining byte budget when ``value`` is a small
+    JSON-ish object (None/bool/int/float/str/bytes and shallow
+    list/tuple/dict of those — the shapes serve replies traffic in),
+    or -1 when it is big, deep, or of any other type (numpy arrays,
+    user classes: their pickle cost is unbounded, keep the executor).
+    Costs ~1us for a typical serve reply dict."""
+    if value is None or value is True or value is False:
+        return budget - 8
+    t = type(value)
+    if t is int:
+        # arbitrary-precision: charge real width or a 10**10000 would
+        # defeat the budget (and the no-store_put-on-loop invariant)
+        return budget - 16 - (value.bit_length() >> 3)
+    if t is float:
+        return budget - 16
+    if t is str or t is bytes:
+        n = len(value) + 8
+        return budget - n if n < budget else -1
+    if depth >= 4:
+        return -1
+    if t is list or t is tuple:
+        for item in value:
+            budget = _probe_small(item, budget - 8, depth + 1)
+            if budget < 0:
+                return -1
+        return budget
+    if t is dict:
+        for k, v in value.items():
+            budget = _probe_small(k, budget - 8, depth + 1)
+            if budget < 0:
+                return -1
+            budget = _probe_small(v, budget, depth + 1)
+            if budget < 0:
+                return -1
+        return budget
+    return -1
+
+
 class _StreamCancelled(Exception):
     """The owner cancelled the stream (consumer dropped the generator,
     or the owner process is gone): stop producing, finish cleanly."""
@@ -605,6 +645,27 @@ class WorkerProcess:
             except Exception:
                 logger.exception("task completion callback failed")
 
+    def _resolve_args_inline_ok(self, blob: bytes):
+        """Event-loop-safe arg resolution attempt for the async-actor
+        hot path: small blobs with NO ObjectRef args unpickle inline —
+        the two executor hops (resolve + package) cost more than a
+        serve-sized payload's unpickle on this class of box (~40-150us
+        each vs ~2-5us).  Returns (args, kwargs, []) or None when the
+        blob is big or carries refs (whose _get_one may block on a
+        store/remote fetch — those keep the executor path).
+
+        Unpickling can run user ``__setstate__`` code on the loop, but
+        that is not a new hazard for THIS actor class: async-actor
+        methods themselves (sync ones included) already execute on the
+        loop thread, so user code blocking it was always possible."""
+        if len(blob) > 16384:
+            return None
+        args, kwargs = cloudpickle.loads(blob)
+        if any(isinstance(a, cw.ObjectRef) for a in args) or \
+                any(isinstance(v, cw.ObjectRef) for v in kwargs.values()):
+            return None
+        return args, kwargs, []
+
     def _resolve_args(self, blob: bytes) -> tuple:
         """Returns (args, kwargs, borrowed_oids); the caller must hand
         ``borrowed_oids`` to core.release_borrowed after execution so arg
@@ -946,8 +1007,12 @@ class WorkerProcess:
         """Async-actor execution: coroutine methods await on the loop
         (interleaving with other calls of their group); sync methods run
         inline on the loop thread, so actor state is single-threaded.
-        Arg resolution and result packaging do blocking IO (shm / RPC) and
-        run in the default executor to keep the loop responsive."""
+        Arg resolution and result packaging do blocking IO (shm / RPC)
+        and run in the default executor to keep the loop responsive —
+        EXCEPT for the serve-shaped hot path (small ref-free args in,
+        small JSON-ish result out), which stays inline: at serving QPS
+        the two executor round-trips dominate a no-op request's replica
+        cost (docs/rpc_fastpath.md inline-return note)."""
         import asyncio
         import functools
 
@@ -960,8 +1025,11 @@ class WorkerProcess:
         borrowed = []
         t_exec = None
         try:
-            args, kwargs, borrowed = await loop.run_in_executor(
-                None, self._resolve_args, spec["args"])
+            resolved = self._resolve_args_inline_ok(spec["args"])
+            if resolved is None:
+                resolved = await loop.run_in_executor(
+                    None, self._resolve_args, spec["args"])
+            args, kwargs, borrowed = resolved
             if spec["method"] == "__ray_terminate__":
                 import os
                 os._exit(0)
@@ -979,6 +1047,15 @@ class WorkerProcess:
                 # async-generator streaming: iterate on the loop, report
                 # off it (see _package_streaming_async)
                 return await self._package_streaming_async(spec, result)
+            if spec["num_returns"] == 1 and _probe_small(
+                    result, min(32768, self._inline_ret_max)) >= 0:
+                # bounded-size scalar/container result: serialize + the
+                # inline-return reply build are cheaper than the
+                # executor hop, and cannot block the loop measurably.
+                # Budget clamped to the inline-return threshold so this
+                # branch can never reach _package_results' store_put
+                # (a blocking shm write) on the loop.
+                return self._package_results(spec, result)
             return await loop.run_in_executor(
                 None, functools.partial(self._package_results, spec,
                                         result))

@@ -78,18 +78,33 @@ class ServeController:
 
     def get_targets(self, name: str,
                     known_version: int = -1) -> Optional[Dict[str, Any]]:
-        """Replica routing table for one deployment; handles poll this."""
+        """Replica routing table for one deployment; handles poll this.
+
+        ``loads`` (replica name -> last health-checked load signal) and
+        ``nodes`` (replica name -> node id) ride EVERY reply, including
+        version-unchanged ones: loads cover traffic other handles sent
+        (handle-local in-flight counts can't see it — power-of-two
+        choices on stale or handle-local-only depth hotspots a decode
+        pool under skewed stream lengths), and they change every
+        health-check pass without bumping routing_version."""
         with self._lock:
             state = self._deployments.get(name)
             if state is None:
                 return None
+            loads = {i["name"]: i.get("last_load", 0.0)
+                     for i in state["replicas"].values()
+                     if i["healthy"] and not i.get("draining")}
             if state["routing_version"] == known_version:
-                return {"version": known_version, "unchanged": True}
+                return {"version": known_version, "unchanged": True,
+                        "loads": loads}
             return {
                 "version": state["routing_version"],
                 "replicas": [i["name"] for i in state["replicas"].values()
                              if i["healthy"] and not i.get("draining")
                              and i["version"] == state["version"]],
+                "nodes": {i["name"]: i.get("node_id", "")
+                          for i in state["replicas"].values()},
+                "loads": loads,
                 "max_concurrent_queries":
                     state["config"].get("max_concurrent_queries", 8),
             }
@@ -191,16 +206,32 @@ class ServeController:
                                               "health_check_period_s", 2.0))
                     info["healthy"] = True
                     info["fails"] = 0
+                    info["ever_healthy"] = True
                     info["last_ongoing"] = metrics["num_ongoing"]
+                    # the autoscaling signal: the replica's custom load
+                    # (per-pool queue depth / slot pressure) when its
+                    # callable publishes one, else == num_ongoing
+                    info["last_load"] = metrics.get(
+                        "load", metrics["num_ongoing"])
+                    if metrics.get("node_id"):
+                        info["node_id"] = metrics["node_id"]
                     total_ongoing += metrics["num_ongoing"]
                 except Exception:
                     metrics_partial = True
                     info.pop("last_ongoing", None)
+                    info.pop("last_load", None)
                     info["fails"] = info.get("fails", 0) + 1
                     grace_s = config.get("health_check_grace_period_s", 120.0)
                     grace = (time.monotonic() - info.get("created_at", 0.0)
                              < grace_s)
-                    if info["fails"] >= 3 and not grace:
+                    # the startup grace shields a replica still LOADING
+                    # (big model + first compile) from being shot before
+                    # it ever answered; a replica that already served a
+                    # health check and then went dark is DEAD — keeping
+                    # it routable for the rest of the grace window would
+                    # bounce every p2c pick that lands on it
+                    if info["fails"] >= 3 and (info.get("ever_healthy")
+                                               or not grace):
                         info["healthy"] = False
                 if info["healthy"] and info["version"] == version:
                     healthy_current.append(tag)
@@ -219,8 +250,12 @@ class ServeController:
             if auto and healthy_current:
                 serving = [t for t in healthy_current
                            if not replicas[t].get("draining")]
+                # the policy consumes each replica's LOAD signal (custom
+                # per-pool metric when published, == ongoing otherwise);
+                # the non-draining denominator contract is unchanged and
+                # holds per pool — each deployment reconciles alone
                 serving_ongoing = sum(
-                    replicas[t].get("last_ongoing", 0.0) for t in serving)
+                    replicas[t].get("last_load", 0.0) for t in serving)
                 new_target = self._autoscale(name, auto, serving_ongoing,
                                              len(serving), target)
                 if new_target > target or not metrics_partial:

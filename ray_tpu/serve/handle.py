@@ -16,9 +16,11 @@ on the controller.
 
 from __future__ import annotations
 
+import asyncio
 import random
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
@@ -48,6 +50,21 @@ class DeploymentHandle:
         self._actors: Dict[str, Any] = {}      # replica name -> actor handle
         self._max_concurrent = 8
         self._inflight: Dict[str, int] = {}
+        # controller-published per-replica signals (refreshed every
+        # poll, including version-unchanged replies): queue-depth load
+        # for p2c routing, node ids for locality-preferring routes
+        self._loads: Dict[str, float] = {}
+        self._nodes: Dict[str, str] = {}
+        # replica name -> monotonic deadline: recently-failed replicas
+        # the routing table may still list (the controller needs a few
+        # health-check passes to retire a death) — skipped until the
+        # deadline so retries don't bounce off the same corpse
+        self._suspect: Dict[str, float] = {}
+        # result (ref / streaming generator) -> replica that produced
+        # it, so a consumer seeing an error AFTER submission can
+        # suspect-list the right replica (mark_suspect / replica_of)
+        self._ref_replica: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
         self._last_refresh = 0.0
         self._controller = None
         self._refreshing = False
@@ -95,11 +112,22 @@ class DeploymentHandle:
                 self._actors.clear()
             return
         if targets.get("unchanged"):
+            # loads ride every reply: they change each health-check
+            # pass without bumping the routing version
+            with self._lock:
+                self._loads.update(targets.get("loads") or {})
             return
         with self._lock:
             self._version = targets["version"]
             self._replicas = targets["replicas"]
             self._max_concurrent = targets["max_concurrent_queries"]
+            self._loads = dict(targets.get("loads") or {})
+            self._nodes = dict(targets.get("nodes") or {})
+            # suspects for retired tags must not accumulate over
+            # autoscaling churn in a long-lived handle
+            now = time.monotonic()
+            self._suspect = {r: d for r, d in self._suspect.items()
+                             if d > now and r in self._loads}
             live = set(self._replicas)
             for r in self._replicas:
                 self._inflight.setdefault(r, 0)
@@ -118,16 +146,38 @@ class DeploymentHandle:
         return actor
 
     # ------------------------------------------------------------- routing
-    def _pick_replica(self) -> Optional[str]:
-        """Power-of-two choices among replicas with spare concurrency."""
+    def _load_score(self, r: str) -> float:
+        """Effective queue depth: the replica's telemetry-published load
+        (covers traffic from OTHER handles and engine-internal queues)
+        plus this handle's own in-flight count (covers what we sent
+        since the last health-check pass).  Handle-local counts alone
+        hotspot a pool under skewed stream lengths — every handle sees
+        its own short queue while one replica drowns."""
+        return self._inflight.get(r, 0) + self._loads.get(r, 0.0)
+
+    def _pick_replica(self, prefer_node: Optional[str] = None
+                      ) -> Optional[str]:
+        """Power-of-two choices on effective queue depth among replicas
+        with spare concurrency; ``prefer_node`` narrows to replicas
+        colocated with that node first (e.g. the node holding a KV
+        handoff's primary copy) and falls back to the whole pool —
+        the cross-node loser still gets the object via the transfer
+        plane's locality-aware pull, just not for free."""
+        now = time.monotonic()
         candidates = [r for r in self._replicas
-                      if self._inflight.get(r, 0) < self._max_concurrent]
+                      if self._inflight.get(r, 0) < self._max_concurrent
+                      and self._suspect.get(r, 0.0) <= now]
         if not candidates:
             return None
+        if prefer_node:
+            colocated = [r for r in candidates
+                         if self._nodes.get(r) == prefer_node]
+            if colocated:
+                candidates = colocated
         if len(candidates) == 1:
             return candidates[0]
         a, b = random.sample(candidates, 2)
-        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+        return a if self._load_score(a) <= self._load_score(b) else b
 
     def _release(self, replica: str) -> None:
         with self._lock:
@@ -135,11 +185,24 @@ class DeploymentHandle:
                 0, self._inflight.get(replica, 1) - 1)
             self._lock.notify_all()
 
+    def mark_suspect(self, replica: str, ttl_s: float = 10.0) -> None:
+        """Skip this replica for ``ttl_s`` (an error surfaced on its
+        stream/result AFTER submission succeeded, so the routing loop's
+        own submit-failure handling never saw it)."""
+        with self._lock:
+            self._suspect[replica] = time.monotonic() + ttl_s
+
+    def replica_of(self, result) -> Optional[str]:
+        """The replica a _route result (ref / streaming generator) was
+        submitted to, for mark_suspect on late-surfacing errors."""
+        return self._ref_replica.get(result)
+
     def _route(self, method: str, args: tuple, kwargs: dict):
         return self._route_impl(
             lambda actor: actor.handle_request.remote(method, args, kwargs))
 
-    def _route_streaming(self, method: str, args: tuple, kwargs: dict):
+    def _route_streaming(self, method: str, args: tuple, kwargs: dict,
+                         prefer_node: Optional[str] = None):
         """Streaming variant: submits the replica's
         handle_request_streaming with num_returns="streaming" and
         returns the live StreamingObjectRefGenerator.  The in-flight
@@ -148,9 +211,10 @@ class DeploymentHandle:
         slot for its true duration."""
         return self._route_impl(
             lambda actor: actor.handle_request_streaming.options(
-                num_returns="streaming").remote(method, args, kwargs))
+                num_returns="streaming").remote(method, args, kwargs),
+            prefer_node=prefer_node)
 
-    def _route_impl(self, submit):
+    def _route_impl(self, submit, prefer_node: Optional[str] = None):
         """One routing loop for both request shapes: pick a replica
         (power-of-two choices under max_concurrent_queries), call
         ``submit(actor)``, and anchor the in-flight release on the
@@ -163,7 +227,7 @@ class DeploymentHandle:
         deadline = time.monotonic() + 60.0
         while True:
             with self._lock:
-                replica = self._pick_replica()
+                replica = self._pick_replica(prefer_node)
                 if replica is not None:
                     self._inflight[replica] = \
                         self._inflight.get(replica, 0) + 1
@@ -181,13 +245,16 @@ class DeploymentHandle:
                 out = submit(actor)
             except Exception:
                 # replica vanished (scale-down/crash): drop it locally,
-                # force-refresh the table, and retry until the deadline
+                # force-refresh the table, and retry until the deadline.
+                # Also suspect-listed: the refreshed table may re-add it
+                # until the controller retires the death
                 with self._lock:
                     self._inflight[replica] = max(
                         0, self._inflight.get(replica, 1) - 1)
                     if replica in self._replicas:
                         self._replicas.remove(replica)
                     self._actors.pop(replica, None)
+                    self._suspect[replica] = time.monotonic() + 10.0
                 if time.monotonic() > deadline:
                     raise
                 self._refresh(force=True)
@@ -196,6 +263,10 @@ class DeploymentHandle:
             # in-flight count drops the instant the completion lands —
             # no polling drainer between a reply and the next admission
             anchor = out.completed() if hasattr(out, "completed") else out
+            try:
+                self._ref_replica[out] = replica
+            except TypeError:
+                pass
             self._worker().add_ready_callback(
                 anchor, lambda r=replica: self._release(r))
             return out
@@ -252,3 +323,174 @@ class DeploymentHandle:
 
     def __repr__(self):
         return f"DeploymentHandle({self.deployment_name!r})"
+
+
+async def _aget(worker, ref, timeout: float = 60.0):
+    """Awaitable ray_tpu.get: readiness via an owned-object ready
+    callback (no polling), then an immediate local get with an executor
+    fallback for store-resident results — the http_proxy fast-path
+    idiom, reusable by any event-loop router."""
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    def _on_ready():
+        loop.call_soon_threadsafe(
+            lambda: fut.done() or fut.set_result(None))
+
+    worker.add_ready_callback(ref, _on_ready)
+    await asyncio.wait_for(fut, timeout=timeout)
+    try:
+        return ray_tpu.get(ref, timeout=0.05)
+    except ray_tpu.exceptions.GetTimeoutError:
+        return await loop.run_in_executor(
+            None, lambda: ray_tpu.get(ref, timeout=timeout))
+
+
+class DisaggHandle:
+    """Client-side prefill->decode router for a disaggregated LLM app
+    (docs/serve_disagg.md).  One ``stream()`` call:
+
+      1. routes the request to the PREFILL pool (p2c on published
+         queue depth) and yields the first token the moment the pool
+         samples it — TTFT never waits for the handoff, let alone a
+         decode slot;
+      2. routes the KV handoff ref to the DECODE pool, preferring a
+         replica colocated with the handoff object's primary copy
+         (``prefer_node``), and streams the decoded tokens;
+      3. re-queues on KVPoolFullError (decode pool momentarily full —
+         bounded backoff, possibly landing on another replica) and
+         re-prefills on replica death, surfacing a ``{"retry": n}``
+         marker mid-stream; already-yielded tokens are not repeated
+         (greedy decode reproduces them; sampled decode resumes with a
+         fresh suffix).
+
+    A prefill replica dying AFTER its handoff was pulled is invisible:
+    the decode stream runs entirely off the imported pages."""
+
+    def __init__(self, prefill_deployment: str, decode_deployment: str,
+                 *, max_retries: int = 3,
+                 pool_full_timeout_s: float = 30.0):
+        self.prefill = DeploymentHandle(prefill_deployment)
+        self.decode = DeploymentHandle(decode_deployment)
+        self.max_retries = max_retries
+        self.pool_full_timeout_s = pool_full_timeout_s
+
+    async def stream(self, request: Dict[str, Any]):
+        """Async generator: ``{"token": id}`` per token (first token
+        from the prefill pool, the rest from the decode pool), optional
+        ``{"retry": n}`` markers, then a summary dict."""
+        emitted = 0                 # tokens already yielded to the client
+        retries = 0
+        while True:
+            try:
+                async for kind, val in self._once(request, emitted):
+                    if kind == "token":
+                        emitted += 1
+                        yield {"token": val}
+                    else:
+                        yield val
+                return
+            except Exception as e:
+                if _is_pool_full(e) or retries >= self.max_retries:
+                    raise
+                retries += 1
+                yield {"retry": retries, "error": type(e).__name__}
+
+    async def _once(self, request: Dict[str, Any], skip: int):
+        """One prefill->decode attempt, yielding ("token", id) /
+        ("summary", dict).  The first ``skip`` stream positions (tokens
+        the client already holds from an earlier attempt) are consumed
+        silently — a retry resumes the client's stream, it doesn't
+        restart it."""
+        worker = self.prefill._worker()
+        loop = asyncio.get_running_loop()
+        # routing runs in an executor: _route_impl may block (capacity
+        # waits, cold-table controller RPC) and this coroutine shares
+        # its loop with every other stream (the http_proxy precedent)
+        pref_ref = await loop.run_in_executor(
+            None, lambda: self.prefill.prefill.remote(request))
+        try:
+            pref = await _aget(worker, pref_ref)
+        except Exception:
+            # the prefill replica died with our call on it: suspect-list
+            # it so the outer retry routes around the corpse
+            name = self.prefill.replica_of(pref_ref)
+            if name:
+                self.prefill.mark_suspect(name)
+            raise
+        pos = 1                 # stream position incl. the first token
+        if pos > skip:
+            yield ("token", pref["first_token"])
+        if pref.get("done"):
+            yield ("summary", {
+                "finish_reason": pref["finish_reason"],
+                "num_tokens": 1, "prompt_len": pref["prompt_len"],
+                "time_to_first_token_s":
+                    pref["time_to_first_token_s"]})
+            return
+        deadline = time.monotonic() + self.pool_full_timeout_s
+        backoff = 0.05
+        while True:
+            gen = await loop.run_in_executor(
+                None, lambda: self.decode._route_streaming(
+                    "decode", (pref["handoff"], request), {},
+                    prefer_node=pref.get("node")))
+            try:
+                async for item_ref in gen:
+                    item = await _aget(worker, item_ref, timeout=60.0)
+                    if "token" in item:
+                        pos += 1
+                        if pos > skip:
+                            yield ("token", item["token"])
+                    else:
+                        item.setdefault(
+                            "time_to_first_token_s",
+                            pref["time_to_first_token_s"])
+                        yield ("summary", item)
+                return
+            except Exception as e:
+                if not _is_pool_full(e):
+                    # a death surfaced mid-stream: the submit succeeded,
+                    # so the routing loop never saw it — suspect-list
+                    # the replica before the outer retry re-routes
+                    name = self.decode.replica_of(gen)
+                    if name:
+                        self.decode.mark_suspect(name)
+                    raise
+                if pos > 1 or time.monotonic() > deadline:
+                    raise      # mid-stream pool-full can't happen; bail
+                # decode pool momentarily full: re-queue the SAME
+                # handoff (bounded backoff, p2c may pick another
+                # replica) instead of wedging behind the pool
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
+
+    # -- convenience non-streaming API ---------------------------------
+    async def generate(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Aggregate a stream() into one result dict (tokens list +
+        summary), the non-streaming client shape."""
+        tokens: List[int] = []
+        out: Dict[str, Any] = {}
+        retries = 0
+        async for item in self.stream(request):
+            if "token" in item:
+                tokens.append(item["token"])
+            elif "retry" in item:
+                retries = item["retry"]
+            else:
+                out = dict(item)
+        out["tokens"] = tokens
+        if retries:
+            out["retries"] = retries
+        return out
+
+    def __repr__(self):
+        return (f"DisaggHandle({self.prefill.deployment_name!r} -> "
+                f"{self.decode.deployment_name!r})")
+
+
+def _is_pool_full(e: BaseException) -> bool:
+    """KVPoolFullError, possibly wrapped by the task-error path."""
+    if isinstance(e, ray_tpu.exceptions.KVPoolFullError):
+        return True
+    return "KVPoolFullError" in f"{type(e).__name__}: {e}"

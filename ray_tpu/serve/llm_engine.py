@@ -94,6 +94,45 @@ class GenerationResult:
     latency_s: float
 
 
+# re-exported here for engine-local users; defined in ray_tpu.exceptions
+# so client-side routers can catch it without importing the jax-heavy
+# engine module.  Raised synchronously by import_prefill when the
+# import wait queue hits its cap (import_queue_max) — see that method's
+# docstring for the FIFO-wait-vs-reject contract.
+from ray_tpu.exceptions import KVPoolFullError  # noqa: E402
+
+
+@dataclasses.dataclass
+class PrefillHandoff:
+    """A prefilled request packaged for decode on ANOTHER engine.
+
+    ``kv`` is the request's occupied pool pages gathered into ONE
+    contiguous host array ``[n_pool_leaves, npages, kv_heads,
+    page_size, 2*head_dim]`` (K/V fused exactly as the pool stores
+    them, ops/paged_attention.py layout) — one ``jax.device_get``
+    round-trip on export, one ``device_put`` + page-table remap on
+    import.  Only ``ceil(prompt_len / page_size)`` pages ship: the
+    first generated token's K/V is written by the importer's first
+    decode step (same invariant as a locally-prefilled install).
+    ``kv is None`` when the request finished at its first token
+    (``finish_reason`` set) — nothing to decode."""
+
+    kv: Optional[Any]                     # np.ndarray, layout above
+    page_size: int
+    npages: int
+    prompt_len: int
+    first_token: int
+    max_new_tokens: int
+    temperature: float
+    eos_id: Optional[int]
+    finish_reason: Optional[str] = None   # set: done at first token
+    export_ms: float = 0.0                # prefill->gather->fetch wall
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.kv.nbytes) if self.kv is not None else 0
+
+
 @dataclasses.dataclass
 class _Request:
     prompt: List[int]
@@ -104,6 +143,18 @@ class _Request:
     on_token: Optional[Callable[[int], None]]
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
     delivered: bool = False
+    export: bool = False                  # deliver a PrefillHandoff
+
+
+@dataclasses.dataclass
+class _Import:
+    """A PrefillHandoff waiting for pool pages on the decode engine.
+    ``need`` is the page count for the FULL generation span (prompt +
+    new tokens, capped by the importing engine's max_seq_len) — decode
+    writes continue past the shipped prompt pages."""
+    handoff: PrefillHandoff
+    request: _Request
+    need: int
 
 
 class _Slot:
@@ -140,6 +191,9 @@ class EngineStats:
         self.tokens_generated = 0        # + prefill first tokens
         self.prefills = 0
         self.requests_completed = 0
+        self.exports = 0                 # prefill handoffs shipped out
+        self.imports = 0                 # prefill handoffs admitted
+        self.import_rejects = 0          # pool-full import rejections
 
     def occupancy(self, num_slots: int) -> float:
         """Fraction of step-slots that produced a delivered token (junk
@@ -154,6 +208,9 @@ class EngineStats:
             "prefills": self.prefills,
             "requests_completed": self.requests_completed,
             "batch_occupancy": round(self.occupancy(num_slots), 4),
+            "exports": self.exports,
+            "imports": self.imports,
+            "import_rejects": self.import_rejects,
         }
 
 
@@ -166,7 +223,8 @@ class LLMEngine:
                  min_prefill_bucket: int = 16, block_size: int = 32,
                  max_seq_len: Optional[int] = None,
                  paged: bool = False, page_size: int = 64,
-                 kv_pool_pages: Optional[int] = None):
+                 kv_pool_pages: Optional[int] = None,
+                 import_queue_max: Optional[int] = None):
         # Inference engine owns its own copies of the knobs a server
         # tunes independently of training:
         #  - max_seq_len: the KV allocation AND the per-step attention
@@ -243,6 +301,38 @@ class LLMEngine:
                 range(1, self.kv_pool_pages))[::-1]
             self._ready: collections.deque = collections.deque()
             self._stale_slots: set = set()   # evicted, redirect pending
+            self._imports: collections.deque = collections.deque()
+            # admitted-handoff wait-queue bound: beyond it
+            # import_prefill rejects SYNCHRONOUSLY (KVPoolFullError) so
+            # the caller can route elsewhere.  None (default) queues
+            # without bound — a queued import costs one deque entry
+            # plus its handoff bytes, and FIFO page allocation cannot
+            # wedge (pages free as resident streams complete, exactly
+            # the pending-prefill contract).  Routers that would
+            # otherwise poll a full pool are the reason rejection is
+            # a cap, not the default: at saturation, thousands of
+            # re-queue round-trips/s cost more decode throughput than
+            # the waiting ever could.
+            self.import_queue_max = import_queue_max
+            self._export_jit: dict = {}      # (page bucket, wave) -> fn
+            self._import_jit: dict = {}      # (page bucket, wave) -> fn
+            # optional observer called with the host-side remap wall
+            # (ms) per admitted import wave — the serving layer feeds
+            # its handoff-latency histogram without the engine growing
+            # a telemetry dependency
+            self.on_import_admit: Optional[Callable[[float], None]] = None
+            # KV pool leaf identity + handoff shape: pool leaves carry
+            # trailing [pool_pages, kv_heads, page_size, 2*head_dim]
+            # (ops/paged_attention.py layout); _ltot counts total
+            # per-layer pools across the cache tree (the scan axis of a
+            # scanned leaf contributes its length) — the leading axis of
+            # PrefillHandoff.kv, which both handoff ends must agree on.
+            self._pool_tail = (cfg.n_kv_heads, page_size,
+                               2 * cfg.head_dim)
+            self._ltot = sum(
+                (leaf.shape[0] if leaf.ndim == 5 else 1)
+                for leaf in jax.tree.leaves(self._cache)
+                if self._is_pool_leaf(leaf))
             self._block_jit = jax.jit(self._block_fn_paged,
                                       donate_argnums=(1, 2))
         else:
@@ -389,6 +479,89 @@ class LLMEngine:
                 prefill, donate_argnums=(1,))
         return fn
 
+    def _is_pool_leaf(self, leaf) -> bool:
+        """A cache leaf holding the shared KV page pool: trailing
+        [pool_pages, kv_heads, page_size, 2*head_dim] with optionally a
+        leading scan-layer axis.  Other cache leaves (per-layer scalar
+        indices) are handoff-irrelevant."""
+        return (leaf.ndim in (4, 5)
+                and leaf.shape[-4] == self.kv_pool_pages
+                and tuple(leaf.shape[-3:]) == self._pool_tail)
+
+    def _page_bucket(self, n: int) -> int:
+        """Power-of-two page-count bucket: bounds the gather/scatter jit
+        specializations the same way _bucket bounds prefill shapes."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_pages)
+
+    def _get_export(self, bucket: int, wave: int):
+        """Gather jit: pull ``wave`` requests' pool pages (``bucket``
+        page slots each, pad slots point at scratch page 0) into ONE
+        contiguous [wave, ltot, bucket, kvh, ps, 2hd] device array —
+        fetched with a single device_get per export group.  Read-only
+        on the cache (no donation): dispatched after this iteration's
+        block step, so it reads the chained cache value in stream
+        order, before any later dispatch can recycle the pages."""
+        fn = self._export_jit.get((bucket, wave))
+        if fn is None:
+            def gather(cache, idx):
+                flat = idx.reshape(-1)                  # [wave*bucket]
+                parts = []
+                for leaf in jax.tree.leaves(cache):
+                    if not self._is_pool_leaf(leaf):
+                        continue
+                    ax = leaf.ndim - 4
+                    g = jnp.take(leaf, flat, axis=ax)
+                    if leaf.ndim == 5:
+                        lc = leaf.shape[0]
+                        g = g.reshape((lc, wave, bucket)
+                                      + tuple(leaf.shape[-3:]))
+                        g = jnp.moveaxis(g, 1, 0)
+                    else:
+                        g = g.reshape((wave, 1, bucket)
+                                      + tuple(leaf.shape[-3:]))
+                    parts.append(g)
+                return jnp.concatenate(parts, axis=1)
+            fn = self._export_jit[(bucket, wave)] = jax.jit(gather)
+        return fn
+
+    def _get_import(self, bucket: int, wave: int):
+        """Scatter jit: the inverse remap — land a handoff's pages at
+        freshly allocated LOCAL physical pages (pad rows/slots target
+        scratch page 0, which absorbs garbage by contract).  Donates
+        the cache like every other engine cache transform."""
+        fn = self._import_jit.get((bucket, wave))
+        if fn is None:
+            def scatter(cache, kv, idx):
+                # kv [wave, ltot, bucket, kvh, ps, 2hd]; idx [wave, bucket]
+                flat = idx.reshape(-1)
+                leaves, treedef = jax.tree_util.tree_flatten(cache)
+                out = []
+                off = 0                     # ltot cursor (trace-static)
+                for leaf in leaves:
+                    if not self._is_pool_leaf(leaf):
+                        out.append(leaf)
+                        continue
+                    tail = tuple(leaf.shape[-3:])
+                    if leaf.ndim == 5:
+                        lc = leaf.shape[0]
+                        src = jnp.moveaxis(kv[:, off:off + lc], 1, 0)
+                        src = src.reshape((lc, wave * bucket) + tail)
+                        out.append(leaf.at[:, flat].set(
+                            src.astype(leaf.dtype)))
+                        off += lc
+                    else:
+                        src = kv[:, off].reshape((wave * bucket,) + tail)
+                        out.append(leaf.at[flat].set(
+                            src.astype(leaf.dtype)))
+                        off += 1
+                return jax.tree_util.tree_unflatten(treedef, out)
+            fn = self._import_jit[(bucket, wave)] = jax.jit(
+                scatter, donate_argnums=(0,))
+        return fn
+
     def _block_fn_paged(self, params, cache, state, admit_meta,
                         admit_lasts, admit_tables):
         """Paged block step.  Differences from _block_fn: per-row block
@@ -509,36 +682,11 @@ class LLMEngine:
         if len(prompt) > self.max_prompt_len:
             raise ValueError(f"prompt len {len(prompt)} > max_prompt_len "
                              f"{self.max_prompt_len}")
-        try:
-            loop = asyncio.get_running_loop()
-        except RuntimeError:
-            loop = None
-        if loop is not None:
-            fut = loop.create_future()
-
-            def deliver(ok, value, _loop=loop, _fut=fut):
-                def _set():
-                    if _fut.done():
-                        return
-                    (_fut.set_result if ok else _fut.set_exception)(value)
-                _loop.call_soon_threadsafe(_set)
-
-            self._enqueue(_Request(list(prompt), max_new_tokens,
-                                   temperature, eos_id, deliver, on_token))
-            return fut
-        ev = threading.Event()
-        out: dict = {}
-
-        def deliver(ok, value):
-            out["ok" if ok else "err"] = value
-            ev.set()
-
-        self._enqueue(_Request(list(prompt), max_new_tokens, temperature,
-                               eos_id, deliver, on_token))
-        ev.wait()
-        if "err" in out:
-            raise out["err"]
-        return out["ok"]
+        return self._submit_request(
+            lambda deliver: _Request(list(prompt), max_new_tokens,
+                                     temperature, eos_id, deliver,
+                                     on_token),
+            self._enqueue)
 
     async def stream(self, prompt: List[int], *, max_new_tokens: int = 32,
                      temperature: float = 0.0,
@@ -580,6 +728,191 @@ class LLMEngine:
                 yield int(tok)
             yield result
             return
+
+    # ------------------------------------------- disaggregated handoff API
+
+    def export_prefill(self, prompt: List[int], *,
+                       max_new_tokens: int = 32, temperature: float = 0.0,
+                       eos_id: Optional[int] = None):
+        """Prefill-only submit: run slotless paged prefill, sample the
+        first token, then GATHER the request's pool pages into one
+        contiguous host buffer and free them — the request never takes
+        a decode slot here.  Resolves to a PrefillHandoff that
+        ``import_prefill`` on another engine admits straight into
+        decode.  Loop-aware like ``submit`` (awaitable inside an event
+        loop, blocking from a plain thread)."""
+        if not self.paged:
+            raise RuntimeError("export_prefill requires paged=True")
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.max_prompt_len:
+            raise ValueError(f"prompt len {len(prompt)} > max_prompt_len "
+                             f"{self.max_prompt_len}")
+        return self._submit_request(
+            lambda deliver: _Request(list(prompt), max_new_tokens,
+                                     temperature, eos_id, deliver, None,
+                                     export=True),
+            self._enqueue)
+
+    def import_prefill(self, handoff: PrefillHandoff, *,
+                       on_token: Optional[Callable[[int], None]] = None):
+        """Admit a PrefillHandoff exported elsewhere: allocate pool
+        pages for the full generation span, scatter the shipped prompt
+        K/V into them (one upload + page-table remap), and queue the
+        request for a decode slot with its first token already known —
+        no prefill runs here.  Resolves to the GenerationResult
+        (``tokens[0]`` is the handoff's first token; ``on_token`` fires
+        only for tokens decoded HERE — the exporter already delivered
+        the first one).
+
+        Admission is FIFO like pending prefills: an import whose pages
+        aren't free yet WAITS in the engine (one deque entry — pages
+        free as resident streams complete, so the wait cannot wedge).
+        KVPoolFullError is raised SYNCHRONOUSLY only when
+        ``import_queue_max`` is set and the wait queue is full — the
+        signal for the router to re-queue against another replica."""
+        if not self.paged:
+            raise RuntimeError("import_prefill requires paged=True")
+        h = handoff
+        if h.finish_reason is not None:
+            raise ValueError("handoff already finished at its first "
+                             "token; nothing to decode")
+        if h.page_size != self.page_size:
+            raise ValueError(f"handoff page_size {h.page_size} != engine "
+                             f"page_size {self.page_size}")
+        kv = np.asarray(h.kv)
+        if (kv.ndim != 5 or kv.shape[0] != self._ltot
+                or kv.shape[1] != h.npages
+                or tuple(kv.shape[2:]) != self._pool_tail):
+            raise ValueError(
+                f"handoff kv shape {kv.shape} does not match this "
+                f"engine's pool layout [{self._ltot}, {h.npages}, "
+                f"{self._pool_tail}] — engines must share the model "
+                "config and page_size")
+        if h.prompt_len >= self.cfg.max_seq_len:
+            # an exporter with a larger max_seq_len can produce this;
+            # it must fail THIS request, not broadcast-error inside the
+            # engine loop (which would fail every resident request)
+            raise ValueError(
+                f"handoff prompt_len {h.prompt_len} >= this engine's "
+                f"max_seq_len {self.cfg.max_seq_len}")
+        span = min(h.prompt_len + h.max_new_tokens, self.cfg.max_seq_len)
+        need = -(-span // self.page_size)
+        if h.npages > need or h.npages > self.max_pages:
+            raise ValueError(
+                f"handoff ships {h.npages} pages but this engine's "
+                f"span allows {min(need, self.max_pages)}")
+        if need > self.kv_pool_pages - 1:
+            raise ValueError(
+                f"handoff needs {need} KV pages; pool holds "
+                f"{self.kv_pool_pages - 1}")
+
+        def build(deliver):
+            req = _Request([], h.max_new_tokens, h.temperature, h.eos_id,
+                           deliver, on_token)
+            return _Import(h, req, need)
+
+        def enqueue(imp):
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("engine closed")
+                if (self.import_queue_max is not None
+                        and len(self._imports) >= self.import_queue_max):
+                    # synchronous, so a full pool costs the caller ONE
+                    # exception — not an engine-loop round trip
+                    self.stats.import_rejects += 1
+                    raise KVPoolFullError(
+                        f"import wait queue full "
+                        f"({len(self._imports)} >= "
+                        f"{self.import_queue_max}); "
+                        f"{len(self._free_pages)} pages free of "
+                        f"{self.kv_pool_pages - 1}")
+                self._imports.append(imp)
+                if self._thread is None or not self._thread.is_alive():
+                    self._thread = threading.Thread(target=self._loop,
+                                                    daemon=True)
+                    self._thread.start()
+                self._lock.notify()
+
+        return self._submit_request(build, enqueue)
+
+    async def stream_import(self, handoff: PrefillHandoff):
+        """Async-generator import: yields each token decoded HERE the
+        quantum it lands (the handoff's first token is NOT re-yielded —
+        the prefill side already streamed it), then the final
+        GenerationResult.  Decode-pool end of the disaggregated
+        streaming path (serve/llm.py LLMServer.decode)."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_token(tok: int) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, ("token", int(tok)))
+
+        fut = self.import_prefill(handoff, on_token=on_token)
+        fut.add_done_callback(lambda f: q.put_nowait(("done", f)))
+        seen = 0
+        while True:
+            kind, val = await q.get()
+            if kind == "token":
+                seen += 1
+                yield val
+                continue
+            result = val.result()   # raises KVPoolFullError / fatal
+            # tokens[0] is the handoff's first token; backstop any
+            # decoded token whose bridge lost the race with completion
+            for tok in result.tokens[1 + seen:]:
+                yield int(tok)
+            yield result
+            return
+
+    def _submit_request(self, build, enqueue):
+        """The loop-aware dual delivery path shared by submit /
+        export_prefill / import_prefill: build the queue item around a
+        deliver callback, enqueue it, and return an awaitable (inside a
+        running event loop) or block for the result."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            fut = loop.create_future()
+
+            def deliver(ok, value, _loop=loop, _fut=fut):
+                def _set():
+                    if _fut.done():
+                        return
+                    (_fut.set_result if ok else _fut.set_exception)(value)
+                _loop.call_soon_threadsafe(_set)
+
+            enqueue(build(deliver))
+            return fut
+        ev = threading.Event()
+        out: dict = {}
+
+        def deliver(ok, value):
+            out["ok" if ok else "err"] = value
+            ev.set()
+
+        enqueue(build(deliver))
+        ev.wait()
+        if "err" in out:
+            raise out["err"]
+        return out["ok"]
+
+    def load_snapshot(self) -> dict:
+        """Cheap queue/occupancy snapshot feeding per-pool autoscaling
+        (serve/replica.py get_metrics -> controller): a prefill pool
+        scales off queue depth, a decode pool off slot/ready occupancy."""
+        with self._lock:
+            return {
+                "pending": len(self._pending),
+                "imports": len(self._imports) if self.paged else 0,
+                "ready": len(self._ready) if self.paged else 0,
+                "busy_slots": self.num_slots - len(self._free),
+                "free_pages": (len(self._free_pages) if self.paged
+                               else 0),
+                "pool_pages": self.kv_pool_pages if self.paged else 0,
+            }
 
     def close(self):
         with self._lock:
@@ -844,6 +1177,11 @@ class LLMEngine:
     # ---------------------------------------------------- paged engine loop
 
     def _pages_needed(self, req: _Request) -> int:
+        if req.export:
+            # prefill-only: the request never decodes here, so it holds
+            # exactly its prompt's pages until the export gather frees
+            # them (the importer allocates the full span)
+            return -(-len(req.prompt) // self.page_size)
         span = min(len(req.prompt) + req.max_new_tokens,
                    self.cfg.max_seq_len)
         return -(-span // self.page_size)
@@ -861,7 +1199,7 @@ class LLMEngine:
         while True:
             with self._lock:
                 while (not self._closed and not self._pending
-                       and not self._ready
+                       and not self._imports and not self._ready
                        and all(s is None for s in self._slots)
                        and inflight is None):
                     self._lock.wait()
@@ -869,13 +1207,28 @@ class LLMEngine:
                     victims = (
                         [s.request for s in self._slots if s is not None]
                         + [pf.slot_state.request for pf in self._ready]
+                        + [imp.request for imp in self._imports]
                         + list(self._pending))
                     self._pending.clear()
                     self._ready.clear()
+                    self._imports.clear()
                     for req in victims:
                         self._safe_deliver(
                             req, False, RuntimeError("engine closed"))
                     return
+                # imports first (a decode-pool engine's whole intake is
+                # handoffs), FIFO like pending prefills: the head waits
+                # for pages, nothing bypasses it (no starvation), and
+                # pages always free as resident streams complete — the
+                # queue-full rejection happens synchronously at submit
+                import_todo = []
+                while self._imports:
+                    if self._imports[0].need > len(self._free_pages):
+                        break
+                    imp = self._imports.popleft()
+                    pages = [self._free_pages.pop()
+                             for _ in range(imp.need)]
+                    import_todo.append((imp, pages))
                 todo = []
                 oversized = []
                 while self._pending:
@@ -889,20 +1242,27 @@ class LLMEngine:
                     req = self._pending.popleft()
                     pages = [self._free_pages.pop() for _ in range(need)]
                     todo.append((req, pages))
-                installs = []
-                while self._free and self._ready:
-                    installs.append((self._ready.popleft(),
-                                     self._free.pop()))
             for req in oversized:
                 self._safe_deliver(req, False, ValueError(
                     f"request needs {self._pages_needed(req)} KV pages; "
                     f"pool holds {self.kv_pool_pages - 1}"))
             try:
+                # scatter imports BEFORE taking installs: an imported
+                # request can land in a free slot this same iteration,
+                # and the block step is dispatched after the scatter so
+                # stream order covers its page writes
+                self._dispatch_import_waves(import_todo)
+                with self._lock:
+                    installs = []
+                    while self._free and self._ready:
+                        installs.append((self._ready.popleft(),
+                                         self._free.pop()))
                 new_prefills = self._dispatch_prefill_waves(todo)
                 nxt = self._dispatch_block_paged(installs)
                 if inflight is not None:
                     self._process_block_paged(inflight)
-                self._process_prefill_waves(new_prefills)
+                self._process_exports(
+                    self._process_prefill_waves(new_prefills))
                 inflight = nxt
             except Exception as e:   # engine-fatal (OOM, compile error)
                 with self._lock:
@@ -910,10 +1270,13 @@ class LLMEngine:
                         [s.request for s in self._slots if s is not None]
                         + [pf.slot_state.request for pf in self._ready]
                         + [r for r, _ in todo]
+                        + [imp.request for imp, _ in import_todo]
+                        + [imp.request for imp in self._imports]
                         + ([r for _, r in inflight[1]] if inflight else [])
                         + list(self._pending))
                     self._pending.clear()
                     self._ready.clear()
+                    self._imports.clear()
                     self._slots = [None] * self.num_slots
                     self._free = list(range(self.num_slots))[::-1]
                     self._free_pages = list(
@@ -949,29 +1312,40 @@ class LLMEngine:
             out.append((firsts, metas))
         return out
 
-    def _process_prefill_waves(self, waves: list) -> None:
+    def _process_prefill_waves(self, waves: list) -> list:
         """Fetch this iteration's prefill first-tokens with ONE combined
         device->host transfer (each fetch is a full round-trip on a
         remote-chip transport; a saturation burst dispatches many waves
-        per iteration) and complete/queue each request."""
+        per iteration) and complete/queue each request.  Returns the
+        export-flagged requests (first token now known) for
+        _process_exports."""
         if not waves:
-            return
+            return []
         if len(waves) == 1:
             host = np.asarray(waves[0][0])
         else:
             host = np.asarray(jnp.concatenate([f for f, _ in waves]))
         off = 0
+        exports = []
         for firsts, metas in waves:
             n = firsts.shape[0]
-            self._complete_prefills(metas, host[off:off + n])
+            exports.extend(self._complete_prefills(metas,
+                                                   host[off:off + n]))
             off += n
+        return exports
 
-    def _complete_prefills(self, metas, host) -> None:
+    def _complete_prefills(self, metas, host) -> list:
         """Requests finish here if one token was all they wanted,
-        otherwise they join the ready queue holding their first token."""
+        otherwise they join the ready queue holding their first token.
+        Export-flagged requests are returned for the gather stage
+        instead of queueing for a local slot."""
+        exports = []
         for (req, pages, table), first in zip(metas, host):
             self.stats.tokens_generated += 1
             sl = _Slot(req, len(req.prompt), int(first), pages)
+            if req.export:
+                exports.append((req, sl))
+                continue
             if req.on_token is not None:
                 self._safe_on_token(req, int(first))
             reason = self._finish_reason(sl, self.cfg.max_seq_len)
@@ -984,6 +1358,115 @@ class LLMEngine:
             else:
                 with self._lock:
                     self._ready.append(_Prefilled(sl, table))
+        return exports
+
+    def _process_exports(self, exports: list) -> None:
+        """Gather exported requests' occupied pages into contiguous
+        host buffers — one device dispatch + ONE fetch per
+        (page-bucket, wave) group — free the pages, and deliver
+        PrefillHandoffs.  Dispatched after this iteration's block step,
+        so the gather reads the chained cache in stream order; the
+        freed pages recycle only through later dispatches (the standard
+        pool invariant)."""
+        if not exports:
+            return
+        groups: dict = {}
+        for req, sl in exports:
+            reason = self._finish_reason(sl, self.cfg.max_seq_len)
+            if reason is not None:
+                # done at its first token: nothing to decode anywhere —
+                # ship a kv-less handoff the serving layer completes
+                # from directly
+                self._free_pages.extend(sl.pages)
+                sl.pages = []
+                self.stats.requests_completed += 1
+                self.stats.exports += 1
+                self._safe_deliver(req, True, PrefillHandoff(
+                    kv=None, page_size=self.page_size, npages=0,
+                    prompt_len=sl.pos, first_token=sl.out[0],
+                    max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature, eos_id=req.eos_id,
+                    finish_reason=reason))
+                continue
+            n_occ = -(-sl.pos // self.page_size)   # prompt pages only
+            groups.setdefault(self._page_bucket(n_occ),
+                              []).append((req, sl, n_occ))
+        for bucket, group in groups.items():
+            for start in range(0, len(group), _WAVE_SIZES[-1]):
+                chunk = group[start:start + _WAVE_SIZES[-1]]
+                wave = next(w for w in _WAVE_SIZES if w >= len(chunk))
+                t0 = time.monotonic()
+                idx = np.zeros((wave, bucket), np.int32)
+                for r, (req, sl, n_occ) in enumerate(chunk):
+                    idx[r, :n_occ] = sl.pages[:n_occ]
+                dev = self._get_export(bucket, wave)(self._cache,
+                                                     jnp.asarray(idx))
+                host = np.asarray(dev)             # ONE fetch per group
+                # amortized per request (the import side divides its
+                # wave cost the same way — the stages must be
+                # comparable in the handoff-latency histogram)
+                ms = round((time.monotonic() - t0) * 1e3 / len(chunk), 3)
+                for r, (req, sl, n_occ) in enumerate(chunk):
+                    kv = np.ascontiguousarray(host[r, :, :n_occ])
+                    self._free_pages.extend(sl.pages)
+                    sl.pages = []
+                    self.stats.exports += 1
+                    self._safe_deliver(req, True, PrefillHandoff(
+                        kv=kv, page_size=self.page_size, npages=n_occ,
+                        prompt_len=sl.pos, first_token=sl.out[0],
+                        max_new_tokens=req.max_new_tokens,
+                        temperature=req.temperature, eos_id=req.eos_id,
+                        export_ms=ms))
+
+    def _dispatch_import_waves(self, todo: list) -> None:
+        """Scatter admitted handoffs' prompt K/V into their freshly
+        allocated pages — one packed upload + one jitted remap per
+        (page-bucket, wave) group — and queue them ready-to-install
+        with their first token already known.  No prefill compute, no
+        fetch: the decode-only admission path."""
+        if not todo:
+            return
+        groups: dict = {}
+        for imp, pages in todo:
+            groups.setdefault(self._page_bucket(imp.handoff.npages),
+                              []).append((imp, pages))
+        for bucket, group in groups.items():
+            for start in range(0, len(group), _WAVE_SIZES[-1]):
+                chunk = group[start:start + _WAVE_SIZES[-1]]
+                wave = next(w for w in _WAVE_SIZES if w >= len(chunk))
+                t0 = time.monotonic()
+                kvbuf = np.zeros(
+                    (wave, self._ltot, bucket) + self._pool_tail,
+                    dtype=self.cfg.dtype)
+                idx = np.zeros((wave, bucket), np.int32)
+                for r, (imp, pages) in enumerate(chunk):
+                    h = imp.handoff
+                    kvbuf[r, :, :h.npages] = np.asarray(h.kv)
+                    idx[r, :h.npages] = pages[:h.npages]
+                self._cache = self._get_import(bucket, wave)(
+                    self._cache, jnp.asarray(kvbuf), jnp.asarray(idx))
+                if self.on_import_admit is not None:
+                    ms = (time.monotonic() - t0) * 1e3 / len(chunk)
+                    for _ in chunk:
+                        self.on_import_admit(ms)
+                for imp, pages in chunk:
+                    h = imp.handoff
+                    sl = _Slot(imp.request, h.prompt_len, h.first_token,
+                               pages)
+                    self.stats.imports += 1
+                    reason = self._finish_reason(sl, self.cfg.max_seq_len)
+                    if reason is not None:
+                        # belt-and-braces: a 1-token import finishes
+                        # without ever stepping (exporters normally
+                        # short-circuit these with finish_reason)
+                        self._free_pages.extend(sl.pages)
+                        sl.pages = []
+                        self._deliver_result(sl, reason)
+                        continue
+                    table = np.zeros((self.max_pages,), np.int32)
+                    table[:len(pages)] = pages
+                    with self._lock:
+                        self._ready.append(_Prefilled(sl, table))
 
     def _dispatch_block_paged(self, installs: list):
         """Install ready requests into free slots (their last token and

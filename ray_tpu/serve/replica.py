@@ -53,8 +53,28 @@ class ReplicaActor:
         else:
             self._callable = cls_or_fn
             self._is_function = True
+        # method name -> (target, is_async): the two
+        # inspect.iscoroutinefunction calls per request cost more than
+        # a no-op handler at serving QPS; targets are stable for the
+        # replica's lifetime
+        self._targets: Dict[str, Any] = {}
         if user_config is not None:
             self.reconfigure(user_config)
+
+    def _resolve_target(self, method_name: str):
+        """(target, is_async) for one request, cached per method."""
+        key = method_name or "__call__"
+        hit = self._targets.get(key)
+        if hit is None:
+            if self._is_function or key == "__call__":
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            is_async = (inspect.iscoroutinefunction(target)
+                        or inspect.iscoroutinefunction(
+                            getattr(target, "__call__", None)))
+            hit = self._targets[key] = (target, is_async)
+        return hit
 
     # ------------------------------------------------------------- requests
     async def handle_request(self, method_name: str, args: tuple,
@@ -64,15 +84,7 @@ class ReplicaActor:
         _M_ONGOING.set(self._num_ongoing)
         _t0 = rtm.now()
         try:
-            if self._is_function:
-                target = self._callable
-            elif method_name in ("__call__", "", None):
-                target = self._callable
-            else:
-                target = getattr(self._callable, method_name)
-            is_async = (inspect.iscoroutinefunction(target)
-                        or inspect.iscoroutinefunction(
-                            getattr(target, "__call__", None)))
+            target, is_async = self._resolve_target(method_name)
             if is_async:
                 result = await target(*args, **kwargs)
             else:
@@ -101,12 +113,7 @@ class ReplicaActor:
         _M_ONGOING.set(self._num_ongoing)
         _t0 = rtm.now()
         try:
-            if self._is_function:
-                target = self._callable
-            elif method_name in ("__call__", "", None):
-                target = self._callable
-            else:
-                target = getattr(self._callable, method_name)
+            target, _ = self._resolve_target(method_name)
             result = target(*args, **kwargs)
             if inspect.isawaitable(result):
                 result = await result
@@ -145,13 +152,40 @@ class ReplicaActor:
 
     def get_metrics(self) -> Dict[str, Any]:
         """Queue metrics feeding the controller's autoscaling policy
-        (cf. reference serve/_private/autoscaling_metrics.py)."""
+        (cf. reference serve/_private/autoscaling_metrics.py).
+
+        ``load``: the autoscaling signal — the user callable's
+        ``autoscale_load()`` when it defines one and returns a number
+        (e.g. an LLM decode pool's slot pressure, serve/llm.py),
+        otherwise the in-flight request count.  ``node_id`` feeds
+        locality-preferring routing (handle.py prefer_node)."""
+        load = None
+        if not self._is_function:
+            fn = getattr(self._callable, "autoscale_load", None)
+            if fn is not None:
+                try:
+                    # float() inside the guard: a non-numeric return
+                    # must fall back, not fail the health check
+                    load = float(fn())
+                except Exception:
+                    load = None
         return {
             "replica_tag": self.replica_tag,
             "num_ongoing": self._num_ongoing,
+            "load": (load if load is not None
+                     else float(self._num_ongoing)),
+            "node_id": self._node_id(),
             "num_processed": self._num_processed,
             "uptime_s": time.time() - self._started,
         }
+
+    @staticmethod
+    def _node_id() -> str:
+        try:
+            from ray_tpu.runtime.core_worker import get_global_worker
+            return get_global_worker().node_id
+        except Exception:
+            return ""
 
     async def prepare_for_shutdown(self) -> bool:
         deadline = time.monotonic() + 5.0

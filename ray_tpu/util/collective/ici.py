@@ -21,13 +21,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# shard_map promotion shim (_shard_map vs jax.experimental.shard_map)
+from ray_tpu._private.jax_compat import shard_map as _shard_map
+
 
 def allreduce(x: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
     """Allreduce an array whose leading dim is sharded over ``axis``;
     every shard ends up holding the sum of all shards."""
     spec = P(axis)
 
-    @functools.partial(jax.shard_map, mesh=mesh, check_vma=False, in_specs=spec, out_specs=spec)
+    @functools.partial(_shard_map, mesh=mesh, check_vma=False, in_specs=spec, out_specs=spec)
     def _ar(shard):
         total = jax.lax.psum(shard.sum(axis=0, keepdims=True), axis)
         return jnp.broadcast_to(total, shard.shape)
@@ -40,7 +43,7 @@ def psum(x: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
     reduced value replicated everywhere (classic gradient allreduce)."""
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, check_vma=False, in_specs=P(axis), out_specs=P())
+        _shard_map, mesh=mesh, check_vma=False, in_specs=P(axis), out_specs=P())
     def _psum(shard):
         return jax.lax.psum(shard, axis)
 
@@ -53,7 +56,7 @@ def psum(x: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
 def all_gather(x: jax.Array, mesh: Mesh, axis: str = "data") -> jax.Array:
     """Gather shards along the leading dim onto every device."""
     @functools.partial(
-        jax.shard_map, mesh=mesh, check_vma=False, in_specs=P(axis), out_specs=P())
+        _shard_map, mesh=mesh, check_vma=False, in_specs=P(axis), out_specs=P())
     def _ag(shard):
         return jax.lax.all_gather(shard, axis, axis=0, tiled=True)
 
@@ -67,7 +70,7 @@ def reduce_scatter(x: jax.Array, mesh: Mesh,
     leave each device with its 1/N piece of the sum. The contribution size
     must be divisible by the axis size."""
     @functools.partial(
-        jax.shard_map, mesh=mesh, check_vma=False, in_specs=P(axis),
+        _shard_map, mesh=mesh, check_vma=False, in_specs=P(axis),
         out_specs=P(axis))
     def _rs(shard):
         flat = shard.reshape((-1,))
@@ -86,7 +89,7 @@ def ppermute(x: jax.Array, mesh: Mesh, axis: str = "data",
     perm = [(i, (i + shift) % n) for i in range(n)]
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, check_vma=False, in_specs=P(axis), out_specs=P(axis))
+        _shard_map, mesh=mesh, check_vma=False, in_specs=P(axis), out_specs=P(axis))
     def _pp(shard):
         return jax.lax.ppermute(shard, axis, perm)
 

@@ -176,6 +176,94 @@ def test_striped_pull_fails_over_when_source_node_killed(
     ray_tpu.shutdown()
 
 
+def test_disagg_serving_survives_replica_chaos():
+    """Disaggregated LLM serving under replica chaos (docs/
+    serve_disagg.md failure semantics): while 8 streams run against a
+    2-prefill + 2-decode app, one PREFILL replica and one BUSY DECODE
+    replica are killed mid-flight.  Every stream must complete with its
+    full token count — prefill deaths re-route/re-prefill, decode
+    deaths surface a mid-stream retry, and the controller respawns
+    both pools back to target."""
+    import asyncio
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import REPLICA_PREFIX, SERVE_NAMESPACE
+
+    rt.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    try:
+        serve.start()
+        serve.run(serve.llm.build_app(
+            preset="tiny", disaggregated=True, num_replicas=2,
+            prefill_replicas=2, num_slots=4, block_size=4, page_size=8,
+            max_concurrent_queries=32))
+        handle = serve.llm.disagg_handle("tiny")
+
+        async def one(i, fired):
+            toks, summary, retries = [], None, 0
+            async for item in handle.stream(
+                    {"prompt": [i + 1, i + 2, i + 3],
+                     "max_new_tokens": 16, "temperature": 0.0}):
+                if "token" in item:
+                    toks.append(item["token"])
+                elif "retry" in item:
+                    retries = item["retry"]
+                else:
+                    summary = item
+                if i == 0 and len(toks) == 2 and not fired["kill"]:
+                    fired["kill"] = True
+                    _kill_one_per_pool()
+            return toks, summary, retries
+
+        def _kill_one_per_pool():
+            st = serve.status()
+            # one prefill replica (any) ...
+            tag = st["llm-tiny-prefill"]["replicas"][0]
+            rt.kill(rt.get_actor(REPLICA_PREFIX + tag,
+                                 namespace=SERVE_NAMESPACE))
+            # ... and one BUSY decode replica (a stream dies under us)
+            for tag in st["llm-tiny-decode"]["replicas"]:
+                a = rt.get_actor(REPLICA_PREFIX + tag,
+                                 namespace=SERVE_NAMESPACE)
+                if rt.get(a.get_metrics.remote(),
+                          timeout=30)["num_ongoing"] > 0:
+                    rt.kill(a)
+                    break
+
+        async def main():
+            fired = {"kill": False}
+            outs = await asyncio.gather(
+                *[one(i, fired) for i in range(8)])
+            return outs, fired["kill"]
+
+        outs, killed = asyncio.run(
+            asyncio.wait_for(main(), timeout=300))
+        assert killed, "chaos never fired"
+        for i, (toks, summary, _) in enumerate(outs):
+            assert len(toks) == 16, (i, len(toks))
+            assert summary is not None and \
+                summary["finish_reason"] == "length"
+        # at least one stream crossed a decode death and retried
+        assert any(r >= 1 for _, _, r in outs), \
+            "no stream observed the decode kill"
+        # the controller heals both pools back to target
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            st = serve.status()
+            if (len(st["llm-tiny-prefill"]["replicas"]) == 2
+                    and len(st["llm-tiny-decode"]["replicas"]) == 2):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"pools never healed: {serve.status()}")
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        rt.shutdown()
+
+
 def test_shuffle_with_unstable_slow_spill_storage(monkeypatch):
     """A shuffle whose working set overflows the store completes with 30%
     of spill writes failing and injected spill latency underneath
