@@ -94,6 +94,15 @@ SECTIONS = {
                            os.path.join(REPO, "benchmarks",
                                         "telemetry_overhead.py")],
                       timeout=900),
+    # cluster event plane cost guard (docs/observability.md):
+    # interleaved same-box A/B of task throughput with RAY_TPU_EVENTS=0
+    # vs 1 (telemetry pinned on in both arms); the events_overhead row
+    # carries the same <=3% bar as the telemetry plane
+    "events": dict(cmd=[sys.executable,
+                        os.path.join(REPO, "benchmarks",
+                                     "telemetry_overhead.py"),
+                        "--events"],
+                   timeout=900),
     "serve_llm": dict(cmd=[sys.executable,
                            os.path.join(REPO, "benchmarks", "serve_llm.py"),
                            "--suite", "--slots", "32", "--requests", "128"],

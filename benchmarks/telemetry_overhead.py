@@ -1,18 +1,20 @@
-"""Telemetry overhead guard: task throughput with RAY_TPU_TELEMETRY=0/1.
+"""Telemetry + event-plane overhead guards: task throughput A/B.
 
-The always-on runtime telemetry (_private/runtime_metrics.py) claims a
-record path cheap enough to leave on in production.  This bench holds it
-to that: the small-task sync throughput loop (the single most
-instrument-dense path — RPC dispatch, submit, push batch, e2e latency,
-execution timing all fire per task) runs in fresh subprocesses with the
-kill switch off and on, A/B **interleaved** on the same box so the
-VM-throttle drift this host suffers hits both arms equally.  The
-``telemetry`` MICROBENCH section records both rates and the delta; the
-acceptance bar is <= 3% overhead for telemetry on.
+Two always-on observability planes claim record paths cheap enough to
+leave on in production, and this bench holds each to a <= 3% bar on the
+single most instrument-dense path (small-task sync throughput — RPC
+dispatch, submit, push batch, e2e latency, execution timing, and the
+per-task flight-recorder breadcrumb all fire per task):
 
-Usage:
-    python benchmarks/telemetry_overhead.py            # full A/B, JSON rows
-    python benchmarks/telemetry_overhead.py --measure  # one arm (internal)
+* ``python telemetry_overhead.py`` — RAY_TPU_TELEMETRY=0/1 A/B
+  (the metrics plane, _private/runtime_metrics.py; MICROBENCH
+  ``telemetry`` section).
+* ``python telemetry_overhead.py --events`` — RAY_TPU_EVENTS=0/1 A/B
+  with telemetry ON in both arms, so the delta isolates the event
+  plane (_private/cluster_events.py; MICROBENCH ``events`` section).
+
+Arms run in fresh subprocesses, **interleaved** on the same box so the
+VM-throttle drift this host suffers hits both arms equally.
 """
 
 import argparse
@@ -55,9 +57,10 @@ def measure() -> None:
         ray_tpu.shutdown()
 
 
-def run_arm(telemetry: str) -> float:
-    env = dict(os.environ, RAY_TPU_TELEMETRY=telemetry,
-               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+def run_arm(env_overrides: dict) -> float:
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               **env_overrides)
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--measure"],
         capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
@@ -69,34 +72,49 @@ def run_arm(telemetry: str) -> float:
             except (ValueError, KeyError):
                 pass
     raise RuntimeError(
-        f"telemetry arm (RAY_TPU_TELEMETRY={telemetry}) produced no "
-        f"result: rc={proc.returncode}\n{proc.stderr[-1500:]}")
+        f"arm {env_overrides} produced no result: "
+        f"rc={proc.returncode}\n{proc.stderr[-1500:]}")
+
+
+def ab(kill_var: str, base_env: dict, label: str) -> list:
+    """Interleaved rounds, best-of per arm, so a throttle dip in one
+    round can't masquerade as plane overhead.  The within-round order
+    ALTERNATES (0,1 then 1,0): whichever arm runs first in a round
+    pays the previous subprocess's teardown tail (dying workers, store
+    cleanup), and a fixed order turns that into a systematic bias."""
+    best = {"0": 0.0, "1": 0.0}
+    for i in range(ROUNDS):
+        order = ("0", "1") if i % 2 == 0 else ("1", "0")
+        for mode in order:
+            best[mode] = max(best[mode],
+                             run_arm(dict(base_env, **{kill_var: mode})))
+    off, on = best["0"], best["1"]
+    overhead_pct = round((off - on) / off * 100.0, 2) if off else 0.0
+    return [
+        {"name": f"tasks sync {label} off", "ops_per_s": off},
+        {"name": f"tasks sync {label} on", "ops_per_s": on},
+        {"name": f"{label}_overhead", "off_ops_s": off, "on_ops_s": on,
+         "overhead_pct": overhead_pct,
+         "rounds": ROUNDS, "min_time_s": MIN_TIME},
+    ]
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--measure", action="store_true",
                     help="run one measurement arm in-process (internal)")
+    ap.add_argument("--events", action="store_true",
+                    help="A/B the event plane (RAY_TPU_EVENTS) instead "
+                         "of the metrics plane")
     args = ap.parse_args()
     if args.measure:
         measure()
         return
-
-    # interleaved rounds: off, on, off, on ... best-of per arm, so a
-    # throttle dip in one round can't masquerade as telemetry overhead
-    best = {"0": 0.0, "1": 0.0}
-    for _ in range(ROUNDS):
-        for mode in ("0", "1"):
-            best[mode] = max(best[mode], run_arm(mode))
-    off, on = best["0"], best["1"]
-    overhead_pct = round((off - on) / off * 100.0, 2) if off else 0.0
-    rows = [
-        {"name": "tasks sync telemetry off", "ops_per_s": off},
-        {"name": "tasks sync telemetry on", "ops_per_s": on},
-        {"name": "telemetry_overhead", "off_ops_s": off, "on_ops_s": on,
-         "overhead_pct": overhead_pct,
-         "rounds": ROUNDS, "min_time_s": MIN_TIME},
-    ]
+    if args.events:
+        # telemetry pinned ON in both arms: the delta is the event plane
+        rows = ab("RAY_TPU_EVENTS", {"RAY_TPU_TELEMETRY": "1"}, "events")
+    else:
+        rows = ab("RAY_TPU_TELEMETRY", {}, "telemetry")
     for row in rows:
         print(json.dumps(row))
 

@@ -255,6 +255,44 @@ _declare("telemetry_flush_interval_ms", int, 2000,
          "Period of the runtime-metrics flusher pushing per-process "
          "snapshots to the GCS KV (dashboard /metrics, list_metrics); "
          "only metrics that changed since the last flush are re-sent.")
+_declare("events_enabled", bool, True,
+         "Cluster event plane (_private/cluster_events.py): typed "
+         "lifecycle events from every daemon/worker into the GCS event "
+         "table, plus the per-process flight-recorder ring feeding "
+         "crash dossiers.  Also overridable as RAY_TPU_EVENTS=0 (the "
+         "bench kill switch, mirroring RAY_TPU_TELEMETRY); disabling "
+         "drops emits after one global read and never starts the "
+         "flusher.")
+_declare("event_ring_size", int, 512,
+         "Per-process flight-recorder ring capacity: the last N events "
+         "(lifecycle + ring-only task breadcrumbs) kept in memory and "
+         "dumped atomically to the per-worker flight file each flush, "
+         "so a crash dossier can show what the process was doing.")
+_declare("events_flush_interval_ms", int, 500,
+         "Period of the cluster-events flusher batching this process's "
+         "events to the GCS table and rewriting its flight file.")
+_declare("gcs_max_cluster_events", int, 20000,
+         "Max events the GCS cluster event table retains (sharded "
+         "rotation, oldest dropped first).")
+_declare("gcs_events_max_bytes", int, 4 * 1024 * 1024,
+         "Byte budget of the GCS cluster event table (JSON-serialized "
+         "record sizes); the hard retention gate — oldest events are "
+         "evicted across shards until the table fits.")
+_declare("gcs_max_dossiers", int, 128,
+         "Max crash dossiers the GCS retains (FIFO eviction).")
+_declare("dossier_log_tail_bytes", int, 16384,
+         "Bytes of worker stdout/stderr tail harvested into a crash "
+         "dossier.")
+_declare("node_unhealthy_mem_frac", float, 0.92,
+         "Host-memory fraction in a raylet health snapshot above which "
+         "the GCS emits a NODE_UNHEALTHY event for the node.")
+_declare("node_unhealthy_store_frac", float, 0.95,
+         "Object-store occupancy fraction above which a node is "
+         "reported unhealthy.")
+_declare("node_unhealthy_lag_ms", float, 2000.0,
+         "Raylet heartbeat-loop event-loop lag above which a node is "
+         "reported unhealthy (a starved daemon thread: the node may "
+         "miss liveness deadlines soon).")
 
 # --------------------------------------------------------------------------- #
 # TPU / device model                                                          #

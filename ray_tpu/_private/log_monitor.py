@@ -88,6 +88,19 @@ class LogMonitor:
                     return  # GCS unreachable; retry next scan
 
 
+def tail_file(path: str, nbytes: int) -> str:
+    """Last ``nbytes`` of a log file, decoded leniently — the crash-
+    dossier harvest path (raylet reads a dead worker's stdout/stderr)."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(max(0, size - nbytes))
+            data = f.read(nbytes)
+        return data.decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
 def print_to_driver(message: dict, job_id: Optional[str] = None) -> None:
     """Driver-side subscriber: prefix lines like the reference does.
 

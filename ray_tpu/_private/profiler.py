@@ -47,6 +47,32 @@ def sample_folded(duration_s: float = 2.0,
     return counts
 
 
+def dump_stacks(max_depth: int = 60) -> Dict[str, str]:
+    """One instantaneous stack per live thread, keyed by thread name
+    (``dump_stacks`` RPC: a stalled process answers in microseconds,
+    no gdb, no sampling window).  The dumping thread excludes itself."""
+    import threading
+    import traceback
+    names = {t.ident: t.name for t in threading.enumerate()}
+    me = threading.get_ident()
+    out: Dict[str, str] = {}
+    for tid, frame in sys._current_frames().items():
+        if tid == me:
+            continue
+        stack = traceback.format_stack(frame)[-max_depth:]
+        out[f"{names.get(tid, '?')} ({tid})"] = "".join(stack)
+    return out
+
+
+def stacks_text(threads: Dict[str, str]) -> str:
+    """Terminal rendering of a dump_stacks() reply."""
+    lines = []
+    for name in sorted(threads):
+        lines.append(f"--- thread {name} ---")
+        lines.append(threads[name].rstrip())
+    return "\n".join(lines)
+
+
 def folded_text(counts: Dict[str, int]) -> str:
     """Flamegraph collapse format, hottest first."""
     return "\n".join(

@@ -196,7 +196,13 @@ class GcsTaskTable:
                 rec = self._tasks.get(victim)
                 if rec is None:
                     continue
-                if rec["state"] in (FINISHED, FAILED) or \
+                # records whose state never left "" carry only instant
+                # markers — the synthetic ``handoff-<object>`` /
+                # ``col-<group>-r<rank>`` rows.  They have no lifecycle
+                # to finish, so they must be evictable like terminal
+                # tasks: sparing them let a long-lived serve app grow
+                # the table to 2x cap in handoff rows that never die.
+                if rec["state"] in (FINISHED, FAILED, "") or \
                         len(self._tasks) > 2 * cap:
                     del self._tasks[victim]
                     dropped += 1

@@ -506,6 +506,16 @@ class ObjectPuller:
                 ps.cv.notify_all()
             if striped:
                 _M_FAILOVER.inc()
+                # lifecycle event (docs/observability.md): which source
+                # died mid-stripe and who absorbed its ranges is exactly
+                # the evidence that evaporates otherwise
+                from ray_tpu._private import cluster_events as cev
+                cev.emit(cev.TRANSFER_FAILOVER,
+                         f"pull source {st.node[:8]} failed "
+                         f"({outcome}); re-queued its outstanding "
+                         "ranges on the survivors",
+                         severity="WARNING", object_id=oid.hex(),
+                         source_node=st.node, outcome=outcome)
 
         while True:
             while len(inflight) < window:

@@ -68,6 +68,9 @@ class DashboardHead:
             web.post("/api/jobs/{submission_id}/stop", self._job_stop),
             web.get("/api/serve/applications", self._serve_status),
             web.get("/api/events", self._events),
+            web.get("/events", self._events),
+            web.get("/api/dossiers", self._dossiers),
+            web.get("/api/dossiers/{dossier_id}", self._dossier),
             web.get("/api/profile", self._profile),
             web.get("/metrics", self._metrics),
             web.get("/", self._index),
@@ -216,18 +219,40 @@ class DashboardHead:
 
     # --------------------------------------------------------------- events
     async def _events(self, request) -> web.Response:
-        """Structured component events (reference dashboard event view
-        over event.cc / event_logger.py emissions)."""
+        """Cluster event plane (docs/observability.md): typed lifecycle
+        events with node/worker/actor/severity/type filters."""
         try:
             limit = int(request.query.get("limit", 200))
         except ValueError:
             raise web.HTTPBadRequest(text="limit must be an integer") \
                 from None
-        sev = request.query.get("severity")
+        q = request.query
         events = await self._call(
-            lambda: self.gcs.call("list_events",
-                                  {"limit": limit, "severity": sev}))
+            lambda: self.gcs.call("list_cluster_events", {
+                "limit": limit, "severity": q.get("severity"),
+                "min_severity": q.get("min_severity"),
+                "type": q.get("type"), "node_id": q.get("node_id"),
+                "worker_id": q.get("worker_id"),
+                "actor_id": q.get("actor_id"),
+                "job_id": q.get("job_id"),
+                "source": q.get("source")}))
         return web.json_response({"events": events})
+
+    async def _dossiers(self, request) -> web.Response:
+        out = await self._call(lambda: self.gcs.call("list_dossiers"))
+        return web.json_response({"dossiers": out})
+
+    async def _dossier(self, request) -> web.Response:
+        """One crash dossier; ``?format=text`` pretty-prints it."""
+        did = request.match_info["dossier_id"]
+        d = await self._call(
+            lambda: self.gcs.call("get_dossier", {"dossier_id": did}))
+        if d is None:
+            raise web.HTTPNotFound(text=f"dossier {did} not found")
+        if request.query.get("format") == "text":
+            from ray_tpu._private.cluster_events import format_dossier
+            return web.Response(text=format_dossier(d))
+        return web.json_response(d)
 
     # -------------------------------------------------------------- profile
     async def _profile(self, request) -> web.Response:

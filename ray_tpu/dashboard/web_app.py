@@ -321,10 +321,17 @@ async function renderPGs() {
 
 async function renderEvents() {
   const d = await J("/api/events?limit=200");
-  return table(["time", "severity", "source", "label", "message"],
+  return `<div class="hint">typed cluster lifecycle events (filters: ` +
+    `?type=&severity=&node_id=&worker_id= — crash dossiers at ` +
+    `<span class="mono">/api/dossiers</span>)</div>` +
+    table(["time", "severity", "type", "source", "node", "worker",
+           "message"],
     d.events.slice().reverse().map(e => [
       new Date(e.ts * 1000).toLocaleTimeString(),
-      badge(e.severity), esc(e.source), esc(e.label), esc(e.message)]));
+      badge(e.severity), esc(e.type || e.label), esc(e.source),
+      `<span class="mono">${esc((e.node_id || "").slice(0, 10))}</span>`,
+      `<span class="mono">${esc((e.worker_id || "").slice(0, 10))}</span>`,
+      esc(e.message)]));
 }
 
 window.tailJob = (sid) => { followJob = sid || null; logOffset = 0;

@@ -12,7 +12,39 @@ class RayTpuError(Exception):
     """Base class for all ray_tpu errors."""
 
 
-class TaskError(RayTpuError):
+class _DossierRef:
+    """Mixin: errors caused by a process death carry a ``dossier_id``
+    (the dead worker's id hex, or a node id hex) referencing the crash
+    dossier the raylet/GCS harvested — event ring, log tail, metrics
+    watermarks (docs/observability.md).  ``debug_dossier()`` fetches
+    and pretty-prints it at the driver."""
+
+    dossier_id: str | None = None
+
+    def debug_dossier(self, timeout: float = 10.0) -> str:
+        """Fetch + format this death's crash dossier from the GCS.
+
+        Returns the formatted dossier text; a descriptive placeholder
+        when no dossier reference exists or it already rotated out."""
+        did = self.dossier_id
+        if not did:
+            cause = getattr(self, "cause", None)
+            if isinstance(cause, _DossierRef) and cause.dossier_id:
+                return cause.debug_dossier(timeout)
+            return "(no dossier reference on this error)"
+        from ray_tpu._private.cluster_events import (fetch_dossier,
+                                                     format_dossier)
+        try:
+            d = fetch_dossier(did, timeout)
+        except Exception as e:  # noqa: BLE001 - diagnostics must not raise
+            return f"(dossier {did[:12]} fetch failed: {e})"
+        if not d:
+            return f"(dossier {did[:12]} not found — rotated out, or " \
+                   "the cluster is gone)"
+        return format_dossier(d)
+
+
+class TaskError(RayTpuError, _DossierRef):
     """A task raised an exception during execution (cf. RayTaskError)."""
 
     def __init__(self, function_name: str = "", cause: BaseException | None = None,
@@ -27,23 +59,39 @@ class TaskError(RayTpuError):
         # Exception's default reduce would reconstruct with the FORMATTED
         # message as function_name, re-wrapping the error on every pickle
         # round trip (messages grew exponentially down task chains).
+        # The state dict keeps the dossier reference across the wire.
         return (TaskError, (self.function_name, self.cause,
-                            self.traceback_str))
+                            self.traceback_str),
+                {"dossier_id": self.dossier_id})
 
 
-class WorkerCrashedError(RayTpuError):
+class WorkerCrashedError(RayTpuError, _DossierRef):
     """The worker process executing the task died (cf. WorkerCrashedError)."""
 
+    def __init__(self, message: str = "worker crashed",
+                 dossier_id: str | None = None):
+        self.dossier_id = dossier_id
+        super().__init__(message)
 
-class ActorDiedError(RayTpuError):
+    def __reduce__(self):
+        return (WorkerCrashedError, (self.args[0] if self.args else "",
+                                     self.dossier_id))
+
+
+class ActorDiedError(RayTpuError, _DossierRef):
     """The actor is dead and will not be restarted (cf. RayActorError)."""
 
-    def __init__(self, reason: str = "actor died"):
+    def __init__(self, reason: str = "actor died",
+                 dossier_id: str | None = None):
         self.reason = reason
+        self.dossier_id = dossier_id
         super().__init__(reason)
 
+    def __reduce__(self):
+        return (ActorDiedError, (self.reason, self.dossier_id))
 
-class ActorUnavailableError(RayTpuError):
+
+class ActorUnavailableError(RayTpuError, _DossierRef):
     """The actor is temporarily unreachable (restart pending)."""
 
 
@@ -60,7 +108,7 @@ class OutOfDiskError(RayTpuError):
     fallback allocation refuse to write (reference OutOfDiskError)."""
 
 
-class OutOfMemoryError(RayTpuError):
+class OutOfMemoryError(RayTpuError, _DossierRef):
     """A worker was killed by the memory monitor (cf. OutOfMemoryError)."""
 
 

@@ -5,7 +5,8 @@ state_manager.py) + dashboard/state_aggregator.py:132 (StateAPIManager).
 """
 
 from ray_tpu.experimental.state.api import (  # noqa: F401
-    list_actors, list_jobs, list_metrics, list_nodes, list_objects,
+    get_dossier, list_actors, list_cluster_events, list_dossiers,
+    list_jobs, list_metrics, list_nodes, list_objects,
     list_placement_groups, list_tasks, list_workers, memory_summary,
     metrics_summary, summarize_actors, summarize_objects, summarize_tasks,
     timeline)
@@ -13,6 +14,7 @@ from ray_tpu.experimental.state.api import (  # noqa: F401
 __all__ = [
     "list_tasks", "list_actors", "list_nodes", "list_jobs", "list_objects",
     "list_workers", "list_placement_groups", "list_metrics",
+    "list_cluster_events", "get_dossier", "list_dossiers",
     "summarize_tasks", "summarize_actors", "summarize_objects",
     "memory_summary", "metrics_summary", "timeline",
 ]

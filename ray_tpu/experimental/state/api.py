@@ -50,6 +50,62 @@ def list_placement_groups(*, limit: int = 10000) -> List[dict]:
     return _gcs().call("list_placement_groups")[:limit]
 
 
+# ----------------------------------------------------------- event plane
+def list_cluster_events(*, node_id: Optional[str] = None,
+                        job_id: Optional[str] = None,
+                        actor_id: Optional[str] = None,
+                        worker_id: Optional[str] = None,
+                        severity: Optional[str] = None,
+                        min_severity: Optional[str] = None,
+                        type: Optional[str] = None,  # noqa: A002
+                        source: Optional[str] = None,
+                        limit: int = 1000) -> List[dict]:
+    """Typed lifecycle events from the GCS cluster event table
+    (docs/observability.md): node up/down/unhealthy, worker
+    spawn/exit, actor restarts, lease timeouts, spill traffic,
+    transfer failovers, collective rank deaths, serve replica
+    retire/autoscale.  Id filters are prefix matches; ``severity`` is
+    exact, ``min_severity`` a floor (DEBUG < INFO < WARNING < ERROR)."""
+    return _gcs().call("list_cluster_events", {
+        "node_id": node_id, "job_id": job_id, "actor_id": actor_id,
+        "worker_id": worker_id, "severity": severity,
+        "min_severity": min_severity, "type": type, "source": source,
+        "limit": limit})
+
+
+def get_dossier(dossier_id: str) -> Optional[dict]:
+    """Crash dossier by id — a dead worker's id hex (prefix ok) or a
+    dead node's id hex.  Contains the process's flight-recorder event
+    ring, log tail and last metrics watermarks (docs/observability.md);
+    ``format_dossier`` renders it for terminals."""
+    return _gcs().call("get_dossier", {"dossier_id": dossier_id})
+
+
+def list_dossiers() -> List[dict]:
+    return _gcs().call("list_dossiers")
+
+
+def node_health_table(nodes: List[dict]) -> List[str]:
+    """Render the cluster health table off heartbeat-piggybacked
+    snapshots — one renderer shared by ``metrics_summary()`` and
+    ``ray-tpu status`` ([] when no node has reported health yet)."""
+    rows = [n for n in nodes if n.get("health")]
+    if not rows:
+        return []
+    lines = ["%-14s %-10s %6s %6s %6s %9s %s" % (
+        "NODE", "STATE", "CPU", "MEM", "STORE", "LAG(ms)", "REASONS")]
+    for n in rows:
+        h = n["health"]
+        state = "DEAD" if not n.get("alive") else (
+            "UNHEALTHY" if n.get("unhealthy") else "OK")
+        lines.append("%-14s %-10s %5.0f%% %5.0f%% %5.0f%% %9.0f %s" % (
+            n["node_id"][:12], state,
+            100 * h.get("cpu_frac", 0), 100 * h.get("mem_frac", 0),
+            100 * h.get("store_frac", 0), h.get("loop_lag_ms", 0),
+            ", ".join(n.get("unhealthy_reasons") or [])))
+    return lines
+
+
 # ----------------------------------------------------------------- fan-outs
 def _each_raylet(fn):
     out = []
@@ -395,6 +451,35 @@ def metrics_summary() -> str:
     stalls, pin counts — telemetry without the dashboard."""
     rows = list_metrics()
     lines: List[str] = []
+
+    # cluster event plane (docs/observability.md): top event types by
+    # count plus unhealthy nodes — the single-screen summary covers
+    # what happened, not just how fast
+    try:
+        stats = _gcs().call("cluster_event_stats", {})
+        counts = stats.get("counts_by_type") or {}
+    except (rpc.RpcError, ConnectionError, TimeoutError):
+        stats, counts = {}, {}
+    if counts:
+        lines.append("== Cluster events ==")
+        lines.append("%-34s %10s" % ("TYPE", "COUNT"))
+        top = sorted(counts.items(), key=lambda kv: -kv[1])[:10]
+        for etype, n in top:
+            lines.append("%-34s %10d" % (etype[:34], n))
+        lines.append("%-34s %10d  (%d retained, %d B)" % (
+            "total", sum(counts.values()), stats.get("events", 0),
+            stats.get("bytes", 0)))
+        lines.append("")
+
+    try:
+        nodes = list_nodes()
+    except (rpc.RpcError, ConnectionError, TimeoutError):
+        nodes = []
+    health_lines = node_health_table(nodes)
+    if health_lines:
+        lines.append("== Node health ==")
+        lines.extend(health_lines)
+        lines.append("")
 
     # object-transfer data plane (docs/object_transfer.md): regressions
     # visible without rerunning benchmarks/object_transfer_perf.py

@@ -587,6 +587,25 @@ class CoreWorker:
                            self._pins_gauge_cb)
         rtm.attach(self.gcs.kv_put,
                    ident=f"{mode}-{self.worker_id.hex()[:12]}")
+        # cluster event plane + flight recorder (docs/observability.md):
+        # lifecycle events batch to the GCS table; the in-memory ring
+        # (incl. ring-only task breadcrumbs) is dumped to a per-worker
+        # flight file each flush so the raylet can harvest it into a
+        # crash dossier after this process dies
+        import os
+        from ray_tpu._private import cluster_events as cev
+        flight = None
+        if session_dir and mode == "worker":
+            flight = os.path.join(
+                session_dir, "logs",
+                cev.flight_file_name(self.worker_id.hex()))
+        self._events_recorder = cev.configure(
+            sink=lambda evs: self.gcs.call(
+                "report_cluster_events", {"events": evs}, timeout=5),
+            source=mode, node_id=node_id,
+            worker_id=self.worker_id.hex(),
+            job_id=self.job_id.hex() if mode == "driver" else "",
+            flight_path=flight)
 
     # ------------------------------------------------------------ lifecycle
     def shutdown(self) -> None:
@@ -595,6 +614,8 @@ class CoreWorker:
         # (a newer worker's attach/callback is left untouched)
         rtm.detach(self.gcs.kv_put)
         rtm.remove_gauge_callback("ray_tpu_shm_pins", self._pins_gauge_cb)
+        from ray_tpu._private import cluster_events as cev
+        cev.detach(self._events_recorder)
         try:
             self.events.stop()
         except Exception:
@@ -1930,7 +1951,8 @@ class CoreWorker:
                         self._sched_cv.notify_all()
                     spec, retries = remaining[0]
                     self._retry_or_fail_dead_worker(st, spec, retries,
-                                                    oom, e)
+                                                    oom, e,
+                                                    lease.worker_id)
                 with self._sched_lock:
                     st["leases"].remove(lease)
                 try:
@@ -1982,11 +2004,15 @@ class CoreWorker:
                     f"{spec.get('name', '')}"))
 
     def _retry_or_fail_dead_worker(self, st, spec, retries: int,
-                                   oom: bool, e: BaseException) -> None:
+                                   oom: bool, e: BaseException,
+                                   worker_id: Optional[str] = None
+                                   ) -> None:
         """Retry accounting for one task whose worker died mid-flight.
         An OOM kill draws from its own retry budget (task_oom_retries)
         and leaves max_retries untouched — the task didn't fail, the
-        node ran dry."""
+        node ran dry.  ``worker_id`` (the dead worker) becomes the
+        propagated error's ``dossier_id``, so ``.debug_dossier()`` at
+        the driver can pull the crash forensics the raylet harvested."""
         if oom:
             left = self._oom_retries.get(spec["task_id"],
                                          CONFIG.task_oom_retries)
@@ -1998,12 +2024,13 @@ class CoreWorker:
                     st["queue"].appendleft((spec, retries))
                     self._sched_cv.notify_all()  # wake parked leases
             else:
-                self._store_task_error(
-                    spec, exc.OutOfMemoryError(
-                        f"task {spec['name']} was OOM-killed "
-                        f"{CONFIG.task_oom_retries + 1} times "
-                        f"(host memory exhausted)"),
-                    error_code=ser.ERROR_OOM)
+                oom_err = exc.OutOfMemoryError(
+                    f"task {spec['name']} was OOM-killed "
+                    f"{CONFIG.task_oom_retries + 1} times "
+                    f"(host memory exhausted)")
+                oom_err.dossier_id = worker_id
+                self._store_task_error(spec, oom_err,
+                                       error_code=ser.ERROR_OOM)
         elif retries > 0:
             logger.info("task %s worker died; retrying (%d left)",
                         spec["name"], retries)
@@ -2012,7 +2039,8 @@ class CoreWorker:
                 self._sched_cv.notify_all()  # wake parked leases
         else:
             self._store_task_error(spec, exc.WorkerCrashedError(
-                f"task {spec['name']} worker died: {e}"))
+                f"task {spec['name']} worker died: {e}",
+                dossier_id=worker_id))
 
     def _lease_was_oom_killed(self, lease: _Lease) -> bool:
         payload = {"worker_id": lease.worker_id}
@@ -2502,7 +2530,8 @@ class CoreWorker:
                 return tuple(info["address"])
             if info["state"] == DEAD:
                 raise exc.ActorDiedError(
-                    info.get("death_cause") or "actor is dead")
+                    info.get("death_cause") or "actor is dead",
+                    dossier_id=info.get("death_worker_id"))
             if time.monotonic() > deadline:
                 raise exc.ActorUnavailableError(
                     f"actor {actor_id_hex[:8]} not ready "
@@ -2614,6 +2643,12 @@ class CoreWorker:
             # drivers flame-sample like any worker (`ray-tpu profile`)
             from ray_tpu._private.profiler import sample_folded
             return sample_folded(float((p or {}).get("duration", 2.0)))
+        if method == "dump_stacks":
+            from ray_tpu._private.profiler import dump_stacks, \
+                sample_folded
+            return {"threads": dump_stacks(),
+                    "folded": sample_folded(
+                        float((p or {}).get("duration", 0.2)))}
         raise rpc.RpcError(f"core_worker: unknown method {method}")
 
     def _rpc_core_worker_stats(self, p) -> dict:

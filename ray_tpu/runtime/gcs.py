@@ -19,6 +19,7 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import rpc
@@ -89,10 +90,15 @@ class GcsServer:
         self._kv: Dict[str, bytes] = {}
         from ray_tpu._private.task_events import GcsTaskTable
         self._task_table = GcsTaskTable()
-        # structured component events (reference src/ray/util/event.cc +
-        # event_logger.py): bounded ring consumed by the dashboard
-        from collections import deque as _deque
-        self._events = _deque(maxlen=1000)
+        # cluster event plane (docs/observability.md): sharded,
+        # retention-bounded table of typed lifecycle events aggregated
+        # from every process, plus the bounded crash-dossier store the
+        # raylets fill on abnormal worker exits.  Ephemeral (never
+        # WALed), like task events and metrics.
+        from ray_tpu._private import cluster_events as cev
+        self._events_table = cev.GcsClusterEventTable()
+        self._dossiers: Dict[str, dict] = {}
+        self._dossier_order: deque = deque()
         self._placement_groups: Dict[str, Dict[str, Any]] = {}
         # channel -> list of (conn, subscriber key)
         self._subs: Dict[str, List[rpc.Connection]] = {}
@@ -419,33 +425,126 @@ class GcsServer:
 
     # ------------------------------------------------------ component events
     def _rpc_report_event(self, conn, p):
-        """Machine-readable component event (reference event.cc schema:
-        severity/label/message/source + custom fields)."""
+        """Legacy single-event report (reference event.cc schema:
+        severity/label/message/source + custom fields); folded into the
+        typed cluster event table — ``label`` becomes the event type."""
         ev = {"ts": p.get("ts") or time.time(),
               "severity": p.get("severity", "INFO"),
               "source": p.get("source", "unknown"),
-              "label": p.get("label", ""),
-              "message": p.get("message", ""),
-              "fields": p.get("fields") or {}}
-        with self._lock:   # appends race list_events on RPC threads
-            self._events.append(ev)
+              "type": p.get("label", "") or "EVENT",
+              "message": p.get("message", "")}
+        for k, v in (p.get("fields") or {}).items():
+            if v is not None:
+                ev.setdefault(k, v)
+        self._events_table.put([ev])
         self._publish("events", ev)
         return {"ok": True}
 
     def record_event(self, severity: str, source: str, label: str,
                      message: str, **fields) -> None:
-        """In-process emission for the GCS's own transitions."""
+        """In-process emission for the GCS's own transitions.  Honors
+        the event-plane kill switch (RAY_TPU_EVENTS=0): ambient
+        instrumentation goes quiet; explicit client ``report_event``
+        calls still land (a user API action, not instrumentation)."""
+        from ray_tpu._private import cluster_events as cev
+        if not cev.enabled():
+            return
         self._rpc_report_event(None, {
             "severity": severity, "source": source, "label": label,
             "message": message, "fields": fields})
 
+    def _rpc_report_cluster_events(self, conn, p):
+        """Batched typed-event flush from a process's EventRecorder
+        (cluster_events.py flusher cadence)."""
+        events = p.get("events") or []
+        dropped = self._events_table.put(events)
+        for ev in events:
+            self._publish("events", ev)
+        return {"dropped": dropped}
+
+    def _rpc_list_cluster_events(self, conn, p):
+        return self._events_table.list(
+            node_id=p.get("node_id"), job_id=p.get("job_id"),
+            actor_id=p.get("actor_id"), worker_id=p.get("worker_id"),
+            severity=p.get("severity"),
+            min_severity=p.get("min_severity"),
+            etype=p.get("type"), source=p.get("source"),
+            limit=int(p.get("limit", 1000)))
+
+    def _rpc_cluster_event_stats(self, conn, p):
+        out = self._events_table.stats()
+        out["counts_by_type"] = self._events_table.counts_by_type()
+        return out
+
     def _rpc_list_events(self, conn, p):
+        """Legacy shape (dashboard Events page, PARITY tests): typed
+        records rendered back as label/message/fields rows."""
         limit = int(p.get("limit", 200)) if p else 200
         sev = (p or {}).get("severity")
-        with self._lock:
-            snapshot = list(self._events)
-        out = [e for e in snapshot if sev is None or e["severity"] == sev]
+        std = ("ts", "type", "severity", "source", "message")
+        out = []
+        for ev in self._events_table.list(severity=sev, limit=limit):
+            out.append({"ts": ev.get("ts"),
+                        "severity": ev.get("severity", "INFO"),
+                        "source": ev.get("source", ""),
+                        "label": ev.get("type", ""),
+                        "message": ev.get("message", ""),
+                        "fields": {k: v for k, v in ev.items()
+                                   if k not in std}})
         return out[-limit:]
+
+    # ------------------------------------------------------------- dossiers
+    def _rpc_put_dossier(self, conn, p):
+        """Store a crash dossier (raylet harvest / GCS node-death
+        assembly).  Bounded FIFO: forensic data for recent deaths, not
+        an archive."""
+        did = p["dossier_id"]
+        dossier = dict(p.get("dossier") or {})
+        dossier.setdefault("dossier_id", did)
+        dossier.setdefault("ts", time.time())
+        with self._lock:
+            if did not in self._dossiers:
+                self._dossier_order.append(did)
+            self._dossiers[did] = dossier
+            while len(self._dossiers) > CONFIG.gcs_max_dossiers and \
+                    len(self._dossier_order) > 1:
+                victim = self._dossier_order.popleft()
+                if victim == did:   # never evict the one just stored
+                    self._dossier_order.append(victim)
+                    continue
+                self._dossiers.pop(victim, None)
+        return {"ok": True}
+
+    def _rpc_get_dossier(self, conn, p):
+        """Dossier by id — worker id hex (worker deaths; prefix match
+        accepted) or node id hex (node deaths)."""
+        want = p.get("dossier_id") or ""
+        with self._lock:
+            d = self._dossiers.get(want)
+            if d is None and len(want) >= 8:
+                for did, cand in self._dossiers.items():
+                    if did.startswith(want):
+                        d = cand
+                        break
+            return dict(d) if d else None
+
+    def _rpc_list_dossiers(self, conn, p):
+        with self._lock:
+            return [{"dossier_id": did,
+                     "kind": d.get("kind", "worker"),
+                     "reason": d.get("reason", ""),
+                     "node_id": d.get("node_id", ""),
+                     "worker_id": d.get("worker_id", ""),
+                     "ts": d.get("ts")}
+                    for did, d in self._dossiers.items()]
+
+    def _rpc_dump_stacks(self, conn, p):
+        """Instantaneous per-thread stack dump + a short folded-stack
+        sample of the GCS process itself (profiler plane)."""
+        from ray_tpu._private.profiler import dump_stacks, sample_folded
+        return {"threads": dump_stacks(),
+                "folded": sample_folded(float((p or {}).get(
+                    "duration", 0.2)))}
 
     # ------------------------------------------------------------------ rpc
     def _handle(self, conn: rpc.Connection, method: str, p: Any) -> Any:
@@ -499,6 +598,10 @@ class GcsServer:
                 node_id, self._nodes[node_id]["resources"],
                 self._nodes[node_id]["available"], True)
         self._publish("node", {"node_id": node_id, "state": "ALIVE"})
+        self.record_event("INFO", "gcs", "NODE_UP",
+                          f"node {node_id[:8]} registered",
+                          node_id=node_id,
+                          resources=dict(p.get("resources", {})))
         # a new node may unblock pending actors / placement groups
         threading.Thread(target=self._retry_pending_actors,
                          daemon=True).start()
@@ -551,7 +654,45 @@ class GcsServer:
             if busy or node.get("busy"):
                 node["last_busy"] = time.monotonic()
             node["busy"] = busy
+            health = p.get("health")
+            unhealthy_flip = None
+            if health is not None:
+                node["health"] = dict(health)
+                reasons = self._health_reasons(health)
+                was = bool(node.get("unhealthy"))
+                now_bad = bool(reasons)
+                node["unhealthy"] = now_bad
+                node["unhealthy_reasons"] = reasons
+                if now_bad != was:
+                    unhealthy_flip = (now_bad, reasons, dict(health))
+        if unhealthy_flip is not None:
+            # edge-triggered: one event per transition, not per beat
+            now_bad, reasons, health = unhealthy_flip
+            self.record_event(
+                "WARNING" if now_bad else "INFO", "gcs",
+                "NODE_UNHEALTHY" if now_bad else "NODE_HEALTHY",
+                f"node {p['node_id'][:8]} "
+                + (f"unhealthy: {', '.join(reasons)}" if now_bad
+                   else "recovered"),
+                node_id=p["node_id"], **health)
         return {"ok": True}
+
+    @staticmethod
+    def _health_reasons(health: dict) -> List[str]:
+        """Threshold check over a raylet health snapshot -> list of
+        breach descriptions ([] = healthy)."""
+        reasons = []
+        mem = health.get("mem_frac")
+        if mem is not None and mem >= CONFIG.node_unhealthy_mem_frac:
+            reasons.append(f"mem {mem:.0%}")
+        store = health.get("store_frac")
+        if store is not None and \
+                store >= CONFIG.node_unhealthy_store_frac:
+            reasons.append(f"store {store:.0%}")
+        lag = health.get("loop_lag_ms")
+        if lag is not None and lag >= CONFIG.node_unhealthy_lag_ms:
+            reasons.append(f"loop lag {lag:.0f}ms")
+        return reasons
 
     def _rpc_list_nodes(self, conn, p):
         now = time.monotonic()
@@ -658,6 +799,24 @@ class GcsServer:
                           f"{CONFIG.health_check_failure_threshold} "
                           "heartbeats", node_id=node_id,
                           actors_affected=len(affected))
+        # node-death dossier: the raylet can't harvest its own corpse,
+        # so the GCS assembles what it already holds — the node's last
+        # flushed events, health snapshot and heartbeat age — under the
+        # node id, driver-retrievable like any worker dossier
+        self._rpc_put_dossier(None, {
+            "dossier_id": node_id,
+            "dossier": {
+                "kind": "node", "node_id": node_id,
+                "reason": f"missed "
+                          f"{CONFIG.health_check_failure_threshold} "
+                          f"heartbeats",
+                "health": node.get("health"),
+                "last_heartbeat_age_s": round(
+                    time.monotonic() - node.get("last_heartbeat", 0), 3),
+                "actors_affected": len(affected),
+                "events": self._events_table.list(node_id=node_id,
+                                                  limit=100),
+            }})
         for aid in affected:
             self._on_actor_failure(aid, f"node {node_id[:8]} died")
         # placement groups with a bundle on the dead node go back to PENDING
@@ -1040,6 +1199,9 @@ class GcsServer:
             else:
                 entry["state"] = ALIVE
                 entry["address"] = tuple(p["address"])
+                # a successful restart voids the previous crash's
+                # dossier reference — the next death names its own
+                entry.pop("death_worker_id", None)
         if dead:
             if node_conn is not None:
                 try:
@@ -1053,15 +1215,31 @@ class GcsServer:
         return {"ok": True}
 
     def _rpc_actor_failed(self, conn, p):
-        self._on_actor_failure(p["actor_id"], p.get("reason", "worker died"))
+        self._on_actor_failure(p["actor_id"], p.get("reason", "worker died"),
+                               worker_id=p.get("worker_id"))
         return {"ok": True}
 
-    def _on_actor_failure(self, aid: str, reason: str) -> None:
+    def _on_actor_failure(self, aid: str, reason: str,
+                          worker_id: Optional[str] = None) -> None:
         """Actor restart FSM; cf. GcsActorManager::OnActorCreationFailed /
         SchedulePendingActors (gcs_actor_manager.cc:1233)."""
         with self._lock:
             entry = self._actors.get(aid)
-            if entry is None or entry["state"] == DEAD:
+            if entry is None:
+                return
+            if worker_id:
+                # the worker whose death caused (or followed — a
+                # kill_actor marks DEAD before the raylet reports the
+                # worker's exit) this transition: the handle that
+                # points ActorDiedError.debug_dossier() at the dossier.
+                # Overwrite while the actor is live (each failure's
+                # worker supersedes the last restart's); once DEAD,
+                # first writer wins — a late duplicate report must not
+                # repoint an already-propagated reference.
+                if entry["state"] != DEAD or \
+                        not entry.get("death_worker_id"):
+                    entry["death_worker_id"] = worker_id
+            if entry["state"] == DEAD:
                 return
             if entry["restarts"] < entry["max_restarts"]:
                 entry["restarts"] += 1
@@ -1081,7 +1259,8 @@ class GcsServer:
                                 "reason": reason})
         self.record_event("WARNING" if restart else "ERROR", "gcs",
                           "ACTOR_RESTARTING" if restart else "ACTOR_DEAD",
-                          f"actor {aid[:8]}: {reason}", actor_id=aid)
+                          f"actor {aid[:8]}: {reason}", actor_id=aid,
+                          worker_id=worker_id)
         if restart:
             logger.info("restarting actor %s (%s)", aid[:8], reason)
             self._schedule_actor(aid)

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import psutil
 
+from ray_tpu._private import cluster_events as cev
 from ray_tpu._private import rpc
 from ray_tpu._private import runtime_metrics as rtm
 from ray_tpu._private import transfer
@@ -306,6 +307,16 @@ class Raylet:
                            lambda: len(self._workers))
         rtm.attach(self.gcs.kv_put,
                    ident="raylet-" + self.node_id.hex()[:12])
+        # cluster event plane (docs/observability.md): this raylet's
+        # lifecycle events (worker spawn/exit, OOM kills, spill traffic)
+        # batch to the GCS event table on the recorder's flusher cadence
+        self._events_recorder = cev.configure(
+            sink=lambda evs: self.gcs.call(
+                "report_cluster_events", {"events": evs}, timeout=5),
+            source="raylet", node_id=self.node_id.hex())
+        # folded stacks sampled just before a hang-timeout kill, keyed
+        # by worker id until the dossier harvest consumes them
+        self._hang_stacks: Dict[str, Any] = {}
 
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
@@ -424,23 +435,11 @@ class Raylet:
 
     def _report_event(self, severity: str, label: str, message: str,
                       **fields) -> None:
-        """Best-effort structured component event to the GCS (reference
-        event.cc + event_logger.py; dashboard Events view consumes).
-        Fire-and-forget on its own thread: emission sites sit on
-        memory-critical paths (OOM kill, spill under _spill_mutex) that
-        must never wait on a GCS round trip."""
-        fields.setdefault("node_id", self.node_id.hex())
-
-        def send():
-            try:
-                self.gcs.call("report_event", {
-                    "severity": severity, "source": "raylet",
-                    "label": label, "message": message,
-                    "fields": fields}, timeout=5)
-            except Exception:
-                pass
-
-        threading.Thread(target=send, daemon=True).start()
+        """Typed component event via the batched event plane.  Emission
+        sites sit on memory-critical paths (OOM kill, spill under
+        _spill_mutex) — emit() is a ring append; the recorder's flusher
+        pays the GCS round trip off-path."""
+        cev.emit(label, message, severity=severity, **fields)
 
     # --------------------------------------------------------------- serving
     def _handle(self, conn: rpc.Connection, method: str, p: Any) -> Any:
@@ -461,9 +460,44 @@ class Raylet:
             self._on_worker_dead(peer[1], "connection lost")
 
     # ------------------------------------------------------------- heartbeat
+    def _node_health(self, loop_lag_ms: float) -> Dict[str, Any]:
+        """Health snapshot piggybacked on heartbeats (cpu/mem/store
+        occupancy, heartbeat-loop lag, worker-pool size): feeds the
+        GCS NODE_UNHEALTHY threshold and the `ray-tpu status` health
+        table (docs/observability.md)."""
+        health: Dict[str, Any] = {
+            "loop_lag_ms": round(loop_lag_ms, 1),
+            "workers": len(self._workers),
+            "oom_kills": self._oom_kill_count,
+        }
+        try:
+            vm = psutil.virtual_memory()
+            health["mem_frac"] = round(vm.percent / 100.0, 4)
+            health["cpu_frac"] = round(
+                psutil.cpu_percent(interval=None) / 100.0, 4)
+        except Exception:
+            pass
+        try:
+            st = self.store.stats()
+            health["store_frac"] = round(
+                st["bytes_in_use"] / max(1, st["capacity"]), 4)
+        except Exception:
+            pass
+        return health
+
     def _heartbeat_loop(self) -> None:
         period = CONFIG.heartbeat_period_ms / 1000.0
+        beats = 0
+        t_sleep = time.monotonic()
         while not self._stopped.wait(period):
+            # loop lag = how late this wake fired vs the period —
+            # stamped against the moment we went to SLEEP, so it
+            # measures thread starvation (overloaded box) only, not
+            # the previous iteration's work (a slow GCS heartbeat RPC
+            # must not flip every node to NODE_UNHEALTHY)
+            now = time.monotonic()
+            loop_lag_ms = max(0.0, (now - t_sleep - period) * 1000.0)
+            beats += 1
             try:
                 with self._res_lock:
                     avail = dict(self.available)
@@ -489,11 +523,17 @@ class Raylet:
                                 or bool(self._spilled))
                     except Exception:
                         busy = True
-                reply = self.gcs.call("heartbeat",
-                                      {"node_id": self.node_id.hex(),
-                                       "available": avail,
-                                       "load": load,
-                                       "busy": busy})
+                hb = {"node_id": self.node_id.hex(),
+                      "available": avail,
+                      "load": load,
+                      "busy": busy}
+                # health snapshot every ~1s (or immediately when the
+                # loop itself lagged): cheap, and the GCS only edge-
+                # triggers events on threshold crossings
+                if beats % max(1, int(round(1.0 / period))) == 0 or \
+                        loop_lag_ms >= CONFIG.node_unhealthy_lag_ms:
+                    hb["health"] = self._node_health(loop_lag_ms)
+                reply = self.gcs.call("heartbeat", hb)
                 if reply and reply.get("reregister"):
                     # the GCS restarted without our node in its restored
                     # state: introduce ourselves again
@@ -519,6 +559,11 @@ class Raylet:
                 if self._stopped.is_set():
                     return
                 logger.warning("heartbeat to GCS failed")
+            finally:
+                # re-stamp at the bottom of every iteration (all exit
+                # paths incl. continue) so the next wake's lag excludes
+                # this iteration's own work
+                t_sleep = time.monotonic()
 
     def _lease_spillback_loop(self) -> None:
         """Dedicated thread: never blocks heartbeats (a slow GCS list_nodes
@@ -656,6 +701,8 @@ class Raylet:
             sstore.delete(skey)
             return False
         logger.debug("spilled %s (%d bytes)", oid.hex()[:12], size)
+        cev.emit(cev.OBJECT_SPILL, f"spilled {oid.hex()[:12]}",
+                 severity="DEBUG", object_id=oid.hex(), bytes=size)
         return True
 
     def _fetch_spilled_chunk(self, oid, p):
@@ -751,6 +798,8 @@ class Raylet:
                 self._fallback_local.discard(oid.binary())
             sstore.delete(skey)
             logger.debug("restored %s (%d bytes)", oid.hex()[:12], size)
+            cev.emit(cev.OBJECT_RESTORE, f"restored {oid.hex()[:12]}",
+                     severity="DEBUG", object_id=oid.hex(), bytes=size)
             return True
         finally:
             with self._lock:
@@ -774,6 +823,31 @@ class Raylet:
                                timeout=duration + 30)
         from ray_tpu._private.profiler import sample_folded
         return sample_folded(duration)
+
+    def _rpc_dump_stacks(self, conn, p):
+        """Instant per-thread stacks + a short folded sample of this
+        raylet — or, with ``worker_id``/``pid``, forwarded to one of
+        its workers (`ray-tpu summary stacks`, docs/observability.md:
+        sampling a stalled process without gdb)."""
+        wid = p.get("worker_id")
+        pid = p.get("pid")
+        if wid or pid:
+            with self._lock:
+                h = None
+                for w, handle in self._workers.items():
+                    if (wid and w.startswith(wid)) or \
+                            (pid and handle.proc.pid == int(pid)):
+                        h = handle
+                        break
+            if h is None or h.conn is None:
+                raise rpc.RpcError(
+                    f"no live worker matching {wid or pid!r}")
+            return h.conn.call("dump_stacks",
+                               {"duration": p.get("duration", 0.2)},
+                               timeout=30)
+        from ray_tpu._private.profiler import dump_stacks, sample_folded
+        return {"threads": dump_stacks(),
+                "folded": sample_folded(float(p.get("duration", 0.2)))}
 
     def _rpc_spill_dir(self, conn, p):
         """Clients writing fallback-allocated primaries need the dir."""
@@ -1091,6 +1165,10 @@ class Raylet:
                 proc.terminate()
             except OSError:
                 pass
+        cev.emit(cev.WORKER_SPAWN,
+                 f"worker {worker_id.hex()[:8]} spawned",
+                 worker_id=worker_id.hex(), job_id=job_id,
+                 proc_pid=proc.pid)
         return handle
 
     # ---------------------------------------------------------- zygote
@@ -1275,6 +1353,10 @@ class Raylet:
         handle.job_id = job_id
         with self._lock:
             self._workers[worker_id.hex()] = handle
+        cev.emit(cev.WORKER_SPAWN,
+                 f"cpp worker {worker_id.hex()[:8]} spawned",
+                 worker_id=worker_id.hex(), job_id=job_id,
+                 proc_pid=proc.pid, language="cpp")
         return handle
 
     def _rpc_register_worker(self, conn, p):
@@ -1310,24 +1392,112 @@ class Raylet:
                     q.remove(wid)
             lease = h.lease_id
             actor_id = h.actor_id
+            oom = wid in self._oom_kills
         logger.info("worker %s dead: %s", wid[:8], reason)
         if h.proc.poll() is None:
             try:
                 h.proc.terminate()
             except OSError:
                 pass
+        clean = reason == "idle trim"
+        cev.emit(cev.WORKER_EXIT,
+                 f"worker {wid[:8]} exited: {reason}",
+                 severity="INFO" if clean else "ERROR",
+                 worker_id=wid, actor_id=actor_id, job_id=h.job_id,
+                 reason=reason, exit_code=h.proc.returncode, oom=oom)
+        if not clean and not self._stopped.is_set():
+            # forensics off-path: flight ring + log tail + metrics
+            # watermarks -> GCS dossier, referenced by the propagated
+            # WorkerCrashedError/ActorDiedError (docs/observability.md)
+            threading.Thread(
+                target=self._harvest_dossier,
+                args=(wid, h, reason, actor_id, oom), daemon=True).start()
         if lease is not None:
             self._release_lease_resources(lease)
         if actor_id is not None:
             try:
                 self.gcs.call("actor_failed", {"actor_id": actor_id,
-                                               "reason": reason})
+                                               "reason": reason,
+                                               "worker_id": wid})
             except (ConnectionError, rpc.RpcError):
                 pass
         self._dispatch_pending()
 
+    def _harvest_dossier(self, wid: str, h: WorkerHandle, reason: str,
+                         actor_id: Optional[str], oom: bool) -> None:
+        """Assemble + store one dead worker's crash dossier.  Best
+        effort end to end: forensics must never destabilize the raylet."""
+        import json as _json
+
+        from ray_tpu._private.log_monitor import tail_file
+        try:
+            events = cev.read_flight_file(self.session_dir, wid)
+            tail_n = CONFIG.dossier_log_tail_bytes
+            # python workers log as worker-<wid12>.*, cpp workers as
+            # cppworker-<wid12>.* (_spawn_cpp_worker): try both or the
+            # whole cpp class harvests an empty tail
+            log_tail = {}
+            for s in ("err", "out"):
+                for kind in ("worker", "cppworker"):
+                    path = os.path.join(self.session_dir, "logs",
+                                        f"{kind}-{wid[:12]}.{s}")
+                    tail = tail_file(path, tail_n)
+                    if tail:
+                        break
+                log_tail[s] = tail
+            # the dead process's last flushed metrics snapshots (its
+            # flusher ident is "<mode>-<wid12>"); watermark gauges in
+            # there are the per-interval peaks right before death
+            metrics = {}
+            try:
+                suffix = "/worker-" + wid[:12]
+                keys = [k for k in self.gcs.kv_keys("metrics/")
+                        if k.endswith(suffix)]
+                for key in keys[:48]:
+                    raw = self.gcs.kv_get(key)
+                    if not raw:
+                        continue
+                    try:
+                        blob = _json.loads(raw)
+                    except ValueError:
+                        continue
+                    metrics[key.split("/", 2)[1]] = blob.get("values")
+            except (ConnectionError, rpc.RpcError, TimeoutError):
+                pass
+            dossier = {
+                "kind": "worker", "worker_id": wid,
+                "node_id": self.node_id.hex(),
+                "actor_id": actor_id, "job_id": h.job_id,
+                "pid": h.proc.pid, "reason": reason,
+                "exit_code": h.proc.returncode, "oom": oom,
+                "events": events, "log_tail": log_tail,
+                "metrics": metrics,
+                "stacks": self._hang_stacks.pop(wid, None),
+            }
+            self.gcs.call("put_dossier",
+                          {"dossier_id": wid, "dossier": dossier},
+                          timeout=10)
+        except Exception:
+            logger.debug("dossier harvest for %s failed", wid[:8],
+                         exc_info=True)
+
     def _kill_worker(self, wid: str, reason: str,
-                     force: bool = False) -> None:
+                     force: bool = False,
+                     sample_stacks: bool = False) -> None:
+        if sample_stacks:
+            # hang-timeout kill: flame-sample the still-live process
+            # first so the dossier shows WHERE it was stuck (satellite:
+            # profiler wired into the event plane).  Bounded, and only
+            # on paths that already waited out a multi-second timeout.
+            with self._lock:
+                h0 = self._workers.get(wid)
+                conn = h0.conn if h0 is not None else None
+            if conn is not None:
+                try:
+                    self._hang_stacks[wid] = conn.call(
+                        "profile", {"duration": 0.3}, timeout=5)
+                except Exception:
+                    pass
         with self._lock:
             h = self._workers.get(wid)
             if h is None:
@@ -1491,6 +1661,11 @@ class Raylet:
                 if still_queued:
                     self._pending_leases.remove(req)
             if still_queued:
+                cev.emit(cev.LEASE_TIMEOUT,
+                         f"lease for {need} timed out after "
+                         f"{CONFIG.worker_lease_timeout_s:.0f}s",
+                         severity="WARNING", job_id=p.get("job_id"),
+                         resources=dict(need))
                 raise rpc.RpcError("lease request timed out (resources busy)")
             # dispatch popped it concurrently with our timeout: a grant is
             # imminent — wait briefly for it instead of leaking the lease
@@ -1677,7 +1852,11 @@ class Raylet:
                 "actor_id": p["actor_id"], "spec": p["spec"]},
                 timeout=CONFIG.actor_creation_timeout_s)
         except (rpc.RemoteError, ConnectionError, TimeoutError) as e:
-            self._kill_worker(handle.worker_id.hex(), f"actor init failed: {e}")
+            # a TimeoutError here is a hang-timeout kill: sample the
+            # wedged __init__'s stacks into the dossier before killing
+            self._kill_worker(handle.worker_id.hex(),
+                              f"actor init failed: {e}",
+                              sample_stacks=isinstance(e, TimeoutError))
             raise rpc.RpcError(f"actor init failed: {e}")
         logger.info(
             "actor %s hosted: spawn %.0fms ready %.0fms init %.0fms",
@@ -1934,6 +2113,7 @@ class Raylet:
         # unhook telemetry publishing bound to this raylet's GCS client
         rtm.detach(self.gcs.kv_put)
         rtm.remove_gauge_callback("ray_tpu_worker_pool_size")
+        cev.detach(self._events_recorder)
         if self._log_monitor is not None:
             self._log_monitor.stop()
         with self._lock:

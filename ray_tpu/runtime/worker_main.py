@@ -25,6 +25,7 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import cluster_events as cev
 from ray_tpu._private import rpc
 from ray_tpu._private import runtime_metrics as rtm
 from ray_tpu._private import serialization as ser
@@ -535,6 +536,14 @@ class WorkerProcess:
             # reporter_agent CPU profiling, reporter_agent.py:253)
             from ray_tpu._private.profiler import sample_folded
             return sample_folded(float((p or {}).get("duration", 2.0)))
+        if method == "dump_stacks":
+            # instant per-thread stacks + short folded sample: a stalled
+            # worker answers without gdb (`ray-tpu summary stacks`)
+            from ray_tpu._private.profiler import dump_stacks, \
+                sample_folded
+            return {"threads": dump_stacks(),
+                    "folded": sample_folded(
+                        float((p or {}).get("duration", 0.2)))}
         raise rpc.RpcError(f"worker: unknown method {method}")
 
     # --------------------------------------------------------- normal tasks
@@ -698,6 +707,10 @@ class WorkerProcess:
                                 name=spec.get("name", ""),
                                 **({"trace_id": trace_ctx["trace_id"]}
                                    if trace_ctx else {}))
+        # flight-recorder breadcrumb (ring_only: never shipped to the
+        # GCS table — it lands in this worker's crash dossier instead)
+        cev.emit(cev.TASK_RUNNING, spec.get("name", ""), ring_only=True,
+                 task_id=TaskID(spec["task_id"]).hex())
         # join the submitter's trace: user spans inside the task nest
         # under the caller's span (auto span injection)
         propagate_trace_context(trace_ctx)
@@ -723,6 +736,11 @@ class WorkerProcess:
 
     def _package_error(self, spec, e: BaseException) -> dict:
         tb = traceback.format_exc()
+        cev.emit(cev.TASK_FAILED,
+                 f"{spec.get('name') or spec.get('method', '')}: "
+                 f"{type(e).__name__}: {e}",
+                 severity="WARNING", ring_only=True,
+                 error_type=type(e).__name__)
         if isinstance(e, exc.TaskError):
             # an upstream dependency already failed: propagate ITS error
             # unchanged (re-wrapping nests quoted tracebacks
@@ -1000,6 +1018,9 @@ class WorkerProcess:
                                 actor_id=spec.get("actor_id", ""),
                                 **({"trace_id": trace_ctx["trace_id"]}
                                    if trace_ctx else {}))
+        cev.emit(cev.TASK_RUNNING, spec.get("method", ""), ring_only=True,
+                 task_id=TaskID(spec["task_id"]).hex(),
+                 actor_id=spec.get("actor_id"))
         propagate_trace_context(trace_ctx)
         return None
 

@@ -12,7 +12,8 @@ Usage:
   python -m ray_tpu.scripts stop
   python -m ray_tpu.scripts status [--address ...]
   python -m ray_tpu.scripts list tasks|actors|nodes|jobs|objects|workers|placement-groups
-  python -m ray_tpu.scripts summary tasks|actors|objects|metrics
+  python -m ray_tpu.scripts summary tasks|actors|objects|metrics|stacks
+  python -m ray_tpu.scripts events [--type T] [--node N] [--dossier ID]
   python -m ray_tpu.scripts memory
   python -m ray_tpu.scripts timeline [-o trace.json]
   python -m ray_tpu.scripts job submit|status|logs|stop|list ...
@@ -250,6 +251,14 @@ def cmd_status(args) -> None:
     for n in alive:
         print(f"  node {n['node_id'][:12]} @ "
               f"{n['address'][0]}:{n['address'][1]} {n['resources']}")
+    # cluster health table off the heartbeat-piggybacked snapshots
+    # (docs/observability.md node health plane)
+    from ray_tpu.experimental.state.api import node_health_table
+    health_lines = node_health_table(nodes)
+    if health_lines:
+        print("Health:")
+        for line in health_lines:
+            print("  " + line)
 
 
 def cmd_list(args) -> None:
@@ -279,10 +288,115 @@ def cmd_summary(args) -> None:
         # by p50/p95, stream stalls, pin counts) — docs/observability.md
         print(state.metrics_summary())
         return
+    if args.resource == "stacks":
+        _summary_stacks(args)
+        return
     fn = {"tasks": state.summarize_tasks,
           "actors": state.summarize_actors,
           "objects": state.summarize_objects}[args.resource]
     print(json.dumps(fn(), indent=1, default=str))
+
+
+def _summary_stacks(args) -> None:
+    """`ray-tpu summary stacks [--pid P | --actor A]`: per-thread stack
+    dumps + a short flame sample of live cluster processes, without
+    gdb (docs/observability.md).  Default: the GCS and every raylet;
+    --pid targets the worker process with that pid, --actor the worker
+    hosting that actor (id prefix or name)."""
+    from ray_tpu._private import rpc
+    from ray_tpu._private.profiler import stacks_text, top_summary
+    from ray_tpu.experimental import state
+    from ray_tpu.runtime.core_worker import get_global_worker
+
+    gcs = get_global_worker().gcs
+
+    def show(title, reply):
+        print(f"===== {title} =====")
+        print(stacks_text(reply.get("threads", {})))
+        folded = reply.get("folded")
+        if folded:
+            print("-- hot leaves (sampled) --")
+            print(top_summary(folded, limit=8))
+        print()
+
+    pid = getattr(args, "pid", None)
+    actor = getattr(args, "actor", None)
+    if actor:
+        cand = next(
+            (a for a in state.list_actors()
+             if a["actor_id"].startswith(actor)
+             or (a.get("name") or "") == actor), None)
+        if cand is None or not cand.get("address"):
+            sys.exit(f"no live actor matching {actor!r}")
+        conn = rpc.connect(tuple(cand["address"]), timeout=5.0)
+        try:
+            show(f"actor {cand['actor_id'][:12]}",
+                 conn.call("dump_stacks", {}, timeout=30))
+        finally:
+            conn.close()
+        return
+    if pid:
+        for w in state.list_workers():
+            if w.get("pid") == int(pid) and w.get("alive"):
+                node = next((n for n in state.list_nodes()
+                             if n["node_id"] == w["node_id"]), None)
+                if node is None:
+                    sys.exit(f"worker pid {pid}'s node "
+                             f"{w['node_id'][:12]} is gone")
+                conn = rpc.connect(tuple(node["address"]), timeout=5.0)
+                try:
+                    show(f"worker pid {pid}",
+                         conn.call("dump_stacks", {"pid": int(pid)},
+                                   timeout=30))
+                finally:
+                    conn.close()
+                return
+        sys.exit(f"no live worker with pid {pid}")
+    show("gcs", gcs.call("dump_stacks", {}, timeout=30))
+    for node in state.list_nodes():
+        if not node.get("alive"):
+            continue
+        try:
+            conn = rpc.connect(tuple(node["address"]), timeout=5.0)
+        except OSError:
+            continue
+        try:
+            show(f"raylet {node['node_id'][:12]}",
+                 conn.call("dump_stacks", {}, timeout=30))
+        except (rpc.RpcError, ConnectionError, TimeoutError):
+            pass
+        finally:
+            conn.close()
+
+
+def cmd_events(args) -> None:
+    """`ray-tpu events`: the cluster event table as an operator table;
+    `--dossier <id>` dumps a crash dossier instead."""
+    _connect(args)
+    from ray_tpu.experimental import state
+    if args.dossier:
+        from ray_tpu._private.cluster_events import format_dossier
+        d = state.get_dossier(args.dossier)
+        if d is None:
+            sys.exit(f"no dossier matching {args.dossier!r} "
+                     "(rotated out, or the process died cleanly)")
+        print(format_dossier(d))
+        return
+    rows = state.list_cluster_events(
+        node_id=args.node, job_id=args.job, actor_id=args.actor,
+        worker_id=args.worker, severity=args.severity,
+        min_severity=args.min_severity, type=args.type,
+        limit=args.limit)
+    print("%-8s %-7s %-22s %-8s %-12s %s" % (
+        "TIME", "SEV", "TYPE", "SOURCE", "NODE", "MESSAGE"))
+    for e in rows:
+        print("%-8s %-7s %-22s %-8s %-12s %s" % (
+            time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0))),
+            e.get("severity", "?")[:7], e.get("type", "?")[:22],
+            e.get("source", "")[:8],
+            str(e.get("node_id") or "")[:12],
+            e.get("message", "")))
+    print(f"({len(rows)} events)", file=sys.stderr)
 
 
 def cmd_memory(args) -> None:
@@ -554,9 +668,30 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("summary", help="summarize cluster state")
     sp.add_argument("resource",
-                    choices=["tasks", "actors", "objects", "metrics"])
+                    choices=["tasks", "actors", "objects", "metrics",
+                             "stacks"])
     sp.add_argument("--address")
+    sp.add_argument("--pid", help="(stacks) worker pid to sample")
+    sp.add_argument("--actor",
+                    help="(stacks) actor id prefix or name to sample")
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("events",
+                        help="cluster lifecycle events / crash dossiers")
+    sp.add_argument("--address")
+    sp.add_argument("--severity", help="exact severity filter")
+    sp.add_argument("--min-severity", dest="min_severity",
+                    help="severity floor (DEBUG|INFO|WARNING|ERROR)")
+    sp.add_argument("--type", help="event type (e.g. WORKER_EXIT)")
+    sp.add_argument("--node", help="node id prefix")
+    sp.add_argument("--job", help="job id")
+    sp.add_argument("--actor", help="actor id prefix")
+    sp.add_argument("--worker", help="worker id prefix")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--dossier",
+                    help="dump the crash dossier with this id "
+                         "(worker/node id hex) instead of listing events")
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser("timeline", help="export Chrome trace")
     sp.add_argument("-o", "--output")

@@ -259,6 +259,21 @@ class ServeController:
                 new_target = self._autoscale(name, auto, serving_ongoing,
                                              len(serving), target)
                 if new_target > target or not metrics_partial:
+                    if new_target != target:
+                        # autoscale decision: one event per target
+                        # change, with the inputs that drove it
+                        # (docs/observability.md)
+                        from ray_tpu._private import cluster_events \
+                            as cev
+                        cev.emit(
+                            cev.AUTOSCALE,
+                            f"deployment {name!r}: target "
+                            f"{target} -> {new_target} "
+                            f"(load={serving_ongoing:.1f} over "
+                            f"{len(serving)} serving)",
+                            deployment=name, old_target=target,
+                            new_target=new_target,
+                            load=round(serving_ongoing, 2))
                     target = new_target
 
             # a rising target revives draining replicas before spawning
@@ -332,6 +347,16 @@ class ServeController:
                     to_kill.append(tag)
             for tag in to_kill:
                 info = replicas.pop(tag)
+                from ray_tpu._private import cluster_events as cev
+                why = ("unhealthy" if not info.get("healthy")
+                       else "old version"
+                       if info.get("version") != version
+                       else "scaled down (drained)")
+                cev.emit(cev.REPLICA_RETIRED,
+                         f"deployment {name!r} replica {tag}: {why}",
+                         severity="WARNING" if why == "unhealthy"
+                         else "INFO",
+                         deployment=name, replica=tag, reason=why)
                 self._kill_replica(info["name"])
 
             with self._lock:

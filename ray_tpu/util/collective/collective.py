@@ -131,12 +131,14 @@ class _Mailbox:
     late-arriving message queued forever to poison the next op that
     reuses the (src, tag) slot.  Unsequenced tags (p2p) are exempt."""
 
-    def __init__(self):
+    def __init__(self, group: str = "", rank: int = -1):
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._msgs: Dict[Tuple[int, str], deque] = {}
         self._floor = 0
         self._closed = False
+        self._group = group
+        self._rank = rank
 
     def put(self, src: int, tag: str, payload: Any) -> None:
         seq = tag_seq(tag)
@@ -162,6 +164,17 @@ class _Mailbox:
                     return msg
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    # the likeliest cause is ``src`` dying mid-op: emit
+                    # the rank-death event before unwinding so the
+                    # cluster event table explains the op failure
+                    # (docs/observability.md)
+                    from ray_tpu._private import cluster_events as cev
+                    cev.emit(cev.COLLECTIVE_RANK_DEATH,
+                             f"group {self._group!r} rank {self._rank}: "
+                             f"recv from rank {src} timed out "
+                             f"(tag={tag}) — peer dead or stalled",
+                             severity="ERROR", group=self._group,
+                             rank=self._rank, src_rank=src)
                     raise TimeoutError(
                         f"collective recv (src={src}, tag={tag}) timed out")
                 self._cv.wait(remaining)
@@ -191,7 +204,7 @@ class _Group:
         self._worker = worker
         self._store = getattr(worker, "store", None)
         self._node = getattr(worker, "node_id", "")
-        self._mailbox = _Mailbox()
+        self._mailbox = _Mailbox(name, rank)
         self._board = ServeBoard()
         # "msg" never blocks (mailbox append): inline on the reader.
         # "take" stays POOLED: an already-published entry resolves its

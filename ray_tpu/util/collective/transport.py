@@ -69,6 +69,12 @@ _M_STALL_H = rtm.histogram(
     "ray_tpu_collective_seg_wait_ms",
     "per-segment blocking wait inside a collective op (ms)")
 
+# a single-segment wait past this emits a COLLECTIVE_RING_STALL cluster
+# event (docs/observability.md) — well above healthy segment times, far
+# below the op timeout, so the event fires while the op can still be
+# saved (or at least explains the timeout that follows)
+_RING_STALL_EVENT_MS = 5000.0
+
 
 def tag_seq(tag: str) -> Optional[int]:
     """Op sequence number embedded in a collective tag (``"<seq>:..."``);
@@ -260,6 +266,13 @@ class TcpLink:
                 f"collective take from rank {self._peer} failed: "
                 f"{e}") from e
         except ConnectionError as e:
+            # hard rank death detected at the transport: emit before
+            # unwinding so the event table explains the op failure
+            from ray_tpu._private import cluster_events as cev
+            cev.emit(cev.COLLECTIVE_RANK_DEATH,
+                     f"collective peer rank {self._peer} connection "
+                     f"lost mid-op: {e}", severity="ERROR",
+                     peer_rank=self._peer)
             raise ConnectionError(
                 f"collective peer rank {self._peer} connection lost "
                 f"mid-op: {e}") from e
@@ -270,6 +283,15 @@ class TcpLink:
         ms = (rtm.now() - t0) * 1000.0
         _M_STALL_H.observe(ms)
         _M_STALL.set_max(ms)
+        if ms >= _RING_STALL_EVENT_MS:
+            # a segment wait this long means the ring is limping (a
+            # rank is starved or its link is saturated): one WARNING
+            # event per offending wait, next to the stall watermark
+            from ray_tpu._private import cluster_events as cev
+            cev.emit(cev.COLLECTIVE_RING_STALL,
+                     f"waited {ms:.0f}ms on a segment from rank "
+                     f"{self._peer}", severity="WARNING",
+                     peer_rank=self._peer, stall_ms=round(ms, 1))
         if not isinstance(arr, np.ndarray):
             raise RuntimeError(
                 f"collective take from rank {self._peer} returned "

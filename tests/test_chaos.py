@@ -173,6 +173,29 @@ def test_striped_pull_fails_over_when_source_node_killed(
                  np.arange(n, dtype=np.float64)[:: n // 64]).all())
     # failover, not lineage re-execution: the producer ran exactly once
     assert open(marker).read() == "x"
+    # forensics (docs/observability.md): the failover left a typed
+    # event, and the dead node has a driver-retrievable dossier naming
+    # it with >=1 event explaining the death
+    from ray_tpu.experimental import state
+    deadline = time.monotonic() + 60
+    failovers, dossier = [], None
+    while time.monotonic() < deadline:
+        failovers = state.list_cluster_events(type="TRANSFER_FAILOVER")
+        dossier = state.get_dossier(node_dst.node_id)
+        if failovers and dossier is not None:
+            break
+        time.sleep(0.5)
+    assert failovers, "no TRANSFER_FAILOVER event reached the GCS"
+    assert dossier is not None, "no dossier for the killed node"
+    assert dossier["kind"] == "node"
+    assert dossier["node_id"] == node_dst.node_id
+    assert any(e.get("type") == "NODE_DEAD" or "heartbeat"
+               in str(dossier.get("reason", ""))
+               for e in [dossier] + list(dossier.get("events") or [])), \
+        dossier
+    dead_events = state.list_cluster_events(type="NODE_DEAD",
+                                            node_id=node_dst.node_id)
+    assert dead_events, "no NODE_DEAD event for the killed node"
     ray_tpu.shutdown()
 
 
@@ -215,18 +238,23 @@ def test_disagg_serving_survives_replica_chaos():
                     _kill_one_per_pool()
             return toks, summary, retries
 
+        killed_actor_ids = []
+
         def _kill_one_per_pool():
             st = serve.status()
             # one prefill replica (any) ...
             tag = st["llm-tiny-prefill"]["replicas"][0]
-            rt.kill(rt.get_actor(REPLICA_PREFIX + tag,
-                                 namespace=SERVE_NAMESPACE))
+            a = rt.get_actor(REPLICA_PREFIX + tag,
+                             namespace=SERVE_NAMESPACE)
+            killed_actor_ids.append(a._actor_id.hex())
+            rt.kill(a)
             # ... and one BUSY decode replica (a stream dies under us)
             for tag in st["llm-tiny-decode"]["replicas"]:
                 a = rt.get_actor(REPLICA_PREFIX + tag,
                                  namespace=SERVE_NAMESPACE)
                 if rt.get(a.get_metrics.remote(),
                           timeout=30)["num_ongoing"] > 0:
+                    killed_actor_ids.append(a._actor_id.hex())
                     rt.kill(a)
                     break
 
@@ -256,6 +284,28 @@ def test_disagg_serving_survives_replica_chaos():
             time.sleep(0.5)
         else:
             raise AssertionError(f"pools never healed: {serve.status()}")
+        # forensics: each killed replica's worker left a WORKER_EXIT
+        # event naming it, and its dossier is driver-retrievable with
+        # >=1 event explaining the death (docs/observability.md)
+        from ray_tpu.experimental import state
+        assert killed_actor_ids
+        for aid in killed_actor_ids:
+            deadline = time.monotonic() + 60
+            exits, dossier = [], None
+            while time.monotonic() < deadline:
+                exits = state.list_cluster_events(type="WORKER_EXIT",
+                                                  actor_id=aid)
+                if exits:
+                    dossier = state.get_dossier(exits[0]["worker_id"])
+                if exits and dossier is not None:
+                    break
+                time.sleep(0.5)
+            assert exits, f"no WORKER_EXIT event for actor {aid[:8]}"
+            assert dossier is not None, \
+                f"no dossier for actor {aid[:8]}'s worker"
+            assert dossier["worker_id"] == exits[0]["worker_id"]
+            assert dossier["actor_id"] == aid
+            assert dossier.get("reason"), dossier
     finally:
         try:
             serve.shutdown()

@@ -1,0 +1,299 @@
+"""Cluster event plane + failure flight recorder (docs/observability.md):
+typed lifecycle events into the GCS table, retention bounds, crash
+dossiers, dump_stacks, and the task-table synthetic-record bound."""
+
+import json
+import os
+import time
+
+import pytest
+
+from ray_tpu._private import cluster_events as cev
+from ray_tpu._private.config import CONFIG
+
+
+# --------------------------------------------------------------- units
+def test_event_table_retention_bounds():
+    """Both retention gates hold: max event count (sharded rotation)
+    and the max-bytes budget — the table can never grow unbounded."""
+    table = cev.GcsClusterEventTable(max_events=64,
+                                     max_bytes=1024 * 1024)
+    dropped = table.put([{"type": "T", "node_id": f"n{i % 5}",
+                          "message": f"m{i}"} for i in range(500)])
+    st = table.stats()
+    assert st["events"] <= 64
+    assert dropped >= 500 - 64
+    # byte budget: oversized payloads evict oldest-first until it fits
+    table2 = cev.GcsClusterEventTable(max_events=10_000,
+                                      max_bytes=8 * 1024)
+    table2.put([{"type": "BIG", "node_id": f"n{i}",
+                 "blob": "x" * 1024} for i in range(64)])
+    assert table2.stats()["bytes"] <= 8 * 1024
+    assert table2.stats()["events"] < 64
+    # counts_by_type survives rotation (metrics_summary top-types view)
+    assert table.counts_by_type()["T"] == 500
+
+
+def test_event_table_filters():
+    table = cev.GcsClusterEventTable(max_events=1000,
+                                     max_bytes=1 << 20)
+    table.put([
+        {"type": "WORKER_EXIT", "severity": "ERROR", "node_id": "aaa111",
+         "worker_id": "w1", "job_id": "j1", "message": "boom"},
+        {"type": "WORKER_SPAWN", "severity": "INFO", "node_id": "aaa111",
+         "worker_id": "w2", "job_id": "j1"},
+        {"type": "OBJECT_SPILL", "severity": "DEBUG", "node_id": "bbb222"},
+        {"type": "ACTOR_DEAD", "severity": "ERROR", "actor_id": "ac1",
+         "node_id": "bbb222"},
+    ])
+    assert len(table.list(etype="WORKER_EXIT")) == 1
+    assert len(table.list(severity="ERROR")) == 2
+    # min_severity is a floor: DEBUG < INFO < WARNING < ERROR
+    assert len(table.list(min_severity="INFO")) == 3
+    assert len(table.list(node_id="aaa")) == 2      # prefix match
+    assert len(table.list(actor_id="ac")) == 1
+    assert len(table.list(worker_id="w1")) == 1
+    assert len(table.list(job_id="j1")) == 2
+    rows = table.list(limit=2)
+    assert len(rows) == 2
+    # sorted by ts: limit keeps the newest tail
+    assert rows == sorted(rows, key=lambda e: e["ts"])
+
+
+def test_recorder_ring_flight_and_ring_only(tmp_path):
+    """ring_only events reach the ring + flight file but never the
+    sink; the flight dump is atomic and readable post-mortem."""
+    shipped = []
+    flight = str(tmp_path / "logs" / cev.flight_file_name("deadbeef" * 4))
+    os.makedirs(os.path.dirname(flight))
+    rec = cev.EventRecorder(sink=lambda evs: shipped.extend(evs),
+                            source="test", worker_id="deadbeef" * 4,
+                            flight_path=flight)
+    rec.emit("TASK_RUNNING", "crumb", ring_only=True, task_id="t1")
+    rec.emit("WORKER_EXIT", "real", severity="ERROR")
+    rec.flush()
+    assert [e["type"] for e in shipped] == ["WORKER_EXIT"]
+    ring = cev.read_flight_file(str(tmp_path), "deadbeef" * 4)
+    assert [e["type"] for e in ring] == ["TASK_RUNNING", "WORKER_EXIT"]
+    # ring is bounded
+    for i in range(CONFIG.event_ring_size + 50):
+        rec.emit("X", ring_only=True, i=i)
+    assert len(rec.ring_snapshot()) <= CONFIG.event_ring_size
+    rec.stop()
+    # a sink failure re-queues the batch instead of dropping it
+    boom = {"n": 0}
+
+    def flaky(evs):
+        boom["n"] += 1
+        if boom["n"] == 1:
+            raise ConnectionError("gcs away")
+        shipped.extend(evs)
+
+    rec2 = cev.EventRecorder(sink=flaky, source="test")
+    rec2.emit("RETRY_ME")
+    rec2.flush()
+    rec2.flush()
+    assert any(e["type"] == "RETRY_ME" for e in shipped)
+
+
+def test_kill_switch_disables_recorder(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_EVENTS", "0")
+    assert not cev.enabled()
+    assert cev.configure(sink=lambda evs: None, source="test") is None
+    cev.emit("ANYTHING")            # must be a cheap no-op, not a crash
+    assert cev.ring_snapshot() == []
+    monkeypatch.delenv("RAY_TPU_EVENTS")
+    assert cev.enabled()
+
+
+def test_task_table_bounds_synthetic_instant_records():
+    """PR 8 whitelisted synthetic ``handoff-<object>`` records into the
+    GcsTaskTable; they carry only instant markers (state never leaves
+    ""), so the eviction scan must treat them as evictable — under a
+    long-lived serve app they used to rotate forever and pin the table
+    at 2x cap (regression, ISSUE 9 satellite)."""
+    from ray_tpu._private.task_events import GcsTaskTable
+    saved = CONFIG.copy_overrides()
+    CONFIG.set("gcs_max_task_events", 32)
+    try:
+        table = GcsTaskTable()
+        # one genuinely live task must survive the rotation
+        table.put_events([{"task_id": "live-1", "state": "RUNNING",
+                           "name": "t", "ts": time.time()}])
+        for i in range(300):
+            table.put_events([{
+                "task_id": f"handoff-{i:08x}", "state": "HANDOFF",
+                "name": "kv_handoff", "ts": time.time(),
+                "stage": "export", "bytes": 1}])
+        rows = table.list()
+        assert len(rows) <= 32, f"table grew to {len(rows)}"
+        assert any(r["task_id"] == "live-1" for r in rows), \
+            "live task evicted while synthetic records were spared"
+    finally:
+        CONFIG.set_overrides(saved)
+
+
+# --------------------------------------------------------- integration
+def test_event_plane_end_to_end(ray_start_regular):
+    """One cluster exercises the whole plane: worker spawn/exit events,
+    a crash dossier retrievable from the propagated error, node health
+    snapshots, dump_stacks on every process kind, and the summary
+    sections."""
+    import ray_tpu
+    from ray_tpu.experimental import state
+    from ray_tpu.runtime.core_worker import get_global_worker
+
+    @ray_tpu.remote
+    def warm():
+        return os.getpid()
+
+    # run a few tasks first so the worker's flight ring has breadcrumbs
+    # and at least one flush interval passes before the death
+    pid = ray_tpu.get(warm.remote(), timeout=60)
+    for _ in range(3):
+        ray_tpu.get(warm.remote(), timeout=60)
+    time.sleep(1.2 * CONFIG.events_flush_interval_ms / 1000.0 + 0.3)
+
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(13)
+
+    with pytest.raises(ray_tpu.exceptions.WorkerCrashedError) as ei:
+        ray_tpu.get(die.remote(), timeout=120)
+    err = ei.value
+    assert err.dossier_id, "WorkerCrashedError carries no dossier id"
+
+    # events + dossier land asynchronously (flusher + harvest thread)
+    deadline = time.monotonic() + 60
+    dossier = None
+    while time.monotonic() < deadline:
+        exits = state.list_cluster_events(type="WORKER_EXIT")
+        dossier = state.get_dossier(err.dossier_id)
+        if exits and dossier is not None:
+            break
+        time.sleep(0.5)
+    assert exits, "no WORKER_EXIT event reached the GCS table"
+    assert dossier is not None, "no dossier for the dead worker"
+
+    # the event names the dead worker and its node
+    ev = next(e for e in exits if e["worker_id"] == err.dossier_id)
+    assert ev["severity"] == "ERROR"
+    assert ev["node_id"]
+    # spawn events exist too, and filters compose
+    assert state.list_cluster_events(type="WORKER_SPAWN",
+                                     node_id=ev["node_id"][:8])
+    assert all(e["severity"] == "ERROR"
+               for e in state.list_cluster_events(severity="ERROR"))
+
+    # dossier: identifies the process, carries ring + log tail sections
+    assert dossier["worker_id"] == err.dossier_id
+    assert dossier["kind"] == "worker"
+    assert "log_tail" in dossier and "events" in dossier
+    # the flight ring captured the warm tasks (the worker outlived a
+    # flush interval); the dying task itself may or may not have made
+    # the final dump
+    assert any(e.get("type") == "TASK_RUNNING"
+               for e in dossier["events"]), dossier["events"]
+    text = err.debug_dossier()
+    assert err.dossier_id[:12] in text or "crash dossier" in text
+
+    # node health snapshots ride heartbeats into list_nodes
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        nodes = [n for n in state.list_nodes() if n.get("health")]
+        if nodes:
+            break
+        time.sleep(0.25)
+    assert nodes, "no node health snapshot arrived"
+    h = nodes[0]["health"]
+    assert {"mem_frac", "store_frac", "loop_lag_ms",
+            "workers"} <= set(h)
+
+    # dump_stacks answers on the GCS, the raylet, and a live worker
+    worker = get_global_worker()
+    gs = worker.gcs.call("dump_stacks", {"duration": 0.05}, timeout=30)
+    assert gs["threads"] and isinstance(gs["folded"], dict)
+    rs = worker._raylet.call("dump_stacks", {"duration": 0.05},
+                             timeout=30)
+    assert rs["threads"]
+    # the warm worker died with the die() task (lease reuse): sample a
+    # freshly-leased live one instead
+    live_pid = ray_tpu.get(warm.remote(), timeout=60)
+    ws = worker._raylet.call("dump_stacks",
+                             {"pid": live_pid, "duration": 0.05},
+                             timeout=30)
+    assert ws["threads"], "worker dump_stacks forward failed"
+
+    # single-screen summary covers the new plane
+    summary = state.metrics_summary()
+    assert "Cluster events" in summary
+    assert "WORKER_EXIT" in summary
+    assert "Node health" in summary
+
+    # legacy ring API still works (PARITY: event.cc analog)
+    worker.gcs.call("report_event", {
+        "severity": "WARNING", "source": "test", "label": "UNIT",
+        "message": "hello", "fields": {"k": 1}})
+    legacy = worker.gcs.call("list_events", {"limit": 500})
+    assert any(e["label"] == "UNIT" and e["fields"]["k"] == 1
+               for e in legacy)
+
+
+def test_actor_death_dossier(ray_start_regular):
+    """rt.kill()'d actor: ActorDiedError carries the dead worker's
+    dossier id and the dossier names the actor."""
+    import ray_tpu
+    from ray_tpu.experimental import state
+
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    a = Victim.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    time.sleep(1.2 * CONFIG.events_flush_interval_ms / 1000.0)
+    ray_tpu.kill(a)
+    # poll until the raylet's actor_failed (carrying the dead worker's
+    # id) lands — a get racing it can see DEAD before the id is known
+    deadline = time.monotonic() + 60
+    err = None
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(a.ping.remote(), timeout=30)
+        except ray_tpu.exceptions.ActorDiedError as e:
+            err = e
+            if err.dossier_id:
+                break
+        except ray_tpu.exceptions.RayTpuError:
+            pass
+        time.sleep(0.3)
+    assert err is not None, "kill never surfaced as ActorDiedError"
+    assert err.dossier_id, "ActorDiedError carries no dossier id"
+    deadline = time.monotonic() + 60
+    dossier = None
+    while time.monotonic() < deadline:
+        dossier = state.get_dossier(err.dossier_id)
+        if dossier is not None:
+            break
+        time.sleep(0.5)
+    assert dossier is not None
+    assert dossier["worker_id"] == err.dossier_id
+    assert "ActorDied" in type(err).__name__
+    assert "crash dossier" in err.debug_dossier()
+
+
+def test_dossier_store_bounded(ray_start_regular):
+    """The GCS dossier store is FIFO-bounded at gcs_max_dossiers."""
+    from ray_tpu.runtime.core_worker import get_global_worker
+    gcs = get_global_worker().gcs
+    for i in range(CONFIG.gcs_max_dossiers + 20):
+        gcs.call("put_dossier", {
+            "dossier_id": f"unit-{i:04d}",
+            "dossier": {"kind": "worker", "reason": "unit"}})
+    listed = gcs.call("list_dossiers")
+    assert len(listed) <= CONFIG.gcs_max_dossiers
+    # newest survive, oldest rotated
+    ids = {d["dossier_id"] for d in listed}
+    assert f"unit-{CONFIG.gcs_max_dossiers + 19:04d}" in ids
+    assert "unit-0000" not in ids

@@ -322,6 +322,26 @@ def test_rank_death_mid_allreduce_surfaces_error(col_cluster):
     # timely: bounded by the op timeout (x the suite's timeout scale),
     # reached far earlier via the dead peer's broken connection
     assert time.monotonic() - t0 < 150
+    # forensics (docs/observability.md): the survivor emitted a
+    # COLLECTIVE_RANK_DEATH event, and the killed rank's worker has a
+    # driver-retrievable dossier naming it
+    from ray_tpu.experimental import state
+    deadline = time.monotonic() + 60
+    deaths, exits, dossier = [], [], None
+    dead_aid = ranks[1]._actor_id.hex()
+    while time.monotonic() < deadline:
+        deaths = state.list_cluster_events(type="COLLECTIVE_RANK_DEATH")
+        exits = state.list_cluster_events(type="WORKER_EXIT",
+                                          actor_id=dead_aid)
+        if exits:
+            dossier = state.get_dossier(exits[0]["worker_id"])
+        if deaths and exits and dossier is not None:
+            break
+        time.sleep(0.5)
+    assert deaths, "no COLLECTIVE_RANK_DEATH event reached the GCS"
+    assert exits, "no WORKER_EXIT event for the killed rank"
+    assert dossier is not None, "no dossier for the killed rank's worker"
+    assert dossier["actor_id"] == dead_aid
     ray_tpu.get(ranks[0].destroy.remote(), timeout=60)
     ray_tpu.kill(ranks[0])
 

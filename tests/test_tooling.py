@@ -333,7 +333,13 @@ def test_component_events_and_profiling(ray_start_regular):
         base = f"http://{head.host}:{head.port}"
         with urllib.request.urlopen(base + "/api/events", timeout=10) as r:
             evs = _json.loads(r.read())["events"]
-        assert any(e["label"] == "UNIT" for e in evs)
+        # typed cluster-event rows (docs/observability.md): the legacy
+        # report_event's label became the event type
+        assert any(e["type"] == "UNIT" for e in evs)
+        with urllib.request.urlopen(
+                base + "/api/events?type=UNIT", timeout=10) as r:
+            filtered = _json.loads(r.read())["events"]
+        assert filtered and all(e["type"] == "UNIT" for e in filtered)
         with urllib.request.urlopen(
                 base + "/api/profile?duration=0.3&format=top",
                 timeout=60) as r:
