@@ -29,12 +29,28 @@ PEAK_FLOPS = {
 
 def _bench_one(cfg, batch, seq, steps, warmup, peak, *,
                optimizer=None, chunked=False):
+    from ray_tpu._private import step_stats as sst
     from ray_tpu.models import GPT
     from ray_tpu.train.step import (OptimizerConfig, lm_loss_chunked_fn,
                                     make_sharded_train)
     from ray_tpu.parallel import build_mesh, MeshConfig
 
+    n_params = cfg.num_params()
+    # PaLM-style: 6N per token fwd+bwd + attention 12*L*d*S
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+
     mesh = build_mesh(MeshConfig(data=-1))
+    n_chips = mesh.size
+    # goodput ledger (docs/observability.md training performance
+    # plane): the same per-step clock the trainers drive, standalone
+    # (no cluster, local-only ledger).  peak_flops covers the whole
+    # mesh so the ledger MFU is per-chip-comparable with the hand
+    # computation below.
+    run = sst.start_run(
+        f"bench-{getattr(cfg, 'name', 'gpt')}",
+        flops_per_token=flops_per_token, peak_flops=peak * n_chips,
+        tokens_per_step=batch * seq)
+    clock = sst.step_clock()
     model = GPT(cfg, mesh=mesh)
     rng = np.random.default_rng(0)
     batch_data = {"tokens": jnp.asarray(
@@ -46,26 +62,46 @@ def _bench_one(cfg, batch, seq, steps, warmup, peak, *,
         example_batch=batch_data, **kwargs)
     state = init_fn(jax.random.PRNGKey(0), batch_data)
 
+    if run is not None:
+        run.ledger.note_init_done()
+    t_compile = time.perf_counter()
     for _ in range(warmup):
         state, metrics = step_fn(state, batch_data)
     # Fence via a device-to-host read: on the axon tunnel platform
     # block_until_ready returns early, a D2H copy forces the full chain.
     float(metrics["loss"])
+    if run is not None:
+        run.ledger.note_compile_ms((time.perf_counter() - t_compile) * 1e3)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, batch_data)
-    final_loss = float(metrics["loss"])
+    for i in range(steps):
+        clock.begin()
+        with clock.phase("host_dispatch"):
+            state, metrics = step_fn(state, batch_data)
+        if i == steps - 1:
+            # the drain fence belongs to the LAST step's device_compute
+            # so the ledger's productive window equals the timed window
+            # (per-step fencing would serialize the device pipeline and
+            # change the headline number)
+            with clock.phase("device_compute"):
+                final_loss = float(metrics["loss"])
+        clock.end()
     dt = (time.perf_counter() - t0) / steps
+    ledger = sst.end_run(run) or {}
 
-    n_chips = mesh.size
     tokens_per_sec = batch * seq / dt / n_chips  # per chip
-    n_params = cfg.num_params()
-    # PaLM-style: 6N per token fwd+bwd + attention 12*L*d*S
-    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
     mfu = flops_per_token * tokens_per_sec / peak
-    return {"tokens_s": round(tokens_per_sec, 1), "mfu": round(mfu, 4),
-            "step_ms": round(dt * 1e3, 2), "params": n_params,
-            "n_chips": n_chips, "final_loss": round(final_loss, 4)}
+    out = {"tokens_s": round(tokens_per_sec, 1), "mfu": round(mfu, 4),
+           "step_ms": round(dt * 1e3, 2), "params": n_params,
+           "n_chips": n_chips, "final_loss": round(final_loss, 4)}
+    if ledger:
+        out.update({
+            "goodput": ledger.get("goodput"),
+            "ledger_mfu": ledger.get("mfu"),
+            "init_ms": round(ledger.get("init_ms", 0.0), 1),
+            "compile_ms": round(ledger.get("compile_ms", 0.0), 1),
+            "phase_ms": ledger.get("phase_ms"),
+        })
+    return out
 
 
 def main():
@@ -139,6 +175,14 @@ def main():
         "n_chips": small["n_chips"],
         "params": small["params"],
         "final_loss": small["final_loss"],
+        # goodput ledger (docs/observability.md): the step-stats plane's
+        # accounting of the same run — ledger_mfu must match `mfu`
+        # (same flops arithmetic, clock-measured productive time)
+        "goodput": small.get("goodput"),
+        "ledger_mfu": small.get("ledger_mfu"),
+        "init_ms": small.get("init_ms"),
+        "compile_ms": small.get("compile_ms"),
+        "phase_ms": small.get("phase_ms"),
     }
     if large is not None:
         out["large_model"] = large

@@ -103,6 +103,17 @@ SECTIONS = {
                                      "telemetry_overhead.py"),
                         "--events"],
                    timeout=900),
+    # training performance plane cost guard (docs/observability.md):
+    # interleaved same-box A/B of a fully-clocked ms-scale step loop
+    # with RAY_TPU_STEP_STATS=0 vs 1 (telemetry + events pinned on);
+    # the step_stats_overhead row carries the same <=3% bar.  4 rounds:
+    # the ~5ms-step loop resolves a ~1% plane cost only if best-of gets
+    # enough draws against this box's minute-scale throttle drift
+    "step_stats": dict(cmd=[sys.executable,
+                            os.path.join(REPO, "benchmarks",
+                                         "telemetry_overhead.py"),
+                            "--step-stats", "--rounds", "4"],
+                       timeout=1200),
     "serve_llm": dict(cmd=[sys.executable,
                            os.path.join(REPO, "benchmarks", "serve_llm.py"),
                            "--suite", "--slots", "32", "--requests", "128"],

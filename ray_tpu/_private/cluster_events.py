@@ -78,6 +78,11 @@ COLLECTIVE_RING_STALL = "COLLECTIVE_RING_STALL"
 # serving
 REPLICA_RETIRED = "REPLICA_RETIRED"
 AUTOSCALE = "AUTOSCALE"
+# training performance plane (emitted by the GCS step-stats table,
+# docs/observability.md): a gang rank's step time crossed
+# median + k*MAD — the degraded rank names itself (rank/step/phase)
+# instead of silently dragging the allreduce
+TRAIN_STRAGGLER = "TRAIN_STRAGGLER"
 # flight-recorder breadcrumbs (ring_only by convention)
 TASK_RUNNING = "TASK_RUNNING"
 TASK_FAILED = "TASK_FAILED"

@@ -293,6 +293,45 @@ _declare("node_unhealthy_lag_ms", float, 2000.0,
          "Raylet heartbeat-loop event-loop lag above which a node is "
          "reported unhealthy (a starved daemon thread: the node may "
          "miss liveness deadlines soon).")
+_declare("daemon_connect_retry_s", float, 10.0,
+         "Bounded retry window for a freshly spawned daemon's FIRST "
+         "connection to its GCS (backoff 50ms doubling to 1s; daemon "
+         "call sites only — raylet/dashboard/monitor pass "
+         "connect_retry=True, interactive clients stay fail-fast).  "
+         "Under heavy box load the GCS subprocess can publish its "
+         "address file before its accept loop keeps up with the "
+         "connection burst; one refused connect must not kill the "
+         "raylet (the load-dependent startup-race flake).  Scaled by "
+         "timeout_scale.")
+_declare("step_stats_enabled", bool, True,
+         "Training performance plane (_private/step_stats.py): per-step "
+         "phase clocks, the cross-rank GCS step table + straggler "
+         "detection, and the goodput ledger.  Also overridable as "
+         "RAY_TPU_STEP_STATS=0 (the bench kill switch, mirroring "
+         "RAY_TPU_TELEMETRY / RAY_TPU_EVENTS); disabling hands train "
+         "loops a shared no-op clock.")
+_declare("step_stats_flush_interval_ms", int, 500,
+         "Period of the per-rank step-stats flusher batching step "
+         "reports to the GCS step table (never an RPC on the step "
+         "path).")
+_declare("step_stats_timeline_steps", int, 256,
+         "Per-run cap on STEP timeline slices recorded into the GCS "
+         "task table (the STREAM_ITEM cap discipline: first N steps "
+         "per run per rank).")
+_declare("gcs_step_stats_max_steps", int, 512,
+         "Per-run step retention in the GCS step table (oldest steps "
+         "rotate out first).")
+_declare("gcs_max_step_runs", int, 16,
+         "Max training runs the GCS step table retains "
+         "(oldest-touched evicted first).")
+_declare("straggler_mad_k", float, 4.0,
+         "Straggler detection: a rank whose step time exceeds the "
+         "cross-rank median + k * MAD (by at least straggler_min_ms) "
+         "edge-triggers a TRAIN_STRAGGLER event.")
+_declare("straggler_min_ms", float, 20.0,
+         "Absolute floor on the straggler overshoot: ms-scale steps "
+         "jitter by scheduler noise, and median + k*MAD alone would "
+         "fire on microsecond skew in a tight gang.")
 
 # --------------------------------------------------------------------------- #
 # TPU / device model                                                          #
@@ -460,6 +499,7 @@ class Config:
 # plane semantics like spill thresholds or batch waits)
 _SCALED_FLAGS = frozenset({
     "health_check_failure_threshold",
+    "daemon_connect_retry_s",
     "worker_start_timeout_s",
     "worker_lease_timeout_s",
     "actor_creation_timeout_s",

@@ -30,12 +30,16 @@ FAILED = "FAILED"
 # collective op on a rank's synthetic ``col-<group>-r<rank>`` record,
 # docs/collective.md) never has a lifecycle at all, and a HANDOFF (one
 # export/import leg of a disaggregated-serving KV handoff on a
-# synthetic ``handoff-<object>`` record, docs/serve_disagg.md) likewise
+# synthetic ``handoff-<object>`` record, docs/serve_disagg.md) likewise.
+# A STEP (one clocked train step with its phase breakdown on a rank's
+# synthetic ``step-<run>-r<rank>`` record, docs/observability.md) is
+# the training-performance-plane sibling of COLLECTIVE/HANDOFF.
 STREAM_ITEM = "STREAM_ITEM"
 PULL = "PULL"
 COLLECTIVE = "COLLECTIVE"
 HANDOFF = "HANDOFF"
-_INSTANT_STATES = frozenset({STREAM_ITEM, PULL, COLLECTIVE, HANDOFF})
+STEP = "STEP"
+_INSTANT_STATES = frozenset({STREAM_ITEM, PULL, COLLECTIVE, HANDOFF, STEP})
 
 _STATE_RANK = {SUBMITTED: 1, PENDING_NODE_ASSIGNMENT: 2, RUNNING: 3,
                FINISHED: 4, FAILED: 4}
@@ -164,11 +168,13 @@ class GcsTaskTable:
                     entry["index"] = ev["index"]
                 for field in ("dur_ms", "bytes", "nsources", "object_id",
                               "node_id", "worker_id", "op", "algo",
-                              "world", "stage", "npages"):
+                              "world", "stage", "npages", "step",
+                              "phases", "trace_id"):
                     if field in ev:  # per-pull transfer / per-op
-                        # collective / KV-handoff slices (node/worker =
-                        # the pulling / participating process, not a
-                        # producer task)
+                        # collective / KV-handoff / train-step slices
+                        # (node/worker = the pulling / participating
+                        # process, not a producer task; STEP carries a
+                        # per-step trace_id + phase-duration dict)
                         entry[field] = ev[field]
                 rec["events"].append(entry)
                 rec["events"].sort(key=lambda e: e["ts"])

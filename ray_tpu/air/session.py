@@ -107,7 +107,21 @@ def _require_session() -> _Session:
 
 def report(metrics: Dict[str, Any],
            checkpoint: Optional[Checkpoint] = None) -> None:
-    _require_session().report(metrics, checkpoint)
+    if checkpoint is None:
+        _require_session().report(metrics, checkpoint)
+        return
+    # training performance plane: handing a checkpoint to the driver
+    # (serialization + the consumption handshake) is time the chip is
+    # not stepping — attribute it to the ``checkpoint`` phase of the
+    # open step, or the run ledger's out-of-step totals
+    import time as _time
+    from ray_tpu._private import step_stats
+    t0 = _time.monotonic()
+    try:
+        _require_session().report(metrics, checkpoint)
+    finally:
+        step_stats.record_phase(
+            "checkpoint", (_time.monotonic() - t0) * 1000.0)
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

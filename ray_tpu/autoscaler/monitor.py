@@ -27,7 +27,7 @@ class Monitor:
                  poll_period_s: float = 1.0):
         from ray_tpu.runtime.gcs import GcsClient
         self.config = load_config(config)
-        self.gcs = GcsClient(tuple(gcs_address))
+        self.gcs = GcsClient(tuple(gcs_address), connect_retry=True)
         provider_kwargs = {}
         if self.config.provider.get("type", "fake") == "fake":
             provider_kwargs = {"gcs_address": tuple(gcs_address),

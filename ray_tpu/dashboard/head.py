@@ -22,7 +22,7 @@ from ray_tpu.runtime.gcs import GcsClient
 class DashboardHead:
     def __init__(self, gcs_address: Tuple[str, int],
                  host: str = "127.0.0.1", port: int = 8265):
-        self.gcs = GcsClient(gcs_address)
+        self.gcs = GcsClient(gcs_address, connect_retry=True)
         self.gcs_address = tuple(gcs_address)
         self.host = host
         self.port = port
@@ -71,6 +71,7 @@ class DashboardHead:
             web.get("/events", self._events),
             web.get("/api/dossiers", self._dossiers),
             web.get("/api/dossiers/{dossier_id}", self._dossier),
+            web.get("/api/training", self._training),
             web.get("/api/profile", self._profile),
             web.get("/metrics", self._metrics),
             web.get("/", self._index),
@@ -253,6 +254,22 @@ class DashboardHead:
             from ray_tpu._private.cluster_events import format_dossier
             return web.Response(text=format_dossier(d))
         return web.json_response(d)
+
+    # ------------------------------------------------------------- training
+    async def _training(self, request) -> web.Response:
+        """Training performance plane (docs/observability.md):
+        ?run=<id-or-group-prefix> — run directory + step skew + the
+        goodput-ledger summary of the selected (default latest) run."""
+        run = request.query.get("run")
+
+        def build():
+            table = self.gcs.call("list_step_stats",
+                                  {"run": run, "limit": 50})
+            table["summary"] = self.gcs.call("training_summary",
+                                             {"run": run})
+            return table
+
+        return web.json_response(await self._call(build))
 
     # -------------------------------------------------------------- profile
     async def _profile(self, request) -> web.Response:

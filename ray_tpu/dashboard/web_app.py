@@ -80,7 +80,8 @@ INDEX_HTML = r"""<!DOCTYPE html>
 <main id="main">loading…</main>
 <script>
 const TABS = ["Overview", "Metrics", "Nodes", "Actors", "Tasks",
-              "Timeline", "Jobs", "Serve", "Placement Groups", "Events"];
+              "Timeline", "Training", "Jobs", "Serve",
+              "Placement Groups", "Events"];
 let tab = location.hash ? decodeURIComponent(location.hash.slice(1))
                         : "Overview";
 let followJob = null, logOffset = 0, timer = null;
@@ -280,6 +281,65 @@ async function renderTasks() {
            Math.max(2, 100 * r.dur / maxDur)}%"></i></span>`]));
 }
 
+// ---- Training: the performance plane's goodput ledger + step skew
+// (GCS step table, docs/observability.md) — per-run MFU/goodput tiles,
+// per-rank time buckets, and the recent cross-rank skew
+async function renderTraining() {
+  const d = await J("/api/training");
+  const runs = d.runs || [];
+  if (!runs.length)
+    return `<div class="hint">no training runs have reported step ` +
+      `stats yet (per-step phase clocks: ray_tpu.train.step_clock)` +
+      `</div>`;
+  const s = d.summary || {};
+  const agg = s.aggregate || {};
+  let html = "";
+  if (s.run) {
+    const tiles = [
+      ["run", s.run], ["world", s.world],
+      ["goodput", agg.goodput != null ?
+        (100 * agg.goodput).toFixed(1) + "%" : "–"],
+      ["MFU", agg.mfu != null ? (100 * agg.mfu).toFixed(2) + "%" : "–"],
+      ["tokens/s", agg.tokens_per_s ?? "–"],
+      ["steps", s.steps_seen ?? 0],
+    ];
+    html += `<div class="tiles">` + tiles.map(([k, v]) =>
+      `<div class="tile"><div class="v">${esc(v)}</div>` +
+      `<div class="k">${esc(k)}</div></div>`).join("") + `</div>`;
+    const ranks = Object.entries(s.ranks || {});
+    if (ranks.length) {
+      html += table(["rank", "steps", "init (ms)", "compile (ms)",
+                     "productive (ms)", "ckpt (ms)", "idle (ms)",
+                     "goodput", "MFU"],
+        ranks.map(([r, l]) => [
+          esc(r), esc(l.steps ?? 0),
+          (l.init_ms ?? 0).toFixed(0), (l.compile_ms ?? 0).toFixed(0),
+          (l.productive_ms ?? 0).toFixed(0),
+          (l.checkpoint_ms ?? 0).toFixed(0),
+          (l.idle_ms ?? 0).toFixed(0),
+          ((l.goodput ?? 0) * 100).toFixed(1) + "%",
+          ((l.mfu ?? 0) * 100).toFixed(2) + "%"]));
+    }
+  }
+  html += `<div class="hint">runs (stragglers flagged from ` +
+    `median + k·MAD cross-rank skew — TRAIN_STRAGGLER in Events)</div>`;
+  html += table(["run", "group", "world", "steps", "straggling ranks",
+                 "worst recent skew"],
+    runs.slice().reverse().map(r => {
+      const skew = (r.skew || []).reduce((a, b) =>
+        (b.skew_ms > (a?.skew_ms ?? -1) ? b : a), null);
+      const strag = Object.keys(r.straggling || {});
+      return [
+        `<span class="mono">${esc(r.run)}</span>`, esc(r.group || ""),
+        esc(r.world), esc(r.steps_seen),
+        strag.length ? badge("rank " + strag.join(", rank ")) :
+          badge("OK"),
+        skew ? `+${skew.skew_ms.toFixed(1)} ms @ step ${skew.step}` :
+          "–"];
+    }));
+  return html;
+}
+
 async function renderJobs() {
   const d = await J("/api/jobs");
   let html = table(["job", "status", "entrypoint", "logs"],
@@ -343,9 +403,9 @@ document.addEventListener("click", (e) => {
 
 const RENDER = {"Overview": renderOverview, "Metrics": renderMetrics,
   "Nodes": renderNodes, "Actors": renderActors, "Tasks": renderTasks,
-  "Timeline": renderTimeline, "Jobs": renderJobs,
-  "Serve": renderServe, "Placement Groups": renderPGs,
-  "Events": renderEvents};
+  "Timeline": renderTimeline, "Training": renderTraining,
+  "Jobs": renderJobs, "Serve": renderServe,
+  "Placement Groups": renderPGs, "Events": renderEvents};
 
 async function pollLog(g) {
   if (tab !== "Jobs" || !followJob) return;

@@ -7,14 +7,15 @@ state_manager.py) + dashboard/state_aggregator.py:132 (StateAPIManager).
 from ray_tpu.experimental.state.api import (  # noqa: F401
     get_dossier, list_actors, list_cluster_events, list_dossiers,
     list_jobs, list_metrics, list_nodes, list_objects,
-    list_placement_groups, list_tasks, list_workers, memory_summary,
-    metrics_summary, summarize_actors, summarize_objects, summarize_tasks,
-    timeline)
+    list_placement_groups, list_step_stats, list_tasks, list_workers,
+    memory_summary, metrics_summary, summarize_actors, summarize_objects,
+    summarize_tasks, timeline, training_summary, training_summary_text)
 
 __all__ = [
     "list_tasks", "list_actors", "list_nodes", "list_jobs", "list_objects",
     "list_workers", "list_placement_groups", "list_metrics",
     "list_cluster_events", "get_dossier", "list_dossiers",
+    "list_step_stats", "training_summary", "training_summary_text",
     "summarize_tasks", "summarize_actors", "summarize_objects",
     "memory_summary", "metrics_summary", "timeline",
 ]

@@ -73,6 +73,75 @@ def list_cluster_events(*, node_id: Optional[str] = None,
         "limit": limit})
 
 
+# ------------------------------------------------ training perf plane
+def list_step_stats(run: Optional[str] = None, *, limit: int = 100,
+                    steps_limit: int = 64) -> dict:
+    """The GCS training step table (docs/observability.md): run
+    directory rows (group, world, per-rank metadata, recent cross-rank
+    skew) and — with ``run`` given (id or group prefix) — that run's
+    per-step per-rank phase records."""
+    return _gcs().call("list_step_stats", {
+        "run": run, "limit": limit, "steps_limit": steps_limit})
+
+
+def training_summary(run: Optional[str] = None) -> Optional[dict]:
+    """The goodput-ledger view of one training run (latest by
+    default): per-rank init/compile/productive/checkpoint/idle time
+    buckets, tokens, MFU and goodput fraction, plus a cross-rank
+    aggregate (docs/observability.md)."""
+    return _gcs().call("training_summary", {"run": run})
+
+
+def training_summary_text(run: Optional[str] = None) -> str:
+    """Operator table for ``ray-tpu summary training``."""
+    s = training_summary(run)
+    if not s:
+        return "(no training runs reported yet)"
+    lines = [f"run {s['run']}"
+             + (f"  (group {s['group']})" if s.get("group") else "")
+             + f"  world={s['world']}  steps={s.get('steps_seen', 0)}"]
+    agg = s.get("aggregate")
+    if agg:
+        lines.append(
+            "aggregate: goodput %.1f%%  mfu %.2f%%  %s tokens  "
+            "%.0f tokens/s" % (
+                100 * agg.get("goodput", 0.0), 100 * agg.get("mfu", 0.0),
+                f"{agg.get('tokens', 0):,}",
+                agg.get("tokens_per_s", 0.0)))
+    ranks = s.get("ranks") or {}
+    if ranks:
+        lines.append("%-5s %8s %9s %9s %11s %9s %9s %8s %7s" % (
+            "RANK", "STEPS", "INIT(ms)", "COMP(ms)", "PROD(ms)",
+            "CKPT(ms)", "IDLE(ms)", "GOODPUT", "MFU"))
+        for rank, led in sorted(ranks.items(), key=lambda kv: int(kv[0])):
+            lines.append("%-5s %8d %9.0f %9.0f %11.0f %9.0f %9.0f "
+                         "%7.1f%% %6.2f%%" % (
+                             rank, led.get("steps", 0),
+                             led.get("init_ms", 0.0),
+                             led.get("compile_ms", 0.0),
+                             led.get("productive_ms", 0.0),
+                             led.get("checkpoint_ms", 0.0),
+                             led.get("idle_ms", 0.0),
+                             100 * led.get("goodput", 0.0),
+                             100 * led.get("mfu", 0.0)))
+        # per-phase breakdown off rank 0 (the canonical series)
+        led0 = ranks.get(0) or ranks.get("0") or \
+            next(iter(ranks.values()))
+        phases = led0.get("phase_ms") or {}
+        if phases:
+            lines.append("rank-0 phase totals: " + "  ".join(
+                f"{k}={v:.0f}ms" for k, v in sorted(phases.items())))
+    skew = s.get("skew") or []
+    if skew:
+        worst = max(skew, key=lambda r: r.get("skew_ms", 0.0))
+        lines.append(
+            "cross-rank skew (last %d analyzed steps): worst +%.1fms "
+            "at step %d (median %.1fms)" % (
+                len(skew), worst.get("skew_ms", 0.0),
+                worst.get("step", 0), worst.get("median_ms", 0.0)))
+    return "\n".join(lines)
+
+
 def get_dossier(dossier_id: str) -> Optional[dict]:
     """Crash dossier by id — a dead worker's id hex (prefix ok) or a
     dead node's id hex.  Contains the process's flight-recorder event
@@ -250,6 +319,7 @@ def timeline(path: Optional[str] = None) -> List[dict]:
         pulls = []
         cols = []
         handoffs = []
+        steps = []
         for ev in t.get("events", []):
             if ev["state"] == "RUNNING":
                 start = ev["ts"]
@@ -263,6 +333,41 @@ def timeline(path: Optional[str] = None) -> List[dict]:
                 cols.append(ev)
             elif ev["state"] == "HANDOFF":
                 handoffs.append(ev)
+            elif ev["state"] == "STEP":
+                steps.append(ev)
+        for ev in steps:
+            # one clocked train step (docs/observability.md): rides the
+            # rank's synthetic step-<run>-r<rank> record.  The whole
+            # step is one slice; its phase breakdown nests as
+            # sub-slices stacked in canonical phase order, all stamped
+            # with the step's trace_id so gang ranks correlate.
+            dur_s = float(ev.get("dur_ms", 0.0)) / 1e3
+            t_start = ev["ts"] - dur_s
+            pid = ev.get("node_id", t.get("node_id", "node"))[:8]
+            tid = ev.get("worker_id", t.get("worker_id", "worker"))[:8]
+            args = {"task_id": t["task_id"], "step": ev.get("step")}
+            if ev.get("trace_id"):
+                args["trace_id"] = ev["trace_id"]
+            events.append({
+                "name": f"step {ev.get('step', '?')}",
+                "cat": "train_step", "ph": "X",
+                "ts": t_start * 1e6, "dur": dur_s * 1e6,
+                "pid": pid, "tid": tid, "args": dict(args),
+            })
+            phases = ev.get("phases") or {}
+            from ray_tpu._private.step_stats import PHASES
+            off = t_start
+            ordered = [p for p in PHASES if p in phases] + \
+                [p for p in sorted(phases) if p not in PHASES]
+            for phase in ordered:
+                p_dur = float(phases[phase]) / 1e3
+                events.append({
+                    "name": phase, "cat": "train_phase", "ph": "X",
+                    "ts": off * 1e6, "dur": p_dur * 1e6,
+                    "pid": pid, "tid": f"{tid}/phases",
+                    "args": dict(args, phase=phase),
+                })
+                off += p_dur
         for ev in cols:
             # one host-collective op (docs/collective.md): rides the
             # rank's synthetic col-<group>-r<rank> record, which has no
@@ -532,6 +637,39 @@ def metrics_summary() -> str:
             lines.append("%-34s %10d %9.3g %9.3g" % (
                 f"{stage} ({unit})", r["count"], r.get("p50", 0.0),
                 r.get("p95", 0.0)))
+        lines.append("")
+
+    # training performance plane (docs/observability.md): per-phase
+    # step clocks + the goodput ledger, visible without the dashboard
+    phase_rows = [r for r in rows
+                  if r["name"] == "ray_tpu_train_phase_ms"
+                  and r.get("count")]
+    step_rows = [r for r in rows if r["name"] == "ray_tpu_train_step_ms"
+                 and r.get("count")]
+    if phase_rows or step_rows:
+        lines.append("== Training steps ==")
+        lines.append("%-34s %10s %9s %9s" % ("PHASE", "COUNT", "P50",
+                                             "P95"))
+        for r in sorted(step_rows,
+                        key=lambda r: r["tags"].get("run", "")):
+            lines.append("%-34s %10d %9.3g %9.3g" % (
+                f"step ({r['tags'].get('run', '?')[:24]})", r["count"],
+                r.get("p50", 0.0), r.get("p95", 0.0)))
+        for r in sorted(phase_rows,
+                        key=lambda r: r["tags"].get("phase", "")):
+            lines.append("%-34s %10d %9.3g %9.3g" % (
+                r["tags"].get("phase", "?"), r["count"],
+                r.get("p50", 0.0), r.get("p95", 0.0)))
+        try:
+            summary = training_summary()
+        except (rpc.RpcError, ConnectionError, TimeoutError):
+            summary = None
+        agg = (summary or {}).get("aggregate")
+        if agg:
+            lines.append("latest run %s: goodput %.1f%%  mfu %.2f%%" % (
+                (summary or {}).get("run", "?"),
+                100 * agg.get("goodput", 0.0),
+                100 * agg.get("mfu", 0.0)))
         lines.append("")
 
     rpc_rows = [r for r in rows if r["name"] == "ray_tpu_rpc_dispatch_ms"

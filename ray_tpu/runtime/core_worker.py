@@ -2640,9 +2640,16 @@ class CoreWorker:
         if method == "core_worker_stats":
             return self._rpc_core_worker_stats(p or {})
         if method == "profile":
-            # drivers flame-sample like any worker (`ray-tpu profile`)
-            from ray_tpu._private.profiler import sample_folded
-            return sample_folded(float((p or {}).get("duration", 2.0)))
+            # drivers flame-sample like any worker (`ray-tpu profile`);
+            # "device" requests the gang-capture dict (host stacks +
+            # jax.profiler device trace when on TPU)
+            from ray_tpu._private.profiler import (profile_capture,
+                                                   sample_folded)
+            p = p or {}
+            if "device" in p:
+                return profile_capture(float(p.get("duration", 2.0)),
+                                       device=bool(p.get("device")))
+            return sample_folded(float(p.get("duration", 2.0)))
         if method == "dump_stacks":
             from ray_tpu._private.profiler import dump_stacks, \
                 sample_folded

@@ -97,6 +97,13 @@ class GcsServer:
         # WALed), like task events and metrics.
         from ray_tpu._private import cluster_events as cev
         self._events_table = cev.GcsClusterEventTable()
+        # training performance plane (docs/observability.md): per-run
+        # step table aggregating every rank's phase clocks, straggler
+        # detection edge-triggering TRAIN_STRAGGLER into the event
+        # table, and the goodput-ledger store.  Ephemeral like task
+        # events and metrics.
+        from ray_tpu._private import step_stats as sst
+        self._step_stats = sst.GcsStepStatsTable(emit=self.record_event)
         self._dossiers: Dict[str, dict] = {}
         self._dossier_order: deque = deque()
         self._placement_groups: Dict[str, Dict[str, Any]] = {}
@@ -492,6 +499,30 @@ class GcsServer:
                         "fields": {k: v for k, v in ev.items()
                                    if k not in std}})
         return out[-limit:]
+
+    # ------------------------------------------------- training perf plane
+    def _rpc_report_step_stats(self, conn, p):
+        """Batched per-step reports (and end-of-run goodput ledgers)
+        from each rank's step-stats flusher (_private/step_stats.py)."""
+        return {"dropped": self._step_stats.put(p.get("reports") or [])}
+
+    def _rpc_list_step_stats(self, conn, p):
+        """Run directory + recent per-step cross-rank records.  With
+        ``run`` (id or group prefix) includes that run's step rows;
+        the run rows carry rank metadata (worker id/address) so
+        ``ray-tpu profile --group`` can gang-fan-out."""
+        run = p.get("run")
+        out = {"runs": self._step_stats.list_runs(
+            run=run, limit=int(p.get("limit", 100)))}
+        if run:
+            out["steps"] = self._step_stats.steps(
+                run, limit=int(p.get("steps_limit", 64)))
+        out["stats"] = self._step_stats.stats()
+        return out
+
+    def _rpc_training_summary(self, conn, p):
+        """The goodput-ledger view of one run (latest by default)."""
+        return self._step_stats.summary(p.get("run"))
 
     # ------------------------------------------------------------- dossiers
     def _rpc_put_dossier(self, conn, p):
@@ -1526,7 +1557,7 @@ class GcsClient:
 
     def __init__(self, address: Tuple[str, int],
                  push_handler=None, timeout: Optional[float] = None,
-                 handler=None):
+                 handler=None, connect_retry: bool = False):
         self._address = tuple(address)
         self._timeout = timeout or CONFIG.gcs_rpc_timeout_s
         self._sub_lock = threading.Lock()
@@ -1540,9 +1571,40 @@ class GcsClient:
         self.on_reconnect = None
         # ``handler`` serves requests the GCS sends *to us* over this duplex
         # connection (e.g. create_actor dispatched to a raylet).
-        self._conn = rpc.connect(self._address,
-                                 push_handler=self._on_push,
-                                 handler=handler)
+        # ``connect_retry`` (daemon call sites only — raylet, dashboard,
+        # monitor): the FIRST connect retries with bounded backoff,
+        # because a freshly spawned daemon races the GCS's accept loop
+        # under box load — the address file is published once the
+        # socket listens, but a loaded host can starve the acceptor
+        # long enough for a connect burst to be refused.  One refused
+        # connect must not kill the raylet at spawn (the load-dependent
+        # startup-race flake); the window is daemon_connect_retry_s.
+        # Interactive clients (init(address=...), the CLI) keep
+        # fail-fast semantics: a dead or mistyped address raises
+        # immediately.
+        if connect_retry:
+            self._conn = self._connect_with_retry(handler)
+        else:
+            self._conn = rpc.connect(self._address,
+                                     push_handler=self._on_push,
+                                     handler=handler)
+
+    def _connect_with_retry(self, handler) -> rpc.Connection:
+        deadline = time.monotonic() + CONFIG.daemon_connect_retry_s
+        delay = 0.05
+        while True:
+            try:
+                return rpc.connect(self._address,
+                                   push_handler=self._on_push,
+                                   handler=handler)
+            except ConnectionError:
+                # ConnectionError only: a resolver failure (gaierror, a
+                # mistyped host) can never heal and must fail fast
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(min(delay, max(0.0,
+                                          deadline - time.monotonic())))
+                delay = min(delay * 2, 1.0)
 
     def _on_push(self, method: str, payload: Any) -> None:
         if method == "pubsub":

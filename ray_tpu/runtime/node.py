@@ -9,6 +9,7 @@ subprocess; worker nodes start just a raylet pointed at the head's GCS.
 from __future__ import annotations
 
 import atexit
+import itertools
 import json
 import os
 import subprocess
@@ -22,11 +23,23 @@ from ray_tpu._private.logging_utils import get_logger
 logger = get_logger("node")
 
 
+_session_seq = itertools.count()
+
+
 def new_session_dir() -> str:
+    """Unique per call, even for back-to-back init()s in one process
+    within one wall second.  Two clusters sharing a dir was the
+    daemon-spawn startup-race flake: the second ``start_gcs`` read the
+    FIRST (dead) GCS's leftover ``gcs_address.json`` and pointed its
+    raylet at a dead port (connection refused at spawn), and the second
+    GCS replayed the first's snapshot/WAL as its own state.  The
+    raylet address files already carried a microsecond suffix for
+    exactly this collision — the session dir itself needed it too."""
     base = os.path.join("/tmp", "ray_tpu_sessions")
     os.makedirs(base, exist_ok=True)
     session = os.path.join(
-        base, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}")
+        base, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}"
+              f"_{next(_session_seq)}")
     os.makedirs(os.path.join(session, "logs"), exist_ok=True)
     return session
 
@@ -91,6 +104,13 @@ class NodeProcesses:
 
     def start_gcs(self, port: int = 0) -> Tuple[str, int]:
         addr_file = os.path.join(self.session_dir, "gcs_address.json")
+        # belt-and-braces vs the stale-address-file race: a leftover
+        # file from an earlier GCS in this dir must never satisfy
+        # _wait_address_file before the fresh daemon publishes its own
+        try:
+            os.remove(addr_file)
+        except FileNotFoundError:
+            pass
         self.gcs_proc = _spawn(
             [sys.executable, "-m", "ray_tpu.runtime.gcs",
              "--port", str(port),

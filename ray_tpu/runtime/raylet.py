@@ -291,7 +291,7 @@ class Raylet:
         self.gcs_address = tuple(gcs_address)
         self.labels = dict(labels or {})
         self.gcs = GcsClient(gcs_address, push_handler=self._gcs_push,
-                             handler=self._handle)
+                             handler=self._handle, connect_retry=True)
         self.gcs.call("register_node", {
             "node_id": self.node_id.hex(),
             "address": list(self.address),
@@ -819,8 +819,10 @@ class Raylet:
                         break
             if h is None or h.conn is None:
                 raise rpc.RpcError(f"no live worker matching {wid!r}")
-            return h.conn.call("profile", {"duration": duration},
-                               timeout=duration + 30)
+            fwd = {"duration": duration}
+            if "device" in p:   # gang/device capture passes through
+                fwd["device"] = bool(p.get("device"))
+            return h.conn.call("profile", fwd, timeout=duration + 30)
         from ray_tpu._private.profiler import sample_folded
         return sample_folded(duration)
 

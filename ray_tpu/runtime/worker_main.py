@@ -533,9 +533,18 @@ class WorkerProcess:
             os._exit(1)
         if method == "profile":
             # on-demand flame sampling of this worker (reference
-            # reporter_agent CPU profiling, reporter_agent.py:253)
-            from ray_tpu._private.profiler import sample_folded
-            return sample_folded(float((p or {}).get("duration", 2.0)))
+            # reporter_agent CPU profiling, reporter_agent.py:253).
+            # With "device" set (gang profiling, `ray-tpu profile
+            # --group --device`) the reply is the capture dict — a
+            # jax.profiler device trace bracketing the host sampling
+            # window when on TPU, a caveat string on CPU-only boxes.
+            from ray_tpu._private.profiler import (profile_capture,
+                                                   sample_folded)
+            p = p or {}
+            if "device" in p:
+                return profile_capture(float(p.get("duration", 2.0)),
+                                       device=bool(p.get("device")))
+            return sample_folded(float(p.get("duration", 2.0)))
         if method == "dump_stacks":
             # instant per-thread stacks + short folded sample: a stalled
             # worker answers without gdb (`ray-tpu summary stacks`)

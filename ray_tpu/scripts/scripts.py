@@ -288,6 +288,12 @@ def cmd_summary(args) -> None:
         # by p50/p95, stream stalls, pin counts) — docs/observability.md
         print(state.metrics_summary())
         return
+    if args.resource == "training":
+        # the goodput ledger: init/compile/productive/checkpoint/idle
+        # buckets, MFU and goodput per rank (docs/observability.md
+        # training performance plane)
+        print(state.training_summary_text(getattr(args, "run", None)))
+        return
     if args.resource == "stacks":
         _summary_stacks(args)
         return
@@ -428,18 +434,31 @@ def cmd_debug(args) -> None:
 def cmd_profile(args) -> None:
     """Flame-sample a live cluster process (reference `ray stack`/py-spy
     reporter path): GCS by default, a raylet with --node, one of its
-    workers with --worker. Prints folded stacks (-o writes a .folded
-    file for flamegraph tooling) or a top-N leaf summary."""
+    workers with --worker.  `--group <name>` gang-fans-out instead:
+    every rank of the named training run captures the SAME time window
+    (folded host stacks always; a jax.profiler device trace with
+    --device, TPU only — on a CPU-only box each rank reports the
+    caveat and ships host stacks) and the captures merge into one
+    Perfetto trace keyed by rank, correlated with the run's STEP
+    timeline slices (docs/observability.md).  Prints folded stacks
+    (-o writes .folded, or the merged .json for --group) or a top-N
+    leaf summary."""
     from ray_tpu._private import rpc
-    from ray_tpu._private.profiler import folded_text, top_summary
+    from ray_tpu._private.profiler import (folded_text, split_leaf_detail,
+                                           top_summary)
     from ray_tpu.runtime.gcs import GcsClient
 
     if args.worker and not args.node:
         sys.exit("--worker requires --node (the worker's raylet)")
+    if args.device and not args.group:
+        sys.exit("--device requires --group (gang device capture)")
     addr = _resolve_address(args)
     host, port = addr.rsplit(":", 1)
     gcs = GcsClient((host, int(port)))
     try:
+        if args.group:
+            _profile_group(args, gcs)
+            return
         if args.node:
             node = next((n for n in gcs.call("list_nodes")
                          if n["node_id"].startswith(args.node)
@@ -460,11 +479,95 @@ def cmd_profile(args) -> None:
     finally:
         gcs.close()
     if args.output:
+        clean, _ = split_leaf_detail(counts)
         with open(args.output, "w") as f:
             f.write(folded_text(counts) + "\n")
-        print(f"wrote {sum(counts.values())} samples to {args.output}")
+        print(f"wrote {sum(clean.values())} samples to {args.output}")
     else:
         print(top_summary(counts))
+
+
+def _profile_group(args, gcs) -> None:
+    """Gang-coordinated capture: one profile window on every rank of a
+    training run, merged into a single Perfetto trace keyed by rank."""
+    import threading
+    from ray_tpu._private import rpc
+    from ray_tpu._private import step_stats
+    from ray_tpu._private.profiler import merge_folded, top_summary
+
+    info = gcs.call("list_step_stats", {"run": args.group})
+    runs = info.get("runs") or []
+    if not runs:
+        sys.exit(f"no training run matching {args.group!r} has reported "
+                 "step stats (is the gang running with "
+                 "RAY_TPU_STEP_STATS on?)")
+    run = runs[-1]   # latest matching
+    ranks = {int(r): m for r, m in (run.get("ranks") or {}).items()
+             if m.get("address")}
+    if not ranks:
+        sys.exit(f"run {run['run']}: no rank has reported its RPC "
+                 "address yet")
+    results: dict = {}
+    errors: dict = {}
+
+    def capture(rank: int, meta: dict) -> None:
+        try:
+            conn = rpc.connect(tuple(meta["address"]), timeout=5.0)
+            try:
+                results[rank] = conn.call(
+                    "profile", {"duration": args.duration,
+                                "device": bool(args.device)},
+                    timeout=args.duration + 40)
+            finally:
+                conn.close()
+        except Exception as e:
+            errors[rank] = repr(e)
+
+    t_start = time.time()
+    threads = [threading.Thread(target=capture, args=(r, m), daemon=True)
+               for r, m in sorted(ranks.items())]
+    for t in threads:
+        t.start()   # all ranks sample the same wall-clock window
+    for t in threads:
+        t.join(args.duration + 60)
+    t_end = time.time()
+    for rank, err in sorted(errors.items()):
+        print(f"rank {rank}: capture failed: {err}", file=sys.stderr)
+    if not results:
+        sys.exit("no rank produced a capture")
+
+    per_rank = {}
+    merged: dict = {}
+    for rank, reply in sorted(results.items()):
+        folded = reply.get("folded", reply) if isinstance(reply, dict) \
+            and "folded" in reply else reply
+        per_rank[rank] = folded
+        merge_folded(merged, folded)
+        if isinstance(reply, dict):
+            if reply.get("device_trace"):
+                print(f"rank {rank}: device trace at "
+                      f"{reply['device_trace']} (on the rank's host)")
+            elif reply.get("device_error"):
+                print(f"rank {rank}: {reply['device_error']}",
+                      file=sys.stderr)
+    # correlate with the run's STEP slices from the GCS task table
+    try:
+        rows = gcs.call("list_task_events",
+                        {"name": f"train_step:{run['run']}",
+                         "limit": 4096})
+    except Exception:
+        rows = []
+    step_events = step_stats.step_trace_events(
+        rows, window=(t_start - 300.0, t_end))
+    trace = step_stats.merged_profile_trace(
+        per_rank, interval_s=0.01, t_start=t_start,
+        step_events=step_events)
+    out = args.output or f"profile-{run['run']}.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} trace events for {len(per_rank)} ranks "
+          f"to {out} (open in ui.perfetto.dev)")
+    print(top_summary(merged))
 
 
 def cmd_stack(args) -> None:
@@ -669,11 +772,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("summary", help="summarize cluster state")
     sp.add_argument("resource",
                     choices=["tasks", "actors", "objects", "metrics",
-                             "stacks"])
+                             "stacks", "training"])
     sp.add_argument("--address")
     sp.add_argument("--pid", help="(stacks) worker pid to sample")
     sp.add_argument("--actor",
                     help="(stacks) actor id prefix or name to sample")
+    sp.add_argument("--run",
+                    help="(training) run id or group prefix "
+                         "(default: latest run)")
     sp.set_defaults(fn=cmd_summary)
 
     sp = sub.add_parser("events",
@@ -704,12 +810,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("profile",
-                        help="flame-sample a live cluster process")
+                        help="flame-sample a live cluster process, or a "
+                             "whole training gang with --group")
     sp.add_argument("--address")
     sp.add_argument("--node", help="node id prefix (default: the GCS)")
     sp.add_argument("--worker", help="worker id prefix on that node")
+    sp.add_argument("--group",
+                    help="training run id or group prefix: capture the "
+                         "same window on EVERY rank and merge into one "
+                         "Perfetto trace keyed by rank")
+    sp.add_argument("--device", action="store_true",
+                    help="(--group) also capture a jax.profiler device "
+                         "trace per rank (TPU only; CPU-only boxes "
+                         "report the caveat and ship host stacks)")
     sp.add_argument("--duration", type=float, default=2.0)
-    sp.add_argument("-o", "--output", help="write folded stacks here")
+    sp.add_argument("-o", "--output",
+                    help="write folded stacks (.folded) or the merged "
+                         "gang trace (.json) here")
     sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("microbenchmark",

@@ -26,11 +26,15 @@ from ray_tpu.train.torch_trainer import (TorchConfig,  # noqa: F401
                                          TorchTrainer, prepare_data_loader,
                                          prepare_model)
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401
+# training performance plane (docs/observability.md): the per-step
+# phase clock + goodput ledger a train loop drives
+from ray_tpu._private.step_stats import (instrument_step,  # noqa: F401
+                                         set_model_info, step_clock)
 
 __all__ = [
     "BaseTrainer", "DataParallelTrainer", "BackendConfig",
     "TrainingFailedError", "JaxTrainer", "JaxConfig", "get_mesh",
-    "sync_gradients",
+    "sync_gradients", "step_clock", "instrument_step", "set_model_info",
     "TorchTrainer", "TorchConfig", "prepare_model", "prepare_data_loader",
     "WorkerGroup", "TrainWorker", "make_sharded_train", "OptimizerConfig",
     "make_vision_train", "classification_loss_fn", "Predictor",

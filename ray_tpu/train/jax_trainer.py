@@ -139,6 +139,8 @@ def sync_gradients(tree: Any, *, group_name: Optional[str] = None,
     """
     import jax
     import numpy as np
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu._private import step_stats
     from ray_tpu.util import collective as col
 
     group_name = group_name or os.environ.get(
@@ -148,6 +150,20 @@ def sync_gradients(tree: Any, *, group_name: Optional[str] = None,
     world = col.get_collective_group_size(group_name)
     if world <= 1:
         return tree
+    # training performance plane: the reduction is one step phase — if
+    # the loop's StepClock has a step open this lands inside it, else
+    # in the run ledger's out-of-step totals (docs/observability.md)
+    _t0 = rtm.now()
+    try:
+        return _sync_gradients_timed(tree, group_name, op, average,
+                                     world, jax, np, col)
+    finally:
+        step_stats.record_phase("grad_allreduce",
+                                (rtm.now() - _t0) * 1000.0)
+
+
+def _sync_gradients_timed(tree, group_name, op, average, world, jax,
+                          np, col):
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arrs = [np.asarray(leaf) for leaf in leaves]
     by_dtype: Dict[Any, list] = {}

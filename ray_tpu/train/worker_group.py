@@ -14,6 +14,7 @@ import queue
 import socket
 import threading
 import traceback
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.air import session as air_session
@@ -147,10 +148,17 @@ class TrainWorker:
         with air_session._session_lock:
             air_session._sessions.pop(threading.get_ident(), None)
         sess = self._session
+        run_id = trial_id or uuid.uuid4().hex[:8]
+        self._run_id = run_id
 
         def runner():
+            from ray_tpu._private import step_stats
             with air_session._session_lock:
                 air_session._sessions[threading.get_ident()] = sess
+            try:
+                run = self._start_step_stats(run_id, experiment_name)
+            except Exception:
+                run = None   # observability must never fail the loop
             try:
                 takes_config = True
                 try:
@@ -165,6 +173,10 @@ class TrainWorker:
             except BaseException:
                 self._error = traceback.format_exc()
             finally:
+                try:
+                    step_stats.end_run(run)
+                except Exception:
+                    pass
                 self._done.set()
                 with air_session._session_lock:
                     air_session._sessions.pop(threading.get_ident(), None)
@@ -172,6 +184,38 @@ class TrainWorker:
         self._thread = threading.Thread(target=runner, daemon=True,
                                         name=f"train_loop_r{self.world_rank}")
         self._thread.start()
+
+    def _start_step_stats(self, run_id: str, experiment_name: str):
+        """Open this rank's training-performance-plane run context
+        (docs/observability.md): per-step phase clocks + goodput ledger,
+        reports riding the worker's GCS client into the cluster step
+        table.  The rank metadata (worker id + RPC address) lets
+        ``ray-tpu profile --group`` gang-fan-out to every rank."""
+        from ray_tpu._private import step_stats
+        group = os.environ.get("RAY_TPU_TRAIN_COLLECTIVE_GROUP", "") \
+            or experiment_name
+        sink = None
+        meta = {"world": self.world_size, "pid": os.getpid()}
+        try:
+            from ray_tpu.runtime import core_worker as cw
+            worker = cw.get_global_worker()
+        except Exception:
+            worker = None
+        if worker is not None:
+            gcs = worker.gcs
+            meta.update(worker_id=worker.worker_id.hex(),
+                        node_id=worker.node_id,
+                        address=list(worker.address))
+
+            def sink(reports):
+                gcs.call("report_step_stats", {"reports": reports},
+                         timeout=5)
+        return step_stats.start_run(
+            run_id, group=group, rank=self.world_rank,
+            world=self.world_size, sink=sink, meta=meta)
+
+    def training_run_id(self) -> Optional[str]:
+        return getattr(self, "_run_id", None)
 
     def next_result(self, timeout: float = 2.0):
         """Poll one reported (metrics, checkpoint) item, or status sentinels:

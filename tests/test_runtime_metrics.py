@@ -276,6 +276,48 @@ def test_list_metrics_gauge_max_aggregation(ray_start_regular):
     assert row["value"] == 5.0 and row["max"] == 4.0
 
 
+def test_step_phase_bucket_boundaries_conformant():
+    """The training-plane families use sub-ms-resolution boundaries
+    (ms-scale steps: a healthy data_wait is tens of microseconds) that
+    span to checkpoint-scale tens of seconds, strictly increasing, and
+    render as conformant Prometheus histograms (the byte-scale-bucket
+    precedent from the serve handoff families)."""
+    from ray_tpu._private.runtime_metrics import (HistogramFamily,
+                                                  prometheus_exposition)
+    from ray_tpu._private.step_stats import STEP_PHASE_MS_BOUNDARIES
+
+    b = STEP_PHASE_MS_BOUNDARIES
+    assert b[0] <= 0.01, "sub-ms steps need sub-10us resolution at the low end"
+    assert sum(1 for x in b if x < 1.0) >= 5, "too few sub-ms buckets"
+    assert b[-1] >= 10000.0, "checkpoint phases reach tens of seconds"
+    assert list(b) == sorted(set(b)), "boundaries must strictly increase"
+
+    fam = HistogramFamily("tm_step_phase_ms", "phase",
+                          tag_key="phase",
+                          boundaries=STEP_PHASE_MS_BOUNDARIES)
+    assert fam.boundaries == tuple(sorted(STEP_PHASE_MS_BOUNDARIES))
+    # sub-ms observations land in DISTINCT buckets (the point of the
+    # low-end resolution)
+    fam.observe("data_wait", 0.02)
+    fam.observe("data_wait", 0.2)
+    fam.observe("data_wait", 40000.0)   # overflow
+    payload = fam._payload()
+    rec = payload["values"][json.dumps({"phase": "data_wait"})]
+    assert len([k for k in rec["buckets"] if k != "+Inf"]) == 2
+    assert rec["buckets"]["+Inf"] == 1
+    text = prometheus_exposition(
+        [("tm_step_phase_ms", "w", payload)])
+    assert 'le="+Inf"' in text and "tm_step_phase_ms_count" in text
+    # the registered runtime families carry the same boundaries (when
+    # telemetry is enabled in this process they are real instruments)
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu._private import step_stats as sst
+    inst = rtm._instruments.get("ray_tpu_train_phase_ms")
+    if inst is not None:
+        assert inst.boundaries == tuple(sorted(STEP_PHASE_MS_BOUNDARIES))
+        assert sst._M_PHASE_MS is inst
+
+
 # ------------------------------------------------------- task_events fixes
 def test_task_table_eviction_scans_past_live_head():
     """A live (non-terminal) task at the head of first-seen order must

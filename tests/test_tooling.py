@@ -299,9 +299,12 @@ def test_component_events_and_profiling(ray_start_regular):
     only_err = gcs.call("list_events", {"severity": "ERROR", "limit": 10})
     assert all(e["severity"] == "ERROR" for e in only_err)
 
-    # profile the GCS process
+    # profile the GCS process (folded keys are line-stable `name (file)`;
+    # leaf line detail rides the reserved entry)
+    from ray_tpu._private.profiler import split_leaf_detail
     counts = gcs.call("profile", {"duration": 0.3}, timeout=40)
-    assert counts and all(isinstance(v, int) for v in counts.values())
+    clean, _detail = split_leaf_detail(counts)
+    assert clean and all(isinstance(v, int) for v in clean.values())
 
     # profile a worker through its raylet (spin one up with a task)
     @ray_tpu.remote
