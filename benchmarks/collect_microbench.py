@@ -114,6 +114,18 @@ SECTIONS = {
                                          "telemetry_overhead.py"),
                             "--step-stats", "--rounds", "4"],
                        timeout=1200),
+    # request tracing plane cost guard (docs/observability.md): paired
+    # interleaved OFF/ON segments of the small-task loop at the DEFAULT
+    # trace_sample_rate (telemetry + events pinned on); the
+    # tracing_overhead row carries the same <=3% bar.  4 rounds -> 64
+    # pairs: the task loop schedules a worker process per call, so
+    # per-pair ratios spread +-15% on this box and the median needs
+    # that many draws to resolve a ~1% plane cost
+    "tracing": dict(cmd=[sys.executable,
+                         os.path.join(REPO, "benchmarks",
+                                      "telemetry_overhead.py"),
+                         "--tracing", "--rounds", "4"],
+                    timeout=1200),
     "serve_llm": dict(cmd=[sys.executable,
                            os.path.join(REPO, "benchmarks", "serve_llm.py"),
                            "--suite", "--slots", "32", "--requests", "128"],

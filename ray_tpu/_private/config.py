@@ -332,6 +332,53 @@ _declare("straggler_min_ms", float, 20.0,
          "Absolute floor on the straggler overshoot: ms-scale steps "
          "jitter by scheduler noise, and median + k*MAD alone would "
          "fire on microsecond skew in a tight gang.")
+_declare("tracing_enabled", bool, True,
+         "Distributed request tracing plane (util/tracing/"
+         "tracing_helper.py): trace roots at every serve ingress, "
+         "sampled roots at task/actor submission, cross-process span "
+         "propagation and the GCS span table.  Also overridable as "
+         "RAY_TPU_TRACING=0 (the bench kill switch, mirroring "
+         "RAY_TPU_TELEMETRY / RAY_TPU_EVENTS); disabling makes every "
+         "root/span call a no-op after one cached flag read.")
+_declare("trace_sample_rate", float, 0.1,
+         "Fraction of traces whose spans are recorded, decided by a "
+         "deterministic hash of the trace id (every process reaches "
+         "the same verdict for the same id with no coordination).  "
+         "Serve ingresses always open a root context for SLO "
+         "accounting; this rate gates span recording and task/actor "
+         "submission roots.  1.0 records everything, 0 disables "
+         "recording while keeping SLO counters.")
+_declare("trace_flush_interval_ms", int, 500,
+         "Period of the per-process span-buffer flusher batching "
+         "finished spans to the GCS span table (never an RPC on the "
+         "request path).")
+_declare("trace_buffer_size", int, 2048,
+         "Per-process bound on buffered-but-unflushed spans; past it "
+         "new spans are dropped (counted) rather than growing memory "
+         "behind a dead GCS.")
+_declare("trace_stream_span_items", int, 16,
+         "Per-stream cap on per-yield item marker spans recorded into "
+         "a sampled trace (the STREAM_ITEM cap discipline, scaled down "
+         "— tracing wants the pacing shape, not every token).")
+_declare("gcs_max_traces", int, 512,
+         "Max traces the GCS span table retains (sharded rotation, "
+         "oldest dropped first).")
+_declare("gcs_traces_max_bytes", int, 8 * 1024 * 1024,
+         "Byte budget of the GCS span table (JSON-serialized span "
+         "sizes); the hard retention gate alongside the trace count.")
+_declare("gcs_trace_max_spans", int, 256,
+         "Per-trace span cap in the GCS span table (first and last "
+         "halves survive, like the task table's per-record event cap).")
+_declare("serve_slo_ttft_ms", float, 2000.0,
+         "Serve SLO target: time-to-first-token budget per request "
+         "(ms).  Completed requests are classified against it into "
+         "ray_tpu_serve_slo_good/violation{pool,slo=ttft} counters "
+         "with exemplar trace ids on the slowest requests; <= 0 "
+         "disables the dimension.")
+_declare("serve_slo_tpot_ms", float, 200.0,
+         "Serve SLO target: inter-token latency budget (ms/token past "
+         "the first) for streaming requests; <= 0 disables the "
+         "dimension.")
 
 # --------------------------------------------------------------------------- #
 # TPU / device model                                                          #

@@ -121,6 +121,24 @@ def _maybe_fuzz() -> None:
         time.sleep(random.uniform(0.0, ms / 1000.0))
 
 
+# distributed-tracing context install around dispatch (lazy import: rpc
+# is imported by everything, tracing_helper only needs CONFIG but the
+# indirection keeps cold rpc startup free of it)
+_trace_mod = None
+
+
+def _trace_install(ctx):
+    global _trace_mod
+    if _trace_mod is None:
+        from ray_tpu.util.tracing import tracing_helper
+        _trace_mod = tracing_helper
+    return _trace_mod.install(ctx)
+
+
+def _trace_uninstall(token) -> None:
+    _trace_mod.uninstall(token)
+
+
 # wire format: one frame is
 #   <IIBQ>  (pickle_len, nbufs, kind, msg_id)
 #   nbufs * <Q>  out-of-band buffer lengths
@@ -748,10 +766,20 @@ class Connection:
 
     def _handle_request(self, msg_id: int, method: str, payload: Any) -> None:
         t0 = rtm.now()
+        trace_token = None
         try:
             if self._handler is None:
                 raise RpcError(f"no handler for {method}")
             _maybe_fuzz()
+            if type(payload) is dict and "_trace_ctx" in payload:
+                # distributed-tracing propagation (docs/observability.md):
+                # a caller that stamped its trace context onto the payload
+                # (streaming item reports, transfer-plane chunk fetches)
+                # has the handler run inside that trace — spans it opens
+                # join the request's trace with no per-method plumbing.
+                # Popped before the handler, restored after: dispatch-pool
+                # threads are reused and must not leak a context.
+                trace_token = _trace_install(payload.pop("_trace_ctx"))
             result = self._handler(self, method, payload)
             if isinstance(result, Deferred):
                 # the reply is sent by whichever thread resolves it;
@@ -762,6 +790,9 @@ class Connection:
             ok, value = True, result
         except BaseException as e:  # noqa: BLE001 - errors cross the wire
             ok, value = False, e
+        finally:
+            if trace_token is not None:
+                _trace_uninstall(trace_token)
         _M_DISPATCH.observe_since(method, t0)
         self._respond(msg_id, ok, value)
 

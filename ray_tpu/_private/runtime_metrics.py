@@ -84,6 +84,62 @@ class Counter:
                 "values": {"{}": self.value}, "ts": time.time()}
 
 
+class CounterFamily:
+    """Per-label-set counters (serve SLO verdicts by pool+dimension).
+
+    The Counter sibling of HistogramFamily: ``inc(labels)`` pays one
+    dict hit, where ``labels`` is a tuple matching ``tag_keys`` (or a
+    bare string for a single key).  Label sets are bounded
+    (``max_labels``) like the histogram family."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, description: str = "", *,
+                 tag_keys=("label",), max_labels: int = 256):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self.max_labels = max_labels
+        self._items: Dict[tuple, Counter] = {}
+        self._lock = threading.Lock()
+        self._overflow: Optional[Counter] = None
+
+    def get(self, labels) -> Counter:
+        if isinstance(labels, str):
+            labels = (labels,)
+        labels = tuple(labels)
+        c = self._items.get(labels)
+        if c is None:
+            with self._lock:
+                c = self._items.get(labels)
+                if c is None:
+                    if len(self._items) >= self.max_labels:
+                        if self._overflow is None:
+                            self._overflow = Counter(self.name,
+                                                     self.description)
+                            self._items[("__other__",) * len(
+                                self.tag_keys)] = self._overflow
+                        return self._overflow
+                    c = Counter(self.name, self.description)
+                    self._items[labels] = c
+        return c
+
+    def inc(self, labels, n: float = 1.0) -> None:
+        self.get(labels).inc(n)
+
+    def labels(self) -> List[tuple]:
+        with self._lock:
+            return list(self._items)
+
+    def _payload(self) -> dict:
+        with self._lock:
+            items = list(self._items.items())
+        return {"type": "counter", "description": self.description,
+                "values": {json.dumps(dict(zip(self.tag_keys, labels))):
+                           c.value for labels, c in items},
+                "ts": time.time()}
+
+
 class Gauge:
     """Point-in-time value.  ``watermark`` gauges track a high-water
     mark via ``set_max`` and are reset to 0 after each flush, so every
@@ -285,6 +341,12 @@ def histogram_family(name: str, description: str = "", *,
                      boundaries: Iterable[float] = DEFAULT_MS_BOUNDARIES):
     return _register(name, lambda: HistogramFamily(
         name, description, tag_key=tag_key, boundaries=boundaries))
+
+
+def counter_family(name: str, description: str = "", *,
+                   tag_keys=("label",)):
+    return _register(name, lambda: CounterFamily(
+        name, description, tag_keys=tag_keys))
 
 
 def gauge_callback(name: str, description: str,

@@ -237,7 +237,36 @@ class ObjectPuller:
 
         ``publish_small=True`` lands even single-chunk objects in the
         local store (the prefetch path wants a local copy; the get path
-        prefers returning the bytes without store churn)."""
+        prefers returning the bytes without store churn).
+
+        Tracing (docs/observability.md): when the calling thread carries
+        a sampled trace context (a serve decode pulling its KV handoff,
+        a task fetching an argument), the pull lands as a ``pull`` span
+        in that trace — the "handoff pull" hop of a disaggregated serve
+        request is this very call."""
+        from ray_tpu.util.tracing import tracing_helper as trh
+        ctx = trh.current_context()
+        if ctx is None or not trh.ctx_sampled(ctx):
+            return self._pull_impl(oid, sources, deadline, publish_small)
+        t0 = time.time()
+        out = self._pull_impl(oid, sources, deadline, publish_small,
+                              trace_ctx={"trace_id": ctx["trace_id"],
+                                         "span_id": ctx.get("span_id"),
+                                         "sampled": True})
+        trh.record_span({
+            "trace_id": ctx["trace_id"], "span_id": trh.new_span_id(),
+            "parent_id": ctx.get("span_id"),
+            "name": f"pull:{oid.hex()[:12]}", "kind": "pull",
+            "start": t0, "dur_ms": round(out.duration_s * 1e3, 3),
+            "status": trh.OK if out.status == "ok" else trh.ERROR,
+            "attrs": {"bytes": out.bytes, "nsources": out.nsources,
+                      "pull_status": out.status}})
+        return out
+
+    def _pull_impl(self, oid: ObjectID, sources: Sequence[str],
+                   deadline: Optional[float] = None,
+                   publish_small: bool = False,
+                   trace_ctx: Optional[dict] = None) -> PullOutcome:
         t_start = time.monotonic()
         _M_PULLS.inc()
         chunk = CONFIG.object_transfer_chunk_bytes
@@ -253,10 +282,13 @@ class ObjectPuller:
                 transient = True
                 continue
             try:
-                res = conn.call("fetch_object_chunk",
-                                {"object_id": oid.binary(), "offset": 0,
-                                 "length": chunk, "timeout": 0.0,
-                                 "oob": True},
+                req = {"object_id": oid.binary(), "offset": 0,
+                       "length": chunk, "timeout": 0.0, "oob": True}
+                if trace_ctx is not None:
+                    # the serving raylet joins the trace for the
+                    # dispatch (rpc.py installs/pops "_trace_ctx")
+                    req["_trace_ctx"] = trace_ctx
+                res = conn.call("fetch_object_chunk", req,
                                 timeout=self._chunk_timeout(deadline))
             except (ConnectionError, rpc.RemoteError, TimeoutError,
                     OSError):
@@ -302,7 +334,7 @@ class ObjectPuller:
         try:
             return self._pull_body(oid, total, meta, data0, chunk, nh0,
                                    list(sources), conns, absent, transient,
-                                   deadline, t_start)
+                                   deadline, t_start, trace_ctx)
         finally:
             if acquired:
                 self._budget.release(total)
@@ -365,7 +397,8 @@ class ObjectPuller:
                 return bytearray(total), "heap"
 
     def _pull_body(self, oid, total, meta, data0, chunk, nh0, sources,
-                   conns, absent, transient, deadline, t_start):
+                   conns, absent, transient, deadline, t_start,
+                   trace_ctx=None):
         dest, kind = self._alloc_dest(oid, total, meta, deadline)
         if kind == "sealed":
             return PullOutcome("ok", data=dest, meta=meta, published=True,
@@ -407,14 +440,14 @@ class ObjectPuller:
             t = threading.Thread(
                 target=self._source_loop,
                 args=(st, oid, mv, total, chunk, window, ps, deadline,
-                      len(states) > 1),
+                      len(states) > 1, trace_ctx),
                 daemon=True, name="pull-stripe")
             t.start()
             threads.append(t)
         # the first (primary) source runs on the calling thread: the
         # single-source common case spawns no threads at all
         self._source_loop(states[0], oid, mv, total, chunk, window, ps,
-                          deadline, len(states) > 1)
+                          deadline, len(states) > 1, trace_ctx)
         for t in threads:
             t.join()
 
@@ -464,7 +497,7 @@ class ObjectPuller:
 
     def _source_loop(self, st: _SourceState, oid, mv, total, chunk,
                      window, ps: _PullState, deadline,
-                     striped: bool) -> None:
+                     striped: bool, trace_ctx=None) -> None:
         """Drain the shared offset queue through one source, keeping up
         to ``window`` chunk requests in flight.  On failure the source's
         outstanding offsets go back on the queue for the survivors."""
@@ -528,6 +561,11 @@ class ObjectPuller:
                 length = min(chunk, total - off)
                 payload = {"object_id": oid.binary(), "offset": off,
                            "length": length, "timeout": 0.0, "oob": True}
+                if trace_ctx is not None:
+                    # every chunk dispatch joins the trace, not just the
+                    # discovery probe (the docs promise chunk fetches
+                    # ride the context; ~70B per 8MiB chunk frame)
+                    payload["_trace_ctx"] = trace_ctx
                 used: List[int] = []
                 try:
                     fut = st.conn.call_async(

@@ -72,6 +72,8 @@ class DashboardHead:
             web.get("/api/dossiers", self._dossiers),
             web.get("/api/dossiers/{dossier_id}", self._dossier),
             web.get("/api/training", self._training),
+            web.get("/api/traces", self._traces),
+            web.get("/api/traces/{trace_id}", self._trace),
             web.get("/api/profile", self._profile),
             web.get("/metrics", self._metrics),
             web.get("/", self._index),
@@ -272,6 +274,29 @@ class DashboardHead:
         return web.json_response(await self._call(build))
 
     # -------------------------------------------------------------- profile
+    async def _traces(self, request) -> web.Response:
+        """Request-trace directory (docs/observability.md tracing
+        plane); ?slo_violations=1 narrows to SLO misses."""
+        q = request.query
+        rows = await self._call(
+            lambda: self.gcs.call("list_traces", {
+                "slo_violations": q.get("slo_violations") in ("1", "true"),
+                "route": q.get("route"),
+                "limit": int(q.get("limit", 100))}))
+        stats = await self._call(
+            lambda: self.gcs.call("trace_stats", {}))
+        return web.json_response({"traces": rows, "stats": stats})
+
+    async def _trace(self, request) -> web.Response:
+        trace = await self._call(
+            lambda: self.gcs.call(
+                "get_trace",
+                {"trace_id": request.match_info["trace_id"]}))
+        if trace is None:
+            return web.json_response({"error": "no such trace"},
+                                     status=404)
+        return web.json_response({"trace": trace})
+
     async def _profile(self, request) -> web.Response:
         """On-demand flame sampling of any cluster process (reference
         reporter_agent CPU profiling): ?node_id=...[&worker_id=...]

@@ -80,7 +80,7 @@ INDEX_HTML = r"""<!DOCTYPE html>
 <main id="main">loading…</main>
 <script>
 const TABS = ["Overview", "Metrics", "Nodes", "Actors", "Tasks",
-              "Timeline", "Training", "Jobs", "Serve",
+              "Timeline", "Training", "Traces", "Jobs", "Serve",
               "Placement Groups", "Events"];
 let tab = location.hash ? decodeURIComponent(location.hash.slice(1))
                         : "Overview";
@@ -340,6 +340,63 @@ async function renderTraining() {
   return html;
 }
 
+// ---- Traces: the request tracing plane's span table — one row per
+// sampled request (root route, TTFT/TPOT vs SLO targets), click a
+// trace id to expand its span tree inline (docs/observability.md)
+let followTrace = null;
+async function renderTraces() {
+  const d = await J("/api/traces?limit=100");
+  const s = d.stats || {};
+  let html = `<div class="tiles">` + [
+      ["traces (retained)", `${s.traces ?? 0} / ${s.traces_seen ?? 0}`],
+      ["spans", s.spans ?? 0],
+      ["dropped by rotation", s.dropped_traces ?? 0],
+    ].map(([k, v]) =>
+      `<div class="tile"><div class="v">${esc(v)}</div>` +
+      `<div class="k">${esc(k)}</div></div>`).join("") + `</div>`;
+  html += `<div class="hint">sampled request traces (CLI: ` +
+    `<span class="mono">ray-tpu traces --slo-violations</span>, ` +
+    `<span class="mono">ray-tpu trace &lt;id&gt;</span>)</div>`;
+  html += table(["trace", "time", "route", "spans", "TTFT (ms)",
+                 "TPOT (ms)", "SLO", "status"],
+    (d.traces || []).map(t => [
+      `<a class="tracelink mono" data-tid="${esc(t.trace_id)}">` +
+      `${esc(t.trace_id.slice(0, 16))}</a>`,
+      t.start ? new Date(t.start * 1000).toLocaleTimeString() : "–",
+      esc(t.route || t.name || ""), esc(t.nspans),
+      t.ttft_ms != null ? t.ttft_ms.toFixed(1) : "–",
+      t.tpot_ms != null ? t.tpot_ms.toFixed(2) : "–",
+      t.slo_ok == null ? badge("–")
+        : (t.slo_ok ? badge("OK")
+           : badge("VIOLATED " + (t.slo_violated || []).join(","))),
+      badge(t.status || "?")]));
+  if (followTrace) {
+    const td = await J(`/api/traces/${encodeURIComponent(followTrace)}`);
+    const spans = (td.trace || {}).spans || [];
+    const t0 = Math.min(...spans.map(sp => sp.start || 0));
+    html += `<div class="hint">spans of <b class="mono">` +
+      `${esc(followTrace.slice(0, 16))}</b> — ` +
+      `<a class="tracelink" data-tid="">close</a></div>`;
+    html += table(["+t (ms)", "span", "kind", "dur (ms)", "process",
+                   "status", "detail"],
+      spans.map(sp => [
+        ((sp.start - t0) * 1000).toFixed(1),
+        `<span class="mono">${esc(sp.name)}</span>`, esc(sp.kind),
+        (sp.dur_ms ?? 0).toFixed(2),
+        `<span class="mono">${esc((sp.worker_id || sp.source || "")
+           .slice(0, 10))}</span>`,
+        badge(sp.status || "ok"),
+        esc(["bytes", "npages", "num_tokens", "error_type"]
+          .filter(k => sp[k] != null).map(k => `${k}=${sp[k]}`)
+          .join(" "))]));
+  }
+  return html;
+}
+document.addEventListener("click", (e) => {
+  const a = e.target.closest("a.tracelink[data-tid]");
+  if (a) { followTrace = a.dataset.tid || null; refresh(); }
+});
+
 async function renderJobs() {
   const d = await J("/api/jobs");
   let html = table(["job", "status", "entrypoint", "logs"],
@@ -404,7 +461,7 @@ document.addEventListener("click", (e) => {
 const RENDER = {"Overview": renderOverview, "Metrics": renderMetrics,
   "Nodes": renderNodes, "Actors": renderActors, "Tasks": renderTasks,
   "Timeline": renderTimeline, "Training": renderTraining,
-  "Jobs": renderJobs, "Serve": renderServe,
+  "Traces": renderTraces, "Jobs": renderJobs, "Serve": renderServe,
   "Placement Groups": renderPGs, "Events": renderEvents};
 
 async function pollLog(g) {

@@ -7,9 +7,11 @@ state_manager.py) + dashboard/state_aggregator.py:132 (StateAPIManager).
 from ray_tpu.experimental.state.api import (  # noqa: F401
     get_dossier, list_actors, list_cluster_events, list_dossiers,
     list_jobs, list_metrics, list_nodes, list_objects,
-    list_placement_groups, list_step_stats, list_tasks, list_workers,
-    memory_summary, metrics_summary, summarize_actors, summarize_objects,
-    summarize_tasks, timeline, training_summary, training_summary_text)
+    list_placement_groups, list_step_stats, list_tasks, list_traces,
+    list_workers, get_trace, memory_summary, metrics_summary,
+    summarize_actors, summarize_objects, summarize_tasks, timeline,
+    trace_stats, trace_timeline, trace_tree_text, training_summary,
+    training_summary_text)
 
 __all__ = [
     "list_tasks", "list_actors", "list_nodes", "list_jobs", "list_objects",
@@ -18,4 +20,6 @@ __all__ = [
     "list_step_stats", "training_summary", "training_summary_text",
     "summarize_tasks", "summarize_actors", "summarize_objects",
     "memory_summary", "metrics_summary", "timeline",
+    "list_traces", "get_trace", "trace_stats", "trace_timeline",
+    "trace_tree_text",
 ]

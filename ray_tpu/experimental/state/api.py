@@ -142,6 +142,121 @@ def training_summary_text(run: Optional[str] = None) -> str:
     return "\n".join(lines)
 
 
+# ----------------------------------------------------------- tracing plane
+def list_traces(*, slo_violations: bool = False,
+                route: Optional[str] = None,
+                status: Optional[str] = None,
+                since: Optional[float] = None,
+                limit: int = 100) -> List[dict]:
+    """Trace directory rows from the GCS span table
+    (docs/observability.md): one row per retained trace — root
+    name/route/pool, duration, TTFT/TPOT, SLO verdict, span count,
+    dossier cross-link.  ``slo_violations=True`` narrows to requests
+    that missed a target; ``route`` is a prefix match."""
+    return _gcs().call("list_traces", {
+        "slo_violations": slo_violations, "route": route,
+        "status": status, "since": since, "limit": limit})
+
+
+def get_trace(trace_id: str) -> Optional[dict]:
+    """One full trace by id (prefix ok): every retained span sorted by
+    start time, plus the root's SLO fields."""
+    return _gcs().call("get_trace", {"trace_id": trace_id})
+
+
+def trace_stats() -> dict:
+    return _gcs().call("trace_stats", {})
+
+
+def trace_tree_text(trace: dict) -> str:
+    """Render one trace as an indented span tree (``ray-tpu trace``):
+    parent/child structure, per-span duration/status, the hop
+    decomposition of the request."""
+    if not trace:
+        return "(no such trace)"
+    spans = trace.get("spans") or []
+    by_parent: Dict[Optional[str], List[dict]] = {}
+    ids = {s.get("span_id") for s in spans}
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent not in ids:
+            parent = None     # orphan (parent rotated out): show at root
+        by_parent.setdefault(parent, []).append(s)
+    for kids in by_parent.values():
+        kids.sort(key=lambda s: s.get("start", 0))
+    lines = [f"trace {trace.get('trace_id', '?')}  "
+             f"({len(spans)} spans"
+             + (", truncated" if trace.get("truncated") else "") + ")"]
+    root = trace.get("root") or {}
+    if root.get("slo_ok") is not None:
+        verdict = "OK" if root["slo_ok"] else (
+            "VIOLATED " + ",".join(root.get("slo_violated") or []))
+        lines.append(
+            "slo: %s  ttft=%s ms  tpot=%s ms  tokens=%s" % (
+                verdict, root.get("ttft_ms", "-"),
+                root.get("tpot_ms", "-"), root.get("num_tokens", "-")))
+    if root.get("dossier_id"):
+        lines.append(f"crash dossier: {root['dossier_id']}  "
+                     f"(ray-tpu events --dossier {root['dossier_id']})")
+    t0 = min((s.get("start", 0) for s in spans), default=0)
+
+    def _walk(parent: Optional[str], depth: int) -> None:
+        for s in by_parent.get(parent, []):
+            status = s.get("status", "ok")
+            mark = "" if status == "ok" else \
+                f"  !{s.get('error_type') or status}"
+            where = (s.get("worker_id") or "")[:8]
+            extras = "".join(
+                f"  {k}={s[k]}" for k in ("bytes", "npages", "num_tokens",
+                                          "index")
+                if s.get(k) is not None)
+            lines.append(
+                "%8.1fms  %s%-28s %8.1fms  [%s]%s%s" % (
+                    (s.get("start", 0) - t0) * 1e3, "  " * depth,
+                    s.get("name", "?")[:28], s.get("dur_ms", 0.0),
+                    where or s.get("source", "?"), extras, mark))
+            _walk(s.get("span_id"), depth + 1)
+
+    _walk(None, 0)
+    return "\n".join(lines)
+
+
+def trace_timeline(trace_id: str, path: Optional[str] = None
+                   ) -> List[dict]:
+    """Perfetto export of ONE trace: its spans as complete slices merged
+    with the cluster timeline's slices that carry the same trace id
+    (task/queue-wait/STREAM_ITEM/PULL/HANDOFF/STEP events), so the
+    request's hops and the subsystems they exercised share one time
+    axis.  Load in chrome://tracing or ui.perfetto.dev."""
+    trace = get_trace(trace_id)
+    if not trace:
+        return []
+    tid_full = trace["trace_id"]
+    events: List[dict] = []
+    for s in trace.get("spans") or []:
+        args = {k: v for k, v in s.items()
+                if k not in ("start", "dur_ms", "name")}
+        events.append({
+            "name": s.get("name", "?"), "cat": f"span:{s.get('kind')}",
+            "ph": "X", "ts": s.get("start", 0) * 1e6,
+            "dur": max(1.0, float(s.get("dur_ms", 0.0)) * 1e3),
+            "pid": f"trace {tid_full[:8]}",
+            "tid": (s.get("source") or "proc") + ":" +
+                   (s.get("worker_id") or "")[:8],
+            "args": args,
+        })
+    # merge the subsystem slices stamped with this trace id (PULL /
+    # HANDOFF / STEP / task / stream_item rows keep their own pid/tid —
+    # the process axis — while the span rows group under the trace pid)
+    for ev in timeline():
+        if (ev.get("args") or {}).get("trace_id") == tid_full:
+            events.append(ev)
+    if path:
+        with open(path, "w") as f:
+            json.dump(events, f)
+    return events
+
+
 def get_dossier(dossier_id: str) -> Optional[dict]:
     """Crash dossier by id — a dead worker's id hex (prefix ok) or a
     dead node's id hex.  Contains the process's flight-recorder event
@@ -670,6 +785,54 @@ def metrics_summary() -> str:
                 (summary or {}).get("run", "?"),
                 100 * agg.get("goodput", 0.0),
                 100 * agg.get("mfu", 0.0)))
+        lines.append("")
+
+    # request tracing plane (docs/observability.md): trace volume, the
+    # sampled fraction, and the worst SLO-violating routes with concrete
+    # exemplar trace ids — `ray-tpu trace <id>` shows which hop ate the
+    # budget
+    try:
+        tstats = trace_stats()
+    except (rpc.RpcError, ConnectionError, TimeoutError):
+        tstats = {}
+    slo_rows = [r for r in rows
+                if r["name"] in ("ray_tpu_serve_slo_good",
+                                 "ray_tpu_serve_slo_violation")]
+    if tstats.get("traces_seen") or slo_rows:
+        lines.append("== Request traces ==")
+        total_classified = sum(r.get("value", 0.0) for r in slo_rows
+                               if r["tags"].get("slo") == "ttft")
+        lines.append("%-34s %10d  (%d retained, %d spans, %d B)" % (
+            "traces recorded", tstats.get("traces_seen", 0),
+            tstats.get("traces", 0), tstats.get("spans", 0),
+            tstats.get("bytes", 0)))
+        if total_classified:
+            # ingress roots only: counting task-submission traces here
+            # would inflate the fraction past the real serve coverage
+            lines.append("%-34s %13.1f%%  (%d requests SLO-classified)"
+                         % ("sampled fraction",
+                            100.0 * min(1.0, tstats.get("ingress_seen", 0)
+                                        / total_classified),
+                            total_classified))
+        for r in sorted(slo_rows, key=lambda r: (
+                r["tags"].get("pool", ""), r["tags"].get("slo", ""),
+                r["name"])):
+            lines.append("%-34s %14g" % (
+                "slo %s %s{%s}" % (
+                    "good" if r["name"].endswith("good") else "VIOLATION",
+                    r["tags"].get("slo", "?"), r["tags"].get("pool", "?")),
+                r.get("value", 0.0)))
+        violating = sorted(
+            ((route, s) for route, s in
+             (tstats.get("slo_by_route") or {}).items()
+             if s.get("violation")),
+            key=lambda kv: -kv[1]["violation"])[:5]
+        for route, s in violating:
+            ex = (s.get("exemplars") or [{}])[0]
+            lines.append("%-34s %6d violations  worst %sms  trace %s" % (
+                f"route {route[:26]}", s["violation"],
+                ex.get("ttft_ms", "?"),
+                (ex.get("trace_id") or "?")[:16]))
         lines.append("")
 
     rpc_rows = [r for r in rows if r["name"] == "ray_tpu_rpc_dispatch_ms"

@@ -42,6 +42,18 @@ from ray_tpu._private.logging_utils import get_logger
 from ray_tpu.runtime.gcs import ALIVE, DEAD, GcsClient, RESTARTING
 from ray_tpu.runtime.object_store import SharedMemoryStore
 
+# tracing_helper lives under ray_tpu.util, whose __init__ imports back
+# into core_worker (placement_group) — resolved lazily, cached
+_trh = None
+
+
+def _tracing():
+    global _trh
+    if _trh is None:
+        from ray_tpu.util.tracing import tracing_helper
+        _trh = tracing_helper
+    return _trh
+
 logger = get_logger("core_worker")
 
 _INLINE_MAX = None  # resolved lazily from CONFIG
@@ -606,6 +618,15 @@ class CoreWorker:
             worker_id=self.worker_id.hex(),
             job_id=self.job_id.hex() if mode == "driver" else "",
             flight_path=flight)
+        # distributed request tracing (docs/observability.md): bind this
+        # process's span buffer; finished spans batch to the GCS span
+        # table on the flusher thread, never on the request path
+        from ray_tpu.util.tracing import tracing_helper as trh
+        self._span_buffer = trh.configure(
+            lambda spans: self.gcs.call(
+                "report_spans", {"spans": spans}, timeout=5),
+            node_id=node_id, worker_id=self.worker_id.hex(),
+            source=mode)
 
     # ------------------------------------------------------------ lifecycle
     def shutdown(self) -> None:
@@ -616,6 +637,8 @@ class CoreWorker:
         rtm.remove_gauge_callback("ray_tpu_shm_pins", self._pins_gauge_cb)
         from ray_tpu._private import cluster_events as cev
         cev.detach(self._events_recorder)
+        from ray_tpu.util.tracing import tracing_helper as trh
+        trh.detach(self._span_buffer)
         try:
             self.events.stop()
         except Exception:
@@ -1233,6 +1256,15 @@ class CoreWorker:
             with self._owned_lock:
                 entry.recovering = False
 
+    def result_is_error(self, ref: ObjectRef) -> bool:
+        """Whether a READY owned ref resolved to an error payload —
+        without deserializing (the serve trace roots classify a
+        completed request's status off the reply the moment its ready
+        callback fires)."""
+        with self._owned_lock:
+            entry = self._owned.get(ref.id)
+            return bool(entry is not None and entry.error)
+
     def add_ready_callback(self, ref: ObjectRef, cb) -> None:
         """Run ``cb()`` once the owned object is ready — immediately when
         it already is (or when the ref isn't owned by this worker, where
@@ -1387,7 +1419,7 @@ class CoreWorker:
             # the flag is needed
             spec["backpressure"] = CONFIG.generator_backpressure_num_objects
             self._register_stream(task_id.binary(), spec["backpressure"])
-        trace_ctx = _current_trace_context()
+        trace_ctx = _submit_trace_ctx(spec["name"])
         if trace_ctx:
             # auto span injection (reference _inject_tracing_into_function,
             # tracing_helper.py:324): the submitting span's context rides
@@ -2562,7 +2594,7 @@ class CoreWorker:
         if num_returns == "streaming":
             spec["backpressure"] = CONFIG.generator_backpressure_num_objects
             self._register_stream(task_id.binary(), spec["backpressure"])
-        trace_ctx = _current_trace_context()
+        trace_ctx = _submit_trace_ctx(method_name)
         if trace_ctx:
             spec["trace_ctx"] = trace_ctx
         refs = []
@@ -2929,6 +2961,30 @@ class _ActorPipe:
 def _current_trace_context() -> dict:
     from ray_tpu.util.tracing.tracing_helper import get_trace_context
     return get_trace_context()
+
+
+def _submit_trace_ctx(name: str) -> Optional[dict]:
+    """Trace context to stamp onto a task/actor spec at submission.
+
+    An active context (a serve ingress root, a user ``span()``, an
+    executing task's span) propagates as-is.  With NO active context the
+    deterministic sampler may open a fresh trace root for this
+    submission (docs/observability.md): the unsampled fast path costs
+    one random draw + compare; a sampled one records an instant
+    ``submit`` root span so the trace has an anchor whose children are
+    the worker-side execution spans."""
+    trh = _tracing()
+    ctx = trh.current_context()
+    if ctx:
+        return dict(ctx)
+    ctx = trh.maybe_sample_root()
+    if ctx is not None:
+        trh.record_span({
+            "trace_id": ctx["trace_id"], "span_id": ctx["span_id"],
+            "name": f"submit:{name}", "kind": "submit",
+            "start": time.time(), "dur_ms": 0.0, "status": trh.OK,
+            "root": True})
+    return ctx
 
 
 def _maybe_big(value: Any) -> bool:

@@ -104,6 +104,13 @@ class GcsServer:
         # events and metrics.
         from ray_tpu._private import step_stats as sst
         self._step_stats = sst.GcsStepStatsTable(emit=self.record_event)
+        # distributed request tracing plane (docs/observability.md):
+        # trace-indexed span store fed by every process's span-buffer
+        # flusher; root spans carrying a dossier_id cross-link the
+        # dossier back to the trace.  Ephemeral like events/metrics.
+        from ray_tpu.util.tracing import tracing_helper as trh
+        self._span_table = trh.GcsSpanTable(
+            on_dossier_link=self._link_dossier_trace)
         self._dossiers: Dict[str, dict] = {}
         self._dossier_order: deque = deque()
         self._placement_groups: Dict[str, Dict[str, Any]] = {}
@@ -523,6 +530,36 @@ class GcsServer:
     def _rpc_training_summary(self, conn, p):
         """The goodput-ledger view of one run (latest by default)."""
         return self._step_stats.summary(p.get("run"))
+
+    # ------------------------------------------------------- tracing plane
+    def _rpc_report_spans(self, conn, p):
+        """Batched span flush from a process's SpanBuffer
+        (tracing_helper.py flusher cadence)."""
+        return {"dropped": self._span_table.put(p.get("spans") or [])}
+
+    def _rpc_list_traces(self, conn, p):
+        return self._span_table.list(
+            slo_violations=bool(p.get("slo_violations")),
+            route=p.get("route"), status=p.get("status"),
+            since=p.get("since"), limit=int(p.get("limit", 100)))
+
+    def _rpc_get_trace(self, conn, p):
+        return self._span_table.get(p.get("trace_id") or "")
+
+    def _rpc_trace_stats(self, conn, p):
+        return self._span_table.stats()
+
+    def _link_dossier_trace(self, dossier_id: str, trace_id: str) -> None:
+        """A root span died carrying a dossier_id: stamp the trace id
+        onto the dossier (prefix match like get_dossier) so forensics
+        navigate both ways."""
+        with self._lock:
+            d = self._dossiers.get(dossier_id)
+            if d is None and len(dossier_id) >= 8:
+                d = next((cand for did, cand in self._dossiers.items()
+                          if did.startswith(dossier_id)), None)
+            if d is not None:
+                d["trace_id"] = trace_id
 
     # ------------------------------------------------------------- dossiers
     def _rpc_put_dossier(self, conn, p):

@@ -108,6 +108,7 @@ class _StreamSession:
     pauses (blocks in send()) until the consumer catches up."""
 
     def __init__(self, core, spec, inline_max: int):
+        from ray_tpu.util.tracing import tracing_helper as trh
         self.core = core
         self.spec = spec
         self.task_id = TaskID(spec["task_id"])
@@ -116,6 +117,21 @@ class _StreamSession:
         self.inline_max = inline_max
         self.outstanding: "deque" = deque()
         self.index = 0
+        # tracing (docs/observability.md): the session is constructed
+        # inside the task's execution context — capture it here because
+        # the async-actor variant's send() runs on an executor thread
+        # where the ContextVar is absent.  Sampled streams record the
+        # first N yields as instant spans and ride the context on each
+        # report RPC so the owner-side handler joins the trace.
+        self._trh = trh
+        self._trace_ctx = trh.current_context()
+        # _traced gates context propagation on EVERY report RPC;
+        # _span_items only caps the per-yield marker spans — an
+        # operator zeroing the marker knob must not silently cut the
+        # owner side out of the trace
+        self._traced = trh.ctx_sampled(self._trace_ctx)
+        self._span_items = (CONFIG.trace_stream_span_items
+                            if self._traced else 0)
 
     def send(self, value) -> None:
         self._wait_for_credit()
@@ -129,6 +145,8 @@ class _StreamSession:
             self.core.store_put(oid, head, views)
             payload["location"] = self.core.node_id
             payload["size"] = size
+        if self._traced:
+            payload["_trace_ctx"] = self._trace_ctx
         try:
             fut = self.conn.call_async("report_generator_item", payload)
         except (ConnectionError, OSError):
@@ -142,6 +160,12 @@ class _StreamSession:
                 self.task_id.hex(), "STREAM_ITEM",
                 name=self.spec.get("name", ""), index=self.index,
                 **({"trace_id": tc["trace_id"]} if tc else {}))
+        if self.index < self._span_items:
+            # per-yield marker span in the sampled trace: the pacing
+            # shape of the stream's head, without a span per token
+            self._trh.instant_span(
+                f"yield[{self.index}]", "stream_item",
+                ctx=self._trace_ctx, index=self.index, bytes=size)
         self.outstanding.append(fut)
         self.index += 1
 
@@ -707,6 +731,7 @@ class WorkerProcess:
         return tuple(resolved), rkw, borrowed
 
     def _execute(self, spec, resolved=None) -> dict:
+        from ray_tpu.util.tracing import tracing_helper as trh
         from ray_tpu.util.tracing.tracing_helper import \
             propagate_trace_context
         fn = self.core.load_function(spec["fn_key"])
@@ -720,11 +745,19 @@ class WorkerProcess:
         # GCS table — it lands in this worker's crash dossier instead)
         cev.emit(cev.TASK_RUNNING, spec.get("name", ""), ring_only=True,
                  task_id=TaskID(spec["task_id"]).hex())
+        # execution span (docs/observability.md): when the submitter's
+        # trace is sampled, this task's whole worker-side execution is
+        # one span, child of the submitting span
+        exec_span = trh.open_span(f"task:{spec.get('name', '')}", "task",
+                                  ctx=trace_ctx)
         # join the submitter's trace: user spans inside the task nest
-        # under the caller's span (auto span injection)
-        propagate_trace_context(trace_ctx)
+        # under the caller's span (auto span injection); nested
+        # submissions become children of the execution span
+        propagate_trace_context(exec_span.ctx() if exec_span is not None
+                                else trace_ctx)
         borrowed = []
         t_exec = None
+        err_type = None
         try:
             args, kwargs, borrowed = (resolved if resolved is not None
                                       else self._resolve_args(spec["args"]))
@@ -732,6 +765,7 @@ class WorkerProcess:
             result = fn(*args, **kwargs)
             return self._package_results(spec, result)
         except Exception as e:  # noqa: BLE001 - user errors cross the wire
+            err_type = type(e).__name__
             return self._package_error(spec, e)
         finally:
             # observed in the finally so the sample covers generator
@@ -740,6 +774,10 @@ class WorkerProcess:
             # failed executions alike
             if t_exec is not None:
                 _M_EXEC.observe_since(spec.get("name", ""), t_exec)
+            if exec_span is not None:
+                exec_span.end(trh.ERROR if err_type else trh.OK,
+                              error_type=err_type,
+                              task_id=TaskID(spec["task_id"]).hex())
             propagate_trace_context(None)
             self.core.release_borrowed(borrowed)
 
@@ -1014,12 +1052,15 @@ class WorkerProcess:
     def _begin_actor_call(self, spec):
         """Shared prologue of sync/async actor execution: liveness guard
         plus task bookkeeping (incl. joining the caller's trace).  Returns
-        an error reply to short-circuit with, or None to proceed."""
+        ``(error_reply_or_None, exec_span_or_None)`` — the error reply
+        short-circuits the call; the span (opened only for sampled
+        traces) is ended by the caller's finally."""
+        from ray_tpu.util.tracing import tracing_helper as trh
         from ray_tpu.util.tracing.tracing_helper import \
             propagate_trace_context
         if self.actor_instance is None:
             return self._package_error(
-                spec, exc.ActorDiedError("actor not initialized"))
+                spec, exc.ActorDiedError("actor not initialized")), None
         self.core.current_task_id = TaskID(spec["task_id"])
         trace_ctx = spec.get("trace_ctx")
         self.core.events.record(TaskID(spec["task_id"]).hex(), "RUNNING",
@@ -1030,8 +1071,11 @@ class WorkerProcess:
         cev.emit(cev.TASK_RUNNING, spec.get("method", ""), ring_only=True,
                  task_id=TaskID(spec["task_id"]).hex(),
                  actor_id=spec.get("actor_id"))
-        propagate_trace_context(trace_ctx)
-        return None
+        exec_span = trh.open_span(
+            f"task:{spec.get('method', '')}", "actor_task", ctx=trace_ctx)
+        propagate_trace_context(exec_span.ctx() if exec_span is not None
+                                else trace_ctx)
+        return None, exec_span
 
     async def _execute_actor_async(self, spec) -> dict:
         """Async-actor execution: coroutine methods await on the loop
@@ -1046,14 +1090,16 @@ class WorkerProcess:
         import asyncio
         import functools
 
+        from ray_tpu.util.tracing import tracing_helper as trh
         from ray_tpu.util.tracing.tracing_helper import \
             propagate_trace_context
-        err = self._begin_actor_call(spec)
+        err, exec_span = self._begin_actor_call(spec)
         if err is not None:
             return err
         loop = asyncio.get_running_loop()
         borrowed = []
         t_exec = None
+        err_type = None
         try:
             resolved = self._resolve_args_inline_ok(spec["args"])
             if resolved is None:
@@ -1090,12 +1136,17 @@ class WorkerProcess:
                 None, functools.partial(self._package_results, spec,
                                         result))
         except Exception as e:  # noqa: BLE001
+            err_type = type(e).__name__
             return self._package_error(spec, e)
         finally:
             # in the finally: covers async-generator streaming (the
             # iteration happens in _package_streaming_async) and errors
             if t_exec is not None:
                 _M_EXEC.observe_since(spec.get("method", ""), t_exec)
+            if exec_span is not None:
+                exec_span.end(trh.ERROR if err_type else trh.OK,
+                              error_type=err_type,
+                              task_id=TaskID(spec["task_id"]).hex())
             propagate_trace_context(None)
             self.core.release_borrowed(borrowed)
 
@@ -1131,13 +1182,15 @@ class WorkerProcess:
         return None
 
     def _execute_actor(self, spec) -> dict:
+        from ray_tpu.util.tracing import tracing_helper as trh
         from ray_tpu.util.tracing.tracing_helper import \
             propagate_trace_context
-        err = self._begin_actor_call(spec)
+        err, exec_span = self._begin_actor_call(spec)
         if err is not None:
             return err
         borrowed = []
         t_exec = None
+        err_type = None
         try:
             args, kwargs, borrowed = self._resolve_args(spec["args"])
             if spec["method"] == "__ray_terminate__":
@@ -1161,12 +1214,17 @@ class WorkerProcess:
             result = method(*args, **kwargs)
             return self._package_results(spec, result)
         except Exception as e:  # noqa: BLE001
+            err_type = type(e).__name__
             return self._package_error(spec, e)
         finally:
             # finally-observed: covers sync-generator streaming (driven
             # inside _package_results) and failed calls
             if t_exec is not None:
                 _M_EXEC.observe_since(spec.get("method", ""), t_exec)
+            if exec_span is not None:
+                exec_span.end(trh.ERROR if err_type else trh.OK,
+                              error_type=err_type,
+                              task_id=TaskID(spec["task_id"]).hex())
             propagate_trace_context(None)
             self.core.release_borrowed(borrowed)
 

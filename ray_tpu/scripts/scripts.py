@@ -405,6 +405,51 @@ def cmd_events(args) -> None:
     print(f"({len(rows)} events)", file=sys.stderr)
 
 
+def cmd_traces(args) -> None:
+    """`ray-tpu traces`: the trace directory as an operator table —
+    newest first, with the SLO verdict per request root;
+    `--slo-violations` narrows to requests that missed a target
+    (docs/observability.md request tracing plane)."""
+    _connect(args)
+    from ray_tpu.experimental import state
+    rows = state.list_traces(slo_violations=args.slo_violations,
+                             route=args.route, limit=args.limit)
+    print("%-18s %-8s %-22s %6s %9s %9s %-9s %s" % (
+        "TRACE", "TIME", "ROUTE", "SPANS", "TTFT(ms)", "TPOT(ms)",
+        "SLO", "STATUS"))
+    for r in rows:
+        slo = ("-" if r.get("slo_ok") is None else
+               ("ok" if r["slo_ok"] else
+                "VIOL:" + ",".join(r.get("slo_violated") or [])))
+        print("%-18s %-8s %-22s %6d %9s %9s %-9s %s" % (
+            r["trace_id"][:16] + "..",
+            time.strftime("%H:%M:%S", time.localtime(r.get("start") or 0)),
+            (r.get("route") or r.get("name") or "")[:22],
+            r.get("nspans", 0),
+            r.get("ttft_ms") if r.get("ttft_ms") is not None else "-",
+            r.get("tpot_ms") if r.get("tpot_ms") is not None else "-",
+            slo, r.get("status") or ""))
+    print(f"({len(rows)} traces)", file=sys.stderr)
+
+
+def cmd_trace(args) -> None:
+    """`ray-tpu trace <trace_id>`: one request's span tree — which hop
+    (queue wait, prefill, handoff pull, import wait, decode) ate the
+    budget.  `--perfetto FILE` exports the trace merged with the
+    cluster timeline's same-trace slices for ui.perfetto.dev."""
+    _connect(args)
+    from ray_tpu.experimental import state
+    trace = state.get_trace(args.trace_id)
+    if trace is None:
+        sys.exit(f"no trace matching {args.trace_id!r} "
+                 "(rotated out, unsampled, or not flushed yet)")
+    print(state.trace_tree_text(trace))
+    if args.perfetto:
+        events = state.trace_timeline(trace["trace_id"], args.perfetto)
+        print(f"wrote {len(events)} merged trace events to "
+              f"{args.perfetto} (open in ui.perfetto.dev)")
+
+
 def cmd_memory(args) -> None:
     _connect(args)
     from ray_tpu.experimental.state import memory_summary
@@ -803,6 +848,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("traces",
+                        help="list request traces (span table)")
+    sp.add_argument("--address")
+    sp.add_argument("--slo-violations", dest="slo_violations",
+                    action="store_true",
+                    help="only requests that missed a TTFT/TPOT target")
+    sp.add_argument("--route", help="route/deployment prefix filter")
+    sp.add_argument("--limit", type=int, default=50)
+    sp.set_defaults(fn=cmd_traces)
+
+    sp = sub.add_parser("trace",
+                        help="show one request trace's span tree")
+    sp.add_argument("trace_id", help="trace id (prefix ok)")
+    sp.add_argument("--address")
+    sp.add_argument("--perfetto", metavar="FILE",
+                    help="also export the trace merged with the "
+                         "timeline's same-trace slices")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("stack",
                         help="dump all session processes' thread stacks")

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.serve.controller import CONTROLLER_NAME, SERVE_NAMESPACE
+from ray_tpu.util.tracing import tracing_helper as trh
 
 _REFRESH_INTERVAL_S = 1.0
 
@@ -272,14 +273,88 @@ class DeploymentHandle:
             return out
 
     # ------------------------------------------------------------ user API
+    def _open_root(self):
+        """Driver-entry trace root (docs/observability.md): opened only
+        when no trace is already active (an http-proxy or disagg root
+        upstream owns the request), installed for the submit section so
+        the replica task joins it.  Returns (root, token, t0)."""
+        if trh.current_context() is not None:
+            return None, None, 0.0
+        root = trh.serve_ingress_root(
+            f"handle:{self.deployment_name}", route=self.deployment_name)
+        if root is None:
+            return None, None, 0.0
+        return root, trh.install(root.ctx()), time.perf_counter()
+
+    def _anchor_root(self, root, t0: float, out) -> None:
+        """Close the root (+ TTFT SLO accounting) when the request's
+        completion anchor resolves — no polling, the same ready
+        callback that drops the in-flight count.  A reply that resolved
+        to an error payload closes the root as a failure, not a
+        (possibly fast) SLO success."""
+        anchor = out.completed() if hasattr(out, "completed") else out
+        pool = self.deployment_name
+        worker = self._worker()
+
+        def _done():
+            if worker.result_is_error(anchor):
+                trh.finish_request(root, pool=pool, route=pool,
+                                   status=trh.ERROR,
+                                   error_type="TaskError")
+            else:
+                trh.finish_request(root, pool=pool, route=pool,
+                                   ttft_s=time.perf_counter() - t0)
+
+        worker.add_ready_callback(anchor, _done)
+
     def remote(self, *args, **kwargs):
-        return self._route("__call__", args, kwargs)
+        root, token, t0 = self._open_root()
+        try:
+            out = self._route("__call__", args, kwargs)
+        except Exception as e:
+            trh.finish_request(root, pool=self.deployment_name,
+                               status=trh.ERROR,
+                               error_type=type(e).__name__)
+            raise
+        finally:
+            if token is not None:
+                trh.uninstall(token)
+        if root is not None:
+            self._anchor_root(root, t0, out)
+        return out
 
     def remote_streaming(self, *args, **kwargs):
         """Route one request through the replica's streaming path:
         returns a StreamingObjectRefGenerator whose items arrive as the
         deployment's generator produces them (token streaming)."""
-        return self._route_streaming("__call__", args, kwargs)
+        root, token, t0 = self._open_root()
+        try:
+            out = self._route_streaming("__call__", args, kwargs)
+        except Exception as e:
+            trh.finish_request(root, pool=self.deployment_name,
+                               status=trh.ERROR,
+                               error_type=type(e).__name__)
+            raise
+        finally:
+            if token is not None:
+                trh.uninstall(token)
+        if root is not None:
+            # the anchor is stream COMPLETION: the root's dur is total
+            # stream latency; TTFT SLO accounting belongs to token-aware
+            # drivers (DisaggHandle / the llm stream consumers)
+            anchor = out.completed() if hasattr(out, "completed") else out
+            worker = self._worker()
+            pool = self.deployment_name
+
+            def _done():
+                failed = worker.result_is_error(anchor)
+                trh.finish_request(
+                    root, pool=pool, route=pool,
+                    status=trh.ERROR if failed else trh.OK,
+                    error_type="TaskError" if failed else None)
+
+            worker.add_ready_callback(anchor, _done)
+        return out
 
     def try_remote(self, *args, **kwargs):
         """One-shot non-blocking route: submit to a replica with spare
@@ -296,14 +371,25 @@ class DeploymentHandle:
             if replica is None:
                 return None
             self._inflight[replica] = self._inflight.get(replica, 0) + 1
+        root, token, t0 = self._open_root()
         try:
             actor = self._actor_for(replica)
             ref = actor.handle_request.remote("__call__", args, kwargs)
-        except Exception:
+        except Exception as e:
+            # close the root like remote() does — an abandoned root
+            # would drop the failed request from SLO accounting
+            trh.finish_request(root, pool=self.deployment_name,
+                               status=trh.ERROR,
+                               error_type=type(e).__name__)
             self._release(replica)
             return None
+        finally:
+            if token is not None:
+                trh.uninstall(token)
         self._worker().add_ready_callback(
             ref, lambda r=replica: self._release(r))
+        if root is not None:
+            self._anchor_root(root, t0, ref)
         return ref
 
     def _worker(self):
@@ -378,25 +464,72 @@ class DisaggHandle:
     async def stream(self, request: Dict[str, Any]):
         """Async generator: ``{"token": id}`` per token (first token
         from the prefill pool, the rest from the decode pool), optional
-        ``{"retry": n}`` markers, then a summary dict."""
+        ``{"retry": n}`` markers, then a summary dict.
+
+        Tracing (docs/observability.md): the whole request is one trace
+        — an ingress root here, ``prefill`` / ``decode`` hop spans per
+        attempt, the replica-side execution / handoff-pull / import-wait
+        spans as their children — closed with TTFT/TPOT SLO accounting.
+        A request that dies mid-flight closes its root with the failure
+        and the crash ``dossier_id`` when the error carries one, so the
+        trace and the flight recorder cross-link."""
+        root = trh.serve_ingress_root(
+            f"disagg:{self.decode.deployment_name}",
+            route=self.decode.deployment_name)
+        t0 = time.perf_counter()
+        first_tok = last_tok = None
         emitted = 0                 # tokens already yielded to the client
         retries = 0
-        while True:
-            try:
-                async for kind, val in self._once(request, emitted):
-                    if kind == "token":
-                        emitted += 1
-                        yield {"token": val}
-                    else:
-                        yield val
-                return
-            except Exception as e:
-                if _is_pool_full(e) or retries >= self.max_retries:
-                    raise
-                retries += 1
-                yield {"retry": retries, "error": type(e).__name__}
+        failure: Optional[BaseException] = None
+        try:
+            while True:
+                try:
+                    async for kind, val in self._once(request, emitted,
+                                                      root):
+                        if kind == "token":
+                            now = time.perf_counter()
+                            if first_tok is None:
+                                first_tok = now
+                            last_tok = now
+                            emitted += 1
+                            yield {"token": val}
+                        else:
+                            yield val
+                    return
+                except Exception as e:
+                    if _is_pool_full(e) or retries >= self.max_retries:
+                        raise
+                    retries += 1
+                    yield {"retry": retries, "error": type(e).__name__}
+        except BaseException as e:
+            failure = e
+            raise
+        finally:
+            if root is not None:
+                tpot_s = None
+                if emitted > 1 and first_tok is not None:
+                    tpot_s = (last_tok - first_tok) / (emitted - 1)
+                if failure is None:
+                    status = trh.OK
+                elif isinstance(failure, (GeneratorExit,
+                                          asyncio.CancelledError)):
+                    # the CLIENT walked away mid-stream: not a service
+                    # failure — excluded from both SLO counters
+                    status = trh.CANCELLED
+                else:
+                    status = trh.ERROR
+                trh.finish_request(
+                    root, pool="disagg",
+                    route=self.decode.deployment_name,
+                    status=status,
+                    ttft_s=(first_tok - t0)
+                    if first_tok is not None else None,
+                    tpot_s=tpot_s, num_tokens=emitted,
+                    error_type=(type(failure).__name__
+                                if failure is not None else None),
+                    dossier_id=getattr(failure, "dossier_id", None))
 
-    async def _once(self, request: Dict[str, Any], skip: int):
+    async def _once(self, request: Dict[str, Any], skip: int, root=None):
         """One prefill->decode attempt, yielding ("token", id) /
         ("summary", dict).  The first ``skip`` stream positions (tokens
         the client already holds from an earlier attempt) are consumed
@@ -404,20 +537,33 @@ class DisaggHandle:
         restart it."""
         worker = self.prefill._worker()
         loop = asyncio.get_running_loop()
+        rctx = root.ctx() if root is not None else None
+        # client-observed prefill hop: routing + queue wait + replica
+        # prefill + reply; the replica-side task:prefill span nests
+        # under it, so queue wait is the visible gap between the two
+        sp_pref = trh.open_span("prefill", "hop", ctx=rctx)
+        pctx = sp_pref.ctx() if sp_pref is not None else rctx
         # routing runs in an executor: _route_impl may block (capacity
         # waits, cold-table controller RPC) and this coroutine shares
-        # its loop with every other stream (the http_proxy precedent)
+        # its loop with every other stream (the http_proxy precedent);
+        # bind_ctx carries the trace across the executor hop
         pref_ref = await loop.run_in_executor(
-            None, lambda: self.prefill.prefill.remote(request))
+            None, trh.bind_ctx(
+                pctx, lambda: self.prefill.prefill.remote(request)))
         try:
             pref = await _aget(worker, pref_ref)
-        except Exception:
+        except Exception as e:
             # the prefill replica died with our call on it: suspect-list
             # it so the outer retry routes around the corpse
+            if sp_pref is not None:
+                sp_pref.end(trh.ERROR, error_type=type(e).__name__)
             name = self.prefill.replica_of(pref_ref)
             if name:
                 self.prefill.mark_suspect(name)
             raise
+        if sp_pref is not None:
+            sp_pref.end(prompt_len=pref.get("prompt_len"),
+                        npages=pref.get("npages"))
         pos = 1                 # stream position incl. the first token
         if pos > skip:
             yield ("token", pref["first_token"])
@@ -431,10 +577,15 @@ class DisaggHandle:
         deadline = time.monotonic() + self.pool_full_timeout_s
         backoff = 0.05
         while True:
+            # one decode hop span per routed attempt (a pool-full
+            # re-queue is a fresh attempt, possibly another replica)
+            sp_dec = trh.open_span("decode", "hop", ctx=rctx)
+            dctx = sp_dec.ctx() if sp_dec is not None else rctx
             gen = await loop.run_in_executor(
-                None, lambda: self.decode._route_streaming(
-                    "decode", (pref["handoff"], request), {},
-                    prefer_node=pref.get("node")))
+                None, trh.bind_ctx(
+                    dctx, lambda: self.decode._route_streaming(
+                        "decode", (pref["handoff"], request), {},
+                        prefer_node=pref.get("node"))))
             try:
                 async for item_ref in gen:
                     item = await _aget(worker, item_ref, timeout=60.0)
@@ -447,8 +598,12 @@ class DisaggHandle:
                             "time_to_first_token_s",
                             pref["time_to_first_token_s"])
                         yield ("summary", item)
+                if sp_dec is not None:
+                    sp_dec.end(num_tokens=pos)
                 return
             except Exception as e:
+                if sp_dec is not None:
+                    sp_dec.end(trh.ERROR, error_type=type(e).__name__)
                 if not _is_pool_full(e):
                     # a death surfaced mid-stream: the submit succeeded,
                     # so the routing loop never saw it — suspect-list

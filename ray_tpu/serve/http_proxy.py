@@ -47,6 +47,7 @@ class HTTPProxyActor:
         from aiohttp import web
 
         from ray_tpu.runtime.core_worker import get_global_worker
+        from ray_tpu.util.tracing import tracing_helper as trh
 
         # per-request closures touch only locals: worker/handle lookups,
         # monotonic, and the json codec are bound once (the proxy's whole
@@ -57,6 +58,9 @@ class HTTPProxyActor:
         add_ready = worker.add_ready_callback
         ray_get = ray_tpu.get
         GetTimeout = ray_tpu.exceptions.GetTimeoutError
+        ingress_root = trh.serve_ingress_root
+        install_ctx = trh.install
+        finish_request = trh.finish_request
 
         async def handle(request: web.Request) -> web.Response:
             deployment = request.match_info["deployment"]
@@ -69,6 +73,16 @@ class HTTPProxyActor:
                 payload = dict(request.query)
             loop = asyncio.get_running_loop()
 
+            # request trace root (docs/observability.md): every HTTP
+            # request gets a root context (SLO accounting classifies all
+            # of them; span recording follows the deterministic
+            # sampler).  Installed on THIS coroutine's context only —
+            # concurrent requests interleave with their own identities.
+            root = ingress_root(f"http:{deployment}", route=deployment)
+            if root is not None:
+                install_ctx(root.ctx())
+            t_req = monotonic()
+
             # Fast path stays ON the event loop end to end: non-blocking
             # submit (try_remote), readiness via an owned-object ready
             # callback, and an immediate local get once ready.  Executor
@@ -80,8 +94,13 @@ class HTTPProxyActor:
                 h = get_handle(deployment)
                 ref = h.try_remote(payload)
                 if ref is None:        # cold table / backpressure
+                    # bind_ctx: the executor thread must carry this
+                    # request's context, or the handle would open a
+                    # second root for the same request
                     ref = await loop.run_in_executor(
-                        None, h.remote, payload)
+                        None, trh.bind_ctx(
+                            root.ctx() if root is not None else None,
+                            h.remote, payload))
                 fut = loop.create_future()
 
                 def _on_ready():
@@ -108,9 +127,17 @@ class HTTPProxyActor:
                     result = await loop.run_in_executor(
                         None, lambda: ray_get(ref, timeout=remaining))
             except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500
+                finish_request(root, pool="http", route=deployment,
+                               status=trh.ERROR,
+                               ttft_s=monotonic() - t_req,
+                               error_type=type(e).__name__,
+                               dossier_id=getattr(e, "dossier_id", None))
                 return web.json_response(
                     {"error": type(e).__name__, "message": str(e)},
                     status=500)
+            # non-streaming HTTP: the whole request latency IS its TTFT
+            finish_request(root, pool="http", route=deployment,
+                           ttft_s=monotonic() - t_req)
             try:
                 return web.json_response(result)
             except TypeError:

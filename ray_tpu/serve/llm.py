@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from ray_tpu._private import runtime_metrics as rtm
 from ray_tpu.serve.deployment import deployment
+from ray_tpu.util.tracing import tracing_helper as trh
 
 # Disaggregated-serving telemetry (docs/serve_disagg.md): per-pool
 # latency families ("prefill"/"decode" pool labels; "colocated" for a
@@ -226,6 +227,11 @@ class LLMServer:
         t1 = time.monotonic()
         ref = ray_tpu.put(h)
         put_ms = (time.monotonic() - t1) * 1e3
+        # handoff-export hop in the request's trace (the actor-call
+        # execution span is the parent): gather+fetch+publish cost
+        trh.instant_span("handoff_export", "handoff",
+                         dur_ms=h.export_ms + put_ms,
+                         bytes=h.nbytes, npages=h.npages)
         # the ref pin keeps the object alive (we own it) until the
         # decode pool pulled a copy; expired pins sweep FIFO (also from
         # autoscale_load so an idle replica doesn't retain its last
@@ -267,31 +273,50 @@ class LLMServer:
         pull_ms = 0.0
         if not isinstance(handoff, PrefillHandoff):
             # an ObjectRef: fetch via the pull engine (multi-source
-            # striped, zero-copy landing), off the replica's event loop
+            # striped, zero-copy landing), off the replica's event loop.
+            # The handoff-pull hop span wraps the whole fetch; bind_ctx
+            # carries the request's trace onto the executor thread so
+            # the transfer engine's own pull span nests under it.
+            sp_pull = trh.open_span("handoff_pull", "hop")
             t0 = time.monotonic()
             loop = asyncio.get_running_loop()
             ref = handoff
             handoff = await loop.run_in_executor(
-                None, lambda: ray_tpu.get(ref, timeout=60.0))
+                None, trh.bind_ctx(
+                    sp_pull.ctx() if sp_pull is not None else None,
+                    lambda: ray_tpu.get(ref, timeout=60.0)))
             pull_ms = (time.monotonic() - t0) * 1e3
+            if sp_pull is not None:
+                sp_pull.end(bytes=handoff.nbytes, npages=handoff.npages)
             _M_HANDOFF_BYTES.observe("import", handoff.nbytes)
             _M_HANDOFF_MS.observe("import_pull", pull_ms)
             _record_handoff_event("import", ref.id.hex(),
                                   handoff.nbytes, pull_ms,
                                   npages=handoff.npages)
+        # import-wait hop: admission into a decode slot (page-table
+        # remap, plus any pool-full backoff) — the "import wait" budget
+        # line of a traced request
+        sp_admit = trh.open_span("import_wait", "hop")
         deadline = time.monotonic() + self.import_retry_s
         backoff = 0.02
         while True:
             agen = self.engine.stream_import(handoff)
             try:
                 first = await agen.__anext__()
+                if sp_admit is not None:
+                    sp_admit.end(npages=handoff.npages)
                 break
             except KVPoolFullError:
                 if time.monotonic() >= deadline:
+                    if sp_admit is not None:
+                        sp_admit.end(trh.ERROR,
+                                     error_type="KVPoolFullError")
                     raise
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 0.5)
             except StopAsyncIteration:
+                if sp_admit is not None:
+                    sp_admit.end()
                 return
         # TPOT clock starts at admission, AFTER any pool-full wait:
         # queue time must not masquerade as inter-token latency

@@ -14,6 +14,7 @@ import time
 from typing import Any, Dict
 
 from ray_tpu._private import runtime_metrics as rtm
+from ray_tpu.util.tracing import tracing_helper as trh
 
 _M_REQ = rtm.histogram_family(
     "ray_tpu_serve_request_ms",
@@ -83,19 +84,41 @@ class ReplicaActor:
         self._num_ongoing += 1
         _M_ONGOING.set(self._num_ongoing)
         _t0 = rtm.now()
+        # replica span (docs/observability.md): the actor-call exec span
+        # is named after the wrapper (task:handle_request); this one
+        # names the ROUTED user method, and its context carries into the
+        # user code — so the serve hop reads "<deployment>.<method>" in
+        # a trace, and spans the target opens (handoff pull, import
+        # wait) nest under it
+        sspan = trh.open_span(
+            f"serve:{self.deployment_name}.{method_name or '__call__'}",
+            "serve")
+        token = trh.install(sspan.ctx()) if sspan is not None else None
         try:
             target, is_async = self._resolve_target(method_name)
             if is_async:
                 result = await target(*args, **kwargs)
             else:
                 loop = asyncio.get_running_loop()
-                result = await loop.run_in_executor(
-                    self._sync_pool,
-                    functools.partial(target, *args, **kwargs))
+                call = functools.partial(target, *args, **kwargs)
+                if sspan is not None:
+                    # run_in_executor drops ContextVars; re-bind so the
+                    # user code's spans keep the request's trace
+                    call = trh.bind_ctx(sspan.ctx(), call)
+                result = await loop.run_in_executor(self._sync_pool,
+                                                    call)
                 if inspect.isawaitable(result):  # e.g. @serve.batch future
                     result = await result
+            if sspan is not None:
+                sspan.end()
             return result
+        except BaseException as e:
+            if sspan is not None:
+                sspan.end(trh.ERROR, error_type=type(e).__name__)
+            raise
         finally:
+            if token is not None:
+                trh.uninstall(token)
             self._num_ongoing -= 1
             self._num_processed += 1
             _M_ONGOING.set(self._num_ongoing)
@@ -112,6 +135,13 @@ class ReplicaActor:
         self._num_ongoing += 1
         _M_ONGOING.set(self._num_ongoing)
         _t0 = rtm.now()
+        # replica span covering the whole stream (first call -> last
+        # yield); the user generator's own spans nest under it
+        sspan = trh.open_span(
+            f"serve:{self.deployment_name}.{method_name or '__call__'}",
+            "serve")
+        token = trh.install(sspan.ctx()) if sspan is not None else None
+        nitems = 0
         try:
             target, _ = self._resolve_target(method_name)
             result = target(*args, **kwargs)
@@ -119,6 +149,7 @@ class ReplicaActor:
                 result = await result
             if hasattr(result, "__aiter__"):
                 async for item in result:
+                    nitems += 1
                     yield item
             else:
                 # sync generator: pull each (possibly blocking) step on
@@ -133,8 +164,18 @@ class ReplicaActor:
                         self._sync_pool, next, it, sentinel)
                     if item is sentinel:
                         break
+                    nitems += 1
                     yield item
+            if sspan is not None:
+                sspan.end(num_items=nitems)
+        except BaseException as e:
+            if sspan is not None:
+                sspan.end(trh.ERROR, error_type=type(e).__name__,
+                          num_items=nitems)
+            raise
         finally:
+            if token is not None:
+                trh.uninstall(token)
             self._num_ongoing -= 1
             self._num_processed += 1
             _M_ONGOING.set(self._num_ongoing)

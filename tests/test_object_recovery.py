@@ -15,6 +15,18 @@ def _worker():
     from ray_tpu.runtime.core_worker import get_global_worker
     return get_global_worker()
 
+
+@pytest.fixture(autouse=True)
+def _shutdown_after_test():
+    """Tests here call ray_tpu.shutdown() at the end of their own
+    bodies — so one test failing mid-body used to leave its cluster
+    live, and every later test in the file inited on top of it and
+    failed on unrelated asserts (the PR 5..11 A/B pollution: one real
+    failure cascaded into three).  shutdown() is idempotent; always
+    run it."""
+    yield
+    ray_tpu.shutdown()
+
 # every shm object in these tests is > inline_object_max_bytes (100 KiB)
 BIG = 256 * 1024 // 8  # float64 elements -> 2 MiB... keep sizes explicit
 
@@ -309,11 +321,31 @@ def test_spilled_chunk_served_despite_unsealed_local_create():
                 break
         time.sleep(0.1)
     assert spilled is not None, "nothing spilled"
-    # stage the race: the pull engine has allocated (not yet sealed) the
-    # destination for this object in the node's shared store
     with w._owned_lock:
         size = w._owned[spilled.id].size
-    buf = w.store.create(spilled.id, size, allow_evict=False)
+    # Wait for the raylet's hysteresis spill scan to settle the store:
+    # right after the puts, each put's request_spill freed only its own
+    # slack, so usage sits at ~capacity and the unsealed 8 MiB create
+    # below would fail with ObjectStoreFullError before the race is
+    # even staged (the scan drains to 90% of the threshold within a few
+    # 200 ms ticks).
+    deadline = time.monotonic() + 30
+    buf = None
+    while buf is None:
+        st = w.store.stats()
+        if st["bytes_in_use"] + size <= st["capacity"]:
+            try:
+                # stage the race: the pull engine has allocated (not yet
+                # sealed) the destination for this object in shared store
+                buf = w.store.create(spilled.id, size, allow_evict=False)
+                break
+            except ray_tpu.exceptions.ObjectStoreFullError:
+                pass  # fragmented free space: let the scan spill more
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"store never settled below capacity for an {size}-byte "
+                f"unsealed create: {w.store.stats()}")
+        time.sleep(0.2)
     try:
         conn = rpc.connect(tuple(w.raylet_addr), timeout=5)
         try:

@@ -1,0 +1,577 @@
+"""Distributed request tracing plane (docs/observability.md).
+
+Covers the tentpole's load-bearing claims: deterministic sampling (the
+same trace id reaches the same verdict in every process), cross-process
+context propagation (driver -> task -> nested task, async-actor
+interleaving on one event loop, streaming per-yield spans), span-table
+retention bounds, the serve SLO accounting + exemplar path, the
+trace <-> crash-dossier cross-link, the kill switch, and the
+end-to-end disaggregated-serve trace whose hop spans decompose TTFT.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import CONFIG
+from ray_tpu.util.tracing import tracing_helper as trh
+
+
+def _worker():
+    from ray_tpu.runtime.core_worker import get_global_worker
+    return get_global_worker()
+
+
+def _wait_for(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def full_sampling(monkeypatch):
+    """Force sample rate 1.0 so every trace records (propagation tests
+    must not depend on a lucky draw).  Worker-side recording trusts the
+    propagated ``sampled`` flag, so only the driver needs the rate."""
+    monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE_RATE", "1.0")
+    CONFIG.set("trace_sample_rate", 1.0)
+    yield
+    CONFIG.set("trace_sample_rate", 0.1)
+
+
+def _flush_traces():
+    trh.flush_now()
+
+
+def _get_trace(w, trace_id, nspans=1, timeout=30.0):
+    """Poll the GCS span table until the trace holds >= nspans spans
+    (worker-side flushers tick at trace_flush_interval_ms)."""
+    def _go():
+        _flush_traces()
+        t = w.gcs.call("get_trace", {"trace_id": trace_id})
+        if t and len(t.get("spans") or []) >= nspans:
+            return t
+        time.sleep(0.3)
+        return None
+    return _wait_for(_go, timeout=timeout,
+                     msg=f"trace {trace_id[:8]} with {nspans} spans")
+
+
+# ------------------------------------------------------------ sampler unit
+def test_sampler_deterministic_across_processes():
+    """The sampling verdict is a pure function of the trace id: every
+    process derives the same answer with no coordination."""
+    CONFIG.set("trace_sample_rate", 0.5)
+    try:
+        ids = [trh.new_trace_id() for _ in range(64)]
+        local = [trh.sampled(t) for t in ids]
+        # decisions split (rate 0.5 over 64 draws: both outcomes present
+        # with probability 1 - 2^-63)
+        assert any(local) and not all(local)
+        # same ids, fresh interpreter, same verdicts
+        code = (
+            "import json,sys\n"
+            "from ray_tpu._private.config import CONFIG\n"
+            "CONFIG.set('trace_sample_rate', 0.5)\n"
+            "from ray_tpu.util.tracing import tracing_helper as trh\n"
+            "ids = json.loads(sys.argv[1])\n"
+            "print(json.dumps([trh.sampled(t) for t in ids]))\n")
+        import json
+        out = subprocess.run(
+            [sys.executable, "-c", code, json.dumps(ids)],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert out.returncode == 0, out.stderr[-800:]
+        assert json.loads(out.stdout.strip().splitlines()[-1]) == local
+        # a root minted by the submission sampler always re-derives True
+        CONFIG.set("trace_sample_rate", 0.25)
+        for _ in range(32):
+            ctx = trh.maybe_sample_root()
+            if ctx is not None:
+                assert trh.sampled(ctx["trace_id"])
+    finally:
+        CONFIG.set("trace_sample_rate", 0.1)
+
+
+def test_ids_distinct_across_fork():
+    """Workers fork from a warm zygote: the id generator must reseed in
+    the child or two workers mint identical trace/span ids and merge
+    unrelated requests into one trace."""
+    if not hasattr(os, "fork"):
+        pytest.skip("no fork on this platform")
+    # draw once so the parent's generator state is warm pre-fork
+    trh.new_trace_id()
+    r, w = os.pipe()
+    pid = os.fork()
+    if pid == 0:  # child
+        try:
+            os.write(w, trh.new_trace_id().encode())
+        finally:
+            os._exit(0)
+    os.close(w)
+    child_id = b""
+    while True:
+        chunk = os.read(r, 64)
+        if not chunk:
+            break
+        child_id += chunk
+    os.close(r)
+    os.waitpid(pid, 0)
+    parent_id = trh.new_trace_id()
+    assert len(child_id) == 32
+    assert child_id.decode() != parent_id
+
+
+def test_sampler_rate_bounds():
+    CONFIG.set("trace_sample_rate", 0.0)
+    try:
+        assert all(trh.maybe_sample_root() is None for _ in range(64))
+        assert not trh.sampled(trh.new_trace_id())
+    finally:
+        CONFIG.set("trace_sample_rate", 1.0)
+    try:
+        ctx = trh.maybe_sample_root()
+        assert ctx is not None and ctx["sampled"]
+    finally:
+        CONFIG.set("trace_sample_rate", 0.1)
+
+
+# -------------------------------------------------------- span table unit
+def test_span_table_retention_bounds():
+    """Count, byte and per-trace-span bounds all rotate oldest-first."""
+    t = trh.GcsSpanTable(max_traces=16, max_bytes=64 * 1024)
+    t.max_spans = 8
+
+    def span(tid, i):
+        return {"trace_id": tid, "span_id": f"s{i:04d}", "name": "x" * 50,
+                "kind": "task", "start": time.time(), "dur_ms": 1.0,
+                "status": "ok"}
+
+    # trace-count bound (sharded: per-shard cap = max_traces/8 = 2)
+    tids = [trh.new_trace_id() for _ in range(64)]
+    for tid in tids:
+        t.put([span(tid, 0)])
+    stats = t.stats()
+    assert stats["traces"] <= 16
+    assert stats["traces_seen"] == 64
+    assert stats["dropped_traces"] >= 48
+    # per-trace span cap: first/last halves survive
+    tid = trh.new_trace_id()
+    t.put([span(tid, i) for i in range(40)])
+    rec = t.get(tid)
+    assert rec["truncated"] and len(rec["spans"]) == 8
+    kept = {s["span_id"] for s in rec["spans"]}
+    assert "s0000" in kept and "s0039" in kept
+    # byte budget: a flood of fat spans cannot grow the table unbounded
+    t2 = trh.GcsSpanTable(max_traces=10_000, max_bytes=32 * 1024)
+    for i in range(200):
+        tid = trh.new_trace_id()
+        t2.put([dict(span(tid, 0), name="y" * 400)])
+    assert t2.stats()["bytes"] <= 32 * 1024
+
+
+def test_span_table_slo_index_and_exemplars():
+    t = trh.GcsSpanTable(max_traces=64, max_bytes=1 << 20)
+    for i in range(8):
+        tid = trh.new_trace_id()
+        t.put([{"trace_id": tid, "span_id": f"r{i}", "name": "req",
+                "kind": "ingress", "start": time.time(), "dur_ms": 5.0,
+                "status": "ok", "root": True, "route": "llm-a",
+                "ttft_ms": 100.0 * (i + 1), "slo_ok": i < 6,
+                "slo_violated": [] if i < 6 else ["ttft"]}])
+    rows = t.list(slo_violations=True)
+    assert len(rows) == 2
+    stats = t.stats()["slo_by_route"]["llm-a"]
+    assert stats == {
+        "good": 6, "violation": 2,
+        "exemplars": stats["exemplars"]}
+    # exemplars are the worst TTFTs, descending
+    ttfts = [e["ttft_ms"] for e in stats["exemplars"]]
+    assert ttfts == sorted(ttfts, reverse=True)
+    assert ttfts[0] == 800.0
+
+
+# ------------------------------------------------------------- kill switch
+def test_kill_switch_noop_path(monkeypatch):
+    """RAY_TPU_TRACING=0: roots/samplers return None, configure refuses
+    a buffer, record_span drops — one cached flag read per call."""
+    monkeypatch.setenv("RAY_TPU_TRACING", "0")
+    CONFIG.set("tracing_enabled", True)  # bump gen -> re-read env
+    try:
+        assert not trh.enabled()
+        assert trh.serve_ingress_root("x") is None
+        assert trh.maybe_sample_root() is None
+        assert trh.configure(lambda spans: None) is None
+        # finish_request on a None root is a no-op
+        trh.finish_request(None, pool="p", ttft_s=1.0)
+        # user span() keeps its task-event contract but records nothing
+        with trh.span("off-span"):
+            assert trh.get_trace_context().get("trace_id")
+    finally:
+        monkeypatch.delenv("RAY_TPU_TRACING")
+        CONFIG.set("tracing_enabled", True)
+
+
+# ------------------------------------------------- cross-process propagation
+def test_cross_process_propagation_nested(ray_start_regular,
+                                          full_sampling):
+    """driver -> task -> nested task: one trace, parent/child linked
+    through two process hops."""
+    w = _worker()
+
+    @ray_tpu.remote
+    def inner():
+        return 1
+
+    @ray_tpu.remote
+    def outer():
+        import ray_tpu
+        return ray_tpu.get(inner.remote())
+
+    root = trh.serve_ingress_root("req", route="test")
+    token = trh.install(root.ctx())
+    try:
+        assert ray_tpu.get(outer.remote(), timeout=120) == 1
+    finally:
+        trh.uninstall(token)
+    trh.finish_request(root, pool="test", ttft_s=0.001)
+    trace = _get_trace(w, root.trace_id, nspans=3)
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert by_name["task:outer"]["parent_id"] == root.span_id
+    assert by_name["task:inner"]["parent_id"] == \
+        by_name["task:outer"]["span_id"]
+    # execution spans are stamped with the executing process, not ours
+    assert by_name["task:outer"]["worker_id"] != w.worker_id.hex()
+    assert trace["root"]["route"] == "test"
+
+
+def test_async_actor_interleaved_contexts(ray_start_regular,
+                                          full_sampling):
+    """Two concurrent calls on ONE async actor, each under its own
+    trace: the ContextVar keeps the identities apart while both
+    coroutines interleave on the actor's single event loop."""
+    w = _worker()
+
+    @ray_tpu.remote
+    class A:
+        async def slow(self, ms):
+            import asyncio
+            from ray_tpu.util.tracing.tracing_helper import \
+                get_trace_context
+            before = get_trace_context().get("trace_id")
+            await asyncio.sleep(ms / 1000.0)
+            after = get_trace_context().get("trace_id")
+            return before, after
+
+    a = A.remote()
+    ray_tpu.get(a.slow.remote(0), timeout=120)  # actor up
+
+    roots = [trh.serve_ingress_root(f"req{i}") for i in range(2)]
+    refs = []
+    for i, root in enumerate(roots):
+        token = trh.install(root.ctx())
+        try:
+            # both in flight together: 300ms + 150ms overlap on the loop
+            refs.append(a.slow.remote(300 if i == 0 else 150))
+        finally:
+            trh.uninstall(token)
+    outs = ray_tpu.get(refs, timeout=120)
+    for root, (before, after) in zip(roots, outs):
+        # each call saw ITS OWN trace id, before and after the await
+        # that interleaved it with the other call
+        assert before == root.trace_id, (before, root.trace_id)
+        assert after == root.trace_id, (after, root.trace_id)
+
+
+def test_streaming_per_yield_spans(ray_start_regular, full_sampling):
+    """A sampled streaming task records per-yield marker spans (capped
+    at trace_stream_span_items) inside the task's trace."""
+    w = _worker()
+
+    @ray_tpu.remote
+    def gen():
+        for i in range(40):
+            yield i
+
+    with trh.span("stream-driver"):
+        tid = trh.get_trace_context()["trace_id"]
+        out = [ray_tpu.get(r, timeout=60) for r in
+               gen.options(num_returns="streaming").remote()]
+    assert out == list(range(40))
+    cap = CONFIG.trace_stream_span_items
+    trace = _get_trace(w, tid, nspans=cap + 1)
+    yields = sorted((s for s in trace["spans"]
+                     if s["kind"] == "stream_item"),
+                    key=lambda s: s.get("index", -1))
+    assert len(yields) == cap  # capped, not one span per token
+    assert [s["index"] for s in yields] == list(range(cap))
+    # children of the executing task's span
+    task_span = next(s for s in trace["spans"] if s["kind"] == "task")
+    assert all(y["parent_id"] == task_span["span_id"] for y in yields)
+
+
+def test_transfer_pull_span(ray_start_cluster, full_sampling):
+    """A cross-node object fetch inside a sampled trace lands as a
+    ``pull`` span (the transfer-plane hop of the trace)."""
+    import numpy as np
+
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 2, "producer": 2})
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(num_cpus=1, address=cluster.address)
+    try:
+        w = _worker()
+
+        @ray_tpu.remote(resources={"producer": 1}, num_cpus=1)
+        def produce():
+            import numpy as np
+            return np.arange(2_000_000, dtype=np.float64)  # 16 MiB
+
+        ref = produce.remote()
+        with trh.span("pull-driver"):
+            tid = trh.get_trace_context()["trace_id"]
+            value = ray_tpu.get(ref, timeout=120)
+        assert float(value[-1]) == 1_999_999.0
+        trace = _get_trace(w, tid, nspans=2)
+        pulls = [s for s in trace["spans"] if s["kind"] == "pull"]
+        assert pulls, [s["name"] for s in trace["spans"]]
+        assert pulls[0]["attrs"]["bytes"] > 15_000_000
+    finally:
+        ray_tpu.shutdown()
+
+
+# --------------------------------------------------- dossier cross-link
+def test_trace_dossier_cross_link(ray_start_regular, full_sampling):
+    """A root span closed with a dossier_id links both ways: the trace
+    record carries the dossier id, the dossier gains the trace id."""
+    w = _worker()
+    w.gcs.call("put_dossier", {
+        "dossier_id": "deadbeef00112233",
+        "dossier": {"kind": "worker", "reason": "test-crash"}})
+    root = trh.serve_ingress_root("doomed", route="llm-x")
+    trh.finish_request(root, pool="decode", route="llm-x",
+                       status=trh.ERROR, ttft_s=None,
+                       error_type="ActorDiedError",
+                       dossier_id="deadbeef00112233")
+    trace = _get_trace(w, root.trace_id, nspans=1)
+    assert trace["root"]["dossier_id"] == "deadbeef00112233"
+    d = _wait_for(lambda: w.gcs.call(
+        "get_dossier", {"dossier_id": "deadbeef"}),
+        msg="dossier")
+    assert d["trace_id"] == root.trace_id
+    # and the violation listing carries the exemplar id
+    rows = w.gcs.call("list_traces", {"status": "error"})
+    assert any(r["trace_id"] == root.trace_id
+               and r["dossier_id"] == "deadbeef00112233" for r in rows)
+
+
+def test_death_mid_request_links_dossier(ray_start_regular,
+                                         full_sampling):
+    """An actor dying under a traced request closes the root with the
+    failure and the crash dossier id the error carried."""
+    w = _worker()
+
+    @ray_tpu.remote(max_restarts=0)
+    class Doomed:
+        def boom(self):
+            import os
+            os._exit(1)
+
+    a = Doomed.remote()
+    root = trh.serve_ingress_root("dying-request", route="doomed")
+    token = trh.install(root.ctx())
+    try:
+        # the exact surface depends on timing: ActorDiedError once the
+        # GCS verdict lands, ActorUnavailableError when the conn breaks
+        # with the call in flight — both carry the dossier ref
+        with pytest.raises((ray_tpu.exceptions.ActorDiedError,
+                            ray_tpu.exceptions.ActorUnavailableError)
+                           ) as ei:
+            ray_tpu.get(a.boom.remote(), timeout=120)
+    finally:
+        trh.uninstall(token)
+    did = getattr(ei.value, "dossier_id", None)
+    trh.finish_request(root, pool="serve", route="doomed",
+                       status=trh.ERROR,
+                       error_type=type(ei.value).__name__,
+                       dossier_id=did)
+    trace = _get_trace(w, root.trace_id, nspans=1)
+    assert trace["root"]["status"] == "error"
+    if did:  # dossier harvest is best-effort; the link must hold when
+        assert trace["root"]["dossier_id"] == did  # it exists
+        d = _wait_for(lambda: w.gcs.call("get_dossier",
+                                         {"dossier_id": did}),
+                      msg="dossier")
+        assert d.get("trace_id") == root.trace_id
+
+
+# ------------------------------------------------------------ serve + SLO
+def test_serve_slo_accounting_and_summary(ray_start_regular,
+                                          full_sampling):
+    """Completed requests are classified against the TTFT target:
+    violations publish counters + exemplar trace ids, and both the
+    state API filter and metrics_summary surface them."""
+    from ray_tpu.experimental import state
+
+    w = _worker()
+    CONFIG.set("serve_slo_ttft_ms", 50.0)
+    try:
+        good = trh.serve_ingress_root("fast", route="llm-fast")
+        trh.finish_request(good, pool="decode", route="llm-fast",
+                           ttft_s=0.005)
+        slow = trh.serve_ingress_root("slow", route="llm-slow")
+        trh.finish_request(slow, pool="decode", route="llm-slow",
+                           ttft_s=0.500, tpot_s=0.001, num_tokens=8)
+        _get_trace(w, slow.trace_id, nspans=1)
+        rows = state.list_traces(slo_violations=True)
+        assert [r["trace_id"] for r in rows] == [slow.trace_id]
+        assert rows[0]["slo_violated"] == ["ttft"]
+        assert rows[0]["ttft_ms"] == 500.0
+        stats = state.trace_stats()
+        ex = stats["slo_by_route"]["llm-slow"]["exemplars"]
+        assert ex[0]["trace_id"] == slow.trace_id
+        # counters flushed into the metrics namespace
+        from ray_tpu._private import runtime_metrics as rtm
+        rtm.flush_now()
+        summary = _wait_for(
+            lambda: (lambda s: s if "Request traces" in s else None)(
+                state.metrics_summary()),
+            msg="Request traces section")
+        assert "llm-slow" in summary
+        assert slow.trace_id[:16] in summary
+    finally:
+        CONFIG.set("serve_slo_ttft_ms", 2000.0)
+
+
+@pytest.mark.usefixtures("full_sampling")
+def test_disagg_request_trace_end_to_end(ray_start_regular):
+    """Acceptance smoke (2 prefill + 2 decode replicas): one streamed
+    request yields ONE retrievable trace whose spans cover
+    ingress -> prefill -> handoff-pull -> decode with correct
+    parent/child links, whose summed hop durations account for >= 90%
+    of the measured TTFT, and an injected-slow request shows up under
+    ``--slo-violations`` with its exemplar trace id."""
+    import asyncio
+
+    from ray_tpu import serve
+    from ray_tpu.experimental import state
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_serve_llm import _disagg_app
+
+    w = _worker()
+    serve.start()
+    serve.run(_disagg_app())
+    try:
+        handle = serve.llm.disagg_handle("tiny")
+
+        async def one(req):
+            toks, summary = [], None
+            t0 = time.perf_counter()
+            ttft = None
+            async for item in handle.stream(req):
+                if "token" in item:
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
+                    toks.append(item["token"])
+                elif "retry" not in item:
+                    summary = item
+            return toks, summary, ttft
+
+        req = {"prompt": [1, 2, 3, 4, 5], "max_new_tokens": 6,
+               "temperature": 0.0}
+        toks, summary, ttft = asyncio.run(
+            asyncio.wait_for(one(req), timeout=300))
+        assert len(toks) == 6 and summary["finish_reason"] == "length"
+
+        # exactly one ingress trace for the request
+        rows = _wait_for(
+            lambda: (_flush_traces() or
+                     [r for r in state.list_traces(limit=200)
+                      if r.get("pool") == "disagg"]) or None,
+            msg="disagg trace row")
+        assert len(rows) == 1
+
+        # hop coverage: ingress -> prefill/decode client hops ->
+        # replica exec + serve spans -> handoff legs.  Poll until every
+        # expected hop flushed (replica-side buffers tick at
+        # trace_flush_interval_ms, independently of the driver's)
+        pref_serve = "serve:llm-tiny-prefill.prefill"
+        dec_serve = "serve:llm-tiny-decode.decode"
+        wanted = {"prefill", "decode", "handoff_pull", "import_wait",
+                  "handoff_export", pref_serve, dec_serve}
+
+        def _full_trace():
+            t = _get_trace(w, rows[0]["trace_id"], nspans=1, timeout=60)
+            names = {s["name"] for s in t["spans"]}
+            if wanted - names:
+                time.sleep(0.3)
+                return None
+            return t
+
+        trace = _wait_for(_full_trace, timeout=60,
+                          msg=f"hop spans {wanted}")
+        by_name = {}
+        for s in trace["spans"]:
+            by_name.setdefault(s["name"], s)
+        root = trace["root"]
+        assert root["pool"] == "disagg" and root["ttft_ms"] is not None
+        root_id = root["span_id"]
+        assert by_name["prefill"]["parent_id"] == root_id
+        assert by_name["decode"]["parent_id"] == root_id
+        # client hop -> actor exec span -> replica serve span -> legs
+        exec_pref = next(
+            s for s in trace["spans"]
+            if s["name"] == "task:handle_request"
+            and s["parent_id"] == by_name["prefill"]["span_id"])
+        assert by_name[pref_serve]["parent_id"] == exec_pref["span_id"]
+        assert by_name["handoff_export"]["parent_id"] == \
+            by_name[pref_serve]["span_id"]
+        exec_dec = next(
+            s for s in trace["spans"]
+            if s["name"] == "task:handle_request_streaming")
+        assert exec_dec["parent_id"] == by_name["decode"]["span_id"]
+        assert by_name[dec_serve]["parent_id"] == exec_dec["span_id"]
+        assert by_name["handoff_pull"]["parent_id"] == \
+            by_name[dec_serve]["span_id"]
+        assert by_name["import_wait"]["parent_id"] == \
+            by_name[dec_serve]["span_id"]
+        # prefill and decode execution ran on DIFFERENT replicas
+        assert exec_pref["worker_id"] != exec_dec["worker_id"]
+
+        # TTFT decomposition: the client-observed prefill hop IS the
+        # time-to-first-token path (routing + queue + replica prefill +
+        # reply); it must account for >= 90% of the measured TTFT
+        assert ttft is not None
+        assert by_name["prefill"]["dur_ms"] >= 0.9 * ttft * 1e3, (
+            by_name["prefill"]["dur_ms"], ttft * 1e3)
+
+        # injected-slow request: drop the TTFT budget below this
+        # pipeline's floor, stream once more, and the violation listing
+        # names the new trace
+        CONFIG.set("serve_slo_ttft_ms", 0.01)
+        try:
+            asyncio.run(asyncio.wait_for(one(req), timeout=300))
+        finally:
+            CONFIG.set("serve_slo_ttft_ms", 2000.0)
+        viol = _wait_for(
+            lambda: (_flush_traces() or
+                     state.list_traces(slo_violations=True,
+                                      limit=50)) or None,
+            msg="slo violation row")
+        assert any(r.get("pool") == "disagg"
+                   and "ttft" in (r["slo_violated"] or [])
+                   for r in viol), viol
+        # the exemplar id resolves to a real trace
+        vid = next(r["trace_id"] for r in viol
+                   if r.get("pool") == "disagg")
+        assert state.get_trace(vid)["root"]["slo_ok"] is False
+    finally:
+        serve.shutdown()
