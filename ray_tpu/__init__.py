@@ -6,6 +6,14 @@ attention, JaxTrainer, datasets, tuning, RL, and serving.
 """
 
 from ray_tpu._private.config import CONFIG  # noqa: F401
+
+# debug-mode lock-order sanitizer (docs/static_analysis.md): installed
+# BEFORE the runtime modules import so their module-level locks are
+# instrumented too; a no-op unless RAY_TPU_DEBUG_LOCKS / debug_locks is
+# set (spawned daemons inherit the env and self-instrument here)
+from ray_tpu._private.analysis import lock_sanitizer as _lock_sanitizer
+_lock_sanitizer.maybe_install()
+
 from ray_tpu.actor import get_actor, kill, method  # noqa: F401
 from ray_tpu.api import (available_resources, cluster_resources, context,  # noqa: F401
                          get, get_runtime_context, init, is_initialized,

@@ -1,0 +1,39 @@
+"""raylint: framework-invariant static analysis + runtime sanitizers.
+
+The runtime's five data/observability planes are built on hand-rolled
+concurrency primitives — inline fast-method dispatch (rpc.py), shm
+single-writer rings (experimental/channel.py), ``Deferred`` replies,
+flusher threads — and the same mechanical bug classes kept surfacing in
+review: an inline RPC handler that blocks (the full-duplex ring
+deadlock docs/collective.md describes), lock-order races
+(raylet ``_kill_worker`` TOCTOU), ContextVars dropped across executor
+hops (the http_proxy double-root bug).  This package enforces those
+invariants by machine instead of reviewer memory:
+
+* **Static side** — an AST-based checker framework (``core.py``) with a
+  project-wide index + best-effort call graph (``callgraph.py``) and
+  one module per rule under ``checkers/``.  ``ray-tpu lint`` (and the
+  tier-1 gate ``tests/test_static_analysis.py``) runs every checker
+  over the whole package; any unallowlisted violation fails the suite.
+  Findings are suppressed only by an *inline justification comment*
+  (``# raylint: disable=<rule> -- <why>``) or a baseline entry in
+  ``allowlist.txt`` — both REQUIRE the justification text, and stale
+  baseline entries are themselves violations.
+
+* **Runtime side** — debug-mode sanitizers, off unless asked for:
+  ``RAY_TPU_DEBUG_LOCKS=1`` (``lock_sanitizer.py``) swaps
+  ``threading.Lock/RLock`` created by instrumented modules for wrappers
+  that record the per-thread lock acquisition-order graph into a
+  process-global table and raise at the FIRST A->B / B->A inversion
+  (not at the one-in-a-thousand actual deadlock);
+  ``RAY_TPU_DEBUG_CHANNELS=1`` (``channel_check.py``) asserts the shm
+  ring protocol's single-writer / seq-word-last / cumulative-ack
+  discipline on every publish and ack.  The chaos and compiled-DAG
+  suites run with both enabled.
+
+See docs/static_analysis.md for the checker catalog and workflows.
+"""
+
+from ray_tpu._private.analysis.core import (  # noqa: F401
+    Violation, ProjectIndex, run_lint, all_checkers,
+)

@@ -1,0 +1,4 @@
+from ray_tpu._private.analysis.cli import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,244 @@
+"""Best-effort call resolution + blocking-primitive matching.
+
+Resolution is deliberately conservative-but-useful:
+
+* bare names resolve to same-module functions, then ``from``-imports;
+* ``alias.attr`` resolves through the module's import table;
+* ``self.attr`` resolves to a method of the enclosing class (single
+  level — no MRO walk; this codebase barely uses inheritance in the
+  runtime core);
+* as a last resort, an attribute call whose name is defined EXACTLY
+  once in the whole package resolves to that definition (``kv_put`` is
+  only GcsClient's) — ambiguity means no edge, never a guessed one.
+
+Unresolvable calls simply end the walk on that edge; that is the main
+source of false negatives, which is the right failure mode for a gate
+(silent pass beats noisy block).  False positives from the heuristic
+edges are absorbed by the allowlist with a written justification.
+
+Nested ``def``/``lambda`` bodies are NOT scanned as part of their
+enclosing function: they almost always run on another thread or later
+(callbacks, Thread targets), so charging their calls to the enclosing
+frame would mis-attribute the blocking thread.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu._private.analysis.core import ModuleInfo, ProjectIndex
+
+# direct blocking primitives, keyed by how the call site names them.
+# attribute names here are matched on ANY receiver — distinctive enough
+# in this codebase (``.call`` is the sync RPC, ``.wait`` is
+# Event/Condition/future wait); the walk's SAFE set and the allowlist
+# carry the exceptions.
+BLOCKING_ATTRS: Dict[str, str] = {
+    "result": "Future.result() wait",
+    "wait": "Event/Condition/future wait",
+    "call": "synchronous RPC Connection.call",
+    "recv": "socket recv",
+    "recv_into": "socket recv_into",
+    "accept": "socket accept",
+    "sendall": "socket sendall",
+    "sendmsg": "socket sendmsg",
+    "communicate": "subprocess communicate",
+    "check_output": "subprocess check_output",
+    "check_call": "subprocess check_call",
+}
+
+# module-level functions that block, as (dotted module, name)
+BLOCKING_FUNCS: Dict[Tuple[str, str], str] = {
+    ("time", "sleep"): "time.sleep",
+    ("subprocess", "run"): "subprocess.run",
+    ("subprocess", "check_output"): "subprocess.check_output",
+    ("subprocess", "check_call"): "subprocess.check_call",
+    ("socket", "create_connection"): "socket.create_connection",
+    ("ray_tpu", "get"): "ray_tpu.get",
+    ("ray_tpu", "wait"): "ray_tpu.wait",
+    ("ray_tpu.api", "get"): "ray_tpu.get",
+    ("ray_tpu.api", "wait"): "ray_tpu.wait",
+}
+
+
+def body_calls(node) -> Iterable[ast.Call]:
+    """Every Call in ``node``'s body (an AST node or a statement list),
+    skipping nested def/lambda bodies (they run on other threads/later;
+    see module docstring)."""
+    stack = list(node) if isinstance(node, list) \
+        else list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def callee_parts(call: ast.Call) -> Tuple[Optional[str], Optional[str]]:
+    """(receiver dotted-or-None, attr/name) of a call's callee."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return None, f.id
+    if isinstance(f, ast.Attribute):
+        parts = []
+        v = f.value
+        while isinstance(v, ast.Attribute):
+            parts.append(v.attr)
+            v = v.value
+        if isinstance(v, ast.Name):
+            parts.append(v.id)
+            return ".".join(reversed(parts)), f.attr
+        return "?", f.attr
+    return None, None
+
+
+class Target:
+    """A resolved function: (module, qualname, def node)."""
+
+    __slots__ = ("mod", "qual", "node")
+
+    def __init__(self, mod: ModuleInfo, qual: str, node: ast.AST):
+        self.mod = mod
+        self.qual = qual
+        self.node = node
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.mod.modname, self.qual)
+
+
+def resolve_call(index: ProjectIndex, mod: ModuleInfo,
+                 scope: Optional[str], call: ast.Call) -> List[Target]:
+    """Resolve a call to package-internal function definitions."""
+    recv, name = callee_parts(call)
+    if name is None:
+        return []
+    out: List[Target] = []
+    if recv is None:
+        # bare name: same module, then from-imports
+        if name in mod.functions:
+            return [Target(mod, name, mod.functions[name])]
+        fi = mod.from_imports.get(name)
+        if fi:
+            tmod = index.module(fi[0])
+            if tmod and fi[1] in tmod.functions:
+                return [Target(tmod, fi[1], tmod.functions[fi[1]])]
+        return _unique_fallback(index, name)
+    if recv == "self" and scope and "." in scope:
+        cls = scope.split(".")[0]
+        qual = f"{cls}.{name}"
+        if qual in mod.functions:
+            return [Target(mod, qual, mod.functions[qual])]
+        return _unique_fallback(index, name)
+    # alias.attr through the import tables; ``from pkg import mod as m``
+    # binds a MODULE through from_imports, so both tables apply
+    root = recv.split(".")[0]
+    dotted = mod.imports.get(root)
+    if dotted is None:
+        fi = mod.from_imports.get(root)
+        if fi and index.module(f"{fi[0]}.{fi[1]}") is not None:
+            dotted = f"{fi[0]}.{fi[1]}"
+    if dotted:
+        full = ".".join([dotted] + recv.split(".")[1:])
+        tmod = index.module(full)
+        if tmod and name in tmod.functions:
+            return [Target(tmod, name, tmod.functions[name])]
+        return out
+    return _unique_fallback(index, name)
+
+
+# names the unique-definition fallback must never claim: builtins and
+# ubiquitous method names resolve to stdlib/dict/str behavior far more
+# often than to the one package function that happens to share the name
+_FALLBACK_DENY = frozenset(dir(__builtins__)) | frozenset(
+    dir(builtins)) | frozenset({
+        "get", "set", "put", "add", "pop", "update", "close", "stop",
+        "start", "run", "read", "write", "send", "keys", "values",
+        "items", "copy", "clear", "append", "extend", "join", "split",
+        "strip", "encode", "decode", "submit", "apply", "init", "reset",
+        "step", "sample", "train", "save", "restore", "count", "index",
+    })
+
+
+def _unique_fallback(index: ProjectIndex, name: str) -> List[Target]:
+    if name in _FALLBACK_DENY or name.startswith("__"):
+        return []
+    cands = index.func_index.get(name, [])
+    if len(cands) == 1:
+        mod, qual = cands[0]
+        return [Target(mod, qual, mod.functions[qual])]
+    return []
+
+
+def match_blocking(mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+    """Description if this call site IS a blocking primitive."""
+    recv, name = callee_parts(call)
+    if name is None:
+        return None
+    if recv is None:
+        fi = mod.from_imports.get(name)
+        if fi and (fi[0], fi[1]) in BLOCKING_FUNCS:
+            return BLOCKING_FUNCS[(fi[0], fi[1])]
+        return None
+    root = recv.split(".")[0]
+    dotted = mod.imports.get(root)
+    if dotted is not None and recv == root:
+        desc = BLOCKING_FUNCS.get((dotted, name))
+        if desc:
+            return desc
+    if name in BLOCKING_ATTRS:
+        return BLOCKING_ATTRS[name]
+    return None
+
+
+class BlockingHit:
+    """One blocking call reached from a walk root."""
+
+    __slots__ = ("chain", "desc", "mod", "line")
+
+    def __init__(self, chain: List[str], desc: str, mod: ModuleInfo,
+                 line: int):
+        self.chain = chain        # ["handler", "helper", ...]
+        self.desc = desc
+        self.mod = mod            # module of the blocking call site
+        self.line = line
+
+
+def find_blocking(index: ProjectIndex, start: Target,
+                  safe: Set[Tuple[str, str]],
+                  is_blocking: Callable[[ModuleInfo, ast.Call],
+                                        Optional[str]] = match_blocking,
+                  max_depth: int = 6,
+                  max_hits: int = 4) -> List[BlockingHit]:
+    """DFS the call graph from ``start``; report blocking primitives
+    reachable on the caller's thread.  ``safe`` entries
+    ((modname, qualname)) are trusted sinks the walk never enters."""
+    hits: List[BlockingHit] = []
+    seen: Set[Tuple[str, str]] = {start.key}
+
+    def walk(t: Target, chain: List[str], depth: int) -> None:
+        if len(hits) >= max_hits:
+            return
+        for call in body_calls(t.node):
+            desc = is_blocking(t.mod, call)
+            if desc:
+                hits.append(BlockingHit(
+                    chain + [f"{desc}"], desc, t.mod, call.lineno))
+                if len(hits) >= max_hits:
+                    return
+                continue
+            if depth >= max_depth:
+                continue
+            for nxt in resolve_call(index, t.mod, t.qual, call):
+                if nxt.key in seen or nxt.key in safe:
+                    continue
+                seen.add(nxt.key)
+                walk(nxt, chain + [nxt.qual], depth + 1)
+
+    walk(start, [start.qual], 0)
+    return hits

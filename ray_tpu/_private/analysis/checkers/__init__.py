@@ -1,0 +1,6 @@
+"""raylint rules, one module per checker (docs/static_analysis.md).
+
+Each checker module exposes ``RULE`` (the id used in disable comments
+and the baseline), ``DESCRIPTION``, and ``check(index) ->
+list[Violation]``.  Register new checkers in ``core.all_checkers``.
+"""

@@ -1,0 +1,99 @@
+"""async-blocking: no blocking calls inside ``async def`` bodies.
+
+An event loop runs every coroutine of the process on one thread: a
+single blocking call (``time.sleep``, a sync RPC, a ``Future.result``
+wait, ``subprocess.run``) freezes EVERY request the loop is juggling —
+the serve data path multiplexes 1k+ concurrent token streams over one
+loop (docs/serve_disagg.md), so one blocked coroutine is a cluster-
+visible latency cliff, not a local slowdown.  The sanctioned pattern is
+``await loop.run_in_executor(None, blocking_fn)`` (wrapped in
+``bind_ctx`` when the hop reads trace context — see the
+executor-hop-context rule).
+
+Matched violations are DIRECT calls in the async body (transitive
+analysis lives in the inline-handler rule; here one hop is the
+overwhelming bug shape).  ``await``-ed calls are exempt (``await
+asyncio.wait(...)`` yields, it does not block), as are calls inside
+nested sync ``def``s (those run wherever they're called from — usually
+an executor).  ``asyncio.run()`` inside an ``async def`` is flagged
+too: it is always a bug (cannot nest loops; the LLMEngine.warmup
+incident).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ray_tpu._private.analysis import callgraph as cg
+from ray_tpu._private.analysis.core import (ModuleInfo, ProjectIndex,
+                                            Violation)
+
+RULE = "async-blocking"
+DESCRIPTION = ("blocking primitives called directly inside async def "
+               "bodies (event-loop stalls)")
+
+# attribute-call names that block; receiver-independent like the inline
+# rule, but scoped to direct calls so the noise floor stays low
+_BLOCKING_ATTRS = {
+    "result": "Future.result() wait",
+    "call": "synchronous RPC Connection.call",
+    "communicate": "subprocess communicate",
+    "check_output": "subprocess check_output",
+    "check_call": "subprocess check_call",
+}
+# .wait( is handled specially: Event().wait blocks, but `await x.wait()`
+# (asyncio.Event) is fine and covered by the await exemption
+
+
+def _async_blocking(mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+    recv, name = cg.callee_parts(call)
+    if name is None:
+        return None
+    if recv is not None:
+        root = recv.split(".")[0]
+        dotted = mod.imports.get(root)
+        if dotted == "time" and name == "sleep":
+            return "time.sleep"
+        if dotted == "subprocess" and name in ("run", "check_output",
+                                               "check_call"):
+            return f"subprocess.{name}"
+        if dotted == "asyncio" and name == "run":
+            return "asyncio.run (nested event loop)"
+        if name in _BLOCKING_ATTRS:
+            return _BLOCKING_ATTRS[name]
+        if name == "wait":
+            return "Event/Condition/future wait"
+        return None
+    fi = mod.from_imports.get(name)
+    if fi:
+        if fi == ("time", "sleep"):
+            return "time.sleep"
+        if fi[0] == "subprocess":
+            return f"subprocess.{fi[1]}"
+    return None
+
+
+def _awaited_calls(func: ast.AST) -> set:
+    return {n.value for n in ast.walk(func)
+            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)}
+
+
+def check(index: ProjectIndex) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in index.modules.values():
+        for qual, func in mod.functions.items():
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            awaited = _awaited_calls(func)
+            for call in cg.body_calls(func):
+                if call in awaited:
+                    continue
+                desc = _async_blocking(mod, call)
+                if desc:
+                    out.append(Violation(
+                        RULE, mod.relpath, call.lineno, qual,
+                        f"blocking call in async def: {desc} (move it "
+                        f"behind await loop.run_in_executor, or use the "
+                        f"asyncio equivalent)"))
+    return out

@@ -1,0 +1,111 @@
+"""config-knob: every CONFIG read resolves; every declared knob is read.
+
+``Config.__getattr__`` raises ``AttributeError`` for undeclared names —
+but only when the line actually runs, so a typo'd ``CONFIG.hartbeat_ms``
+on a failure path ships silently and detonates in production.  The
+reverse rot is dead knobs: a ``_declare`` whose every reader was
+refactored away keeps masquerading as a tuning surface (and keeps its
+``RAY_TPU_<NAME>`` env contract) while doing nothing.
+
+Reads counted: ``CONFIG.<name>`` attribute access anywhere in the
+package, ``getattr(CONFIG, "<literal>")``, and membership of the name
+in a module-level tuple/set that is itself iterated against CONFIG (the
+``_SCALED_FLAGS`` pattern lives inside config.py and is exempt anyway).
+``CONFIG.set(...)/update(...)`` are writes, not reads — a knob that is
+only ever written is still dead.
+
+Dead knobs consumed only by tests keep their declaration honest with an
+inline ``# raylint: disable=config-knob -- <why>`` on the _declare.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ray_tpu._private.analysis.core import ProjectIndex, Violation
+
+RULE = "config-knob"
+DESCRIPTION = ("CONFIG reads must resolve to a declared knob; declared "
+               "knobs must be read somewhere")
+
+_CONFIG_METHODS = {"set", "update", "generation", "snapshot",
+                   "copy_overrides", "set_overrides",
+                   "overrides_env_blob"}
+_CONFIG_MODULE = "ray_tpu._private.config"
+
+
+def _declared(index: ProjectIndex) -> Dict[str, int]:
+    mod = index.module(_CONFIG_MODULE)
+    out: Dict[str, int] = {}
+    if mod is None:
+        return out
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "_declare" and node.args \
+                and isinstance(node.args[0], ast.Constant):
+            out[node.args[0].value] = node.lineno
+    return out
+
+
+def _reads(index: ProjectIndex,
+           declared: Dict[str, int]) -> Dict[str, List[Tuple[str, int, str]]]:
+    """knob name -> [(relpath, line, enclosing symbol)] read sites."""
+    out: Dict[str, List[Tuple[str, int, str]]] = {}
+
+    def add(mod, name: str, line: int) -> None:
+        sym = mod.enclosing_function(line) or "<module>"
+        out.setdefault(name, []).append((mod.relpath, line, sym))
+
+    for mod in index.modules.values():
+        if mod.modname == _CONFIG_MODULE:
+            # Config's own machinery accesses flags generically; a
+            # literal ``self.<knob>`` naming a DECLARED knob inside the
+            # class (the timeout_scale scaling hook) counts as a read,
+            # other self-attrs are ordinary instance state
+            for node in mod.attr_loads:
+                if isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and node.attr in declared:
+                    add(mod, node.attr, node.lineno)
+            continue
+        for node in mod.attr_loads:
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id == "CONFIG" \
+                    and node.attr not in _CONFIG_METHODS:
+                add(mod, node.attr, node.lineno)
+        for node, _recv, name in mod.calls:
+            if name == "getattr" and isinstance(node.func, ast.Name) \
+                    and len(node.args) >= 2 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id == "CONFIG" \
+                    and isinstance(node.args[1], ast.Constant):
+                add(mod, node.args[1].value, node.lineno)
+    return out
+
+
+def check(index: ProjectIndex) -> List[Violation]:
+    declared = _declared(index)
+    reads = _reads(index, declared)
+    out: List[Violation] = []
+    if not declared:
+        return out
+    config_mod = index.module(_CONFIG_MODULE)
+    for name, sites in sorted(reads.items()):
+        if name in declared:
+            continue
+        for relpath, line, sym in sites:
+            out.append(Violation(
+                RULE, relpath, line, sym,
+                f"CONFIG.{name} is not a declared knob (would raise "
+                f"AttributeError at runtime); _declare it in "
+                f"_private/config.py or fix the name"))
+    read_names: Set[str] = set(reads)
+    for name, line in sorted(declared.items()):
+        if name not in read_names:
+            out.append(Violation(
+                RULE, config_mod.relpath, line, name,
+                f"declared knob {name!r} is never read inside the "
+                f"package (dead knob: delete it, or justify a "
+                f"test/env-only knob inline)"))
+    return out

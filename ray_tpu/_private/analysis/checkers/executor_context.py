@@ -1,0 +1,141 @@
+"""executor-hop-context: ContextVars must survive executor hops.
+
+``loop.run_in_executor`` and ``threading.Thread`` do NOT carry
+ContextVars to the target thread.  Code that reads the tracing context
+(util/tracing/tracing_helper.py ``current_context`` /
+``get_trace_context`` / span opens) on the far side of such a hop sees
+an EMPTY context — the serve http_proxy double-root bug: the fallback
+path opened a second trace root per request because its executor hop
+dropped the ingress root.  The contract is to wrap the target with
+``tracing_helper.bind_ctx(ctx, fn, ...)``.
+
+This checker flags ``run_in_executor``/``Thread(target=...)`` call
+sites whose target callable resolves to a function that (transitively,
+shallow) reads the trace context, unless the target is a ``bind_ctx``
+call.  Long-lived daemon loops (flushers, heartbeats) legitimately run
+context-free — those are allowlisted with a justification, which is the
+documentation that the thread is context-free ON PURPOSE.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ray_tpu._private.analysis import callgraph as cg
+from ray_tpu._private.analysis.core import (ModuleInfo, ProjectIndex,
+                                            Violation)
+
+RULE = "executor-hop-context"
+DESCRIPTION = ("run_in_executor / Thread targets that read trace "
+               "ContextVars must be wrapped with bind_ctx")
+
+# context READS in tracing_helper (writes like propagate/install are the
+# receiving side's job and fine to call anywhere)
+_CTX_READERS = {"current_context", "get_trace_context",
+                "maybe_sample_root", "span", "start_span",
+                "start_ingress_root"}
+_TRACING_MOD = "ray_tpu.util.tracing.tracing_helper"
+
+
+def _reads_context(index: ProjectIndex, target: cg.Target,
+                   depth: int = 3, _seen=None) -> Optional[str]:
+    """Does ``target`` (shallow-transitively) read the trace context?
+    Returns the reading callee name, or None."""
+    if _seen is None:
+        _seen = set()
+    if target.key in _seen:
+        return None
+    _seen.add(target.key)
+    # a function that installs its own context per item
+    # (propagate_trace_context / install at the top of a daemon loop,
+    # like the worker exec loop) manages the hop itself — reads past
+    # the install see a real context, so don't scan or descend
+    for call in cg.body_calls(target.node):
+        _recv, name = cg.callee_parts(call)
+        if name in ("propagate_trace_context", "install", "bind_ctx"):
+            return None
+    for call in cg.body_calls(target.node):
+        _recv, name = cg.callee_parts(call)
+        if name in _CTX_READERS:
+            # must actually resolve to the tracing helper — a same-name
+            # method elsewhere (e.g. a Perfetto `span` builder) is not
+            # a context read
+            resolved = cg.resolve_call(index, target.mod, target.qual,
+                                       call)
+            if any(t.mod.modname == _TRACING_MOD for t in resolved):
+                return name
+        if name == "get" and isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id.endswith("_ctx_var"):
+            return "_ctx_var.get"
+        if depth > 0:
+            for nxt in cg.resolve_call(index, target.mod, target.qual,
+                                       call):
+                hit = _reads_context(index, nxt, depth - 1, _seen)
+                if hit:
+                    return hit
+    return None
+
+
+def _target_arg(mod: ModuleInfo, call: ast.Call) -> Optional[ast.AST]:
+    """The callable being shipped across the hop, or None."""
+    _recv, name = cg.callee_parts(call)
+    if name == "run_in_executor":
+        if len(call.args) >= 2:
+            return call.args[1]
+        return None
+    if name == "Thread":
+        # threading.Thread or from-imported Thread only
+        recv, _ = cg.callee_parts(call)
+        if recv is not None and mod.imports.get(recv) != "threading":
+            return None
+        if recv is None and mod.from_imports.get("Thread",
+                                                 ("", ""))[0] != "threading":
+            return None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+    return None
+
+
+def _is_bind_ctx(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        _recv, name = cg.callee_parts(node)
+        if name == "bind_ctx":
+            return True
+        # functools.partial(bind_ctx(...)) etc: look one level in
+        return any(_is_bind_ctx(a) for a in node.args)
+    return False
+
+
+def check(index: ProjectIndex) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in index.modules.values():
+        if mod.modname == _TRACING_MOD:
+            continue  # the helper's own internals
+        for call, _recv, name in mod.calls:
+            if name not in ("run_in_executor", "Thread"):
+                continue
+            tgt = _target_arg(mod, call)
+            if tgt is None or _is_bind_ctx(tgt):
+                continue
+            qual = mod.enclosing_function(call.lineno) or ""
+            # resolve the shipped callable to a definition
+            targets: List[cg.Target] = []
+            if isinstance(tgt, (ast.Name, ast.Attribute)):
+                fake = ast.Call(func=tgt, args=[], keywords=[])
+                targets = cg.resolve_call(index, mod, qual, fake)
+            reader = None
+            for t in targets:
+                reader = _reads_context(index, t)
+                if reader:
+                    break
+            if reader:
+                out.append(Violation(
+                    RULE, mod.relpath, call.lineno,
+                    qual or "<module>",
+                    f"executor/thread hop target reads trace "
+                    f"context via {reader}() but is not wrapped "
+                    f"with tracing_helper.bind_ctx"))
+    return out

@@ -1,0 +1,237 @@
+"""inline-handler-purity: fast-method handlers must never block.
+
+The RPC layer (docs/rpc_fastpath.md) runs registered ``fast_methods``
+INLINE on the connection's reader thread.  A handler that blocks there
+stops the reader — and on a full-duplex connection that is a deadlock
+shape, not a slowdown: the blocked send's drain depends on the peer,
+whose own reader may be blocked on us (the collective take-handler
+deadlock documented in util/collective/transport.py).  The contract
+(rpc.py module docstring) is: buffer + notify + enqueue frames only; no
+socket waits, no ``Deferred``/future result waits, no sleeps, no sync
+RPCs, no store fetches.
+
+This checker finds every ``fast_methods=`` registration (name
+iterables, ``FAST_METHODS`` class attrs, and predicate functions — any
+string the predicate compares against its ``method`` parameter counts
+as fast), maps each fast name to its handler (``_rpc_<name>``
+convention, plus ``if method == "<name>"`` dispatch branches), and
+walks the call graph from each handler looking for blocking primitives
+on the reader thread.  The reply path itself (``Connection._send`` /
+``Deferred.resolve``) is a sanctioned sink: enqueue-and-coalesce is the
+design, and its flush semantics are owned by rpc.py, not the handler.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.analysis import callgraph as cg
+from ray_tpu._private.analysis.core import (ModuleInfo, ProjectIndex,
+                                            Violation)
+
+RULE = "inline-handler-purity"
+DESCRIPTION = ("functions registered via rpc fast_methods must not "
+               "transitively block on the reader thread")
+
+# the rpc reply machinery: enqueueing a frame (and opportunistically
+# flushing the write queue) is the transport's own accepted tradeoff —
+# the handler contract is about waits on OTHER threads' progress
+SAFE_SINKS: Set[Tuple[str, str]] = {
+    ("ray_tpu._private.rpc", "Connection._send"),
+    ("ray_tpu._private.rpc", "Connection._respond"),
+    ("ray_tpu._private.rpc", "Connection._flush"),
+    ("ray_tpu._private.rpc", "Connection.push"),
+    ("ray_tpu._private.rpc", "Connection.close"),
+    ("ray_tpu._private.rpc", "Deferred.resolve"),
+    ("ray_tpu._private.rpc", "Deferred.fail"),
+    ("ray_tpu._private.rpc", "Deferred._finish"),
+    ("ray_tpu._private.rpc", "Deferred._bind"),
+    ("ray_tpu._private.rpc", "_run_cb"),
+}
+
+
+def _string_items(node: ast.AST, mod: ModuleInfo) -> List[str]:
+    """String literals inside a set/tuple/list/frozenset(...) literal,
+    following one level of Name indirection to a module/class assign."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("frozenset", "set", "tuple", "list") \
+            and node.args:
+        node = node.args[0]
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    if isinstance(node, ast.Name) or (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        name = node.id if isinstance(node, ast.Name) else node.attr
+        out: List[str] = []
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Assign):
+                for tgt in n.targets:
+                    tname = None
+                    if isinstance(tgt, ast.Name):
+                        tname = tgt.id
+                    elif isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        tname = tgt.attr
+                    if tname == name:
+                        out.extend(_string_items(n.value, mod))
+        return out
+    return []
+
+
+def _predicate_fast_names(mod: ModuleInfo, func: ast.AST) -> List[str]:
+    """Strings a fast-method predicate compares its first ('method')
+    parameter against — conservatively, ALL of them count as fast."""
+    args = getattr(func, "args", None)
+    if args is None or not args.args:
+        return []
+    pname = args.args[0].arg
+    names: List[str] = []
+    for n in ast.walk(func):
+        if isinstance(n, ast.Compare) and isinstance(n.left, ast.Name) \
+                and n.left.id == pname:
+            for comp in n.comparators:
+                if isinstance(comp, ast.Constant) and \
+                        isinstance(comp.value, str):
+                    names.append(comp.value)
+                elif isinstance(comp, (ast.Tuple, ast.Set, ast.List)):
+                    names.extend(e.value for e in comp.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, str))
+    return names
+
+
+def _fast_registrations(mod: ModuleInfo) -> List[Tuple[str, int]]:
+    """(fast method name, registration line) pairs in this module."""
+    out: List[Tuple[str, int]] = []
+    for node, _recv, _name in mod.calls:
+        for kw in node.keywords:
+            if kw.arg != "fast_methods":
+                continue
+            val = kw.value
+            names = _string_items(val, mod)
+            if not names and isinstance(val, ast.Name):
+                # predicate function — often nested inside the
+                # registering __init__ (worker_main's shape), so fall
+                # back to a unique leaf-name match
+                fn = mod.functions.get(val.id)
+                if fn is None:
+                    cands = [f for q, f in mod.functions.items()
+                             if q.rsplit(".", 1)[-1] == val.id]
+                    fn = cands[0] if len(cands) == 1 else None
+                if fn is not None:
+                    names = _predicate_fast_names(mod, fn)
+            for name in names:
+                out.append((name, node.lineno))
+    return out
+
+
+def _branch_callees(mod: ModuleInfo,
+                    fast_name: str) -> Tuple[bool, List[str]]:
+    """(dispatch branch exists, functions called inside it) for
+    ``if method == "<fast_name>"`` branches of any same-module
+    dispatcher (a function with a ``method`` param).  A branch with no
+    self-owned calls is a resolved, trivially pure handler."""
+    found = False
+    out: List[str] = []
+    for qual, func in mod.functions.items():
+        args = getattr(func, "args", None)
+        if args is None or not any(a.arg == "method" for a in args.args):
+            continue
+        for n in ast.walk(func):
+            if not isinstance(n, ast.If):
+                continue
+            if not _test_matches(n.test, "method", fast_name):
+                continue
+            found = True
+            for call in cg.body_calls(n.body):
+                recv, cname = cg.callee_parts(call)
+                # only self-owned calls: a call on a parameter (e.g.
+                # ``p.get(...)`` on the payload dict) is not the handler
+                if cname and (recv is None or recv == "self"
+                              or recv.startswith("self.")):
+                    out.append(cname)
+    return found, out
+
+
+def _test_matches(test: ast.AST, param: str, value: str) -> bool:
+    if isinstance(test, ast.Compare) and isinstance(test.left, ast.Name) \
+            and test.left.id == param:
+        for comp in test.comparators:
+            if isinstance(comp, ast.Constant) and comp.value == value:
+                return True
+            if isinstance(comp, (ast.Tuple, ast.Set, ast.List)) and any(
+                    isinstance(e, ast.Constant) and e.value == value
+                    for e in comp.elts):
+                return True
+    if isinstance(test, ast.BoolOp):
+        return any(_test_matches(v, param, value) for v in test.values)
+    return False
+
+
+def _handlers_for(index: ProjectIndex, mod: ModuleInfo,
+                  fast_name: str) -> Tuple[bool, List[cg.Target]]:
+    """(resolved, handler definitions) for a fast method name in its
+    registering module."""
+    targets: Dict[Tuple[str, str], cg.Target] = {}
+    want = f"_rpc_{fast_name}"
+    for qual, node in mod.functions.items():
+        if qual.rsplit(".", 1)[-1] == want:
+            t = cg.Target(mod, qual, node)
+            targets[t.key] = t
+    branch_found, callees = _branch_callees(mod, fast_name)
+    for cname in callees:
+        base = cname[5:] if cname.startswith("_rpc_") else cname
+        for qual, node in mod.functions.items():
+            leaf = qual.rsplit(".", 1)[-1]
+            if leaf != cname and leaf != f"_rpc_{base}":
+                continue
+            args = getattr(node, "args", None)
+            if args is not None and any(a.arg == "method"
+                                        for a in args.args):
+                # the callee is itself a dispatcher (worker_main's
+                # dispatch closure forwards to the generic _handle):
+                # its per-name branch is already scanned above, and
+                # walking the WHOLE function would charge every other
+                # method's branch to this fast name
+                continue
+            t = cg.Target(mod, qual, node)
+            targets[t.key] = t
+    return bool(targets) or branch_found, list(targets.values())
+
+
+def check(index: ProjectIndex) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in index.modules.values():
+        regs = _fast_registrations(mod)
+        if not regs:
+            continue
+        seen_names = set()
+        for fast_name, reg_line in regs:
+            if fast_name in seen_names:
+                continue
+            seen_names.add(fast_name)
+            resolved, handlers = _handlers_for(index, mod, fast_name)
+            if not resolved:
+                out.append(Violation(
+                    RULE, mod.relpath, reg_line, f"fast:{fast_name}",
+                    f"fast method {fast_name!r}: no handler definition "
+                    f"resolved in {mod.relpath} (rename to "
+                    f"_rpc_{fast_name} or dispatch via `if method == "
+                    f"...` so the purity walk can see it)"))
+                continue
+            for h in handlers:
+                for hit in cg.find_blocking(index, h, SAFE_SINKS):
+                    out.append(Violation(
+                        RULE, mod.relpath, hit.line
+                        if hit.mod is mod else h.node.lineno,
+                        h.qual,
+                        f"fast method {fast_name!r} handler may block "
+                        f"on the reader thread: "
+                        f"{' -> '.join(hit.chain)} "
+                        f"({hit.mod.relpath}:{hit.line})"))
+    return out

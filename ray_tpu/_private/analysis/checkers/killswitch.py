@@ -1,0 +1,131 @@
+"""kill-switch: plane emission guards stay cheap; metric names unique.
+
+The observability planes (telemetry / events / step stats / tracing)
+share one kill-switch idiom: an ``enabled()`` helper that reads the
+``RAY_TPU_*`` env var and the CONFIG flag.  That read is an env lookup
+plus a config-lock round trip — fine at binding/attach time, a real
+cost on per-emission hot paths (and the exact regression the tracing
+plane measured before caching).  The sanctioned hot-path shape is the
+generation-keyed flag cache (``tracing_helper._flags``): cache the
+verdict keyed on ``CONFIG.generation()`` so runtime overrides still
+take effect while steady-state reads are one tuple compare.
+
+Rule (a): any function that calls a plane's ``enabled()`` must itself
+reference ``CONFIG.generation()`` (i.e. BE the flag cache).  Binding-
+time callers (instrument factories, ``attach``/``configure``) carry an
+inline justification instead — which doubles as documentation that the
+call is intentionally resolution-time.
+
+Rule (b): a runtime-metrics instrument name may be registered at
+exactly one call site.  ``_register`` silently dedupes by name, so a
+second registration returns the FIRST site's instrument — with the
+second site's boundaries/tag keys silently ignored (two modules can
+disagree about one family forever without an error).  Dynamic
+(non-literal) names are skipped.  Names must carry the ``ray_tpu_``
+prefix so the exposition namespace stays collision-free.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ray_tpu._private.analysis import callgraph as cg
+from ray_tpu._private.analysis.core import ProjectIndex, Violation
+
+RULE = "kill-switch"
+DESCRIPTION = ("plane enabled() calls outside generation-keyed caches; "
+               "duplicate or unprefixed metric registrations")
+
+# kill-switch helper owners: module -> its enabled() qualname
+_PLANES = {
+    "ray_tpu._private.runtime_metrics": "enabled",
+    "ray_tpu._private.cluster_events": "enabled",
+    "ray_tpu._private.step_stats": "enabled",
+    "ray_tpu.util.tracing.tracing_helper": "enabled",
+}
+
+_REGISTRARS = {"counter", "gauge", "histogram", "histogram_family",
+               "counter_family", "gauge_callback"}
+_RTM_MODULE = "ray_tpu._private.runtime_metrics"
+
+
+def _references_generation(func: ast.AST) -> bool:
+    for n in ast.walk(func):
+        if isinstance(n, ast.Attribute) and n.attr == "generation" \
+                and isinstance(n.value, ast.Name) \
+                and n.value.id == "CONFIG":
+            return True
+    return False
+
+
+def _check_enabled_callers(index: ProjectIndex) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in index.modules.values():
+        for call, _recv, name in mod.calls:
+            if name != "enabled":
+                continue
+            qual = mod.enclosing_function(call.lineno)
+            if qual is None:
+                continue  # module-level: binding time by definition
+            resolved = cg.resolve_call(index, mod, qual, call)
+            plane = None
+            for t in resolved:
+                if _PLANES.get(t.mod.modname) == t.qual:
+                    plane = t.mod.modname
+                    break
+            if plane is None:
+                continue
+            if _references_generation(mod.functions[qual]):
+                continue  # this IS the generation-keyed cache
+            out.append(Violation(
+                RULE, mod.relpath, call.lineno, qual,
+                f"{plane.rsplit('.', 1)[-1]}.enabled() called "
+                f"outside a CONFIG.generation()-keyed flag cache "
+                f"(env read + config lock per call; use the "
+                f"_flags idiom, or justify a binding-time call "
+                f"inline)"))
+    return out
+
+
+def _check_registrations(index: ProjectIndex) -> List[Violation]:
+    # literal name -> [(relpath, line, symbol, registrar)]
+    sites: Dict[str, List[Tuple[str, int, str, str]]] = {}
+    out: List[Violation] = []
+    for mod in index.modules.values():
+        if mod.modname == _RTM_MODULE:
+            continue  # the factories themselves
+        for node, recv, name in mod.calls:
+            if name not in _REGISTRARS:
+                continue
+            resolved = cg.resolve_call(index, mod, None, node)
+            if not any(t.mod.modname == _RTM_MODULE for t in resolved):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            mname = node.args[0].value
+            sym = mod.enclosing_function(node.lineno) or "<module>"
+            sites.setdefault(mname, []).append(
+                (mod.relpath, node.lineno, sym, name))
+            if not mname.startswith("ray_tpu_"):
+                out.append(Violation(
+                    RULE, mod.relpath, node.lineno, sym,
+                    f"runtime metric {mname!r} lacks the ray_tpu_ "
+                    f"prefix"))
+    for mname, regs in sorted(sites.items()):
+        if len(regs) <= 1:
+            continue
+        first = regs[0]
+        for relpath, line, sym, registrar in regs[1:]:
+            out.append(Violation(
+                RULE, relpath, line, sym,
+                f"metric {mname!r} registered more than once "
+                f"(first at {first[0]}:{first[1]}); _register dedupes "
+                f"by name, so this site's {registrar} arguments are "
+                f"silently ignored — share the instrument instead"))
+    return out
+
+
+def check(index: ProjectIndex) -> List[Violation]:
+    return _check_enabled_callers(index) + _check_registrations(index)

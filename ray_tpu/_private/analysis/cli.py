@@ -1,0 +1,68 @@
+"""``ray-tpu lint``: run raylint over a package tree, exit nonzero on
+unallowlisted violations (docs/static_analysis.md).
+
+Fast enough to gate tier-1 (<10s over the whole package: AST parse +
+table walks, no imports of the analyzed code), zero new CI plumbing —
+``tests/test_static_analysis.py`` invokes the same entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import List, Optional
+
+from ray_tpu._private.analysis import core
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray-tpu lint",
+        description="framework-invariant static analyzer (raylint)")
+    p.add_argument("--root", default=None,
+                   help="package directory to lint (default: the "
+                        "installed ray_tpu package)")
+    p.add_argument("--rule", action="append", dest="rules",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore allowlist.txt (show every finding)")
+    p.add_argument("--baseline", default=core.DEFAULT_BASELINE,
+                   help="baseline allowlist path")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the checker catalog and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the summary line")
+    return p
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for checker in core.all_checkers():
+            print(f"{checker.RULE:24s} {checker.DESCRIPTION}")
+        return 0
+    t0 = time.monotonic()
+    baseline = None if args.no_baseline else args.baseline
+    root = args.root
+    if root is None:
+        import ray_tpu
+        root = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+    violations = core.run_lint(root=root, baseline=baseline,
+                               rules=args.rules)
+    for v in violations:
+        print(v.render())
+    if not args.quiet:
+        dt = time.monotonic() - t0
+        print(f"raylint: {len(violations)} violation(s) in "
+              f"{dt:.2f}s ({root})", file=sys.stderr)
+    return 1 if violations else 0
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,305 @@
+"""raylint core: project index, checker protocol, allowlists, runner.
+
+The analyzer is deliberately a *heuristic* AST tool, not a type checker:
+call resolution is best-effort (see callgraph.py) and every rule accepts
+that a finding may be a justified design decision.  What keeps that
+honest is the suppression contract:
+
+* an inline comment ``# raylint: disable=<rule>[,<rule>...] -- <why>``
+  on the offending line (or the line above it) suppresses the finding —
+  but ONLY with non-empty justification text after ``--``;
+* a baseline entry in ``allowlist.txt`` (``<rule> <path>::<symbol> --
+  <why>``) suppresses every finding with that key — same justification
+  requirement, and entries that no longer match anything are reported
+  as ``stale-allowlist`` so the baseline can only shrink.
+
+Violation keys are ``rule path::symbol`` (no line numbers), so the
+baseline survives unrelated edits to the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# rules the suppression machinery itself emits; they can never be
+# suppressed (a baseline that allowlists its own staleness is no
+# baseline at all)
+META_RULES = frozenset({"stale-allowlist", "allowlist-format"})
+
+_DISABLE_RE = re.compile(
+    r"#\s*raylint:\s*disable=([\w,\-]+)(?:\s*--\s*(\S.*))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str          # checker id, e.g. "inline-handler-purity"
+    path: str          # repo-relative, e.g. "ray_tpu/_private/rpc.py"
+    line: int          # 1-based anchor line (display only; not in key)
+    symbol: str        # dotted context, e.g. "Connection._read_loop"
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Line-number-free identity used by the baseline allowlist."""
+        return f"{self.rule} {self.path}::{self.symbol}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.symbol}: "
+                f"{self.message}")
+
+
+class ModuleInfo:
+    """One parsed module: AST plus the lookup tables checkers need."""
+
+    def __init__(self, path: str, relpath: str, modname: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.modname = modname
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # qualname ("Class.method" / "func" / "outer.inner") -> def node
+        self.functions: Dict[str, ast.AST] = {}
+        # class name -> ClassDef
+        self.classes: Dict[str, ast.ClassDef] = {}
+        # local alias -> dotted module ("rtm" -> "ray_tpu._private....")
+        self.imports: Dict[str, str] = {}
+        # local name -> (dotted module, original name)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        # (line, rule) suppressions: line -> {rule: reason-or-None}
+        self.suppressions: Dict[int, Dict[str, Optional[str]]] = {}
+        # single-pass node buckets (checkers iterate these instead of
+        # re-walking the tree): (call, receiver-dotted, callee name)
+        # triples, and Load-context attribute accesses
+        self.calls: List[Tuple[ast.Call, Optional[str], Optional[str]]] = []
+        self.attr_loads: List[ast.Attribute] = []
+        self._index_defs()
+        self._index_nodes()
+        self._index_suppressions()
+
+    # ------------------------------------------------------------ indexing
+    def _index_defs(self) -> None:
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}" if prefix else child.name
+                    self.functions[qual] = child
+                    visit(child, qual + ".")
+                elif isinstance(child, ast.ClassDef):
+                    if not prefix:
+                        self.classes[child.name] = child
+                    visit(child, f"{prefix}{child.name}.")
+        visit(self.tree, "")
+
+    def _index_nodes(self) -> None:
+        from ray_tpu._private.analysis.callgraph import callee_parts
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                recv, name = callee_parts(node)
+                self.calls.append((node, recv, name))
+            elif isinstance(node, ast.Attribute):
+                if isinstance(node.ctx, ast.Load):
+                    self.attr_loads.append(node)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.imports[alias.asname or
+                                 alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = \
+                        (node.module, alias.name)
+
+    def _index_suppressions(self) -> None:
+        for i, text in enumerate(self.lines, start=1):
+            m = _DISABLE_RE.search(text)
+            if m:
+                reason = (m.group(2) or "").strip() or None
+                per = self.suppressions.setdefault(i, {})
+                for rule in m.group(1).split(","):
+                    per[rule.strip()] = reason
+
+    # ------------------------------------------------------------- queries
+    def enclosing_function(self, line: int) -> Optional[str]:
+        """Qualname of the innermost def spanning ``line``."""
+        best, best_span = None, None
+        for qual, node in self.functions.items():
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                span = end - node.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = qual, span
+        return best
+
+
+class ProjectIndex:
+    """Every module of the package parsed once, shared by all checkers."""
+
+    def __init__(self, root: str, package: str = "ray_tpu"):
+        self.root = os.path.abspath(root)
+        self.package = package
+        # repo dir the relpaths are relative to (parent of the package)
+        self.base = os.path.dirname(self.root)
+        self.modules: Dict[str, ModuleInfo] = {}    # modname -> info
+        self.by_relpath: Dict[str, ModuleInfo] = {}
+        self.errors: List[Violation] = []
+        self._load()
+        # global function-name index: bare name -> [(ModuleInfo, qualname)]
+        self.func_index: Dict[str, List[Tuple[ModuleInfo, str]]] = {}
+        for mod in self.modules.values():
+            for qual in mod.functions:
+                self.func_index.setdefault(
+                    qual.rsplit(".", 1)[-1], []).append((mod, qual))
+
+    def _load(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.base)
+                modname = rel[:-3].replace(os.sep, ".")
+                if modname.endswith(".__init__"):
+                    modname = modname[:-len(".__init__")]
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        src = f.read()
+                    info = ModuleInfo(path, rel, modname, src)
+                except (SyntaxError, UnicodeDecodeError) as e:
+                    self.errors.append(Violation(
+                        "parse-error", rel, getattr(e, "lineno", 0) or 0,
+                        "<module>", f"cannot parse: {e}"))
+                    continue
+                self.modules[modname] = info
+                self.by_relpath[rel] = info
+
+    def module(self, dotted: str) -> Optional[ModuleInfo]:
+        return self.modules.get(dotted)
+
+    def suppressed(self, v: Violation) -> Tuple[bool, bool]:
+        """(is suppressed inline, has justification).  Checks the
+        violation's line and the line above it (trailing comment vs a
+        comment line of its own)."""
+        mod = self.by_relpath.get(v.path)
+        if mod is None:
+            return False, False
+        for line in (v.line, v.line - 1):
+            per = mod.suppressions.get(line)
+            if per and (v.rule in per or "all" in per):
+                reason = per.get(v.rule, per.get("all"))
+                return True, reason is not None
+        return False, False
+
+
+# ------------------------------------------------------------------ baseline
+_BASELINE_RE = re.compile(
+    r"^(?P<rule>[\w\-]+)\s+(?P<path>\S+?)::(?P<symbol>\S+)"
+    r"(?:\s*--\s*(?P<reason>\S.*))?$")
+
+
+def load_baseline(path: str) -> Tuple[Dict[str, str], List[Violation]]:
+    """Parse allowlist.txt -> ({violation key: reason}, format errors)."""
+    entries: Dict[str, str] = {}
+    errors: List[Violation] = []
+    if not os.path.exists(path):
+        return entries, errors
+    rel = os.path.basename(path)
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, start=1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = _BASELINE_RE.match(line)
+            if not m:
+                errors.append(Violation(
+                    "allowlist-format", rel, lineno, "<entry>",
+                    f"unparseable baseline entry: {line!r}"))
+                continue
+            if m.group("rule") in META_RULES:
+                errors.append(Violation(
+                    "allowlist-format", rel, lineno, "<entry>",
+                    f"meta rule {m.group('rule')!r} cannot be baselined"))
+                continue
+            reason = (m.group("reason") or "").strip()
+            if not reason:
+                errors.append(Violation(
+                    "allowlist-format", rel, lineno, "<entry>",
+                    "baseline entry has no `-- justification`: "
+                    f"{line!r}"))
+                continue
+            key = (f"{m.group('rule')} {m.group('path')}"
+                   f"::{m.group('symbol')}")
+            entries[key] = reason
+    return entries, errors
+
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "allowlist.txt")
+
+
+def all_checkers() -> list:
+    """The registered checker modules (each: RULE, DESCRIPTION, check)."""
+    from ray_tpu._private.analysis.checkers import (
+        async_hygiene, config_knobs, executor_context, inline_handlers,
+        killswitch)
+    return [inline_handlers, async_hygiene, executor_context,
+            config_knobs, killswitch]
+
+
+def run_lint(root: Optional[str] = None,
+             baseline: Optional[str] = DEFAULT_BASELINE,
+             rules: Optional[Sequence[str]] = None,
+             index: Optional[ProjectIndex] = None,
+             ) -> List[Violation]:
+    """Run every (selected) checker over the package rooted at ``root``
+    and return the violations that survive inline + baseline
+    suppression.  Stale/format problems in the suppression layer are
+    returned as violations themselves."""
+    if index is None:
+        if root is None:
+            import ray_tpu
+            root = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+        index = ProjectIndex(root)
+    raw: List[Violation] = list(index.errors)
+    for checker in all_checkers():
+        if rules and checker.RULE not in rules:
+            continue
+        raw.extend(checker.check(index))
+
+    entries: Dict[str, str] = {}
+    out: List[Violation] = []
+    if baseline:
+        entries, fmt_errors = load_baseline(baseline)
+        out.extend(fmt_errors)
+    used_keys = set()
+    for v in raw:
+        inline, justified = index.suppressed(v)
+        if inline:
+            if not justified and v.rule not in META_RULES:
+                out.append(Violation(
+                    "allowlist-format", v.path, v.line, v.symbol,
+                    f"inline `raylint: disable={v.rule}` has no "
+                    f"`-- justification`"))
+            used_keys.add(v.key)
+            continue
+        if v.key in entries:
+            used_keys.add(v.key)
+            continue
+        out.append(v)
+    if baseline and not rules:
+        # staleness is only meaningful against a FULL run: under
+        # --rule filtering, other rules' baseline entries legitimately
+        # match nothing this pass
+        rel = os.path.basename(baseline)
+        for key in sorted(set(entries) - used_keys):
+            out.append(Violation(
+                "stale-allowlist", rel, 0, key,
+                f"baseline entry matches no current finding: {key}"))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out

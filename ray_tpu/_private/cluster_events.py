@@ -215,6 +215,7 @@ def configure(*, sink: Optional[Callable[[List[dict]], Any]],
         old, _recorder = _recorder, None
     if old is not None:
         old.stop()
+    # raylint: disable=kill-switch -- configure() runs once per init(), not per emit; emit()'s own guard is one global read
     if not enabled():
         return None
     rec = EventRecorder(sink=sink, source=source, node_id=node_id,

@@ -102,8 +102,6 @@ _declare("scheduler_spill_threshold", float, 0.5,
          "Hybrid scheduling: local/packing preference holds until a node's "
          "critical-resource utilization crosses this fraction (cf. reference "
          "scheduler_spread_threshold, ray_config_def.h).")
-_declare("worker_pool_prestart", int, 0,
-         "Number of workers each node daemon prestarts eagerly.")
 _declare("worker_pool_max_idle", int, 8,
          "Max idle workers kept alive per node for lease reuse.")
 _declare("worker_start_timeout_s", float, 30.0, "Worker process start timeout.")
@@ -112,7 +110,9 @@ _declare("worker_prefork", bool, True,
          "interpreter+jax import per raylet instead of per worker). "
          "Venv-interpreter and cpp workers always exec.")
 _declare("worker_lease_timeout_s", float, 30.0, "Worker lease RPC timeout.")
-_declare("task_retry_delay_ms", int, 100, "Delay before resubmitting a failed task.")
+_declare("task_retry_delay_ms", int, 0,
+         "Delay before resubmitting a task whose worker died (crash-loop "
+         "backoff); 0 (default) resubmits immediately.")
 _declare("max_direct_call_args_bytes", int, 100 * 1024,
          "Args bigger than this are put into the object store before submit.")
 _declare("heartbeat_period_ms", int, 250,
@@ -203,8 +203,6 @@ _declare("lineage_max_bytes", int, 64 * 1024**2,
          "Cap on pinned lineage (task specs kept for object reconstruction).")
 _declare("free_objects_period_ms", int, 100,
          "Batching period for releasing store objects whose refcount hit zero.")
-_declare("pull_chunk_bytes", int, 4 * 1024**2,
-         "Chunk size for inter-node object transfer.")
 _declare("pull_memory_cap_bytes", int, 512 * 1024**2,
          "Admission cap on the total bytes of concurrently in-flight remote "
          "object pulls per process (reference PullManager's bounded pull "
@@ -236,7 +234,6 @@ _declare("prefetch_pin_ttl_s", float, 60.0,
          "by their lease's return (e.g. the lease request timed out or "
          "the task was cancelled before dispatch) drop after this long.")
 _declare("log_to_driver", bool, True, "Forward worker stdout/stderr to the driver.")
-_declare("event_stats", bool, False, "Record per-handler event-loop stats.")
 _declare("task_events_buffer_size", int, 10000,
          "Ring-buffer capacity of per-worker task state-transition events.")
 _declare("task_events_flush_interval_ms", int, 500,
@@ -375,6 +372,19 @@ _declare("serve_slo_ttft_ms", float, 2000.0,
          "ray_tpu_serve_slo_good/violation{pool,slo=ttft} counters "
          "with exemplar trace ids on the slowest requests; <= 0 "
          "disables the dimension.")
+_declare("debug_locks", bool, False,
+         "Lock-order sanitizer (analysis/lock_sanitizer.py): swap "
+         "threading.Lock/RLock created by instrumented runtime modules "
+         "for wrappers that record the per-thread acquisition-order "
+         "graph and raise at the FIRST A->B/B->A inversion.  Debug "
+         "tool (set RAY_TPU_DEBUG_LOCKS=1); the chaos and compiled-DAG "
+         "suites run under it.")
+_declare("debug_channels", bool, False,
+         "Shm-ring protocol checker (analysis/channel_check.py): "
+         "assert single-writer / seq-word-last / cumulative-ack "
+         "discipline on every experimental/channel.py publish and "
+         "ack.  Debug tool (set RAY_TPU_DEBUG_CHANNELS=1); enabled in "
+         "the chaos and compiled-DAG suites.")
 _declare("serve_slo_tpot_ms", float, 200.0,
          "Serve SLO target: inter-token latency budget (ms/token past "
          "the first) for streaming requests; <= 0 disables the "
@@ -448,11 +458,13 @@ _declare("collective_bcast_store_min_bytes", int, 4 * 1024 * 1024,
 # --------------------------------------------------------------------------- #
 # Libraries                                                                   #
 # --------------------------------------------------------------------------- #
-_declare("data_block_target_bytes", int, 128 * 1024**2,
-         "Target block size for ray_tpu.data datasets.")
-_declare("serve_http_host", str, "127.0.0.1", "Serve proxy bind host.")
-_declare("serve_http_port", int, 8000, "Serve proxy bind port.")
-_declare("serve_controller_loop_ms", int, 100, "Serve controller reconcile period.")
+_declare("serve_http_host", str, "127.0.0.1",
+         "Default serve proxy bind host (HTTPOptions overrides per app).")
+_declare("serve_http_port", int, 8000,
+         "Default serve proxy bind port (HTTPOptions overrides per app).")
+_declare("serve_controller_loop_ms", int, 250,
+         "Serve controller reconcile period (replica set convergence "
+         "and autoscaling cadence).")
 
 
 class Config:

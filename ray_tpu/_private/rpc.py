@@ -38,6 +38,39 @@ Fast path (docs/rpc_fastpath.md):
   skips the defensive copy queued frames normally pay and fires the hook
   exactly once when the frame drains (or is dropped on failure), so the
   raylet's chunk server holds its shm pin only for the write's lifetime.
+
+The inline-handler contract (machine-enforced)
+----------------------------------------------
+
+A handler registered via ``fast_methods`` runs ON THE CONNECTION'S
+READER THREAD.  While it runs, nothing else is read off that socket —
+so the contract is strict:
+
+* it may **buffer, mutate in-memory state under short locks, notify
+  waiters, enqueue reply/push frames, and return a value or a
+  ``Deferred``** (resolved later from another thread);
+* it must **never wait on another thread's or the peer's progress**: no
+  ``time.sleep``, no ``Future.result`` / ``Event.wait`` /
+  ``Condition.wait``, no synchronous ``Connection.call`` (its response
+  arrives on a reader — possibly THIS one), no ``ray_tpu.get`` / store
+  fetches, no socket receives, no pool submits that are awaited.
+
+The failure shape is not a slowdown but a distributed deadlock: on a
+full-duplex connection, an inline handler blocking on reply drain stops
+the reader whose peer may be blocked symmetrically on us (observed in
+the collective take-handler incident, util/collective/transport.py).
+Enqueueing frames is fine — ``_send`` may opportunistically flush, but
+that is the transport's own bounded tradeoff, not a wait on a peer.
+
+Handlers that are fast only CONDITIONALLY (worker_main's ``push_tasks``
+is inline only for ref-free frames) must encode the condition in the
+registration predicate, so the slow variant takes the pooled path.
+
+This contract is enforced by the ``inline-handler-purity`` raylint
+checker (docs/static_analysis.md): every registered fast name is
+resolved to its handler and the call graph walked for blocking
+primitives; violations fail tier-1.  Justified exceptions carry an
+inline ``# raylint: disable=...`` comment with the reason.
 """
 
 from __future__ import annotations

@@ -313,6 +313,7 @@ _flush_wake = threading.Event()
 
 
 def _register(name: str, factory: Callable[[], Any]):
+    # raylint: disable=kill-switch -- binding-time only: instruments resolve once at import; the record path never re-reads the flag
     if not enabled():
         return NOOP
     with _lock:
@@ -354,6 +355,7 @@ def gauge_callback(name: str, description: str,
     """Register a gauge polled at flush/snapshot time (pool sizes, pin
     counts): zero hot-path cost, always-current value.  Re-registering
     a name replaces the callback (fresh CoreWorker per init())."""
+    # raylint: disable=kill-switch -- binding-time only: callbacks register once per owner, polled by the flusher
     if not enabled():
         return
     with _lock:
@@ -406,6 +408,7 @@ def attach(sink: Callable[[str, bytes], Any], ident: str) -> None:
     # a fresh sink means a fresh KV (new cluster): the dirty-skip cache
     # must not suppress the first publication of unchanged metrics
     _last_sent.clear()
+    # raylint: disable=kill-switch -- attach() runs once per init(); the flusher it may start ticks on its own clock
     if enabled():
         _ensure_flusher()
 

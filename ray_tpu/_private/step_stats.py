@@ -478,6 +478,7 @@ def start_run(run_id: Optional[str] = None, *, group: str = "",
     one around the user loop; bench.py opens its own).  ``sink`` takes
     report batches (``report_step_stats`` payload); None = local-only
     (the ledger still accumulates).  Returns None when disabled."""
+    # raylint: disable=kill-switch -- once per training RUN, not per step; disabled runs get the shared no-op clock
     if not enabled():
         return None
     run = _RunContext(run_id or f"run-{uuid.uuid4().hex[:8]}",

@@ -59,6 +59,7 @@ from typing import List, Optional, Tuple
 
 from ray_tpu._private import runtime_metrics as rtm
 from ray_tpu._private import serialization as ser
+from ray_tpu._private.analysis import channel_check
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import ChannelClosedError, ChannelTimeoutError
 
@@ -145,6 +146,9 @@ class Channel:
         # N ranks yield-spinning on fewer cores starve the one rank
         # that has real work, inverting the latency win
         self.spin_yields = _SPIN_YIELDS
+        # protocol sanitizer gate, resolved per attach so suites can
+        # flip RAY_TPU_DEBUG_CHANNELS without reimporting this module
+        self._debug = channel_check.enabled()
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -288,6 +292,10 @@ class ChannelWriter:
     def __init__(self, channel: Channel):
         self.channel = channel
         self.seq = 0                   # items published so far
+        # debug-mode writer identity: claims the ring's header claim
+        # word on first publish so a second writer instance trips the
+        # single-writer check (analysis/channel_check.py)
+        self._wid = channel_check.writer_id() if channel._debug else 0
 
     def writable(self) -> bool:
         """True when the ring has a free slot, i.e. the next write will
@@ -318,6 +326,8 @@ class ChannelWriter:
             ch._wait(lambda: ch._min_acks() > floor, deadline, stop,
                      "write")
             _M_WRITE_WAIT.observe_since(t0)
+        if ch._debug:
+            channel_check.check_publish(ch, k, self._wid)
         off = ch._slot_off(k)
         payload = ch._view[off + _SLOT_HEADER:off + _SLOT_HEADER + size]
         try:
@@ -386,9 +396,13 @@ class ChannelReader:
             _M_READ_WAIT.observe_since(t0)
         size = _U64.unpack_from(view, off + 8)[0]
         flags = _U64.unpack_from(view, off + 16)[0]
+        if ch._debug:
+            channel_check.check_read(ch, k, size)
         payload = view[off + _SLOT_HEADER:off + _SLOT_HEADER + size]
 
         def ack(_view=view, _ch=ch, _idx=self.idx, _want=want):
+            if _ch._debug:
+                channel_check.check_ack(_ch, _idx, _want)
             try:
                 _U64.pack_into(_view, _ch._acks_off + 8 * _idx, _want)
             except ValueError:

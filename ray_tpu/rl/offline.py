@@ -288,10 +288,28 @@ class MARWIL:
                 self._timesteps_total += mb.count
         self.iteration += 1
         info = {k: float(v) for k, v in aux_last.items()}
+        # report the DATASET-wide action log-likelihood, not the last
+        # minibatch's: a shuffle-dependent 64-row tail is too noisy to
+        # claim "training improved" against (near convergence its
+        # sampling error exceeds a whole train() call's progress)
+        info["logp"] = self._dataset_logp()
         result = {"info": info, "training_iteration": self.iteration,
                   "timesteps_total": self._timesteps_total}
         result.update(self.evaluate())
         return result
+
+    def _dataset_logp(self, cap: int = 16384) -> float:
+        """Mean log-likelihood of the dataset's actions under the
+        current policy (one forward pass; first ``cap`` rows for very
+        large datasets — deterministic, unlike a shuffled tail)."""
+        from ray_tpu.rl import models as M
+        n = min(self.dataset.count, cap)
+        obs = self._jnp.asarray(np.asarray(self.dataset[SB.OBS])[:n])
+        acts = self._jnp.asarray(np.asarray(self.dataset[SB.ACTIONS])[:n])
+        logits, _ = self.model.apply({"params": self.params}, obs)
+        logp_fn = M.diag_gaussian_logp if self.continuous \
+            else M.categorical_logp
+        return float(logp_fn(logits, acts).mean())
 
     def evaluate(self, episodes: int = 5) -> Dict[str, Any]:
         """Greedy rollouts in the real env to score the cloned policy."""

@@ -1982,8 +1982,8 @@ class CoreWorker:
                         # it declines to open a lease for a short queue
                         self._sched_cv.notify_all()
                     spec, retries = remaining[0]
-                    self._retry_or_fail_dead_worker(st, spec, retries,
-                                                    oom, e,
+                    self._retry_or_fail_dead_worker(key, st, spec,
+                                                    retries, oom, e,
                                                     lease.worker_id)
                 with self._sched_lock:
                     st["leases"].remove(lease)
@@ -2035,7 +2035,7 @@ class CoreWorker:
                     f"worker returned no result for task "
                     f"{spec.get('name', '')}"))
 
-    def _retry_or_fail_dead_worker(self, st, spec, retries: int,
+    def _retry_or_fail_dead_worker(self, key, st, spec, retries: int,
                                    oom: bool, e: BaseException,
                                    worker_id: Optional[str] = None
                                    ) -> None:
@@ -2066,9 +2066,29 @@ class CoreWorker:
         elif retries > 0:
             logger.info("task %s worker died; retrying (%d left)",
                         spec["name"], retries)
-            with self._sched_lock:
-                st["queue"].appendleft((spec, retries - 1))
-                self._sched_cv.notify_all()  # wake parked leases
+
+            def _requeue():
+                with self._sched_lock:
+                    st["queue"].appendleft((spec, retries - 1))
+                    self._sched_cv.notify_all()  # wake parked leases
+                # a DELAYED requeue lands after the dead lease's
+                # teardown already ran its _maybe_request_lease against
+                # an empty queue — without this, no lease-request loop
+                # exists to consume the spec and it strands forever
+                self._maybe_request_lease(key, st)
+
+            delay_ms = CONFIG.task_retry_delay_ms
+            if delay_ms > 0:
+                # optional backoff before resubmission (a crash-looping
+                # task must not spin the lease machinery at full rate);
+                # 0 (default) requeues immediately.  Daemon timer: a
+                # pending requeue must not block interpreter exit nor
+                # fire into a torn-down scheduler after shutdown.
+                t = threading.Timer(delay_ms / 1000.0, _requeue)
+                t.daemon = True
+                t.start()
+            else:
+                _requeue()
         else:
             self._store_task_error(spec, exc.WorkerCrashedError(
                 f"task {spec['name']} worker died: {e}",

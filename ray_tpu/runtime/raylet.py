@@ -290,6 +290,10 @@ class Raylet:
 
         self.gcs_address = tuple(gcs_address)
         self.labels = dict(labels or {})
+        if CONFIG.tpu_slice_name and "slice" not in self.labels:
+            # pod-slice identity rides the node labels so placement
+            # machinery can treat one slice's hosts as an atomic bundle
+            self.labels["slice"] = CONFIG.tpu_slice_name
         self.gcs = GcsClient(gcs_address, push_handler=self._gcs_push,
                              handler=self._handle, connect_retry=True)
         self.gcs.call("register_node", {

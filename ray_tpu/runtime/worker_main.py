@@ -614,6 +614,7 @@ class WorkerProcess:
             raise out["raise"]
         return out["reply"]
 
+    # raylint: disable=inline-handler-purity -- conditional fast method: the registration predicate routes ref-carrying specs (the only path into _resolve_args' blocking fetches) to the POOLED dispatcher; ref-free frames, the only ones dispatched inline, never leave the enqueue pass
     def _run_queued_batch(self, conn, p) -> "rpc.Deferred":
         """Batched ``push_tasks`` frame: enqueue every spec to the serial
         executor FIFO in frame order; the LAST completion resolves the

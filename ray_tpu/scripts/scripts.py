@@ -708,6 +708,23 @@ def cmd_job(args) -> None:
                   f"{info.entrypoint}")
 
 
+def cmd_lint(args) -> None:
+    """`ray-tpu lint`: the raylint static analyzer over the package
+    (docs/static_analysis.md).  Exits nonzero on any unallowlisted
+    violation — the same entry the tier-1 gate runs."""
+    from ray_tpu._private.analysis import cli as lint_cli
+    argv = []
+    if args.root:
+        argv += ["--root", args.root]
+    for r in args.rules or ():
+        argv += ["--rule", r]
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.list_rules:
+        argv.append("--list-rules")
+    sys.exit(lint_cli.run(argv))
+
+
 def cmd_serve(args) -> None:
     """serve status / run / deploy / shutdown (reference `serve` CLI)."""
     _connect(args)
@@ -892,6 +909,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write folded stacks (.folded) or the merged "
                          "gang trace (.json) here")
     sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser("lint",
+                        help="raylint: framework-invariant static "
+                             "analyzer (docs/static_analysis.md)")
+    sp.add_argument("--root", help="package dir to lint (default: the "
+                                   "installed ray_tpu package)")
+    sp.add_argument("--rule", action="append", dest="rules",
+                    help="run only this rule (repeatable)")
+    sp.add_argument("--no-baseline", action="store_true",
+                    help="ignore the allowlist baseline")
+    sp.add_argument("--list-rules", action="store_true",
+                    help="print the checker catalog and exit")
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("microbenchmark",
                         help="core-runtime ops/s suite (ray_perf analog)")

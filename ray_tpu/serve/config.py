@@ -10,6 +10,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from ray_tpu._private.config import CONFIG
+
 
 @dataclass
 class AutoscalingConfig:
@@ -29,8 +31,11 @@ class AutoscalingConfig:
 
 @dataclass
 class HTTPOptions:
-    host: str = "127.0.0.1"
-    port: int = 8000
+    # defaults resolve from the central flag table at construction so
+    # RAY_TPU_SERVE_HTTP_HOST/PORT env overrides reach `serve.start()`
+    # callers that never build an explicit HTTPOptions
+    host: str = field(default_factory=lambda: CONFIG.serve_http_host)
+    port: int = field(default_factory=lambda: CONFIG.serve_http_port)
 
 
 @dataclass

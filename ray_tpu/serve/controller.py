@@ -21,6 +21,8 @@ import time
 import uuid
 from typing import Any, Dict, Optional
 
+from ray_tpu._private.config import CONFIG
+
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
 REPLICA_PREFIX = "SERVE_REPLICA::"
@@ -157,7 +159,7 @@ class ServeController:
             except Exception:  # noqa: BLE001 - loop must survive
                 import traceback
                 traceback.print_exc()
-            time.sleep(0.25)
+            time.sleep(max(0.01, CONFIG.serve_controller_loop_ms / 1000.0))
 
     def _publish_status(self):
         """Snapshot status into GCS internal KV so non-driver processes

@@ -721,6 +721,7 @@ class LLMEngine:
                 seen += 1
                 yield val
                 continue
+            # raylint: disable=async-blocking -- future already done (this item came from its add_done_callback); result() cannot block
             result = val.result()   # raises engine-fatal errors
             # backstop: any token whose bridge callback lost the race
             # with completion still reaches the consumer, in order
@@ -857,6 +858,7 @@ class LLMEngine:
                 seen += 1
                 yield val
                 continue
+            # raylint: disable=async-blocking -- future already done (this item came from its add_done_callback); result() cannot block
             result = val.result()   # raises KVPoolFullError / fatal
             # tokens[0] is the handoff's first token; backstop any
             # decoded token whose bridge lost the race with completion

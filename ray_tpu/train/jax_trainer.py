@@ -16,6 +16,7 @@ import threading
 import uuid
 from typing import Any, Callable, Dict, Optional
 
+from ray_tpu._private.config import CONFIG
 from ray_tpu.air.config import RunConfig, ScalingConfig
 from ray_tpu.train.base_trainer import (BackendConfig, DataParallelTrainer,
                                         WorkerGroup)
@@ -207,7 +208,9 @@ def get_mesh(mesh_shape: Optional[Dict[str, int]] = None):
 
     n = jax.device_count()
     if not mesh_shape:
-        mesh_shape = {"data": n}
+        # the configurable default layout ({"data": -1} unless
+        # overridden): -1 absorbs every device below
+        mesh_shape = dict(CONFIG.mesh_default_axes) or {"data": n}
     names = list(mesh_shape.keys())
     sizes = list(mesh_shape.values())
     wild = [i for i, v in enumerate(sizes) if v == -1]

@@ -1000,9 +1000,16 @@ class _Group:
 def init_collective_group(world_size: int, rank: int,
                           backend: str = "dcn",
                           group_name: str = "default",
-                          timeout: float = 60.0) -> None:
+                          timeout: Optional[float] = None) -> None:
     """Join a collective group. Every participating process calls this with
-    its own rank; returns once the full ring has rendezvoused."""
+    its own rank; returns once the full ring has rendezvoused.
+
+    ``timeout`` bounds the rendezvous; None takes
+    ``CONFIG.collective_rendezvous_timeout_s`` (a timeout_scale-scaled
+    flag, so loaded CI boxes stretch the patience without per-call
+    plumbing)."""
+    if timeout is None:
+        timeout = CONFIG.collective_rendezvous_timeout_s
     if backend not in ("dcn", "gloo", "ring"):
         raise ValueError(
             f"backend {backend!r} not supported; TPU in-graph collectives "

@@ -55,6 +55,10 @@ logger = get_logger("collective")
 # data-plane telemetry (docs/collective.md / docs/observability.md).
 # The tcp/shm byte counters are the transport-selection ground truth:
 # a same-node-only group must leave the TCP counter at exactly zero.
+# hot-path kill-switch binding, the rpc.py idiom: one enabled() read at
+# import; record sites whose ARGUMENT computation isn't free guard on
+# this instead of paying an env read + config lock per segment
+_TELEMETRY = rtm.enabled()
 _M_TCP_BYTES = rtm.counter(
     "ray_tpu_collective_tcp_bytes_total",
     "collective segment payload bytes moved over TCP links")
@@ -129,7 +133,7 @@ class ServeBoard:
         while holding the lock would wedge every other taker/publisher
         (including the RPC readers servicing this very socket)."""
         d.resolve(arr, stable=True, on_sent=self._sent_one)
-        if rtm.enabled():
+        if _TELEMETRY:
             _M_TCP_BYTES.inc(arr.nbytes)
 
     def publish(self, dst: int, tag: str, arr: np.ndarray) -> None:
@@ -296,7 +300,7 @@ class TcpLink:
             raise RuntimeError(
                 f"collective take from rank {self._peer} returned "
                 f"{type(arr).__name__}")
-        if rtm.enabled():
+        if _TELEMETRY:
             _M_TCP_BYTES.inc(arr.nbytes)
         return arr, bool(used)
 
@@ -401,7 +405,7 @@ class ShmLink:
     def _write_one(self, tag: str, arr: np.ndarray,
                    timeout: Optional[float]) -> None:
         self._writer.write((tag, arr), timeout=timeout)
-        if rtm.enabled():
+        if _TELEMETRY:
             _M_SHM_BYTES.inc(arr.nbytes)
 
     def _pump_outbox(self) -> None:
@@ -755,7 +759,7 @@ class ShmArena:
         np.copyto(np.frombuffer(mine, np.uint8, count=src.nbytes),
                   src.view(np.uint8))
         struct.pack_into("<Q", ctl, self._in_word(self._idx), seq)
-        if rtm.enabled():
+        if _TELEMETRY:
             _M_SHM_BYTES.inc(src.nbytes)
         # 2. reduce MY chunk from every peer slab straight into the
         # shared result slab (single writer per region)

@@ -395,6 +395,7 @@ def configure(sink: Optional[Callable[[List[dict]], Any]], *,
         old, _buffer = _buffer, None
     if old is not None:
         old.stop()
+    # raylint: disable=kill-switch -- configure() runs once per init(); span hot paths read the _flags() generation cache
     if sink is None or not enabled():
         return None
     buf = SpanBuffer(sink, node_id=node_id, worker_id=worker_id,

@@ -22,6 +22,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("RAY_TPU_TIMEOUT_SCALE", "4.0")
 _TIMEOUT_SCALE = float(os.environ["RAY_TPU_TIMEOUT_SCALE"])
 
+import contextlib  # noqa: E402
+
 import jax  # noqa: E402
 
 # The environment's sitecustomize force-registers an `axon` TPU backend and
@@ -71,3 +73,28 @@ def ray_start_cluster():
     cluster = Cluster()
     yield cluster
     cluster.shutdown()
+
+
+@contextlib.contextmanager
+def debug_sanitizers_enabled():
+    """Run a block under BOTH runtime sanitizers
+    (docs/static_analysis.md): the lock-order sanitizer and the
+    shm-ring protocol checker, in this process and — via the inherited
+    env — in every daemon/worker spawned inside the block.  Env is
+    restored afterwards so the rest of a tier-1 run stays
+    uninstrumented.  The chaos and compiled-DAG suites wrap their whole
+    module in this via an autouse fixture."""
+    from ray_tpu._private.analysis import lock_sanitizer
+    keys = ("RAY_TPU_DEBUG_LOCKS", "RAY_TPU_DEBUG_CHANNELS")
+    old = {k: os.environ.get(k) for k in keys}
+    for k in keys:
+        os.environ[k] = "1"
+    lock_sanitizer.install()
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

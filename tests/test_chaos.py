@@ -1,12 +1,30 @@
 """Randomized chaos tests: unplanned node loss under live load (cf.
-reference chaos_test suite + NodeKiller, _private/test_utils.py:1301)."""
+reference chaos_test suite + NodeKiller, _private/test_utils.py:1301).
+
+Runs under BOTH runtime sanitizers (docs/static_analysis.md): the
+lock-order sanitizer and the shm-ring protocol checker, in this driver
+process and — via the inherited env — in every daemon/worker the
+cluster fixtures spawn.  Chaos exercises the widest concurrent surface
+in the tree, so a lock-order inversion or ring-protocol break anywhere
+in the kill/recovery paths fails loudly here instead of deadlocking
+one run in a thousand."""
 
 import time
 
 import numpy as np
 
+import pytest
+
+from conftest import debug_sanitizers_enabled
+
 import ray_tpu
 from ray_tpu._private.chaos import NodeKiller
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _debug_sanitizers():
+    with debug_sanitizers_enabled():
+        yield
 
 
 def test_tasks_survive_random_node_kills(ray_start_cluster):

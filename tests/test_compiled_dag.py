@@ -26,6 +26,17 @@ _TIMEOUT_SCALE = float(os.environ.get("RAY_TPU_TIMEOUT_SCALE", "1.0"))
 GET_T = 60.0 * _TIMEOUT_SCALE
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _debug_sanitizers():
+    """Run the whole compiled-DAG suite under the lock-order sanitizer
+    and the shm-ring protocol checker (docs/static_analysis.md) — the
+    ring protocol and the driver/actor locking here are exactly what
+    those sanitizers exist to police."""
+    from conftest import debug_sanitizers_enabled
+    with debug_sanitizers_enabled():
+        yield
+
+
 @ray_tpu.remote
 class Adder:
     def __init__(self, inc=1):

@@ -424,13 +424,21 @@ def test_batched_push_tasks_early_results_stream(fuzz):
     def push_tasks(conn, p):
         results = []
         for s in p["specs"]:
+            if s["name"] == "slowtail":
+                # the tail EXECUTES for a second before completing: its
+                # task_done push and the frame ack both trail the head
+                # by this much.  (The fake used to push the tail's
+                # task_done BEFORE sleeping, so the head's "early"
+                # assert raced the serial push loop by microseconds and
+                # lost under box load — the two pushes must be
+                # separated by the simulated execution, like a real
+                # worker's.)
+                time.sleep(1.0)
             res = {"ok": {"results": [{"name": s["name"]}]}}
             if len(p["specs"]) > 1:
                 conn.push("task_done", {"task_id": s["task_id"],
                                         "res": res})
             results.append(res)
-            if s["name"] == "slowtail":
-                time.sleep(0.5)   # ack (and tail) delayed half a second
         return {"results": results}
 
     def lease_worker(conn, p):
