@@ -57,6 +57,13 @@ NODE_UP = "NODE_UP"
 NODE_DEAD = "NODE_DEAD"
 NODE_UNHEALTHY = "NODE_UNHEALTHY"
 NODE_HEALTHY = "NODE_HEALTHY"
+# preemption lifecycle (docs/fault_tolerance.md): a drain request marks
+# the node PREEMPTING with a grace deadline; the raylet stops granting
+# leases, lets short tasks finish, evacuates primary copies, then
+# reports DRAINED with the evacuation ledger
+NODE_PREEMPTING = "NODE_PREEMPTING"
+NODE_DRAINED = "NODE_DRAINED"
+OBJECT_EVACUATED = "OBJECT_EVACUATED"
 # worker lifecycle (emitted by the raylet)
 WORKER_SPAWN = "WORKER_SPAWN"
 WORKER_EXIT = "WORKER_EXIT"
@@ -83,6 +90,10 @@ AUTOSCALE = "AUTOSCALE"
 # median + k*MAD — the degraded rank names itself (rank/step/phase)
 # instead of silently dragging the allreduce
 TRAIN_STRAGGLER = "TRAIN_STRAGGLER"
+# elastic gang recovery (docs/fault_tolerance.md): the trainer driver
+# detected rank/node death (event plane or poll failure), re-formed the
+# gang and resumed from the latest reported checkpoint
+TRAIN_GANG_RECOVERY = "TRAIN_GANG_RECOVERY"
 # flight-recorder breadcrumbs (ring_only by convention)
 TASK_RUNNING = "TASK_RUNNING"
 TASK_FAILED = "TASK_FAILED"

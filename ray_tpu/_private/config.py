@@ -233,6 +233,34 @@ _declare("prefetch_pin_ttl_s", float, 60.0,
          "Safety-net lifetime of raylet prefetch pins: pins not released "
          "by their lease's return (e.g. the lease request timed out or "
          "the task was cancelled before dispatch) drop after this long.")
+_declare("drain_grace_s", float, 30.0,
+         "Default grace window of a drain_node request (spot/preemption "
+         "notice): the draining raylet stops granting leases, waits this "
+         "long for in-flight task leases to finish, then evacuates "
+         "primary object copies to surviving nodes "
+         "(docs/fault_tolerance.md).")
+_declare("evacuation_enabled", bool, True,
+         "Drain-time object evacuation: a draining raylet ships its "
+         "sealed store + spilled objects to surviving nodes over the "
+         "transfer plane so a graceful preemption loses zero objects.")
+_declare("evac_pin_ttl_s", float, 300.0,
+         "Lifetime of the receiving raylet's pin on an evacuated copy: "
+         "long enough for owners to learn the new location (first fetch "
+         "after the source dies), bounded so orphaned copies whose "
+         "owners never come back stop occupying shm.")
+_declare("gcs_max_evacuated_objects", int, 8192,
+         "Cap on the GCS evacuated-object location table (oldest "
+         "entries rotate out; an expired hint degrades to lineage "
+         "reconstruction, never to a wrong answer).")
+_declare("gcs_evac_ttl_s", float, 600.0,
+         "Expiry of evacuated-object location hints: owners that "
+         "haven't consulted a hint within this window fall back to "
+         "reconstruction.")
+_declare("object_reconstruct_max_attempts", int, 10,
+         "Per-object budget on lineage reconstruction resubmits, on top "
+         "of the task's own max_retries: a flapping node repeatedly "
+         "losing the same object must converge to ObjectLostError "
+         "instead of resubmitting forever.")
 _declare("log_to_driver", bool, True, "Forward worker stdout/stderr to the driver.")
 _declare("task_events_buffer_size", int, 10000,
          "Ring-buffer capacity of per-worker task state-transition events.")

@@ -101,6 +101,50 @@ class FakeMultiNodeProvider(NodeProvider):
         with self._lock:
             self._terminate_locked(node_id)
 
+    def inject_preemption(self, node_id: str, grace_s: float = 5.0,
+                          graceful: bool = True) -> list:
+        """Chaos seam: simulate a spot preemption of one launch unit
+        (docs/fault_tolerance.md).  With ``graceful`` the provider
+        issues drain_node for every raylet of the unit (the preemption
+        NOTICE) and hard-kills the host processes after ``grace_s``;
+        ungraceful kills immediately.  Returns the drained raylet node
+        hexes."""
+        from ray_tpu.runtime.gcs import GcsClient
+        drained = []
+        gcs = GcsClient(self.gcs_address)
+        try:
+            members = [n for n in gcs.call("list_nodes", timeout=10)
+                       if n.get("alive") and (n.get("labels") or {})
+                       .get("autoscaler-node-id") == node_id]
+            if graceful:
+                for n in members:
+                    try:
+                        gcs.call("drain_node",
+                                 {"node_id": n["node_id"],
+                                  "grace_s": grace_s,
+                                  "reason": "spot preemption notice"},
+                                 timeout=10)
+                        drained.append(n["node_id"])
+                    except Exception:
+                        pass
+        finally:
+            gcs.close()
+
+        def _kill():
+            with self._lock:
+                for p in self._procs.get(node_id, []):
+                    if p.poll() is None:
+                        p.kill()
+            # the record itself flips to terminated on the next
+            # non_terminated_nodes() scan (dead host => dead slice)
+        if graceful and grace_s > 0:
+            t = threading.Timer(grace_s, _kill)
+            t.daemon = True
+            t.start()
+        else:
+            _kill()
+        return drained
+
     def shutdown(self) -> None:
         with self._lock:
             for nid in list(self._nodes):

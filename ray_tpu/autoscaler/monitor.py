@@ -39,17 +39,91 @@ class Monitor:
         self.poll_period_s = poll_period_s
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # event-driven replacement (docs/fault_tolerance.md): launch
+        # units we already replaced after a preemption event, and units
+        # the autoscaler itself terminated (whose NODE_DEAD events must
+        # NOT trigger a replacement — that would undo every idle
+        # termination)
+        self._replaced_units: set = set()
+        self._self_terminated: set = set()
+        # event cursor: only preemptions newer than this monitor's
+        # start are actionable — a restarted monitor must not replay
+        # the retained table (whose NODE_DEAD rows include units the
+        # previous monitor idle-terminated) into a launch storm
+        self._events_since = time.time()
 
     def run_once(self) -> dict:
         nodes = self.gcs.call("list_nodes")
         lm = LoadMetrics.from_gcs_snapshot(nodes)
         status = self.autoscaler.update(lm)
+        self._self_terminated.update(status.get("terminated", ()))
+        status["preemption_replacements"] = \
+            self._consume_preemption_events(nodes)
         status["time"] = time.time()
         try:
             self.gcs.kv_put(STATUS_KEY, json.dumps(status).encode())
         except Exception:
             pass
         return status
+
+    # ------------------------------------------- event-driven replacement
+    def _consume_preemption_events(self, nodes) -> list:
+        """Consume NODE_PREEMPTING/NODE_DEAD events (the event plane,
+        not polling) and request a slice-atomic replacement unit
+        through the provider: a preemption NOTICE launches the
+        replacement while the doomed slice is still draining, so the
+        replacement overlaps the grace window instead of following the
+        death (docs/fault_tolerance.md)."""
+        if not getattr(self.provider, "safe_to_scale", True):
+            # operator-reconciled provider mid-apply (the autoscaler.py
+            # gate): defer — nothing is marked replaced, so the events
+            # stay actionable next tick
+            return []
+        try:
+            events = self.gcs.call(
+                "list_cluster_events",
+                {"min_severity": "WARNING", "limit": 200}, timeout=5)
+        except Exception:
+            return []
+        by_id = {n["node_id"]: n for n in nodes}
+        launched = []
+        for ev in events or ():
+            if ev.get("type") not in ("NODE_PREEMPTING", "NODE_DEAD"):
+                continue
+            if ev.get("ts", 0) < self._events_since:
+                continue
+            node = by_id.get(ev.get("node_id"))
+            if node is None:
+                continue
+            labels = node.get("labels") or {}
+            unit = labels.get("autoscaler-node-id")
+            node_type = labels.get("node-type")
+            if not unit or not node_type:
+                continue    # head node or externally managed
+            if unit in self._replaced_units or \
+                    unit in self._self_terminated:
+                continue
+            rec_id = self._launch_replacement(node_type)
+            self._replaced_units.add(unit)   # one replacement per unit,
+            # even when the launch was refused (at max_workers the
+            # normal demand loop takes over; re-launching every tick
+            # would stampede the provider)
+            if rec_id is not None:
+                launched.append(rec_id)
+        return launched
+
+    def _launch_replacement(self, node_type: str) -> Optional[str]:
+        nt = self.config.node_types.get(node_type)
+        if nt is None:
+            return None
+        live = sum(1 for rec in self.provider.non_terminated_nodes()
+                   if rec.node_type == node_type)
+        if live >= nt.max_workers:
+            return None
+        rec = self.provider.create_node(node_type, nt.node_config,
+                                        nt.resources, nt.hosts_per_node,
+                                        nt.labels)
+        return rec.node_id
 
     def start(self) -> None:
         def loop():

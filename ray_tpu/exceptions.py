@@ -95,8 +95,18 @@ class ActorUnavailableError(RayTpuError, _DossierRef):
     """The actor is temporarily unreachable (restart pending)."""
 
 
-class ObjectLostError(RayTpuError):
-    """The object's primary copy was lost and could not be reconstructed."""
+class ObjectLostError(RayTpuError, _DossierRef):
+    """The object's primary copy was lost and could not be
+    reconstructed.  When lineage is exhausted the error names the node
+    dossier of the node that lost the last copy
+    (``err.debug_dossier()``; docs/fault_tolerance.md)."""
+
+    def __init__(self, message: str = "object lost",
+                 dossier_id: str | None = None):
+        self.dossier_id = dossier_id
+        super().__init__(message)
+        # default exception pickling round-trips (cls, args) + __dict__,
+        # which carries dossier_id — no custom __reduce__ needed
 
 
 class ObjectStoreFullError(RayTpuError):

@@ -281,7 +281,8 @@ def node_health_table(nodes: List[dict]) -> List[str]:
     for n in rows:
         h = n["health"]
         state = "DEAD" if not n.get("alive") else (
-            "UNHEALTHY" if n.get("unhealthy") else "OK")
+            "DRAINING" if n.get("draining") else (
+                "UNHEALTHY" if n.get("unhealthy") else "OK"))
         lines.append("%-14s %-10s %5.0f%% %5.0f%% %5.0f%% %9.0f %s" % (
             n["node_id"][:12], state,
             100 * h.get("cpu_frac", 0), 100 * h.get("mem_frac", 0),

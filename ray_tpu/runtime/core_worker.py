@@ -402,7 +402,8 @@ class _NotifyingEvent:
 
 class _OwnedObject:
     __slots__ = ("state", "data", "error", "locations", "event", "refcount",
-                 "task_spec", "dynamic_children", "recovering", "size")
+                 "task_spec", "dynamic_children", "recovering", "size",
+                 "last_lost_node", "recon_attempts", "evac_tried")
 
     def __init__(self):
         self.state = "pending"       # pending | ready
@@ -422,6 +423,18 @@ class _OwnedObject:
         # a _recover_or_fail thread is resolving this entry: borrowers
         # polling every 10 ms must not spawn redundant ones
         self.recovering = False
+        # the last dead node pruned from ``locations``: when lineage is
+        # exhausted, ObjectLostError names this node's crash dossier
+        # (docs/fault_tolerance.md)
+        self.last_lost_node: Optional[str] = None
+        # lineage resubmits charged to THIS object, bounded by
+        # object_reconstruct_max_attempts on top of the task's own
+        # retry budget (a flapping node must converge, not loop)
+        self.recon_attempts = 0
+        # evac hints already followed by borrower-driven recovery: a
+        # stale hint (landing node dropped the copy) must be consulted
+        # once, not poll-looped forever by _recover_or_fail
+        self.evac_tried: Optional[set] = None
 
 
 # Pull admission control lives with the data-plane engine now
@@ -975,6 +988,7 @@ class CoreWorker:
         with self._owned_lock:
             entry = self._owned.get(oid)
         if entry is not None:
+            evac_tried: set = set()
             while True:
                 t = self._remaining(deadline)
                 if not entry.event.wait(t if t is not None else None):
@@ -990,6 +1004,11 @@ class CoreWorker:
                     return res
                 if deadline is not None and time.monotonic() >= deadline:
                     return None
+                # a drained node may have evacuated the copy before
+                # dying: consult the GCS hint table before burning a
+                # reconstruction (docs/fault_tolerance.md)
+                if self._merge_evacuated_locations(oid, entry, evac_tried):
+                    continue
                 # every live copy is gone: recover via lineage or give up
                 # (reference ObjectRecoveryManager::RecoverObject,
                 # object_recovery_manager.h:41)
@@ -997,7 +1016,13 @@ class CoreWorker:
                     raise exc.ObjectLostError(
                         f"object {oid.hex()[:16]} lost: all copies are gone "
                         f"and it cannot be reconstructed (put objects and "
-                        f"tasks out of retries are unrecoverable)")
+                        f"tasks out of retries/reconstruction budget are "
+                        f"unrecoverable)"
+                        + (f"; last copy died with node "
+                           f"{entry.last_lost_node[:12]} — see "
+                           f".debug_dossier()" if entry.last_lost_node
+                           else ""),
+                        dossier_id=entry.last_lost_node)
         # 2. local shm (argument prefetch lands borrowed copies here:
         # the hit counter is the numerator of the prefetch hit ratio)
         res = self.store.get(oid, timeout=0.0)
@@ -1034,6 +1059,12 @@ class CoreWorker:
         alive = self._alive_node_ids()
         with self._owned_lock:
             if alive:
+                lost = entry.locations - alive
+                if lost:
+                    # remember who lost the (so far) last copy: if
+                    # lineage is later exhausted, ObjectLostError names
+                    # this node's dossier
+                    entry.last_lost_node = sorted(lost)[0]
                 entry.locations &= alive
             return set(entry.locations)
 
@@ -1187,6 +1218,39 @@ class CoreWorker:
                 return None
             time.sleep(0.01)
 
+    def _merge_evacuated_locations(self, oid: ObjectID,
+                                   entry: _OwnedObject,
+                                   tried: set) -> bool:
+        """Grow the entry's location set from the GCS evacuated-object
+        table (docs/fault_tolerance.md): a draining node ships its
+        primary copies to survivors and registers each landing, so an
+        owner whose old locations died finds the copy here instead of
+        re-executing lineage.  ``tried`` keeps one fetch attempt from
+        looping on a hint whose copy turned out absent.  Returns True
+        when a new candidate location was merged."""
+        try:
+            hints = self.gcs.call("get_evacuated_locations",
+                                  {"object_ids": [oid.hex()]}, timeout=5)
+        except (ConnectionError, rpc.RpcError, TimeoutError, OSError):
+            return False
+        nodes = set((hints or {}).get(oid.hex(), ())) - tried
+        if not nodes:
+            return False
+        alive = self._alive_node_ids()
+        if alive:
+            # liveness-filter BEFORE marking tried: a hint whose target
+            # isn't in the (≤1s-stale) alive view yet must stay
+            # consultable on the next attempt, not be consumed unseen
+            nodes &= alive
+        if not nodes:
+            return False
+        tried |= nodes
+        with self._owned_lock:
+            entry.locations |= nodes
+        logger.info("object %s: following evacuated copy to %s",
+                    oid.hex()[:12], sorted(n[:8] for n in nodes))
+        return True
+
     # ------------------------------------------------------- reconstruction
     def _try_reconstruct(self, oid: ObjectID, entry: _OwnedObject) -> bool:
         """All copies of an owned object are gone: resubmit the task that
@@ -1202,6 +1266,16 @@ class CoreWorker:
                 return False
             if meta["retries_left"] <= 0:
                 return False
+            if entry.recon_attempts >= \
+                    CONFIG.object_reconstruct_max_attempts:
+                # per-object budget on top of task retries: a flapping
+                # node repeatedly losing the same object converges to
+                # ObjectLostError instead of resubmitting forever
+                logger.warning(
+                    "object %s: reconstruction budget exhausted "
+                    "(%d attempts)", oid.hex()[:12], entry.recon_attempts)
+                return False
+            entry.recon_attempts += 1
             meta["retries_left"] -= 1  # shared dict: visible to all slots
             spec = meta["spec"]
             task_id = TaskID(spec["task_id"])
@@ -1239,11 +1313,23 @@ class CoreWorker:
         kick off reconstruction or resolve the entry to ObjectLostError so
         every waiter (local and remote) gets a clean failure."""
         try:
+            # an evacuated copy beats re-execution: merge any hint the
+            # draining node registered before reconstructing.  The
+            # tried set persists on the entry — borrowers poll every
+            # 10 ms, and a stale hint must be followed once, not
+            # re-merged on every recovery attempt
+            with self._owned_lock:
+                if entry.evac_tried is None:
+                    entry.evac_tried = set()
+                tried = entry.evac_tried
+            if self._merge_evacuated_locations(oid, entry, tried):
+                return
             if self._try_reconstruct(oid, entry):
                 return
             err = exc.ObjectLostError(
                 f"object {oid.hex()[:16]} lost: all copies are gone and it "
-                f"cannot be reconstructed")
+                f"cannot be reconstructed",
+                dossier_id=entry.last_lost_node)
             head, views = ser.serialize(err, error_type=ser.ERROR_OBJECT_LOST)
             data = ser.to_flat_bytes(head, views)
             with self._owned_lock:

@@ -113,6 +113,15 @@ class GcsServer:
             on_dossier_link=self._link_dossier_trace)
         self._dossiers: Dict[str, dict] = {}
         self._dossier_order: deque = deque()
+        # evacuated-object location hints (docs/fault_tolerance.md):
+        # oid hex -> (node hex set, ts).  Written by draining raylets as
+        # they ship primary copies to survivors; read by owners whose
+        # location set emptied, BEFORE lineage reconstruction.  Bounded
+        # (dict insertion order IS the eviction order — refreshes
+        # reinsert, so the cap always drops the stalest hint) +
+        # TTL-swept; ephemeral (an expired hint degrades to
+        # reconstruction, never to a wrong answer).
+        self._evac: Dict[str, Tuple[set, float]] = {}
         self._placement_groups: Dict[str, Dict[str, Any]] = {}
         # channel -> list of (conn, subscriber key)
         self._subs: Dict[str, List[rpc.Connection]] = {}
@@ -699,6 +708,123 @@ class GcsServer:
             return
         self._try_place_pg(pg)
 
+    # ------------------------------------------------- preemption / drain
+    def _mark_node_draining(self, node_id: str, grace_s: float,
+                            reason: str) -> bool:
+        """Idempotently flag a node PREEMPTING: placement skips it and
+        the typed event (with the grace deadline) fires exactly once
+        per drain.  Returns False for unknown/dead nodes."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node["alive"]:
+                return False
+            already = bool(node.get("draining"))
+            node["draining"] = True
+            deadline = time.time() + grace_s
+            if already:
+                # a later notice can only shorten the advertised window
+                # (and a passed-deadline heartbeat echoing remaining
+                # grace 0 must not keep re-extending it)
+                deadline = min(node.get("drain_deadline", deadline),
+                               deadline)
+            node["drain_deadline"] = deadline
+        if not already:
+            self.record_event(
+                "WARNING", "gcs", "NODE_PREEMPTING",
+                f"node {node_id[:8]} draining: {reason} "
+                f"(grace {grace_s:.0f}s)", node_id=node_id,
+                grace_s=grace_s, reason=reason,
+                deadline=time.time() + grace_s)
+            self._publish("node", {"node_id": node_id,
+                                   "state": "DRAINING"})
+        return True
+
+    def _rpc_drain_node(self, conn, p):
+        """Operator/provider-initiated drain (`ray-tpu drain`, spot
+        preemption notice): mark the node draining and forward the
+        drain to its raylet, which stops granting leases and evacuates
+        primary copies (docs/fault_tolerance.md)."""
+        node_id = p["node_id"]
+        raw = p.get("grace_s")   # explicit 0 = die ASAP, keep it
+        grace = CONFIG.drain_grace_s if raw is None else float(raw)
+        reason = p.get("reason", "drain requested")
+        if not self._mark_node_draining(node_id, grace, reason):
+            return {"ok": False, "reason": "unknown or dead node"}
+        with self._lock:
+            node_conn = self._node_conns.get(node_id)
+        if node_conn is not None:
+            try:
+                node_conn.call("drain", {"grace_s": grace,
+                                         "reason": reason,
+                                         "from_gcs": True}, timeout=10)
+            except (ConnectionError, rpc.RpcError, TimeoutError) as e:
+                return {"ok": True, "forwarded": False,
+                        "reason": f"raylet drain forward failed: {e}"}
+        return {"ok": True, "forwarded": node_conn is not None}
+
+    def _rpc_report_node_draining(self, conn, p):
+        """Raylet-initiated drain (the `drain` RPC hit the raylet
+        directly): reflect it in the node table + event plane."""
+        raw = p.get("grace_s")
+        ok = self._mark_node_draining(
+            p["node_id"],
+            CONFIG.drain_grace_s if raw is None else float(raw),
+            p.get("reason", "drain requested"))
+        return {"ok": ok}
+
+    def _rpc_report_node_drained(self, conn, p):
+        """Drain completed: the raylet's evacuation ledger becomes the
+        NODE_DRAINED event the chaos gate (and operators) assert on."""
+        self.record_event(
+            "INFO", "gcs", "NODE_DRAINED",
+            f"node {p['node_id'][:8]} drained: "
+            f"{p.get('evacuated', 0)} objects evacuated "
+            f"({p.get('bytes', 0)} bytes, {p.get('failed', 0)} failed)",
+            node_id=p["node_id"], evacuated=p.get("evacuated", 0),
+            bytes=p.get("bytes", 0), failed=p.get("failed", 0),
+            duration_s=p.get("duration_s"))
+        return {"ok": True}
+
+    def _rpc_report_object_evacuated(self, conn, p):
+        """A draining raylet landed a copy of ``object_id`` on
+        ``node_id``; owners consult this table when their location set
+        empties (multi-source: every completed evacuation target joins
+        the hint, so striped pulls can fan over them immediately)."""
+        oid = p["object_id"]
+        node = p["node_id"]
+        with self._lock:
+            rec = self._evac.pop(oid, None)
+            nodes = rec[0] if rec is not None else set()
+            nodes.add(node)
+            # pop + reinsert rotates a refreshed hint to the back of
+            # the insertion order, so the cap evicts the stalest entry
+            self._evac[oid] = (nodes, time.monotonic())
+            while len(self._evac) > CONFIG.gcs_max_evacuated_objects:
+                self._evac.pop(next(iter(self._evac)))
+        return {"ok": True}
+
+    def _rpc_get_evacuated_locations(self, conn, p):
+        """Batch lookup: {oid hex: [node hexes]} for ids with a live
+        hint (unknown ids are simply absent from the reply)."""
+        out = {}
+        now = time.monotonic()
+        ttl = CONFIG.gcs_evac_ttl_s
+        with self._lock:
+            for oid in p.get("object_ids", ()):
+                rec = self._evac.get(oid)
+                if rec is not None and now - rec[1] <= ttl:
+                    out[oid] = sorted(rec[0])
+        return out
+
+    def _sweep_evac(self) -> None:
+        now = time.monotonic()
+        ttl = CONFIG.gcs_evac_ttl_s
+        with self._lock:
+            dead = [oid for oid, rec in self._evac.items()
+                    if now - rec[1] > ttl]
+            for oid in dead:
+                self._evac.pop(oid, None)
+
     def _rpc_heartbeat(self, conn, p):
         with self._lock:
             node = self._nodes.get(p["node_id"])
@@ -723,6 +849,32 @@ class GcsServer:
             if busy or node.get("busy"):
                 node["last_busy"] = time.monotonic()
             node["busy"] = busy
+            # heartbeat-carried drain flag: the idempotent backstop for
+            # a raylet-initiated drain whose report RPC was lost
+            hb_draining = bool(p.get("draining"))
+            # bundle-pool reconciliation (docs/fault_tolerance.md):
+            # the raylet reports the placement-group bundle pools it
+            # holds; flag the ones the GCS no longer places on this
+            # node (pg removed, or rescheduled elsewhere after a member
+            # node died while this raylet was unreachable) so the
+            # raylet can release the stranded reservation.  Only the
+            # two unambiguous shapes are flagged — a PENDING group
+            # mid-placement must keep its fresh reservations.
+            stale_bundles = []
+            for key in p.get("bundles", ()):
+                pgid, _, idx = str(key).partition(":")
+                pg = self._placement_groups.get(pgid)
+                if pg is None:
+                    stale_bundles.append(key)
+                    continue
+                placement = pg.get("placement")
+                if pg.get("state") == "CREATED" and placement is not None:
+                    try:
+                        i = int(idx)
+                    except ValueError:
+                        continue
+                    if i >= len(placement) or placement[i] != p["node_id"]:
+                        stale_bundles.append(key)
             health = p.get("health")
             unhealthy_flip = None
             if health is not None:
@@ -744,7 +896,17 @@ class GcsServer:
                 + (f"unhealthy: {', '.join(reasons)}" if now_bad
                    else "recovered"),
                 node_id=p["node_id"], **health)
-        return {"ok": True}
+        if hb_draining:
+            # outside self._lock: _mark_node_draining takes it itself
+            raw = p.get("drain_grace_s")
+            self._mark_node_draining(
+                p["node_id"],
+                CONFIG.drain_grace_s if raw is None else float(raw),
+                p.get("drain_reason") or "raylet-initiated drain")
+        reply = {"ok": True}
+        if stale_bundles:
+            reply["stale_bundles"] = stale_bundles
+        return reply
 
     @staticmethod
     def _health_reasons(health: dict) -> List[str]:
@@ -827,6 +989,7 @@ class GcsServer:
             # cardinality stays bounded under worker churn
             if ticks % 50 == 0:
                 self._prune_stale_metrics()
+                self._sweep_evac()
             # actors/pgs parked with "no feasible node" are otherwise only
             # retried on node registration — also retry as resources free
             # up (freshly reported by heartbeats), else a full-but-draining
@@ -1152,8 +1315,11 @@ class GcsServer:
                     return all(
                         int(round(node["available"].get(r, 0) * 1000))
                         >= int(round(v * 1000)) for r, v in need.items())
+                # draining nodes are about to disappear: placing new
+                # actors there guarantees an immediate restart
                 feasible = [node for node in self._nodes.values()
-                            if node["alive"] and _fits(node)]
+                            if node["alive"] and not node.get("draining")
+                            and _fits(node)]
                 spread = strategy.get("type") == "spread"
                 if spread:
                     # most-available-CPU first (cf. SpreadSchedulingPolicy)
@@ -1401,7 +1567,8 @@ class GcsServer:
                 return pg["state"] == "CREATED"
             if self._placement_groups.get(pgid) is not pg:
                 return False   # removed (or re-registered) concurrently
-            nodes = [n for n in self._nodes.values() if n["alive"]]
+            nodes = [n for n in self._nodes.values()
+                     if n["alive"] and not n.get("draining")]
             placement = self._pack_bundles(pg["bundles"], pg["strategy"],
                                            nodes)
             if placement is None:

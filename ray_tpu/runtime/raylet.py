@@ -266,6 +266,13 @@ class Raylet:
         self._bundle_pools: Dict[str, Dict[str, float]] = {}
         self._lock = threading.RLock()
         self._stopped = threading.Event()
+        # preemption drain (docs/fault_tolerance.md): once set, new
+        # leases are refused (redirected to surviving nodes), queued
+        # leases are swept, and the drain thread waits out short tasks
+        # before evacuating primary object copies to surviving peers
+        self._draining = False
+        self._drain_reason = ""
+        self._drain_deadline = 0.0
 
         # handlers that only touch in-memory state under short locks (no
         # spawns, no GCS round trips, no disk): dispatched inline on the
@@ -403,6 +410,11 @@ class Raylet:
         # freed while its prefetch was still pulling: the completion must
         # discard the copy instead of pinning a resurrected object
         self._prefetch_freed: set = set()
+        # pins taken by evacuation ingest (subset of _prefetch_pins):
+        # unlike plain prefetch replicas, these may be an object's LAST
+        # copy (cascading drains) and must re-evacuate if THIS node
+        # drains too
+        self._evac_keep: set = set()
         self._prefetch_lock = threading.Lock()
         # bounded: a lease storm carrying many large-arg entries queues
         # here instead of spawning a thread per argument (PullBudget
@@ -531,6 +543,17 @@ class Raylet:
                       "available": avail,
                       "load": load,
                       "busy": busy}
+                with self._res_lock:
+                    # bundle-pool reconciliation (docs/fault_tolerance
+                    # .md): report the reservations we hold so the GCS
+                    # can flag ones it no longer places here (pg
+                    # removed / rescheduled while we were unreachable)
+                    hb["bundles"] = list(self._bundle_pools)
+                if self._draining:
+                    hb["draining"] = True
+                    hb["drain_reason"] = self._drain_reason
+                    hb["drain_grace_s"] = max(
+                        0.0, self._drain_deadline - time.monotonic())
                 # health snapshot every ~1s (or immediately when the
                 # loop itself lagged): cheap, and the GCS only edge-
                 # triggers events on threshold crossings
@@ -559,6 +582,13 @@ class Raylet:
                     threading.Thread(target=self.shutdown,
                                      daemon=True).start()
                     return
+                if reply and reply.get("stale_bundles"):
+                    # off-thread: the verify round trip must not delay
+                    # liveness reporting past the death threshold
+                    threading.Thread(
+                        target=self._release_stale_bundles,
+                        args=(list(reply["stale_bundles"]),),
+                        daemon=True).start()
             except (ConnectionError, rpc.RpcError, TimeoutError):
                 if self._stopped.is_set():
                     return
@@ -598,7 +628,8 @@ class Raylet:
         except (ConnectionError, rpc.RemoteError, TimeoutError):
             return
         remote_nodes = [n for n in nodes
-                        if n["node_id"] != self.node_id.hex() and n["alive"]]
+                        if n["node_id"] != self.node_id.hex()
+                        and n["alive"] and not n.get("draining")]
         for req in stale:
             need = dict(req["resources"])
             need.setdefault("CPU", 1.0)
@@ -999,6 +1030,295 @@ class Raylet:
 
         threading.Thread(target=_exit, daemon=True).start()
         return {"ok": True}
+
+    # --------------------------------------------------- preemption drain
+    def _rpc_drain(self, conn, p):
+        """Graceful-preemption drain (spot notice, `ray-tpu drain`):
+        emit NODE_PREEMPTING with the grace deadline, stop granting
+        leases, let short tasks finish, then evacuate primary object
+        copies to surviving nodes over the transfer plane
+        (docs/fault_tolerance.md).  Idempotent."""
+        raw_grace = p.get("grace_s")
+        # explicit 0 means "die ASAP, evacuate now" — `or` would turn
+        # it into the 30s default
+        grace = CONFIG.drain_grace_s if raw_grace is None \
+            else float(raw_grace)
+        reason = p.get("reason", "drain requested")
+        with self._lock:
+            already = self._draining
+            self._draining = True
+            self._drain_reason = reason
+            new_deadline = time.monotonic() + grace
+            if already:
+                # a later notice can only SHORTEN the window (a 300s
+                # maintenance drain followed by a 5s spot notice must
+                # evacuate now); the running drain loop re-reads the
+                # deadline every tick
+                self._drain_deadline = min(self._drain_deadline,
+                                           new_deadline)
+            else:
+                self._drain_deadline = new_deadline
+        if already:
+            return {"ok": True, "already": True}
+        logger.warning("draining: %s (grace %.0fs)", reason, grace)
+        # ring_only: the GCS emits the one canonical NODE_PREEMPTING
+        # table event (either RPC path reports there); this copy is a
+        # flight-ring breadcrumb for this raylet's dossier
+        cev.emit(cev.NODE_PREEMPTING,
+                 f"raylet draining: {reason} (grace {grace:.0f}s)",
+                 severity="WARNING", ring_only=True,
+                 grace_s=grace, reason=reason)
+        if not p.get("from_gcs"):
+            # direct raylet-RPC drain: reflect it in the GCS node table
+            # so placement stops choosing this node immediately (the
+            # heartbeat-carried flag is the idempotent backstop)
+            try:
+                self.gcs.call("report_node_draining",
+                              {"node_id": self.node_id.hex(),
+                               "grace_s": grace, "reason": reason},
+                              timeout=5)
+            except (ConnectionError, rpc.RpcError, TimeoutError):
+                pass
+        threading.Thread(target=self._drain_loop, args=(grace, reason),
+                         daemon=True).start()
+        return {"ok": True}
+
+    def _drain_loop(self, grace: float, reason: str) -> None:
+        """Runs the drain to completion: sweep queued leases, wait out
+        in-flight task leases (actors are restarted elsewhere by the
+        GCS when the node dies — their leases never drain), evacuate,
+        report the ledger.  Best effort end to end: a drain must never
+        crash the raylet it is trying to wind down."""
+        t0 = time.monotonic()
+        try:
+            self._sweep_queued_leases()
+            # live deadline read: a later, shorter preemption notice
+            # shrinks _drain_deadline and this wait must honor it.  The
+            # lease wait RESERVES part of the window for evacuation — a
+            # task that outlives the grace must not eat the whole
+            # budget and leave the primary copies to die with the node.
+            evac_reserve = min(10.0, 0.4 * grace)
+            while time.monotonic() < self._drain_deadline - evac_reserve:
+                with self._lock:
+                    busy = [lid for lid in self._leases
+                            if not lid.startswith("actor-")]
+                if not busy:
+                    break
+                time.sleep(0.2)
+            evacuated = nbytes = failed = 0
+            if CONFIG.evacuation_enabled:
+                evacuated, nbytes, failed = self._evacuate_objects(
+                    self._drain_deadline)
+            try:
+                self.gcs.call("report_node_drained",
+                              {"node_id": self.node_id.hex(),
+                               "evacuated": evacuated, "bytes": nbytes,
+                               "failed": failed,
+                               "duration_s": round(
+                                   time.monotonic() - t0, 3)},
+                              timeout=10)
+            except (ConnectionError, rpc.RpcError, TimeoutError):
+                pass
+            logger.warning("drain complete: %d objects evacuated "
+                           "(%d bytes, %d failed) in %.1fs", evacuated,
+                           nbytes, failed, time.monotonic() - t0)
+        except Exception:
+            logger.exception("drain loop failed")
+
+    def _sweep_queued_leases(self) -> None:
+        """Resolve every queued lease request with a redirect to a
+        surviving node (or a clean error): a request parked behind this
+        node's resources must not sit until its timeout while the node
+        is going away.  Redirect rules mirror the lease handler: only
+        non-bundle, spillback<2 requests can follow a retry_at — the
+        other shapes consume the reply as a final grant."""
+        with self._lock:
+            stranded = list(self._pending_leases)
+            self._pending_leases.clear()
+        if not stranded:
+            return
+        # one cluster snapshot for the whole sweep (the stale-request
+        # scan above does the same): N queued leases must not cost N
+        # list_nodes round trips on the drain path
+        try:
+            nodes = self.gcs.call("list_nodes", timeout=5)
+        except (ConnectionError, rpc.RemoteError, TimeoutError):
+            nodes = []
+        candidates = [n for n in nodes
+                      if n["node_id"] != self.node_id.hex()
+                      and n["alive"] and not n.get("draining")]
+        for req in stranded:
+            need = dict(req["resources"])
+            need.setdefault("CPU", 1.0)
+            target = None
+            if req.get("pool") is None and req.get("spillback", 0) < 2:
+                for node in candidates:
+                    if all(node["available"].get(r, 0) >= v
+                           for r, v in need.items()):
+                        target = tuple(node["address"])
+                        break
+            if target is not None:
+                req["out"]["grant"] = {"retry_at": list(target)}
+            else:
+                req["out"]["error"] = "node draining (preemption " \
+                                      "imminent); no alternative node"
+            req["event"].set()
+
+    def _evacuation_targets(self) -> list:
+        return [n for n in self._gcs_nodes(0.5)
+                if n.get("alive") and not n.get("draining")
+                and n["node_id"] != self.node_id.hex()]
+
+    def _evacuate_objects(self, deadline: float) -> Tuple[int, int, int]:
+        """Ship every local primary copy (sealed shm objects + spilled
+        files) to surviving nodes: the receiving raylet pulls over the
+        transfer plane (`ingest_object`), pins the copy for
+        evac_pin_ttl_s, and the landing is registered in the GCS
+        evacuated-object table so owners find it the moment their old
+        location set dies (docs/fault_tolerance.md).  -> (evacuated,
+        bytes, failed)."""
+        targets = self._evacuation_targets()
+        if not targets:
+            # distinct label: the canonical NODE_DRAINED (with its
+            # ledger) still comes from the GCS at drain completion
+            self._report_event("ERROR", "EVACUATION_SKIPPED",
+                               "evacuation skipped: no surviving node")
+            return 0, 0, 0
+        with self._prefetch_lock:
+            # plain prefetch pins are borrowed REPLICAS of arguments
+            # whose primaries live elsewhere — shipping them would
+            # burn the grace window on copies nobody will miss.
+            # Evac-ingested pins stay: after a cascading drain they
+            # may be an object's last copy.
+            skip = set(self._prefetch_pins) - self._evac_keep
+        work = []   # (oid, size)
+        for oid, size, _tick, _pins in self.store.list_objects():
+            if oid.binary() not in skip:
+                work.append((oid, size))
+        from ray_tpu._private.ids import ObjectID
+        with self._lock:
+            shm = {o.binary() for o, _s in work}
+            for ob, (size, _meta) in self._spilled.items():
+                if ob not in shm and ob not in skip:
+                    work.append((ObjectID(ob), size))
+        if not work:
+            return 0, 0, 0
+        results = []
+        with ThreadPoolExecutor(max_workers=4,
+                                thread_name_prefix="evac") as pool:
+            # rotated target list per object: the primary target is
+            # round-robin, but a refusal (full store, transfer already
+            # in flight, transient unreachability) falls over to the
+            # remaining survivors instead of abandoning the object
+            futs = [pool.submit(
+                        self._evacuate_one, oid, size,
+                        targets[i % len(targets):] +
+                        targets[:i % len(targets)], deadline)
+                    for i, (oid, size) in enumerate(work)]
+            for f in futs:
+                try:
+                    results.append(f.result())
+                except Exception:
+                    results.append(None)
+        evacuated = sum(1 for r in results if r is not None)
+        nbytes = sum(r for r in results if r is not None)
+        return evacuated, nbytes, len(results) - evacuated
+
+    def _evacuate_one(self, oid, size: int, targets: list,
+                      deadline: float) -> Optional[int]:
+        """Hand one object to the first of ``targets`` that takes it
+        (each raylet pulls it from us); returns the evacuated byte
+        count (0 is a legitimate success — empty objects evacuate too),
+        None when every target failed."""
+        with self._lock:
+            if oid.binary() in self._deferred_frees:
+                return 0    # being freed: nothing to preserve (success)
+        landed = None
+        for target in targets:
+            if time.monotonic() > deadline + 30.0:
+                # far past the grace window: stop churning so the
+                # NODE_DRAINED report (which operators wait on) isn't
+                # delayed by minutes on a large store
+                return None
+            timeout = max(2.0, deadline - time.monotonic() + 10.0)
+            try:
+                conn = self._conn_cache.get(tuple(target["address"]))
+                reply = conn.call("ingest_object",
+                                  {"object_id": oid.binary(),
+                                   "source": self.node_id.hex(),
+                                   "timeout": timeout},
+                                  timeout=timeout + 5.0)
+            except (ConnectionError, rpc.RpcError, TimeoutError,
+                    OSError) as e:
+                logger.warning("evacuation of %s to %s failed: %s",
+                               oid.hex()[:12], target["node_id"][:8], e)
+                continue
+            if reply and reply.get("ok"):
+                landed = target
+                break
+        if landed is None:
+            return None
+        try:
+            self.gcs.call("report_object_evacuated",
+                          {"object_id": oid.hex(),
+                           "node_id": landed["node_id"]}, timeout=5)
+        except (ConnectionError, rpc.RpcError, TimeoutError):
+            return None  # unregistered copy is invisible: don't count it
+        cev.emit(cev.OBJECT_EVACUATED,
+                 f"evacuated {oid.hex()[:12]} -> "
+                 f"{landed['node_id'][:8]}", severity="DEBUG",
+                 object_id=oid.hex(), bytes=size,
+                 target_node_id=landed["node_id"])
+        return size
+
+    def _rpc_ingest_object(self, conn, p):
+        """Receiving side of evacuation: pull ``object_id`` from the
+        draining ``source`` node over the transfer plane, publish it
+        into local shm and pin it for evac_pin_ttl_s (released early by
+        the owner's free, like a prefetch pin).  Runs pooled — the pull
+        blocks on the network."""
+        from ray_tpu._private.ids import ObjectID
+        ob = bytes(p["object_id"])
+        oid = ObjectID(ob)
+        if self._draining:
+            raise rpc.RpcError("node draining: refusing evacuation")
+        with self._lock:
+            if ob in self._spilled:
+                return {"ok": True, "already": True}
+        if self.store.contains(oid):
+            return {"ok": True, "already": True}
+        with self._prefetch_lock:
+            if ob in self._prefetch_inflight:
+                # a prefetch is mid-pull for the same object: it will
+                # land a local copy anyway — report not-ours so the
+                # drainer tries another target for durability
+                return {"ok": False, "reason": "transfer in flight"}
+            self._prefetch_inflight.add(ob)
+        try:
+            out = self._puller.pull(
+                oid, [p["source"]],
+                deadline=time.monotonic() + float(p.get("timeout", 30.0)),
+                publish_small=True)
+            if out.status != "ok" or not out.published:
+                return {"ok": False, "reason": out.status}
+            with self._prefetch_lock:
+                freed = ob in self._prefetch_freed
+                if not freed:
+                    self._prefetch_pins[ob] = (
+                        out.data,
+                        time.monotonic() + CONFIG.evac_pin_ttl_s)
+                    self._evac_keep.add(ob)
+            if freed:
+                # freed while we pulled: discard instead of resurrecting
+                out.data.release()
+                self.store.release(oid)
+                self.store.delete(oid)
+                return {"ok": False, "reason": "freed during transfer"}
+            return {"ok": True, "bytes": out.bytes}
+        finally:
+            with self._prefetch_lock:
+                self._prefetch_inflight.discard(ob)
+                self._prefetch_freed.discard(ob)
 
     def _rpc_was_oom_killed(self, conn, p):
         """Owners distinguish an OOM kill from a plain crash so the
@@ -1591,12 +1911,48 @@ class Raylet:
         """Release a bundle pool; whatever is currently free in the pool
         returns to the node. In-flight leases drain back via _give_back."""
         key = f"{p['pg_id']}:{int(p['index'])}"
+        return {"ok": self._drop_bundle_pool(key)}
+
+    def _drop_bundle_pool(self, key: str) -> bool:
         with self._res_lock:
             pool = self._bundle_pools.pop(key, None)
             if pool:
                 for r, v in pool.items():
                     self.available[r] = self.available.get(r, 0) + v
-        return {"ok": pool is not None}
+        return pool is not None
+
+    def _release_stale_bundles(self, keys: list) -> None:
+        """A heartbeat reply flagged bundle pools the GCS no longer
+        places on this node (docs/fault_tolerance.md: pg removed or
+        rescheduled after a member node died while this raylet was
+        unreachable — the stranded-reservation leak).  Each key is
+        re-verified against fresh GCS state before release so a
+        flag computed just before a re-reservation landed here can't
+        drop a live pool."""
+        for key in keys:
+            pgid, _, idx = key.partition(":")
+            try:
+                pg = self.gcs.call("get_placement_group",
+                                   {"pg_id": pgid}, timeout=5)
+            except (ConnectionError, rpc.RpcError, TimeoutError):
+                continue    # can't verify: keep the pool, retry next beat
+            if pg is not None:
+                placement = pg.get("placement") or []
+                try:
+                    i = int(idx)
+                except ValueError:
+                    continue
+                ours = (i < len(placement)
+                        and placement[i] == self.node_id.hex())
+                if pg.get("state") != "CREATED" or ours:
+                    continue    # mid-placement or (again) ours: keep
+            if self._drop_bundle_pool(key):
+                logger.warning("released stranded placement bundle %s",
+                               key)
+                self._report_event(
+                    "WARNING", "BUNDLE_RECLAIMED",
+                    f"stranded placement bundle {key} released",
+                    bundle=key)
 
     def _rpc_lease_worker(self, conn, p):
         """Grant a worker lease, spill to another node, or queue.
@@ -1610,6 +1966,20 @@ class Raylet:
         bundle = p.get("bundle")  # [pg_id_hex, index] -> lease from the pool
         pool_key = f"{bundle[0]}:{int(bundle[1])}" if bundle else None
         spillback = int(p.get("spillback", 0))
+        if self._draining:
+            # draining (docs/fault_tolerance.md): no new leases — not
+            # even bundle leases; the group is about to lose this node
+            # and the event plane is already driving its failover.
+            # Redirects only where the client follows them: a bundle
+            # lease or a strategy-pinned request (spillback==2) treats
+            # the reply as a final grant, so those get the clean error.
+            if pool_key is None and spillback < 2:
+                target = self._find_remote_candidate(need)
+                if target is not None:
+                    return {"retry_at": list(target)}
+            raise rpc.RpcError(
+                "node draining (preemption imminent): "
+                f"{self._drain_reason}")
         if pool_key is None and spillback == 0 and \
                 CONFIG.locality_aware_scheduling and p.get("arg_locs"):
             # locality-aware placement (docs/object_transfer.md): on the
@@ -1693,7 +2063,8 @@ class Raylet:
         except (ConnectionError, rpc.RemoteError, TimeoutError):
             return None
         for node in nodes:
-            if node["node_id"] == self.node_id.hex() or not node["alive"]:
+            if node["node_id"] == self.node_id.hex() or not node["alive"] \
+                    or node.get("draining"):
                 continue
             if all(node["available"].get(r, 0) >= v for r, v in need.items()):
                 return tuple(node["address"])
@@ -1704,6 +2075,11 @@ class Raylet:
         exhausted bundle pool must not head-of-line-block node-pool leases
         (and vice versa) since they draw from independent pools."""
         while True:
+            if self._draining:
+                # a request that slipped into the queue as the drain
+                # flag flipped must still get a redirect, not a grant
+                self._sweep_queued_leases()
+                return
             with self._lock:
                 req = None
                 rescan = False
@@ -2044,6 +2420,7 @@ class Raylet:
     def _release_prefetch_pin(self, ob: bytes) -> None:
         with self._prefetch_lock:
             rec = self._prefetch_pins.pop(ob, None)
+            self._evac_keep.discard(ob)
         if rec is None:
             return
         view, _exp = rec
@@ -2076,7 +2453,8 @@ class Raylet:
         nodes = self._gcs_nodes(1.0)
         for node in nodes:
             nh = node["node_id"]
-            if nh == self.node_id.hex() or not node.get("alive"):
+            if nh == self.node_id.hex() or not node.get("alive") \
+                    or node.get("draining"):
                 continue
             nbytes = float(arg_locs.get(nh, 0.0))
             if nbytes <= best_bytes or \
@@ -2108,6 +2486,8 @@ class Raylet:
             return {"node_id": self.node_id.hex(),
                     "resources": dict(self.resources),
                     "available": dict(self.available),
+                    "bundles": list(self._bundle_pools),
+                    "draining": self._draining,
                     "num_workers": len(self._workers),
                     "oom_kill_count": self._oom_kill_count,
                     "memory_usage": self._memory_monitor.last_usage,

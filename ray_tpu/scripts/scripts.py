@@ -375,6 +375,38 @@ def _summary_stacks(args) -> None:
             conn.close()
 
 
+def cmd_drain(args) -> None:
+    """`ray-tpu drain <node-id-prefix>`: graceful-preemption drain of
+    one node (docs/fault_tolerance.md): emits NODE_PREEMPTING with the
+    grace deadline, the raylet stops granting leases, lets short tasks
+    finish and evacuates primary object copies to surviving nodes."""
+    _connect(args)
+    from ray_tpu.runtime.core_worker import get_global_worker
+    worker = get_global_worker()
+    matches = [n for n in worker.gcs.call("list_nodes")
+               if n["alive"] and n["node_id"].startswith(args.node_id)]
+    if not matches:
+        sys.exit(f"no alive node matching {args.node_id!r}")
+    if len(matches) > 1:
+        sys.exit(f"ambiguous node prefix {args.node_id!r}: "
+                 + ", ".join(n["node_id"][:12] for n in matches))
+    node = matches[0]
+    # omit grace_s when unset so the server-side CONFIG.drain_grace_s
+    # default applies (an explicit --grace 0 still means "die ASAP")
+    payload = {
+        "node_id": node["node_id"],
+        "reason": args.reason or "operator drain (ray-tpu drain)",
+    }
+    if args.grace is not None:
+        payload["grace_s"] = args.grace
+    reply = worker.gcs.call("drain_node", payload)
+    if not reply.get("ok"):
+        sys.exit(f"drain refused: {reply.get('reason')}")
+    grace = "default" if args.grace is None else f"{args.grace:g}s"
+    print(f"node {node['node_id'][:12]} draining "
+          f"(grace {grace}, forwarded={reply.get('forwarded')})")
+
+
 def cmd_events(args) -> None:
     """`ray-tpu events`: the cluster event table as an operator table;
     `--dossier <id>` dumps a crash dossier instead."""
@@ -843,6 +875,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="(training) run id or group prefix "
                          "(default: latest run)")
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("drain",
+                        help="gracefully drain a node before preemption "
+                             "(stop leases, evacuate objects)")
+    sp.add_argument("node_id", help="node id hex (prefix ok)")
+    sp.add_argument("--grace", type=float, default=None,
+                    help="grace window in seconds before the node is "
+                         "expected to die (default: the cluster's "
+                         "drain_grace_s)")
+    sp.add_argument("--reason", default="")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("events",
                         help="cluster lifecycle events / crash dossiers")

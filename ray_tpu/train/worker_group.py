@@ -93,6 +93,16 @@ class TrainWorker:
         import jax
         return jax.device_count()
 
+    def get_runtime_node_id(self) -> str:
+        """The ray_tpu node hosting this rank: the driver's gang watch
+        matches NODE_PREEMPTING/NODE_DEAD events against these ids
+        (docs/fault_tolerance.md)."""
+        try:
+            from ray_tpu.runtime import core_worker as cw
+            return cw.get_global_worker().node_id
+        except Exception:
+            return ""
+
     # -- host (DCN) collectives -------------------------------------------
     def init_host_collective(self, world_size: int,
                              group_name: str) -> None:
@@ -266,7 +276,9 @@ class WorkerGroup:
         from ray_tpu.util.scheduling_strategies import \
             PlacementGroupSchedulingStrategy
 
+        import time as _time
         self.num_workers = num_workers
+        self.created_ts = _time.time()   # gang-watch event horizon
         res = dict(resources_per_worker or {"CPU": 1.0})
         self.pg = placement_group([dict(res) for _ in range(num_workers)],
                                   strategy=placement_strategy)
@@ -293,6 +305,13 @@ class WorkerGroup:
         refs = [getattr(w, method).remote(*args, **kwargs)
                 for w in self.workers]
         return ray_tpu.get(refs)
+
+    def node_ids(self) -> List[str]:
+        """ray_tpu node ids hosting the gang, in rank order (cached:
+        the gang never migrates within one incarnation)."""
+        if not getattr(self, "_node_ids", None):
+            self._node_ids = self.execute("get_runtime_node_id")
+        return list(self._node_ids)
 
     def execute_single(self, rank: int, method: str, *args, **kwargs) -> Any:
         import ray_tpu

@@ -242,6 +242,75 @@ def test_load_metrics_from_gcs_snapshot():
     assert lm.summary()["total"] == {"CPU": 4}
 
 
+def test_event_driven_preemption_replacement():
+    """ISSUE 15 satellite: the monitor consumes NODE_PREEMPTING events
+    (the event plane, not polling) and requests a slice-atomic
+    replacement through the provider WHILE the doomed unit is still
+    draining; the unit's own NODE_DEAD must not double-replace, and
+    idle terminations initiated by the autoscaler never trigger a
+    replacement (their NODE_DEAD events are self-inflicted)."""
+    from ray_tpu.cluster_utils import AutoscalingCluster
+    cluster = AutoscalingCluster({
+        "max_workers": 4,
+        "idle_timeout_s": 3600,
+        "available_node_types": {
+            "cpu2": {"resources": {"CPU": 2}, "min_workers": 1,
+                     "max_workers": 3},
+        },
+    }, head_resources={"CPU": 1})
+    try:
+        ray_tpu.init(address=cluster.address)
+        provider = cluster.monitor.provider
+        from ray_tpu.runtime.core_worker import get_global_worker
+        gcs = get_global_worker().gcs
+        # wait for the min_workers unit to register with the GCS
+        deadline = time.monotonic() + 120
+        unit = None
+        while time.monotonic() < deadline:
+            labeled = [n for n in gcs.call("list_nodes")
+                       if n.get("alive") and (n.get("labels") or {})
+                       .get("autoscaler-node-id")]
+            if labeled:
+                unit = labeled[0]["labels"]["autoscaler-node-id"]
+                break
+            time.sleep(0.5)
+        assert unit, "min_workers unit never registered"
+
+        drained = provider.inject_preemption(unit, grace_s=4.0)
+        assert drained, "preemption notice reached no raylet"
+
+        # the replacement launches off the event, during the grace
+        # window (the preempted unit is typically still alive)
+        deadline = time.monotonic() + 60
+        repl = []
+        while time.monotonic() < deadline:
+            repl = [r for r in provider.non_terminated_nodes()
+                    if r.node_id != unit]
+            if repl:
+                break
+            time.sleep(0.3)
+        assert repl, "no replacement unit launched from the event"
+
+        evs = gcs.call("list_cluster_events", {"type": "NODE_PREEMPTING"})
+        assert evs, "no NODE_PREEMPTING event recorded"
+
+        # stability: once the unit dies, NODE_DEAD must not launch a
+        # second replacement for the same unit
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(r.node_id != unit
+                   for r in provider.non_terminated_nodes()):
+                break
+            time.sleep(0.5)
+        for _ in range(6):   # several monitor ticks
+            time.sleep(0.5)
+        others = {r.node_id for r in provider.non_terminated_nodes()}
+        assert len(others) <= 2, f"replacement storm: {others}"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 @pytest.mark.slow
 def test_fake_multinode_scale_up_and_down():
     """End-to-end: queued tasks drive a real launch; idle node terminates.

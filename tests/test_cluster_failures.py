@@ -77,6 +77,92 @@ def test_actor_restarts_after_node_death(ray_start_cluster):
     ray_tpu.shutdown()
 
 
+def test_stranded_bundle_reservation_reconciled(ray_start_cluster):
+    """ISSUE 15 satellite: a raylet holding a bundle reservation the
+    GCS no longer knows about (placement group removed / rescheduled
+    while the return_bundle RPC was lost) must release it via the
+    heartbeat-carried bundle reconciliation — no permanently stranded
+    resources."""
+    from ray_tpu._private import rpc
+
+    cluster = ray_start_cluster
+    node2 = cluster.add_node(resources={"CPU": 4})
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(num_cpus=1, address=cluster.address)
+    conn = rpc.connect(node2.address)
+    try:
+        # orphan reservation: a pg id the GCS never heard of (models a
+        # removed group whose return_bundle never arrived)
+        r = conn.call("reserve_bundle",
+                      {"pg_id": "feedfacefeedface", "index": 0,
+                       "resources": {"CPU": 2}})
+        assert r["ok"]
+        info = conn.call("node_info", {})
+        assert info["bundles"] == ["feedfacefeedface:0"]
+        assert info["available"]["CPU"] == 2.0
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            info = conn.call("node_info", {})
+            if not info["bundles"]:
+                break
+            time.sleep(0.3)
+        assert info["bundles"] == []
+        assert info["available"]["CPU"] == 4.0
+    finally:
+        conn.close()
+    ray_tpu.shutdown()
+
+
+def test_placement_group_reschedules_off_dead_node(ray_start_cluster):
+    """A member node dying while holding bundles sends the group back
+    to PENDING and fully re-reserves it on surviving/replacement nodes
+    — with no tpu-slice/bundle reservation left behind on survivors
+    beyond the re-placed set."""
+    from ray_tpu._private import rpc
+    from ray_tpu.util.placement_group import (placement_group,
+                                              placement_group_table)
+
+    cluster = ray_start_cluster
+    node2 = cluster.add_node(resources={"CPU": 4})
+    node3 = cluster.add_node(resources={"CPU": 4})
+    cluster.wait_for_nodes(3)
+    ray_tpu.init(num_cpus=1, address=cluster.address)
+    pg = placement_group([{"CPU": 3}, {"CPU": 3}],
+                         strategy="STRICT_SPREAD")
+    assert pg.wait(60)
+    tbl = placement_group_table(pg)[pg.id.hex()]
+    victim_hex = tbl["placement"][1]
+    victim = node2 if node2.node_id == victim_hex else node3
+    survivor = node3 if victim is node2 else node2
+    cluster.remove_node(victim)
+    cluster.add_node(resources={"CPU": 4})
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        tbl = placement_group_table(pg)[pg.id.hex()]
+        if tbl["state"] == "CREATED" and \
+                victim_hex not in (tbl["placement"] or []):
+            break
+        time.sleep(0.3)
+    assert tbl["state"] == "CREATED"
+    assert victim_hex not in tbl["placement"]
+    # the survivor holds exactly the bundles of the NEW placement —
+    # nothing stranded from the broken incarnation
+    expect = {f"{pg.id.hex()}:{i}" for i, nid in
+              enumerate(tbl["placement"]) if nid == survivor.node_id}
+    conn = rpc.connect(survivor.address)
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            held = set(conn.call("node_info", {})["bundles"])
+            if held == expect:
+                break
+            time.sleep(0.3)
+        assert held == expect
+    finally:
+        conn.close()
+    ray_tpu.shutdown()
+
+
 def test_chunked_object_transfer_across_nodes(ray_start_cluster):
     """A multi-chunk object produced on one node is pulled by another with
     bounded per-message frames (reference chunked ObjectManager::Push)."""

@@ -378,6 +378,46 @@ def test_stale_rendezvous_keys_ignored(col_cluster):
     assert gcs.kv_get(f"collective/{name}/nonce") is None
 
 
+def test_rerendezvous_after_rank_death_fresh_incarnation(col_cluster):
+    """ISSUE 15 satellite: a gang killed mid-life (no destroy — its
+    complete key set survives in the GCS under its nonce) and
+    re-created under the SAME name must rendezvous a fresh incarnation:
+    the dead incarnation's keys never satisfy the new join (rank 0
+    confirms the nonce over RPC), the nonce rotates, the stale prefix
+    is swept, and the reborn group's ops are numerically correct."""
+    from ray_tpu.runtime.core_worker import get_global_worker
+    gcs = get_global_worker().gcs
+    name = "reborn"
+    ranks = _spawn(2, name, _FAST_CFG)
+    outs = ray_tpu.get([r.op.remote("allreduce", 64) for r in ranks],
+                       timeout=120)
+    exp = _reduced(_inputs(2, 64), "sum")
+    for out in outs:
+        np.testing.assert_allclose(out, exp, rtol=1e-6)
+    old_nonce = gcs.kv_get(f"collective/{name}/nonce")
+    assert old_nonce
+    # ungraceful gang death (rank/slice kill): no destroy runs, the
+    # dead incarnation's complete, valid-looking key set stays behind
+    for r in ranks:
+        ray_tpu.kill(r)
+    time.sleep(0.5)
+    old = old_nonce.decode()
+    assert gcs.kv_get(f"collective/{name}/{old}/0") is not None
+    ranks2 = _spawn(2, name, _FAST_CFG)
+    try:
+        outs = ray_tpu.get(
+            [r.op.remote("allreduce", 2048) for r in ranks2], timeout=120)
+        exp = _reduced(_inputs(2, 2048), "sum")
+        for out in outs:
+            np.testing.assert_allclose(out, exp, rtol=1e-6)
+        new_nonce = gcs.kv_get(f"collective/{name}/nonce")
+        assert new_nonce and new_nonce != old_nonce
+        # the fresh rank 0 swept the dead incarnation's prefix
+        assert gcs.kv_get(f"collective/{name}/{old}/0") is None
+    finally:
+        _teardown(ranks2)
+
+
 def test_init_group_race_holds_slot(monkeypatch):
     """Two threads racing init_collective_group on one name: exactly ONE
     _Group is constructed (the loser fails the duplicate check without
