@@ -87,6 +87,17 @@ SECTIONS = {
                             os.path.join(REPO, "benchmarks",
                                          "collective_perf.py")],
                        timeout=2400),
+    # quantized collective + backward overlap (docs/collective.md): ws4
+    # group with the shm transport disabled so every segment rides
+    # loopback TCP (the DCN regime the int8 codec targets) — fp32 vs
+    # quantize="int8" interleaved A/B at 1/64 MiB (>=2x bar at 64 MiB)
+    # and the allreduce_async overlap probe (>=50% of ring time hidden
+    # behind a calibrated synthetic backward)
+    "collective_quant": dict(cmd=[sys.executable,
+                                  os.path.join(REPO, "benchmarks",
+                                               "collective_perf.py"),
+                                  "--quant"],
+                             timeout=1200),
     # always-on runtime telemetry cost guard (docs/observability.md):
     # interleaved same-box A/B of task throughput with
     # RAY_TPU_TELEMETRY=0 vs 1; the overhead_pct row is the <=3% bar
@@ -193,6 +204,18 @@ _COLLECTIVE_ROWS = {
     "allreduce 64MiB ws4 new": "collective_allreduce_ws4_mb_s",
     "allreduce 64MiB ws2 new": "collective_allreduce_ws2_mb_s",
     "broadcast 64MiB ws4 new": "collective_broadcast_ws4_mb_s",
+}
+
+# Quantized-collective rows (docs/collective.md): the int8 wire-codec
+# bandwidth and the async-overlap hidden fraction must stay visible the
+# same way — the tracked field differs per row.
+_COLLECTIVE_QUANT_ROWS = {
+    "allreduce 64MiB ws4 sim-dcn int8": ("mb_per_s",
+                                         "collective_quant_int8_mb_s"),
+    "allreduce 64MiB ws4 sim-dcn fp32": ("mb_per_s",
+                                         "collective_quant_fp32_mb_s"),
+    "allreduce 8MiB ws4 overlap hidden-frac": (
+        "hidden_frac", "collective_overlap_hidden_frac"),
 }
 
 # Disaggregated-serving rows (docs/serve_disagg.md): the A/B bars must
@@ -345,6 +368,34 @@ def collective_deltas(rows, committed):
     return out
 
 
+def collective_quant_deltas(rows, committed):
+    """Same contract for the collective_quant section; the tracked field
+    differs per row (mb_per_s for the codec arms, hidden_frac for the
+    overlap probe)."""
+    if not committed:
+        return {}
+    base = {}
+    for r in committed.get("collective_quant", []):
+        if isinstance(r, dict) and r.get("name") in _COLLECTIVE_QUANT_ROWS:
+            field, key = _COLLECTIVE_QUANT_ROWS[r["name"]]
+            if r.get(field):
+                base[key] = r[field]
+    out = {}
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        spec = _COLLECTIVE_QUANT_ROWS.get(row.get("name"))
+        if spec is None:
+            continue
+        field, key = spec
+        if key not in base or not row.get(field):
+            continue
+        prev, cur = base[key], row[field]
+        out[key] = {"committed": prev, "current": cur,
+                    "ratio": round(cur / prev, 3)}
+    return out
+
+
 def merge_preserve(out, prev, regenerated):
     """Carry over every section of `prev` that this run didn't regenerate.
 
@@ -441,7 +492,8 @@ def main():
 
     committed = None
     if regenerated & {"core", "streaming", "compiled_dag",
-                      "object_transfer", "collective", "serve_disagg"}:
+                      "object_transfer", "collective",
+                      "collective_quant", "serve_disagg"}:
         committed = _committed_baseline(args.output)
     if "core" in regenerated:
         deltas = control_plane_deltas(out["core"], committed)
@@ -488,6 +540,15 @@ def main():
                 print(f"[collect] {key}: {d['committed_mb_s']:,.0f} -> "
                       f"{d['current_mb_s']:,.0f} MB/s "
                       f"(x{d['ratio']}) [{tag}]", flush=True)
+    if "collective_quant" in regenerated:
+        deltas = collective_quant_deltas(out["collective_quant"], committed)
+        if deltas:
+            out["collective_quant_deltas"] = deltas
+            for key, d in deltas.items():
+                tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
+                print(f"[collect] {key}: {d['committed']:,.2f} -> "
+                      f"{d['current']:,.2f} (x{d['ratio']}) [{tag}]",
+                      flush=True)
     if "serve_disagg" in regenerated:
         deltas = serve_disagg_deltas(out["serve_disagg"], committed)
         if deltas:

@@ -22,6 +22,15 @@ pre-ISSUE-6 ring, so this box's VM-throttle drift hits both arms equally
   become additional sources: per-rank ``ray_tpu_pull_sources``
   telemetry must show >= 2 distinct sources used by at least one rank
   (the ROADMAP item 4 weight-sync shape).
+* **quantized A/B + overlap** (``--quant``, the ``collective_quant``
+  MICROBENCH section) — same-box ws4 groups with shm DISABLED (all
+  segments on TCP).  The fp32 vs ``quantize="int8"`` interleaved A/B
+  at 1/64 MiB runs under ``collective_sim_dcn_mbps`` pacing (a modeled
+  bytes-limited DCN link — the codec's target regime; unpaced loopback
+  only measures encode CPU vs kernel memcpy); the 64 MiB speedup row
+  is the >=2x bar.  The async-overlap probe runs unpaced —
+  ``allreduce_async`` behind a calibrated synthetic backward pass,
+  reporting the fraction of ring time hidden (>=50% bar).
 
 Run on an IDLE box (MICROBENCH policy): ratios are load-sensitive.
 
@@ -82,6 +91,37 @@ class BenchRank:
         out = self.col.allreduce(self.x, self.name)
         dt = time.perf_counter() - t0
         return dt, float(out[0])
+
+    def allreduce_quant(self):
+        t0 = time.perf_counter()
+        out = self.col.allreduce(self.x, self.name, quantize="int8")
+        dt = time.perf_counter() - t0
+        return dt, float(out[0])
+
+    def overlap_probe(self, rounds):
+        """Async allreduce behind a synthetic backward pass, per-round
+        (comm_ms, blocked_wait_ms) from the handle's own clocks.  The
+        backward is modeled as a host SLEEP sized ~2x one sync op: in
+        the regime sync_gradients(async_op=True) targets, backward
+        runs on the accelerator and the host is idle — which is also
+        what lets the probe discriminate cleanly on a shared-CPU box
+        (a host busy-loop would starve the async worker through the
+        GIL and measure scheduler luck, not the engine).  A broken
+        engine that only ran the op inside result() would still show
+        wait ~= comm, i.e. hidden ~= 0."""
+        import statistics as st
+        t0 = time.perf_counter()
+        self.col.allreduce(self.x, self.name)
+        t_comm = time.perf_counter() - t0
+        comm, wait = [], []
+        for _ in range(rounds):
+            h = self.col.allreduce_async(self.x, self.name)
+            time.sleep(2.0 * t_comm)   # the device-side backward
+            t0 = time.perf_counter()
+            h.result(timeout=300)
+            wait.append((time.perf_counter() - t0) * 1000.0)
+            comm.append(h.comm_ms() or 0.0)
+        return st.median(comm), st.median(wait)
 
     def broadcast_new(self, src, stagger_s=0.0):
         if stagger_s and self.rank != src:
@@ -246,6 +286,94 @@ def bench_same_node():
         ray_tpu.shutdown()
 
 
+SIM_DCN_MBPS = float(os.environ.get("COLLECTIVE_BENCH_DCN_MBPS", "6"))
+
+
+def bench_quant_overlap():
+    """The ``collective_quant`` MICROBENCH section (``--quant``): ws4
+    same-box groups with the shm transport DISABLED so every segment
+    rides TCP.
+
+    The fp32-vs-int8 A/B runs with ``collective_sim_dcn_mbps`` pacing
+    every published segment to a modeled bytes-limited DCN link.  On
+    this box the unthrottled loopback "wire" is itself CPU (4 ranks
+    share the cores), so an unpaced A/B only measures encode cost vs
+    kernel memcpy — the codec's target regime is the one where DCN
+    bytes are the bottleneck, and the pacing puts both arms there
+    while charging each arm its own ENCODED byte count.  The 64 MiB
+    speedup row is the >=2x acceptance bar.
+
+    The overlap probe runs on an UNPACED group (overlap is about
+    hiding real ring time behind compute): allreduce_async behind a
+    calibrated synthetic backward, reporting the fraction of ring time
+    hidden (>=0.5 bar)."""
+    world = 4
+    ray_tpu.init(num_cpus=world + 2,
+                 object_store_memory=1024 * 1024 * 1024)
+    try:
+        cfg = {"collective_shm_enabled": False,
+               "collective_flat_shm": False,
+               "collective_quant_min_bytes": 64 * 1024,
+               "collective_sim_dcn_mbps": SIM_DCN_MBPS}
+        ranks = [BenchRank.remote(world, r, "bench-quant", cfg=cfg)
+                 for r in range(world)]
+        for label, nelems in [("1MiB", 256 * 1024),
+                              ("64MiB", 16 * 1024 * 1024)]:
+            nbytes = nelems * 4
+            ray_tpu.get([r.prep.remote(nelems) for r in ranks],
+                        timeout=120)
+            fp_t, q_t = [], []
+            for _ in range(ROUNDS):
+                # interleaved A/B: box drift hits both arms equally
+                outs = ray_tpu.get(
+                    [r.allreduce_new.remote() for r in ranks],
+                    timeout=600)
+                fp_t.append(max(dt for dt, _ in outs))
+                outs = ray_tpu.get(
+                    [r.allreduce_quant.remote() for r in ranks],
+                    timeout=600)
+                q_t.append(max(dt for dt, _ in outs))
+            fp_r = median_rate(fp_t, nbytes)
+            q_r = median_rate(q_t, nbytes)
+            emit({"name": f"allreduce {label} ws{world} sim-dcn fp32",
+                  "mb_per_s": fp_r, "sim_dcn_mbps": SIM_DCN_MBPS})
+            emit({"name": f"allreduce {label} ws{world} sim-dcn int8",
+                  "mb_per_s": q_r, "sim_dcn_mbps": SIM_DCN_MBPS})
+            row = {"name": f"allreduce {label} ws{world} quant speedup",
+                   "speedup": round(q_r / max(0.001, fp_r), 2)}
+            if label == "64MiB":
+                row["bar"] = ">= 2.0"
+            emit(row)
+        ray_tpu.get([r.destroy.remote() for r in ranks], timeout=120)
+        for r in ranks:
+            ray_tpu.kill(r)
+
+        # overlap probe on an UNPACED group, 8 MiB: large enough that
+        # the ring dwarfs per-op bookkeeping, small enough for quick
+        # rounds
+        cfg.pop("collective_sim_dcn_mbps")
+        ranks = [BenchRank.remote(world, r, "bench-overlap", cfg=cfg)
+                 for r in range(world)]
+        ray_tpu.get([r.prep.remote(2 * 1024 * 1024) for r in ranks],
+                    timeout=120)
+        outs = ray_tpu.get(
+            [r.overlap_probe.remote(max(5, ROUNDS)) for r in ranks],
+            timeout=900)
+        # min over ranks: the bar holds only if EVERY rank hides
+        fracs = [max(0.0, (c - w) / c) if c > 0 else 0.0
+                 for c, w in outs]
+        emit({"name": "allreduce 8MiB ws4 overlap hidden-frac",
+              "hidden_frac": round(min(fracs), 3),
+              "comm_ms": round(max(c for c, _ in outs), 1),
+              "wait_ms": round(max(w for _, w in outs), 1),
+              "bar": ">= 0.5"})
+        ray_tpu.get([r.destroy.remote() for r in ranks], timeout=120)
+        for r in ranks:
+            ray_tpu.kill(r)
+    finally:
+        ray_tpu.shutdown()
+
+
 def bench_multi_source():
     """4 ranks on 4 simulated nodes: the cross-node (DCN) regime.
     Interleaved allreduce A/B (pipelined zero-copy TCP ring vs the
@@ -312,6 +440,9 @@ def bench_multi_source():
 
 
 def main():
+    if "--quant" in sys.argv[1:]:
+        bench_quant_overlap()
+        return
     bench_same_node()
     if not SKIP_MULTINODE:
         bench_multi_source()

@@ -475,6 +475,35 @@ _declare("collective_hierarchical", bool, True,
          "Two-level collectives when ranks are colocated: intra-node "
          "reduce over shm to a per-node leader, inter-node ring among "
          "leaders, intra-node broadcast of the result.")
+_declare("collective_quant_block", int, 256,
+         "Elements per fp32 scale block of the int8 wire codec "
+         "(quantize='int8'): wire bytes per fp32 segment are "
+         "n + 4*ceil(n/block), so larger blocks compress harder at the "
+         "cost of coarser per-block dynamic range (docs/collective.md).")
+_declare("collective_quant_min_bytes", int, 64 * 1024,
+         "Tensors at most this size skip wire quantization even when "
+         "quantize= is requested: small ops are latency-bound, and the "
+         "encode/decode passes cost more than the bytes they save.")
+_declare("collective_bucket_bytes", int, 32 * 1024 * 1024,
+         "sync_gradients splits each per-dtype gradient bucket into "
+         "sub-buckets of at most this size, each its own allreduce: "
+         "with async_op=True early buckets reduce while the caller is "
+         "still computing later gradients (backward overlap), and even "
+         "the barrier path pipelines bucket k+1's encode behind bucket "
+         "k's ring (docs/collective.md).")
+_declare("collective_topology", bool, True,
+         "Slice-aware collective scheduling: ranks carrying a "
+         "tpu_slice_name label are grouped by slice, reduced "
+         "intra-slice first (ICI shard_map collectives when a mesh is "
+         "registered, host links otherwise), and only slice leaders "
+         "ring over DCN (docs/collective.md).")
+_declare("collective_sim_dcn_mbps", float, 0.0,
+         "Debug/benchmark: pace every published collective segment to "
+         "this bandwidth (MiB/s of ENCODED bytes; 0 = off).  Models a "
+         "bytes-limited DCN link on boxes whose loopback wire is "
+         "really CPU, so the quantized-allreduce A/B measures the "
+         "regime the codec targets (the object_spill_slow_ms "
+         "injection precedent; benchmarks/collective_perf.py --quant).")
 _declare("collective_bcast_store_min_bytes", int, 4 * 1024 * 1024,
          "broadcast() payloads at least this size move over the object-"
          "transfer data plane instead of the ring — when the group spans "

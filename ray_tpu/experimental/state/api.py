@@ -735,6 +735,38 @@ def metrics_summary() -> str:
                 100.0 * pf_hits / pf_reqs))
         lines.append("")
 
+    # collective data plane (docs/collective.md): wire traffic by
+    # codec, bytes the quantized path saved, per-algo op latency, and
+    # how much async-op ring time overlapped the caller's compute
+    wire_rows = [r for r in rows
+                 if r["name"] == "ray_tpu_collective_wire_bytes"]
+    saved = _scalar("ray_tpu_collective_bytes_saved_total")
+    op_rows = [r for r in rows
+               if r["name"] == "ray_tpu_collective_op_ms"
+               and r.get("count")]
+    if wire_rows or op_rows:
+        lines.append("== Collective ==")
+        for r in sorted(wire_rows,
+                        key=lambda r: r["tags"].get("codec", "")):
+            lines.append("%-34s %14s" % (
+                f"wire bytes ({r['tags'].get('codec', '?')})",
+                f"{r.get('value', 0.0):,.0f}"))
+        if saved:
+            lines.append("%-34s %14s" % ("bytes saved (quantized)",
+                                         f"{saved:,.0f}"))
+        for r in sorted(op_rows, key=lambda r: r["tags"].get("op", "")):
+            lines.append("%-34s %10d %9.3g %9.3g" % (
+                r["tags"].get("op", "?"), r["count"],
+                r.get("p50", 0.0), r.get("p95", 0.0)))
+        hid = byname.get(("ray_tpu_collective_overlap_hidden_ms", ()))
+        if hid and hid.get("count"):
+            wait = byname.get(("ray_tpu_collective_overlap_wait_ms", ()))
+            lines.append("%-34s %9.3g / %.3g ms" % (
+                "overlap hidden/waited p50",
+                hid.get("p50", 0.0),
+                (wait or {}).get("p50", 0.0)))
+        lines.append("")
+
     # disaggregated serving (docs/serve_disagg.md): handoff movement
     # cost + per-pool latency, visible without the dashboard
     handoff_rows = [r for r in rows

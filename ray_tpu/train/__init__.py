@@ -12,7 +12,8 @@ from ray_tpu.train.base_trainer import (BackendConfig,  # noqa: F401
 from ray_tpu.train.huggingface_trainer import \
     HuggingFaceTrainer  # noqa: F401
 from ray_tpu.train.jax_trainer import (JaxConfig, JaxTrainer,  # noqa: F401
-                                       get_mesh, sync_gradients)
+                                       PendingSync, get_mesh,
+                                       sync_gradients)
 from ray_tpu.train.gbdt_trainer import (GBDTTrainer,  # noqa: F401
                                         LightGBMTrainer, SklearnPredictor,
                                         XGBoostTrainer)
@@ -34,7 +35,8 @@ from ray_tpu._private.step_stats import (instrument_step,  # noqa: F401
 __all__ = [
     "BaseTrainer", "DataParallelTrainer", "BackendConfig",
     "TrainingFailedError", "JaxTrainer", "JaxConfig", "get_mesh",
-    "sync_gradients", "step_clock", "instrument_step", "set_model_info",
+    "sync_gradients", "PendingSync", "step_clock", "instrument_step",
+    "set_model_info",
     "TorchTrainer", "TorchConfig", "prepare_model", "prepare_data_loader",
     "WorkerGroup", "TrainWorker", "make_sharded_train", "OptimizerConfig",
     "make_vision_train", "classification_loss_fn", "Predictor",

@@ -124,11 +124,12 @@ class TrainWorker:
         finally:
             os.environ.pop("RAY_TPU_TRAIN_COLLECTIVE_GROUP", None)
 
-    def host_allreduce(self, arr, op: str = "sum"):
+    def host_allreduce(self, arr, op: str = "sum", quantize=None):
         """Debug/test hook: one allreduce on the gang's host group."""
         from ray_tpu.util import collective as col
         return col.allreduce(
-            arr, os.environ["RAY_TPU_TRAIN_COLLECTIVE_GROUP"], op)
+            arr, os.environ["RAY_TPU_TRAIN_COLLECTIVE_GROUP"], op,
+            quantize=quantize)
 
     # -- train loop lifecycle ---------------------------------------------
     def start_training(self, train_fn: Callable, config: Dict[str, Any],

@@ -14,14 +14,16 @@ Two planes (SURVEY.md §5 "distributed communication backend"):
 """
 
 from ray_tpu.util.collective.collective import (  # noqa: F401
-    ReduceOp, allgather, allreduce, barrier, broadcast,
-    destroy_collective_group, get_rank, get_collective_group_size,
-    init_collective_group, is_group_initialized, recv, reduce,
-    reducescatter, send)
+    AsyncWork, ReduceOp, allgather, allreduce, allreduce_async, barrier,
+    broadcast, destroy_collective_group, get_rank,
+    get_collective_group_size, init_collective_group,
+    is_group_initialized, recv, reduce, reducescatter, register_ici_mesh,
+    send, wait_all)
 
 __all__ = [
     "ReduceOp", "init_collective_group", "destroy_collective_group",
     "is_group_initialized", "get_rank", "get_collective_group_size",
-    "allreduce", "allgather", "reducescatter", "broadcast", "reduce",
-    "send", "recv", "barrier",
+    "allreduce", "allreduce_async", "AsyncWork", "wait_all",
+    "register_ici_mesh", "allgather", "reducescatter", "broadcast",
+    "reduce", "send", "recv", "barrier",
 ]

@@ -34,11 +34,12 @@ from __future__ import annotations
 
 import json
 import pickle
+import queue
 import threading
 import time
 import uuid
 from collections import deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,10 +47,12 @@ from ray_tpu._private import rpc
 from ray_tpu._private import runtime_metrics as rtm
 from ray_tpu._private.config import CONFIG
 from ray_tpu.runtime.core_worker import get_global_worker
+from ray_tpu.util.collective import quant as _quant
 from ray_tpu.util.collective.transport import (_M_TCP_BYTES, ServeBoard,
                                                ShmArena, ShmLink, TcpLink,
                                                Window, _chunk_bounds,
-                                               _remaining, tag_seq)
+                                               _remaining, count_wire,
+                                               tag_seq)
 
 
 class ReduceOp:
@@ -85,6 +88,16 @@ _M_OP_BYTES = rtm.histogram_family(
 _M_BCAST_STORE = rtm.counter(
     "ray_tpu_collective_bcast_store_total",
     "broadcasts routed over the multi-source object-transfer plane")
+# backward-overlap accounting (docs/collective.md): per async op, how
+# long the wire work ran vs how long the caller actually blocked in
+# ``result()`` — the difference is comm time hidden behind compute
+_M_OVERLAP_HIDDEN = rtm.histogram(
+    "ray_tpu_collective_overlap_hidden_ms",
+    "per async collective op: comm time hidden behind caller compute "
+    "(op wall time minus time blocked in result())")
+_M_OVERLAP_WAIT = rtm.histogram(
+    "ray_tpu_collective_overlap_wait_ms",
+    "per async collective op: time the caller blocked in result()")
 
 # COLLECTIVE timeline slices: cap per group so chatty training loops
 # can't grow the GCS task table without bound (same rationale as the
@@ -103,6 +116,54 @@ def _as_numpy(tensor: Any) -> np.ndarray:
 # one definition
 
 
+
+
+class AsyncWork:
+    """Completion handle for a collective op enqueued with
+    ``allreduce_async`` (the chained-completion API backward-overlapped
+    gradient sync rides, docs/collective.md).
+
+    ``result()`` blocks until the op ran on the group's async worker
+    thread and returns (or re-raises) its outcome.  The first
+    ``result()`` call also settles the overlap telemetry: the op's wall
+    time minus the time actually spent blocked here is comm that was
+    hidden behind the caller's compute."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._res: Any = None
+        self._exc: Optional[BaseException] = None
+        self._t0 = rtm.now()          # enqueue time
+        self._t_done = 0.0
+        self._observed = False
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def comm_ms(self) -> Optional[float]:
+        """Enqueue-to-completion wall time; None while in flight."""
+        if not self._ev.is_set():
+            return None
+        return (self._t_done - self._t0) * 1000.0
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        t0 = rtm.now()
+        if not self._ev.wait(timeout):
+            raise TimeoutError("collective async op result timed out")
+        if not self._observed:
+            self._observed = True
+            wait_ms = (rtm.now() - t0) * 1000.0
+            _M_OVERLAP_WAIT.observe(wait_ms)
+            _M_OVERLAP_HIDDEN.observe(
+                max(0.0, (self.comm_ms() or 0.0) - wait_ms))
+        if self._exc is not None:
+            raise self._exc
+        return self._res
+
+    def _finish(self, res: Any, exc: Optional[BaseException]) -> None:
+        self._res, self._exc = res, exc
+        self._t_done = rtm.now()
+        self._ev.set()
 
 
 class _StagingPool:
@@ -221,6 +282,17 @@ class _Group:
         self._op_lock = threading.Lock()
         self._op_count = 0
         self._destroyed = threading.Event()
+        # backward-overlap engine: ops enqueued with allreduce_async run
+        # FIFO on one worker thread (started lazily), so every rank
+        # executes async ops in enqueue order — the cross-rank op-order
+        # agreement the tag protocol requires
+        self._async_q: Optional[queue.Queue] = None
+        self._async_thread: Optional[threading.Thread] = None
+        self._async_lock = threading.Lock()
+        # intra-slice in-graph reduction hook (register_ici_mesh): when
+        # set, the topology schedule reduces SUM ops across the slice
+        # inside a compiled program instead of over host links
+        self._ici_reduce = None
         try:
             self._rendezvous()
         except BaseException:
@@ -260,20 +332,28 @@ class _Group:
             gcs.kv_put(f"{base}/nonce", self.nonce.encode())
         else:
             self.nonce = self._poll_nonce(gcs, base, deadline)
+        # each rank publishes its slice label alongside the address: the
+        # topology scheduler groups ranks by slice without extra control
+        # traffic (the label mirrors the raylet's "slice" node label,
+        # docs/collective.md)
         me = json.dumps([self._server.address[0],
-                         int(self._server.address[1]), self._node])
+                         int(self._server.address[1]), self._node,
+                         CONFIG.tpu_slice_name])
         gcs.kv_put(f"{base}/{self.nonce}/{self.rank}", me.encode())
         self._addrs: Dict[int, Tuple[str, int]] = {}
         self._nodes: Dict[int, str] = {}
+        self._slices: Dict[int, str] = {}
         while len(self._addrs) < self.world_size:
             for r in range(self.world_size):
                 if r in self._addrs:
                     continue
                 raw = gcs.kv_get(f"{base}/{self.nonce}/{r}")
                 if raw is not None:
-                    host, port, node = json.loads(raw.decode())
+                    vals = json.loads(raw.decode())
+                    host, port, node = vals[0], vals[1], vals[2]
                     self._addrs[r] = (host, int(port))
                     self._nodes[r] = node
+                    self._slices[r] = vals[3] if len(vals) > 3 else ""
             if len(self._addrs) == self.world_size:
                 if self.rank == 0 or self._confirm_rank0():
                     break
@@ -281,6 +361,7 @@ class _Group:
                 # nonce: rank 0 never confirmed it — rejoin below
                 self._addrs.clear()
                 self._nodes.clear()
+                self._slices.clear()
             if self.rank != 0:
                 # a rank that read a dead incarnation's leftover nonce
                 # migrates the moment rank 0 publishes the fresh one
@@ -292,6 +373,7 @@ class _Group:
                     gcs.kv_put(f"{base}/{cur}/{self.rank}", me.encode())
                     self._addrs.clear()
                     self._nodes.clear()
+                    self._slices.clear()
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"collective group {self.name!r}: only "
@@ -460,14 +542,31 @@ class _Group:
             return False
         return (self.world_size + 1) * nbytes * 2.5 <= cap
 
-    def _hier_worthwhile(self) -> bool:
-        """Two-level only pays when it cuts INTER-NODE traffic: several
-        nodes AND colocated ranks.  A single-node group is better off
-        on the flat shm ring — funneling every byte through one leader
-        process serializes the reduction work the ring spreads across
-        ranks."""
-        nnodes = len(set(self._nodes.values()))
-        return 1 < nnodes < self.world_size
+    def _hier_worthwhile(self, reducer=None) -> bool:
+        """Hierarchy only pays when the topology collapses ranks:
+        several groups AND colocated ranks cut DCN traffic, or a
+        registered in-graph (ICI) reducer can absorb a multi-rank slice
+        entirely (SUM ops; ``register_ici_mesh`` must run on every rank
+        of the group so all ranks reach the same verdict).  A flat
+        single-node group is better off on the ring — funneling every
+        byte through one leader process serializes the reduction work
+        the ring spreads across ranks."""
+        groups = self._topo_groups()
+        if (self._ici_reduce is not None and reducer is np.add
+                and bool(CONFIG.collective_topology)
+                and len(groups) < self.world_size):
+            return True
+        return 1 < len(groups) < self.world_size
+
+    def _topo_engaged(self) -> bool:
+        """True when the slice-aware schedule actually differs from the
+        2-level node grouping (slice labels collapse nodes, or an ICI
+        reducer is registered)."""
+        if not CONFIG.collective_topology:
+            return False
+        if self._ici_reduce is not None:
+            return True
+        return len(self._topo_groups()) != len(set(self._nodes.values()))
 
     # ------------------------------------------------- small-tensor plane
     def _small_send(self, peer: int, tag: str, arr: np.ndarray,
@@ -503,13 +602,21 @@ class _Group:
     # parameterized engine is deliberate future work — change the
     # pump/publish discipline in ALL FOUR places or in none.
     def _ring_allreduce(self, flat: np.ndarray, participants: List[int],
-                        reducer, seq: int, deadline: float) -> None:
+                        reducer, seq: int, deadline: float,
+                        codec=None) -> None:
         """Pipelined ring allreduce over ``participants``, in place on
         ``flat``: reduce-scatter then allgather, each chunk segmented
         into ``collective_chunk_bytes`` pieces chained per segment —
         receiving segment (k, s) immediately reduces and publishes
         segment (k+1, s), so successive ring steps overlap (the NCCL
-        schedule, full duplex)."""
+        schedule, full duplex).
+
+        With a ``codec`` (quantize="int8"), every segment is encoded
+        before the wire and decoded into the fp32 master accumulator
+        ``flat`` on arrival: reduce-scatter hops re-encode the running
+        partial sum (one bounded rounding error per hop), allgather
+        hops forward the encoded bytes verbatim (zero added error) —
+        see quant.py for the numerics contract."""
         m = len(participants)
         if m == 1 or flat.size == 0:
             return
@@ -519,12 +626,32 @@ class _Group:
         bounds = _chunk_bounds(flat.size, m)
         se = self._seg_elems_of(flat.itemsize)
         win = Window(CONFIG.collective_inflight_segments, deadline)
-        staging = _StagingPool(win.depth, min(se, max(1, flat.size)),
-                               flat.dtype)
+        max_seg = min(se, max(1, flat.size))
+        if codec is None:
+            staging = _StagingPool(win.depth, max_seg, flat.dtype)
+        else:
+            # staging receives WIRE bytes; decode owns the payload, so
+            # slot rotation stays safe under the same issue-order rule
+            staging = _StagingPool(win.depth, codec.wire_nbytes(max_seg),
+                                   np.uint8)
 
         def segs(c):
             a, b = bounds[c]
             return [(s, min(s + se, b)) for s in range(a, b, se)]
+
+        def pub(tag, rng):
+            if codec is None:
+                count_wire("fp32", rng.nbytes, rng.nbytes)
+                nlink.publish(tag, rng, deadline)
+            else:
+                wire = codec.encode(rng)
+                count_wire(codec.name, wire.nbytes, rng.nbytes)
+                nlink.publish(tag, wire, deadline)
+
+        def dest_of(a, b):
+            if codec is None:
+                return staging.take(b - a)
+            return staging.take(codec.wire_nbytes(b - a))
 
         # own chunk's initial publishes go out lazily, one per request
         # issued below, so a bounded shm ring can never absorb a whole
@@ -534,88 +661,232 @@ class _Group:
         def pump_init():
             if init:
                 tag, arr = init.popleft()
-                nlink.publish(tag, arr, deadline)
+                pub(tag, arr)
 
         last = m - 2
 
         def rs_done(k, a, b):
             def done(arr, in_place):
                 rng = flat[a:b]
+                if codec is not None:
+                    arr = codec.decode(arr, b - a, flat.dtype)
                 reducer(rng, arr, out=rng)
                 if k < last:
-                    nlink.publish(f"{seq}:rs{k + 1}:{a}", rng, deadline)
+                    pub(f"{seq}:rs{k + 1}:{a}", rng)
                 else:
-                    nlink.publish(f"{seq}:ag0:{a}", rng, deadline)
+                    pub(f"{seq}:ag0:{a}", rng)
             return done
 
         def ag_done(k, a, b):
             def done(arr, in_place):
                 rng = flat[a:b]
+                if codec is not None:
+                    if k < last:
+                        # forward the encoded bytes verbatim: the copy
+                        # owns them (arr may view a rotating staging
+                        # slot or a shm ring slot) and no re-encode
+                        # means allgather adds no per-hop error
+                        fwd = np.array(arr, copy=True)
+                        count_wire(codec.name, fwd.nbytes, rng.nbytes)
+                        nlink.publish(f"{seq}:ag{k + 1}:{a}", fwd,
+                                      deadline)
+                    codec.decode(arr, b - a, flat.dtype, out=rng)
+                    return
                 if not in_place:
                     np.copyto(rng, arr)
                 if k < last:
-                    nlink.publish(f"{seq}:ag{k + 1}:{a}", rng, deadline)
+                    pub(f"{seq}:ag{k + 1}:{a}", rng)
             return done
 
         for k in range(m - 1):
             for a, b in segs((i - k - 1) % m):
                 pump_init()
-                win.push(plink, f"{seq}:rs{k}:{a}", staging.take(b - a),
+                win.push(plink, f"{seq}:rs{k}:{a}", dest_of(a, b),
                          rs_done(k, a, b))
         for k in range(m - 1):
             for a, b in segs((i - k) % m):
                 pump_init()
-                # allgather segments land straight in their final
-                # position in the output buffer (recv_into zero-copy)
-                win.push(plink, f"{seq}:ag{k}:{a}", flat[a:b],
+                # fp32 allgather segments land straight in their final
+                # position in the output buffer (recv_into zero-copy);
+                # quantized ones land in wire staging and decode out
+                win.push(plink, f"{seq}:ag{k}:{a}",
+                         flat[a:b] if codec is None else dest_of(a, b),
                          ag_done(k, a, b))
         while init:
             pump_init()
         win.drain()
 
-    def _hier_allreduce(self, flat: np.ndarray, reducer, seq: int,
-                        deadline: float) -> np.ndarray:
-        """Two-level allreduce: intra-node reduce to a per-node leader
-        (shm), ring among leaders (one rank per node), intra-node
-        broadcast of the result."""
-        by_node: Dict[str, List[int]] = {}
+    def _topo_groups(self) -> Dict[str, List[int]]:
+        """Topology grouping for this group's ranks, computed per op:
+        ranks carrying a slice label (published at rendezvous) group by
+        slice when ``collective_topology`` is on; unlabeled ranks group
+        by node id, so an unlabeled cluster degenerates to the classic
+        node-boundary grouping."""
+        topo = bool(CONFIG.collective_topology)
+        by: Dict[str, List[int]] = {}
         for r in range(self.world_size):
+            s = self._slices.get(r, "") if topo else ""
+            key = ("s:" + s) if s else ("n:" + self._nodes.get(r, ""))
+            by.setdefault(key, []).append(r)
+        return by
+
+    def _hier_allreduce(self, flat: np.ndarray, reducer, seq: int,
+                        deadline: float, codec=None) -> np.ndarray:
+        """Topology-scheduled hierarchical allreduce
+        (docs/collective.md).  Three levels, each engaged only where
+        the topology collapses ranks:
+
+        1. intra-node reduce to a per-node leader (shm links);
+        2. intra-slice allreduce among the slice's node leaders — via
+           the registered in-graph (ICI) reducer when one exists (SUM
+           ops reduce across the whole slice inside a compiled program
+           and level 1 is skipped entirely), else a host-link ring;
+        3. a DCN ring among slice leaders only;
+
+        then the result fans back out (slice leader -> node leaders ->
+        node members).  Unlabeled clusters run exactly the former
+        2-level node-boundary schedule (every node is its own slice,
+        level 2 is empty)."""
+        by_slice = self._topo_groups()
+        my_slice: List[int] = []
+        for rs in by_slice.values():
+            if self.rank in rs:
+                my_slice = sorted(rs)
+                break
+        slice_leaders = sorted(min(rs) for rs in by_slice.values())
+        slice_leader = my_slice[0]
+        by_node: Dict[str, List[int]] = {}
+        for r in my_slice:
             by_node.setdefault(self._nodes.get(r, ""), []).append(r)
         local = sorted(by_node[self._nodes.get(self.rank, "")])
         leader = local[0]
-        leaders = sorted(min(rs) for rs in by_node.values())
+        node_leaders = sorted(min(rs) for rs in by_node.values())
+        use_ici = (self._ici_reduce is not None and reducer is np.add
+                   and len(my_slice) > 1
+                   and bool(CONFIG.collective_topology))
         se = self._seg_elems_of(flat.itemsize)
         segs = [(a, min(a + se, flat.size))
                 for a in range(0, flat.size, se)]
-        win = Window(CONFIG.collective_inflight_segments, deadline)
-        if self.rank != leader:
-            ln = self._link(leader)
+        max_seg = min(se, max(1, flat.size))
+
+        def pool(depth):
+            if codec is None:
+                return _StagingPool(depth, max_seg, flat.dtype)
+            return _StagingPool(depth, codec.wire_nbytes(max_seg),
+                                np.uint8)
+
+        def fan_out(tag_fn, targets):
+            """Publish every segment to every target; quantized
+            payloads are encoded ONCE per segment and the same wire
+            array rides every link."""
+            if not targets:
+                return
+            links = [self._link(t) for t in targets]
             for a, b in segs:
-                ln.publish(f"{seq}:hr{self.rank}:{a}", flat[a:b],
-                           deadline)
-            for a, b in segs:
+                rng = flat[a:b]
+                payload = rng if codec is None else codec.encode(rng)
+                name = "fp32" if codec is None else codec.name
+                for ln in links:
+                    count_wire(name, payload.nbytes, rng.nbytes)
+                    ln.publish(tag_fn(a), payload, deadline)
+
+        def recv_into(win, ln, tag, a, b, staging):
+            """Window-push a receive that lands (decoded) in
+            ``flat[a:b]``."""
+            if codec is None:
                 def done(arr, in_place, a=a, b=b):
                     if not in_place:
                         np.copyto(flat[a:b], arr)
-                win.push(ln, f"{seq}:hb:{a}", flat[a:b], done)
-            win.drain()
-            return flat
-        staging = _StagingPool(win.depth, min(se, max(1, flat.size)),
-                               flat.dtype)
-        for a, b in segs:
-            for mr in local[1:]:
+                win.push(ln, tag, flat[a:b], done)
+            else:
+                def done(arr, in_place, a=a, b=b):
+                    codec.decode(arr, b - a, flat.dtype, out=flat[a:b])
+                win.push(ln, tag, staging.take(codec.wire_nbytes(b - a)),
+                         done)
+
+        def recv_reduce(win, ln, tag, a, b, staging):
+            if codec is None:
                 def done(arr, in_place, a=a, b=b):
                     rng = flat[a:b]
                     reducer(rng, arr, out=rng)
-                win.push(self._link(mr), f"{seq}:hr{mr}:{a}",
-                         staging.take(b - a), done)
-        win.drain()
-        if len(leaders) > 1:
-            self._ring_allreduce(flat, leaders, reducer, seq, deadline)
-        for mr in local[1:]:
-            ln = self._link(mr)
+                win.push(ln, tag, staging.take(b - a), done)
+            else:
+                def done(arr, in_place, a=a, b=b):
+                    rng = flat[a:b]
+                    reducer(rng, codec.decode(arr, b - a, flat.dtype),
+                            out=rng)
+                win.push(ln, tag, staging.take(codec.wire_nbytes(b - a)),
+                         done)
+
+        if use_ici:
+            # level 1+2 collapse into one in-graph reduction: every
+            # slice rank contributes and receives the slice sum with
+            # zero host-link bytes
+            reduced = self._ici_reduce(flat)
+            np.copyto(flat, np.asarray(reduced,
+                                       dtype=flat.dtype).reshape(-1))
+            if len(slice_leaders) > 1:
+                if self.rank == slice_leader:
+                    self._ring_allreduce(flat, slice_leaders, reducer,
+                                         seq, deadline, codec)
+                    fan_out(lambda a: f"{seq}:hb:{a}",
+                            [r for r in my_slice if r != self.rank])
+                else:
+                    win = Window(CONFIG.collective_inflight_segments,
+                                 deadline)
+                    staging = pool(win.depth)
+                    ln = self._link(slice_leader)
+                    for a, b in segs:
+                        recv_into(win, ln, f"{seq}:hb:{a}", a, b,
+                                  staging)
+                    win.drain()
+            return flat
+
+        if self.rank != leader:
+            # node member: contribute to my node leader, receive the
+            # finished result back
+            ln = self._link(leader)
+            fan_out(lambda a: f"{seq}:hr{self.rank}:{a}", [leader])
+            win = Window(CONFIG.collective_inflight_segments, deadline)
+            staging = pool(win.depth)
             for a, b in segs:
-                ln.publish(f"{seq}:hb:{a}", flat[a:b], deadline)
+                recv_into(win, ln, f"{seq}:hb:{a}", a, b, staging)
+            win.drain()
+            return flat
+        # level 1: star-reduce my node's members
+        if local[1:]:
+            win = Window(CONFIG.collective_inflight_segments, deadline)
+            staging = pool(win.depth)
+            for a, b in segs:
+                for mr in local[1:]:
+                    recv_reduce(win, self._link(mr),
+                                f"{seq}:hr{mr}:{a}", a, b, staging)
+            win.drain()
+        # level 2: intra-slice ring among this slice's node leaders
+        # (host links; disjoint from the DCN ring's link set, so the
+        # shared per-op tag space cannot collide)
+        if len(node_leaders) > 1:
+            self._ring_allreduce(flat, node_leaders, reducer, seq,
+                                 deadline, codec)
+        # level 3: DCN ring among slice leaders only
+        if self.rank == slice_leader and len(slice_leaders) > 1:
+            self._ring_allreduce(flat, slice_leaders, reducer, seq,
+                                 deadline, codec)
+        # fan back out: slice leader -> other node leaders of my slice
+        if len(slice_leaders) > 1 and len(node_leaders) > 1:
+            if self.rank == slice_leader:
+                fan_out(lambda a: f"{seq}:hs:{a}",
+                        [r for r in node_leaders if r != self.rank])
+            else:
+                win = Window(CONFIG.collective_inflight_segments,
+                             deadline)
+                staging = pool(win.depth)
+                ln = self._link(slice_leader)
+                for a, b in segs:
+                    recv_into(win, ln, f"{seq}:hs:{a}", a, b, staging)
+                win.drain()
+        # node leader -> node members
+        fan_out(lambda a: f"{seq}:hb:{a}", local[1:])
         return flat
 
     def _rd_allreduce(self, flat: np.ndarray, reducer, seq: int,
@@ -645,14 +916,31 @@ class _Group:
         return acc
 
     # ---------------------------------------------------------- primitives
-    def allreduce(self, tensor: Any, op: str = ReduceOp.SUM) -> np.ndarray:
+    def allreduce(self, tensor: Any, op: str = ReduceOp.SUM,
+                  quantize: Optional[str] = None) -> np.ndarray:
         x = _as_numpy(tensor)
+        # resolve the codec FIRST so an unknown name fails loudly even
+        # on sizes that would bypass quantization
+        codec = _quant.get_codec(quantize, CONFIG.collective_quant_block)
+        if codec is not None and not np.issubdtype(x.dtype, np.floating):
+            raise ValueError(
+                f"quantize={quantize!r} requires a floating dtype, "
+                f"got {x.dtype} (integer reductions must stay exact)")
         if self.world_size == 1:
             return x.copy()
+        if codec is not None and x.nbytes <= max(
+                CONFIG.collective_quant_min_bytes,
+                CONFIG.collective_small_max_bytes):
+            # too small to amortize encode + scale overhead; the
+            # threshold is config + tensor size, so every rank nulls
+            # the codec identically (callers must pass the same
+            # quantize= on every rank, like op=)
+            codec = None
         reducer = _REDUCERS[op]
         with self._op_lock:
             seq, deadline, t0 = self._begin()
-            if x.nbytes > CONFIG.collective_small_max_bytes \
+            if codec is None \
+                    and x.nbytes > CONFIG.collective_small_max_bytes \
                     and self._flat_shm_ok(x.nbytes):
                 # the arena reads the input slab-side: no private
                 # working copy needed
@@ -671,16 +959,20 @@ class _Group:
                 return out.reshape(x.shape)
             flat = np.array(x, copy=True).reshape(-1)
             if flat.nbytes <= CONFIG.collective_small_max_bytes:
-                algo = "rd"
+                algo = "rd"  # codec is always None here (size gate)
                 out = self._rd_allreduce(flat, reducer, seq, deadline)
-            elif CONFIG.collective_hierarchical and self._hier_worthwhile():
-                algo = "hier"
-                out = self._hier_allreduce(flat, reducer, seq, deadline)
+            elif CONFIG.collective_hierarchical \
+                    and self._hier_worthwhile(reducer):
+                algo = "topo" if self._topo_engaged() else "hier"
+                out = self._hier_allreduce(flat, reducer, seq, deadline,
+                                           codec)
             else:
                 algo = "ring"
                 self._ring_allreduce(flat, list(range(self.world_size)),
-                                     reducer, seq, deadline)
+                                     reducer, seq, deadline, codec)
                 out = flat
+            if codec is not None:
+                algo = f"{algo}-{codec.name}"
             self._end("allreduce", algo, x.nbytes, deadline, t0)
         if not out.flags.writeable:
             out = out.copy()
@@ -952,8 +1244,64 @@ class _Group:
     def barrier(self) -> None:
         self.allreduce(np.zeros(1, np.float32))
 
+    # -------------------------------------------------- async (overlap)
+    def allreduce_async(self, tensor: Any, op: str = ReduceOp.SUM,
+                        quantize: Optional[str] = None) -> AsyncWork:
+        """Enqueue an allreduce and return immediately with an
+        :class:`AsyncWork` handle — the chained-completion API that
+        lets a training step kick gradient sync for early buckets while
+        later gradients are still being computed.
+
+        Ops run on a single per-group worker thread in enqueue order,
+        so every rank executes async collectives in the same sequence
+        (the tag protocol requires cross-rank op-order agreement).
+        Corollary: do NOT issue sync collectives on this group while
+        async ops are in flight — fence with ``wait_all`` first.  The
+        caller must not mutate ``tensor`` until the handle resolves."""
+        h = AsyncWork()
+        with self._async_lock:
+            if self._destroyed.is_set():
+                raise RuntimeError(f"group {self.name!r} destroyed")
+            if self._async_q is None:
+                self._async_q = queue.Queue()
+                self._async_thread = threading.Thread(
+                    target=self._async_main,
+                    name=f"col-async-{self.name}", daemon=True)
+                self._async_thread.start()
+            self._async_q.put((tensor, op, quantize, h))
+        return h
+
+    def _async_main(self) -> None:
+        while True:
+            item = self._async_q.get()
+            if item is None:
+                return
+            tensor, op, quantize, h = item
+            try:
+                h._finish(self.allreduce(tensor, op, quantize=quantize),
+                          None)
+            except BaseException as e:  # handle owns delivery
+                h._finish(None, e)
+
     def destroy(self) -> None:
         self._destroyed.set()
+        with self._async_lock:
+            q, t = self._async_q, self._async_thread
+            self._async_q = self._async_thread = None
+        if q is not None:
+            q.put(None)
+            if t is not None:
+                t.join(timeout=5.0)
+            # fail anything still queued behind the sentinel so no
+            # waiter blocks forever on a dead group
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    item[3]._finish(None, RuntimeError(
+                        f"group {self.name!r} destroyed"))
         try:
             gcs = self._worker.gcs
             base = f"collective/{self.name}"
@@ -1064,8 +1412,64 @@ def get_collective_group_size(group_name: str = "default") -> int:
 
 
 def allreduce(tensor: Any, group_name: str = "default",
-              op: str = ReduceOp.SUM) -> np.ndarray:
-    return _get(group_name).allreduce(tensor, op)
+              op: str = ReduceOp.SUM,
+              quantize: Optional[str] = None) -> np.ndarray:
+    return _get(group_name).allreduce(tensor, op, quantize=quantize)
+
+
+def allreduce_async(tensor: Any, group_name: str = "default",
+                    op: str = ReduceOp.SUM,
+                    quantize: Optional[str] = None) -> AsyncWork:
+    """Non-blocking allreduce; see :meth:`_Group.allreduce_async`."""
+    return _get(group_name).allreduce_async(tensor, op,
+                                            quantize=quantize)
+
+
+def wait_all(handles: Sequence[AsyncWork],
+             timeout: Optional[float] = None) -> List[np.ndarray]:
+    """Fence: block until every handle resolves, returning results in
+    order.  The first failed op raises (after all have settled or the
+    per-handle timeout lapses)."""
+    return [h.result(timeout=timeout) for h in handles]
+
+
+def register_ici_mesh(mesh, axis: str = "data",
+                      group_name: str = "default") -> None:
+    """Register a jax Mesh so topology-scheduled allreduces fold the
+    intra-slice stage into one compiled in-graph psum
+    (``util/collective/ici.py``) instead of host links.
+
+    Contract: call on EVERY rank of the group (all ranks must reach
+    the same schedule verdict); exactly one local device per process
+    on ``axis``; SUM ops only (others keep the host schedule).  Pass
+    ``mesh=None`` to deregister."""
+    g = _get(group_name)
+    if mesh is None:
+        g._ici_reduce = None
+        return
+    g._ici_reduce = _mesh_psum_reducer(mesh, axis)
+
+
+def _mesh_psum_reducer(mesh, axis: str):
+    """Build the slice-sum callable the hierarchical schedule invokes:
+    host fp32 vector in, psum-over-``axis`` vector out (every rank of
+    the slice gets the sum, so host stages 1-2 are skipped)."""
+    import jax
+
+    from ray_tpu.util.collective import ici
+
+    def _reduce(flat: np.ndarray) -> np.ndarray:
+        dev = jax.local_devices()[0]
+        x = jax.device_put(flat, dev)
+        n = mesh.shape[axis]
+        stacked = jax.make_array_from_single_device_arrays(
+            (n,) + x.shape,
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(axis)),
+            [x.reshape((1,) + x.shape)])
+        return np.asarray(ici.psum(stacked, mesh, axis))
+
+    return _reduce
 
 
 def reduce(tensor: Any, dst_rank: int = 0, group_name: str = "default",

@@ -48,6 +48,7 @@ import numpy as np
 from ray_tpu._private import rpc
 from ray_tpu._private import runtime_metrics as rtm
 from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import CONFIG
 from ray_tpu._private.logging_utils import get_logger
 
 logger = get_logger("collective")
@@ -72,6 +73,39 @@ _M_STALL = rtm.gauge(
 _M_STALL_H = rtm.histogram(
     "ray_tpu_collective_seg_wait_ms",
     "per-segment blocking wait inside a collective op (ms)")
+# codec-tagged wire accounting (docs/collective.md): every segment the
+# ring engines publish increments wire_bytes under its codec label
+# ("fp32" for the unquantized plane), and quantized segments credit the
+# fp32-equivalent-minus-wire difference to bytes_saved — the counters
+# the MICROBENCH 2x claim and metrics_summary's Collective block read.
+_M_WIRE_BYTES = rtm.counter_family(
+    "ray_tpu_collective_wire_bytes",
+    "collective ring segment bytes published, by wire codec",
+    tag_keys=("codec",))
+_M_BYTES_SAVED = rtm.counter(
+    "ray_tpu_collective_bytes_saved_total",
+    "wire bytes saved by collective quantization (fp32-equivalent "
+    "payload minus encoded payload)")
+
+
+def count_wire(codec_name: str, wire_nbytes: int,
+               raw_nbytes: int) -> None:
+    """Wire-accounting hook for the ring engines (one call per
+    published segment).  When ``collective_sim_dcn_mbps`` > 0 it also
+    paces the publisher to that bandwidth — a debug/benchmark knob (the
+    ``object_spill_slow_ms`` injection precedent) that models a
+    bytes-limited DCN link on boxes whose loopback "wire" is really
+    CPU: the sleep is proportional to the ENCODED bytes, so a wire
+    codec's saving shows up as exactly the wall time a real
+    bandwidth-limited link would give back."""
+    mbps = CONFIG.collective_sim_dcn_mbps
+    if mbps > 0:
+        time.sleep(wire_nbytes / (mbps * 2**20))
+    if not _TELEMETRY:
+        return
+    _M_WIRE_BYTES.inc((codec_name,), wire_nbytes)
+    if raw_nbytes > wire_nbytes:
+        _M_BYTES_SAVED.inc(raw_nbytes - wire_nbytes)
 
 # a single-segment wait past this emits a COLLECTIVE_RING_STALL cluster
 # event (docs/observability.md) — well above healthy segment times, far

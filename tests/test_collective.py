@@ -91,11 +91,12 @@ class Rank:
         col.init_collective_group(world, rank, group_name=name)
 
     def op(self, opname, nelems, dtype="float32", reduce_op="sum",
-           src=0, dst=0):
+           src=0, dst=0, quantize=None):
         rng = np.random.RandomState(1000 + self.rank)
         x = rng.uniform(1.0, 2.0, nelems).astype(dtype)
         if opname == "allreduce":
-            return self.col.allreduce(x, self.name, reduce_op)
+            return self.col.allreduce(x, self.name, reduce_op,
+                                      quantize=quantize)
         if opname == "reducescatter":
             return self.col.reducescatter(x, self.name, reduce_op)
         if opname == "allgather":
@@ -109,6 +110,42 @@ class Rank:
     def barrier(self):
         self.col.barrier(self.name)
         return True
+
+    def async_overlap(self, nelems, nops, quantize=None):
+        """Issue nops async allreduces, compute while they fly, fence
+        with wait_all; returns per-op sums for correctness checks."""
+        rng = np.random.RandomState(1000 + self.rank)
+        xs = [rng.uniform(1.0, 2.0, nelems).astype("float32")
+              for _ in range(nops)]
+        hs = [self.col.allreduce_async(x, self.name, quantize=quantize)
+              for x in xs]
+        acc = 0.0  # synthetic backward: keeps the caller thread busy
+        for _ in range(50):
+            acc += float(np.sqrt(np.arange(20000,
+                                           dtype=np.float64)).sum())
+        res = self.col.wait_all(hs, timeout=120)
+        return [float(r.sum()) for r in res], acc > 0
+
+    def stub_ici(self, slice_ranks, nelems):
+        """Install a fake in-graph slice reducer: it computes the exact
+        slice sum from the test's deterministic per-rank inputs, so the
+        schedule's host stages can be asserted skipped without jax."""
+        from ray_tpu.util.collective.collective import _get
+        g = _get(self.name)
+        calls = []
+
+        def fake(flat):
+            calls.append(flat.size)
+            return np.sum([np.random.RandomState(1000 + r)
+                           .uniform(1.0, 2.0, nelems).astype("float32")
+                           for r in slice_ranks], axis=0)
+
+        g._ici_reduce = fake
+        self._ici_calls = calls
+        return True
+
+    def ici_calls(self):
+        return list(getattr(self, "_ici_calls", []))
 
     def metric(self, name):
         from ray_tpu._private import runtime_metrics as rtm
@@ -261,6 +298,7 @@ def test_collective_same_node_zero_tcp_bytes(col_cluster):
     transfer-plane route is gated to multi-node groups)."""
     name = "shm-only"
     ranks = _spawn(4, name, dict(_FAST_CFG, collective_shm_enabled=True,
+                                 collective_quant_min_bytes=2048,
                                  collective_bcast_store_min_bytes=256 *
                                  1024))
     try:
@@ -268,6 +306,9 @@ def test_collective_same_node_zero_tcp_bytes(col_cluster):
                     timeout=120)
         ray_tpu.get([r.op.remote("allreduce", 200001) for r in ranks],
                     timeout=180)
+        # the quantized path must ALSO stay on shm links same-node
+        ray_tpu.get([r.op.remote("allreduce", 200001, quantize="int8")
+                     for r in ranks], timeout=180)
         ray_tpu.get([r.op.remote("allgather", 40001) for r in ranks],
                     timeout=180)
         # 1.2 MB >= the store threshold, but single-node -> ring
@@ -290,6 +331,11 @@ def test_collective_same_node_zero_tcp_bytes(col_cluster):
                 f"same-node group moved {tcp} TCP bytes"
             assert shm is not None and shm["{}"] > 0.0
             assert bc is None or bc["{}"] == 0.0  # ring, not store
+            wire = ray_tpu.get(
+                r.metric.remote("ray_tpu_collective_wire_bytes"),
+                timeout=60)
+            assert wire is not None and \
+                wire.get('{"codec": "int8"}', 0.0) > 0.0
     finally:
         _teardown(ranks)
 
@@ -575,3 +621,180 @@ def test_sync_gradients_rides_host_allreduce(col_cluster):
     # mean of (1, 2) and (10, 20)
     assert abs(result.metrics["w0"] - 1.5) < 1e-6
     assert abs(result.metrics["b0"] - 15.0) < 1e-6
+
+
+def _quant_bound(world, exp):
+    """docs/collective.md numerics contract: <= world hops, each
+    perturbing at most blockmax/254; positive [1,2] inputs keep every
+    running blockmax under the final reduced max."""
+    return world * np.abs(exp).max() / 254.0 + 1e-6
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_quantized_allreduce_numerics(col_cluster, world):
+    """int8-quantized allreduce vs the numpy fp32 reference: every
+    ReduceOp at even AND odd world sizes on a length divisible by
+    neither the world nor the codec block, error within the documented
+    bound — and quantize=None on the same group stays byte-for-byte
+    identical to the plain fp32 plane."""
+    name = f"quant-{world}"
+    cfg = dict(_FAST_CFG, collective_quant_min_bytes=2048,
+               collective_flat_shm=False)
+    ranks = _spawn(world, name, cfg)
+    nelems = 30001  # 30001 % world != 0, % 256 != 0
+    try:
+        for reduce_op in ("sum", "product", "min", "max"):
+            xs = _inputs(world, nelems)
+            exp = _reduced(xs, reduce_op)
+            outs = ray_tpu.get(
+                [r.op.remote("allreduce", nelems, reduce_op=reduce_op,
+                             quantize="int8") for r in ranks],
+                timeout=180)
+            bound = _quant_bound(world, exp)
+            if reduce_op == "product":
+                # one hop's rounding error multiplies through the
+                # remaining partial products
+                bound *= 2.0
+            for out in outs:
+                err = np.abs(out - exp).max()
+                assert err <= bound, (reduce_op, err, bound)
+        # exactness: quantize=None must match the untouched fp32 plane
+        # bit-for-bit (same deterministic schedule, same bytes)
+        a = ray_tpu.get([r.op.remote("allreduce", nelems)
+                         for r in ranks], timeout=180)
+        b = ray_tpu.get([r.op.remote("allreduce", nelems, quantize=None)
+                         for r in ranks], timeout=180)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y)
+        labels = ray_tpu.get(ranks[0].op_labels.remote(), timeout=60)
+        assert "allreduce/ring-int8" in labels, labels
+    finally:
+        _teardown(ranks)
+
+
+def test_allreduce_async_overlap(col_cluster):
+    """The chained-completion API: allreduce_async returns immediately,
+    ops complete in enqueue order on every rank while the caller
+    computes, wait_all fences, and the overlap telemetry records how
+    much ring time the compute hid."""
+    world, nops, nelems = 3, 4, 30001
+    ranks = _spawn(world, "async-ov", _FAST_CFG)
+    try:
+        outs = ray_tpu.get(
+            [r.async_overlap.remote(nelems, nops) for r in ranks],
+            timeout=180)
+        # op i reduces the i-th fresh draw from each rank's rng stream
+        draws = [np.random.RandomState(1000 + r)
+                 .uniform(1.0, 2.0, nops * nelems).astype("float32")
+                 .reshape(nops, nelems) for r in range(world)]
+        for sums, computed in outs:
+            assert computed
+            assert len(sums) == nops
+            for i in range(nops):
+                exp = float(np.sum([d[i] for d in draws]))
+                assert abs(sums[i] - exp) / abs(exp) < 1e-5
+        hid = ray_tpu.get(ranks[0].metric.remote(
+            "ray_tpu_collective_overlap_hidden_ms"), timeout=60)
+        wait = ray_tpu.get(ranks[0].metric.remote(
+            "ray_tpu_collective_overlap_wait_ms"), timeout=60)
+        assert hid is not None and hid["{}"]["count"] == nops
+        assert wait is not None and wait["{}"]["count"] == nops
+    finally:
+        _teardown(ranks)
+
+
+def test_topology_schedule_slices(col_cluster):
+    """Ranks labeled with tpu_slice_name group by slice: allreduce
+    takes the slice-aware schedule (op label 'topo'), results match
+    numpy for fp32 and stay in bound quantized; registering an
+    in-graph (ICI) reducer on a multi-rank slice folds its host stages
+    into one call per op."""
+    world, nelems = 3, 70001
+    name = "topo-sched"
+    ranks = []
+    for r in range(world):
+        cfg = dict(_FAST_CFG, collective_flat_shm=False,
+                   collective_quant_min_bytes=2048,
+                   tpu_slice_name="sliceA" if r < 2 else "sliceB")
+        ranks.append(Rank.remote(world, r, name, cfg))
+    try:
+        exp = _reduced(_inputs(world, nelems), "sum")
+        outs = ray_tpu.get([r.op.remote("allreduce", nelems)
+                            for r in ranks], timeout=180)
+        for out in outs:
+            np.testing.assert_allclose(out, exp, rtol=2e-5)
+        labels = ray_tpu.get(ranks[0].op_labels.remote(), timeout=60)
+        assert "allreduce/topo" in labels, labels
+        # quantized variant rides the same schedule
+        outs = ray_tpu.get(
+            [r.op.remote("allreduce", nelems, quantize="int8")
+             for r in ranks], timeout=180)
+        for out in outs:
+            assert np.abs(out - exp).max() <= _quant_bound(world, exp)
+        labels = ray_tpu.get(ranks[0].op_labels.remote(), timeout=60)
+        assert "allreduce/topo-int8" in labels, labels
+        # ICI hook: slice A's ranks get a stub in-graph reducer that
+        # returns the exact slice sum — SUM ops must route through it
+        # (one call per op) and still produce the global sum
+        ray_tpu.get([r.stub_ici.remote([0, 1], nelems)
+                     for r in ranks[:2]], timeout=60)
+        outs = ray_tpu.get([r.op.remote("allreduce", nelems)
+                            for r in ranks], timeout=180)
+        for out in outs:
+            np.testing.assert_allclose(out, exp, rtol=2e-5)
+        for r in ranks[:2]:
+            calls = ray_tpu.get(r.ici_calls.remote(), timeout=60)
+            assert calls == [nelems], calls
+    finally:
+        _teardown(ranks)
+
+
+def test_sync_gradients_quantized_and_async(col_cluster):
+    """sync_gradients e2e over a 2-worker gang: an SGD run whose
+    gradient sync rides quantize="int8" diverges from the fp32 run by
+    <= 0.1% on the loss curve, and the async_op=True chained form
+    (issue -> compute -> wait fence) matches the sync form."""
+    from ray_tpu.air import ScalingConfig, session
+    from ray_tpu.train import JaxConfig, JaxTrainer
+
+    def loop(config):
+        import numpy as np
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.train import sync_gradients
+        CONFIG.update({"collective_small_max_bytes": 1024,
+                       "collective_quant_min_bytes": 2048,
+                       "collective_chunk_bytes": 64 * 1024})
+        rank = session.get_world_rank()
+        rng = np.random.RandomState(77 + rank)
+        dim, n = 4096, 32  # 16 KB grads: over the quantization floor
+        X = rng.randn(n, dim).astype(np.float32)
+        w_true = np.random.RandomState(7).randn(dim).astype(np.float32)
+        y = X @ w_true + 0.01 * rng.randn(n).astype(np.float32)
+
+        def grad_loss(w):
+            r = X @ w - y
+            return {"w": (2.0 / n) * (X.T @ r)}, float((r * r).mean())
+
+        div = 0.0
+        w_fp = np.zeros(dim, np.float32)
+        w_q = np.zeros(dim, np.float32)
+        for step in range(8):
+            g_fp, l_fp = grad_loss(w_fp)
+            g_q, l_q = grad_loss(w_q)
+            if step:
+                div = max(div, abs(l_q - l_fp) / max(abs(l_fp), 1e-9))
+            # async chained form for fp32 (overlap exercised e2e),
+            # sync quantized form for the int8 trajectory
+            pend = sync_gradients(g_fp, async_op=True)
+            sq = sync_gradients(g_q, quantize="int8")
+            sf = pend.wait()
+            w_fp = w_fp - 0.05 * sf["w"]
+            w_q = w_q - 0.05 * sq["w"]
+        session.report({"div": div, "final_loss": l_fp})
+
+    trainer = JaxTrainer(
+        loop, jax_config=JaxConfig(init_distributed=False),
+        scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["div"] <= 1e-3, result.metrics

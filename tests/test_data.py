@@ -234,8 +234,10 @@ def test_random_access_dataset(ray_start_regular):
     ds = rdata.from_items(rows, parallelism=4)
     rad = ds.to_random_access_dataset("id", num_workers=3)
     import ray_tpu as rt
-    assert rt.get(rad.get_async(27), timeout=30)["value"] == "v9"
-    assert rt.get(rad.get_async(28), timeout=30) is None   # absent key
+    # generous timeout: the first get rides the 3 RAD workers' cold
+    # start, which on a loaded 1-CPU box can far outlive the old 30s
+    assert rt.get(rad.get_async(27), timeout=180)["value"] == "v9"
+    assert rt.get(rad.get_async(28), timeout=180) is None  # absent key
     got = rad.multiget([0, 3, 146, 147, 99])
     assert [g["value"] if g else None for g in got] == \
         ["v0", "v1", None, "v49", "v33"]
