@@ -11,6 +11,9 @@ vs_baseline >= 1.0 means we meet/beat the target MFU on this chip.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import jax
@@ -104,6 +107,33 @@ def _bench_one(cfg, batch, seq, steps, warmup, peak, *,
     return out
 
 
+def _multichip_rows(timeout_s: float = 900.0):
+    """The sharded-training headline legs (docs/train_sharded.md), in a
+    fresh process: the simulated multi-device mesh needs
+    ``XLA_FLAGS=--xla_force_host_platform_device_count`` pinned before
+    first backend touch, and THIS process's backend is already live.
+    Returns the child's JSON dict ({"multichip": ..., "pipeline": ...})
+    or an error row — the headline must degrade, not die."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "benchmarks.sharded_train_bench"],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"multichip": {
+            "error": f"no JSON from sharded_train_bench (exit "
+                     f"{proc.returncode}): {tail[-1] if tail else ''}"}}
+    except Exception as e:  # noqa: BLE001 — degrade to an error row
+        return {"multichip": {"error": f"{type(e).__name__}: {e}"}}
+
+
 def main():
     from ray_tpu.models import get_config
     from ray_tpu.train.step import OptimizerConfig
@@ -186,6 +216,20 @@ def main():
     }
     if large is not None:
         out["large_model"] = large
+
+    # multi-chip headline (docs/train_sharded.md): a gpt-large-family
+    # gang on a simulated >= 4-device mesh — planner fsdp x tp layouts,
+    # int8 backward-overlapped gradient sync — surviving one injected
+    # mid-run slice preemption (``preempted: survived``, goodput/MFU
+    # ledger as referee), plus a pp=2 MPMD pipeline row whose
+    # per-microbatch submission cost is telemetry-asserted ~ 0.
+    # RAY_TPU_BENCH_MULTICHIP=0 skips (the legs cost a few minutes).
+    if os.environ.get("RAY_TPU_BENCH_MULTICHIP", "1").strip().lower() \
+            not in ("0", "false", "no", "off"):
+        rows = _multichip_rows()
+        out["multichip"] = rows.get("multichip")
+        if rows.get("pipeline") is not None:
+            out["pipeline_mpmd"] = rows["pipeline"]
     print(json.dumps(out))
 
 

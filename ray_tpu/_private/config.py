@@ -515,6 +515,18 @@ _declare("collective_bcast_store_min_bytes", int, 4 * 1024 * 1024,
 # --------------------------------------------------------------------------- #
 # Libraries                                                                   #
 # --------------------------------------------------------------------------- #
+_declare("sharded_ckpt_keep", int, 2,
+         "Sharded-checkpoint fallback chain depth "
+         "(ray_tpu/train/sharded/executor.py): each gang checkpoint "
+         "embeds pointers to this many predecessors, so a restore whose "
+         "newest shards were lost with an ungracefully killed node "
+         "walks back one interval per lost checkpoint instead of "
+         "failing the run (docs/train_sharded.md).")
+_declare("sharded_ckpt_pull_timeout_s", float, 30.0,
+         "Per-shard pull timeout on sharded-checkpoint restore: how "
+         "long a fresh gang rank waits for its parameter shard to "
+         "stripe in from the object-transfer plane before the restore "
+         "falls back to the previous checkpoint in the chain.")
 _declare("serve_http_host", str, "127.0.0.1",
          "Default serve proxy bind host (HTTPOptions overrides per app).")
 _declare("serve_http_port", int, 8000,

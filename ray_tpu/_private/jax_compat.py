@@ -13,11 +13,27 @@ installed jax so call sites stay on one spelling:
     shard_map, so it stays usable as a static bound (``range(n)``).
   - ``tpu_compiler_params``: ``pltpu.CompilerParams`` vs
     ``pltpu.TPUCompilerParams``.
+  - ``Mesh`` / ``PartitionSpec`` / ``NamedSharding``: ``jax.sharding``
+    vs the pre-0.4 spellings (``jax.interpreters.pxla.Mesh``,
+    ``jax.experimental.PartitionSpec``).  The sharded-training planner
+    (ray_tpu/train/sharded/) imports these from here so the whole
+    subsystem tracks one resolution instead of per-file try/excepts.
 """
 
 from __future__ import annotations
 
 import jax
+
+try:
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+except ImportError:                                   # pre-jax.sharding era
+    from jax.experimental import PartitionSpec        # type: ignore
+    from jax.experimental.maps import Mesh            # type: ignore
+    try:
+        from jax.experimental.pjit import \
+            NamedSharding                             # type: ignore
+    except ImportError:
+        NamedSharding = None                          # type: ignore
 
 try:
     shard_map = jax.shard_map

@@ -191,13 +191,14 @@ class CompiledDAG:
 
     def __init__(self, root: DAGNode, *, max_inflight: int = 2,
                  buffer_size_bytes: int = _DEFAULT_BUFFER_BYTES,
-                 name: str = ""):
+                 name: str = "", threaded_ops: bool = False):
         from ray_tpu.runtime.core_worker import get_global_worker
         if max_inflight < 1:
             raise ValueError("max_inflight must be >= 1")
         self._worker = get_global_worker()
         self._root = root
         self._max_inflight = int(max_inflight)
+        self._threaded_ops = bool(threaded_ops)
         self._buffer_bytes = int(buffer_size_bytes)
         self.dag_id = hashlib.sha1(
             f"{id(self)}:{time.time_ns()}".encode()).hexdigest()
@@ -428,6 +429,7 @@ class CompiledDAG:
         for aid, ops in per_actor.items():
             payload = {"dag_id": self.dag_id, "name": self.name,
                        "ops": ops, "event_cap": EXEC_EVENT_CAP,
+                       "threaded_ops": self._threaded_ops,
                        # lets the resident loop watch for this driver's
                        # death and unwind instead of leaking forever on
                        # detached actors

@@ -51,14 +51,23 @@ class DAGNode:
 
     def experimental_compile(self, *, max_inflight: int = 2,
                              buffer_size_bytes: int = 1 << 20,
-                             name: str = ""):
+                             name: str = "", threaded_ops: bool = False):
         """Compile an actor-method-only graph into a ``CompiledDAG``:
         preallocated shm channels per edge + resident actor loops, so
         ``execute()`` pays zero per-call task submission (see
-        dag/compiled_dag.py and docs/compiled_dag.md)."""
+        dag/compiled_dag.py and docs/compiled_dag.md).
+
+        ``threaded_ops=True`` gives each of an actor's ops its own
+        resident thread instead of one serial per-actor loop: an actor
+        appearing at several pipeline depths (e.g. forward AND backward
+        of an MPMD stage) can then work on different execution indices
+        concurrently — the 1F1B interleave.  Method execution stays
+        serialized per actor (the worker's method mutex); only the
+        channel waits overlap."""
         from ray_tpu.dag.compiled_dag import CompiledDAG
         return CompiledDAG(self, max_inflight=max_inflight,
-                           buffer_size_bytes=buffer_size_bytes, name=name)
+                           buffer_size_bytes=buffer_size_bytes, name=name,
+                           threaded_ops=threaded_ops)
 
     def walk(self) -> List["DAGNode"]:
         """All nodes, dependencies first, each once."""

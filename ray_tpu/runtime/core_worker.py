@@ -92,6 +92,11 @@ _M_FETCH_LOCAL = rtm.counter(
 _M_FETCH_REMOTE = rtm.counter(
     "ray_tpu_fetch_remote_pulls_total",
     "borrowed-object fetches that had to pull from a remote node")
+_M_ACTOR_SUBMITS = rtm.counter(
+    "ray_tpu_actor_tasks_submitted_total",
+    "classic actor-task submissions from this process; a compiled-DAG "
+    "hot loop must NOT move this (the zero-submission contract the "
+    "pipeline runner asserts, docs/compiled_dag.md)")
 
 
 class ObjectRef:
@@ -1204,8 +1209,13 @@ class CoreWorker:
                     "timeout": min(t, 2.0) if t is not None else 2.0,
                 }, timeout=CONFIG.gcs_rpc_timeout_s)
             except (ConnectionError, rpc.RemoteError, OSError):
+                data = self._orphan_borrower_fetch(ref, deadline, pin_out)
+                if data is not None:
+                    return data
                 raise exc.ObjectLostError(
-                    f"owner of {ref} unreachable at {ref.owner_addr}")
+                    f"owner of {ref} unreachable at {ref.owner_addr} and "
+                    f"no surviving copy found (evacuation hints + live-"
+                    f"node sweep)")
             if res is not None:
                 if "data" in res:
                     return memoryview(res["data"])
@@ -1217,6 +1227,32 @@ class CoreWorker:
             if deadline is not None and time.monotonic() >= deadline:
                 return None
             time.sleep(0.01)
+
+    def _orphan_borrower_fetch(self, ref: ObjectRef,
+                               deadline: Optional[float],
+                               pin_out: Optional[list] = None
+                               ) -> Optional[memoryview]:
+        """Owner-death fallback for borrowed refs (docs/fault_tolerance.md):
+        the bytes may well outlive the owner — a drained node evacuated
+        its primaries into survivors (GCS hint table), or the copy sits
+        in a surviving node's store while only the owning *process* died
+        (sharded train checkpoints put by gang workers outlive the gang
+        teardown exactly this way).  Consult the hint table, then sweep
+        the live nodes with one striped pull; raylets that answer
+        "absent" drop out of the source set inside the engine."""
+        oid = ref.id
+        nodes: set = set()
+        try:
+            hints = self.gcs.call("get_evacuated_locations",
+                                  {"object_ids": [oid.hex()]}, timeout=5)
+            nodes |= set((hints or {}).get(oid.hex(), ()))
+        except (ConnectionError, rpc.RpcError, TimeoutError, OSError):
+            pass
+        nodes |= self._alive_node_ids()
+        nodes.discard(self.node_id)   # local shm was already tried
+        if not nodes:
+            return None
+        return self._fetch_from_location_set(ref, nodes, deadline, pin_out)
 
     def _merge_evacuated_locations(self, oid: ObjectID,
                                    entry: _OwnedObject,
@@ -2684,6 +2720,7 @@ class CoreWorker:
                           concurrency_group: Optional[str] = None
                           ) -> List[ObjectRef]:
         num_returns = normalize_num_returns(num_returns)
+        _M_ACTOR_SUBMITS.inc()
         task_id = TaskID.from_random()
         aid = actor_id.hex()
         spec = {

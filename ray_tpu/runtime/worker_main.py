@@ -264,10 +264,30 @@ class _CompiledDagRunner:
         except BaseException:
             self._release()
             raise
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True,
-            name=f"dag-loop-{self.dag_id[:8]}")
-        self._thread.start()
+        # threaded_ops (docs/compiled_dag.md): one resident thread PER OP
+        # instead of one serial per-actor loop, so an actor appearing at
+        # several pipeline depths (MPMD stage forward + backward) can
+        # overlap execution indices — forward of microbatch t+1 proceeds
+        # while backward of t still waits on its input channel.  Method
+        # calls stay serialized through worker._method_mutex in _run_op;
+        # only channel waits run concurrently.
+        self.threaded = bool(payload.get("threaded_ops")) \
+            and len(self.ops) > 1
+        self._live_loops = len(self.ops) if self.threaded else 1
+        self._live_lock = threading.Lock()
+        if self.threaded:
+            self._threads = [
+                threading.Thread(
+                    target=self._op_loop, args=(op,), daemon=True,
+                    name=f"dag-loop-{self.dag_id[:8]}-op{i}")
+                for i, op in enumerate(self.ops)]
+            for t in self._threads:
+                t.start()
+        else:
+            self._threads = [threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"dag-loop-{self.dag_id[:8]}")]
+            self._threads[0].start()
         if self.job_id:
             # a driver that dies without teardown() never poisons the
             # channels: on a detached actor this loop (and its channel
@@ -325,23 +345,54 @@ class _CompiledDagRunner:
             pass        # poisoned (teardown / participant death): unwind
         except Exception:
             logger.exception("compiled DAG %s loop failed", self.dag_id[:8])
-            # the loop dying with the actor still ALIVE is invisible to
-            # the driver's liveness poll: poison every attached channel
-            # so blocked peers unwind with DAGUnavailableError instead
-            # of hanging forever
-            for ch in self._channels.values():
-                try:
-                    ch.poison(self._chan_mod.POISON_WORKER_DIED)
-                except Exception:
-                    pass
+            self._poison_all()
         finally:
-            self._release()
-            # self-remove so an unwound loop (driver death, poison, or
-            # crash) doesn't leave a dead entry; _dag_teardown pops
-            # before calling shutdown(), so this is a no-op there
-            with self.worker._dag_lock:
-                if self.worker._dag_runners.get(self.dag_id) is self:
-                    del self.worker._dag_runners[self.dag_id]
+            self._loop_done()
+
+    def _op_loop(self, op) -> None:
+        """threaded_ops variant: one op, own execution-index counter.
+        Per-channel FIFO order keeps indices aligned across threads."""
+        from ray_tpu.exceptions import ChannelError
+        idx = 0
+        try:
+            while not self._stop.is_set():
+                self._run_op(op, idx)
+                idx += 1
+        except ChannelError:
+            pass
+        except Exception:
+            logger.exception("compiled DAG %s op %s loop failed",
+                             self.dag_id[:8], op["method"])
+            self._poison_all()
+        finally:
+            self._loop_done()
+
+    def _poison_all(self) -> None:
+        # a loop dying with the actor still ALIVE is invisible to the
+        # driver's liveness poll: poison every attached channel so
+        # blocked peers unwind with DAGUnavailableError instead of
+        # hanging forever
+        for ch in self._channels.values():
+            try:
+                ch.poison(self._chan_mod.POISON_WORKER_DIED)
+            except Exception:
+                pass
+
+    def _loop_done(self) -> None:
+        """Last loop thread out releases the channel pins and
+        self-removes; earlier exits only signal the others to stop."""
+        self._stop.set()
+        with self._live_lock:
+            self._live_loops -= 1
+            if self._live_loops > 0:
+                return
+        self._release()
+        # self-remove so an unwound loop (driver death, poison, or
+        # crash) doesn't leave a dead entry; _dag_teardown pops
+        # before calling shutdown(), so this is a no-op there
+        with self.worker._dag_lock:
+            if self.worker._dag_runners.get(self.dag_id) is self:
+                del self.worker._dag_runners[self.dag_id]
 
     def _record(self, idx: int, state: str, method: str, **extra) -> None:
         if idx >= self.event_cap:
@@ -426,7 +477,8 @@ class _CompiledDagRunner:
         """Teardown: the driver has already poisoned the channels, so a
         blocked read/write is waking up; stop, join, release pins."""
         self._stop.set()
-        self._thread.join(timeout=5.0)
+        for t in self._threads:
+            t.join(timeout=5.0)
         self._release()
 
 

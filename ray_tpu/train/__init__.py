@@ -27,6 +27,12 @@ from ray_tpu.train.torch_trainer import (TorchConfig,  # noqa: F401
                                          TorchTrainer, prepare_data_loader,
                                          prepare_model)
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401
+# the sharded-training subsystem (docs/train_sharded.md): GSPMD layout
+# planner + gang executor + MPMD pipeline over compiled-DAG channels
+from ray_tpu.train.sharded import (LayoutPlan,  # noqa: F401
+                                   PipelineRunner, PipelineSpec,
+                                   ShardedRunConfig, ShardedTrainer,
+                                   ShardingConfig)
 # training performance plane (docs/observability.md): the per-step
 # phase clock + goodput ledger a train loop drives
 from ray_tpu._private.step_stats import (instrument_step,  # noqa: F401
@@ -43,4 +49,6 @@ __all__ = [
     "JaxPredictor", "BatchPredictor", "GBDTTrainer", "XGBoostTrainer",
     "LightGBMTrainer", "SklearnPredictor",
     "lm_loss_fn", "lm_loss_chunked_fn", "HuggingFaceTrainer",
+    "ShardingConfig", "LayoutPlan", "ShardedRunConfig", "ShardedTrainer",
+    "PipelineSpec", "PipelineRunner",
 ]

@@ -12,16 +12,12 @@ step function (see ray_tpu.train.step.make_sharded_train).
 from __future__ import annotations
 
 import os
-import threading
 import uuid
 from typing import Any, Callable, Dict, Optional
 
-from ray_tpu._private.config import CONFIG
 from ray_tpu.air.config import RunConfig, ScalingConfig
 from ray_tpu.train.base_trainer import (BackendConfig, DataParallelTrainer,
                                         WorkerGroup)
-
-_local = threading.local()
 
 
 class JaxConfig(BackendConfig):
@@ -288,56 +284,10 @@ def _sync_gradients_issue(tree, group_name, op, average, world, quantize,
                        record=async_op)
 
 
-def get_mesh(mesh_shape: Optional[Dict[str, int]] = None):
-    """Build (and cache, per train-loop) the device mesh for this run.
-
-    Inside a JaxTrainer loop, reads the mesh shape from the trainer's
-    ScalingConfig when not given explicitly. Axis sizes of -1 absorb
-    remaining devices.
-    """
-    import jax
-    from jax.experimental import mesh_utils
-    from jax.sharding import Mesh
-
-    if mesh_shape is None:
-        mesh_shape = getattr(_local, "mesh_shape", None) or {}
-    cached = getattr(_local, "mesh", None)
-    if cached is not None and getattr(_local, "mesh_shape", None) == mesh_shape:
-        return cached
-
-    n = jax.device_count()
-    if not mesh_shape:
-        # the configurable default layout ({"data": -1} unless
-        # overridden): -1 absorbs every device below
-        mesh_shape = dict(CONFIG.mesh_default_axes) or {"data": n}
-    names = list(mesh_shape.keys())
-    sizes = list(mesh_shape.values())
-    wild = [i for i, v in enumerate(sizes) if v == -1]
-    if len(wild) > 1:
-        raise ValueError("at most one mesh axis may be -1")
-    fixed = 1
-    for v in sizes:
-        if v != -1:
-            fixed *= v
-    if wild:
-        if n % fixed:
-            raise ValueError(f"{n} devices not divisible by {fixed}")
-        sizes[wild[0]] = n // fixed
-    else:
-        total = 1
-        for v in sizes:
-            total *= v
-        if total != n:
-            raise ValueError(
-                f"mesh {dict(zip(names, sizes))} needs {total} devices, "
-                f"have {n}")
-    devices = mesh_utils.create_device_mesh(tuple(sizes))
-    mesh = Mesh(devices, tuple(names))
-    _local.mesh = mesh
-    _local.mesh_shape = dict(zip(names, sizes))
-    return mesh
-
-
-def set_loop_mesh_shape(shape: Optional[Dict[str, int]]) -> None:
-    _local.mesh_shape = shape
-    _local.mesh = None
+# The mesh authority moved to the layout planner
+# (ray_tpu/train/sharded/layout.py): one code path resolves ScalingConfig
+# mesh shapes, ShardingConfigs and the MULTICHIP dryrun layouts.  These
+# re-exports keep the historical `from ray_tpu.train import get_mesh`
+# spelling working.
+from ray_tpu.train.sharded.layout import (get_mesh,  # noqa: F401,E402
+                                          set_loop_mesh_shape)

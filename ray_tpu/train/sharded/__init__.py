@@ -1,0 +1,33 @@
+"""ray_tpu.train.sharded: the sharded-training executor subsystem.
+
+Three layers (docs/train_sharded.md):
+
+  - :mod:`~ray_tpu.train.sharded.layout` — the GSPMD layout planner and
+    the repo's single mesh authority: ``ShardingConfig`` (dp/fsdp/cp/tp/pp
+    degrees) -> mesh + canonical ``PartitionSpec`` table per
+    parameter/activation class.
+  - :mod:`~ray_tpu.train.sharded.executor` — the gang executor:
+    WorkerGroup spawn, jax.distributed bootstrap, ICI-mesh registration
+    with the topology schedule, backward-overlapped int8 gradient sync,
+    sharded checkpoints through the object-transfer plane.
+  - :mod:`~ray_tpu.train.sharded.pipeline` — the MPMD pipeline runner:
+    pp>1 stage actors compiled into one CompiledDAG over shm channels
+    (zero per-microbatch task submission, 1F1B schedule).
+"""
+
+from ray_tpu.train.sharded.layout import (LayoutPlan,  # noqa: F401
+                                          ShardingConfig, dryrun_plans,
+                                          get_mesh, plan,
+                                          set_loop_mesh_shape)
+from ray_tpu.train.sharded.executor import (ShardedRunConfig,  # noqa: F401
+                                            ShardedTrainer,
+                                            make_grad_apply_step)
+from ray_tpu.train.sharded.pipeline import (PipelineSpec,  # noqa: F401
+                                            PipelineRunner, gpt_stage_specs)
+
+__all__ = [
+    "ShardingConfig", "LayoutPlan", "plan", "get_mesh",
+    "set_loop_mesh_shape", "dryrun_plans",
+    "ShardedTrainer", "ShardedRunConfig", "make_grad_apply_step",
+    "PipelineRunner", "PipelineSpec", "gpt_stage_specs",
+]

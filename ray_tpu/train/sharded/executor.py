@@ -1,0 +1,430 @@
+"""Gang executor: the sharded-training flagship loop.
+
+End-to-end wiring of the planes the repo has been building
+(docs/train_sharded.md):
+
+  - gang spawn through :class:`~ray_tpu.train.worker_group.WorkerGroup`
+    + ``jax.distributed`` bootstrap (JaxConfig),
+  - the layout planner's mesh/specs compiled into a SPLIT train step —
+    ``grad_fn`` (jitted fwd+bwd) / host-plane
+    ``sync_gradients(quantize="int8", async_op=True)`` / ``apply_fn``
+    (jitted optimizer, donated state) — so cross-runtime data
+    parallelism rides the DCN collective plane while fsdp/tp stay
+    compiled into the step,
+  - ICI-mesh registration with the PR 16 topology schedule when the
+    gang shares one jax.distributed runtime,
+  - sharded checkpoints through the object-transfer plane: each rank
+    puts its leaf partition, refs land in the GCS KV, restore stripes
+    the partitions back in and walks a fallback chain when shards died
+    with a node.
+
+Elasticity is inherited from DataParallelTrainer's gang recovery
+(docs/fault_tolerance.md): a preempted node fails the incarnation, the
+driver harvests the newest checkpoint and restarts the gang; lost work
+is bounded by ``checkpoint_interval`` (+1 interval per checkpoint lost
+to an ungraceful kill, see CONFIG.sharded_ckpt_keep).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.train.sharded.layout import ShardingConfig
+
+_KV_PREFIX = "shardckpt"
+
+
+# ---------------------------------------------------------------------------
+# split grad/apply step
+# ---------------------------------------------------------------------------
+
+def make_grad_apply_step(model, mesh, optimizer=None, rules=None,
+                         loss_fn=None, example_batch=None, z_loss=None):
+    """Split variant of :func:`ray_tpu.train.step.make_sharded_train`.
+
+    Returns ``(init_fn, grad_fn, apply_fn, state_shardings,
+    batch_sharding)``:
+
+      - ``grad_fn(state, batch) -> (grads, metrics)`` — jitted forward +
+        backward, grads land in the params' shardings,
+      - ``apply_fn(state, grads) -> state`` — jitted optimizer update
+        with donated state.
+
+    The split exists so a *host-plane* reduction can run between the
+    two: ``sync_gradients`` sees materialized per-rank gradients, and
+    with ``async_op=True`` the ring overlaps the host-side work between
+    issue and fence.  The fused single-jit step stays the right call
+    when the reduction is compiled into the graph instead.
+    """
+    import jax
+
+    from ray_tpu.parallel.sharding import LOGICAL_RULES
+    from ray_tpu.train.step import (OptimizerConfig, TrainState, lm_loss_fn,
+                                    trace_state_shardings)
+    optimizer = optimizer or OptimizerConfig()
+    rules = rules or LOGICAL_RULES
+    loss_fn = loss_fn or lm_loss_fn
+    tx = optimizer.make()
+    if z_loss is None:
+        z_loss = getattr(getattr(model, "cfg", None), "z_loss", 0.0)
+
+    def build_state(rng, batch) -> TrainState:
+        variables = model.init(rng, batch["tokens"][:, :-1])
+        return TrainState.create(apply_fn=model.apply,
+                                 params=variables["params"], tx=tx)
+
+    from ray_tpu._private.jax_compat import NamedSharding, PartitionSpec
+    state_shardings, batch_sharding = trace_state_shardings(
+        build_state, example_batch, mesh, rules, batch_axes=("batch", None))
+    param_shardings = state_shardings.params
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    def grad(state, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            lambda p: loss_fn(state.apply_fn, p, batch, z_loss),
+            has_aux=True)(state.params)
+        return grads, dict(metrics)
+
+    def apply(state, grads):
+        return state.apply_gradients(grads=grads)
+
+    init_fn = jax.jit(build_state, out_shardings=state_shardings)
+    grad_fn = jax.jit(grad,
+                      in_shardings=(state_shardings, batch_sharding),
+                      out_shardings=(param_shardings, repl))
+    apply_fn = jax.jit(apply,
+                       in_shardings=(state_shardings, param_shardings),
+                       out_shardings=state_shardings,
+                       donate_argnums=(0,))
+    return init_fn, grad_fn, apply_fn, state_shardings, batch_sharding
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpoints over the object-transfer plane
+# ---------------------------------------------------------------------------
+
+def _kv_key(tag: str, step: int, rank) -> str:
+    return f"{_KV_PREFIX}/{tag}/{step}/{rank}"
+
+
+def _gcs():
+    from ray_tpu.runtime import core_worker as cw
+    return cw.get_global_worker().gcs
+
+
+def save_sharded_checkpoint(state, *, tag: str, step: int, rank: int,
+                            world: int, keep_alive: List[Any]) -> None:
+    """Put this rank's leaf partition and register the ref in the GCS KV.
+
+    The state's flat leaves are partitioned round-robin across ranks
+    (leaf i belongs to rank ``i % world``), so checkpoint bytes spread
+    ~evenly over the gang's nodes and a restore stripes from every node
+    at once.  ``keep_alive`` must outlive the checkpoint's usefulness:
+    dropping the ref frees the shard (owner refcount).
+    """
+    import jax
+    import numpy as np
+
+    import ray_tpu
+
+    leaves = jax.tree_util.tree_leaves(state)
+    mine = {i: np.asarray(leaf) for i, leaf in enumerate(leaves)
+            if i % world == rank}
+    ref = ray_tpu.put({"step": step, "rank": rank, "leaves": mine})
+    keep_alive.append(ref)
+    from ray_tpu.runtime import core_worker as cw
+    node = cw.get_global_worker().node_id
+    _gcs().kv_put(_kv_key(tag, step, rank),
+                  pickle.dumps({"ref": ref, "node": node,
+                                "n_leaves": len(leaves)}))
+
+
+def make_checkpoint_meta(*, tag: str, step: int, world: int,
+                         chain: List[int]) -> Dict[str, Any]:
+    """The rank-0 report checkpoint: no tensor bytes, just the KV
+    coordinates plus the fallback chain of earlier checkpointed steps
+    (newest first)."""
+    return {"kind": "sharded_kv", "tag": tag, "step": step,
+            "world": world, "chain": list(chain)}
+
+
+class ShardRestoreError(RuntimeError):
+    """Every checkpoint in the chain had at least one unrecoverable
+    shard."""
+
+
+def restore_sharded_checkpoint(meta: Dict[str, Any], state):
+    """Rebuild ``state`` from a sharded checkpoint, walking the chain.
+
+    Pulls every rank's partition (striped, multi-source: each shard
+    lives on whichever node put or inherited it — the PR 5 pull engine
+    and the PR 15 evacuation/orphan-fetch paths do the finding),
+    reassembles the flat leaf list, and device_puts each leaf with the
+    live state's sharding.  Returns ``(state, step)``; falls back one
+    chain entry per missing shard set.
+    """
+    import jax
+
+    import ray_tpu
+    from ray_tpu._private.config import CONFIG
+
+    tag, world = meta["tag"], meta["world"]
+    treedef = jax.tree_util.tree_structure(state)
+    shardings = [x.sharding for x in jax.tree_util.tree_leaves(state)]
+    gcs = _gcs()
+    errors = []
+    for step in meta["chain"]:
+        try:
+            parts = []
+            for rank in range(world):
+                raw = gcs.kv_get(_kv_key(tag, step, rank))
+                if raw is None:
+                    raise ShardRestoreError(
+                        f"step {step}: no KV entry for rank {rank}")
+                parts.append(pickle.loads(raw))
+            payloads = ray_tpu.get(
+                [p["ref"] for p in parts],
+                timeout=CONFIG.sharded_ckpt_pull_timeout_s)
+            leaves_np: Dict[int, Any] = {}
+            for payload in payloads:
+                leaves_np.update(payload["leaves"])
+            n = parts[0]["n_leaves"]
+            if sorted(leaves_np) != list(range(n)):
+                raise ShardRestoreError(
+                    f"step {step}: leaf partitions incomplete "
+                    f"({len(leaves_np)}/{n})")
+            leaves = [jax.device_put(leaves_np[i], shardings[i])
+                      for i in range(n)]
+            return jax.tree_util.tree_unflatten(treedef, leaves), step
+        except Exception as e:  # noqa: BLE001 — walk the chain
+            errors.append(f"step {step}: {type(e).__name__}: {e}")
+    raise ShardRestoreError(
+        "no checkpoint in the chain was restorable: " + "; ".join(errors))
+
+
+# ---------------------------------------------------------------------------
+# ICI registration (PR 16 topology schedule)
+# ---------------------------------------------------------------------------
+
+def maybe_register_ici(mesh, *, axis: str = "data",
+                       group_name: Optional[str] = None) -> bool:
+    """Register the gang's mesh with the collective topology schedule
+    when the contract holds: a multi-process jax runtime where every
+    process holds exactly one local device on ``axis`` (then the
+    intra-slice level of the hierarchical allreduce folds into one
+    in-graph psum — docs/collective.md).  Returns whether registration
+    happened; separate-runtime gangs (each worker its own device world)
+    decline, their cross-worker reduction IS the host ring."""
+    import jax
+
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.util import collective as col
+
+    group_name = group_name or os.environ.get(
+        "RAY_TPU_TRAIN_COLLECTIVE_GROUP", "")
+    if not group_name or not col.is_group_initialized(group_name):
+        return False
+    if not CONFIG.collective_topology:
+        return False
+    if jax.process_count() <= 1 or mesh.shape.get(axis, 1) <= 1:
+        return False
+    # the in-graph reducer assembles a global array from ONE local
+    # shard, so the contract is exactly one addressable device in the
+    # mesh per process (collective.register_ici_mesh)
+    local = [d for d in mesh.devices.flat
+             if d.process_index == jax.process_index()]
+    if len(local) != 1:
+        return False
+    col.register_ici_mesh(mesh, axis=axis, group_name=group_name)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the canned sharded train loop + trainer
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ShardedRunConfig:
+    """Everything the gang loop needs, picklable into train_loop_config."""
+
+    sharding: ShardingConfig = dataclasses.field(
+        default_factory=ShardingConfig)
+    model: str = "tiny"
+    model_overrides: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    num_workers: int = 2
+    steps: int = 8
+    batch_per_worker: int = 4
+    seq_len: int = 64
+    checkpoint_interval: int = 2
+    quantize: Optional[str] = "int8"
+    async_grad_sync: bool = True
+    register_ici: bool = True
+    learning_rate: float = 1e-3
+    optimizer: str = "adamw"
+    seed: int = 0
+    # slow-step throttle for chaos tests (seconds of host sleep per
+    # step), so an injected preemption reliably lands mid-run
+    step_sleep_s: float = 0.0
+    # leave one GCS-KV breadcrumb per executed (rank, step, pid): the
+    # chaos test and the bench's preemption leg count re-executed steps
+    # exactly (lost work <= checkpoint_interval)
+    kv_breadcrumbs: bool = False
+    # per-worker peak FLOPs for the goodput ledger's MFU column
+    # (0 = unknown: the ledger reports time buckets only)
+    peak_flops: float = 0.0
+
+
+def _synth_batch(cfg, vocab: int, rank: int, step: int):
+    """Deterministic per-(rank, step) token batch: DP ranks see disjoint
+    streams, a re-executed step sees identical data (exactly-once
+    semantics for the chaos test's loss bookkeeping)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(
+        (cfg.seed * 1_000_003 + step) * 65_537 + rank)
+    return {"tokens": jnp.asarray(
+        rng.integers(0, vocab, (cfg.batch_per_worker, cfg.seq_len + 1)),
+        jnp.int32)}
+
+
+def sharded_train_loop(config: Dict[str, Any]):
+    """The per-worker gang loop (module-level: workers import it)."""
+    import jax
+    import numpy as np
+
+    from ray_tpu._private import step_stats
+    from ray_tpu.air import session
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.models import GPT, get_config
+    from ray_tpu.train.jax_trainer import sync_gradients
+    from ray_tpu.train.sharded import layout
+    from ray_tpu.train.step import OptimizerConfig
+
+    cfg: ShardedRunConfig = config["run"]
+    rank = session.get_world_rank()
+    world = session.get_world_size()
+    tag = config.get("tag") or session.get_trial_id() or "sharded"
+
+    plan = layout.plan(cfg.sharding, n_devices=jax.device_count()
+                       if jax.process_count() == 1 else None)
+    mesh = plan.build_mesh()
+    model_cfg = get_config(cfg.model, **cfg.model_overrides)
+    model = GPT(model_cfg, mesh=mesh)
+    n_params = model_cfg.num_params()
+    flops_per_token = (6 * n_params
+                       + 12 * model_cfg.n_layers * model_cfg.d_model
+                       * cfg.seq_len)
+    step_stats.set_model_info(
+        flops_per_token=flops_per_token,
+        peak_flops=cfg.peak_flops or None,
+        tokens_per_step=cfg.batch_per_worker * cfg.seq_len)
+
+    batch = _synth_batch(cfg, model_cfg.vocab_size, rank, 0)
+    opt = OptimizerConfig(learning_rate=cfg.learning_rate,
+                          warmup_steps=1, decay_steps=max(10, cfg.steps),
+                          optimizer=cfg.optimizer)
+    init_fn, grad_fn, apply_fn, _, _ = make_grad_apply_step(
+        model, mesh, opt, example_batch=batch)
+    # same init seed on every DP rank: replicas must start identical,
+    # divergence is what sync_gradients prevents
+    state = init_fn(jax.random.PRNGKey(cfg.seed), batch)
+
+    if cfg.register_ici:
+        registered = maybe_register_ici(mesh)
+    else:
+        registered = False
+
+    start_step = 0
+    ckpt = session.get_checkpoint()
+    if ckpt is not None:
+        meta = ckpt.to_dict()
+        if meta.get("kind") == "sharded_kv":
+            state, start_step = restore_sharded_checkpoint(meta, state)
+            start_step += 1
+
+    clock = step_stats.step_clock()
+    loss = float("nan")
+    keep_alive: List[Any] = []
+    chain: List[int] = list(
+        (ckpt.to_dict().get("chain") if ckpt is not None else None) or [])
+    from ray_tpu._private.config import CONFIG
+    keep = max(1, int(CONFIG.sharded_ckpt_keep))
+
+    for step in range(start_step, cfg.steps):
+        if cfg.kv_breadcrumbs:
+            _gcs().kv_put(f"shardsteps/{tag}/{rank}/{step}/{os.getpid()}",
+                          b"1")
+        clock.begin()
+        with clock.phase("device_compute"):
+            grads, metrics = grad_fn(
+                state, _synth_batch(cfg, model_cfg.vocab_size, rank, step))
+        if cfg.async_grad_sync:
+            # issue the bucketed ring while the host prepares the next
+            # batch (the overlap the PendingSync fence accounts for)
+            pending = sync_gradients(grads, quantize=cfg.quantize,
+                                     async_op=True)
+            with clock.phase("host_dispatch"):
+                next_batch = _synth_batch(cfg, model_cfg.vocab_size, rank,
+                                          step + 1)
+                del next_batch  # prefetch: generation cost is the point
+            grads = pending.wait()
+        else:
+            grads = sync_gradients(grads, quantize=cfg.quantize)
+        with clock.phase("optimizer"):
+            state = apply_fn(state, grads)
+        if cfg.step_sleep_s:
+            import time
+            time.sleep(cfg.step_sleep_s)
+        loss = float(metrics["loss"])
+        clock.end()
+        out = {"step": step, "loss": loss, "rank": rank,
+               "ici_registered": registered}
+        report_ckpt = None
+        if (step + 1) % cfg.checkpoint_interval == 0 \
+                or step == cfg.steps - 1:
+            save_sharded_checkpoint(state, tag=tag, step=step, rank=rank,
+                                    world=world, keep_alive=keep_alive)
+            chain.insert(0, step)
+            del chain[keep:]
+            del keep_alive[:-keep]
+            if rank == 0:
+                report_ckpt = Checkpoint.from_dict(make_checkpoint_meta(
+                    tag=tag, step=step, world=world, chain=chain))
+        session.report(out, checkpoint=report_ckpt)
+    return {"final_loss": loss, "steps": cfg.steps,
+            "ici_registered": registered}
+
+
+class ShardedTrainer:
+    """Driver-side front end: a DataParallelTrainer running
+    :func:`sharded_train_loop` under a JaxConfig, with the planner's
+    config threaded through.  ``fit()`` returns the underlying trainer's
+    Result (gang recovery included)."""
+
+    def __init__(self, run: ShardedRunConfig, *,
+                 run_config=None, jax_config=None,
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 tag: Optional[str] = None,
+                 resume_from_checkpoint=None):
+        from ray_tpu.air.config import ScalingConfig
+        from ray_tpu.train.base_trainer import DataParallelTrainer
+        from ray_tpu.train.jax_trainer import JaxConfig
+
+        self.run = run
+        scaling = ScalingConfig(num_workers=run.num_workers,
+                                resources_per_worker=resources_per_worker)
+        self._trainer = DataParallelTrainer(
+            sharded_train_loop,
+            train_loop_config={"run": run, "tag": tag},
+            backend_config=jax_config or JaxConfig(init_distributed=False,
+                                                   platform="cpu"),
+            scaling_config=scaling,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint)
+
+    def fit(self):
+        return self._trainer.fit()

@@ -205,10 +205,12 @@ def lm_loss_chunked_fn(apply_fn: Callable, params: Any,
     return _lm_loss_body(batch, head)
 
 
-def _born_sharded(build_state, step, example_batch, mesh: Mesh,
-                  rules: ShardingRules, batch_axes=("batch",)):
-    """Shared construction: trace the state abstractly, read logical
-    PartitionSpecs, jit init (born sharded) and step (donated state)."""
+def trace_state_shardings(build_state, example_batch, mesh: Mesh,
+                          rules: ShardingRules, batch_axes=("batch",)):
+    """Trace the state abstractly and map its logical PartitionSpecs to
+    mesh shardings.  Returns (state_shardings, batch_sharding) — the
+    contract both the fused step below and the sharded executor's split
+    grad/apply step (train/sharded/executor.py) build on."""
     if example_batch is None:
         raise ValueError("example_batch is required to trace shapes")
     abstract = jax.eval_shape(build_state, jax.random.PRNGKey(0),
@@ -231,6 +233,15 @@ def _born_sharded(build_state, step, example_batch, mesh: Mesh,
     batch_sharding = jax.tree.map(
         lambda _: NamedSharding(mesh, logical_spec(batch_axes, mesh, rules)),
         example_batch)
+    return state_shardings, batch_sharding
+
+
+def _born_sharded(build_state, step, example_batch, mesh: Mesh,
+                  rules: ShardingRules, batch_axes=("batch",)):
+    """Shared construction: trace the state abstractly, read logical
+    PartitionSpecs, jit init (born sharded) and step (donated state)."""
+    state_shardings, batch_sharding = trace_state_shardings(
+        build_state, example_batch, mesh, rules, batch_axes)
     repl = NamedSharding(mesh, PartitionSpec())
     init_fn = jax.jit(build_state, out_shardings=state_shardings)
     step_fn = jax.jit(
