@@ -137,6 +137,18 @@ SECTIONS = {
                                       "telemetry_overhead.py"),
                          "--tracing", "--rounds", "4"],
                     timeout=1200),
+    # metrics-history plane cost guard (docs/observability.md): paired
+    # interleaved OFF/ON segments of metrics-shaped kv_put RPCs against
+    # an in-process GcsServer at the default retention geometry
+    # (telemetry + events pinned on); the history_overhead row carries
+    # the same <=3% bar.  4 rounds -> 64 pairs, same reasoning as the
+    # tracing arm: per-pair ratios on this box spread several percent
+    # and the median needs the draws to resolve a ~1% plane cost
+    "history": dict(cmd=[sys.executable,
+                         os.path.join(REPO, "benchmarks",
+                                      "telemetry_overhead.py"),
+                         "--history", "--rounds", "4"],
+                    timeout=1200),
     "serve_llm": dict(cmd=[sys.executable,
                            os.path.join(REPO, "benchmarks", "serve_llm.py"),
                            "--suite", "--slots", "32", "--requests", "128"],

@@ -1,6 +1,6 @@
-"""Telemetry + event + step-stats + tracing overhead guards: A/B bars.
+"""Telemetry + event + step-stats + tracing + history overhead: A/B bars.
 
-Four always-on observability planes claim record paths cheap enough to
+Five always-on observability planes claim record paths cheap enough to
 leave on in production, and this bench holds each to a <= 3% bar on its
 most instrument-dense path:
 
@@ -25,6 +25,18 @@ most instrument-dense path:
   this box's throttle drift.  The OFF arm flips CONFIG.tracing_enabled
   in the driver, which is the real kill-switch path: with no sampled
   context stamped at submission, the worker side records nothing.
+* ``python telemetry_overhead.py --history`` — metrics-history plane
+  A/B (_private/metrics_history.py; MICROBENCH ``history`` section).
+  The plane's entire cost sits GCS-side on the metrics KV ingest path
+  (each flusher write is staged and batch-folded into the retention
+  rings at the default 1s/10s/60s geometry), so the paired segments
+  drive THAT path: metrics-shaped kv_put RPCs against an in-process
+  GcsServer, OFF/ON flipped per segment via
+  CONFIG.metrics_history_enabled — the real kill switch (history_on()
+  re-resolves on the generation bump, and an in-process server shares
+  the driver's CONFIG).  Per-segment statistic = median per-write
+  latency; overhead = median of per-pair ratios, same as the
+  step-stats/tracing arms.
 
 Arms run in fresh subprocesses, **interleaved** on the same box so the
 VM-throttle drift this host suffers hits both arms equally.
@@ -265,6 +277,106 @@ def measure_tracing() -> None:
         ray_tpu.shutdown()
 
 
+def measure_history() -> None:
+    """The metrics-history A/B, paired: alternating fixed-write-count
+    OFF/ON segments of metrics-shaped kv_put RPCs against an
+    in-process GcsServer, per-segment statistic = median per-write
+    latency, overhead = median of per-pair ratios.
+
+    The history plane's only hot path is GCS-side: every metrics KV
+    write additionally stages for the per-series retention rings and
+    every 64th write folds the batch (_private/metrics_history.py), so
+    the denominator must be the real ingest path — an RPC round trip
+    into _rpc_kv_put — not a bare in-process record() call, which
+    would compare ring arithmetic against nothing.  The GCS normally
+    runs as a subprocess daemon, so
+    a driver-side CONFIG.set could never reach it; an in-process
+    GcsServer shares this process's CONFIG, which lets the paired arms
+    flip CONFIG.metrics_history_enabled per segment — the production
+    kill switch, re-resolved by history_on() on the generation bump.
+    Writes rotate over a worker-sized fan of distinct series so ring
+    appends, live-bucket overwrites, and the byte-budget sweep all get
+    exercised at the default 1s/10s/60s geometry."""
+    import statistics
+
+    from ray_tpu._private import rpc
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.runtime.gcs import GcsServer
+
+    gcs = GcsServer()
+    conn = rpc.connect(gcs.address)
+    try:
+        # one flusher write: the runtime-metrics wire shape (a handful
+        # of tagged values per series, as a real worker flush carries)
+        payload = json.dumps({
+            "type": "histogram", "description": "history bench series",
+            "values": {json.dumps({"method": f"m{i}"}):
+                       {"count": 10 + i, "sum": 1.5 * i,
+                        "buckets": [0, 1, 2, 3, 4, 0, 0, 0]}
+                       for i in range(4)},
+            "ts": time.time(), "runtime": True}).encode()
+        nseries = 32   # worker-sized flush fan of distinct series
+        seq = [0]
+
+        def segment(nwrites: int) -> float:
+            """Median per-write latency (us) over one segment."""
+            lats = []
+            for _ in range(nwrites):
+                i = seq[0] = seq[0] + 1
+                key = f"metrics/ray_tpu_bench_hist_{i % nseries}/proc"
+                t0 = time.perf_counter()
+                conn.call("kv_put", {"key": key, "value": payload})
+                lats.append(time.perf_counter() - t0)
+            return statistics.median(lats) * 1e6
+
+        def arm(on: bool, nwrites: int) -> float:
+            # the in-process server's ingest thread shares this CONFIG:
+            # the set() bumps the generation and history_on() in
+            # _rpc_kv_put re-resolves — the same path RAY_TPU doctor
+            # users take to kill the plane on a live config push
+            CONFIG.set("metrics_history_enabled", on)
+            try:
+                return segment(nwrites)
+            finally:
+                CONFIG.set("metrics_history_enabled", True)
+
+        seg_writes = 200
+        pairs = max(32, int(MIN_TIME * ROUNDS * 8))
+        arm(True, seg_writes)    # warm both paths (rings populated)
+        arm(False, seg_writes)
+        ratios, off_lats, on_lats = [], [], []
+        for i in range(pairs):
+            if i % 2 == 0:
+                off = arm(False, seg_writes)
+                on = arm(True, seg_writes)
+            else:
+                on = arm(True, seg_writes)
+                off = arm(False, seg_writes)
+            off_lats.append(off)
+            on_lats.append(on)
+            ratios.append((on - off) / off)
+        overhead_pct = round(statistics.median(ratios) * 100.0, 2)
+        off_med = round(statistics.median(off_lats), 2)
+        on_med = round(statistics.median(on_lats), 2)
+        hist_stats = conn.call("metrics_history_stats", {})
+        print(json.dumps({"name": "metrics ingest history off",
+                          "p50_us": off_med,
+                          "ops_per_s": round(1e6 / off_med, 2)}))
+        print(json.dumps({"name": "metrics ingest history on",
+                          "p50_us": on_med,
+                          "ops_per_s": round(1e6 / on_med, 2)}))
+        print(json.dumps({"name": "history_overhead",
+                          "off_p50_us": off_med, "on_p50_us": on_med,
+                          "overhead_pct": overhead_pct,
+                          "pairs": pairs, "seg_writes": seg_writes,
+                          "series": hist_stats.get("series"),
+                          "points": hist_stats.get("points"),
+                          "bytes": hist_stats.get("bytes")}))
+    finally:
+        conn.close()
+        gcs.stop()
+
+
 def _run_measure(measure_flag: str, env_overrides: dict) -> list:
     """One measurement subprocess -> its parsed JSON stdout rows."""
     env = dict(os.environ,
@@ -329,10 +441,17 @@ def main() -> None:
                     help="run one step-stats measurement arm (internal)")
     ap.add_argument("--measure-tracing", action="store_true",
                     help="run one tracing measurement arm (internal)")
+    ap.add_argument("--measure-history", action="store_true",
+                    help="run one metrics-history measurement arm "
+                         "(internal)")
     ap.add_argument("--tracing", action="store_true",
                     help="A/B the request tracing plane "
                          "(CONFIG.tracing_enabled) on the small-task "
                          "loop at the default sample rate")
+    ap.add_argument("--history", action="store_true",
+                    help="A/B the metrics-history plane "
+                         "(CONFIG.metrics_history_enabled) on the GCS "
+                         "metrics ingest path at the default retention")
     ap.add_argument("--events", action="store_true",
                     help="A/B the event plane (RAY_TPU_EVENTS) instead "
                          "of the metrics plane")
@@ -363,6 +482,9 @@ def main() -> None:
     if args.measure_tracing:
         measure_tracing()
         return
+    if args.measure_history:
+        measure_history()
+        return
     if args.tracing:
         # one subprocess, paired interleaved OFF/ON segments (see
         # measure_tracing); telemetry+events pinned on in both arms so
@@ -371,6 +493,19 @@ def main() -> None:
         # CONFIG.tracing_enabled, and the paired arms flip the CONFIG
         # flag; an env pin would force both arms ON
         rows = _run_measure("--measure-tracing", {
+            "RAY_TPU_TELEMETRY": "1", "RAY_TPU_EVENTS": "1",
+            "TELEMETRY_BENCH_ROUNDS": str(ROUNDS),
+            "TELEMETRY_BENCH_MIN_TIME": str(MIN_TIME)})
+        for row in rows:
+            print(json.dumps(row))
+        return
+    if args.history:
+        # one subprocess, paired interleaved OFF/ON segments against an
+        # in-process GcsServer (see measure_history)
+        # NOTE: no RAY_TPU_METRICS_HISTORY in the env — the env
+        # override beats CONFIG.metrics_history_enabled, and the paired
+        # arms flip the CONFIG flag; an env pin would force both arms
+        rows = _run_measure("--measure-history", {
             "RAY_TPU_TELEMETRY": "1", "RAY_TPU_EVENTS": "1",
             "TELEMETRY_BENCH_ROUNDS": str(ROUNDS),
             "TELEMETRY_BENCH_MIN_TIME": str(MIN_TIME)})

@@ -1,7 +1,7 @@
 """kill-switch: plane emission guards stay cheap; metric names unique.
 
-The observability planes (telemetry / events / step stats / tracing)
-share one kill-switch idiom: an ``enabled()`` helper that reads the
+The observability planes (telemetry / events / step stats / tracing /
+metrics history) share one kill-switch idiom: an ``enabled()`` helper that reads the
 ``RAY_TPU_*`` env var and the CONFIG flag.  That read is an env lookup
 plus a config-lock round trip — fine at binding/attach time, a real
 cost on per-emission hot paths (and the exact regression the tracing
@@ -42,6 +42,7 @@ _PLANES = {
     "ray_tpu._private.runtime_metrics": "enabled",
     "ray_tpu._private.cluster_events": "enabled",
     "ray_tpu._private.step_stats": "enabled",
+    "ray_tpu._private.metrics_history": "enabled",
     "ray_tpu.util.tracing.tracing_helper": "enabled",
 }
 

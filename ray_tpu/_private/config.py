@@ -417,6 +417,45 @@ _declare("serve_slo_tpot_ms", float, 200.0,
          "Serve SLO target: inter-token latency budget (ms/token past "
          "the first) for streaming requests; <= 0 disables the "
          "dimension.")
+_declare("metrics_history_enabled", bool, True,
+         "Metrics-history plane kill switch (RAY_TPU_METRICS_HISTORY "
+         "env wins): the GCS folds every metrics KV write into the "
+         "bounded multi-resolution history table and runs the "
+         "recovery-SLO auditor over the event stream.  Off, the GCS "
+         "keeps only latest-snapshot metrics (pre-history behavior).")
+_declare("metrics_history_resolutions", str, "1:120,10:180,60:120",
+         "History retention geometry: comma-separated res_s:slots "
+         "rings per series.  The default keeps 2 min at 1 s, 30 min "
+         "at 10 s and 2 h at 60 s; within a bucket the newest flusher "
+         "snapshot wins (snapshots are cumulative, so last-write IS "
+         "the downsample).")
+_declare("gcs_metrics_history_max_series", int, 512,
+         "Max metric series (distinct metrics/{name}/{ident} keys) the "
+         "history table retains; the longest-idle series is evicted "
+         "first, like the metrics KV staleness sweep.")
+_declare("gcs_metrics_history_max_bytes", int, 8 * 1024 * 1024,
+         "Byte budget of the metrics history table (raw payload bytes "
+         "across all rings); the hard retention gate alongside the "
+         "series cap — oldest point dropped first.")
+_declare("gcs_max_recovery_episodes", int, 256,
+         "Max closed recovery episodes the auditor retains (drain / "
+         "failover / heal); per-kind totals and violation counters "
+         "survive rotation like the event table's counts_by_type.")
+_declare("gcs_recovery_max_bytes", int, 512 * 1024,
+         "Byte budget of the recovery-episode table (JSON-serialized "
+         "episode sizes), alongside the episode count cap.")
+_declare("recovery_slo_drain_s", float, 0.0,
+         "Drain SLO: budget for NODE_PREEMPTING -> NODE_DRAINED per "
+         "episode (s).  <= 0 uses each episode's advertised grace "
+         "window — the budget the raylet promised to finish inside.")
+_declare("recovery_slo_failover_s", float, 120.0,
+         "Failover SLO: budget from the first failure event "
+         "(NODE_PREEMPTING/NODE_DEAD) to TRAIN_GANG_RECOVERY (s); "
+         "<= 0 disables classification.")
+_declare("recovery_slo_heal_s", float, 90.0,
+         "Pool-heal SLO: budget from REPLICA_RETIRED to the next "
+         "AUTOSCALE target change for that deployment (s); <= 0 "
+         "disables classification.")
 
 # --------------------------------------------------------------------------- #
 # TPU / device model                                                          #

@@ -924,8 +924,22 @@ class Server:
             conn = Connection(sock, handler=self._handler,
                               on_close=self._conn_closed,
                               fast_methods=self._fast_methods)
-            with self._lock:
+            self._register_conn(conn)
+
+    def _register_conn(self, conn: Connection) -> bool:
+        """Track a freshly accepted connection; closes it instead when
+        stop() already ran.  An accept landing between stop()'s
+        ``_stopped.set()`` and its ``connections()`` snapshot would
+        otherwise never be closed — its live server-side reader then
+        silently consumes the client's pushes forever, so the client
+        never observes EOF and hangs instead of getting a
+        ConnectionError."""
+        with self._lock:
+            if not self._stopped.is_set():
                 self._conns.add(conn)
+                return True
+        conn.close()
+        return False
 
     def _conn_closed(self, conn: Connection) -> None:
         with self._lock:

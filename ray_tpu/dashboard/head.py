@@ -74,6 +74,9 @@ class DashboardHead:
             web.get("/api/training", self._training),
             web.get("/api/traces", self._traces),
             web.get("/api/traces/{trace_id}", self._trace),
+            web.get("/api/history", self._history),
+            web.get("/api/recovery", self._recovery),
+            web.get("/api/doctor", self._doctor),
             web.get("/api/profile", self._profile),
             web.get("/metrics", self._metrics),
             web.get("/", self._index),
@@ -244,6 +247,49 @@ class DashboardHead:
     async def _dossiers(self, request) -> web.Response:
         out = await self._call(lambda: self.gcs.call("list_dossiers"))
         return web.json_response({"dossiers": out})
+
+    async def _history(self, request) -> web.Response:
+        """Metrics-history plane (docs/observability.md): windowed
+        points per series from the GCS retention rings."""
+        q = request.query
+        try:
+            limit = int(q.get("limit", 2000))
+            since = float(q["since"]) if "since" in q else None
+            resolution = (float(q["resolution"])
+                          if "resolution" in q else None)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text="limit/since/resolution must be numeric") from None
+        points = await self._call(
+            lambda: self.gcs.call("list_metrics_history", {
+                "name": q.get("name"), "ident": q.get("ident"),
+                "since": since, "resolution": resolution,
+                "limit": limit}))
+        stats = await self._call(
+            lambda: self.gcs.call("metrics_history_stats", {}))
+        return web.json_response({"points": points, "stats": stats})
+
+    async def _recovery(self, request) -> web.Response:
+        """Recovery auditor: derived drain/failover/heal episodes with
+        SLO verdicts, plus the rotation-surviving counters."""
+        q = request.query
+        try:
+            limit = int(q.get("limit", 100))
+        except ValueError:
+            raise web.HTTPBadRequest(text="limit must be an integer") \
+                from None
+        episodes = await self._call(
+            lambda: self.gcs.call("list_recovery_episodes", {
+                "kind": q.get("kind"), "limit": limit}))
+        stats = await self._call(
+            lambda: self.gcs.call("recovery_stats", {}))
+        return web.json_response({"episodes": episodes, "stats": stats})
+
+    async def _doctor(self, request) -> web.Response:
+        """Cross-plane correlation report (ranked findings)."""
+        report = await self._call(
+            lambda: self.gcs.call("doctor_report", {}))
+        return web.json_response(report)
 
     async def _dossier(self, request) -> web.Response:
         """One crash dossier; ``?format=text`` pretty-prints it."""

@@ -909,3 +909,113 @@ def metrics_summary() -> str:
                                            extra))
 
     return "\n".join(lines) if lines else "(no metrics published yet)"
+
+
+# ------------------------------------------------------- sixth plane
+# metrics history + recovery auditing + doctor (docs/observability.md)
+def list_metrics_history(name: Optional[str] = None, *,
+                         ident: Optional[str] = None,
+                         since: Optional[float] = None,
+                         resolution: Optional[float] = None,
+                         limit: int = 2000) -> List[dict]:
+    """Windowed metric points from the GCS history rings, oldest first
+    (``resolution`` picks the ring with the closest bucket width; the
+    finest by default).  Each point: ``ts``/``res_s``/``name``/
+    ``ident``/``type``/``values`` — the flusher snapshot that closed
+    that bucket."""
+    return _gcs().call("list_metrics_history", {
+        "name": name, "ident": ident, "since": since,
+        "resolution": resolution, "limit": limit})
+
+
+def metrics_history_stats(*, series: bool = False) -> dict:
+    return _gcs().call("metrics_history_stats", {"series": series})
+
+
+def list_recovery_episodes(kind: Optional[str] = None, *,
+                           include_open: bool = True,
+                           limit: int = 100) -> List[dict]:
+    """Recovery episodes the auditor derived from the event plane:
+    ``drain`` (NODE_PREEMPTING -> NODE_DRAINED), ``failover`` (first
+    failure event -> TRAIN_GANG_RECOVERY) and ``heal``
+    (REPLICA_RETIRED -> AUTOSCALE), each with ``latency_s`` and its
+    SLO verdict."""
+    return _gcs().call("list_recovery_episodes", {
+        "kind": kind, "include_open": include_open, "limit": limit})
+
+
+def recovery_stats() -> dict:
+    return _gcs().call("recovery_stats", {})
+
+
+def doctor_report() -> dict:
+    """The cross-plane correlation report (``ray-tpu doctor``): ranked
+    findings with evidence lines, assembled GCS-side from one snapshot
+    of all six observability planes."""
+    return _gcs().call("doctor_report", {})
+
+
+def doctor_report_text() -> str:
+    from ray_tpu._private.metrics_history import format_doctor_report
+    return format_doctor_report(doctor_report())
+
+
+def collect_debug_bundle(path: str) -> Dict[str, Any]:
+    """One-shot forensics export (``ray-tpu debug-bundle``): a gzipped
+    tarball of every observability plane as JSON — events + dossiers,
+    traces, metrics (snapshot AND history window), step stats,
+    recovery episodes, the doctor report (json + rendered text) and
+    the merged Perfetto timeline.  Returns a manifest of member names
+    and sizes so callers (and tests) can assert on the contents."""
+    import io
+    import tarfile
+    import time as _time
+
+    def _collect(fn):
+        try:
+            return fn()
+        except Exception as e:   # a missing plane must not sink the rest
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    gcs = _gcs()
+    members: Dict[str, Any] = {
+        "nodes.json": _collect(list_nodes),
+        "events.json": _collect(
+            lambda: list_cluster_events(limit=5000)),
+        "event_stats.json": _collect(
+            lambda: gcs.call("cluster_event_stats", {})),
+        "dossiers.json": _collect(
+            lambda: [get_dossier(d["dossier_id"]) or d
+                     for d in list_dossiers()]),
+        "traces.json": _collect(lambda: list_traces(limit=200)),
+        "trace_stats.json": _collect(trace_stats),
+        "metrics.json": _collect(list_metrics),
+        "metrics_history.json": _collect(
+            lambda: list_metrics_history(limit=10000)),
+        "metrics_history_stats.json": _collect(
+            lambda: metrics_history_stats(series=True)),
+        "step_stats.json": _collect(lambda: list_step_stats()),
+        "training_summary.json": _collect(training_summary),
+        "recovery_episodes.json": _collect(
+            lambda: list_recovery_episodes(limit=1000)),
+        "recovery_stats.json": _collect(recovery_stats),
+        "doctor.json": _collect(doctor_report),
+        "timeline.json": _collect(timeline),
+    }
+    from ray_tpu._private.metrics_history import format_doctor_report
+    members["doctor.txt"] = _collect(
+        lambda: format_doctor_report(members["doctor.json"]))
+    manifest = {"generated_ts": _time.time(), "members": {}}
+    with tarfile.open(path, "w:gz") as tar:
+        for name, payload in members.items():
+            if name.endswith(".json"):
+                blob = json.dumps(payload, indent=1,
+                                  default=str).encode()
+            else:
+                blob = str(payload).encode()
+            info = tarfile.TarInfo("debug-bundle/" + name)
+            info.size = len(blob)
+            info.mtime = int(_time.time())
+            tar.addfile(info, io.BytesIO(blob))
+            manifest["members"][name] = len(blob)
+    return manifest

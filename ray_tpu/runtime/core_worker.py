@@ -482,6 +482,42 @@ class CoreWorker:
     _task_t0: Dict[bytes, float] = {}
     _task_tq: Dict[bytes, float] = {}
 
+    def _init_submitter_state(self) -> None:
+        """Every field the task-submission machinery reads: the lease
+        loops (``_enqueue_task``/``_lease_request_loop``/
+        ``_lease_worker_loop``), spillback + locality hints
+        (``_lease_with_spillback``/``_arg_hints``), and ownership
+        bookkeeping.  The scripted-peer harnesses (tests/test_rpc.py,
+        tests/test_scripted_peers.py) construct owners that skip
+        ``CoreWorker.__init__`` and call THIS instead — a new submitter
+        field initialized inline in ``__init__`` silently breaks that
+        tier with an AttributeError swallowed on a lease thread, so add
+        it here.
+        """
+        self._owned: Dict[ObjectID, _OwnedObject] = {}
+        self._owned_lock = threading.RLock()  # ObjectRef ctor re-enters
+        # strong refs to task-argument ObjectRefs, held until the task using
+        # them completes (otherwise the owner may free the object before the
+        # executing worker fetches it)
+        self._arg_refs: Dict[bytes, list] = {}
+        # task submission state: per scheduling key a FIFO of pending specs
+        # and a set of leased workers that pull from it (cf. reference
+        # OnWorkerIdle, direct_task_transport.cc:174 — tasks pipeline onto
+        # leased workers; at most one lease request in flight per key,
+        # RequestNewWorkerIfNeeded :325)
+        self._sched: Dict[str, Dict[str, Any]] = {}
+        self._sched_lock = threading.Lock()
+        # wakes idle keepalive leases when new work lands on their key
+        self._sched_cv = threading.Condition(self._sched_lock)
+        # task binary -> remaining OOM-kill retries (separate budget from
+        # max_retries; reference task_oom_retries)
+        self._oom_retries: Dict[bytes, int] = {}
+        self._node_table: Dict[str, Dict] = {}
+        self._shutdown = threading.Event()
+        # submit-time monotonic stamps: e2e latency + first-dispatch wait
+        self._task_t0: Dict[bytes, float] = {}
+        self._task_tq: Dict[bytes, float] = {}
+
     def __init__(self, *, mode: str, gcs_address: Tuple[str, int],
                  raylet_address: Tuple[str, int], store_path: str,
                  node_id: str, job_id: Optional[JobID] = None,
@@ -498,8 +534,7 @@ class CoreWorker:
         self._put_counter = 0
         self._counter_lock = threading.Lock()
 
-        self._owned: Dict[ObjectID, _OwnedObject] = {}
-        self._owned_lock = threading.RLock()  # ObjectRef ctor re-enters
+        self._init_submitter_state()
         self._memory_cache: Dict[ObjectID, Any] = {}   # deserialized values
         # insertion order of BORROWED cache entries only — the trim's
         # working set.  Owned entries leave via refcounting, so scanning
@@ -514,10 +549,6 @@ class CoreWorker:
         self._borrowed_seq = itertools.count()
         self._pins: Dict[ObjectID, int] = {}   # local shm pins we hold
         self._pins_lock = threading.Lock()
-        # strong refs to task-argument ObjectRefs, held until the task using
-        # them completes (otherwise the owner may free the object before the
-        # executing worker fetches it)
-        self._arg_refs: Dict[bytes, list] = {}
         self._owner_conns = transfer.ConnCache()
         self._pull_budget = _PullBudget(CONFIG.pull_memory_cap_bytes)
         # bulk data plane (docs/object_transfer.md): pipelined multi-
@@ -548,22 +579,9 @@ class CoreWorker:
         self.raylet_addr = tuple(raylet_address)
         self._raylet = rpc.connect(self.raylet_addr)
 
-        # task submission state: per scheduling key a FIFO of pending specs
-        # and a set of leased workers that pull from it (cf. reference
-        # OnWorkerIdle, direct_task_transport.cc:174 — tasks pipeline onto
-        # leased workers; at most one lease request in flight per key,
-        # RequestNewWorkerIfNeeded :325)
-        self._sched: Dict[str, Dict[str, Any]] = {}
-        self._sched_lock = threading.Lock()
-        # wakes idle keepalive leases when new work lands on their key
-        self._sched_cv = threading.Condition(self._sched_lock)
-        # task binary -> remaining OOM-kill retries (separate budget from
-        # max_retries; reference task_oom_retries)
-        self._oom_retries: Dict[bytes, int] = {}
         self._fn_cache: Dict[str, Any] = {}
         self._fn_key_by_id: Dict[int, str] = {}  # id(func) -> fn key
         self._fn_id_pins: Dict[int, Any] = {}    # keeps those ids stable
-        self._node_table: Dict[str, Dict] = {}
 
         # actor submission: per-actor ordered pipeline (a single sender
         # thread per actor allocates seqs in submission order and pipelines
@@ -590,7 +608,6 @@ class CoreWorker:
 
         # deferred remote frees: (node_hex, oid_binary) batched per node
         # every free_objects_period_ms (reference: plasma Delete batching)
-        self._shutdown = threading.Event()
         self._free_queue: List[Tuple[str, bytes]] = []
         self._free_cv = threading.Condition()
         self._free_thread = threading.Thread(target=self._free_loop,
@@ -604,9 +621,6 @@ class CoreWorker:
             self.gcs, job_id=self.job_id.hex() if mode == "driver" else "",
             node_id=node_id, worker_id=self.worker_id.hex())
 
-        # submit-time monotonic stamps: e2e latency + first-dispatch wait
-        self._task_t0: Dict[bytes, float] = {}
-        self._task_tq: Dict[bytes, float] = {}
         # runtime telemetry rides the GCS KV: bind this process's flusher
         # and the poll-time pin-count gauge (zero hot-path cost); both
         # are unhooked in shutdown() so this CoreWorker (and everything

@@ -111,6 +111,14 @@ class GcsServer:
         from ray_tpu.util.tracing import tracing_helper as trh
         self._span_table = trh.GcsSpanTable(
             on_dossier_link=self._link_dossier_trace)
+        # metrics-history plane (docs/observability.md): every metrics
+        # KV write is additionally folded into a bounded multi-
+        # resolution ring per series, and the recovery auditor derives
+        # drain/failover/heal episodes from the event stream.
+        # Ephemeral like all other observability tables.
+        from ray_tpu._private import metrics_history as mh
+        self._history = mh.GcsMetricsHistoryTable()
+        self._auditor = mh.RecoveryAuditor()
         self._dossiers: Dict[str, dict] = {}
         self._dossier_order: deque = deque()
         # evacuated-object location hints (docs/fault_tolerance.md):
@@ -460,6 +468,7 @@ class GcsServer:
             if v is not None:
                 ev.setdefault(k, v)
         self._events_table.put([ev])
+        self._audit_events([ev])
         self._publish("events", ev)
         return {"ok": True}
 
@@ -482,9 +491,18 @@ class GcsServer:
         (cluster_events.py flusher cadence)."""
         events = p.get("events") or []
         dropped = self._events_table.put(events)
+        self._audit_events(events)
         for ev in events:
             self._publish("events", ev)
         return {"dropped": dropped}
+
+    def _audit_events(self, events) -> None:
+        """Feed freshly landed events to the recovery auditor (sixth
+        plane, metrics_history.py): it derives drain/failover/heal
+        episodes and never emits events itself (no recursion)."""
+        from ray_tpu._private import metrics_history as mh
+        if mh.history_on():
+            self._auditor.observe(events)
 
     def _rpc_list_cluster_events(self, conn, p):
         return self._events_table.list(
@@ -558,6 +576,55 @@ class GcsServer:
 
     def _rpc_trace_stats(self, conn, p):
         return self._span_table.stats()
+
+    # ---------------------------------------------- metrics-history plane
+    def _rpc_list_metrics_history(self, conn, p):
+        """Windowed points for a series (or all series of a metric):
+        parsed payloads oldest-first from the retention rings."""
+        p = p or {}
+        return self._history.query(
+            name=p.get("name"), ident=p.get("ident"),
+            since=p.get("since"), resolution=p.get("resolution"),
+            limit=int(p.get("limit", 2000)))
+
+    def _rpc_metrics_history_stats(self, conn, p):
+        out = self._history.stats()
+        if (p or {}).get("series"):
+            out["series_index"] = self._history.series()
+        return out
+
+    def _rpc_list_recovery_episodes(self, conn, p):
+        p = p or {}
+        return self._auditor.list(
+            kind=p.get("kind"),
+            include_open=bool(p.get("include_open", True)),
+            limit=int(p.get("limit", 100)))
+
+    def _rpc_recovery_stats(self, conn, p):
+        return self._auditor.stats()
+
+    def _rpc_doctor_report(self, conn, p):
+        """Cross-plane correlation: one snapshot of all six planes ->
+        ranked findings (metrics_history.build_doctor_report).  The
+        assembly is a handful of in-process table reads — cheap enough
+        for the CLI, the dashboard and the debug bundle to share."""
+        from ray_tpu._private import metrics_history as mh
+        p = p or {}
+        snapshot = {
+            "now": time.time(),
+            "nodes": self._rpc_list_nodes(None, {}),
+            "events": self._events_table.list(
+                min_severity="WARNING",
+                limit=int(p.get("events_limit", 200))),
+            "episodes": self._auditor.list(
+                limit=int(p.get("episodes_limit", 100))),
+            "recovery_stats": self._auditor.stats(),
+            "traces": self._span_table.list(slo_violations=True,
+                                            limit=10),
+            "dossiers": self._rpc_list_dossiers(None, {}),
+            "history_stats": self._history.stats(),
+        }
+        return mh.build_doctor_report(snapshot)
 
     def _link_dossier_trace(self, dossier_id: str, trace_id: str) -> None:
         """A root span died carrying a dossier_id: stamp the trace id
@@ -1155,12 +1222,22 @@ class GcsServer:
         """Runtime-metrics flusher sink: plain KV write, never WALed."""
         with self._lock:
             self._kv[key] = value
+        from ray_tpu._private import metrics_history as mh
+        if mh.history_on():
+            self._history.ingest(key, value)
 
     def _rpc_kv_put(self, conn, p):
         with self._lock:
             existed = p["key"] in self._kv
             if p.get("overwrite", True) or not existed:
                 self._kv[p["key"]] = p["value"]
+        # worker metrics flushers arrive over this generic RPC (their
+        # sink is a kv_put call): stage them for the history plane too
+        # (batched fold — the RPC reply never waits on ring work)
+        if p["key"].startswith("metrics/"):
+            from ray_tpu._private import metrics_history as mh
+            if mh.history_on():
+                self._history.ingest(p["key"], p["value"])
         return {"existed": existed}
 
     def _rpc_kv_get(self, conn, p):

@@ -497,6 +497,37 @@ def cmd_timeline(args) -> None:
           "(open in chrome://tracing or ui.perfetto.dev)")
 
 
+def cmd_doctor(args) -> None:
+    """`ray-tpu doctor`: the cross-plane correlation report — node
+    health, recovery episodes + SLO verdicts, recent WARNING+ events,
+    straggler flags, worst-trace exemplars and open dossiers ranked
+    into findings with evidence lines (docs/observability.md)."""
+    import json as _json
+    _connect(args)
+    from ray_tpu.experimental import state
+    if args.json:
+        print(_json.dumps(state.doctor_report(), indent=1,
+                          default=str))
+        return
+    print(state.doctor_report_text())
+
+
+def cmd_debug_bundle(args) -> None:
+    """`ray-tpu debug-bundle`: export every observability plane —
+    events, dossiers, traces, metrics snapshot + history, step stats,
+    recovery episodes, doctor report, merged Perfetto timeline — as
+    one tarball for offline forensics."""
+    _connect(args)
+    from ray_tpu.experimental import state
+    out = args.output or f"debug-bundle-{int(time.time())}.tar.gz"
+    manifest = state.collect_debug_bundle(out)
+    total = sum(manifest["members"].values())
+    print(f"wrote {out}: {len(manifest['members'])} members, "
+          f"{total:,} bytes")
+    for name, size in sorted(manifest["members"].items()):
+        print(f"  {name:32s} {size:>10,} B")
+
+
 def cmd_debug(args) -> None:
     _connect(args)
     from ray_tpu.util.rpdb import list_breakpoints
@@ -909,6 +940,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("doctor",
+                        help="cross-plane health report: ranked "
+                             "findings with evidence lines")
+    sp.add_argument("--address")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the raw report as JSON")
+    sp.set_defaults(fn=cmd_doctor)
+
+    sp = sub.add_parser("debug-bundle",
+                        help="export all observability planes as one "
+                             "tarball for offline forensics")
+    sp.add_argument("-o", "--output",
+                    help="tarball path (default debug-bundle-"
+                         "<ts>.tar.gz)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_debug_bundle)
 
     sp = sub.add_parser("traces",
                         help="list request traces (span table)")

@@ -149,6 +149,10 @@ class DataParallelTrainer(BaseTrainer):
         # it, bounding lost work to one checkpoint interval
         self._latest_checkpoint: Optional[Checkpoint] = None
         self._last_failure: str = ""
+        # last "step" any rank reported: with the checkpoint's resume
+        # step this prices a failover in re-executed steps — the lost
+        # work the recovery auditor (metrics_history.py) ledgers
+        self._last_step: Optional[int] = None
 
     def _apply_trial_config(self, config: Dict[str, Any]) -> None:
         merged = dict(self.train_loop_config)
@@ -216,6 +220,9 @@ class DataParallelTrainer(BaseTrainer):
                 for metrics, ckpt in self._poll_group(group):
                     if ckpt is not None:
                         self._latest_checkpoint = ckpt
+                    if isinstance(metrics, dict) and \
+                            isinstance(metrics.get("step"), int):
+                        self._last_step = metrics["step"]
                     yield metrics, ckpt
                 return
             except TrainingFailedError as e:
@@ -253,6 +260,23 @@ class DataParallelTrainer(BaseTrainer):
         gang is spawned: the chaos gate's time-to-failover referee."""
         try:
             from ray_tpu._private import cluster_events as cev
+            # price the failover in re-executed steps: everything past
+            # the checkpoint the gang resumes from, up to the last step
+            # any rank reported, runs again
+            resume_step = None
+            if self.resume_from_checkpoint is not None:
+                try:
+                    raw = self.resume_from_checkpoint.to_dict() \
+                        .get("step")
+                    resume_step = raw if isinstance(raw, int) else None
+                except Exception:
+                    resume_step = None
+            lost = None
+            if resume_step is not None and self._last_step is not None:
+                lost = max(0, self._last_step - resume_step)
+            elif self._last_step is not None and \
+                    self.resume_from_checkpoint is None:
+                lost = self._last_step + 1   # from-scratch restart
             cev.emit(
                 cev.TRAIN_GANG_RECOVERY,
                 f"gang for {name!r} re-formed (attempt {attempt}): "
@@ -262,7 +286,8 @@ class DataParallelTrainer(BaseTrainer):
                 downtime_s=(round(time.monotonic() - t_failed, 3)
                             if t_failed else None),
                 resumed_from_checkpoint=self.resume_from_checkpoint
-                is not None)
+                is not None, resume_step=resume_step,
+                last_step=self._last_step, lost_steps=lost)
         except Exception:
             pass    # observability must never fail the loop
 

@@ -75,6 +75,36 @@ def ray_start_cluster():
     cluster.shutdown()
 
 
+def record_recovery_row(row):
+    """Under ``MICROBENCH_RECORD=1`` the chaos gates double as the data
+    source for MICROBENCH.json's ``recovery`` section: the drain /
+    failover / heal latencies they already assert against the
+    recovery-SLO auditor ARE the numbers the bench table should cite,
+    so recording them here keeps bench and gate from drifting.  Same
+    merge-by-row-name idiom as benchmarks/scale_envelope.py — a partial
+    re-run must not drop sibling rows, and collect_microbench's
+    merge_preserve carries the whole section across refreshes."""
+    import json
+    if os.environ.get("MICROBENCH_RECORD") != "1":
+        return
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MICROBENCH.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    sec = doc.setdefault("recovery", {})
+    merged = {r.get("name"): r for r in sec.get("episodes", [])}
+    merged[row.get("name")] = row
+    sec["episodes"] = list(merged.values())
+    sec["source"] = ("tests/test_preemption.py + tests/test_chaos.py "
+                     "under MICROBENCH_RECORD=1: recovery-SLO auditor "
+                     "episodes from injected chaos")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+
+
 @contextlib.contextmanager
 def debug_sanitizers_enabled():
     """Run a block under BOTH runtime sanitizers

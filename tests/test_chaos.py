@@ -232,7 +232,159 @@ def test_striped_pull_fails_over_when_source_node_killed(
     dead_events = state.list_cluster_events(type="NODE_DEAD",
                                             node_id=node_dst.node_id)
     assert dead_events, "no NODE_DEAD event for the killed node"
+    # the recovery-SLO auditor folded the same events into its transfer
+    # ledger: every TRANSFER_FAILOVER counted, broken down by outcome
+    rstats = state.recovery_stats()
+    assert rstats["transfer_failovers"] >= len(failovers)
+    assert sum(rstats["transfer_by_outcome"].values()) == \
+        rstats["transfer_failovers"]
     ray_tpu.shutdown()
+
+
+def test_replica_kill_heal_episode_audited():
+    """Serve-pool chaos for the auditor's third episode kind: kill a
+    serving replica under steady load — the controller's REPLICA_RETIRED
+    ("unhealthy") opens the heal episode — then push the load past the
+    autoscaling target so the next AUTOSCALE target change closes it.
+    Pool-heal latency is derived entirely from the serve controller's
+    own event stream and cross-checked here against the raw event
+    timestamps the auditor folded."""
+    import threading
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+    from ray_tpu.experimental import state
+    from ray_tpu.serve.controller import REPLICA_PREFIX, SERVE_NAMESPACE
+
+    name = "heal-gate"
+    stop = threading.Event()
+    rt.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        serve.start()
+
+        @serve.deployment(max_concurrent_queries=8, autoscaling_config={
+            "min_replicas": 1, "max_replicas": 3,
+            "target_num_ongoing_requests_per_replica": 2.0,
+            "upscale_delay_s": 0.5,
+            # a scale-DOWN would close the heal episode with the wrong
+            # target change: park downscaling outside the test window
+            "downscale_delay_s": 600.0})
+        def slow(x):
+            time.sleep(0.25)
+            return x
+
+        handle = serve.run(slow.bind(), name=name)
+        assert rt.get(handle.remote(0), timeout=120) == 0
+
+        depth = [4]   # open-loop depth; the second wave raises it to 8
+
+        def load():
+            pending = [handle.remote(i) for i in range(depth[0])]
+            while not stop.is_set():
+                try:
+                    done, pending = rt.wait(pending, num_returns=1,
+                                            timeout=120)
+                    rt.get(done, timeout=60)
+                except Exception:
+                    pass   # a request died with the killed replica
+                while len(pending) < depth[0] and not stop.is_set():
+                    pending.append(handle.remote(0))
+            try:
+                rt.get(pending, timeout=120)
+            except Exception:
+                pass
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+
+        # wave 1: depth 4 over target_ongoing 2.0 -> the controller
+        # scales 1 -> 2 (this AUTOSCALE precedes the chaos, so it must
+        # NOT close anything — no heal episode is open yet)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            st = serve.status()[name]
+            if st["target_replicas"] == 2 and len(st["replicas"]) == 2:
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError(f"never scaled to 2: {serve.status()}")
+
+        # chaos: SIGKILL one serving replica mid-load
+        victim_tag = list(st["replicas"])[0]
+        rt.kill(rt.get_actor(REPLICA_PREFIX + victim_tag,
+                             namespace=SERVE_NAMESPACE))
+        retired = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            evs = [e for e in state.list_cluster_events(
+                       type="REPLICA_RETIRED")
+                   if e.get("replica") == victim_tag]
+            if evs:
+                retired = evs[-1]
+                break
+            time.sleep(0.3)
+        assert retired is not None, \
+            "controller never retired the dead replica"
+        assert retired["reason"] == "unhealthy"
+        assert retired["severity"] == "WARNING"
+
+        # wave 2: depth 8 over 2 serving -> desired ceil(8/2)=4 capped
+        # at max_replicas=3 -> AUTOSCALE 2 -> 3 heals the pool and
+        # closes the episode
+        depth[0] = 8
+        autoscale = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            evs = [e for e in state.list_cluster_events(type="AUTOSCALE")
+                   if e.get("deployment") == name
+                   and e.get("new_target") == 3]
+            if evs:
+                autoscale = evs[-1]
+                break
+            time.sleep(0.3)
+        assert autoscale is not None, \
+            "load surge never drove a target change"
+        stop.set()
+        t.join(timeout=120)
+
+        # the auditor's heal episode tells the same story as the raw
+        # REPLICA_RETIRED/AUTOSCALE pair it folded
+        ep = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            eps = [e for e in state.list_recovery_episodes(
+                       kind="heal", include_open=False)
+                   if e.get("deployment") == name]
+            if eps:
+                ep = eps[-1]
+                break
+            time.sleep(0.3)
+        assert ep is not None, "auditor never closed the heal episode"
+        assert ep["opening_type"] == "REPLICA_RETIRED"
+        assert ep["closing_type"] == "AUTOSCALE"
+        assert ep["replica"] == victim_tag and ep["retired"] == 1
+        assert ep["reason"] == "unhealthy"
+        assert ep["old_target"] == 2 and ep["new_target"] == 3
+        assert abs(ep["latency_s"]
+                   - (autoscale["ts"] - retired["ts"])) < 0.05
+        # default pool-heal SLO (recovery_slo_heal_s): 90 s
+        assert ep["slo_s"] == 90.0
+        assert ep["violation"] == (ep["latency_s"] > 90.0)
+
+        from conftest import record_recovery_row
+        record_recovery_row({
+            "name": "heal", "latency_s": ep["latency_s"],
+            "retired": ep["retired"], "slo_s": ep["slo_s"],
+            "violation": ep["violation"],
+            "reference": "tests/test_chaos.py::"
+                         "test_replica_kill_heal_episode_audited"})
+    finally:
+        stop.set()
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        rt.shutdown()
 
 
 def test_disagg_serving_survives_replica_chaos():

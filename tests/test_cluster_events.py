@@ -297,3 +297,199 @@ def test_dossier_store_bounded(ray_start_regular):
     ids = {d["dossier_id"] for d in listed}
     assert f"unit-{CONFIG.gcs_max_dossiers + 19:04d}" in ids
     assert "unit-0000" not in ids
+
+
+# ------------------------------------------------- recovery SLO auditor
+# (sixth plane, docs/observability.md: the GCS folds the typed event
+# stream into drain/failover/heal episodes with SLO classification)
+
+def _ev(etype, ts, **fields):
+    return dict(type=etype, ts=ts, **fields)
+
+
+def test_auditor_drain_episode_matches_event_timestamps():
+    """NODE_PREEMPTING -> NODE_DRAINED closes a drain episode whose
+    latency is exactly the event-timestamp delta, with the evacuation
+    ledger attached from the OBJECT_EVACUATED stream."""
+    from ray_tpu._private.metrics_history import RecoveryAuditor
+
+    a = RecoveryAuditor()
+    t0 = 1000.0
+    a.observe([
+        _ev("NODE_PREEMPTING", t0, node_id="n1", grace_s=5.0,
+            reason="spot"),
+        _ev("OBJECT_EVACUATED", t0 + 0.5, node_id="n1", bytes=100),
+        _ev("OBJECT_EVACUATED", t0 + 1.0, node_id="n1", bytes=200),
+        _ev("NODE_DRAINED", t0 + 2.5, node_id="n1", evacuated=2,
+            bytes=300, failed=0, duration_s=2.4),
+    ])
+    eps = a.list(kind="drain")
+    assert len(eps) == 1
+    ep = eps[0]
+    assert not ep["open"] and ep["latency_s"] == 2.5
+    assert ep["opening_type"] == "NODE_PREEMPTING"
+    assert ep["closing_type"] == "NODE_DRAINED"
+    assert ep["evacuated"] == 2 and ep["evacuated_bytes"] == 300
+    # no explicit drain SLO configured: the advertised grace window is
+    # the budget, and 2.5s < 5s is within it
+    assert ep["slo_s"] == 5.0 and not ep["violation"]
+    assert a.stats()["counts_by_kind"] == {"drain": 1}
+
+    # blowing the grace window classifies as an SLO violation
+    a.observe([
+        _ev("NODE_PREEMPTING", t0 + 10, node_id="n2", grace_s=1.0),
+        _ev("NODE_DRAINED", t0 + 13, node_id="n2", evacuated=0),
+    ])
+    ep2 = a.list(kind="drain")[-1]
+    assert ep2["violation"] and ep2["latency_s"] == 3.0
+    assert a.stats()["violations_by_kind"] == {"drain": 1}
+
+
+def test_auditor_failover_anchors_on_first_failure_event():
+    """The graceful path anchors time-to-failover at NODE_PREEMPTING
+    (not the later NODE_DEAD), counts lost work, and closes the
+    dangling drain as died-before-drained."""
+    from ray_tpu._private.metrics_history import RecoveryAuditor
+
+    a = RecoveryAuditor()
+    t0 = 2000.0
+    a.observe([
+        _ev("NODE_PREEMPTING", t0, node_id="n1", grace_s=5.0),
+        _ev("NODE_DEAD", t0 + 6.0, node_id="n1", actors_affected=2),
+        _ev("TRAIN_GANG_RECOVERY", t0 + 14.0, experiment="exp",
+            attempt=1, downtime_s=8.0, resumed_from_checkpoint=True,
+            lost_steps=2, resume_step=5, last_step=7),
+    ])
+    fo = a.list(kind="failover")
+    assert len(fo) == 1 and not fo[0]["open"]
+    assert fo[0]["opening_type"] == "NODE_PREEMPTING"
+    assert fo[0]["latency_s"] == 14.0       # anchored at the notice
+    assert fo[0]["lost_steps"] == 2 and fo[0]["experiment"] == "exp"
+    assert a.stats()["lost_steps"] == 2
+    # the node died before reporting NODE_DRAINED: the drain episode
+    # closed as a failure instead of dangling open forever
+    dr = a.list(kind="drain")[0]
+    assert not dr["open"] and dr["outcome"] == "died before drained"
+
+
+def test_auditor_failover_without_failure_event_synthesizes_anchor():
+    """A recovery with no observed node failure (worker-level crash)
+    still yields an episode, anchored on the trainer's downtime."""
+    from ray_tpu._private.metrics_history import RecoveryAuditor
+
+    a = RecoveryAuditor()
+    a.observe([_ev("TRAIN_GANG_RECOVERY", 3000.0, experiment="solo",
+                   downtime_s=4.0, lost_steps=0)])
+    eps = a.list(kind="failover")
+    assert len(eps) == 1
+    assert eps[0]["opening_type"] == "TRAIN_DOWNTIME"
+    assert eps[0]["latency_s"] == 4.0
+    assert eps[0]["key"] == "run:solo"
+
+
+def test_auditor_heal_episode():
+    """REPLICA_RETIRED -> AUTOSCALE measures serve pool healing."""
+    from ray_tpu._private.metrics_history import RecoveryAuditor
+
+    a = RecoveryAuditor()
+    a.observe([
+        _ev("REPLICA_RETIRED", 4000.0, deployment="d", replica="r1",
+            reason="unhealthy"),
+        _ev("REPLICA_RETIRED", 4001.0, deployment="d", replica="r2",
+            reason="unhealthy"),
+        _ev("AUTOSCALE", 4003.0, deployment="d", old_target=2,
+            new_target=4, load=0.9),
+    ])
+    eps = a.list(kind="heal")
+    assert len(eps) == 1
+    ep = eps[0]
+    assert ep["latency_s"] == 3.0 and ep["retired"] == 2
+    assert ep["new_target"] == 4
+    assert not ep["violation"]   # default heal SLO is 90s
+
+
+def test_auditor_transfer_failover_counters():
+    from ray_tpu._private.metrics_history import RecoveryAuditor
+
+    a = RecoveryAuditor()
+    a.observe([
+        _ev("TRANSFER_FAILOVER", 5000.0, object_id="o1",
+            outcome="restriped"),
+        _ev("TRANSFER_FAILOVER", 5001.0, object_id="o2",
+            outcome="restriped"),
+        _ev("TRANSFER_FAILOVER", 5002.0, object_id="o3",
+            outcome="lost"),
+    ])
+    st = a.stats()
+    assert st["transfer_failovers"] == 3
+    assert st["transfer_by_outcome"] == {"restriped": 2, "lost": 1}
+
+
+def test_auditor_retention_bounds_and_rotation_survival():
+    """Both retention gates hold (episode count and byte budget) and
+    the per-kind totals survive rotation, like the event table's
+    counts_by_type."""
+    from ray_tpu._private.metrics_history import RecoveryAuditor
+
+    a = RecoveryAuditor(max_episodes=8, max_bytes=1 << 20)
+    for i in range(50):
+        t = 6000.0 + i * 10
+        a.observe([
+            _ev("NODE_PREEMPTING", t, node_id=f"n{i}", grace_s=1.0),
+            _ev("NODE_DRAINED", t + 2.0, node_id=f"n{i}", evacuated=0),
+        ])
+    st = a.stats()
+    assert st["episodes"] <= 8 and st["dropped"] >= 42
+    assert st["counts_by_kind"]["drain"] == 50       # survives rotation
+    assert st["violations_by_kind"]["drain"] == 50   # 2s > 1s grace
+    assert len(a.list(kind="drain", include_open=False)) <= 8
+
+    # byte budget: padded episodes evict oldest-first until it fits
+    b = RecoveryAuditor(max_episodes=10_000, max_bytes=4096)
+    for i in range(40):
+        t = 7000.0 + i * 10
+        b.observe([
+            _ev("NODE_PREEMPTING", t, node_id=f"m{i}", grace_s=5.0,
+                reason="x" * 200),
+            _ev("NODE_DRAINED", t + 1.0, node_id=f"m{i}", evacuated=0),
+        ])
+    st = b.stats()
+    assert st["bytes"] <= 4096 and st["episodes"] < 40
+    assert st["counts_by_kind"]["drain"] == 40
+
+
+def test_doctor_report_names_episodes():
+    """The doctor's findings name the auditor's episodes by id, rank
+    ERROR above WARNING above INFO, and the text rendering carries the
+    verdict."""
+    from ray_tpu._private.metrics_history import (
+        RecoveryAuditor, build_doctor_report, format_doctor_report)
+
+    a = RecoveryAuditor()
+    a.observe([
+        _ev("NODE_PREEMPTING", 8000.0, node_id="n1", grace_s=1.0),
+        _ev("NODE_DRAINED", 8003.0, node_id="n1", evacuated=1),
+    ])
+    ep = a.list(kind="drain")[0]
+    report = build_doctor_report({
+        "nodes": [{"node_id": "n1" * 12, "alive": False},
+                  {"node_id": "n2" * 12, "alive": True}],
+        "episodes": a.list(),
+        "recovery_stats": a.stats(),
+        "events": [{"type": "NODE_DEAD", "severity": "ERROR",
+                    "ts": 8004.0, "message": "n1 dead"}],
+    })
+    assert not report["healthy"]
+    assert report["counts"]["dead_nodes"] == 1
+    assert report["counts"]["slo_violations"] == 1
+    sevs = [f["severity"] for f in report["findings"]]
+    assert sevs == sorted(sevs, key=["ERROR", "WARNING", "INFO"].index)
+    text = format_doctor_report(report)
+    assert "ray-tpu doctor" in text
+    assert "ATTENTION NEEDED" in text
+    assert ep["id"] in text      # the episode is named, e.g. drain-1
+
+    healthy = build_doctor_report({"nodes": [{"node_id": "x", "alive":
+                                              True}]})
+    assert healthy["healthy"]
+    assert "HEALTHY" in format_doctor_report(healthy)

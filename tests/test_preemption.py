@@ -13,8 +13,9 @@ end to end on a multi-node simulated cluster:
   kill, graceful and ungraceful: the trainer detects rank death (event
   plane or poll failure), re-forms the gang on replacement capacity and
   resumes from the latest checkpoint — lost work <= one checkpoint
-  interval, time-to-failover asserted from NODE_PREEMPTING/NODE_DEAD ->
-  TRAIN_GANG_RECOVERY event timestamps;
+  interval, time-to-failover asserted from the recovery-SLO auditor's
+  failover episode (NODE_PREEMPTING/NODE_DEAD -> TRAIN_GANG_RECOVERY),
+  cross-checked against the raw event timestamps it folded;
 * **lineage hardening** — cascading loss (an object whose args also
   died) reconstructs transitively; exhausted lineage raises
   ObjectLostError naming the dead node's dossier; the per-object
@@ -58,6 +59,21 @@ def _wait_event(gcs, etype, timeout=60.0, **match):
 def _driver_gcs():
     from ray_tpu.runtime.core_worker import get_global_worker
     return get_global_worker().gcs
+
+
+def _wait_episode(gcs, kind, timeout=60.0, **match):
+    """Newest CLOSED recovery episode of ``kind`` whose fields contain
+    ``match`` — the auditor's derived view of the same chaos the raw
+    event asserts above it already pinned down."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        eps = gcs.call("list_recovery_episodes",
+                       {"kind": kind, "include_open": False})
+        for ep in reversed(eps or []):
+            if all(ep.get(k) == v for k, v in match.items()):
+                return ep
+        time.sleep(0.3)
+    return None
 
 
 # --------------------------------------------------------------- drain
@@ -128,6 +144,29 @@ def test_graceful_drain_evacuates_objects(ray_start_cluster):
                  if n["node_id"] != victim.node_id}
     assert len(evacs) == n
     assert all(e["target_node_id"] in survivors for e in evacs)
+
+    # the recovery-SLO auditor folded that event stream into ONE drain
+    # episode whose numbers match the event-timestamp ground truth —
+    # drain latency, evacuation ledger and the grace-window SLO verdict
+    ep = _wait_episode(gcs, "drain", node_id=victim.node_id)
+    assert ep is not None, "auditor never closed the drain episode"
+    assert ep["opening_type"] == "NODE_PREEMPTING"
+    assert ep["closing_type"] == "NODE_DRAINED"
+    assert abs(ep["latency_s"] - (drained["ts"] - pre["ts"])) < 0.05
+    assert ep["evacuated"] == n and ep["failed"] == 0
+    assert ep["evacuated_bytes"] == drained["bytes"]
+    # no explicit drain SLO configured: the advertised 5 s grace window
+    # IS the budget, and the drain finished inside it
+    assert ep["slo_s"] == 5.0
+    assert ep["violation"] == (ep["latency_s"] > 5.0)
+    from conftest import record_recovery_row
+    record_recovery_row({
+        "name": "drain", "latency_s": ep["latency_s"],
+        "evacuated": ep["evacuated"],
+        "evacuated_bytes": ep["evacuated_bytes"],
+        "slo_s": ep["slo_s"], "violation": ep["violation"],
+        "reference": "tests/test_preemption.py::"
+                     "test_graceful_drain_evacuates_objects"})
 
     # the preemption lands: SIGKILL, no cleanup
     cluster.remove_node(victim)
@@ -318,8 +357,102 @@ def _run_gang_with_kill(cluster, graceful: bool):
         # the event watch failed over proactively off the preemption
         # notice: recovery references the event plane, not a poll error
         assert "event plane" in rec_ev.get("reason", "") or ttf < 60
+
+    # the auditor's failover episode derived the same story: anchored
+    # at the FIRST failure event (the preemption NOTICE on the graceful
+    # leg, the death on the ungraceful one), closed by the gang
+    # recovery, time-to-failover matching the hand-subtracted event
+    # timestamps and lost work counted in re-executed steps
+    ep = _wait_episode(gcs, "failover", experiment=name)
+    assert ep is not None, "auditor never closed the failover episode"
+    assert ep["opening_type"] == first_type
+    assert ep["node_id"] == victim.node_id
+    assert ep["closing_type"] == "TRAIN_GANG_RECOVERY"
+    assert abs(ep["latency_s"] - ttf) < 0.05, (ep["latency_s"], ttf)
+    assert ep["lost_steps"] == int(rec_ev.get("lost_steps") or 0)
+    assert 0 <= ep["lost_steps"] <= interval
+    # default failover SLO is 120 s; the ttf bound above means no breach
+    assert ep["slo_s"] == 120.0 and not ep["violation"]
+
+    # `ray-tpu doctor` names the episode: the closed-episodes finding
+    # cites the slowest recovery, which is this failover
+    from ray_tpu._private.metrics_history import format_doctor_report
+    report = gcs.call("doctor_report", {})
+    text = format_doctor_report(report)
+    assert ep["id"] in text, text
+    assert any(f["category"] == "recovery"
+               for f in report["findings"])
+
+    from conftest import record_recovery_row
+    record_recovery_row({
+        "name": f"failover_{'graceful' if graceful else 'ungraceful'}",
+        "time_to_failover_s": ep["latency_s"],
+        "lost_steps": ep["lost_steps"], "opened_by": ep["opening_type"],
+        "slo_s": ep["slo_s"], "violation": ep["violation"],
+        "reference": "tests/test_preemption.py::_run_gang_with_kill"})
+
+    if graceful:
+        # one-shot forensics: `ray-tpu debug-bundle` exports every
+        # plane of THIS incident as one tarball — the events, the
+        # failover episode, the doctor verdict naming it and a
+        # non-empty metrics-history window, all correlated
+        _assert_debug_bundle(gcs, ep)
     ray_tpu.shutdown()
     return fail_ev, rec_ev
+
+
+def _assert_debug_bundle(gcs, ep):
+    import json
+    import os
+    import tarfile
+    import tempfile
+
+    from ray_tpu.experimental import state
+
+    # the history plane fills from the periodic runtime-metrics flush;
+    # wait until at least one series landed so the bundle's window has
+    # real points in it
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if gcs.call("metrics_history_stats", {}).get("series", 0) > 0:
+            break
+        time.sleep(0.5)
+
+    path = os.path.join(tempfile.mkdtemp(), "bundle.tar.gz")
+    try:
+        manifest = state.collect_debug_bundle(path)
+        with tarfile.open(path) as tar:
+            names = tar.getnames()
+            members = {}
+            for want in ("events.json", "recovery_episodes.json",
+                         "metrics_history.json",
+                         "metrics_history_stats.json", "dossiers.json",
+                         "doctor.json", "doctor.txt"):
+                assert f"debug-bundle/{want}" in names, names
+                blob = tar.extractfile(f"debug-bundle/{want}").read()
+                members[want] = (blob.decode() if want.endswith(".txt")
+                                 else json.loads(blob))
+        assert set(manifest["members"]) == {
+            n[len("debug-bundle/"):] for n in names}
+        # correlated content, not just file presence: the bundle's
+        # planes all tell this incident's story
+        assert any(e.get("type") == "TRAIN_GANG_RECOVERY"
+                   for e in members["events.json"])
+        assert any(b.get("id") == ep["id"]
+                   for b in members["recovery_episodes.json"])
+        assert any(d.get("dossier_id") == ep["node_id"]
+                   for d in members["dossiers.json"]
+                   if isinstance(d, dict)), \
+            "bundle carries no dossier for the dead node"
+        assert ep["id"] in members["doctor.txt"]
+        assert members["metrics_history_stats.json"]["series"] > 0
+        assert len(members["metrics_history.json"]) > 0
+    finally:
+        try:
+            os.remove(path)
+            os.rmdir(os.path.dirname(path))
+        except OSError:
+            pass
 
 
 def test_training_survives_graceful_slice_preemption(ray_start_cluster):

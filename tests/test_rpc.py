@@ -288,6 +288,34 @@ def test_push_closes_connection_on_dead_socket(fuzz):
         srv.stop()
 
 
+def test_accept_after_stop_is_closed():
+    """Regression: a connection accepted concurrently with Server.stop()
+    must not survive as a live unregistered reader.  stop() closes a
+    snapshot of connections(); an accept that lands its _conns.add after
+    that snapshot was never closed, and its reader then drained the
+    client's pushes forever — test_push_closes_connection_on_dead_socket
+    hung on exactly that interleaving.  _register_conn must refuse (and
+    close) once stop() has run."""
+    import socket as socket_mod
+
+    srv, _ = _echo_server()
+    lst = socket_mod.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    client = socket_mod.create_connection(lst.getsockname())
+    accepted, _ = lst.accept()
+    try:
+        srv.stop()
+        conn = rpc.Connection(accepted, handler=srv._handler,
+                              on_close=srv._conn_closed)
+        assert srv._register_conn(conn) is False
+        assert conn.closed, "post-stop accept left a live reader"
+        assert conn not in srv.connections()
+    finally:
+        client.close()
+        lst.close()
+
+
 # --------------------------------------------------------------------------
 # batched push_tasks at the submitter level (scripted fake peers)
 # --------------------------------------------------------------------------
@@ -318,15 +346,10 @@ def _make_owner(raylet_addr):
 
     class Owner(cw.CoreWorker):
         def __init__(self):
-            self._sched = {}
-            self._sched_lock = threading.Lock()
-            self._sched_cv = threading.Condition(self._sched_lock)
-            self._shutdown = threading.Event()
+            # the shared helper owns the full submitter field list, so
+            # new fields added there can't drift from this harness
+            self._init_submitter_state()
             self._raylet = rpc.connect(raylet_addr)
-            self._oom_retries = {}
-            self._arg_refs = {}
-            self._owned = {}
-            self._owned_lock = threading.Lock()
             self.job_id = JobID.from_random()
             self.replies = []
             self.errors = []

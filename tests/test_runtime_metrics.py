@@ -392,3 +392,154 @@ def test_task_table_event_list_bounded():
                        "ts": time.time()}])
     rec = table.list()[0]
     assert rec["state"] == "RUNNING"
+
+
+# ---------------------------------------------------------------------
+# sixth plane: GCS metrics history (docs/observability.md)
+
+def _mk_payload(v, ts=None):
+    return json.dumps({"type": "gauge", "description": "t",
+                       "values": {"{}": v},
+                       "ts": time.time() if ts is None else ts,
+                       "runtime": True}).encode()
+
+
+def test_history_multi_resolution_downsampling():
+    """Each ring seals the LAST write of a closed bucket (last-write-
+    wins) and the live bucket surfaces as the series' pending value."""
+    from ray_tpu._private.metrics_history import GcsMetricsHistoryTable
+
+    t = GcsMetricsHistoryTable(resolutions=[(1.0, 10), (10.0, 10)])
+    base = 1000.0
+    for i in range(30):   # 10 writes/s for 3 seconds
+        t.record("metrics/m/a", _mk_payload(i), now=base + i * 0.1)
+    fine = t.query(name="m", resolution=1.0)
+    # buckets 1000 and 1001 sealed with their last write (9, 19);
+    # bucket 1002's last write (29) is the pending live point
+    assert [p["values"]["{}"] for p in fine] == [9, 19, 29]
+    coarse = t.query(name="m", resolution=10.0)
+    # no 10s boundary crossed yet: pending only
+    assert [p["values"]["{}"] for p in coarse] == [29]
+    # crossing the 10s boundary seals the pending into the coarse ring
+    t.record("metrics/m/a", _mk_payload(99), now=base + 10.5)
+    coarse = t.query(name="m", resolution=10.0)
+    assert [p["values"]["{}"] for p in coarse] == [29, 99]
+    # since= filters by point timestamp
+    late = t.query(name="m", resolution=1.0, since=base + 2.0)
+    assert [p["values"]["{}"] for p in late] == [29, 99]
+
+
+def test_history_ring_count_bound():
+    """A ring never holds more than its configured slot count no matter
+    how many buckets roll past it."""
+    from ray_tpu._private.metrics_history import GcsMetricsHistoryTable
+
+    t = GcsMetricsHistoryTable(resolutions=[(1.0, 5)],
+                               max_bytes=10 * 1024 * 1024)
+    for i in range(50):   # one write per 1s bucket -> 49 seals
+        t.record("metrics/m/a", _mk_payload(i), now=2000.0 + i)
+    s = t.series()[0]
+    assert s["points"] == [5]
+    assert t.stats()["dropped_points"] == 50 - 1 - 5
+
+
+def test_history_series_cap_evicts_idlest():
+    from ray_tpu._private.metrics_history import GcsMetricsHistoryTable
+
+    t = GcsMetricsHistoryTable(resolutions=[(1.0, 10)], max_series=2)
+    t.record("metrics/m/old", _mk_payload(1), now=1000.0)
+    t.record("metrics/m/mid", _mk_payload(2), now=1001.0)
+    t.record("metrics/m/new", _mk_payload(3), now=1002.0)
+    keys = [s["key"] for s in t.series()]
+    assert keys == ["metrics/m/mid", "metrics/m/new"]
+    st = t.stats()
+    assert st["series"] == 2 and st["evicted_series"] == 1
+
+
+def test_history_byte_budget():
+    """The byte budget holds under sustained ingest (oldest stored
+    points dropped first), and the accounting the stats report matches
+    what the table actually holds."""
+    from ray_tpu._private.metrics_history import GcsMetricsHistoryTable
+
+    payload = _mk_payload(1.0)
+    budget = len(payload) * 20
+    t = GcsMetricsHistoryTable(resolutions=[(1.0, 1000)],
+                               max_series=1000, max_bytes=budget)
+    for i in range(200):   # 50 buckets x 4 series, all sealed points
+        t.record(f"metrics/m/s{i % 4}", _mk_payload(float(i)),
+                 now=3000.0 + (i // 4))
+    st = t.stats()
+    assert st["bytes"] <= budget
+    assert st["dropped_points"] > 0
+    # recount from the table contents: stats must not drift from truth
+    with t._lock:
+        held = sum(len(raw) for s in t._series.values()
+                   for ring in s["rings"] for _, _, raw in ring)
+        held += sum(len(s["last_raw"]) for s in t._series.values())
+    assert st["bytes"] == held
+
+
+def test_history_staged_ingest_read_your_writes():
+    """ingest() stages without folding; any reader drains first, so a
+    write is visible to the query that follows it."""
+    from ray_tpu._private.metrics_history import GcsMetricsHistoryTable
+
+    t = GcsMetricsHistoryTable()
+    t.ingest("metrics/m/a", _mk_payload(7.0))
+    assert len(t._staged) == 1          # below the batch threshold
+    pts = t.query(name="m")
+    assert [p["values"]["{}"] for p in pts] == [7.0]
+    assert len(t._staged) == 0
+    # the batch threshold folds without a reader
+    for _ in range(t._INGEST_BATCH):
+        t.ingest("metrics/m/a", _mk_payload(8.0))
+    assert len(t._staged) < t._INGEST_BATCH
+
+
+def test_history_kill_switch(monkeypatch):
+    """RAY_TPU_METRICS_HISTORY=0 beats the CONFIG flag: the GCS ingest
+    path records nothing and the history stays empty."""
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.runtime.gcs import GcsServer
+
+    monkeypatch.setenv("RAY_TPU_METRICS_HISTORY", "0")
+    CONFIG.set("metrics_history_enabled", True)  # bump gen -> re-read env
+    gcs = GcsServer()
+    try:
+        gcs._handle(None, "kv_put", {"key": "metrics/m/x",
+                                     "value": _mk_payload(1.0)})
+        assert gcs._handle(None, "metrics_history_stats", {})["series"] == 0
+        # KV itself still works -- only the history fold is killed
+        with gcs._lock:
+            assert "metrics/m/x" in gcs._kv
+        monkeypatch.delenv("RAY_TPU_METRICS_HISTORY")
+        CONFIG.set("metrics_history_enabled", True)  # bump gen again
+        gcs._handle(None, "kv_put", {"key": "metrics/m/x",
+                                     "value": _mk_payload(2.0)})
+        assert gcs._handle(None, "metrics_history_stats", {})["series"] == 1
+    finally:
+        gcs.stop()
+
+
+def test_gcs_history_rpcs():
+    """The GCS-side RPC surface: windowed query, stats, and the
+    optional per-series index."""
+    from ray_tpu.runtime.gcs import GcsServer
+
+    gcs = GcsServer()
+    try:
+        for i in range(5):
+            gcs._metrics_kv_put("metrics/ray_tpu_t/w1", _mk_payload(i))
+            gcs._metrics_kv_put("metrics/ray_tpu_u/w1", _mk_payload(i * 10))
+        pts = gcs._handle(None, "list_metrics_history",
+                          {"name": "ray_tpu_t"})
+        assert pts and all(p["name"] == "ray_tpu_t" for p in pts)
+        assert pts[-1]["values"]["{}"] == 4   # newest sample visible
+        st = gcs._handle(None, "metrics_history_stats", {"series": True})
+        assert st["series"] == 2 and st["bytes"] > 0
+        idx = {s["key"] for s in st["series_index"]}
+        assert idx == {"metrics/ray_tpu_t/w1", "metrics/ray_tpu_u/w1"}
+        assert st["resolutions"] == [[1.0, 120], [10.0, 180], [60.0, 120]]
+    finally:
+        gcs.stop()

@@ -58,14 +58,12 @@ class ScriptedOwner(cw.CoreWorker):
 
     def __init__(self, raylet_addr):
         # deliberately NOT calling super().__init__ — only the submitter
-        # machinery's state exists; anything else raising AttributeError
-        # is a seam this test file must think about explicitly
-        self._sched = {}
-        self._sched_lock = threading.Lock()
-        self._sched_cv = threading.Condition(self._sched_lock)
-        self._shutdown = threading.Event()
+        # machinery's state exists (one shared helper with the real
+        # CoreWorker, so new submitter fields can't drift from this
+        # tier); anything else raising AttributeError is a seam this
+        # test file must think about explicitly
+        self._init_submitter_state()
         self._raylet = rpc.connect(raylet_addr)
-        self._oom_retries = {}
         self.job_id = JobID.from_random()
         self.replies = []
         self.errors = []
