@@ -166,6 +166,19 @@ SECTIONS = {
                               "--duration", "90",
                               "--new-tokens", "96"],
                          timeout=3600),
+    # serving front door (docs/serve_frontdoor.md): closed-loop SSE
+    # ingress + prefix-affinity routing + quantized handoffs under the
+    # bimodal shared-prefix mix — the row carries the per-pool
+    # TTFT/TPOT SLO classification from the trace plane, the prefix
+    # hit rate (must be nonzero on this mix) and the bytes the int8
+    # handoff codec kept off the wire
+    "serve_frontdoor": dict(cmd=[sys.executable,
+                                 os.path.join(REPO, "benchmarks",
+                                              "serve_frontdoor.py"),
+                                 "--connections", "1000",
+                                 "--duration", "60",
+                                 "--new-tokens", "32"],
+                            timeout=3600),
     "rl": dict(cmd=[sys.executable,
                     os.path.join(REPO, "benchmarks", "rl_perf.py")],
                timeout=3600),   # PPO-to-150 + 2 IMPALA rows on 1 core
@@ -238,6 +251,42 @@ _SERVE_DISAGG_ROWS = {
     "serve_disagg_disaggregated": ("tokens_per_s",
                                    "disagg_tokens_per_s"),
 }
+
+
+# Front-door rows (docs/serve_frontdoor.md): the closed-loop ingress
+# row's throughput and prefix-affinity effectiveness must stay visible
+# the same way.
+_SERVE_FRONTDOOR_ROWS = {
+    "serve_frontdoor_closed_loop": [
+        ("tokens_per_s", "frontdoor_tokens_per_s"),
+        ("prefix_hit_rate", "frontdoor_prefix_hit_rate"),
+    ],
+}
+
+
+def serve_frontdoor_deltas(rows, committed):
+    """Same contract as the other delta families for the front-door
+    closed-loop row (two tracked fields per row)."""
+    if not committed:
+        return {}
+    base = {}
+    for r in committed.get("serve_frontdoor", []):
+        if isinstance(r, dict) and r.get("metric") in _SERVE_FRONTDOOR_ROWS:
+            for field, key in _SERVE_FRONTDOOR_ROWS[r["metric"]]:
+                if r.get(field):
+                    base[key] = r[field]
+    out = {}
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        for field, key in _SERVE_FRONTDOOR_ROWS.get(row.get("metric"),
+                                                    ()):
+            if key not in base or not row.get(field):
+                continue
+            prev, cur = base[key], row[field]
+            out[key] = {"committed": prev, "current": cur,
+                        "ratio": round(cur / prev, 3)}
+    return out
 
 
 def serve_disagg_deltas(rows, committed):
@@ -505,7 +554,8 @@ def main():
     committed = None
     if regenerated & {"core", "streaming", "compiled_dag",
                       "object_transfer", "collective",
-                      "collective_quant", "serve_disagg"}:
+                      "collective_quant", "serve_disagg",
+                      "serve_frontdoor"}:
         committed = _committed_baseline(args.output)
     if "core" in regenerated:
         deltas = control_plane_deltas(out["core"], committed)
@@ -565,6 +615,16 @@ def main():
         deltas = serve_disagg_deltas(out["serve_disagg"], committed)
         if deltas:
             out["serve_disagg_deltas"] = deltas
+            for key, d in deltas.items():
+                tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
+                print(f"[collect] {key}: {d['committed']:,.2f} -> "
+                      f"{d['current']:,.2f} (x{d['ratio']}) [{tag}]",
+                      flush=True)
+    if "serve_frontdoor" in regenerated:
+        deltas = serve_frontdoor_deltas(out["serve_frontdoor"],
+                                        committed)
+        if deltas:
+            out["serve_frontdoor_deltas"] = deltas
             for key, d in deltas.items():
                 tag = "REGRESSION" if d["ratio"] < 0.9 else "ok"
                 print(f"[collect] {key}: {d['committed']:,.2f} -> "

@@ -85,6 +85,12 @@ COLLECTIVE_RING_STALL = "COLLECTIVE_RING_STALL"
 # serving
 REPLICA_RETIRED = "REPLICA_RETIRED"
 AUTOSCALE = "AUTOSCALE"
+# SLO-driven pool re-roling (docs/serve_frontdoor.md): the controller
+# moves a replica between a <base>-prefill/<base>-decode pair — REROLE
+# opens when the donor replica starts draining, REROLE_DONE closes when
+# the receiver pool is healthy at its new target and the donor retired
+SERVE_REROLE = "SERVE_REROLE"
+SERVE_REROLE_DONE = "SERVE_REROLE_DONE"
 # training performance plane (emitted by the GCS step-stats table,
 # docs/observability.md): a gang rank's step time crossed
 # median + k*MAD — the degraded rank names itself (rank/step/phase)

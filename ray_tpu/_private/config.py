@@ -573,6 +573,45 @@ _declare("serve_http_port", int, 8000,
 _declare("serve_controller_loop_ms", int, 250,
          "Serve controller reconcile period (replica set convergence "
          "and autoscaling cadence).")
+_declare("serve_handoff_quantize", bool, False,
+         "Encode cross-host PrefillHandoff KV with the block-scaled "
+         "int8 wire codec (util/collective/quant.py) before "
+         "ray_tpu.put: ~3.9x fewer handoff bytes on the wire at the "
+         "cost of one encode/decode pass per handoff.  Greedy decode "
+         "must stay token-exact (the disagg smoke test gates it).")
+_declare("serve_prefix_cache_pages", int, 0,
+         "KV pages each paged LLM engine may retain as a shared prompt-"
+         "prefix cache after prefill (docs/serve_frontdoor.md).  A new "
+         "prompt whose page-aligned prefix digest-chain matches a "
+         "retained run skips re-prefilling those pages (suffix-only "
+         "prefill over borrowed read-only pages).  0 disables "
+         "retention; refcounted LRU eviction keeps the budget.")
+_declare("serve_prefix_index_max", int, 4096,
+         "Router-side prefix index bound (frontdoor/prefix.py): max "
+         "digest -> replica entries a DeploymentHandle keeps from the "
+         "controller's load-publish path; LRU past it.")
+_declare("serve_rerole_enabled", bool, False,
+         "SLO-driven elastic re-roling: the serve controller watches "
+         "per-pool ttft/tpot SLO violation deltas (trace plane) for "
+         "<base>-prefill/<base>-decode pairs and re-roles one replica "
+         "from the healthy pool into the burning one (drain -> "
+         "re-role -> rejoin).  Off by default; request_rerole() still "
+         "works for forced episodes.")
+_declare("serve_rerole_interval_s", float, 30.0,
+         "How often the controller evaluates the re-roling policy "
+         "(seconds between trace_stats polls).")
+_declare("serve_rerole_cooldown_s", float, 120.0,
+         "Minimum time between re-roling episodes per deployment pair: "
+         "the pool must re-stabilize (and the SLO counters re-baseline) "
+         "before the policy may move another replica.")
+_declare("serve_rerole_min_violations", int, 20,
+         "New SLO violations (since the last poll, on the dominant "
+         "dimension) required before a re-role triggers — below it the "
+         "signal is noise, not a burning pool.")
+_declare("recovery_slo_rerole_s", float, 60.0,
+         "Re-role SLO: budget from SERVE_REROLE to SERVE_REROLE_DONE "
+         "(donor drained + receiver pool healthy again, s); <= 0 "
+         "disables classification.")
 
 
 class Config:

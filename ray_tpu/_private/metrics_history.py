@@ -373,6 +373,10 @@ _M_HEAL_S = rtm.histogram(
     "ray_tpu_recovery_heal_s",
     "REPLICA_RETIRED -> next AUTOSCALE pool-heal latency (s).",
     boundaries=RECOVERY_S_BOUNDARIES)
+_M_REROLE_S = rtm.histogram(
+    "ray_tpu_recovery_rerole_s",
+    "SERVE_REROLE -> SERVE_REROLE_DONE pool re-roling latency (s).",
+    boundaries=RECOVERY_S_BOUNDARIES)
 _M_EPISODES = rtm.counter_family(
     "ray_tpu_recovery_episodes_total",
     "Closed recovery episodes by kind.", tag_keys=("kind",))
@@ -391,10 +395,11 @@ _M_LOST_STEPS = rtm.counter(
 DRAIN = "drain"
 FAILOVER = "failover"
 HEAL = "heal"
+REROLE = "rerole"
 
 # recovery SLO targets are read per closed episode — rare — but the
 # auditor sits on the event-put path, so ride the same generation cache
-_slo_cache = (-1, 0.0, 0.0, 0.0)
+_slo_cache = (-1, 0.0, 0.0, 0.0, 0.0)
 
 
 def _slos() -> tuple:
@@ -404,7 +409,8 @@ def _slos() -> tuple:
     if cached[0] != gen:
         cached = (gen, CONFIG.recovery_slo_drain_s,
                   CONFIG.recovery_slo_failover_s,
-                  CONFIG.recovery_slo_heal_s)
+                  CONFIG.recovery_slo_heal_s,
+                  CONFIG.recovery_slo_rerole_s)
         _slo_cache = cached
     return cached
 
@@ -472,6 +478,10 @@ class RecoveryAuditor:
             self._on_replica_retired(ev)
         elif etype == "AUTOSCALE":
             self._on_autoscale(ev)
+        elif etype == "SERVE_REROLE":
+            self._on_rerole(ev)
+        elif etype == "SERVE_REROLE_DONE":
+            self._on_rerole_done(ev)
         elif etype == "TRANSFER_FAILOVER":
             with self._lock:
                 self._transfer_failovers += 1
@@ -637,6 +647,22 @@ class RecoveryAuditor:
                             old_target=ev.get("old_target"),
                             new_target=ev.get("new_target"),
                             load=ev.get("load"))
+
+    def _on_rerole(self, ev: Dict[str, Any]) -> None:
+        # keyed by the pool pair: the controller serializes re-roles per
+        # pair (cooldown), so one open episode per pair is the contract
+        key = f"{ev.get('src')}->{ev.get('dst')}"
+        self._open_episode(REROLE, key, ev, src=ev.get("src"),
+                           dst=ev.get("dst"), replica=ev.get("replica"),
+                           reason=ev.get("reason"),
+                           slo_kind=ev.get("slo_kind"),
+                           trace_id=ev.get("trace_id"))
+
+    def _on_rerole_done(self, ev: Dict[str, Any]) -> None:
+        key = f"{ev.get('src')}->{ev.get('dst')}"
+        self._close_episode(REROLE, key, ev, _slos()[4], _M_REROLE_S,
+                            src_replicas=ev.get("src_replicas"),
+                            dst_replicas=ev.get("dst_replicas"))
 
     # ---------------------------------------------------------- views
     def list(self, kind: Optional[str] = None,

@@ -142,6 +142,11 @@ class Attention(nn.Module):
     # keeps the dense layout.  See ops/paged_attention.py.
     paged_pages: int = 0
     page_size: int = 64
+    # prefix-cache suffix prefill (serve/llm_engine.py): T > 1 windows
+    # may start at nonzero positions over pages already holding a cached
+    # prompt prefix, so attention must read back through the pool
+    # instead of being causal over its own window only
+    prefix_attend: bool = False
 
     @nn.compact
     def __call__(self, x, cos, sin, positions=None, block_tables=None):
@@ -266,7 +271,26 @@ class Attention(nn.Module):
         # advanced indices at dims 0 and 2 -> value layout [B, T, kvh, 2hd]
         ckv.value = ckv.value.at[pages, :, offs].set(kv)
         if q.shape[1] > 1:
-            return xla_attention(q, k, v, causal=True)
+            if not self.prefix_attend:
+                return xla_attention(q, k, v, causal=True)
+            # suffix prefill: the window's keys are NOT the whole story —
+            # leading block-table entries hold a cached prompt prefix, so
+            # gather the row's full logical span back out of the pool and
+            # mask by absolute position (key j visible iff j <= query p).
+            # Unallocated table entries point at scratch page 0, whose
+            # garbage sits past every real query position.  Offset-0
+            # windows reduce to the causal case (their own keys were just
+            # scattered), so this path is correct for any offset.
+            b = q.shape[0]
+            gathered = ckv.value[block_tables]   # [B, mp, kvh, ps, 2hd]
+            kvfull = jnp.moveaxis(gathered, 3, 2).reshape(
+                b, -1, cfg.n_kv_heads, 2 * cfg.head_dim)
+            k_idx = jnp.arange(kvfull.shape[1])
+            mask = k_idx[None, None, None, :] <= \
+                positions[:, None, :, None]
+            return xla_attention(q, kvfull[..., :cfg.head_dim],
+                                 kvfull[..., cfg.head_dim:],
+                                 causal=False, mask=mask)
         from ray_tpu.ops.paged_attention import paged_attention
         out = paged_attention(q[:, 0], ckv.value, block_tables,
                               positions[:, 0] + 1)
@@ -280,13 +304,15 @@ class Block(nn.Module):
     decode: bool = False
     paged_pages: int = 0
     page_size: int = 64
+    prefix_attend: bool = False
 
     @nn.compact
     def __call__(self, x, cos, sin, positions=None, block_tables=None):
         cfg = self.cfg
         y = RMSNorm(cfg.norm_eps, name="attn_norm")(x)
         y = Attention(cfg, self.mesh, self.rules, self.decode,
-                      self.paged_pages, self.page_size, name="attn")(
+                      self.paged_pages, self.page_size,
+                      self.prefix_attend, name="attn")(
             y, cos, sin, positions, block_tables)
         y = jax.ad_checkpoint.checkpoint_name(y, "attn_out")
         x = x + y
@@ -319,6 +345,7 @@ class GPT(nn.Module):
     decode: bool = False
     paged_pages: int = 0                   # >0: paged KV decode (see Attention)
     page_size: int = 64
+    prefix_attend: bool = False            # suffix prefill over cached pages
 
     @nn.compact
     def __call__(self, tokens, positions=None, return_hidden: bool = False,
@@ -359,7 +386,8 @@ class GPT(nn.Module):
         block_kwargs = dict(mesh=self.mesh, rules=self.rules,
                             decode=self.decode,
                             paged_pages=self.paged_pages,
-                            page_size=self.page_size)
+                            page_size=self.page_size,
+                            prefix_attend=self.prefix_attend)
         call_args = (cos, sin, positions, block_tables)
         if do_remat and 0 < n_remat < cfg.n_layers:
             # partial remat: the first n_remat layers recompute in the

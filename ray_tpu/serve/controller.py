@@ -35,6 +35,14 @@ class ServeController:
         self._lock = threading.RLock()
         self._global_version = 0
         self._shutdown = False
+        # SLO-driven elastic re-roling (docs/serve_frontdoor.md): at
+        # most ONE replica moves between a disagg pair's pools at a
+        # time; the pending move + the last per-route violation
+        # snapshot live here
+        self._rerole: Optional[Dict[str, Any]] = None
+        self._last_rerole_done = 0.0
+        self._last_rerole_check = 0.0
+        self._slo_last: Dict[str, tuple] = {}
         self._reconcile_thread = threading.Thread(
             target=self._reconcile_loop, daemon=True)
         self._reconcile_thread.start()
@@ -96,20 +104,35 @@ class ServeController:
             loads = {i["name"]: i.get("last_load", 0.0)
                      for i in state["replicas"].values()
                      if i["healthy"] and not i.get("draining")}
+            # prefix-digest advertisements ride every reply like loads
+            # (docs/serve_frontdoor.md): they change each health-check
+            # pass without bumping routing_version, and handles rebuild
+            # their affinity index from the full current set.  Absent
+            # entirely (not empty) when no replica advertises, so
+            # non-LLM handles never materialize an index.
+            prefixes = {i["name"]: i["last_prefixes"]
+                        for i in state["replicas"].values()
+                        if i["healthy"] and not i.get("draining")
+                        and i.get("last_prefixes")}
             if state["routing_version"] == known_version:
-                return {"version": known_version, "unchanged": True,
-                        "loads": loads}
-            return {
-                "version": state["routing_version"],
-                "replicas": [i["name"] for i in state["replicas"].values()
-                             if i["healthy"] and not i.get("draining")
-                             and i["version"] == state["version"]],
-                "nodes": {i["name"]: i.get("node_id", "")
-                          for i in state["replicas"].values()},
-                "loads": loads,
-                "max_concurrent_queries":
-                    state["config"].get("max_concurrent_queries", 8),
-            }
+                out = {"version": known_version, "unchanged": True,
+                       "loads": loads}
+            else:
+                out = {
+                    "version": state["routing_version"],
+                    "replicas": [i["name"]
+                                 for i in state["replicas"].values()
+                                 if i["healthy"] and not i.get("draining")
+                                 and i["version"] == state["version"]],
+                    "nodes": {i["name"]: i.get("node_id", "")
+                              for i in state["replicas"].values()},
+                    "loads": loads,
+                    "max_concurrent_queries":
+                        state["config"].get("max_concurrent_queries", 8),
+                }
+            if prefixes:
+                out["prefixes"] = prefixes
+            return out
 
     def list_deployments(self):
         with self._lock:
@@ -150,11 +173,170 @@ class ServeController:
     def ping(self) -> bool:
         return True
 
+    # ------------------------------------------------------------ re-roling
+    def request_rerole(self, src: str, dst: str, *,
+                       reason: str = "manual",
+                       slo_kind: Optional[str] = None,
+                       trace_id: Optional[str] = None) -> bool:
+        """Move one replica's worth of capacity from deployment ``src``
+        to ``dst`` (docs/serve_frontdoor.md re-roling control loop):
+        the lowest-load ``src`` replica starts draining immediately
+        (it leaves the routing table this instant, finishes its
+        in-flight streams, then retires), ``dst``'s target rises by
+        one, and the reconcile loop converges both pools.  Emits
+        SERVE_REROLE now and SERVE_REROLE_DONE when both pools reach
+        their new targets — the pair the recovery auditor folds into a
+        ``rerole`` episode (kind, ``recovery_slo_rerole_s``).
+
+        Refused (returns False) while another re-role is in flight, for
+        unknown deployments, or when ``src`` cannot give up a replica
+        without emptying (its target must stay >= 1)."""
+        from ray_tpu._private import cluster_events as cev
+
+        with self._lock:
+            s = self._deployments.get(src)
+            d = self._deployments.get(dst)
+            if s is None or d is None or self._rerole is not None:
+                return False
+            if s["target_replicas"] < 2:
+                return False
+            # the donor: lowest-load healthy replica — the cheapest
+            # drain, and under prefix-affinity skew also the one whose
+            # resident pages the router will miss least
+            cand = [(i.get("last_load", 0.0), tag)
+                    for tag, i in s["replicas"].items()
+                    if i["healthy"] and not i.get("draining")]
+            if not cand:
+                return False
+            donor = min(cand)[1]
+            s["target_replicas"] -= 1
+            d["target_replicas"] += 1
+            # drain the chosen donor NOW (reconcile sees excess 0 and
+            # drains nothing else); routing_version bumps so handles
+            # polling "unchanged" drop it from their tables
+            s["replicas"][donor]["draining"] = time.monotonic()
+            s["routing_version"] += 1
+            self._rerole = {
+                "src": src, "dst": dst, "replica": donor,
+                "src_target": s["target_replicas"],
+                "dst_target": d["target_replicas"],
+                "started": time.monotonic(),
+            }
+        cev.emit(cev.SERVE_REROLE,
+                 f"re-roling one replica {src} -> {dst}: drain {donor} "
+                 f"({reason})",
+                 src=src, dst=dst, replica=donor, reason=reason,
+                 slo_kind=slo_kind, trace_id=trace_id)
+        return True
+
+    def _check_rerole_done(self) -> None:
+        """Close the pending re-role once both pools converged: the
+        donor retired from ``src`` and ``dst`` runs at its raised
+        target.  Emits SERVE_REROLE_DONE (the auditor's episode
+        close)."""
+        r = self._rerole
+        if r is None:
+            return
+        with self._lock:
+            s = self._deployments.get(r["src"])
+            d = self._deployments.get(r["dst"])
+            if s is None or d is None:
+                # a redeploy/teardown raced the move: abandon it (the
+                # episode stays open in the auditor, which is the
+                # truthful record — convergence never happened)
+                self._rerole = None
+                return
+
+            def _running(st):
+                return sum(1 for i in st["replicas"].values()
+                           if i["healthy"] and not i.get("draining")
+                           and i["version"] == st["version"])
+
+            src_n, dst_n = _running(s), _running(d)
+            done = (dst_n >= r["dst_target"]
+                    and r["replica"] not in s["replicas"])
+        if not done:
+            return
+        from ray_tpu._private import cluster_events as cev
+        cev.emit(cev.SERVE_REROLE_DONE,
+                 f"re-role {r['src']} -> {r['dst']} complete: "
+                 f"{src_n} / {dst_n} replicas",
+                 src=r["src"], dst=r["dst"], replica=r["replica"],
+                 src_replicas=src_n, dst_replicas=dst_n)
+        self._rerole = None
+        self._last_rerole_done = time.monotonic()
+
+    def _maybe_rerole(self) -> None:
+        """The SLO policy half of re-roling: every
+        ``serve_rerole_interval_s`` read the ingress SLO route index
+        (tracing_helper GcsSpanTable ``slo_by_route``) and, for each
+        disagg pool pair, compare the interval's NEW ttft vs tpot
+        violations on the pair's route.  TTFT burning -> the prefill
+        pool is starved -> decode donates a replica; TPOT burning ->
+        decode is starved -> prefill donates.  A tie or a trickle
+        (under ``serve_rerole_min_violations``) moves nothing, and
+        ``serve_rerole_cooldown_s`` spaces moves so a pool settles
+        (drain + engine warmup) before the next reading acts."""
+        if not CONFIG.serve_rerole_enabled or self._rerole is not None:
+            return
+        now = time.monotonic()
+        if now - self._last_rerole_check < CONFIG.serve_rerole_interval_s:
+            return
+        self._last_rerole_check = now
+        with self._lock:
+            names = set(self._deployments)
+        pairs = [n[:-len("-decode")] for n in names
+                 if n.endswith("-decode")
+                 and n[:-len("-decode")] + "-prefill" in names]
+        if not pairs:
+            return
+        try:
+            from ray_tpu.experimental.state.api import trace_stats
+            slo = trace_stats().get("slo_by_route") or {}
+        except Exception:
+            return      # span table unreachable: no signal, no move
+        in_cooldown = now - self._last_rerole_done \
+            < CONFIG.serve_rerole_cooldown_s
+        for base in pairs:
+            decode, prefill = base + "-decode", base + "-prefill"
+            slot = slo.get(decode)
+            if not slot:
+                continue
+            cur = (int(slot.get("ttft_violation", 0)),
+                   int(slot.get("tpot_violation", 0)))
+            prev = self._slo_last.get(decode, (0, 0))
+            # the snapshot always advances: violations burned during a
+            # cooldown are consumed, not banked for a later move
+            self._slo_last[decode] = cur
+            if in_cooldown:
+                continue
+            d_ttft, d_tpot = cur[0] - prev[0], cur[1] - prev[1]
+            if max(d_ttft, d_tpot) < CONFIG.serve_rerole_min_violations \
+                    or d_ttft == d_tpot:
+                continue
+            exemplars = slot.get("exemplars") or []
+            trace_id = exemplars[0].get("trace_id") if exemplars else None
+            if d_ttft > d_tpot:
+                self.request_rerole(
+                    decode, prefill,
+                    reason=f"{d_ttft} ttft violations on {decode} "
+                           f"this interval (tpot: {d_tpot})",
+                    slo_kind="ttft", trace_id=trace_id)
+            else:
+                self.request_rerole(
+                    prefill, decode,
+                    reason=f"{d_tpot} tpot violations on {decode} "
+                           f"this interval (ttft: {d_ttft})",
+                    slo_kind="tpot", trace_id=trace_id)
+            return      # one move per reading across all pairs
+
     # ------------------------------------------------------- reconciliation
     def _reconcile_loop(self):
         while not self._shutdown:
             try:
+                self._maybe_rerole()
                 self._reconcile_once()
+                self._check_rerole_done()
                 self._publish_status()
             except Exception:  # noqa: BLE001 - loop must survive
                 import traceback
@@ -215,6 +397,7 @@ class ServeController:
                     # callable publishes one, else == num_ongoing
                     info["last_load"] = metrics.get(
                         "load", metrics["num_ongoing"])
+                    info["last_prefixes"] = metrics.get("prefixes")
                     if metrics.get("node_id"):
                         info["node_id"] = metrics["node_id"]
                     total_ongoing += metrics["num_ongoing"]
@@ -222,6 +405,7 @@ class ServeController:
                     metrics_partial = True
                     info.pop("last_ongoing", None)
                     info.pop("last_load", None)
+                    info.pop("last_prefixes", None)
                     info["fails"] = info.get("fails", 0) + 1
                     grace_s = config.get("health_check_grace_period_s", 120.0)
                     grace = (time.monotonic() - info.get("created_at", 0.0)

@@ -24,7 +24,9 @@ import weakref
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private.config import CONFIG
 from ray_tpu.serve.controller import CONTROLLER_NAME, SERVE_NAMESPACE
+from ray_tpu.serve.frontdoor.prefix import PrefixIndex, page_digests
 from ray_tpu.util.tracing import tracing_helper as trh
 
 _REFRESH_INTERVAL_S = 1.0
@@ -56,6 +58,13 @@ class DeploymentHandle:
         # for p2c routing, node ids for locality-preferring routes
         self._loads: Dict[str, float] = {}
         self._nodes: Dict[str, str] = {}
+        # prefix-affinity index (docs/serve_frontdoor.md): fed from the
+        # controller's load-publish path when replicas advertise
+        # resident paged-KV prefix digests; lazily materialized so a
+        # handle to a non-LLM deployment pays nothing
+        self._prefix_index: Optional[PrefixIndex] = None
+        self._prefix_page_size = 0
+        self._prefix_advertisers: set = set()
         # replica name -> monotonic deadline: recently-failed replicas
         # the routing table may still list (the controller needs a few
         # health-check passes to retire a death) — skipped until the
@@ -117,7 +126,9 @@ class DeploymentHandle:
             # pass without bumping the routing version
             with self._lock:
                 self._loads.update(targets.get("loads") or {})
+            self._feed_prefixes(targets.get("prefixes"))
             return
+        self._feed_prefixes(targets.get("prefixes"))
         with self._lock:
             self._version = targets["version"]
             self._replicas = targets["replicas"]
@@ -135,6 +146,44 @@ class DeploymentHandle:
             for gone in [r for r in self._actors if r not in live]:
                 del self._actors[gone]
             self._lock.notify_all()
+
+    def _feed_prefixes(self, prefixes: Optional[Dict[str, dict]]) -> None:
+        """Fold one controller publish of advertised prefix digests
+        (replica -> {"page_size", "digests"}) into the affinity index.
+        ``None`` means the deployment doesn't advertise (non-LLM, or
+        the prefix cache is off) — nothing is built.  Replicas that
+        stopped advertising (died, drained, cache wiped on recovery)
+        are dropped so their digests can't pin new requests."""
+        if prefixes is None:
+            return
+        idx = self._prefix_index
+        if idx is None:
+            idx = self._prefix_index = PrefixIndex(
+                CONFIG.serve_prefix_index_max)
+        for replica, adv in prefixes.items():
+            ps = int(adv.get("page_size") or 0)
+            if ps:
+                self._prefix_page_size = ps
+            idx.update(replica, adv.get("digests") or ())
+        for replica in self._prefix_advertisers - set(prefixes):
+            idx.drop_replica(replica)
+        self._prefix_advertisers = set(prefixes)
+
+    def prefix_route(self, prompt) -> Optional[str]:
+        """Replica holding the deepest resident prefix of ``prompt``
+        (docs/serve_frontdoor.md), or None.  Counts hit/miss/evicted on
+        ``ray_tpu_serve_prefix_hit``; a pure no-op (no metric noise)
+        until some replica has advertised."""
+        idx = self._prefix_index
+        ps = self._prefix_page_size
+        if idx is None or ps <= 0 or not prompt:
+            return None
+        chain = page_digests(prompt, ps)
+        if not chain:
+            return None
+        with self._lock:
+            live = set(self._replicas)
+        return idx.lookup(chain, live)
 
     def _actor_for(self, replica: str):
         """Cached replica actor handle: one GCS lookup per replica per
@@ -156,20 +205,29 @@ class DeploymentHandle:
         its own short queue while one replica drowns."""
         return self._inflight.get(r, 0) + self._loads.get(r, 0.0)
 
-    def _pick_replica(self, prefer_node: Optional[str] = None
+    def _pick_replica(self, prefer_node: Optional[str] = None,
+                      prefer_replica: Optional[str] = None
                       ) -> Optional[str]:
         """Power-of-two choices on effective queue depth among replicas
         with spare concurrency; ``prefer_node`` narrows to replicas
         colocated with that node first (e.g. the node holding a KV
         handoff's primary copy) and falls back to the whole pool —
         the cross-node loser still gets the object via the transfer
-        plane's locality-aware pull, just not for free."""
+        plane's locality-aware pull, just not for free.
+
+        ``prefer_replica`` is a hard affinity pick (a prefix-index hit:
+        THAT replica holds the prompt's resident KV pages) honored
+        whenever the replica is routable with spare concurrency —
+        affinity beats load balance because a hit skips whole prefill
+        pages; a saturated or suspect target falls back to p2c."""
         now = time.monotonic()
         candidates = [r for r in self._replicas
                       if self._inflight.get(r, 0) < self._max_concurrent
                       and self._suspect.get(r, 0.0) <= now]
         if not candidates:
             return None
+        if prefer_replica is not None and prefer_replica in candidates:
+            return prefer_replica
         if prefer_node:
             colocated = [r for r in candidates
                          if self._nodes.get(r) == prefer_node]
@@ -198,9 +256,11 @@ class DeploymentHandle:
         submitted to, for mark_suspect on late-surfacing errors."""
         return self._ref_replica.get(result)
 
-    def _route(self, method: str, args: tuple, kwargs: dict):
+    def _route(self, method: str, args: tuple, kwargs: dict,
+               prefer_replica: Optional[str] = None):
         return self._route_impl(
-            lambda actor: actor.handle_request.remote(method, args, kwargs))
+            lambda actor: actor.handle_request.remote(method, args, kwargs),
+            prefer_replica=prefer_replica)
 
     def _route_streaming(self, method: str, args: tuple, kwargs: dict,
                          prefer_node: Optional[str] = None):
@@ -215,7 +275,8 @@ class DeploymentHandle:
                 num_returns="streaming").remote(method, args, kwargs),
             prefer_node=prefer_node)
 
-    def _route_impl(self, submit, prefer_node: Optional[str] = None):
+    def _route_impl(self, submit, prefer_node: Optional[str] = None,
+                    prefer_replica: Optional[str] = None):
         """One routing loop for both request shapes: pick a replica
         (power-of-two choices under max_concurrent_queries), call
         ``submit(actor)``, and anchor the in-flight release on the
@@ -228,7 +289,7 @@ class DeploymentHandle:
         deadline = time.monotonic() + 60.0
         while True:
             with self._lock:
-                replica = self._pick_replica(prefer_node)
+                replica = self._pick_replica(prefer_node, prefer_replica)
                 if replica is not None:
                     self._inflight[replica] = \
                         self._inflight.get(replica, 0) + 1
@@ -472,10 +533,17 @@ class DisaggHandle:
         spans as their children — closed with TTFT/TPOT SLO accounting.
         A request that dies mid-flight closes its root with the failure
         and the crash ``dossier_id`` when the error carries one, so the
-        trace and the flight recorder cross-link."""
-        root = trh.serve_ingress_root(
-            f"disagg:{self.decode.deployment_name}",
-            route=self.decode.deployment_name)
+        trace and the flight recorder cross-link.  When an upstream
+        ingress already owns the request (the SSE front door installed
+        its root on this task's context), no second root opens — the
+        hop spans join the upstream trace and the front door closes the
+        root with CLIENT-observed SLO latency (one request, one SLO
+        verdict)."""
+        root = None
+        if trh.current_context() is None:
+            root = trh.serve_ingress_root(
+                f"disagg:{self.decode.deployment_name}",
+                route=self.decode.deployment_name)
         t0 = time.perf_counter()
         first_tok = last_tok = None
         emitted = 0                 # tokens already yielded to the client
@@ -543,13 +611,22 @@ class DisaggHandle:
         # under it, so queue wait is the visible gap between the two
         sp_pref = trh.open_span("prefill", "hop", ctx=rctx)
         pctx = sp_pref.ctx() if sp_pref is not None else rctx
+        # prefix-affinity (docs/serve_frontdoor.md): pin the prefill
+        # hop to a replica advertising resident KV pages for this
+        # prompt's deepest page-aligned prefix — a hit skips whole
+        # prefill pages engine-side.  Falls back to p2c on miss or
+        # when the pinned replica is saturated/suspect.
+        pinned = self.prefill.prefix_route(request.get("prompt") or ())
+        if sp_pref is not None and pinned is not None:
+            sp_pref.set_attr("prefix_replica", pinned)
         # routing runs in an executor: _route_impl may block (capacity
         # waits, cold-table controller RPC) and this coroutine shares
         # its loop with every other stream (the http_proxy precedent);
         # bind_ctx carries the trace across the executor hop
         pref_ref = await loop.run_in_executor(
             None, trh.bind_ctx(
-                pctx, lambda: self.prefill.prefill.remote(request)))
+                pctx, lambda: self.prefill._route(
+                    "prefill", (request,), {}, prefer_replica=pinned)))
         try:
             pref = await _aget(worker, pref_ref)
         except Exception as e:

@@ -47,6 +47,8 @@ class HTTPProxyActor:
         from aiohttp import web
 
         from ray_tpu.runtime.core_worker import get_global_worker
+        from ray_tpu.serve.frontdoor import sse as fd_sse
+        from ray_tpu.serve.handle import DisaggHandle, _aget
         from ray_tpu.util.tracing import tracing_helper as trh
 
         # per-request closures touch only locals: worker/handle lookups,
@@ -60,7 +62,20 @@ class HTTPProxyActor:
         GetTimeout = ray_tpu.exceptions.GetTimeoutError
         ingress_root = trh.serve_ingress_root
         install_ctx = trh.install
+        uninstall_ctx = trh.uninstall
         finish_request = trh.finish_request
+        stream_sse = fd_sse.stream_sse
+        # disagg routers are long-lived (they cache routing tables and
+        # the prefix-affinity index); one per preset, bound outside the
+        # handlers like the deployment handles
+        disagg_handles: Dict[str, DisaggHandle] = {}
+
+        def get_disagg(preset: str) -> DisaggHandle:
+            h = disagg_handles.get(preset)
+            if h is None:
+                h = disagg_handles[preset] = DisaggHandle(
+                    f"llm-{preset}-prefill", f"llm-{preset}-decode")
+            return h
 
         async def handle(request: web.Request) -> web.Response:
             deployment = request.match_info["deployment"]
@@ -151,6 +166,80 @@ class HTTPProxyActor:
             if not fut.done():
                 fut.set_exception(TimeoutError("request timed out"))
 
+        async def stream_colocated(request: web.Request):
+            """SSE token streaming from a colocated LLM deployment
+            (docs/serve_frontdoor.md): POST /-/stream/{deployment} with
+            an LLM request body; the replica's ``stream`` method is
+            driven via the streaming handle path and each yielded item
+            is framed as an SSE event the moment its ref resolves."""
+            deployment = request.match_info["deployment"]
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                return web.json_response(
+                    {"error": "BadRequest",
+                     "message": "SSE streaming needs a JSON body"},
+                    status=400)
+            root = ingress_root(f"sse:{deployment}", route=deployment)
+            token = install_ctx(root.ctx()) if root is not None else None
+            try:
+                loop = asyncio.get_running_loop()
+                h = get_handle(deployment)
+                try:
+                    # routing may block (capacity wait, cold-table
+                    # controller RPC): off the loop, ctx re-bound
+                    gen = await loop.run_in_executor(
+                        None, trh.bind_ctx(
+                            root.ctx() if root is not None else None,
+                            lambda: h.stream.remote_streaming(payload)))
+                except Exception as e:  # noqa: BLE001 - HTTP 500 below
+                    finish_request(root, pool="sse", route=deployment,
+                                   status=trh.ERROR,
+                                   error_type=type(e).__name__)
+                    return web.json_response(
+                        {"error": type(e).__name__, "message": str(e)},
+                        status=500)
+
+                async def items():
+                    async for ref in gen:
+                        yield await _aget(worker, ref, timeout=60.0)
+
+                return await stream_sse(request, items(),
+                                        route=deployment, pool="sse",
+                                        root=root)
+            finally:
+                if token is not None:
+                    uninstall_ctx(token)
+
+        async def stream_disagg(request: web.Request):
+            """SSE token streaming through the disaggregated router
+            (docs/serve_frontdoor.md): POST /-/disagg/{preset} streams
+            DisaggHandle.stream — first token from the prefill pool
+            (prefix-affinity routed), decode tokens after the handoff,
+            ``{"retry": n}`` death-recovery markers as SSE retry
+            events.  The ingress root opened HERE is the request's
+            trace root (DisaggHandle joins it instead of opening its
+            own) so the SLO verdict carries client-observed latency."""
+            preset = request.match_info["preset"]
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                return web.json_response(
+                    {"error": "BadRequest",
+                     "message": "SSE streaming needs a JSON body"},
+                    status=400)
+            route = f"llm-{preset}-decode"
+            root = ingress_root(f"sse:disagg:{preset}", route=route)
+            token = install_ctx(root.ctx()) if root is not None else None
+            try:
+                dh = get_disagg(preset)
+                return await stream_sse(request, dh.stream(payload),
+                                        route=route, pool="disagg",
+                                        root=root)
+            finally:
+                if token is not None:
+                    uninstall_ctx(token)
+
         async def healthz(_request):
             return web.Response(text="ok")
 
@@ -168,6 +257,9 @@ class HTTPProxyActor:
             app = web.Application()
             app.router.add_get("/-/healthz", healthz)
             app.router.add_post("/-/echo", echo)
+            app.router.add_post("/-/stream/{deployment}",
+                                stream_colocated)
+            app.router.add_post("/-/disagg/{preset}", stream_disagg)
             app.router.add_route("*", "/{deployment}", handle)
             app.router.add_route("*", "/{deployment}/{tail:.*}", handle)
             runner = web.AppRunner(app)

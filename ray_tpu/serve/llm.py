@@ -30,8 +30,10 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ray_tpu._private import runtime_metrics as rtm
+from ray_tpu._private.config import CONFIG
 from ray_tpu.serve.deployment import deployment
 from ray_tpu.util.tracing import tracing_helper as trh
 
@@ -58,6 +60,51 @@ _M_HANDOFF_MS = rtm.histogram_family(
     "gather+fetch), export_put (store publish), import_pull (transfer-"
     "plane fetch), import_admit (upload+remap until decode-ready)",
     tag_key="stage")
+_M_HANDOFF_SAVED = rtm.counter(
+    "ray_tpu_serve_handoff_saved_bytes",
+    "cross-host KV handoff bytes NOT shipped thanks to the int8 wire "
+    "codec (raw - encoded, serve_handoff_quantize)")
+
+# one int8 wire-codec block size for both handoff endpoints: encode and
+# decode must derive identical segmentation (quant.py wire layout)
+_QUANT_BLOCK = 256
+
+
+def _np_dtype(name: str):
+    """np.dtype from its saved string, accepting jax's ml_dtypes names
+    (a bf16 KV pool round-trips through the codec as bfloat16)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_handoff(h):
+    """Swap a PrefillHandoff's raw KV array for its int8 wire encoding
+    (block-scaled symmetric, collective/quant.py): ~3.9x fewer bytes
+    cross the object store + transfer plane per handoff."""
+    from ray_tpu.util.collective.quant import get_codec
+    raw = h.kv
+    h.kv = get_codec("int8", _QUANT_BLOCK).encode(raw)
+    h.codec = "int8"
+    h.kv_shape = tuple(raw.shape)
+    h.kv_dtype = str(raw.dtype)
+    h.raw_nbytes = int(raw.nbytes)
+    return h
+
+
+def _decode_handoff(h):
+    """Inverse of ``_encode_handoff``: restore the raw KV layout before
+    the decode engine imports it (the engine never sees wire bytes)."""
+    from ray_tpu.util.collective.quant import get_codec
+    nelem = 1
+    for dim in h.kv_shape:
+        nelem *= int(dim)
+    h.kv = get_codec(h.codec, _QUANT_BLOCK).decode(
+        h.kv, nelem, _np_dtype(h.kv_dtype)).reshape(h.kv_shape)
+    h.codec = None
+    return h
 
 
 def _record_handoff_event(stage: str, object_hex: str, nbytes: int,
@@ -110,6 +157,7 @@ class LLMServer:
                  # path unreachable
                  import_retry_s: float = 5.0,
                  import_queue_max: Optional[int] = None,
+                 prefix_cache_pages: Optional[int] = None,
                  _upstream: Any = None,
                  config_overrides: Optional[Dict[str, Any]] = None):
         from ray_tpu.models.configs import get_config
@@ -124,6 +172,8 @@ class LLMServer:
             paged = True      # handoff is defined on the paged pool
         cfg = get_config(preset, **(config_overrides or {}))
         params = self._load_params(cfg, checkpoint, seed)
+        if prefix_cache_pages is None:
+            prefix_cache_pages = CONFIG.serve_prefix_cache_pages
         self.engine = LLMEngine(cfg, params, num_slots=num_slots,
                                 max_prompt_len=max_prompt_len,
                                 top_k=top_k, top_p=top_p, seed=seed,
@@ -131,7 +181,8 @@ class LLMServer:
                                 max_seq_len=max_seq_len, paged=paged,
                                 page_size=page_size,
                                 kv_pool_pages=kv_pool_pages,
-                                import_queue_max=import_queue_max)
+                                import_queue_max=import_queue_max,
+                                prefix_cache_pages=prefix_cache_pages)
         # exported handoff objects are owned by THIS replica: freeing
         # the last owner-side ref frees the object, so each ref is
         # pinned for a TTL comfortably beyond any decode retry deadline
@@ -224,6 +275,13 @@ class LLMServer:
                     "finish_reason": h.finish_reason,
                     "prompt_len": h.prompt_len,
                     "time_to_first_token_s": ttft_s}
+        # optional int8 wire quantization (docs/serve_frontdoor.md):
+        # encode BEFORE the store publish so both the put and the
+        # cross-host pull move ~4x fewer bytes; the decode replica
+        # restores the raw layout before import
+        if CONFIG.serve_handoff_quantize and h.kv is not None:
+            h = _encode_handoff(h)
+            _M_HANDOFF_SAVED.inc(h.raw_nbytes - h.nbytes)
         t1 = time.monotonic()
         ref = ray_tpu.put(h)
         put_ms = (time.monotonic() - t1) * 1e3
@@ -293,6 +351,10 @@ class LLMServer:
             _record_handoff_event("import", ref.id.hex(),
                                   handoff.nbytes, pull_ms,
                                   npages=handoff.npages)
+        if getattr(handoff, "codec", None):
+            # quantized wire handoff: restore the raw KV array (the
+            # engine's import path scatters the pool layout verbatim)
+            handoff = _decode_handoff(handoff)
         # import-wait hop: admission into a decode slot (page-table
         # remap, plus any pool-full backoff) — the "import wait" budget
         # line of a traced request
@@ -370,6 +432,17 @@ class LLMServer:
         out = self.engine.stats.snapshot(self.engine.num_slots)
         out["role"] = self.role
         return out
+
+    def advertised_prefixes(self) -> Optional[Dict[str, Any]]:
+        """Resident prompt-prefix digests for the replica metrics path
+        (docs/serve_frontdoor.md): the controller republishes these on
+        get_targets so handles prefix-affinity-route the prefill hop.
+        None (advertise nothing) when the engine's prefix cache is
+        off."""
+        if not getattr(self.engine, "prefix_cache_pages", 0):
+            return None
+        return {"page_size": self.engine.page_size,
+                "digests": self.engine.prefix_digests()}
 
     def _sweep_handoff_pins(self) -> None:
         now = time.monotonic()

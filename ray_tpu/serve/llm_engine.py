@@ -76,6 +76,7 @@ import numpy as np
 
 from ray_tpu.models.configs import TransformerConfig
 from ray_tpu.models.gpt import GPT
+from ray_tpu.serve.frontdoor.prefix import page_digests
 
 # admission waves are padded to the next of these sizes (bounded jit
 # specializations per prompt bucket); the top size bounds how many
@@ -127,6 +128,15 @@ class PrefillHandoff:
     eos_id: Optional[int]
     finish_reason: Optional[str] = None   # set: done at first token
     export_ms: float = 0.0                # prefill->gather->fetch wall
+    # wire-codec fields (docs/serve_frontdoor.md, serve_handoff_quantize):
+    # when ``codec`` is set, ``kv`` holds the ENCODED uint8 wire buffer
+    # and shape/dtype/raw_nbytes describe the original array — the serve
+    # layer (llm.py) encodes after export and decodes before import, so
+    # the engine only ever sees the raw layout.
+    codec: Optional[str] = None
+    kv_shape: Optional[tuple] = None
+    kv_dtype: Optional[str] = None
+    raw_nbytes: int = 0
 
     @property
     def nbytes(self) -> int:
@@ -144,6 +154,9 @@ class _Request:
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
     delivered: bool = False
     export: bool = False                  # deliver a PrefillHandoff
+    # chained page-boundary digests of the prompt (frontdoor/prefix.py),
+    # computed at submit when the prefix cache is enabled
+    digests: Optional[List[str]] = None
 
 
 @dataclasses.dataclass
@@ -159,16 +172,36 @@ class _Import:
 
 class _Slot:
     __slots__ = ("request", "pos", "out", "last_token", "first_token_at",
-                 "pages")
+                 "pages", "prompt_len", "borrowed", "prefix_entry")
 
     def __init__(self, request: _Request, prompt_len: int, first_token: int,
-                 pages: Optional[List[int]] = None):
+                 pages: Optional[List[int]] = None,
+                 borrowed: int = 0, prefix_entry=None):
         self.request = request
         self.pos = prompt_len            # next write position
         self.out = [first_token]
         self.last_token = first_token
         self.first_token_at = time.monotonic()
         self.pages = pages or []         # paged mode: physical pages owned
+        self.prompt_len = prompt_len
+        # prefix-cache hit bookkeeping: the first ``borrowed`` entries of
+        # ``pages`` are SHARED read-only prefix pages owned by
+        # ``prefix_entry`` — never freed here, refcount released instead
+        self.borrowed = borrowed
+        self.prefix_entry = prefix_entry
+
+
+class _PrefixEntry:
+    """A retained run of full prompt pages, shared read-only across
+    hits.  ``chain[i]`` digests the tokens ``pages[:i+1]`` hold."""
+
+    __slots__ = ("pages", "chain", "refs", "last_used")
+
+    def __init__(self, pages: List[int], chain: List[str]):
+        self.pages = pages
+        self.chain = chain
+        self.refs = 0
+        self.last_used = 0
 
 
 class _Prefilled:
@@ -194,6 +227,10 @@ class EngineStats:
         self.exports = 0                 # prefill handoffs shipped out
         self.imports = 0                 # prefill handoffs admitted
         self.import_rejects = 0          # pool-full import rejections
+        self.prefix_hits = 0             # prefills served from cached pages
+        self.prefix_misses = 0           # cache enabled but no usable match
+        self.prefix_tokens_saved = 0     # prompt tokens NOT re-prefilled
+        self.prefix_evictions = 0        # retained runs evicted (LRU/space)
 
     def occupancy(self, num_slots: int) -> float:
         """Fraction of step-slots that produced a delivered token (junk
@@ -211,6 +248,10 @@ class EngineStats:
             "exports": self.exports,
             "imports": self.imports,
             "import_rejects": self.import_rejects,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_tokens_saved": self.prefix_tokens_saved,
+            "prefix_evictions": self.prefix_evictions,
         }
 
 
@@ -224,7 +265,8 @@ class LLMEngine:
                  max_seq_len: Optional[int] = None,
                  paged: bool = False, page_size: int = 64,
                  kv_pool_pages: Optional[int] = None,
-                 import_queue_max: Optional[int] = None):
+                 import_queue_max: Optional[int] = None,
+                 prefix_cache_pages: int = 0):
         # Inference engine owns its own copies of the knobs a server
         # tunes independently of training:
         #  - max_seq_len: the KV allocation AND the per-step attention
@@ -335,7 +377,31 @@ class LLMEngine:
                 if self._is_pool_leaf(leaf))
             self._block_jit = jax.jit(self._block_fn_paged,
                                       donate_argnums=(1, 2))
+            # prompt-prefix page cache (docs/serve_frontdoor.md):
+            # retained full prompt pages stay OUT of _free_pages, keyed
+            # by their chained token digests; hits borrow them read-only
+            # and prefill only the suffix.  The budget never exceeds the
+            # pool minus one working page.
+            self.prefix_cache_pages = max(
+                0, min(int(prefix_cache_pages), self.kv_pool_pages - 2))
+            self._prefix_lock = threading.Lock()
+            self._prefix_index: dict = {}    # digest -> (_PrefixEntry, n)
+            # deepest-digest -> entry, insertion-ordered for LRU
+            self._prefix_entries: collections.OrderedDict = \
+                collections.OrderedDict()
+            self._prefix_pages_used = 0
+            self._prefix_seq = 0
+            if self.prefix_cache_pages:
+                # same params/cache structure, different (static)
+                # attention path: T>1 windows at nonzero offsets attend
+                # back through the pool over borrowed prefix pages
+                self.model_prefix = GPT(cfg, decode=True,
+                                        paged_pages=self.kv_pool_pages,
+                                        page_size=page_size,
+                                        prefix_attend=True)
+            self._suffix_jit: dict = {}      # (bucket, wave) -> jitted fn
         else:
+            self.prefix_cache_pages = 0
             self._no_admit = (jnp.asarray(no_meta),
                               jnp.zeros((num_slots,), jnp.int32))
             self._block_jit = jax.jit(self._block_fn,
@@ -476,6 +542,34 @@ class LLMEngine:
                 first = self._sample_fn(rng, last, temps)
                 return first, mut["cache"]
             fn = self._prefill_jit[(bucket, wave)] = jax.jit(
+                prefill, donate_argnums=(1,))
+        return fn
+
+    def _get_prefill_suffix(self, bucket: int, wave: int):
+        """Prefix-cache hit prefill: like _get_prefill_paged but each
+        row's window starts at a per-row offset (the cached page-aligned
+        prefix length) and attends back through the pool — leading block
+        table entries are BORROWED read-only prefix pages, the scatter
+        touches only the fresh suffix pages past them (positions//ps >=
+        the borrow count, offsets are page-aligned by construction)."""
+        fn = self._suffix_jit.get((bucket, wave))
+        if fn is None:
+            def prefill(params, cache, packed, tables, offs, rng):
+                # packed [wave, bucket+2]: suffix tokens|s_real|temp*1e6
+                tokens = packed[:, :bucket]
+                s_reals = packed[:, bucket]
+                temps = packed[:, bucket + 1].astype(jnp.float32) / 1e6
+                b, s = tokens.shape
+                positions = offs[:, None] + jnp.broadcast_to(
+                    jnp.arange(s), (b, s))
+                logits, mut = self.model_prefix.apply(
+                    {"params": params, "cache": cache}, tokens, positions,
+                    block_tables=tables, mutable=["cache"])
+                last = jnp.take_along_axis(
+                    logits, (s_reals - 1)[:, None, None], axis=1)[:, 0]
+                first = self._sample_fn(rng, last, temps)
+                return first, mut["cache"]
+            fn = self._suffix_jit[(bucket, wave)] = jax.jit(
                 prefill, donate_argnums=(1,))
         return fn
 
@@ -682,10 +776,12 @@ class LLMEngine:
         if len(prompt) > self.max_prompt_len:
             raise ValueError(f"prompt len {len(prompt)} > max_prompt_len "
                              f"{self.max_prompt_len}")
+        digests = (page_digests(prompt, self.page_size)
+                   if self.paged and self.prefix_cache_pages else None)
         return self._submit_request(
             lambda deliver: _Request(list(prompt), max_new_tokens,
                                      temperature, eos_id, deliver,
-                                     on_token),
+                                     on_token, digests=digests),
             self._enqueue)
 
     async def stream(self, prompt: List[int], *, max_new_tokens: int = 32,
@@ -749,10 +845,12 @@ class LLMEngine:
         if len(prompt) > self.max_prompt_len:
             raise ValueError(f"prompt len {len(prompt)} > max_prompt_len "
                              f"{self.max_prompt_len}")
+        digests = (page_digests(prompt, self.page_size)
+                   if self.prefix_cache_pages else None)
         return self._submit_request(
             lambda deliver: _Request(list(prompt), max_new_tokens,
                                      temperature, eos_id, deliver, None,
-                                     export=True),
+                                     export=True, digests=digests),
             self._enqueue)
 
     def import_prefill(self, handoff: PrefillHandoff, *,
@@ -914,6 +1012,10 @@ class LLMEngine:
                 "free_pages": (len(self._free_pages) if self.paged
                                else 0),
                 "pool_pages": self.kv_pool_pages if self.paged else 0,
+                "prefix_pages_cached": (self._prefix_pages_used
+                                        if self.paged else 0),
+                "prefix_entries": (len(self._prefix_entries)
+                                   if self.paged else 0),
             }
 
     def close(self):
@@ -1045,10 +1147,11 @@ class LLMEngine:
             # the freed slot junk-steps its old table until its redirect
             # row rides a block dispatch; pages recycle only through
             # later dispatches, so immediate free is stream-safe (see
-            # module docstring)
+            # module docstring).  Junk writes only ever advance PAST the
+            # prompt span, so leading pages retained by the prefix cache
+            # are never touched by the straggling steps.
             self._stale_slots.add(i)
-            self._free_pages.extend(sl.pages)
-            sl.pages = []
+            self._prefix_release(sl)
         self._deliver_result(sl, reason)
         return True
 
@@ -1176,6 +1279,165 @@ class LLMEngine:
                 if self._maybe_finish(i):
                     break     # rest of the row is junk past eos
 
+    # ------------------------------------------------- prompt-prefix cache
+    #
+    # All mutation happens on the engine loop thread; _prefix_lock only
+    # makes the index/entry maps readable from RPC threads
+    # (prefix_digests, load_snapshot).  Pages owned by the cache are in
+    # NEITHER _free_pages nor any slot: retention moves ownership from a
+    # finishing slot to an entry, eviction moves it back to the free
+    # list.  The _free_pages list itself stays loop-thread-confined.
+
+    def prefix_digests(self, limit: int = 64) -> List[str]:
+        """Boundary digests of retained prefix runs, newest entries
+        first — the replica's advertisement on the controller
+        load-publish path (frontdoor/prefix.py contract)."""
+        if not (self.paged and self.prefix_cache_pages):
+            return []
+        out: List[str] = []
+        with self._prefix_lock:
+            for entry in reversed(self._prefix_entries.values()):
+                take = entry.chain[:len(entry.pages)]
+                rest = max(0, limit - len(out))
+                out.extend(take[-rest:] if rest < len(take) else take)
+                if len(out) >= limit:
+                    break
+        return out[:limit]
+
+    def _prefix_lookup(self, req: _Request):
+        """Deepest retained run covering a page-aligned prefix of
+        ``req.prompt`` (loop thread, engine lock held).  Returns
+        (entry, cover_pages) or None; the hit must leave >= 1 suffix
+        token to prefill (it samples the first token) and the padded
+        suffix window must still fit max_seq_len."""
+        digests = req.digests
+        if not digests or not self.prefix_cache_pages:
+            return None
+        # never borrow the page holding the last prompt token: at least
+        # one real token must run through the suffix prefill
+        max_cover = (len(req.prompt) - 1) // self.page_size
+        with self._prefix_lock:
+            for i in range(min(len(digests), max_cover) - 1, -1, -1):
+                found = self._prefix_index.get(digests[i])
+                if found is None:
+                    continue
+                entry, cover = found
+                cover = min(cover, max_cover, len(entry.pages))
+                if cover <= 0:
+                    continue
+                suffix = len(req.prompt) - cover * self.page_size
+                if (cover * self.page_size + self._bucket(suffix)
+                        > self.cfg.max_seq_len):
+                    continue   # padded window would overflow the span
+                entry.refs += 1
+                self._prefix_seq += 1
+                entry.last_used = self._prefix_seq
+                self._prefix_entries.move_to_end(entry.chain[-1])
+                return entry, cover
+        return None
+
+    def _prefix_evict_locked(self, need: int) -> bool:
+        """Evict refs==0 entries, oldest first, until ``need`` cache-
+        budget pages are free.  Evicted pages return to _free_pages.
+        Caller holds _prefix_lock; loop thread only."""
+        if need > self.prefix_cache_pages:
+            return False
+        victims = [e for e in self._prefix_entries.values()
+                   if e.refs == 0]
+        vi = 0
+        while (self._prefix_pages_used + need > self.prefix_cache_pages
+               and vi < len(victims)):
+            entry = victims[vi]
+            vi += 1
+            for d in entry.chain:
+                if self._prefix_index.get(d, (None,))[0] is entry:
+                    del self._prefix_index[d]
+            self._prefix_entries.pop(entry.chain[-1], None)
+            self._prefix_pages_used -= len(entry.pages)
+            self._free_pages.extend(entry.pages)
+            entry.pages = []
+            self.stats.prefix_evictions += 1
+        return self._prefix_pages_used + need <= self.prefix_cache_pages
+
+    def _prefix_reclaim(self, need_free: int) -> None:
+        """Admission pressure valve (loop thread, engine lock held):
+        the FIFO head needs ``need_free`` pages the free list doesn't
+        have — evict idle retained runs to unblock it rather than
+        wedging admission behind the cache."""
+        if not self.prefix_cache_pages:
+            return
+        with self._prefix_lock:
+            freed = 0
+            for key in list(self._prefix_entries):
+                if freed >= need_free:
+                    break
+                entry = self._prefix_entries[key]
+                if entry.refs:
+                    continue
+                for d in entry.chain:
+                    if self._prefix_index.get(d, (None,))[0] is entry:
+                        del self._prefix_index[d]
+                del self._prefix_entries[key]
+                self._prefix_pages_used -= len(entry.pages)
+                self._free_pages.extend(entry.pages)
+                freed += len(entry.pages)
+                entry.pages = []
+                self.stats.prefix_evictions += 1
+
+    def _prefix_retain(self, sl: _Slot) -> int:
+        """Move a finishing slot's leading full PROMPT pages into the
+        cache (loop thread).  Returns how many of sl.pages the cache
+        took (they must not be freed); 0 when retention is off, the
+        prompt spans < 1 full page, the run is already cached, or the
+        budget cannot fit it even after eviction."""
+        req = sl.request
+        if (not self.prefix_cache_pages or not req.digests
+                or sl.borrowed):
+            return 0
+        n_full = min(sl.prompt_len // self.page_size, len(req.digests),
+                     len(sl.pages))
+        if n_full <= 0:
+            return 0
+        chain = req.digests[:n_full]
+        with self._prefix_lock:
+            known = self._prefix_index.get(chain[-1])
+            if known is not None and known[1] >= n_full:
+                return 0                    # already resident
+            if not self._prefix_evict_locked(n_full):
+                return 0
+            entry = _PrefixEntry(sl.pages[:n_full], chain)
+            self._prefix_seq += 1
+            entry.last_used = self._prefix_seq
+            for i, d in enumerate(chain):
+                self._prefix_index[d] = (entry, i + 1)
+            self._prefix_entries[chain[-1]] = entry
+            self._prefix_entries.move_to_end(chain[-1])
+            self._prefix_pages_used += n_full
+        return n_full
+
+    def _prefix_release(self, sl: _Slot) -> None:
+        """Free a paged slot's pages with prefix accounting: borrowed
+        prefix pages go back to their entry (refcount), owned pages are
+        offered to retention first, the rest return to the pool."""
+        kept = self._prefix_retain(sl)
+        self._free_pages.extend(sl.pages[max(kept, sl.borrowed):])
+        if sl.prefix_entry is not None:
+            with self._prefix_lock:
+                sl.prefix_entry.refs -= 1
+            sl.prefix_entry = None
+        sl.pages = []
+        sl.borrowed = 0
+
+    def _prefix_reset(self) -> None:
+        """Engine-fatal recovery: the pool was rebuilt, every retained
+        page id is meaningless — drop the cache wholesale."""
+        if not self.paged:
+            return
+        with self._prefix_lock:
+            self._prefix_index.clear()
+            self._prefix_entries.clear()
+            self._prefix_pages_used = 0
+
     # ---------------------------------------------------- paged engine loop
 
     def _pages_needed(self, req: _Request) -> int:
@@ -1225,6 +1487,11 @@ class LLMEngine:
                 # queue-full rejection happens synchronously at submit
                 import_todo = []
                 while self._imports:
+                    short = self._imports[0].need - len(self._free_pages)
+                    if short > 0:
+                        # idle retained prefixes must not wedge the
+                        # FIFO head: the cache yields before admission
+                        self._prefix_reclaim(short)
                     if self._imports[0].need > len(self._free_pages):
                         break
                     imp = self._imports.popleft()
@@ -1232,6 +1499,7 @@ class LLMEngine:
                              for _ in range(imp.need)]
                     import_todo.append((imp, pages))
                 todo = []
+                hits = []
                 oversized = []
                 while self._pending:
                     need = self._pages_needed(self._pending[0])
@@ -1239,11 +1507,30 @@ class LLMEngine:
                         # can never fit: fail it rather than spin forever
                         oversized.append(self._pending.popleft())
                         continue
-                    if need > len(self._free_pages):
+                    hit = self._prefix_lookup(self._pending[0])
+                    fresh = need - (hit[1] if hit else 0)
+                    if fresh > len(self._free_pages):
+                        self._prefix_reclaim(
+                            fresh - len(self._free_pages))
+                    if fresh > len(self._free_pages):
+                        if hit is not None:
+                            with self._prefix_lock:
+                                hit[0].refs -= 1
                         break          # FIFO: no bypass, no starvation
                     req = self._pending.popleft()
-                    pages = [self._free_pages.pop() for _ in range(need)]
-                    todo.append((req, pages))
+                    pages = [self._free_pages.pop()
+                             for _ in range(fresh)]
+                    if hit is not None:
+                        entry, cover = hit
+                        self.stats.prefix_hits += 1
+                        self.stats.prefix_tokens_saved += \
+                            cover * self.page_size
+                        hits.append((req, entry.pages[:cover] + pages,
+                                     cover, entry))
+                    else:
+                        if self.prefix_cache_pages and req.digests:
+                            self.stats.prefix_misses += 1
+                        todo.append((req, pages))
             for req in oversized:
                 self._safe_deliver(req, False, ValueError(
                     f"request needs {self._pages_needed(req)} KV pages; "
@@ -1259,7 +1546,8 @@ class LLMEngine:
                     while self._free and self._ready:
                         installs.append((self._ready.popleft(),
                                          self._free.pop()))
-                new_prefills = self._dispatch_prefill_waves(todo)
+                new_prefills = (self._dispatch_prefill_waves(todo)
+                                + self._dispatch_suffix_waves(hits))
                 nxt = self._dispatch_block_paged(installs)
                 if inflight is not None:
                     self._process_block_paged(inflight)
@@ -1272,6 +1560,7 @@ class LLMEngine:
                         [s.request for s in self._slots if s is not None]
                         + [pf.slot_state.request for pf in self._ready]
                         + [r for r, _ in todo]
+                        + [r for r, _, _, _ in hits]
                         + [imp.request for imp, _ in import_todo]
                         + [imp.request for imp in self._imports]
                         + ([r for _, r in inflight[1]] if inflight else [])
@@ -1284,6 +1573,7 @@ class LLMEngine:
                     self._free_pages = list(
                         range(1, self.kv_pool_pages))[::-1]
                     self._stale_slots.clear()
+                self._prefix_reset()
                 inflight = None
                 self._cache = self._init_cache(self._rows)
                 self._state = self._init_state(0)
@@ -1305,13 +1595,53 @@ class LLMEngine:
                 packed[r, bucket] = len(req.prompt)
                 packed[r, bucket + 1] = int(req.temperature * 1e6)
                 tables[r, :len(pages)] = pages
-                metas.append((req, pages, tables[r].copy()))
+                metas.append((req, pages, tables[r].copy(), 0, None))
             firsts, self._cache = self._get_prefill_paged(
                 bucket, wave)(self.params, self._cache,
                               jnp.asarray(packed),
                               jnp.asarray(tables), self._next_key())
             self.stats.prefills += len(chunk)
             out.append((firsts, metas))
+        return out
+
+    def _dispatch_suffix_waves(self, todo: list) -> list:
+        """Prefix-cache hits: batch by SUFFIX-length bucket and run the
+        offset prefill — each row's leading table entries are borrowed
+        read-only prefix pages, the window starts at the page-aligned
+        cover and writes only fresh pages.  Output rides the same
+        (firsts, metas) shape as _dispatch_prefill_waves."""
+        out = []
+        by_bucket: dict = {}
+        for item in todo:
+            req, pages, cover, entry = item
+            sfx = len(req.prompt) - cover * self.page_size
+            by_bucket.setdefault(self._bucket(sfx), []).append(item)
+        for bucket, group in by_bucket.items():
+            for start in range(0, len(group), _WAVE_SIZES[-1]):
+                chunk = group[start:start + _WAVE_SIZES[-1]]
+                wave = next(w for w in _WAVE_SIZES if w >= len(chunk))
+                packed = np.zeros((wave, bucket + 2), np.int32)
+                packed[:, bucket] = 1
+                tables = np.zeros((wave, self.max_pages), np.int32)
+                offs = np.zeros((wave,), np.int32)
+                metas = []
+                for r, (req, pages, cover, entry) in enumerate(chunk):
+                    c = cover * self.page_size
+                    suffix = req.prompt[c:]
+                    packed[r, :len(suffix)] = suffix
+                    packed[r, bucket] = len(suffix)
+                    packed[r, bucket + 1] = int(req.temperature * 1e6)
+                    tables[r, :len(pages)] = pages
+                    offs[r] = c
+                    metas.append((req, pages, tables[r].copy(),
+                                  cover, entry))
+                firsts, self._cache = self._get_prefill_suffix(
+                    bucket, wave)(self.params, self._cache,
+                                  jnp.asarray(packed),
+                                  jnp.asarray(tables),
+                                  jnp.asarray(offs), self._next_key())
+                self.stats.prefills += len(chunk)
+                out.append((firsts, metas))
         return out
 
     def _process_prefill_waves(self, waves: list) -> list:
@@ -1342,9 +1672,11 @@ class LLMEngine:
         Export-flagged requests are returned for the gather stage
         instead of queueing for a local slot."""
         exports = []
-        for (req, pages, table), first in zip(metas, host):
+        for (req, pages, table, borrowed, entry), first in \
+                zip(metas, host):
             self.stats.tokens_generated += 1
-            sl = _Slot(req, len(req.prompt), int(first), pages)
+            sl = _Slot(req, len(req.prompt), int(first), pages,
+                       borrowed, entry)
             if req.export:
                 exports.append((req, sl))
                 continue
@@ -1354,8 +1686,7 @@ class LLMEngine:
             if reason is not None:
                 # never installed -> nothing junk-steps these pages:
                 # free immediately, no redirect needed
-                self._free_pages.extend(sl.pages)
-                sl.pages = []
+                self._prefix_release(sl)
                 self._deliver_result(sl, reason)
             else:
                 with self._lock:
@@ -1379,8 +1710,7 @@ class LLMEngine:
                 # done at its first token: nothing to decode anywhere —
                 # ship a kv-less handoff the serving layer completes
                 # from directly
-                self._free_pages.extend(sl.pages)
-                sl.pages = []
+                self._prefix_release(sl)
                 self.stats.requests_completed += 1
                 self.stats.exports += 1
                 self._safe_deliver(req, True, PrefillHandoff(
@@ -1410,8 +1740,9 @@ class LLMEngine:
                 ms = round((time.monotonic() - t0) * 1e3 / len(chunk), 3)
                 for r, (req, sl, n_occ) in enumerate(chunk):
                     kv = np.ascontiguousarray(host[r, :, :n_occ])
-                    self._free_pages.extend(sl.pages)
-                    sl.pages = []
+                    # the gather above already read the pages: retention
+                    # (prefill-pool hot path) or free, borrow-aware
+                    self._prefix_release(sl)
                     self.stats.exports += 1
                     self._safe_deliver(req, True, PrefillHandoff(
                         kv=kv, page_size=self.page_size, npages=n_occ,

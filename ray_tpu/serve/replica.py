@@ -201,6 +201,7 @@ class ReplicaActor:
         otherwise the in-flight request count.  ``node_id`` feeds
         locality-preferring routing (handle.py prefer_node)."""
         load = None
+        prefixes = None
         if not self._is_function:
             fn = getattr(self._callable, "autoscale_load", None)
             if fn is not None:
@@ -210,7 +211,18 @@ class ReplicaActor:
                     load = float(fn())
                 except Exception:
                     load = None
-        return {
+            # resident prompt-prefix digests (docs/serve_frontdoor.md):
+            # the controller republishes them on the get_targets load
+            # path so handles can prefix-affinity-route.  Advertised
+            # every health-check pass — the set is the replica's CURRENT
+            # cache, not a delta
+            adv = getattr(self._callable, "advertised_prefixes", None)
+            if adv is not None:
+                try:
+                    prefixes = adv()
+                except Exception:
+                    prefixes = None
+        out = {
             "replica_tag": self.replica_tag,
             "num_ongoing": self._num_ongoing,
             "load": (load if load is not None
@@ -219,6 +231,9 @@ class ReplicaActor:
             "num_processed": self._num_processed,
             "uptime_s": time.time() - self._started,
         }
+        if prefixes:
+            out["prefixes"] = prefixes
+        return out
 
     @staticmethod
     def _node_id() -> str:

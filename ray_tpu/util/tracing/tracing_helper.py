@@ -749,9 +749,18 @@ class GcsSpanTable:
                 # must not grow GCS memory without bound
                 route = "__other__"
             slot = self._slo.setdefault(
-                route, {"good": 0, "violation": 0, "exemplars": []})
+                route, {"good": 0, "violation": 0,
+                        "ttft_violation": 0, "tpot_violation": 0,
+                        "exemplars": []})
             if span.get("slo_ok") is False:
                 slot["violation"] += 1
+                # per-dimension counts: the re-roling policy needs to
+                # know WHICH budget a route is burning (ttft -> the
+                # prefill pool is starved, tpot -> decode is)
+                for dim in span.get("slo_violated") or ():
+                    k = f"{dim}_violation"
+                    if k in slot:
+                        slot[k] += 1
             elif span.get("slo_ok") is True:
                 slot["good"] += 1
             ttft = span.get("ttft_ms")
@@ -834,6 +843,8 @@ class GcsSpanTable:
         with self._stats_lock:
             slo = {route: {"good": s["good"],
                            "violation": s["violation"],
+                           "ttft_violation": s.get("ttft_violation", 0),
+                           "tpot_violation": s.get("tpot_violation", 0),
                            "exemplars": [
                                {"ttft_ms": t, "trace_id": tid}
                                for t, tid in s["exemplars"]]}

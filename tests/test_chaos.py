@@ -9,6 +9,7 @@ in the tree, so a lock-order inversion or ring-protocol break anywhere
 in the kill/recovery paths fails loudly here instead of deadlocking
 one run in a thousand."""
 
+import threading
 import time
 
 import numpy as np
@@ -417,7 +418,7 @@ def test_disagg_serving_survives_replica_chaos():
             max_concurrent_queries=32))
         handle = serve.llm.disagg_handle("tiny")
 
-        async def one(i, fired):
+        async def one(i):
             toks, summary, retries = [], None, 0
             async for item in handle.stream(
                     {"prompt": [i + 1, i + 2, i + 3],
@@ -428,50 +429,61 @@ def test_disagg_serving_survives_replica_chaos():
                     retries = item["retry"]
                 else:
                     summary = item
-                if i == 0 and len(toks) == 2 and not fired["kill"]:
-                    fired["kill"] = True
-                    _kill_one_per_pool()
             return toks, summary, retries
 
         killed_actor_ids = []
+        chaos = {"fired": False}
+        stop = threading.Event()
 
-        def _kill_one_per_pool():
-            st = serve.status()
-            # one prefill replica (any) ...
-            tag = st["llm-tiny-prefill"]["replicas"][0]
-            a = rt.get_actor(REPLICA_PREFIX + tag,
-                             namespace=SERVE_NAMESPACE)
-            killed_actor_ids.append(a._actor_id.hex())
-            rt.kill(a)
-            # ... and one BUSY decode replica (a stream dies under us).
-            # Poll instead of a single scan: on a loaded box the one
-            # instant we look can fall between token steps on every
-            # replica, no decode gets killed, and the "no stream
-            # observed the decode kill" assert below fires.  With 7+
-            # streams still mid-generation a busy replica appears
-            # almost immediately; the deadline only bounds pathology.
-            d = time.monotonic() + 30
-            while time.monotonic() < d:
-                for tag in st["llm-tiny-decode"]["replicas"]:
-                    a = rt.get_actor(REPLICA_PREFIX + tag,
-                                     namespace=SERVE_NAMESPACE)
-                    if rt.get(a.get_metrics.remote(),
-                              timeout=30)["num_ongoing"] > 0:
+        def _watch_and_kill():
+            # state-based trigger: fire the moment ANY decode replica
+            # reports an in-flight stream (server-side num_ongoing),
+            # instead of waiting for a client-side token count — under
+            # load the tiny engine can finish every stream server-side
+            # before a starved driver coroutine sees token 2, and a
+            # count-triggered kill then hits only idle replicas (the
+            # load-flake this watcher deflakes).  Killing a decode
+            # replica WHILE it owns a stream guarantees some stream
+            # observes the death and retries.
+            deadline = time.monotonic() + 60
+            while not stop.is_set() and time.monotonic() < deadline:
+                try:
+                    st = serve.status()
+                    for tag in st["llm-tiny-decode"]["replicas"]:
+                        a = rt.get_actor(REPLICA_PREFIX + tag,
+                                         namespace=SERVE_NAMESPACE)
+                        if rt.get(a.get_metrics.remote(),
+                                  timeout=30)["num_ongoing"] <= 0:
+                            continue
+                        # this decode replica is mid-stream: kill it...
                         killed_actor_ids.append(a._actor_id.hex())
                         rt.kill(a)
+                        # ... and one prefill replica (any)
+                        ptag = st["llm-tiny-prefill"]["replicas"][0]
+                        pa = rt.get_actor(REPLICA_PREFIX + ptag,
+                                          namespace=SERVE_NAMESPACE)
+                        killed_actor_ids.append(pa._actor_id.hex())
+                        rt.kill(pa)
+                        chaos["fired"] = True
                         return
+                except Exception:
+                    # startup races (replica not registered yet) just
+                    # mean "look again"
+                    pass
                 time.sleep(0.05)
-                st = serve.status()
+
+        watcher = threading.Thread(target=_watch_and_kill, daemon=True)
+        watcher.start()
 
         async def main():
-            fired = {"kill": False}
-            outs = await asyncio.gather(
-                *[one(i, fired) for i in range(8)])
-            return outs, fired["kill"]
+            return await asyncio.gather(*[one(i) for i in range(8)])
 
-        outs, killed = asyncio.run(
-            asyncio.wait_for(main(), timeout=300))
-        assert killed, "chaos never fired"
+        try:
+            outs = asyncio.run(asyncio.wait_for(main(), timeout=300))
+        finally:
+            stop.set()
+            watcher.join(timeout=60)
+        assert chaos["fired"], "chaos never fired"
         for i, (toks, summary, _) in enumerate(outs):
             assert len(toks) == 16, (i, len(toks))
             assert summary is not None and \

@@ -1,0 +1,381 @@
+"""Serving front door (docs/serve_frontdoor.md): SSE streaming ingress,
+prefix-affinity routing, SLO-driven pool re-roling.
+
+Tier-1 smokes on the CPU-sized tiny model: the SSE bridge must be
+token-exact against the handle-level stream, the router must pin
+shared-prefix prompts to the advertising prefill replica (and the
+engine must actually skip the re-prefill), and a forced re-role must
+execute drain -> re-role -> rejoin with a closed ``rerole`` episode in
+the recovery auditor.  The 10k-connection closed-loop harness rides as
+@slow (benchmarks/serve_frontdoor.py carries the MICROBENCH row).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu import serve
+
+
+def _init(**system_config):
+    # record every trace: the smokes cross-link specific requests, so
+    # the default 10% sampler would make them flaky
+    system_config.setdefault("trace_sample_rate", 1.0)
+    rt.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+            system_config=system_config)
+
+
+def _shutdown():
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    rt.shutdown()
+
+
+def _stream_all(handle, requests, timeout=300):
+    """Drive N concurrent streams through a DisaggHandle; returns
+    (tokens, summary, retries) per request, in order."""
+    import asyncio
+
+    async def one(req):
+        toks, summary, retries = [], None, 0
+        async for item in handle.stream(req):
+            if "token" in item:
+                toks.append(item["token"])
+            elif "retry" in item:
+                retries = item["retry"]
+            else:
+                summary = item
+        return toks, summary, retries
+
+    async def main():
+        return await asyncio.gather(*[one(r) for r in requests])
+
+    return asyncio.run(asyncio.wait_for(main(), timeout=timeout))
+
+
+def _sse_events(resp):
+    """Parse one SSE response body: [(event_name_or_None, data_dict)].
+    The wire format is ``[event: name NL] data: json NL NL`` per frame
+    (serve/frontdoor/sse.py format_event)."""
+    out, event = [], None
+    for raw in resp:
+        line = raw.decode("utf-8").rstrip("\r\n")
+        if line.startswith("event:"):
+            event = line.split(":", 1)[1].strip()
+        elif line.startswith("data:"):
+            out.append((event, json.loads(line.split(":", 1)[1])))
+            event = None
+    return out
+
+
+def _sse_post(url, req, timeout=240):
+    """POST one LLM request, stream the SSE frames back."""
+    r = urllib.request.Request(
+        url, data=json.dumps(req).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith(
+            "text/event-stream"), resp.headers["Content-Type"]
+        return _sse_events(resp)
+
+
+def _sse_tokens(events):
+    toks = [d["token"] for ev, d in events
+            if ev is None and "token" in d]
+    done = [d for ev, d in events if ev == "done"]
+    assert len(done) == 1, events
+    return toks, done[0]
+
+
+def test_sse_stream_token_exact():
+    """The SSE front door is a faithful bridge: tokens streamed over
+    HTTP (both the colocated ``/-/stream/{deployment}`` path and the
+    disaggregated ``/-/disagg/{preset}`` path) are exactly the tokens
+    the in-process handle streams, with the summary frame as an
+    ``event: done`` and each connection's ingress root feeding the SLO
+    plane with client-observed TTFT/TPOT."""
+    port = 18272
+    _init()
+    try:
+        serve.start(serve.HTTPOptions(port=port))
+        # one app per path: colocated "llm-tiny" + a 1+1 disagg pair
+        serve.run(serve.llm.build_app(preset="tiny", num_slots=4,
+                                      max_concurrent_queries=32))
+        serve.run(serve.llm.build_app(
+            preset="tiny", disaggregated=True, num_replicas=1,
+            prefill_replicas=1, num_slots=4, block_size=4, page_size=8,
+            max_concurrent_queries=32))
+        handle = serve.llm.disagg_handle("tiny")
+
+        prompts = [[1, 2, 3], [7, 8, 9, 10, 11], [50, 60], [9] * 17]
+        reqs = [{"prompt": p, "max_new_tokens": 6, "temperature": 0.0}
+                for p in prompts]
+        expect = {tuple(r["prompt"]): toks
+                  for r, (toks, _, _) in zip(reqs,
+                                             _stream_all(handle, reqs))}
+
+        # --- disagg SSE: 4 concurrent connections
+        outs = [None] * len(reqs)
+        errs = []
+
+        def fetch(i, path):
+            try:
+                outs[i] = _sse_post(
+                    f"http://127.0.0.1:{port}{path}", reqs[i])
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=fetch, args=(i, "/-/disagg/tiny"))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errs, errs
+        for req, events in zip(reqs, outs):
+            toks, done = _sse_tokens(events)
+            assert toks == expect[tuple(req["prompt"])], (req, toks)
+            assert done["finish_reason"] == "length"
+            assert done["num_tokens"] == 6
+
+        # --- colocated SSE against the same expectations
+        events = _sse_post(f"http://127.0.0.1:{port}/-/stream/llm-tiny",
+                           reqs[0])
+        toks, done = _sse_tokens(events)
+        assert toks == expect[tuple(prompts[0])]
+        assert done["finish_reason"] == "length"
+
+        # a malformed body is a 400, not a wedged stream
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{port}/-/disagg/tiny", data=b"not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(r, timeout=30)
+        assert ei.value.code == 400
+
+        # --- ingress roots feed the SLO plane: every SSE request above
+        # closed a root on its route with client-observed latency
+        from ray_tpu.experimental.state.api import trace_stats
+        deadline = time.monotonic() + 60
+        by_route = {}
+        while time.monotonic() < deadline:
+            by_route = trace_stats().get("slo_by_route") or {}
+            dec = by_route.get("llm-tiny-decode") or {}
+            col = by_route.get("llm-tiny") or {}
+            if (dec.get("good", 0) + dec.get("violation", 0) >= 4
+                    and col.get("good", 0) + col.get("violation", 0) >= 1):
+                break
+            time.sleep(0.5)
+        dec = by_route.get("llm-tiny-decode") or {}
+        assert dec.get("good", 0) + dec.get("violation", 0) >= 4, by_route
+        col = by_route.get("llm-tiny") or {}
+        assert col.get("good", 0) + col.get("violation", 0) >= 1, by_route
+    finally:
+        _shutdown()
+
+
+def test_prefix_affinity_routing():
+    """Shared-prefix prompts pin the prefill hop to the replica whose
+    paged-KV cache already holds the prefix: the replica advertises its
+    resident boundary digests up the load-publish path, the router's
+    PrefixIndex routes on them, the ray_tpu_serve_prefix_hit family
+    counts the outcome, and the engine's counters prove the hit path
+    skipped the shared pages' prefill — with the streamed tokens still
+    exactly the lone-generation reference."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.configs import get_config
+    from ray_tpu.models.generate import Generator
+    from ray_tpu.models.gpt import GPT
+    from ray_tpu.serve.controller import SERVE_NAMESPACE
+    from ray_tpu.serve.frontdoor.prefix import _M_PREFIX_HIT
+
+    _init()
+    try:
+        serve.start()
+        # 2 prefill replicas so affinity is a real routing decision
+        # (p2c would spread the shared prefix across both); prefix
+        # cache only on the prefill pool
+        serve.run(serve.llm.build_app(
+            preset="tiny", disaggregated=True, num_replicas=1,
+            prefill_replicas=2, num_slots=4, block_size=4, page_size=8,
+            max_concurrent_queries=32,
+            prefill_server_kwargs={"prefix_cache_pages": 8}))
+        handle = serve.llm.disagg_handle("tiny")
+
+        shared = list(range(1, 17))          # 2 full 8-token pages
+        warm = {"prompt": shared + [31], "max_new_tokens": 4,
+                "temperature": 0.0}
+        (toks, summary, _), = _stream_all(handle, [warm])
+        assert summary["finish_reason"] == "length"
+
+        # advertisement round trip: engine retains pages at slot-free ->
+        # replica advertises on the next health-check pass -> controller
+        # republishes on get_targets -> handle refresh feeds the index
+        deadline = time.monotonic() + 90
+        pinned = None
+        while time.monotonic() < deadline and pinned is None:
+            handle.prefill._refresh(force=True)
+            pinned = handle.prefill.prefix_route(shared)
+            if pinned is None:
+                time.sleep(0.5)
+        assert pinned is not None, "prefix advertisement never reached " \
+            f"the router: {handle.prefill._prefix_index and handle.prefill._prefix_index.stats()}"
+
+        hits0 = _M_PREFIX_HIT.get("hit").value
+        reqs = [{"prompt": shared + [41 + i], "max_new_tokens": 4,
+                 "temperature": 0.0} for i in range(4)]
+        outs = _stream_all(handle, reqs)
+        for (toks, summary, _) in outs:
+            assert summary["finish_reason"] == "length"
+            assert summary["num_tokens"] == 4
+
+        # every routed prefill above consulted the index and hit
+        assert _M_PREFIX_HIT.get("hit").value - hits0 >= 4
+
+        # the pinned replica's ENGINE took the hits: its suffix prefill
+        # skipped the 16 shared tokens each time (prefix_route returns
+        # the full actor name, the same key the routing table uses)
+        a = rt.get_actor(pinned, namespace=SERVE_NAMESPACE)
+        s = rt.get(a.handle_request.remote("stats", (), {}), timeout=60)
+        assert s["prefix_hits"] >= 4, s
+        assert s["prefix_tokens_saved"] >= 4 * len(shared), s
+
+        # numerics gate: the hit path (suffix prefill over retained
+        # pages) must not change what gets generated
+        cfg = get_config("tiny")
+        model = GPT(cfg, decode=True)
+        import jax
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 1), jnp.int32))["params"]
+        lone = Generator(cfg, params)
+        for i, (toks, _, _) in enumerate(outs):
+            expect = [int(t) for t in lone.generate(
+                jnp.asarray([shared + [41 + i]], jnp.int32),
+                max_new_tokens=4, temperature=0.0)[0]]
+            assert toks == expect, (i, toks, expect)
+    finally:
+        _shutdown()
+
+
+def test_forced_rerole_episode_audited():
+    """Controller-driven pool re-roling end to end: request_rerole
+    drains the donor prefill replica, shifts pool targets, and the
+    reconcile loop grows the decode pool — with the whole episode
+    visible to the recovery auditor as a closed ``rerole`` episode
+    cross-linked to a real ingress trace."""
+    from ray_tpu.experimental import state
+    from ray_tpu.serve.controller import CONTROLLER_NAME, SERVE_NAMESPACE
+
+    _init()
+    try:
+        serve.start()
+        serve.run(serve.llm.build_app(
+            preset="tiny", disaggregated=True, num_replicas=1,
+            prefill_replicas=2, num_slots=4, block_size=4, page_size=8,
+            max_concurrent_queries=32))
+        handle = serve.llm.disagg_handle("tiny")
+        # traffic first: the episode should cross-link a real trace
+        _stream_all(handle, [{"prompt": [3, 4, 5], "max_new_tokens": 4,
+                              "temperature": 0.0}] * 2)
+        deadline = time.monotonic() + 60
+        traces = []
+        while time.monotonic() < deadline and not traces:
+            traces = state.list_traces(route="llm-tiny-decode", limit=5)
+            if not traces:
+                time.sleep(0.5)
+        assert traces, "no ingress trace to cross-link"
+        tid = traces[0]["trace_id"]
+
+        controller = rt.get_actor(CONTROLLER_NAME,
+                                  namespace=SERVE_NAMESPACE)
+        ok = rt.get(controller.request_rerole.remote(
+            "llm-tiny-prefill", "llm-tiny-decode", reason="slo",
+            slo_kind="ttft", trace_id=tid), timeout=30)
+        assert ok is True
+        # one move in flight per controller: a concurrent request is
+        # refused, not queued
+        ok2 = rt.get(controller.request_rerole.remote(
+            "llm-tiny-prefill", "llm-tiny-decode"), timeout=30)
+        assert ok2 is False
+
+        # drain -> re-role -> rejoin: prefill 2 -> 1, decode 1 -> 2
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            st = serve.status()
+            if (len(st["llm-tiny-prefill"]["replicas"]) == 1
+                    and st["llm-tiny-prefill"]["target_replicas"] == 1
+                    and len(st["llm-tiny-decode"]["replicas"]) == 2):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"re-role never converged: "
+                                 f"{serve.status()}")
+
+        # the auditor closed the episode (SERVE_REROLE ->
+        # SERVE_REROLE_DONE) with the SLO verdict and the trace link
+        deadline = time.monotonic() + 60
+        eps = []
+        while time.monotonic() < deadline and not eps:
+            eps = state.list_recovery_episodes(kind="rerole",
+                                               include_open=False)
+            if not eps:
+                time.sleep(0.5)
+        assert eps, "auditor never closed the rerole episode"
+        ep = eps[-1]
+        assert ep["src"] == "llm-tiny-prefill"
+        assert ep["dst"] == "llm-tiny-decode"
+        assert ep["reason"] == "slo" and ep["slo_kind"] == "ttft"
+        assert ep["trace_id"] == tid
+        assert state.get_trace(tid) is not None   # link resolves
+        assert ep["src_replicas"] == 1 and ep["dst_replicas"] == 2
+        assert ep["latency_s"] > 0
+        # default re-roling SLO (recovery_slo_rerole_s): 60 s
+        assert ep["slo_s"] == 60.0
+        assert ep["violation"] == (ep["latency_s"] > ep["slo_s"])
+
+        # re-roled pools still serve: a stream through the reshaped
+        # pair completes (the donor's drain never stranded a request)
+        (toks, summary, _), = _stream_all(
+            handle, [{"prompt": [8, 9], "max_new_tokens": 4,
+                      "temperature": 0.0}])
+        assert summary["finish_reason"] == "length"
+
+        from conftest import record_recovery_row
+        record_recovery_row({
+            "name": "rerole", "latency_s": ep["latency_s"],
+            "slo_s": ep["slo_s"], "violation": ep["violation"],
+            "reference": "tests/test_serve_frontdoor.py::"
+                         "test_forced_rerole_episode_audited"})
+    finally:
+        _shutdown()
+
+
+@pytest.mark.slow
+def test_serve_frontdoor_load_harness_10k():
+    """The full 10k-connection closed-loop SSE harness
+    (benchmarks/serve_frontdoor.py) with the MICROBENCH acceptance
+    bars: zero stream errors, per-pool TTFT/TPOT SLO classification
+    present, nonzero prefix-hit-rate on the bimodal shared-prefix mix.
+    ~15 min; tier-1 runs the smokes above instead."""
+    from benchmarks.serve_frontdoor import run_frontdoor
+
+    rows = run_frontdoor(connections=10000, new_tokens=48,
+                         duration_s=120.0)
+    row = rows[-1]
+    assert row["metric"] == "serve_frontdoor_closed_loop"
+    assert row["errors"] == 0
+    assert row["connections"] >= 10000
+    assert row["prefix_hit_rate"] > 0, row
+    slo = row["slo"]
+    assert "llm-tiny-decode" in slo, slo
+    verdicts = slo["llm-tiny-decode"]
+    assert verdicts["good"] + verdicts["violation"] > 0
+    assert row["handoff_saved_bytes"] > 0, row

@@ -191,6 +191,7 @@ def test_span_table_slo_index_and_exemplars():
     stats = t.stats()["slo_by_route"]["llm-a"]
     assert stats == {
         "good": 6, "violation": 2,
+        "ttft_violation": 2, "tpot_violation": 0,
         "exemplars": stats["exemplars"]}
     # exemplars are the worst TTFTs, descending
     ttfts = [e["ttft_ms"] for e in stats["exemplars"]]
