@@ -182,6 +182,15 @@ SECTIONS = {
     "rl": dict(cmd=[sys.executable,
                     os.path.join(REPO, "benchmarks", "rl_perf.py")],
                timeout=3600),   # PPO-to-150 + 2 IMPALA rows on 1 core
+    # podracer RL data plane (docs/rl_podracer.md): IMPALA + PPO
+    # env-frames/s A/B vs the blocking executor (same fleet, same
+    # budget, mid-run actor-kill probe in the podracer arm) and the
+    # fleet-floor weight-adoption latency at 2/4/8 actors — the
+    # sub-linear growth bar for the store-routed multi-source broadcast
+    "rl_podracer": dict(cmd=[sys.executable,
+                             os.path.join(REPO, "benchmarks",
+                                          "rl_podracer.py")],
+                        timeout=2400),
     "vision": dict(cmd=[sys.executable,
                         os.path.join(REPO, "benchmarks", "vision_perf.py")],
                    timeout=1800),

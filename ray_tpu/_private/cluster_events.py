@@ -100,6 +100,13 @@ TRAIN_STRAGGLER = "TRAIN_STRAGGLER"
 # detected rank/node death (event plane or poll failure), re-formed the
 # gang and resumed from the latest reported checkpoint
 TRAIN_GANG_RECOVERY = "TRAIN_GANG_RECOVERY"
+# RL podracer fleet (docs/rl_podracer.md): a rollout actor's stream
+# died (preemption/crash) — the learner keeps stepping on the survivors
+# — and the replacement actor finished rendezvous (weights pulled,
+# stream re-established).  The recovery auditor pairs them per fleet
+# slot into `rl_actor` episodes.
+RL_ACTOR_LOST = "RL_ACTOR_LOST"
+RL_ACTOR_JOINED = "RL_ACTOR_JOINED"
 # flight-recorder breadcrumbs (ring_only by convention)
 TASK_RUNNING = "TASK_RUNNING"
 TASK_FAILED = "TASK_FAILED"

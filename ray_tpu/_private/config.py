@@ -613,6 +613,42 @@ _declare("recovery_slo_rerole_s", float, 60.0,
          "(donor drained + receiver pool healthy again, s); <= 0 "
          "disables classification.")
 
+# --------------------------------------------------------------------------- #
+# RL podracer executor (docs/rl_podracer.md)                                  #
+# --------------------------------------------------------------------------- #
+_declare("podracer_backpressure_fragments", int, 2,
+         "Per-rollout-actor staleness bound for the podracer streaming "
+         "ingest: at most this many yielded-but-unconsumed fragments may "
+         "be in flight per actor (stamped into "
+         "generator_backpressure_num_objects at stream submit time). "
+         "<= 0 leaves the stream unbounded — free-running actors whose "
+         "fragments can be arbitrarily stale.")
+_declare("podracer_prefetch_depth", int, 4,
+         "Depth of the learner-side host prefetch queue that overlaps "
+         "fragment download/deserialization with the compiled learner "
+         "step.  A full queue blocks the per-actor ingest threads, which "
+         "stops acking the streams and lets generator backpressure pause "
+         "the producers — queue depth + per-actor window together bound "
+         "end-to-end staleness.")
+_declare("podracer_weight_quantize", bool, False,
+         "Publish podracer weight versions int8 block-quantized over the "
+         "wire (the PR 16 Int8Codec; block size collective_quant_block). "
+         "~4x fewer broadcast bytes per sync at a bounded blockmax/254 "
+         "round-trip error; actors dequantize on pull.")
+_declare("podracer_weight_keep_versions", int, 2,
+         "Published weight versions the learner keeps alive (object-store "
+         "refs) beyond the newest.  Older refs are dropped so the store "
+         "reclaims them; actors that poll past a dropped version jump "
+         "straight to the newest (the version-skip rule).")
+_declare("podracer_sync_every_steps", int, 1,
+         "Learner steps between weight-version publishes.  1 publishes "
+         "after every optimizer step (lowest staleness, most broadcast "
+         "traffic); larger values amortize the put() + KV bump.")
+_declare("recovery_slo_rl_actor_s", float, 60.0,
+         "RL actor-replacement SLO: budget from RL_ACTOR_LOST to the "
+         "replacement's RL_ACTOR_JOINED for that fleet slot (s); <= 0 "
+         "disables classification.")
+
 
 class Config:
     """Process-wide resolved flag values.

@@ -377,6 +377,11 @@ _M_REROLE_S = rtm.histogram(
     "ray_tpu_recovery_rerole_s",
     "SERVE_REROLE -> SERVE_REROLE_DONE pool re-roling latency (s).",
     boundaries=RECOVERY_S_BOUNDARIES)
+_M_RL_ACTOR_S = rtm.histogram(
+    "ray_tpu_recovery_rl_actor_s",
+    "RL_ACTOR_LOST -> RL_ACTOR_JOINED rollout-actor replacement "
+    "latency per fleet slot (s).",
+    boundaries=RECOVERY_S_BOUNDARIES)
 _M_EPISODES = rtm.counter_family(
     "ray_tpu_recovery_episodes_total",
     "Closed recovery episodes by kind.", tag_keys=("kind",))
@@ -396,10 +401,11 @@ DRAIN = "drain"
 FAILOVER = "failover"
 HEAL = "heal"
 REROLE = "rerole"
+RL_ACTOR = "rl_actor"
 
 # recovery SLO targets are read per closed episode — rare — but the
 # auditor sits on the event-put path, so ride the same generation cache
-_slo_cache = (-1, 0.0, 0.0, 0.0, 0.0)
+_slo_cache = (-1, 0.0, 0.0, 0.0, 0.0, 0.0)
 
 
 def _slos() -> tuple:
@@ -410,7 +416,8 @@ def _slos() -> tuple:
         cached = (gen, CONFIG.recovery_slo_drain_s,
                   CONFIG.recovery_slo_failover_s,
                   CONFIG.recovery_slo_heal_s,
-                  CONFIG.recovery_slo_rerole_s)
+                  CONFIG.recovery_slo_rerole_s,
+                  CONFIG.recovery_slo_rl_actor_s)
         _slo_cache = cached
     return cached
 
@@ -482,6 +489,10 @@ class RecoveryAuditor:
             self._on_rerole(ev)
         elif etype == "SERVE_REROLE_DONE":
             self._on_rerole_done(ev)
+        elif etype == "RL_ACTOR_LOST":
+            self._on_rl_actor_lost(ev)
+        elif etype == "RL_ACTOR_JOINED":
+            self._on_rl_actor_joined(ev)
         elif etype == "TRANSFER_FAILOVER":
             with self._lock:
                 self._transfer_failovers += 1
@@ -663,6 +674,20 @@ class RecoveryAuditor:
         self._close_episode(REROLE, key, ev, _slos()[4], _M_REROLE_S,
                             src_replicas=ev.get("src_replicas"),
                             dst_replicas=ev.get("dst_replicas"))
+
+    def _on_rl_actor_lost(self, ev: Dict[str, Any]) -> None:
+        # keyed per fleet slot: the executor replaces an actor in its
+        # own slot, so LOST(run, slot) pairs with the next JOINED of
+        # the same slot
+        key = f"{ev.get('run_id')}/{ev.get('slot')}"
+        self._open_episode(RL_ACTOR, key, ev, run_id=ev.get("run_id"),
+                           slot=ev.get("slot"), reason=ev.get("reason"))
+
+    def _on_rl_actor_joined(self, ev: Dict[str, Any]) -> None:
+        key = f"{ev.get('run_id')}/{ev.get('slot')}"
+        self._close_episode(RL_ACTOR, key, ev, _slos()[5], _M_RL_ACTOR_S,
+                            weight_version=ev.get("weight_version"),
+                            weight_pull_ms=ev.get("weight_pull_ms"))
 
     # ---------------------------------------------------------- views
     def list(self, kind: Optional[str] = None,

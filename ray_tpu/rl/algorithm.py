@@ -68,6 +68,33 @@ def apply_full_training_state(algo, full: dict) -> None:
         setattr(algo, c, v)
 
 
+def init_actor_critic(cfg):
+    """Probe ``cfg.env_spec`` and build the shared ActorCritic tower:
+    returns (model, params, continuous, logp_fn, ent_fn).  Module-level
+    so the podracer LearnerActor builds the identical tower from a bare
+    config object without instantiating an Algorithm (which would spawn
+    a WorkerSet inside the learner process)."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rl import models as M
+    from ray_tpu.rl.env import Box, make_env
+    probe = make_env(cfg.env_spec)
+    continuous = isinstance(probe.action_space, Box)
+    act_dim = int(np.prod(probe.action_space.shape)) if continuous \
+        else probe.action_space.n
+    obs_dim = int(np.prod(probe.observation_space.shape))
+    probe.close()
+    model = M.ActorCritic(action_dim=act_dim, hidden=tuple(cfg.hidden),
+                          continuous=continuous)
+    params = model.init(jax.random.PRNGKey(cfg.seed or 0),
+                        jnp.zeros((1, obs_dim)))["params"]
+    if continuous:
+        logp_fn, ent_fn = M.diag_gaussian_logp, M.diag_gaussian_entropy
+    else:
+        logp_fn, ent_fn = M.categorical_logp, M.categorical_entropy
+    return model, params, continuous, logp_fn, ent_fn
+
+
 class AlgorithmConfig:
     """Fluent builder: ``PPOConfig().environment("CartPole-v1")
     .rollouts(num_rollout_workers=2).training(lr=5e-5).build()``."""
@@ -90,6 +117,8 @@ class AlgorithmConfig:
         self.hidden = (256, 256)
         self.seed: Optional[int] = None
         self.mesh_shape: Optional[Dict[str, int]] = None
+        self.use_podracer = False
+        self.podracer_kwargs: Dict[str, Any] = {}
         self.extra: Dict[str, Any] = {}
 
     # -- fluent sections (reference names) --------------------------------
@@ -139,6 +168,16 @@ class AlgorithmConfig:
         self.extra.update(kwargs)
         return self
 
+    def podracer(self, enabled: bool = True,
+                 **kwargs) -> "AlgorithmConfig":
+        """Run on the streaming learner–actor executor
+        (docs/rl_podracer.md) instead of the blocking driver.  Extra
+        kwargs (e.g. ``strict_zero_submit=False``) reach the
+        PodracerExecutor constructor."""
+        self.use_podracer = enabled
+        self.podracer_kwargs.update(kwargs)
+        return self
+
     def build(self) -> "Algorithm":
         if self.algo_class is None:
             raise ValueError("use a concrete config (PPOConfig, ...)")
@@ -152,10 +191,29 @@ class Algorithm:
     """Base driver: owns the WorkerSet + learner; subclasses implement
     training_step() returning a result dict."""
 
+    # subclasses that ride the podracer executor name their step
+    # builder here ("impala" / "ppo"); None = classic-only algorithm
+    podracer_algo: Optional[str] = None
+
     def __init__(self, config: AlgorithmConfig):
         self.config = config
         if config.env_spec is None:
             raise ValueError("config.environment(env) is required")
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._episode_history: List[Dict[str, float]] = []
+        if getattr(config, "use_podracer", False):
+            if self.podracer_algo is None:
+                raise ValueError(
+                    f"{type(self).__name__} does not support the "
+                    "podracer executor (only IMPALA/PPO do)")
+            from ray_tpu.rl.podracer import PodracerExecutor
+            self.workers = None
+            self.podracer = PodracerExecutor(
+                self.podracer_algo, config,
+                **getattr(config, "podracer_kwargs", {}))
+            return
+        self.podracer = None
         worker_kwargs = dict(
             num_envs=config.num_envs_per_worker,
             rollout_fragment_length=config.rollout_fragment_length,
@@ -167,9 +225,6 @@ class Algorithm:
             num_workers=max(config.num_rollout_workers, 1),
             worker_kwargs=worker_kwargs,
             recreate_failed_workers=config.recreate_failed_workers)
-        self.iteration = 0
-        self._timesteps_total = 0
-        self._episode_history: List[Dict[str, float]] = []
         self.setup_learner()
         self.workers.sync_weights(self.get_weights())
 
@@ -190,26 +245,7 @@ class Algorithm:
         """Probe the env and build the shared ActorCritic tower: returns
         (model, params, continuous, logp_fn, ent_fn). Used by the whole
         on-policy family (PG/A2C/PPO/IMPALA/APPO)."""
-        import jax
-        import jax.numpy as jnp
-        from ray_tpu.rl import models as M
-        from ray_tpu.rl.env import Box, make_env
-        cfg = self.config
-        probe = make_env(cfg.env_spec)
-        continuous = isinstance(probe.action_space, Box)
-        act_dim = int(np.prod(probe.action_space.shape)) if continuous \
-            else probe.action_space.n
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        probe.close()
-        model = M.ActorCritic(action_dim=act_dim, hidden=tuple(cfg.hidden),
-                              continuous=continuous)
-        params = model.init(jax.random.PRNGKey(cfg.seed or 0),
-                            jnp.zeros((1, obs_dim)))["params"]
-        if continuous:
-            logp_fn, ent_fn = M.diag_gaussian_logp, M.diag_gaussian_entropy
-        else:
-            logp_fn, ent_fn = M.categorical_logp, M.categorical_entropy
-        return model, params, continuous, logp_fn, ent_fn
+        return init_actor_critic(self.config)
 
     def gather_on_policy_batch(self, min_size: int):
         """synchronous_parallel_sample: pull worker fragments until the
@@ -258,20 +294,28 @@ class Algorithm:
     # -- public API --------------------------------------------------------
     def train(self) -> Dict[str, Any]:
         t0 = time.perf_counter()
-        result = self.training_step()
+        if self.podracer is not None:
+            result = self.podracer.train_iteration()
+            self._timesteps_total = result.pop("timesteps_this_iter")
+            # episode metrics ride the fragment stream's meta (no extra
+            # foreach_worker round trip in podracer mode)
+            self._episode_history = \
+                self.podracer.collect_episode_metrics()
+            metrics = self._summarize_episodes()
+            restarts = self.podracer.telemetry["replacements"]
+        else:
+            result = self.training_step()
+            metrics = self._collect_episode_metrics()
+            restarts = self.workers.num_restarts
         self.iteration += 1
-        metrics = self._collect_episode_metrics()
         result.update(metrics)
         result["training_iteration"] = self.iteration
         result["timesteps_total"] = self._timesteps_total
         result["time_this_iter_s"] = time.perf_counter() - t0
-        result["num_worker_restarts"] = self.workers.num_restarts
+        result["num_worker_restarts"] = restarts
         return result
 
-    def _collect_episode_metrics(self) -> Dict[str, Any]:
-        for eps in self.workers.foreach_worker("get_metrics"):
-            self._episode_history.extend(eps)
-        self._episode_history = self._episode_history[-100:]
+    def _summarize_episodes(self) -> Dict[str, Any]:
         if not self._episode_history:
             return {"episode_reward_mean": float("nan"),
                     "episode_len_mean": float("nan"), "episodes_total": 0}
@@ -283,6 +327,12 @@ class Algorithm:
                 "episode_len_mean": float(np.mean(lens)),
                 "episodes_total": len(self._episode_history)}
 
+    def _collect_episode_metrics(self) -> Dict[str, Any]:
+        for eps in self.workers.foreach_worker("get_metrics"):
+            self._episode_history.extend(eps)
+        self._episode_history = self._episode_history[-100:]
+        return self._summarize_episodes()
+
     def get_full_state(self):
         """Complete training state for checkpointing — actor AND critics,
         target networks, optimizer moments, sync counters (reference
@@ -292,12 +342,17 @@ class Algorithm:
         params/target_params/opt_state attributes (PPO/DQN style).
         Returns None only for algorithms with neither (they fall back to
         weights-only checkpoints)."""
+        if self.podracer is not None:
+            return self.podracer.get_full_state()
         return full_training_state(self)
 
     # (helpers defined at module scope so the standalone offline
     # algorithms — CQL/CRR/MADDPG — share the exact same protocol)
 
     def set_full_state(self, state) -> None:
+        if self.podracer is not None:
+            self.podracer.set_full_state(state)
+            return
         apply_full_training_state(self, state)
 
     def save(self) -> Checkpoint:
@@ -312,14 +367,22 @@ class Algorithm:
         d = checkpoint.to_dict()
         if d.get("state") is not None:
             self.set_full_state(d["state"])
+        elif self.podracer is not None:
+            self.podracer.set_weights(d["weights"])
         else:
             # legacy weight-only checkpoint (or weight-only algorithm)
             self.set_weights(d["weights"])
         self.iteration = d.get("iteration", 0)
         self._timesteps_total = d.get("timesteps_total", 0)
-        self.workers.sync_weights(self.get_weights())
+        if self.podracer is None:
+            self.workers.sync_weights(self.get_weights())
+        # podracer: set_full_state/set_weights republished a version;
+        # every actor adopts it at its next fragment boundary
 
     def stop(self) -> None:
+        if self.podracer is not None:
+            self.podracer.stop()
+            return
         self.workers.stop()
 
     @classmethod

@@ -62,58 +62,75 @@ class ImpalaConfig(AlgorithmConfig):
         self.algo_class = Impala
 
 
+def make_impala_optimizer(cfg) -> "optax.GradientTransformation":
+    return optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                       optax.rmsprop(cfg.lr, decay=0.99))
+
+
+def make_impala_sgd_step(model, logp_fn, ent_fn, tx, cfg):
+    """The jitted V-trace learner step over a [T, B] time-major fragment
+    — built once here so the classic driver and the podracer compiled-
+    DAG learner train with identical math."""
+    gamma = cfg.gamma
+    vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+    rho_bar, c_bar = cfg.vtrace_rho_bar, cfg.vtrace_c_bar
+
+    def loss_fn(params, batch):
+        T, B = batch[SB.REWARDS].shape
+        obs = batch[SB.OBS]
+        flat_obs = obs.reshape((T * B,) + obs.shape[2:])
+        logits, values = model.apply({"params": params}, flat_obs)
+        logits = logits.reshape((T, B) + logits.shape[1:])
+        values = values.reshape(T, B)
+        boot_logits, boot_value = model.apply(
+            {"params": params}, batch["bootstrap_obs"])
+        target_logp = logp_fn(logits, batch[SB.ACTIONS])
+        discounts = gamma * (1.0 - batch[SB.TERMINATEDS]
+                             .astype(jnp.float32))
+        vs, pg_adv = vtrace(target_logp, batch[SB.ACTION_LOGP],
+                            batch[SB.REWARDS], values, boot_value,
+                            discounts, rho_bar, c_bar)
+        pg_loss = -(target_logp * pg_adv).mean()
+        vf_loss = 0.5 * jnp.square(vs - values).mean()
+        entropy = ent_fn(logits).mean()
+        total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    @jax.jit
+    def sgd_step(params, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        aux["total_loss"] = loss
+        return params, opt_state, aux
+
+    return sgd_step
+
+
 class Impala(Algorithm):
+    podracer_algo = "impala"
+
     def setup_learner(self) -> None:
         cfg: ImpalaConfig = self.config
         self.model, self.params, _, logp_fn, ent_fn = \
             self.init_actor_critic()
-        self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
-                              optax.rmsprop(cfg.lr, decay=0.99))
+        self.tx = make_impala_optimizer(cfg)
         self.opt_state = self.tx.init(self.params)
         self._inflight: Dict[Any, int] = {}   # ref -> worker index
-
-        model, gamma = self.model, cfg.gamma
-        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
-        rho_bar, c_bar = cfg.vtrace_rho_bar, cfg.vtrace_c_bar
-        tx = self.tx
-
-        def loss_fn(params, batch):
-            T, B = batch[SB.REWARDS].shape
-            obs = batch[SB.OBS]
-            flat_obs = obs.reshape((T * B,) + obs.shape[2:])
-            logits, values = model.apply({"params": params}, flat_obs)
-            logits = logits.reshape((T, B) + logits.shape[1:])
-            values = values.reshape(T, B)
-            boot_logits, boot_value = model.apply(
-                {"params": params}, batch["bootstrap_obs"])
-            target_logp = logp_fn(logits, batch[SB.ACTIONS])
-            discounts = gamma * (1.0 - batch[SB.TERMINATEDS]
-                                 .astype(jnp.float32))
-            vs, pg_adv = vtrace(target_logp, batch[SB.ACTION_LOGP],
-                                batch[SB.REWARDS], values, boot_value,
-                                discounts, rho_bar, c_bar)
-            pg_loss = -(target_logp * pg_adv).mean()
-            vf_loss = 0.5 * jnp.square(vs - values).mean()
-            entropy = ent_fn(logits).mean()
-            total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
-            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
-                           "entropy": entropy}
-
-        @jax.jit
-        def sgd_step(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            return params, opt_state, aux
-
-        self._sgd_step = sgd_step
+        self._sgd_step = make_impala_sgd_step(
+            self.model, logp_fn, ent_fn, self.tx, cfg)
 
     def get_weights(self) -> Any:
+        if self.podracer is not None:
+            return self.podracer.get_weights()
         return jax.tree.map(np.asarray, self.params)
 
     def set_weights(self, weights: Any) -> None:
+        if self.podracer is not None:
+            self.podracer.set_weights(weights)
+            return
         self.params = jax.tree.map(jnp.asarray, weights)
 
     def _submit(self, idx: int) -> None:
@@ -164,5 +181,5 @@ class Impala(Algorithm):
         return {"info": info}
 
     def stop(self) -> None:
-        self._inflight.clear()
+        getattr(self, "_inflight", {}).clear()
         super().stop()

@@ -1,0 +1,136 @@
+"""Podracer learner actor: the compiled-DAG step + weight publishing.
+
+``step()`` is the DAG op — the executor compiles ``inp ->
+learner.step.bind(inp)`` once, then every fragment travels a shm
+channel write + one get: zero classic task submissions in steady state.
+Weight publishing happens INSIDE ``step()`` (every
+``podracer_sync_every_steps`` optimizer steps): ``ray_tpu.put`` + the
+KV pointer bump are object/KV-plane operations issued from the learner
+process, so a steady-state training loop moves the driver's
+``ray_tpu_actor_tasks_submitted_total`` counter by exactly zero.
+
+The loss/step math is built by the SAME module-level builders the
+classic drivers use (``make_impala_sgd_step`` / ``make_ppo_sgd_step``),
+so podracer and blocking training are numerically the same algorithm —
+the data plane is the only thing that changed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.podracer.weights import WeightPublisher
+
+# fragment columns each algorithm's loss actually consumes — extra
+# rollout columns stay host-side instead of riding device_put
+_IMPALA_KEYS = (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.TERMINATEDS,
+                SB.ACTION_LOGP, "bootstrap_obs")
+_PPO_KEYS = (SB.OBS, SB.ACTIONS, SB.ACTION_LOGP, SB.ADVANTAGES,
+             SB.VALUE_TARGETS, SB.VF_PREDS)
+
+
+class LearnerActor:
+    """Owns params/opt_state; steps on fragments; publishes weights."""
+
+    def __init__(self, algo: str, config, weights_name: str):
+        import jax.numpy as jnp
+        from ray_tpu.rl.algorithm import init_actor_critic
+        self.algo = algo
+        self.config = config
+        model, params, _, logp_fn, ent_fn = init_actor_critic(config)
+        self.model = model
+        if algo == "impala":
+            from ray_tpu.rl.impala import (make_impala_optimizer,
+                                           make_impala_sgd_step)
+            self.tx = make_impala_optimizer(config)
+            self._sgd_step = make_impala_sgd_step(
+                model, logp_fn, ent_fn, self.tx, config)
+            self._keys = _IMPALA_KEYS
+        elif algo == "ppo":
+            from ray_tpu.rl.ppo import make_ppo_optimizer, make_ppo_sgd_step
+            self.tx = make_ppo_optimizer(config)
+            self._sgd_step = make_ppo_sgd_step(
+                model, logp_fn, ent_fn, self.tx, config)
+            self._keys = _PPO_KEYS
+        else:
+            raise ValueError(
+                f"podracer supports impala/ppo, got {algo!r}")
+        self.params = params
+        self.opt_state = self.tx.init(params)
+        self._jnp = jnp
+        self._publisher = WeightPublisher(weights_name)
+        self._step_no = 0
+        self._frames = 0
+        self._sync_every = max(1, int(CONFIG.podracer_sync_every_steps))
+
+    # --------------------------------------------------- classic methods
+    def ready(self) -> bool:
+        """Creation fence (the DAG compiler requires a live actor)."""
+        return True
+
+    def publish_now(self) -> int:
+        """Initial version so the fleet rendezvous has weights to pull
+        before the first learner step."""
+        return self._publisher.publish(self.get_weights())
+
+    def get_weights(self) -> Any:
+        import jax
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        import jax
+        self.params = jax.tree.map(self._jnp.asarray, weights)
+
+    def get_state(self) -> dict:
+        """Checkpoint envelope (same v2 protocol as Algorithm)."""
+        from ray_tpu.rl.algorithm import full_training_state
+        state = full_training_state(self) or {}
+        state["_step_no"] = self._step_no
+        state["_frames"] = self._frames
+        return state
+
+    def set_state(self, state: dict) -> int:
+        from ray_tpu.rl.algorithm import apply_full_training_state
+        self._step_no = int(state.pop("_step_no", 0))
+        self._frames = int(state.pop("_frames", 0))
+        apply_full_training_state(self, state)
+        return self.publish_now()
+
+    def stats(self) -> dict:
+        return {"steps": self._step_no, "frames": self._frames,
+                "weight_version": self._publisher.version,
+                "weight_payload_nbytes":
+                    self._publisher.last_payload_nbytes}
+
+    # ---------------------------------------------------- compiled-DAG op
+    def step(self, payload: Tuple[Any, dict]) -> dict:
+        fragment, meta = payload
+        jnp = self._jnp
+        batch = {k: jnp.asarray(fragment[k]) for k in self._keys
+                 if k in fragment}
+        self.params, self.opt_state, aux = self._sgd_step(
+            self.params, self.opt_state, batch)
+        self._step_no += 1
+        frames = int(np.asarray(fragment[SB.REWARDS]).size)
+        self._frames += frames
+        published = 0
+        if self._step_no % self._sync_every == 0:
+            published = self._publisher.publish(self.get_weights())
+        return {"aux": {k: float(v) for k, v in aux.items()},
+                "step": self._step_no,
+                "frames": frames,
+                "published_version": published,
+                "weight_payload_nbytes":
+                    self._publisher.last_payload_nbytes,
+                "learner_ts": time.time()}
+
+
+def learner_actor_class(num_cpus: float = 1.0, num_tpus: float = 0.0):
+    import ray_tpu
+    return ray_tpu.remote(num_cpus=num_cpus,
+                          num_tpus=num_tpus)(LearnerActor)

@@ -32,14 +32,61 @@ class PPOConfig(AlgorithmConfig):
         self.algo_class = PPO
 
 
+def make_ppo_optimizer(cfg) -> "optax.GradientTransformation":
+    return optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                       optax.adam(cfg.lr))
+
+
+def make_ppo_sgd_step(model, logp_fn, ent_fn, tx, cfg):
+    """The jitted clipped-surrogate learner step — built once here so
+    the mesh-sharded driver and the podracer compiled-DAG learner train
+    with identical math."""
+    clip, vf_clip = cfg.clip_param, cfg.vf_clip_param
+    vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+
+    def loss_fn(params, batch):
+        logits, values = model.apply({"params": params}, batch[SB.OBS])
+        logp = logp_fn(logits, batch[SB.ACTIONS])
+        ratio = jnp.exp(logp - batch[SB.ACTION_LOGP])
+        adv = batch[SB.ADVANTAGES]
+        adv = (adv - adv.mean()) / jnp.maximum(adv.std(), 1e-4)
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        vf_targets = batch[SB.VALUE_TARGETS]
+        vf_err = jnp.square(values - vf_targets)
+        vf_clipped = batch[SB.VF_PREDS] + jnp.clip(
+            values - batch[SB.VF_PREDS], -vf_clip, vf_clip)
+        vf_err2 = jnp.square(vf_clipped - vf_targets)
+        vf_loss = 0.5 * jnp.maximum(vf_err, vf_err2)
+        entropy = ent_fn(logits)
+        total = (-surr + vf_coeff * vf_loss - ent_coeff * entropy).mean()
+        kl = (batch[SB.ACTION_LOGP] - logp).mean()
+        return total, {"policy_loss": -surr.mean(),
+                       "vf_loss": vf_loss.mean(),
+                       "entropy": entropy.mean(), "kl": kl}
+
+    @jax.jit
+    def sgd_step(params, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        aux["total_loss"] = loss
+        aux["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, aux
+
+    return sgd_step
+
+
 class PPO(Algorithm):
+    podracer_algo = "ppo"
+
     def setup_learner(self) -> None:
         cfg: PPOConfig = self.config
         self.model, params, self.continuous, logp_fn, ent_fn = \
             self.init_actor_critic()
-        self.tx = optax.chain(
-            optax.clip_by_global_norm(cfg.grad_clip),
-            optax.adam(cfg.lr))
+        self.tx = make_ppo_optimizer(cfg)
 
         # learner mesh: data-parallel over every local device
         self.build_learner_mesh()
@@ -47,49 +94,18 @@ class PPO(Algorithm):
         self.opt_state = jax.device_put(self.tx.init(params),
                                         self.repl_sharding)
         self.params = params
-        model = self.model
-        clip, vf_clip = cfg.clip_param, cfg.vf_clip_param
-        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
-        tx = self.tx
-
-        def loss_fn(params, batch):
-            logits, values = model.apply({"params": params}, batch[SB.OBS])
-            logp = logp_fn(logits, batch[SB.ACTIONS])
-            ratio = jnp.exp(logp - batch[SB.ACTION_LOGP])
-            adv = batch[SB.ADVANTAGES]
-            adv = (adv - adv.mean()) / jnp.maximum(adv.std(), 1e-4)
-            surr = jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
-            vf_targets = batch[SB.VALUE_TARGETS]
-            vf_err = jnp.square(values - vf_targets)
-            vf_clipped = batch[SB.VF_PREDS] + jnp.clip(
-                values - batch[SB.VF_PREDS], -vf_clip, vf_clip)
-            vf_err2 = jnp.square(vf_clipped - vf_targets)
-            vf_loss = 0.5 * jnp.maximum(vf_err, vf_err2)
-            entropy = ent_fn(logits)
-            total = (-surr + vf_coeff * vf_loss - ent_coeff * entropy).mean()
-            kl = (batch[SB.ACTION_LOGP] - logp).mean()
-            return total, {"policy_loss": -surr.mean(),
-                           "vf_loss": vf_loss.mean(),
-                           "entropy": entropy.mean(), "kl": kl}
-
-        @jax.jit
-        def sgd_step(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = loss
-            aux["grad_norm"] = optax.global_norm(grads)
-            return params, opt_state, aux
-
-        self._sgd_step = sgd_step
+        self._sgd_step = make_ppo_sgd_step(
+            self.model, logp_fn, ent_fn, self.tx, cfg)
 
     def get_weights(self) -> Any:
+        if self.podracer is not None:
+            return self.podracer.get_weights()
         return jax.tree.map(np.asarray, self.params)
 
     def set_weights(self, weights: Any) -> None:
+        if self.podracer is not None:
+            self.podracer.set_weights(weights)
+            return
         self.params = jax.device_put(
             jax.tree.map(jnp.asarray, weights), self.repl_sharding)
 

@@ -29,7 +29,14 @@ class SampleBatch(dict):
         super().__init__(*args, **kwargs)
         for k, v in list(self.items()):
             if not isinstance(v, np.ndarray):
-                self[k] = np.asarray(v)
+                v = np.asarray(v)
+            # columns must be C-contiguous: the serializer only ships
+            # contiguous buffers out-of-band (pickle-5), so a strided
+            # view (e.g. a [:, i] env slice) would silently fall back
+            # to an in-band row-wise copy on every fragment hop
+            if not v.flags.c_contiguous:
+                v = np.ascontiguousarray(v)
+            self[k] = v
 
     @property
     def count(self) -> int:

@@ -388,6 +388,105 @@ def test_replica_kill_heal_episode_audited():
         rt.shutdown()
 
 
+def test_impala_podracer_survives_rollout_actor_kill():
+    """Podracer fleet chaos (docs/rl_podracer.md failure semantics): kill
+    one free-running rollout actor mid-IMPALA-run.  The learner must
+    never stall — every train() during the outage keeps consuming the
+    surviving actors' streams and advancing timesteps — while a
+    replacement rendezvouses on a side thread, pulls current weights
+    multi-source, and rejoins the fleet.  The RL_ACTOR_LOST/JOINED event
+    pair is folded by the recovery auditor into an rl_actor episode
+    whose latency matches the raw event timestamps."""
+    import ray_tpu as rt
+    from ray_tpu.experimental import state
+    from ray_tpu.rl.impala import ImpalaConfig
+
+    rt.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    algo = None
+    try:
+        algo = (ImpalaConfig().environment("CartPole-v1")
+                .rollouts(num_rollout_workers=3,
+                          rollout_fragment_length=25)
+                .training(batches_per_step=4)
+                .debugging(seed=0)
+                .podracer()
+                .build())
+        ex = algo.podracer
+        r = algo.train()
+        assert r["timesteps_total"] > 0
+
+        rt.kill(ex._slots[1]["actor"])
+
+        # the learner never stalls: with 2 surviving free-running
+        # streams every iteration of the outage window still advances
+        # timesteps (a stall would TimeoutError inside train())
+        ts_prev = r["timesteps_total"]
+        deadline = time.monotonic() + 180
+        while (ex.telemetry["replacements"] < 1
+               and time.monotonic() < deadline):
+            r = algo.train()
+            assert r["timesteps_total"] > ts_prev, \
+                "learner stalled during actor outage"
+            ts_prev = r["timesteps_total"]
+        assert ex.telemetry["replacements"] >= 1, \
+            "replacement actor never joined"
+        # steady-state windows stayed submission-free through the chaos
+        assert ex.telemetry["classic_submits_steady"] == 0
+
+        lost = None
+        joined = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not (lost and joined):
+            lost = next((e for e in state.list_cluster_events(
+                type="RL_ACTOR_LOST") if e.get("run_id") == ex.run_id
+                and e.get("slot") == 1), None)
+            joined = next((e for e in state.list_cluster_events(
+                type="RL_ACTOR_JOINED") if e.get("run_id") == ex.run_id
+                and e.get("slot") == 1), None)
+            time.sleep(0.3)
+        assert lost is not None, "RL_ACTOR_LOST never reached the GCS"
+        assert joined is not None, "RL_ACTOR_JOINED never reached the GCS"
+        # the rejoin rendezvous pulled CURRENT weights (not version 1:
+        # the learner kept publishing throughout the outage)
+        assert joined["weight_version"] > 1
+        assert joined.get("weight_pull_ms", 0) >= 0
+
+        ep = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and ep is None:
+            eps = [e for e in state.list_recovery_episodes(
+                       kind="rl_actor", include_open=False)
+                   if e.get("key") == f"{ex.run_id}/1"]
+            if eps:
+                ep = eps[-1]
+            else:
+                time.sleep(0.3)
+        assert ep is not None, "auditor never closed the rl_actor episode"
+        assert ep["opening_type"] == "RL_ACTOR_LOST"
+        assert ep["closing_type"] == "RL_ACTOR_JOINED"
+        assert abs(ep["latency_s"] - (joined["ts"] - lost["ts"])) < 0.05
+        assert ep["weight_version"] == joined["weight_version"]
+        # default rl_actor SLO (recovery_slo_rl_actor_s): 60 s
+        assert ep["slo_s"] == 60.0
+        assert ep["violation"] == (ep["latency_s"] > 60.0)
+
+        # post-rejoin the full fleet trains on
+        r = algo.train()
+        assert r["timesteps_total"] > ts_prev
+
+        from conftest import record_recovery_row
+        record_recovery_row({
+            "name": "rl_actor_rejoin", "latency_s": ep["latency_s"],
+            "weight_version": ep["weight_version"],
+            "slo_s": ep["slo_s"], "violation": ep["violation"],
+            "reference": "tests/test_chaos.py::"
+                         "test_impala_podracer_survives_rollout_actor_kill"})
+    finally:
+        if algo is not None:
+            algo.stop()
+        rt.shutdown()
+
+
 def test_disagg_serving_survives_replica_chaos():
     """Disaggregated LLM serving under replica chaos (docs/
     serve_disagg.md failure semantics): while 8 streams run against a

@@ -1,0 +1,227 @@
+"""Podracer RL data plane tests (docs/rl_podracer.md).
+
+Covers the three legs of the executor — streaming fragment ingestion,
+store-routed weight broadcast, compiled-DAG learner — plus the
+pickle-5 out-of-band SampleBatch contract and the rl_actor recovery
+episode the auditor derives from RL_ACTOR_LOST/JOINED events.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import sample_batch as SB
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+# --------------------------------------------------------- weight codec
+
+def test_weight_codec_roundtrip_raw_and_int8():
+    """encode/decode is exact in raw mode and within the Int8Codec
+    block-scale bound when quantized; non-float leaves always ride raw."""
+    from ray_tpu.rl.podracer.weights import decode_weights, encode_weights
+    tree = {"a": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                  "b": np.zeros(3, np.float32)},
+            "step": np.array(7)}
+
+    out = decode_weights(encode_weights(tree, quantize=False))
+    np.testing.assert_array_equal(out["a"]["w"], tree["a"]["w"])
+    assert out["step"] == 7
+
+    q = encode_weights(tree, quantize=True)
+    assert q["codec"] == "int8"
+    outq = decode_weights(q)
+    assert outq["a"]["w"].shape == (3, 4)
+    assert outq["a"]["w"].dtype == np.float32
+    # block-scaled int8: error bounded by blockmax/254
+    bound = np.abs(tree["a"]["w"]).max() / 254 + 1e-7
+    assert np.abs(outq["a"]["w"] - tree["a"]["w"]).max() <= bound
+    # integer leaf survives exactly even under quantize
+    assert outq["step"] == 7
+
+
+def test_weight_publisher_follower_version_skip(ray_start_regular):
+    """The follower adopts the NEWEST version in one pull when multiple
+    publishes happened since its last poll (the version-skip rule), and
+    a poll with nothing new returns None."""
+    from ray_tpu.rl.podracer.weights import WeightFollower, WeightPublisher
+    pub = WeightPublisher("skiptest")
+    fol = WeightFollower("skiptest")
+    try:
+        assert fol.poll() is None          # nothing published yet
+
+        tree = {"w": np.ones((4, 4), np.float32)}
+        pub.publish(tree)
+        got, ver = fol.poll()
+        assert ver == 1
+        np.testing.assert_array_equal(got["w"], tree["w"])
+        assert fol.poll() is None          # same version: no re-pull
+
+        # three publishes back to back: one poll lands on v4, skipping 2
+        for k in range(2, 5):
+            pub.publish({"w": np.full((4, 4), float(k), np.float32)})
+        got, ver = fol.poll()
+        assert ver == 4
+        np.testing.assert_array_equal(got["w"], np.full((4, 4), 4.0))
+        assert fol.versions_skipped == 2
+    finally:
+        pub.clear()
+
+
+# --------------------------------------- SampleBatch pickle-5 contract
+
+def test_sample_batch_ships_columns_out_of_band():
+    """Every column of a SampleBatch rides pickle-5 out-of-band —
+    including columns built from non-contiguous inputs, which __init__
+    must coerce to C-contiguous (a strided view would otherwise fall
+    back to an in-band copy)."""
+    from ray_tpu._private import serialization as ser
+    base = np.arange(1 << 14, dtype=np.float32).reshape(128, 128)
+    batch = SampleBatch({
+        SB.OBS: base,
+        SB.REWARDS: base.T,                    # transposed: not contiguous
+        SB.ACTIONS: np.arange(256, dtype=np.float32)[::2],  # strided
+    })
+    for col in batch.values():
+        assert col.flags.c_contiguous
+    payload = sum(col.nbytes for col in batch.values())
+    head, views = ser.serialize(batch)
+    assert sum(len(v) for v in views) >= payload   # out-of-band, no copy
+    out = ser.deserialize(ser.to_flat_bytes(head, views))
+    np.testing.assert_array_equal(out[SB.REWARDS], base.T)
+    np.testing.assert_array_equal(out[SB.ACTIONS],
+                                  np.arange(256, dtype=np.float32)[::2])
+
+
+def test_sample_batch_store_roundtrip_pins_shm(ray_start_regular):
+    """A large SampleBatch put+get maps straight out of the shared-memory
+    store: the driver holds shm pins while the value is live (the
+    ray_tpu_shm_pins gauge counts them) and the columns round-trip."""
+    import ray_tpu
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu.runtime import core_worker as cw
+
+    batch = SampleBatch({
+        SB.OBS: np.arange(1 << 18, dtype=np.float32).reshape(1024, 256),
+        SB.REWARDS: np.ones(1024, np.float32),
+    })
+    ref = ray_tpu.put(batch)
+    out = ray_tpu.get(ref, timeout=30)
+    worker = cw.get_global_worker()
+    assert sum(worker._pins.values()) >= 1
+    snap = rtm.snapshot()
+    gauge = snap.get("ray_tpu_shm_pins")
+    assert gauge is not None and sum(gauge["values"].values()) >= 1
+    np.testing.assert_array_equal(out[SB.OBS], batch[SB.OBS])
+
+
+# ------------------------------------------------------ executor e2e
+
+def test_impala_podracer_zero_submissions_steady_state(ray_start_podracer):
+    """IMPALA on the podracer plane: timesteps advance, losses flow, the
+    fleet adopts published weight versions, and — the tentpole contract —
+    the driver submits ZERO classic actor tasks per steady-state learner
+    step (the inner loop runs entirely over the compiled DAG's channels;
+    strict_zero_submit raises inside train() if that regresses)."""
+    from ray_tpu.rl.impala import ImpalaConfig
+    algo = (ImpalaConfig().environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=25)
+            .training(batches_per_step=4)
+            .debugging(seed=0)
+            .podracer())
+    algo = algo.build()
+    try:
+        ts = []
+        for _ in range(3):
+            r = algo.train()
+            ts.append(r["timesteps_total"])
+        assert ts[0] > 0 and ts[2] > ts[1] > ts[0]
+        assert "total_loss" in r["info"]
+        ex = algo.podracer
+        assert ex.telemetry["classic_submits_steady"] == 0
+        assert ex.telemetry["learner_steps"] >= 12
+        # the learner published at least one version past the initial
+        # bootstrap and the whole fleet adopted it
+        assert r["info"]["weight_version"] >= 2
+        assert len(ex.telemetry["weight_adoption_s"]) >= 1
+        assert all(s >= 0 for s in ex.telemetry["weight_adoption_s"])
+    finally:
+        algo.stop()
+
+
+def test_ppo_podracer_checkpoint_roundtrip(ray_start_podracer):
+    """PPO rides the same executor; a full save/restore preserves the
+    optimizer + counters and training resumes (timesteps keep growing)."""
+    from ray_tpu.rl.ppo import PPOConfig
+    algo = (PPOConfig().environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=50)
+            .training(train_batch_size=200, sgd_minibatch_size=100)
+            .debugging(seed=0)
+            .podracer()
+            .build())
+    try:
+        r1 = algo.train()
+        assert r1["timesteps_total"] > 0
+        assert algo.podracer.telemetry["classic_submits_steady"] == 0
+        ckpt = algo.save()
+        algo.restore(ckpt)
+        r2 = algo.train()
+        assert r2["timesteps_total"] > r1["timesteps_total"]
+    finally:
+        algo.stop()
+
+
+# ----------------------------------------------------- recovery audit
+
+def _ev(etype, ts, **fields):
+    return dict(type=etype, ts=ts, **fields)
+
+
+def test_auditor_rl_actor_episode():
+    """RL_ACTOR_LOST -> RL_ACTOR_JOINED closes an rl_actor episode keyed
+    by run/slot whose latency is the event-timestamp delta, judged
+    against recovery_slo_rl_actor_s and carrying the rejoin's weight
+    version + pull latency."""
+    from ray_tpu._private.metrics_history import RecoveryAuditor
+
+    a = RecoveryAuditor()
+    t0 = 5000.0
+    a.observe([
+        _ev("RL_ACTOR_LOST", t0, run_id="podracer-impala-abc", slot=1,
+            reason="ConnectionError('stream')"),
+        _ev("RL_ACTOR_JOINED", t0 + 3.5, run_id="podracer-impala-abc",
+            slot=1, weight_version=42, weight_pull_ms=12.5),
+    ])
+    eps = a.list(kind="rl_actor")
+    assert len(eps) == 1
+    ep = eps[0]
+    assert not ep["open"]
+    assert ep["key"] == "podracer-impala-abc/1"
+    assert ep["latency_s"] == 3.5
+    assert ep["opening_type"] == "RL_ACTOR_LOST"
+    assert ep["closing_type"] == "RL_ACTOR_JOINED"
+    assert ep["weight_version"] == 42
+    assert ep["weight_pull_ms"] == 12.5
+    assert ep["slo_s"] == 60.0 and not ep["violation"]
+
+    # a different slot is a different episode; blowing the SLO flags it
+    a.observe([
+        _ev("RL_ACTOR_LOST", t0 + 10, run_id="podracer-impala-abc",
+            slot=2, reason="killed"),
+        _ev("RL_ACTOR_JOINED", t0 + 80, run_id="podracer-impala-abc",
+            slot=2, weight_version=50),
+    ])
+    ep2 = a.list(kind="rl_actor")[-1]
+    assert ep2["key"].endswith("/2")
+    assert ep2["latency_s"] == 70.0 and ep2["violation"]
+
+
+@pytest.fixture
+def ray_start_podracer():
+    """Podracer fleets need headroom beyond ray_start_regular's 4 CPUs:
+    1 learner + 2 rollout actors + replacement slack."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
